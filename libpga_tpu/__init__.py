@@ -1,0 +1,93 @@
+"""libpga_tpu — a TPU-native genetic-algorithm framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of pbalcer/libpga
+(reference: /root/reference — a CUDA C library running generational GAs with
+one CUDA thread per individual, tournament selection, and pluggable
+objective/crossover/mutate device functions; see `include/pga.h` for the
+capability contract).
+
+Design stance (TPU-first, not a port):
+
+- The population is an HBM-resident ``(pop_size, genome_len)`` float array.
+  The reference's double-buffered generations (``pga.h:124-129``) become
+  functional updates with XLA buffer donation — no explicit swap.
+- User callbacks (``obj_f``/``mutate_f``/``crossover_f``, ``pga.h:46-48``)
+  are Python callables traced per-individual and ``vmap``-ed across the
+  population, replacing CUDA device-function pointers.
+- The whole generation step (evaluate → tournament-select → crossover →
+  mutate) is ONE jitted XLA program (optionally a fused Pallas kernel),
+  versus the reference's chunked kernel launches with a full device sync
+  after every operator (``src/pga.cu:62-77,269``).
+- Islands are sharded across TPU cores with ``shard_map``; migration — which
+  the reference declared but never implemented (``pga.cu:368-374,393-395``)
+  — is a ``lax.ppermute`` ring neighbor-exchange over ICI.
+"""
+
+from libpga_tpu.config import PGAConfig
+from libpga_tpu.population import Population
+from libpga_tpu.engine import PGA
+from libpga_tpu import ops
+from libpga_tpu import objectives
+from libpga_tpu import parallel
+from libpga_tpu.api import (
+    pga_init,
+    pga_deinit,
+    pga_create_population,
+    pga_set_objective_function,
+    pga_set_mutate_function,
+    pga_set_crossover_function,
+    pga_get_best,
+    pga_get_best_top,
+    pga_get_best_all,
+    pga_get_best_top_all,
+    pga_evaluate,
+    pga_evaluate_all,
+    pga_crossover,
+    pga_crossover_all,
+    pga_migrate,
+    pga_migrate_between,
+    pga_mutate,
+    pga_mutate_all,
+    pga_swap_generations,
+    pga_fill_random_values,
+    pga_run,
+    pga_run_islands,
+    RANDOM_POPULATION,
+    TOURNAMENT,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "PGA",
+    "PGAConfig",
+    "Population",
+    "ops",
+    "objectives",
+    "parallel",
+    # C-shaped parity API
+    "pga_init",
+    "pga_deinit",
+    "pga_create_population",
+    "pga_set_objective_function",
+    "pga_set_mutate_function",
+    "pga_set_crossover_function",
+    "pga_get_best",
+    "pga_get_best_top",
+    "pga_get_best_all",
+    "pga_get_best_top_all",
+    "pga_evaluate",
+    "pga_evaluate_all",
+    "pga_crossover",
+    "pga_crossover_all",
+    "pga_migrate",
+    "pga_migrate_between",
+    "pga_mutate",
+    "pga_mutate_all",
+    "pga_swap_generations",
+    "pga_fill_random_values",
+    "pga_run",
+    "pga_run_islands",
+    "RANDOM_POPULATION",
+    "TOURNAMENT",
+]

@@ -1,0 +1,169 @@
+"""C-shaped parity API.
+
+A function-for-function mirror of the reference's public C API
+(``include/pga.h:53-150``) for users migrating from libpga: every
+``pga_*`` entry point exists with the same call shape and the same
+semantics — including the ones the reference declared but stubbed
+(``pga_get_best_top``, ``pga_get_best_all``, ``pga_get_best_top_all``,
+``pga_migrate``, ``pga_migrate_between``, ``pga_run_islands``, and
+``pga_run``'s early termination), which are fully implemented here.
+
+Pythonic differences, all deliberate:
+- ``pga_init`` takes an optional seed/config (the reference seeds cuRAND
+  with ``time(NULL)``, ``pga.cu:154``).
+- Callback setters take Python callables (or builtin objective names)
+  instead of ``__device__`` function pointers.
+- Best-genome getters return numpy arrays instead of malloc'd ``gene*``.
+
+The object API (:class:`libpga_tpu.engine.PGA`) is the primary surface;
+this module is a thin veneer over it.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import numpy as np
+
+from libpga_tpu.config import PGAConfig
+from libpga_tpu.engine import PGA, PopulationHandle
+
+# enum population_type (pga.h:31-34)
+RANDOM_POPULATION = "random"
+# enum crossover_selection_type (pga.h:39-42) — a placeholder in the
+# reference ("this is pretty much just a placeholder", pga.h:37); tournament
+# is the only strategy there (pga.cu:329) and the default here.
+TOURNAMENT = "tournament"
+
+
+def pga_init(seed: Optional[int] = None, config: Optional[PGAConfig] = None) -> PGA:
+    """Create a solver instance (``pga.h:53``)."""
+    if config is None:
+        # Reference parity: at most 10 populations per instance (pga.h:44).
+        config = PGAConfig(max_populations=10)
+    return PGA(seed=seed, config=config)
+
+
+def pga_deinit(pga: PGA) -> None:
+    """Release the instance (``pga.h:58``). Device buffers are freed by JAX
+    when unreferenced; this just drops them eagerly."""
+    pga._populations.clear()
+    pga._staged.clear()
+    pga._compiled.clear()
+
+
+def pga_create_population(
+    pga: PGA, size: int, genome_len: int, type: str = RANDOM_POPULATION
+) -> PopulationHandle:
+    """Create a (sub)population (``pga.h:63``)."""
+    return pga.create_population(size, genome_len, init=type)
+
+
+def pga_set_objective_function(pga: PGA, fn: Union[Callable, str]) -> None:
+    """Set the fitness function (``pga.h:72``)."""
+    pga.set_objective(fn)
+
+
+def pga_set_mutate_function(pga: PGA, fn: Optional[Callable]) -> None:
+    """Set the mutation; ``None`` restores the default (``pga.h:78``)."""
+    pga.set_mutate(fn)
+
+
+def pga_set_crossover_function(pga: PGA, fn: Optional[Callable]) -> None:
+    """Set the crossover; ``None`` restores the default (``pga.h:85``)."""
+    pga.set_crossover(fn)
+
+
+def pga_get_best(pga: PGA, pop: PopulationHandle) -> np.ndarray:
+    """Best genome of a population (``pga.h:90``)."""
+    return pga.get_best(pop)
+
+
+def pga_get_best_top(pga: PGA, pop: PopulationHandle, length: int) -> np.ndarray:
+    """Top-``length`` genomes (``pga.h:91``; stub in the reference)."""
+    return pga.get_best_top(pop, length)
+
+
+def pga_get_best_all(pga: PGA) -> np.ndarray:
+    """Best genome across all populations (``pga.h:92``; stub in the
+    reference)."""
+    return pga.get_best_all()
+
+
+def pga_get_best_top_all(pga: PGA, length: int) -> np.ndarray:
+    """Global top-``length`` across populations (``pga.h:93``; stub in the
+    reference)."""
+    return pga.get_best_top_all(length)
+
+
+def pga_evaluate(pga: PGA, pop: PopulationHandle) -> None:
+    """Score the current generation (``pga.h:98``)."""
+    pga.evaluate(pop)
+
+
+def pga_evaluate_all(pga: PGA) -> None:
+    """Score all populations (``pga.h:99``)."""
+    pga.evaluate_all()
+
+
+def pga_crossover(
+    pga: PGA, pop: PopulationHandle, selection: str = TOURNAMENT
+) -> None:
+    """Stage the next generation from the current one (``pga.h:105``)."""
+    pga.crossover(pop, selection)
+
+
+def pga_crossover_all(pga: PGA, selection: str = TOURNAMENT) -> None:
+    """Crossover every population (``pga.h:106``)."""
+    pga.crossover_all(selection)
+
+
+def pga_migrate(pga: PGA, pct: float) -> None:
+    """Randomly migrate top ``pct`` between populations (``pga.h:111``;
+    empty stub in the reference)."""
+    pga.migrate(pct)
+
+
+def pga_migrate_between(
+    pga: PGA, src: PopulationHandle, dst: PopulationHandle, pct: float
+) -> None:
+    """Migrate top ``pct`` from ``src`` to ``dst`` (``pga.h:115``; empty
+    stub in the reference)."""
+    pga.migrate_between(src, dst, pct)
+
+
+def pga_mutate(pga: PGA, pop: PopulationHandle) -> None:
+    """Mutate the staged next generation (``pga.h:120``)."""
+    pga.mutate(pop)
+
+
+def pga_mutate_all(pga: PGA) -> None:
+    """Mutate every staged generation (``pga.h:121``)."""
+    pga.mutate_all()
+
+
+def pga_swap_generations(pga: PGA, pop: PopulationHandle) -> None:
+    """Promote staged → current (``pga.h:129``)."""
+    pga.swap_generations(pop)
+
+
+def pga_fill_random_values(pga: PGA, pop: PopulationHandle) -> None:
+    """Advance the randomness stream (``pga.h:134``)."""
+    pga.fill_random_values(pop)
+
+
+def pga_run(
+    pga: PGA, n: int, target: Optional[float] = None
+) -> int:
+    """Run the standard GA on the first population (``pga.h:143``) —
+    including early termination at ``target``, which the reference header
+    promises (``pga.h:141``) but its implementation lacks."""
+    return pga.run(n, target=target)
+
+
+def pga_run_islands(
+    pga: PGA, n: int, m: int, pct: float, target: Optional[float] = None, mesh=None
+) -> int:
+    """Island GA with migration every ``m`` generations (``pga.h:150``;
+    empty stub in the reference)."""
+    return pga.run_islands(n, m, pct, target=target, mesh=mesh)

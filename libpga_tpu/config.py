@@ -1,0 +1,66 @@
+"""Runtime configuration.
+
+The reference's tuning surface is compile-time only: a ``SHARED_MEM`` define,
+``MAX_THREADS``, ``MAX_POPULATIONS=10``, ``TOURNAMENT_POPULATION=2``, a
+hardcoded ``blocks=8`` grid, and a mutation rate of 0.01 buried inside the
+default mutate callback (reference ``src/pga.cu:58,66,278,200,128``,
+``include/pga.h:44``). Here all of those are promoted to one runtime config
+object.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class PGAConfig:
+    """Configuration for a PGA solver instance.
+
+    Attributes:
+      tournament_size: number of candidates per tournament (reference
+        hardcodes 2, ``pga.cu:278``).
+      mutation_rate: probability an individual receives a point mutation
+        (reference default-callback rate 0.01, ``pga.cu:128``).
+      elitism: number of top individuals copied unchanged into the next
+        generation. The reference has none (generational replacement only);
+        0 preserves that behavior.
+      gene_dtype: dtype of the genome matrix. float32 matches the reference's
+        ``typedef float gene`` (``pga.h:29``).
+      max_populations: cap on populations per solver; the reference fixes 10
+        (``pga.h:44``). ``None`` = unlimited.
+      migration_topology: "ring" (deterministic neighbor ring over ICI) or
+        "random" (random island permutation each migration event, matching
+        the "randomly migrate" wording of ``pga.h:108-111``).
+      use_pallas: route the default-operator generation step through the
+        fused Pallas kernel instead of the XLA-fused path.
+      donate_buffers: donate the genome buffer to jit so XLA updates it in
+        place (the TPU-native replacement for the reference's
+        current/next-generation pointer swap, ``pga.h:124-129``).
+      seed: base PRNG seed. The reference seeds cuRAND with ``time(NULL)``
+        (``pga.cu:154``); here an explicit seed gives reproducibility, and
+        ``None`` picks an OS-entropy seed.
+    """
+
+    tournament_size: int = 2
+    mutation_rate: float = 0.01
+    elitism: int = 0
+    gene_dtype: jnp.dtype = jnp.float32
+    max_populations: Optional[int] = None
+    migration_topology: str = "ring"
+    use_pallas: bool = False
+    donate_buffers: bool = True
+    seed: Optional[int] = None
+
+    def __post_init__(self):
+        if self.tournament_size < 1:
+            raise ValueError("tournament_size must be >= 1")
+        if not (0.0 <= self.mutation_rate <= 1.0):
+            raise ValueError("mutation_rate must be in [0, 1]")
+        if self.elitism < 0:
+            raise ValueError("elitism must be >= 0")
+        if self.migration_topology not in ("ring", "random"):
+            raise ValueError("migration_topology must be 'ring' or 'random'")

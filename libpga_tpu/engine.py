@@ -1,0 +1,559 @@
+"""PGA solver engine.
+
+The Python-native equivalent of the reference's ``pga_t`` instance
+(``src/pga.cu:48-56``): owns populations, the three user callbacks, the PRNG
+stream, and the run loops. Everything device-side happens inside jitted
+programs; the host only orchestrates.
+
+Reference lifecycle parity:
+
+- ``pga_init``/``pga_deinit``       → ``PGA()`` constructor / GC (nothing to
+  free manually; JAX owns device buffers).
+- ``pga_create_population``         → :meth:`PGA.create_population`.
+- ``pga_set_*_function``            → :meth:`set_objective` /
+  :meth:`set_mutate` / :meth:`set_crossover` (plain Python callables replace
+  ``__device__`` fn pointers + ``cudaMemcpyFromSymbol``, ``pga.cu:157-161``).
+- ``pga_run``                       → :meth:`run` — including the
+  objective-value early termination the reference header promises
+  (``pga.h:141``) but never implements.
+- ``pga_get_best(_top)(_all)``      → :meth:`get_best` etc. — including the
+  three NULL-stub variants (``pga.cu:238-248``), implemented on device.
+- ``pga_evaluate/crossover/mutate/swap_generations`` → same-named methods
+  operating on an explicit staged next-generation, for drivers that want
+  the step-by-step API (the fused :meth:`run` path does not use staging).
+- ``pga_run_islands``/``pga_migrate*`` → :meth:`run_islands` /
+  :meth:`migrate` / :meth:`migrate_between` (stubs in the reference,
+  ``pga.cu:368-374,393-395``; implemented here per the header spec).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from libpga_tpu.config import PGAConfig
+from libpga_tpu.population import Population, create_population
+from libpga_tpu.ops.evaluate import evaluate as _evaluate
+from libpga_tpu.ops.select import select_parent_pairs
+from libpga_tpu.ops.crossover import uniform_crossover
+from libpga_tpu.ops.mutate import make_point_mutate
+from libpga_tpu.ops.step import make_breed
+from libpga_tpu.ops.topk import top_k_genomes
+from libpga_tpu.utils.metrics import Metrics
+
+
+@dataclasses.dataclass(frozen=True)
+class PopulationHandle:
+    """Opaque handle to a population owned by a :class:`PGA` instance.
+
+    Plays the role of the reference's ``population_t*`` (``pga.h:27``) —
+    state lives in the engine; the handle survives functional updates.
+    """
+
+    index: int
+
+
+class PGA:
+    """A genetic-algorithm solver instance.
+
+    Example::
+
+        pga = PGA(seed=0)
+        pop = pga.create_population(40_000, 100)
+        pga.set_objective(lambda g: jnp.sum(g))
+        pga.run(100)
+        best = pga.get_best(pop)
+    """
+
+    def __init__(self, seed: Optional[int] = None, config: Optional[PGAConfig] = None):
+        self.config = config or PGAConfig()
+        if seed is None:
+            seed = self.config.seed
+        if seed is None:
+            # Reference seeds cuRAND with time(NULL) (pga.cu:154); we use
+            # fresh OS entropy when no seed is given.
+            seed = int.from_bytes(__import__("os").urandom(4), "little")
+        self._key = jax.random.key(seed)
+        self._populations: List[Population] = []
+        # Staged next generations for the step-by-step operator API — the
+        # functional stand-in for the reference's current/next double buffer.
+        self._staged: List[Optional[jax.Array]] = []
+        self._objective: Optional[Callable] = None
+        self._crossover: Callable = uniform_crossover
+        self._mutate: Callable = make_point_mutate(self.config.mutation_rate)
+        self._compiled: Dict[tuple, Callable] = {}
+        self.metrics = Metrics()
+
+    # ------------------------------------------------------------------ RNG
+
+    def next_key(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    # ----------------------------------------------------------- populations
+
+    def create_population(
+        self, size: int, genome_len: int, init: str = "random"
+    ) -> PopulationHandle:
+        limit = self.config.max_populations
+        if limit is not None and len(self._populations) >= limit:
+            raise RuntimeError(f"max_populations={limit} reached")
+        pop = create_population(
+            self.next_key(), size, genome_len, init=init, dtype=self.config.gene_dtype
+        )
+        self._populations.append(pop)
+        self._staged.append(None)
+        return PopulationHandle(len(self._populations) - 1)
+
+    def population(self, handle: PopulationHandle) -> Population:
+        return self._populations[handle.index]
+
+    @property
+    def populations(self) -> List[Population]:
+        return list(self._populations)
+
+    @property
+    def num_populations(self) -> int:
+        return len(self._populations)
+
+    def _handles(self) -> List[PopulationHandle]:
+        return [PopulationHandle(i) for i in range(len(self._populations))]
+
+    # ------------------------------------------------------------- callbacks
+
+    def set_objective(self, fn) -> None:
+        """Set the fitness function: ``(genome,) -> scalar``, higher better.
+
+        Accepts a callable or the name of a builtin objective from
+        :mod:`libpga_tpu.objectives`.
+        """
+        if isinstance(fn, str):
+            from libpga_tpu import objectives
+
+            fn = objectives.get(fn)
+        self._objective = fn
+        self._compiled.clear()
+
+    def set_mutate(self, fn: Optional[Callable]) -> None:
+        """Set the mutation ``(genome, rand) -> genome``; None → default
+        point mutation (reference semantics, ``pga.cu:127-133``)."""
+        self._mutate = fn if fn is not None else make_point_mutate(
+            self.config.mutation_rate
+        )
+        self._compiled.clear()
+
+    def set_crossover(self, fn: Optional[Callable]) -> None:
+        """Set the crossover ``(p1, p2, rand) -> child``; None → default
+        uniform crossover (reference semantics, ``pga.cu:135-143``)."""
+        self._crossover = fn if fn is not None else uniform_crossover
+        self._compiled.clear()
+
+    def _require_objective(self) -> Callable:
+        if self._objective is None:
+            raise RuntimeError(
+                "no objective set — call set_objective() before evaluating"
+            )
+        return self._objective
+
+    # --------------------------------------------------------- fused run loop
+
+    def _breed_fn(self) -> Callable:
+        """Cached breed (select+crossover+mutate) for the current callbacks."""
+        cache_key = ("breed", self._crossover, self._mutate)
+        fn = self._compiled.get(cache_key)
+        if fn is None:
+            fn = make_breed(
+                self._crossover,
+                self._mutate,
+                tournament_size=self.config.tournament_size,
+                elitism=self.config.elitism,
+            )
+            self._compiled[cache_key] = fn
+        return fn
+
+    def _compiled_run(self, size: int, genome_len: int) -> Callable:
+        """One compiled while_loop serving every (n, target) for this shape.
+
+        The loop carries ``(genomes, scores)`` together and checks the
+        target against the carried scores BEFORE breeding again, so the
+        generation that reaches the target is the one returned — its
+        offspring never overwrite it.
+        """
+        cache_key = (
+            "run",
+            size,
+            genome_len,
+            self._objective,
+            self._crossover,
+            self._mutate,
+        )
+        fn = self._compiled.get(cache_key)
+        if fn is not None:
+            return fn
+
+        obj = self._require_objective()
+        breed = self._breed_fn()
+        use_pallas = self.config.use_pallas
+
+        def run_loop(genomes, key, n, target):
+            scores0 = _evaluate(obj, genomes)
+
+            def cond(carry):
+                g, s, k, gen = carry
+                return jnp.logical_and(gen < n, jnp.max(s) < target)
+
+            def body(carry):
+                g, s, k, gen = carry
+                k, sub = jax.random.split(k)
+                g2 = breed(g, s, sub)
+                s2 = _evaluate(obj, g2)
+                return (g2, s2, k, gen + 1)
+
+            init = (genomes, scores0, key, jnp.int32(0))
+            g, s, k, gens_done = jax.lax.while_loop(cond, body, init)
+            return g, s, gens_done
+
+        donate = (0,) if self.config.donate_buffers else ()
+        fn = jax.jit(run_loop, donate_argnums=donate)
+        if use_pallas:
+            from libpga_tpu.ops.pallas_step import make_pallas_run
+
+            pallas_fn = make_pallas_run(
+                self._require_objective(),
+                tournament_size=self.config.tournament_size,
+                mutation_rate=self.config.mutation_rate,
+            )
+            if pallas_fn is not None and self._is_default_operators():
+                fn = pallas_fn
+        self._compiled[cache_key] = fn
+        return fn
+
+    def _is_default_operators(self) -> bool:
+        from libpga_tpu.ops import mutate as _m
+
+        return self._crossover is uniform_crossover and (
+            getattr(self._mutate, "func", None) is _m.point_mutate
+        )
+
+    def run(
+        self,
+        n: int,
+        target: Optional[float] = None,
+        population: Optional[PopulationHandle] = None,
+    ) -> int:
+        """Run the standard GA for up to ``n`` generations.
+
+        Operates on the first population by default (reference ``pga_run``
+        touches ``populations[0]`` only, ``pga.cu:382-386``). Stops early as
+        soon as a generation's best score reaches ``target`` — the behavior
+        promised by ``pga.h:137-143`` and missing from the reference
+        implementation.
+
+        Returns the number of generations actually executed.
+        """
+        handle = population or PopulationHandle(0)
+        pop = self._populations[handle.index]
+        fn = self._compiled_run(pop.size, pop.genome_len)
+        tgt = jnp.float32(jnp.inf if target is None else target)
+        t0 = time.perf_counter()
+        genomes, scores, gens_done = fn(
+            pop.genomes, self.next_key(), jnp.int32(n), tgt
+        )
+        gens = int(gens_done)
+        self.metrics.record_run(gens, pop.size, time.perf_counter() - t0)
+        self._populations[handle.index] = Population(genomes=genomes, scores=scores)
+        self._staged[handle.index] = None
+        return gens
+
+    # ------------------------------------------------- step-by-step operators
+
+    def evaluate(self, handle: PopulationHandle) -> None:
+        """Score the current generation (reference ``pga_evaluate``)."""
+        pop = self._populations[handle.index]
+        scores = self._jitted_evaluate()(pop.genomes)
+        self._populations[handle.index] = dataclasses.replace(pop, scores=scores)
+
+    def evaluate_all(self) -> None:
+        for h in self._handles():
+            self.evaluate(h)
+
+    def _jitted_evaluate(self):
+        cache_key = ("eval", self._objective)
+        fn = self._compiled.get(cache_key)
+        if fn is None:
+            obj = self._require_objective()
+            fn = jax.jit(lambda g: _evaluate(obj, g))
+            self._compiled[cache_key] = fn
+        return fn
+
+    def crossover(self, handle: PopulationHandle, selection: str = "tournament") -> None:
+        """Select parents from the current generation and stage children as
+        the next generation (reference ``pga_crossover``; the selection-type
+        argument is accepted for parity and, as in the reference
+        (``pga.cu:329``), tournament is the only strategy)."""
+        del selection
+        pop = self._populations[handle.index]
+        fn = self._compiled_op("crossover")
+        self._staged[handle.index] = fn(pop.genomes, pop.scores, self.next_key())
+
+    def crossover_all(self, selection: str = "tournament") -> None:
+        for h in self._handles():
+            self.crossover(h, selection)
+
+    def _compiled_op(self, which: str):
+        cache_key = (which, self._crossover, self._mutate, self.config.tournament_size)
+        fn = self._compiled.get(cache_key)
+        if fn is not None:
+            return fn
+        if which == "crossover":
+            cross = self._crossover
+            k = self.config.tournament_size
+
+            def op(genomes, scores, key):
+                P, L = genomes.shape
+                k_sel, k_c = jax.random.split(key)
+                i1, i2 = select_parent_pairs(k_sel, scores, P, k=k)
+                rand = jax.random.uniform(k_c, (P, L), dtype=jnp.float32)
+                return jax.vmap(cross)(
+                    jnp.take(genomes, i1, axis=0), jnp.take(genomes, i2, axis=0), rand
+                ).astype(genomes.dtype)
+
+        elif which == "mutate":
+            mut = self._mutate
+
+            def op(genomes, key):
+                P, L = genomes.shape
+                rand = jax.random.uniform(key, (P, L), dtype=jnp.float32)
+                return jax.vmap(mut)(genomes, rand).astype(genomes.dtype)
+
+        else:
+            raise ValueError(which)
+        fn = jax.jit(op)
+        self._compiled[cache_key] = fn
+        return fn
+
+    def mutate(self, handle: PopulationHandle) -> None:
+        """Mutate the staged next generation in place (reference
+        ``pga_mutate`` operates on ``next_gen``, ``pga.cu:349-354``)."""
+        staged = self._staged[handle.index]
+        if staged is None:
+            raise RuntimeError("no staged generation — call crossover() first")
+        self._staged[handle.index] = self._compiled_op("mutate")(
+            staged, self.next_key()
+        )
+
+    def mutate_all(self) -> None:
+        for h in self._handles():
+            self.mutate(h)
+
+    def swap_generations(self, handle: PopulationHandle) -> None:
+        """Promote the staged next generation to current (reference
+        ``pga_swap_generations`` pointer swap, ``pga.cu:362-366``)."""
+        staged = self._staged[handle.index]
+        if staged is None:
+            raise RuntimeError("no staged generation — call crossover() first")
+        pop = self._populations[handle.index]
+        self._populations[handle.index] = Population(
+            genomes=staged,
+            scores=jnp.full((pop.size,), -jnp.inf, dtype=jnp.float32),
+        )
+        self._staged[handle.index] = None
+
+    def fill_random_values(self, handle: PopulationHandle) -> None:
+        """Advance the PRNG stream (reference ``pga_fill_random_values``
+        refills the cuRAND pool, ``pga.cu:99-105``; with threaded keys the
+        analog is burning a key)."""
+        del handle
+        self.next_key()
+
+    # -------------------------------------------------------- best extraction
+
+    def get_best(self, handle: PopulationHandle) -> np.ndarray:
+        """Best genome of one population (reference ``pga_get_best``,
+        ``pga.cu:218-236`` — but argmax on device, not host)."""
+        genomes, _ = self.get_best_with_score(handle)
+        return genomes
+
+    def get_best_with_score(
+        self, handle: PopulationHandle
+    ) -> Tuple[np.ndarray, float]:
+        pop = self._populations[handle.index]
+        g, s = top_k_genomes(pop.genomes, pop.scores, 1)
+        return np.asarray(g[0]), float(s[0])
+
+    def get_best_top(self, handle: PopulationHandle, k: int) -> np.ndarray:
+        """Top-k genomes, best first — implements the reference's NULL stub
+        ``pga_get_best_top`` (``pga.cu:238-240``) per its header contract.
+        ``k`` is clamped to the population size."""
+        pop = self._populations[handle.index]
+        g, _ = top_k_genomes(pop.genomes, pop.scores, min(k, pop.size))
+        return np.asarray(g)
+
+    def get_best_all(self) -> np.ndarray:
+        """Best genome across all populations (stub ``pga_get_best_all``,
+        ``pga.cu:242-244``, implemented)."""
+        best_g, best_s = None, -float("inf")
+        for h in self._handles():
+            g, s = self.get_best_with_score(h)
+            if s > best_s:
+                best_g, best_s = g, s
+        if best_g is None:
+            raise RuntimeError("no populations")
+        return best_g
+
+    def get_best_top_all(self, k: int) -> np.ndarray:
+        """Global top-k across all populations (stub ``pga_get_best_top_all``,
+        ``pga.cu:246-248``, implemented). Per-population top-k on device,
+        then a k-way merge of the small candidate set."""
+        cands_g, cands_s = [], []
+        for h in self._handles():
+            pop = self._populations[h.index]
+            kk = min(k, pop.size)
+            g, s = top_k_genomes(pop.genomes, pop.scores, kk)
+            cands_g.append(np.asarray(g))
+            cands_s.append(np.asarray(s))
+        genome_lens = {g.shape[1] for g in cands_g}
+        if len(genome_lens) != 1:
+            raise ValueError("get_best_top_all requires equal genome_len across populations")
+        all_g = np.concatenate(cands_g)
+        all_s = np.concatenate(cands_s)
+        order = np.argsort(-all_s)[:k]
+        return all_g[order]
+
+    # ------------------------------------------------------------- migration
+
+    def migrate(self, pct: float) -> None:
+        """Randomly migrate the top ``pct`` between populations (reference
+        header spec ``pga.h:108-111``; empty stub ``pga.cu:368-370``).
+
+        Ring over a random island order: every population sends its
+        pre-migration top ``pct`` to its successor in a shuffled order,
+        replacing the destination's worst individuals. Emigrants are
+        snapshotted before any immigration so one migrate() event moves
+        each individual at most one hop (same semantics as the sharded
+        island runner).
+        """
+        if not (0.0 <= pct <= 1.0):
+            raise ValueError("migration pct must be in [0, 1]")
+        n = len(self._populations)
+        if n < 2:
+            return
+        emigrants = {}
+        for i, pop in enumerate(self._populations):
+            count = int(pop.size * pct)
+            if count > 0:
+                emigrants[i] = top_k_genomes(pop.genomes, pop.scores, count)
+        order = np.asarray(jax.random.permutation(self.next_key(), jnp.arange(n)))
+        for i in range(n):
+            src, dst = int(order[i]), int(order[(i + 1) % n])
+            if src in emigrants:
+                self._immigrate_into(dst, *emigrants[src])
+
+    def migrate_between(
+        self, src: PopulationHandle, dst: PopulationHandle, pct: float
+    ) -> None:
+        """Copy the top ``pct`` of ``src`` over the worst of ``dst``
+        (reference header spec ``pga.h:112-115``; empty stub
+        ``pga.cu:372-374``). Requires both populations evaluated.
+        ``pct`` small enough to round to 0 emigrants → no-op."""
+        if not (0.0 <= pct <= 1.0):
+            raise ValueError("migration pct must be in [0, 1]")
+        spop = self._populations[src.index]
+        count = int(min(spop.size, self._populations[dst.index].size) * pct)
+        if count == 0:
+            return
+        emigrants, escores = top_k_genomes(spop.genomes, spop.scores, count)
+        self._immigrate_into(dst.index, emigrants, escores)
+
+    def _immigrate_into(self, dst_index: int, emigrants, escores) -> None:
+        from libpga_tpu.parallel.islands import _immigrate
+
+        dpop = self._populations[dst_index]
+        if emigrants.shape[1] != dpop.genome_len:
+            raise ValueError("migration requires equal genome_len")
+        new_g, new_s = _immigrate(
+            dpop.genomes[None], dpop.scores[None], emigrants[None], escores[None]
+        )
+        self._populations[dst_index] = Population(genomes=new_g[0], scores=new_s[0])
+
+    # --------------------------------------------------------------- islands
+
+    def run_islands(
+        self,
+        n: int,
+        m: int,
+        pct: float,
+        target: Optional[float] = None,
+        mesh: Optional[jax.sharding.Mesh] = None,
+    ) -> int:
+        """Island GA over ALL populations: ``n`` generations total, ring/random
+        migration of the top ``pct`` every ``m`` generations (reference header
+        spec ``pga.h:144-150``; empty stub ``pga.cu:393-395``).
+
+        Homogeneous populations run as a stacked ``(islands, size, L)`` batch
+        — vmapped on one device, or sharded island-per-core via ``shard_map``
+        when a ``mesh`` is provided. Returns generations executed.
+        """
+        from libpga_tpu.parallel.islands import run_islands_stacked
+
+        if not self._populations:
+            raise RuntimeError("no populations")
+        sizes = {(p.size, p.genome_len) for p in self._populations}
+        if len(sizes) != 1:
+            return self._run_islands_hetero(n, m, pct, target)
+        stacked = jnp.stack([p.genomes for p in self._populations])
+        t0 = time.perf_counter()
+        genomes, scores, gens = run_islands_stacked(
+            self._breed_fn(),
+            self._require_objective(),
+            stacked,
+            self.next_key(),
+            n=n,
+            m=m,
+            pct=pct,
+            target=target,
+            topology=self.config.migration_topology,
+            mesh=mesh,
+            runner_cache=self._compiled,
+        )
+        self.metrics.record_run(
+            gens, sum(p.size for p in self._populations),
+            time.perf_counter() - t0,
+        )
+        for i in range(len(self._populations)):
+            # genomes[i] on a jax.Array stays on device (no host round trip).
+            self._populations[i] = Population(
+                genomes=genomes[i], scores=scores[i]
+            )
+            self._staged[i] = None
+        return gens
+
+    def _run_islands_hetero(
+        self, n: int, m: int, pct: float, target: Optional[float]
+    ) -> int:
+        """Fallback for heterogeneous population shapes: sequential epochs
+        with host-orchestrated migration (still jitted per population).
+        Returns the maximum generation count any population executed."""
+        gens = 0
+        while gens < n:
+            chunk = min(m, n - gens)
+            executed = [
+                self.run(chunk, target=target, population=h)
+                for h in self._handles()
+            ]
+            gens += max(executed)
+            if target is not None:
+                best = max(
+                    self.get_best_with_score(h)[1] for h in self._handles()
+                )
+                if best >= target:
+                    break
+            if gens < n:
+                self.migrate(pct)
+        return gens

@@ -1,0 +1,73 @@
+"""Builtin objective registry.
+
+The reference has no builtin objectives — every driver supplies a
+``__device__`` function pointer. Here the three reference driver workloads
+(OneMax ``test/test.cu:24-30``, bounded knapsack ``test2/test.cu:28-36``,
+TSP ``test3/test.cu:26-46``) plus the BASELINE.json benchmark configs
+(Rastrigin, NK-landscape, deceptive trap) ship as named builtins. The
+registry also backs the C-ABI shim, where TPU-side custom callables are
+impossible and named objectives are the primary extension surface.
+
+All objectives: ``(genome,) -> scalar`` on ``(L,)`` genes in [0,1);
+HIGHER IS BETTER (the engine argmaxes, matching reference ``pga.cu:224``).
+"""
+
+from libpga_tpu.objectives.classic import (
+    onemax,
+    onemax_bits,
+    sphere,
+    rastrigin,
+    ackley,
+    make_knapsack,
+    default_knapsack,
+    make_tsp,
+    make_nk_landscape,
+    make_deceptive_trap,
+)
+
+_REGISTRY = {}
+
+
+def register(name: str, fn=None):
+    """Register an objective (usable as a decorator)."""
+    if fn is None:
+        return lambda f: register(name, f)
+    _REGISTRY[name] = fn
+    return fn
+
+
+def get(name: str):
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown objective {name!r}; registered: {sorted(_REGISTRY)}"
+        ) from None
+
+
+def names():
+    return sorted(_REGISTRY)
+
+
+register("onemax", onemax)
+register("onemax_bits", onemax_bits)
+register("sphere", sphere)
+register("rastrigin", rastrigin)
+register("ackley", ackley)
+register("knapsack", default_knapsack)
+
+__all__ = [
+    "register",
+    "get",
+    "names",
+    "onemax",
+    "onemax_bits",
+    "sphere",
+    "rastrigin",
+    "ackley",
+    "make_knapsack",
+    "default_knapsack",
+    "make_tsp",
+    "make_nk_landscape",
+    "make_deceptive_trap",
+]

@@ -1,0 +1,183 @@
+"""Classic GA benchmark objectives.
+
+Each is a pure per-genome function ``(L,) -> scalar`` over genes in [0,1),
+higher-is-better, designed to trace cleanly under vmap/jit (no Python
+control flow on traced values).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ------------------------------------------------------------------ OneMax
+
+
+def onemax(genome: jax.Array) -> jax.Array:
+    """Continuous OneMax: sum of genes. The reference's first driver
+    objective (``test/test.cu:24-30``). Optimum = genome_len (as genes → 1)."""
+    return jnp.sum(genome)
+
+
+def onemax_bits(genome: jax.Array) -> jax.Array:
+    """Bitstring OneMax: count of genes that round to 1. Optimum = L."""
+    return jnp.sum((genome >= 0.5).astype(jnp.float32))
+
+
+# ------------------------------------------------- real-coded test functions
+
+
+def _to_box(genome: jax.Array, lo: float, hi: float) -> jax.Array:
+    """Map genes from [0,1) to [lo, hi]."""
+    return lo + genome * (hi - lo)
+
+
+def sphere(genome: jax.Array) -> jax.Array:
+    """Negated sphere function on [-5.12, 5.12]^L. Optimum 0 at x=0."""
+    x = _to_box(genome, -5.12, 5.12)
+    return -jnp.sum(x * x)
+
+
+def rastrigin(genome: jax.Array) -> jax.Array:
+    """Negated Rastrigin on [-5.12, 5.12]^L (BASELINE.json config
+    "Rastrigin-30D real-valued GA"). Optimum 0 at x=0; highly multimodal."""
+    x = _to_box(genome, -5.12, 5.12)
+    return -(10.0 * x.shape[0] + jnp.sum(x * x - 10.0 * jnp.cos(2.0 * jnp.pi * x)))
+
+
+def ackley(genome: jax.Array) -> jax.Array:
+    """Negated Ackley on [-32.768, 32.768]^L. Optimum 0 at x=0."""
+    x = _to_box(genome, -32.768, 32.768)
+    n = x.shape[0]
+    a, b, c = 20.0, 0.2, 2.0 * jnp.pi
+    s1 = jnp.sqrt(jnp.sum(x * x) / n)
+    s2 = jnp.sum(jnp.cos(c * x)) / n
+    return -(-a * jnp.exp(-b * s1) - jnp.exp(s2) + a + jnp.e)
+
+
+# ---------------------------------------------------------------- knapsack
+
+
+def make_knapsack(values, weights, capacity: float, max_item_count: int = 2):
+    """Bounded knapsack with overweight penalty.
+
+    Semantics of the reference's second driver (``test2/test.cu:28-36``):
+    decode per-item count as ``int(g[i] * max_item_count)``; feasible →
+    total value; infeasible → ``capacity - weight`` (negative overweight).
+    """
+    values = jnp.asarray(values, dtype=jnp.float32)
+    weights = jnp.asarray(weights, dtype=jnp.float32)
+
+    def knapsack(genome: jax.Array) -> jax.Array:
+        counts = jnp.floor(genome * max_item_count).astype(jnp.float32)
+        total_value = jnp.sum(values * counts)
+        total_weight = jnp.sum(weights * counts)
+        return jnp.where(
+            total_weight <= capacity, total_value, capacity - total_weight
+        )
+
+    return knapsack
+
+
+# The exact instance the reference driver hardcodes (test2/test.cu:22-26).
+default_knapsack = make_knapsack(
+    values=[75, 150, 250, 35, 10, 100],
+    weights=[7, 8, 6, 4, 3, 9],
+    capacity=10.0,
+    max_item_count=2,
+)
+
+
+# --------------------------------------------------------------------- TSP
+
+
+def make_tsp(city_matrix, duplicate_penalty: float = 10_000.0):
+    """TSP over a distance matrix with duplicate-city penalty.
+
+    Semantics of the reference's third driver (``test3/test.cu:26-46``):
+    city i = ``int(g[i] * L)``; fitness = −(path length + penalty per
+    ordered duplicate pair). The O(L²) duplicate check is a vectorized
+    comparison matrix here rather than the reference's nested loop.
+    """
+    city_matrix = jnp.asarray(city_matrix, dtype=jnp.float32)
+
+    def tsp(genome: jax.Array) -> jax.Array:
+        L = genome.shape[0]
+        cities = jnp.clip(jnp.floor(genome * L).astype(jnp.int32), 0, L - 1)
+        length = jnp.sum(city_matrix[cities[:-1], cities[1:]])
+        dup = cities[:, None] == cities[None, :]
+        off_diag = dup & ~jnp.eye(L, dtype=bool)
+        length = length + duplicate_penalty * jnp.sum(off_diag)
+        return -length
+
+    return tsp
+
+
+def random_tsp_matrix(
+    n_cities: int, seed: int = 0, low: float = 10.0, high: float = 1000.0
+):
+    """Random distance matrix with a planted cheap Hamiltonian path
+    ``i → i+1 = low`` — the same construction as the reference's input
+    generator (``test3/gen.c:27-38``), so the known-good tour is
+    0,1,2,…,L−1 with length ``low * (L-1)``."""
+    rng = np.random.default_rng(seed)
+    m = rng.uniform(low, high, size=(n_cities, n_cities)).astype(np.float32)
+    np.fill_diagonal(m, 0.0)
+    idx = np.arange(n_cities - 1)
+    m[idx, idx + 1] = low
+    return m
+
+
+# ----------------------------------------------------------- NK landscapes
+
+
+def make_nk_landscape(n: int, k: int, seed: int = 0):
+    """NK fitness landscape (epistatic; BASELINE.json "NK-landscape" config).
+
+    Gene i's contribution depends on itself and its next k circular
+    neighbors; contributions come from a fixed random table. Genes are
+    thresholded to bits at 0.5. Fitness = mean contribution in [0, 1].
+
+    Implemented as a table gather: each locus forms a (k+1)-bit index into
+    its own row of a ``(n, 2^(k+1))`` uniform table — one vectorized gather,
+    no per-locus loop.
+    """
+    rng = np.random.default_rng(seed)
+    table = jnp.asarray(
+        rng.uniform(0.0, 1.0, size=(n, 2 ** (k + 1))).astype(np.float32)
+    )
+    offsets = jnp.arange(k + 1)
+    powers = jnp.asarray(2 ** np.arange(k + 1), dtype=jnp.int32)
+
+    def nk(genome: jax.Array) -> jax.Array:
+        bits = (genome >= 0.5).astype(jnp.int32)
+        neighbor_idx = (jnp.arange(n)[:, None] + offsets[None, :]) % n
+        neighborhood = bits[neighbor_idx]  # (n, k+1)
+        codes = jnp.sum(neighborhood * powers[None, :], axis=1)  # (n,)
+        contrib = table[jnp.arange(n), codes]
+        return jnp.mean(contrib)
+
+    return nk
+
+
+def make_deceptive_trap(trap_size: int = 5):
+    """Concatenated deceptive trap (BASELINE.json "deceptive-trap" config).
+
+    Genome splits into blocks of ``trap_size`` bits; a full block scores
+    ``trap_size``, otherwise ``trap_size − 1 − ones`` — the gradient points
+    away from the optimum. Global optimum = all ones = genome_len.
+    """
+
+    def trap(genome: jax.Array) -> jax.Array:
+        L = genome.shape[0]
+        nblocks = L // trap_size
+        bits = (genome[: nblocks * trap_size] >= 0.5).astype(jnp.float32)
+        ones = jnp.sum(bits.reshape(nblocks, trap_size), axis=1)
+        block_score = jnp.where(
+            ones == trap_size, jnp.float32(trap_size), trap_size - 1.0 - ones
+        )
+        return jnp.sum(block_score)
+
+    return trap

@@ -1,0 +1,40 @@
+"""GA operators — the TPU-native equivalents of the reference's CUDA kernels.
+
+Reference kernel → op mapping (all in reference ``src/pga.cu``):
+
+- ``__g_evaluate`` (pga.cu:250-262)        → :func:`evaluate.evaluate`
+- ``tournament_selection`` (pga.cu:280-292)→ :func:`select.tournament_select`
+- ``__g_crossover`` (pga.cu:294-317)       → :func:`crossover` ops + step fusion
+- ``__default_crossover`` (pga.cu:135-143) → :func:`crossover.uniform_crossover`
+- ``__g_mutate`` (pga.cu:333-347)          → :func:`mutate` ops + step fusion
+- ``__default_mutate`` (pga.cu:127-133)    → :func:`mutate.point_mutate`
+- whole-generation loop (pga.cu:376-391)   → :func:`step.make_step` (single
+  fused XLA program per generation instead of ~3×(pop/512) launches)
+"""
+
+from libpga_tpu.ops.evaluate import evaluate
+from libpga_tpu.ops.select import tournament_select
+from libpga_tpu.ops.crossover import (
+    uniform_crossover,
+    one_point_crossover,
+    arithmetic_crossover,
+    order_preserving_crossover,
+)
+from libpga_tpu.ops.mutate import point_mutate, gaussian_mutate, swap_mutate
+from libpga_tpu.ops.topk import best_index, top_k_genomes
+from libpga_tpu.ops.step import make_step
+
+__all__ = [
+    "evaluate",
+    "tournament_select",
+    "uniform_crossover",
+    "one_point_crossover",
+    "arithmetic_crossover",
+    "order_preserving_crossover",
+    "point_mutate",
+    "gaussian_mutate",
+    "swap_mutate",
+    "best_index",
+    "top_k_genomes",
+    "make_step",
+]

@@ -1,0 +1,29 @@
+"""Fitness evaluation.
+
+Reference: ``__g_evaluate`` kernel, one thread per individual, optional
+shared-memory staging of the genome (``src/pga.cu:250-262``). TPU-natively
+this is a ``vmap`` of the user's per-genome objective over the population
+axis; XLA tiles it onto the VPU/MXU and fuses it with neighboring ops, so
+there is no separate "staging" step to write.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def evaluate(obj: Callable[[jax.Array], jax.Array], genomes: jax.Array) -> jax.Array:
+    """Score every individual. Higher is better.
+
+    Args:
+      obj: per-individual objective, ``(genome_len,) -> scalar``.
+      genomes: ``(pop, genome_len)``.
+
+    Returns:
+      ``(pop,)`` float32 scores.
+    """
+    scores = jax.vmap(obj)(genomes)
+    return scores.astype(jnp.float32)

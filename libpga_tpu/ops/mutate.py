@@ -1,0 +1,84 @@
+"""Mutation operators.
+
+Per-individual pure functions ``(genome, rand) -> genome`` with ``rand`` an
+``(L,)`` uniform [0,1) vector — the functional equivalent of the reference
+callback ``void (*mutate_f)(gene*, float* rand, unsigned)``
+(``include/pga.h:47``). Functional (returns a new genome) rather than
+in-place; XLA aliases the buffers.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def point_mutate(genome: jax.Array, rand: jax.Array, rate: float = 0.01) -> jax.Array:
+    """With probability ``rate``, set one random gene to a random value.
+
+    Semantics of the reference default ``__default_mutate``
+    (``src/pga.cu:127-133``): fires when ``rand[1] <= rate``; target position
+    ``floor(rand[0]*L)``; new value ``rand[2]``. This consumption pattern is
+    why the reference requires ``genome_len >= 4``.
+    """
+    L = genome.shape[0]
+    pos = jnp.clip(jnp.floor(rand[0] * L).astype(jnp.int32), 0, L - 1)
+    fire = rand[1] <= rate
+    mutated = genome.at[pos].set(rand[2].astype(genome.dtype))
+    return jax.lax.select(fire, mutated, genome)
+
+
+def make_point_mutate(rate: float = 0.01):
+    """Bind a rate into the standard ``(genome, rand)`` signature."""
+    return partial(point_mutate, rate=rate)
+
+
+def gaussian_mutate(
+    genome: jax.Array,
+    rand: jax.Array,
+    rate: float = 0.1,
+    sigma: float = 0.1,
+) -> jax.Array:
+    """Per-gene Gaussian perturbation (real-coded GAs, e.g. Rastrigin).
+
+    Each gene independently mutates with probability ``rate`` by adding
+    N(0, sigma²) noise, clipped back to [0, 1). Needs three uniforms per
+    gene (gate, radius, angle); rather than widening the rand slice, the
+    extra streams are derived by integer bit-mixing the first (cheap,
+    stateless, in-register). The gate is the raw ``rand`` value — exact
+    rate — and MUST be a different stream than the Box-Muller angle, or the
+    noise sign becomes correlated with firing (a gate of ``u2 < rate`` with
+    rate ≤ 0.25 would make every applied mutation positive).
+    """
+    bits = (rand * jnp.float32(2**24)).astype(jnp.uint32)
+    m1 = bits * jnp.uint32(2654435761) + jnp.uint32(0x9E3779B9)
+    m2 = m1 * jnp.uint32(2246822519) + jnp.uint32(0x85EBCA6B)
+    u1 = (m1 & jnp.uint32(0xFFFFFF)).astype(jnp.float32) / jnp.float32(2**24)
+    u2 = (m2 & jnp.uint32(0xFFFFFF)).astype(jnp.float32) / jnp.float32(2**24)
+    u1 = jnp.clip(u1, 1e-7, 1.0 - 1e-7)
+    # Box-Muller
+    normal = jnp.sqrt(-2.0 * jnp.log(u1)) * jnp.cos(2.0 * jnp.pi * u2)
+    fire = rand < rate
+    out = jnp.where(fire, genome + sigma * normal.astype(genome.dtype), genome)
+    return jnp.clip(out, 0.0, 1.0 - 1e-7)
+
+
+def make_gaussian_mutate(rate: float = 0.1, sigma: float = 0.1):
+    return partial(gaussian_mutate, rate=rate, sigma=sigma)
+
+
+def swap_mutate(genome: jax.Array, rand: jax.Array, rate: float = 0.5) -> jax.Array:
+    """Swap two random positions with probability ``rate`` (permutation GAs)."""
+    L = genome.shape[0]
+    i = jnp.clip(jnp.floor(rand[0] * L).astype(jnp.int32), 0, L - 1)
+    j = jnp.clip(jnp.floor(rand[1] * L).astype(jnp.int32), 0, L - 1)
+    fire = rand[2] <= rate
+    gi, gj = genome[i], genome[j]
+    swapped = genome.at[i].set(gj).at[j].set(gi)
+    return jax.lax.select(fire, swapped, genome)
+
+
+def make_swap_mutate(rate: float = 0.5):
+    return partial(swap_mutate, rate=rate)

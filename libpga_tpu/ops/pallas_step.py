@@ -1,0 +1,20 @@
+"""Fused Pallas generation step for the default operators.
+
+Placeholder for the Pallas-kernel fast path (survey §7 step 4): a fused
+tournament-select + uniform-crossover + point-mutate kernel with in-kernel
+PRNG (``pltpu.prng_random_bits``), avoiding the HBM materialization of the
+``(pop, genome_len)`` random pools the XLA path generates.
+
+``make_pallas_run`` returns ``None`` until the kernel lands; the engine
+falls back to the XLA-fused path.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+
+def make_pallas_run(
+    obj: Callable, *, tournament_size: int = 2, mutation_rate: float = 0.01
+) -> Optional[Callable]:
+    return None

@@ -1,0 +1,52 @@
+"""Parent selection.
+
+Reference: size-2 tournament — draw two random indices from the population,
+keep the higher-scored (``src/pga.cu:278-292``); two tournaments select the
+two parents of each child (``pga.cu:306-307``). Here the tournament is a
+batched gather + argmax over a ``(num, k)`` index matrix, k configurable.
+
+The reference draws tournament indices from the same uniform pool that the
+crossover mask later re-reads, so selection and crossover randomness overlap
+(survey §2.2). That aliasing is a bug, not a feature — here every consumer
+gets an independent PRNG stream.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def tournament_select(
+    key: jax.Array,
+    scores: jax.Array,
+    num: int,
+    k: int = 2,
+) -> jax.Array:
+    """Run ``num`` independent k-way tournaments.
+
+    Args:
+      key: PRNG key.
+      scores: ``(pop,)`` fitness values, higher better.
+      num: number of winners to select.
+      k: tournament size (reference: 2).
+
+    Returns:
+      ``(num,)`` int32 indices of winners into the population.
+    """
+    pop = scores.shape[0]
+    idx = jax.random.randint(key, (num, k), 0, pop, dtype=jnp.int32)
+    cand = scores[idx]  # (num, k) gather
+    win = jnp.argmax(cand, axis=-1)  # ties -> lowest slot, matches strict '>'
+    return jnp.take_along_axis(idx, win[:, None], axis=-1)[:, 0]
+
+
+def select_parent_pairs(
+    key: jax.Array,
+    scores: jax.Array,
+    num_children: int,
+    k: int = 2,
+) -> tuple[jax.Array, jax.Array]:
+    """Two tournaments per child → (p1_idx, p2_idx), each ``(num_children,)``."""
+    winners = tournament_select(key, scores, num_children * 2, k=k)
+    return winners[:num_children], winners[num_children:]

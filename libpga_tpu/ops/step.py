@@ -1,0 +1,104 @@
+"""The fused generation step — the framework's hot loop.
+
+One call = one full generation: tournament-select → crossover → mutate →
+evaluate. The whole thing traces into a single XLA program, which is the
+structural win over the reference's hot loop: there, every generation is
+1 cuRAND fill + 3 operators × ceil(pop/512) chunked kernel launches, each
+followed by a full ``cudaDeviceSynchronize()`` (``src/pga.cu:376-391,62-77``
+— ~23,700 synchronous launches for the 40k×100 OneMax driver).
+
+Split into two pieces:
+
+- :func:`make_breed` — select+crossover+mutate: ``(genomes, scores, key) ->
+  next_genomes``. Selection reads the *given* scores, i.e. the fitness of
+  the current generation, matching the reference (``pga.cu:294-317``).
+- :func:`make_step` — breed then evaluate: ``(genomes, key) ->
+  (next_genomes, next_scores)``.
+
+Run loops carry ``(genomes, scores)`` together and check termination
+targets against the carried scores BEFORE breeding again — so the
+generation that reaches the target is the one returned, never its
+offspring.
+
+Replacement ordering matches the reference: the next generation fully
+replaces the current one (no implicit elitism unless configured).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from libpga_tpu.ops.evaluate import evaluate
+from libpga_tpu.ops.select import select_parent_pairs
+
+
+def make_breed(
+    crossover_fn: Callable,
+    mutate_fn: Callable,
+    *,
+    tournament_size: int = 2,
+    elitism: int = 0,
+) -> Callable[[jax.Array, jax.Array, jax.Array], jax.Array]:
+    """Build the selection+variation half of a generation.
+
+    Args:
+      crossover_fn: per-child ``(p1, p2, rand) -> child``.
+      mutate_fn: per-genome ``(genome, rand) -> genome``.
+      tournament_size: k of the k-way tournament.
+      elitism: copy the top-e of the current generation unchanged into the
+        next one (slots 0..e-1). 0 = pure generational replacement (the
+        reference's behavior).
+
+    Returns:
+      ``breed(genomes, scores, key) -> next_genomes``. Pure.
+    """
+
+    def breed(genomes: jax.Array, scores: jax.Array, key: jax.Array):
+        P, L = genomes.shape
+        k_sel, k_cross, k_mut = jax.random.split(key, 3)
+        p1_idx, p2_idx = select_parent_pairs(k_sel, scores, P, k=tournament_size)
+        p1 = jnp.take(genomes, p1_idx, axis=0)
+        p2 = jnp.take(genomes, p2_idx, axis=0)
+
+        rand_c = jax.random.uniform(k_cross, (P, L), dtype=jnp.float32)
+        children = jax.vmap(crossover_fn)(p1, p2, rand_c)
+
+        rand_m = jax.random.uniform(k_mut, (P, L), dtype=jnp.float32)
+        nxt = jax.vmap(mutate_fn)(children, rand_m)
+
+        if elitism > 0:
+            _, elite_idx = jax.lax.top_k(scores, elitism)
+            nxt = nxt.at[:elitism].set(jnp.take(genomes, elite_idx, axis=0))
+
+        return nxt.astype(genomes.dtype)
+
+    return breed
+
+
+def make_step(
+    obj: Callable,
+    crossover_fn: Callable,
+    mutate_fn: Callable,
+    *,
+    tournament_size: int = 2,
+    elitism: int = 0,
+) -> Callable[[jax.Array, jax.Array], Tuple[jax.Array, jax.Array]]:
+    """One full generation: ``step(genomes, key) -> (next, next_scores)``.
+
+    Requires the caller to seed the process with an initial evaluation
+    (``evaluate(obj, genomes)``) — after that, the returned scores always
+    describe the returned genomes.
+    """
+    breed = make_breed(
+        crossover_fn, mutate_fn, tournament_size=tournament_size, elitism=elitism
+    )
+
+    def step(genomes: jax.Array, key: jax.Array):
+        scores = evaluate(obj, genomes)
+        nxt = breed(genomes, scores, key)
+        return nxt, scores
+
+    return step

@@ -1,0 +1,40 @@
+"""On-device best / top-k extraction.
+
+The reference copies the whole score vector to the host and argmaxes in a C
+loop (``src/pga.cu:218-236``), and its top-k variants are NULL-returning
+stubs (``pga.cu:238-248``). At 1M+ populations the host round-trip dominates,
+so both argmax and top-k run on device here; only the winning genomes cross
+to the host.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def best_index(scores: jax.Array) -> jax.Array:
+    """Index of the best (maximal) score. On-device scalar."""
+    return jnp.argmax(scores)
+
+
+@jax.jit
+def best_genome(genomes: jax.Array, scores: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """(best_genome, best_score) — one gather, fully on device."""
+    i = jnp.argmax(scores)
+    return genomes[i], scores[i]
+
+
+def top_k_genomes(
+    genomes: jax.Array, scores: jax.Array, k: int
+) -> tuple[jax.Array, jax.Array]:
+    """Top-k individuals by score, best first.
+
+    Implements what the reference's ``pga_get_best_top`` promised
+    (``include/pga.h:91``) but stubbed to NULL (``pga.cu:238-240``).
+
+    Returns ``(k, L)`` genomes and ``(k,)`` scores.
+    """
+    top_scores, idx = jax.lax.top_k(scores, k)
+    return genomes[idx], top_scores

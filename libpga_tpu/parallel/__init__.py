@@ -1,0 +1,26 @@
+"""Parallelism: island model over a TPU device mesh.
+
+The reference designed — but never implemented — an island model: multiple
+populations run in a container, with ``pga_migrate``/``pga_migrate_between``/
+``pga_run_islands`` declared in the header (``include/pga.h:108-150``) and
+left as empty stubs (``src/pga.cu:368-374,393-395``); its README claims MPI
+that does not exist anywhere in the tree.
+
+TPU-natively: islands are a stacked ``(islands, size, genome_len)`` array
+sharded island-per-core over a 1-D ``jax.sharding.Mesh`` with ``shard_map``;
+ring migration is a ``lax.ppermute`` neighbor exchange that rides ICI
+(DCN across hosts via ``jax.distributed``); random-topology migration is an
+``all_gather`` of the (small) emigrant sets plus a shared permutation.
+"""
+
+from libpga_tpu.parallel.mesh import default_mesh, island_sharding
+from libpga_tpu.parallel.islands import run_islands_stacked, make_island_epoch
+from libpga_tpu.parallel import distributed
+
+__all__ = [
+    "default_mesh",
+    "island_sharding",
+    "run_islands_stacked",
+    "make_island_epoch",
+    "distributed",
+]

@@ -1,0 +1,333 @@
+"""Island-model execution: epochs of local evolution + migration.
+
+Implements the contract of the reference's never-written
+``pga_run_islands(pga, n, m, pct)`` (header spec ``include/pga.h:144-150``:
+run for ``n`` generations, migrating the top ``pct`` every ``m``
+generations) as one jitted program:
+
+- each island evolves ``m`` generations via ``lax.scan`` of
+  breed-then-evaluate, carrying ``(genomes, scores)`` together so the
+  carried scores always describe the carried genomes;
+- migration selects each island's top-E on device and ships them to the
+  next island — ``jnp.roll`` within a core's local islands, ``lax.ppermute``
+  across cores (the ICI ring), or ``all_gather`` + shared permutation for
+  the random topology; immigrants replace the destination's worst-E, so an
+  island's best always survives a migration event;
+- the epoch loop is a ``lax.while_loop`` so a single compilation serves any
+  (epochs, target); early termination checks the carried scores BEFORE
+  breeding again, so the generation that reached the target is the one
+  returned. With migration every ``m`` generations, the target check has
+  epoch granularity (a transient winner strictly inside an epoch is
+  superseded by its offspring, as in any generational GA without elitism).
+
+Runner builders (:func:`build_local_runner`, :func:`build_sharded_runner`)
+are deterministic in their arguments so callers (the engine) can cache the
+compiled runner across calls.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from libpga_tpu.ops.evaluate import evaluate as _evaluate
+
+
+def make_island_epoch(breed: Callable, obj: Callable, m: int) -> Callable:
+    """``(genomes (S,L), scores (S,), key) -> (genomes, scores, key)`` —
+    m generations of breed-then-evaluate on one island."""
+
+    def epoch(genomes, scores, key):
+        def body(carry, _):
+            g, s, k = carry
+            k, sub = jax.random.split(k)
+            g2 = breed(g, s, sub)
+            s2 = _evaluate(obj, g2)
+            return (g2, s2, k), None
+
+        (genomes, scores, key), _ = jax.lax.scan(
+            body, (genomes, scores, key), None, length=m
+        )
+        return genomes, scores, key
+
+    return epoch
+
+
+def _select_emigrants(genomes, scores, count):
+    """Per-island top-``count``: genomes (I,S,L), scores (I,S) →
+    emigrants (I,count,L), escores (I,count)."""
+    top_s, top_i = jax.lax.top_k(scores, count)
+    em = jnp.take_along_axis(genomes, top_i[..., None], axis=1)
+    return em, top_s
+
+
+def _immigrate(genomes, scores, im_g, im_s):
+    """Replace each island's worst-``count`` with the immigrants.
+    Batched over the leading island axis."""
+    count = im_g.shape[1]
+    _, worst_i = jax.lax.top_k(-scores, count)
+    genomes = jax.vmap(lambda g, idx, im: g.at[idx].set(im))(
+        genomes, worst_i, im_g.astype(genomes.dtype)
+    )
+    scores = jax.vmap(lambda s, idx, ims: s.at[idx].set(ims))(
+        scores, worst_i, im_s
+    )
+    return genomes, scores
+
+
+def _shuffled_ring_sources(key, n):
+    """Source-island index per destination for a ring over a random island
+    order: ``src[order[i+1]] = order[i]``."""
+    order = jax.random.permutation(key, n)
+    return jnp.zeros((n,), dtype=order.dtype).at[order].set(jnp.roll(order, 1))
+
+
+def _migrate_local(genomes, scores, key, count, topology):
+    """Single-device migration across the leading island axis."""
+    I = genomes.shape[0]
+    em_g, em_s = _select_emigrants(genomes, scores, count)
+    if topology == "ring":
+        src = jnp.roll(jnp.arange(I), 1)
+    else:  # random: ring over a shuffled island order
+        src = _shuffled_ring_sources(key, I)
+    return _immigrate(genomes, scores, em_g[src], em_s[src])
+
+
+# --------------------------------------------------------------- local path
+
+
+def build_local_runner(
+    breed: Callable, obj: Callable, *, m: int, count: int, topology: str
+) -> Callable:
+    """Single-device (vmapped-islands) epoch loop.
+
+    Returns ``runner(genomes (I,S,L), island_keys (I,), mig_key,
+    num_epochs, target) -> (genomes, scores (I,S), epochs_done)``.
+    """
+    epoch = make_island_epoch(breed, obj, m)
+    vepoch = jax.vmap(epoch)
+
+    def loop(genomes, island_keys, mig_key, num_epochs, target):
+        scores = jax.vmap(lambda gi: _evaluate(obj, gi))(genomes)
+
+        def cond(c):
+            g, s, keys, mk, e = c
+            return jnp.logical_and(e < num_epochs, jnp.max(s) < target)
+
+        def body(c):
+            g, s, keys, mk, e = c
+            g, s, keys = vepoch(g, s, keys)
+            if count > 0:
+                mk, sub = jax.random.split(mk)
+                g, s = _migrate_local(g, s, sub, count, topology)
+            return (g, s, keys, mk, e + 1)
+
+        init = (genomes, scores, island_keys, mig_key, jnp.int32(0))
+        g, s, keys, mk, e = jax.lax.while_loop(cond, body, init)
+        return g, s, e
+
+    return jax.jit(loop)
+
+
+# ------------------------------------------------------------- sharded path
+
+
+def _migrate_sharded(genomes, scores, key, count, topology, axis_name):
+    """Migration inside shard_map: genomes (I_loc, S, L) per core.
+
+    Ring: emigrants shift one island forward globally — a local roll plus a
+    single ppermute of the boundary island's emigrants to the next core
+    (pure ICI neighbor traffic). Random: all_gather the (small) emigrant
+    sets and index by a shared permutation (identical on every core because
+    it derives from the replicated migration key).
+    """
+    i_loc = genomes.shape[0]
+    n_dev = jax.lax.axis_size(axis_name)
+    total = i_loc * n_dev
+    em_g, em_s = _select_emigrants(genomes, scores, count)
+
+    if topology == "ring":
+        perm = [(d, (d + 1) % n_dev) for d in range(n_dev)]
+        from_prev_g = jax.lax.ppermute(em_g[i_loc - 1], axis_name, perm)
+        from_prev_s = jax.lax.ppermute(em_s[i_loc - 1], axis_name, perm)
+        in_g = jnp.roll(em_g, 1, axis=0).at[0].set(from_prev_g)
+        in_s = jnp.roll(em_s, 1, axis=0).at[0].set(from_prev_s)
+    else:
+        all_g = jax.lax.all_gather(em_g, axis_name)  # (D, I_loc, E, L)
+        all_s = jax.lax.all_gather(em_s, axis_name)
+        all_g = all_g.reshape((total,) + all_g.shape[2:])
+        all_s = all_s.reshape((total,) + all_s.shape[2:])
+        src = _shuffled_ring_sources(key, total)
+        my_first = jax.lax.axis_index(axis_name) * i_loc
+        my_src = jax.lax.dynamic_slice_in_dim(src, my_first, i_loc)
+        in_g = all_g[my_src]
+        in_s = all_s[my_src]
+
+    return _immigrate(genomes, scores, in_g, in_s)
+
+
+def build_sharded_runner(
+    breed: Callable,
+    obj: Callable,
+    *,
+    m: int,
+    count: int,
+    topology: str,
+    mesh: Mesh,
+    axis_name: str = "islands",
+) -> Callable:
+    """shard_map'd epoch loop: islands split over the mesh axis, migration
+    over ICI. Same signature as :func:`build_local_runner`'s return."""
+    epoch = make_island_epoch(breed, obj, m)
+    vepoch = jax.vmap(epoch)
+
+    def shard_body(genomes, island_keys, mig_key, num_epochs, target):
+        # genomes: (I_loc, S, L); island_keys: (I_loc,); mig_key replicated.
+        scores = jax.vmap(lambda gi: _evaluate(obj, gi))(genomes)
+        best0 = jax.lax.pmax(jnp.max(scores), axis_name)
+
+        def cond(c):
+            g, s, keys, mk, e, best = c
+            return jnp.logical_and(e < num_epochs, best < target)
+
+        def body(c):
+            g, s, keys, mk, e, best = c
+            g, s, keys = vepoch(g, s, keys)
+            if count > 0:
+                mk, sub = jax.random.split(mk)
+                g, s = _migrate_sharded(g, s, sub, count, topology, axis_name)
+            # Global best — every core takes the same branch next epoch.
+            # Computed AFTER migration, which only replaces worst-E, so the
+            # carried best is still present in some island.
+            best = jax.lax.pmax(jnp.max(s), axis_name)
+            return (g, s, keys, mk, e + 1, best)
+
+        init = (genomes, scores, island_keys, mig_key, jnp.int32(0), best0)
+        g, s, keys, mk, e, best = jax.lax.while_loop(cond, body, init)
+        return g, s, e
+
+    mapped = jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(axis_name, None, None), P(axis_name), P(), P(), P()),
+        out_specs=(P(axis_name, None, None), P(axis_name, None), P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped)
+
+
+def build_runner(
+    breed: Callable,
+    obj: Callable,
+    *,
+    m: int,
+    count: int,
+    topology: str,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = "islands",
+) -> Callable:
+    if mesh is None:
+        return build_local_runner(breed, obj, m=m, count=count, topology=topology)
+    return build_sharded_runner(
+        breed, obj, m=m, count=count, topology=topology, mesh=mesh,
+        axis_name=axis_name,
+    )
+
+
+# ------------------------------------------------------------- convenience
+
+
+def run_islands_stacked(
+    step_or_breed,
+    obj: Callable,
+    stacked: jax.Array,
+    key: jax.Array,
+    *,
+    n: int,
+    m: int,
+    pct: float,
+    target: Optional[float] = None,
+    topology: str = "ring",
+    mesh: Optional[Mesh] = None,
+    axis_name: str = "islands",
+    runner_cache: Optional[dict] = None,
+) -> Tuple[jax.Array, jax.Array, int]:
+    """Run the island GA on a stacked ``(I, S, L)`` population array.
+
+    ``step_or_breed`` takes ``(genomes, scores, key)`` (a breed fn from
+    :func:`libpga_tpu.ops.step.make_breed`). ``pct`` of the island size is
+    the emigrant count (``int(S*pct)``; 0 → no migration). Pass a dict as
+    ``runner_cache`` to reuse compiled runners across calls.
+
+    Returns ``(genomes (I,S,L), scores (I,S), generations_executed)``.
+    """
+    I, S, L = stacked.shape
+    if m < 1:
+        raise ValueError("migration interval m must be >= 1")
+    if not (0.0 <= pct <= 1.0):
+        raise ValueError("migration pct must be in [0, 1]")
+    breed = step_or_breed
+    count = int(S * pct)
+    epochs, rem = divmod(n, m)
+    tgt = jnp.float32(jnp.inf if target is None else target)
+
+    island_keys = jax.random.split(key, I + 1)
+    mig_key, island_keys = island_keys[0], island_keys[1:]
+
+    if mesh is not None and I % mesh.devices.size != 0:
+        raise ValueError(
+            f"islands ({I}) must be a multiple of mesh devices "
+            f"({mesh.devices.size})"
+        )
+
+    def cached(tag, mm, build):
+        if runner_cache is None:
+            return build()
+        ck = (tag, mm, count, topology, mesh, axis_name, breed, obj)
+        if ck not in runner_cache:
+            runner_cache[ck] = build()
+        return runner_cache[ck]
+
+    runner = cached(
+        "main", m,
+        lambda: build_runner(
+            breed, obj, m=m, count=count, topology=topology, mesh=mesh,
+            axis_name=axis_name,
+        ),
+    )
+    if mesh is not None:
+        stacked = jax.device_put(
+            stacked, NamedSharding(mesh, P(axis_name, None, None))
+        )
+        island_keys = jax.device_put(
+            island_keys, NamedSharding(mesh, P(axis_name))
+        )
+    genomes, scores, epochs_done = runner(
+        stacked, island_keys, mig_key, jnp.int32(epochs), tgt
+    )
+    gens = int(epochs_done) * m
+
+    # Remainder generations (< m) run without a following migration. Only
+    # executed when the epoch loop wasn't cut short by the target.
+    if rem > 0 and (target is None or float(jnp.max(scores)) < float(tgt)):
+        rem_runner = cached(
+            "rem", rem,
+            lambda: build_runner(
+                breed, obj, m=rem, count=0, topology=topology, mesh=mesh,
+                axis_name=axis_name,
+            ),
+        )
+        rem_keys = jax.random.split(jax.random.fold_in(mig_key, 7), I)
+        if mesh is not None:
+            rem_keys = jax.device_put(
+                rem_keys, NamedSharding(mesh, P(axis_name))
+            )
+        genomes, scores, _ = rem_runner(
+            genomes, rem_keys, jax.random.fold_in(mig_key, 11),
+            jnp.int32(1), jnp.float32(jnp.inf),
+        )
+        gens += rem
+    return genomes, scores, gens

@@ -1,0 +1,29 @@
+"""Mesh construction helpers."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+ISLAND_AXIS = "islands"
+
+
+def default_mesh(
+    devices: Optional[Sequence[jax.Device]] = None, axis_name: str = ISLAND_AXIS
+) -> Mesh:
+    """A 1-D mesh over all (or the given) devices, one island group per core.
+
+    On a multi-host pod every process sees the global device list, so the
+    same call yields the global mesh (ICI within a slice, DCN across)."""
+    import numpy as np
+
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    return Mesh(devs, axis_names=(axis_name,))
+
+
+def island_sharding(mesh: Mesh, axis_name: str = ISLAND_AXIS) -> NamedSharding:
+    """Sharding for a stacked ``(islands, size, genome_len)`` array:
+    islands split across the mesh, genomes local to a core."""
+    return NamedSharding(mesh, P(axis_name, None, None))

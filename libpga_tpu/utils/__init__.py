@@ -1,0 +1,4 @@
+from libpga_tpu.utils.metrics import Metrics
+from libpga_tpu.utils import checkpoint
+
+__all__ = ["Metrics", "checkpoint"]

@@ -1,0 +1,56 @@
+"""Population checkpoint / resume.
+
+The reference has no serialization at all — the only state extraction is the
+host copy of one winning genome in ``pga_get_best`` (``src/pga.cu:218-236``).
+Here whole solver states (all populations + PRNG key) round-trip through a
+single ``.npz`` file, so long island runs can resume after preemption.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+if TYPE_CHECKING:
+    from libpga_tpu.engine import PGA
+
+FORMAT_VERSION = 1
+
+
+def save(pga: "PGA", path: str) -> None:
+    """Serialize all populations and the PRNG state to ``path`` (.npz)."""
+    arrays = {
+        "__version__": np.asarray(FORMAT_VERSION),
+        "__num_populations__": np.asarray(len(pga.populations)),
+        "__key__": np.asarray(jax.random.key_data(pga._key)),
+    }
+    for i, pop in enumerate(pga.populations):
+        arrays[f"genomes_{i}"] = np.asarray(pop.genomes)
+        arrays[f"scores_{i}"] = np.asarray(pop.scores)
+    np.savez(path, **arrays)
+
+
+def restore(pga: "PGA", path: str) -> None:
+    """Load populations and PRNG state saved by :func:`save` into ``pga``.
+
+    Replaces any populations already in the engine.
+    """
+    from libpga_tpu.population import Population
+
+    with np.load(path) as data:
+        version = int(data["__version__"])
+        if version != FORMAT_VERSION:
+            raise ValueError(f"unsupported checkpoint version {version}")
+        n = int(data["__num_populations__"])
+        pga._key = jax.random.wrap_key_data(jnp.asarray(data["__key__"]))
+        pga._populations = [
+            Population(
+                genomes=jnp.asarray(data[f"genomes_{i}"]),
+                scores=jnp.asarray(data[f"scores_{i}"]),
+            )
+            for i in range(n)
+        ]
+        pga._staged = [None] * n

@@ -1,0 +1,58 @@
+"""Run metrics and telemetry.
+
+The reference's entire observability story is one ``printf`` of the best
+score inside ``pga_get_best`` (``src/pga.cu:230``). Here every fused run
+records generation counts and wall time, exposing generations/sec — the
+framework's headline metric — plus an optional callback hook for loggers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, List, Optional
+
+
+@dataclasses.dataclass
+class RunRecord:
+    generations: int
+    population_size: int
+    seconds: float
+    timestamp: float
+
+    @property
+    def generations_per_sec(self) -> float:
+        return self.generations / self.seconds if self.seconds > 0 else float("inf")
+
+
+class Metrics:
+    """Accumulates per-run statistics for a PGA instance."""
+
+    def __init__(self):
+        self.runs: List[RunRecord] = []
+        self.on_run: Optional[Callable[[RunRecord], None]] = None
+
+    def record_run(self, generations: int, population_size: int, seconds: float):
+        rec = RunRecord(
+            generations=generations,
+            population_size=population_size,
+            seconds=seconds,
+            timestamp=time.time(),
+        )
+        self.runs.append(rec)
+        if self.on_run is not None:
+            self.on_run(rec)
+        return rec
+
+    @property
+    def total_generations(self) -> int:
+        return sum(r.generations for r in self.runs)
+
+    @property
+    def total_seconds(self) -> float:
+        return sum(r.seconds for r in self.runs)
+
+    @property
+    def generations_per_sec(self) -> float:
+        s = self.total_seconds
+        return self.total_generations / s if s > 0 else float("inf")

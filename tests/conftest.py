@@ -1,0 +1,31 @@
+"""Test harness config: force a simulated 8-device CPU platform.
+
+The reference cannot run without a physical CUDA device (every path hits
+cudaMalloc/kernel launches — survey §4); this is the "fake backend" it
+lacks. Must run before jax initializes a backend.
+"""
+
+import os
+
+# Env vars alone are not enough here: the container's sitecustomize imports
+# jax._src at interpreter start (capturing JAX_PLATFORMS=axon), so the
+# platform must be overridden through jax.config before backend init.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_threefry_partitionable", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def key():
+    return jax.random.key(0)

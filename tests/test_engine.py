@@ -1,0 +1,258 @@
+"""Engine/API integration tests: lifecycle, run loops, convergence on known
+optima, early termination, step-by-step operator parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import libpga_tpu as pga_mod
+from libpga_tpu import PGA, PGAConfig
+from libpga_tpu.engine import PopulationHandle
+
+
+def test_lifecycle_and_population_guards():
+    pga = PGA(seed=0)
+    with pytest.raises(ValueError):
+        pga.create_population(10, 3)  # genome_len >= 4 (reference pga.cu:184)
+    h = pga.create_population(100, 8)
+    assert pga.population(h).size == 100
+    assert pga.population(h).genome_len == 8
+
+
+def test_max_populations_guard():
+    pga = PGA(seed=0, config=PGAConfig(max_populations=2))
+    pga.create_population(10, 4)
+    pga.create_population(10, 4)
+    with pytest.raises(RuntimeError):
+        pga.create_population(10, 4)
+
+
+def test_run_onemax_converges():
+    # The reference's first driver workload, scaled down (test/test.cu).
+    pga = PGA(seed=0)
+    h = pga.create_population(2000, 32)
+    pga.set_objective("onemax")
+    gens = pga.run(60)
+    assert gens == 60
+    g, s = pga.get_best_with_score(h)
+    assert s > 0.85 * 32  # random init averages 16; GA must push toward 32
+
+
+def test_run_early_termination():
+    pga = PGA(seed=0)
+    pga.create_population(2000, 16)
+    pga.set_objective("onemax")
+    gens = pga.run(10_000, target=13.0)
+    assert gens < 10_000  # stopped when best >= 13
+
+
+def test_run_requires_objective():
+    pga = PGA(seed=0)
+    pga.create_population(10, 4)
+    with pytest.raises(RuntimeError):
+        pga.run(1)
+
+
+def test_knapsack_driver_workload():
+    # Reference second driver: pop 100, 6 items, 5 gens (test2/test.cu:43,49).
+    pga = PGA(seed=1)
+    h = pga.create_population(100, 6)
+    pga.set_objective("knapsack")
+    pga.run(30)
+    g, s = pga.get_best_with_score(h)
+    counts = np.floor(np.asarray(g) * 2).astype(int)
+    # Best known: item2 once (w6 v250) + item3 once (w4 v35) = w10 v285.
+    assert s > 0  # feasible
+    weights = np.array([7, 8, 6, 4, 3, 9])
+    assert (counts * weights).sum() <= 10
+    assert s >= 250
+
+
+def test_custom_objective_and_operators():
+    from libpga_tpu.ops.crossover import one_point_crossover
+    from libpga_tpu.ops.mutate import make_gaussian_mutate
+
+    pga = PGA(seed=2)
+    h = pga.create_population(500, 16)
+    pga.set_objective(lambda g: -jnp.sum((g - 0.25) ** 2))
+    pga.set_crossover(one_point_crossover)
+    pga.set_mutate(make_gaussian_mutate(rate=0.2, sigma=0.05))
+    pga.run(40)
+    g, s = pga.get_best_with_score(h)
+    # random init expectation ≈ -2.33 over 16 genes; near-0 = converged
+    assert s > -0.2  # genes near 0.25
+
+
+def test_get_best_top_sorted():
+    pga = PGA(seed=0)
+    h = pga.create_population(256, 8)
+    pga.set_objective("onemax")
+    pga.evaluate(h)
+    top = pga.get_best_top(h, 5)
+    sums = top.sum(axis=1)
+    assert np.all(np.diff(sums) <= 1e-6)  # descending
+
+
+def test_get_best_all_and_top_all():
+    pga = PGA(seed=0)
+    h1 = pga.create_population(128, 8)
+    h2 = pga.create_population(128, 8)
+    pga.set_objective("onemax")
+    pga.evaluate_all()
+    best = pga.get_best_all()
+    b1, s1 = pga.get_best_with_score(h1)
+    b2, s2 = pga.get_best_with_score(h2)
+    assert best.sum() == pytest.approx(max(s1, s2), abs=1e-4)
+    top = pga.get_best_top_all(10)
+    assert top.shape == (10, 8)
+    sums = top.sum(axis=1)
+    assert np.all(np.diff(sums) <= 1e-6)
+
+
+def test_step_by_step_operator_api():
+    """evaluate → crossover → mutate → swap, the reference driver loop."""
+    pga = PGA(seed=0)
+    h = pga.create_population(256, 16)
+    pga.set_objective("onemax")
+    before = np.asarray(pga.population(h).genomes).copy()
+    for _ in range(5):
+        pga.evaluate(h)
+        pga.crossover(h)
+        pga.mutate(h)
+        pga.swap_generations(h)
+    pga.evaluate(h)
+    after = pga.population(h)
+    assert not np.array_equal(before, np.asarray(after.genomes))
+    # mean fitness should improve under selection
+    assert float(jnp.mean(after.scores)) > float(before.sum(axis=1).mean())
+
+
+def test_mutate_requires_staged():
+    pga = PGA(seed=0)
+    h = pga.create_population(16, 4)
+    pga.set_objective("onemax")
+    with pytest.raises(RuntimeError):
+        pga.mutate(h)
+
+
+def test_migrate_between():
+    pga = PGA(seed=0)
+    h1 = pga.create_population(64, 8)
+    h2 = pga.create_population(64, 8)
+    pga.set_objective("onemax")
+    pga.evaluate_all()
+    best_src = pga.get_best_with_score(h1)[1]
+    pga.migrate_between(h1, h2, 0.1)
+    # destination now contains source's best
+    best_dst = pga.get_best_with_score(h2)[1]
+    assert best_dst >= best_src
+
+
+def test_migrate_random_all():
+    pga = PGA(seed=0)
+    for _ in range(4):
+        pga.create_population(64, 8)
+    pga.set_objective("onemax")
+    pga.evaluate_all()
+    global_best = max(
+        pga.get_best_with_score(PopulationHandle(i))[1] for i in range(4)
+    )
+    pga.migrate(0.1)
+    # global best must survive migration (top individuals are copied, and
+    # immigrants only replace the destination's worst)
+    new_best = max(
+        pga.get_best_with_score(PopulationHandle(i))[1] for i in range(4)
+    )
+    assert new_best >= global_best - 1e-6
+
+
+def test_c_shaped_api_parity():
+    """The pga_* veneer mirrors include/pga.h end to end."""
+    p = pga_mod.pga_init(seed=0)
+    pop = pga_mod.pga_create_population(p, 200, 8, pga_mod.RANDOM_POPULATION)
+    pga_mod.pga_set_objective_function(p, "onemax")
+    pga_mod.pga_set_mutate_function(p, None)
+    pga_mod.pga_set_crossover_function(p, None)
+    pga_mod.pga_run(p, 20)
+    g = pga_mod.pga_get_best(p, pop)
+    assert g.shape == (8,)
+    top = pga_mod.pga_get_best_top(p, pop, 3)
+    assert top.shape == (3, 8)
+    pga_mod.pga_evaluate(p, pop)
+    pga_mod.pga_crossover(p, pop, pga_mod.TOURNAMENT)
+    pga_mod.pga_mutate(p, pop)
+    pga_mod.pga_swap_generations(p, pop)
+    pga_mod.pga_fill_random_values(p, pop)
+    pga_mod.pga_deinit(p)
+
+
+def test_seeded_determinism():
+    def run_once():
+        pga = PGA(seed=42)
+        h = pga.create_population(128, 8)
+        pga.set_objective("onemax")
+        pga.run(10)
+        return np.asarray(pga.population(h).genomes)
+
+    np.testing.assert_array_equal(run_once(), run_once())
+
+
+def test_metrics_recorded():
+    pga = PGA(seed=0)
+    pga.create_population(64, 8)
+    pga.set_objective("onemax")
+    pga.run(5)
+    assert pga.metrics.total_generations == 5
+    assert pga.metrics.generations_per_sec > 0
+
+
+def test_run_target_winner_survives():
+    """The generation that reaches the target must be the one returned —
+    not its offspring (regression: winner used to be bred away)."""
+    from libpga_tpu.objectives import onemax_bits
+
+    for seed in range(8):
+        pga = PGA(seed=seed)
+        h = pga.create_population(200, 16)
+        pga.set_objective(onemax_bits)
+        gens = pga.run(10_000, target=15.0)
+        if gens < 10_000:
+            _, s = pga.get_best_with_score(h)
+            assert s >= 15.0, f"seed {seed}: claimed target but best={s}"
+
+
+def test_get_best_top_clamps_k():
+    pga = PGA(seed=0)
+    h = pga.create_population(32, 8)
+    pga.set_objective("onemax")
+    pga.evaluate(h)
+    top = pga.get_best_top(h, 300)  # k > size must clamp, not crash
+    assert top.shape == (32, 8)
+
+
+def test_migrate_zero_pct_is_noop():
+    pga = PGA(seed=0)
+    h1 = pga.create_population(64, 8)
+    h2 = pga.create_population(64, 8)
+    pga.set_objective("onemax")
+    pga.evaluate_all()
+    before = np.asarray(pga.population(h2).genomes).copy()
+    pga.migrate(0.0)
+    pga.migrate_between(h1, h2, 0.0)
+    np.testing.assert_array_equal(before, np.asarray(pga.population(h2).genomes))
+    with pytest.raises(ValueError):
+        pga.migrate(1.5)
+
+
+def test_run_islands_repeat_calls_reuse_cache():
+    """Second run_islands call with same shapes must hit the runner cache
+    (regression: every call used to rebuild + recompile)."""
+    pga = PGA(seed=0)
+    for _ in range(4):
+        pga.create_population(64, 8)
+    pga.set_objective("onemax")
+    pga.run_islands(10, 5, 0.1)
+    n_cached = len(pga._compiled)
+    pga.run_islands(10, 5, 0.1)
+    assert len(pga._compiled) == n_cached

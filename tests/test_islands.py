@@ -1,0 +1,149 @@
+"""Island-model tests on the simulated 8-device CPU mesh — the distributed
+coverage the reference entirely lacks (its island API is all stubs)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from libpga_tpu import PGA, PGAConfig
+from libpga_tpu.ops.crossover import uniform_crossover
+from libpga_tpu.ops.mutate import make_point_mutate
+from libpga_tpu.ops.step import make_breed
+from libpga_tpu.parallel.islands import run_islands_stacked
+from libpga_tpu.parallel.mesh import default_mesh
+
+
+OBJ = lambda g: jnp.sum(g)
+
+
+def _breed():
+    return make_breed(uniform_crossover, make_point_mutate(0.01))
+
+
+def test_local_islands_converge(key):
+    stacked = jax.random.uniform(key, (4, 256, 16))
+    g, s, gens = run_islands_stacked(
+        _breed(), OBJ, stacked, key, n=30, m=5, pct=0.1
+    )
+    assert g.shape == stacked.shape
+    assert s.shape == (4, 256)
+    assert gens == 30
+    assert float(jnp.max(s)) > 0.8 * 16
+
+
+def test_local_islands_remainder_generations(key):
+    stacked = jax.random.uniform(key, (2, 64, 8))
+    _, _, gens = run_islands_stacked(
+        _breed(), OBJ, stacked, key, n=13, m=5, pct=0.1
+    )
+    assert gens == 13  # 2 epochs of 5 + remainder 3
+
+
+def test_local_islands_early_termination(key):
+    stacked = jax.random.uniform(key, (4, 512, 8))
+    _, s, gens = run_islands_stacked(
+        _breed(), OBJ, stacked, key, n=10_000, m=5, pct=0.1, target=7.0
+    )
+    assert gens < 10_000
+    assert float(jnp.max(s)) >= 7.0
+
+
+def test_random_topology(key):
+    stacked = jax.random.uniform(key, (4, 128, 8))
+    g, s, gens = run_islands_stacked(
+        _breed(), OBJ, stacked, key, n=10, m=5, pct=0.1, topology="random"
+    )
+    assert gens == 10
+    assert bool(jnp.all(jnp.isfinite(s)))
+
+
+def test_migration_spreads_best(key):
+    """Plant a super-individual in island 0; after one migration epoch the
+    ring neighbor must contain it (or better)."""
+    stacked = jax.random.uniform(key, (4, 64, 8)) * 0.1
+    stacked = stacked.at[0, 0].set(jnp.ones(8) * 0.999)
+    # Disable evolution effects as much as possible: 1 generation per epoch.
+    g, s, _ = run_islands_stacked(
+        _breed(), OBJ, stacked, key, n=2, m=1, pct=0.05
+    )
+    # elite was in island 0 → island 1 should have received high genomes
+    assert float(jnp.max(s[1])) > 4.0
+
+
+@pytest.mark.parametrize("topology", ["ring", "random"])
+def test_sharded_islands_match_shape(key, topology):
+    mesh = default_mesh()
+    n_dev = mesh.devices.size
+    assert n_dev == 8  # conftest forces 8 CPU devices
+    stacked = jax.random.uniform(key, (8, 128, 16))
+    g, s, gens = run_islands_stacked(
+        _breed(), OBJ, stacked, key, n=20, m=5, pct=0.1,
+        topology=topology, mesh=mesh,
+    )
+    assert g.shape == (8, 128, 16)
+    assert s.shape == (8, 128)
+    assert gens == 20
+    assert float(jnp.max(s)) > 0.75 * 16
+
+
+def test_sharded_multiple_islands_per_device(key):
+    mesh = default_mesh()
+    stacked = jax.random.uniform(key, (16, 64, 8))  # 2 islands per device
+    g, s, gens = run_islands_stacked(
+        _breed(), OBJ, stacked, key, n=10, m=5, pct=0.1, mesh=mesh
+    )
+    assert g.shape == (16, 64, 8)
+    assert gens == 10
+
+
+def test_sharded_islands_uneven_rejected(key):
+    mesh = default_mesh()
+    stacked = jax.random.uniform(key, (6, 32, 8))  # 6 % 8 != 0
+    with pytest.raises(ValueError):
+        run_islands_stacked(
+            _breed(), OBJ, stacked, key, n=5, m=5, pct=0.1, mesh=mesh
+        )
+
+
+def test_sharded_ring_migration_propagates(key):
+    """Super-individual on device-0's island must reach device 1 via the
+    ppermute ring."""
+    mesh = default_mesh()
+    stacked = jax.random.uniform(key, (8, 64, 8)) * 0.1
+    stacked = stacked.at[0, 0].set(jnp.ones(8) * 0.999)
+    g, s, _ = run_islands_stacked(
+        _breed(), OBJ, stacked, key, n=2, m=1, pct=0.05, mesh=mesh
+    )
+    assert float(jnp.max(s[1])) > 4.0
+
+
+def test_engine_run_islands_end_to_end():
+    pga = PGA(seed=0)
+    for _ in range(4):
+        pga.create_population(128, 8)
+    pga.set_objective("onemax")
+    gens = pga.run_islands(20, 5, 0.1)
+    assert gens == 20
+    best = pga.get_best_all()
+    assert best.sum() > 0.75 * 8
+
+
+def test_engine_run_islands_sharded():
+    pga = PGA(seed=0)
+    for _ in range(8):
+        pga.create_population(64, 8)
+    pga.set_objective("onemax")
+    mesh = default_mesh()
+    gens = pga.run_islands(10, 5, 0.1, mesh=mesh)
+    assert gens == 10
+    assert pga.get_best_all().shape == (8,)
+
+
+def test_engine_run_islands_heterogeneous_fallback():
+    pga = PGA(seed=0)
+    pga.create_population(64, 8)
+    pga.create_population(128, 8)  # different size → hetero path
+    pga.set_objective("onemax")
+    gens = pga.run_islands(10, 5, 0.1)
+    assert gens == 10

@@ -1,0 +1,111 @@
+"""Objective-function tests: known optima, reference-driver semantics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from libpga_tpu import objectives
+from libpga_tpu.objectives import (
+    onemax,
+    onemax_bits,
+    sphere,
+    rastrigin,
+    ackley,
+    default_knapsack,
+    make_knapsack,
+    make_tsp,
+    make_nk_landscape,
+    make_deceptive_trap,
+)
+from libpga_tpu.objectives.classic import random_tsp_matrix
+
+
+def test_registry():
+    assert "onemax" in objectives.names()
+    assert objectives.get("onemax") is onemax
+    with pytest.raises(KeyError):
+        objectives.get("nope")
+
+
+def test_onemax():
+    assert float(onemax(jnp.ones(10))) == pytest.approx(10.0)
+    assert float(onemax_bits(jnp.array([0.9, 0.1, 0.5, 0.49]))) == 2.0
+
+
+def test_sphere_rastrigin_ackley_optima():
+    # genes = 0.5 → x = 0 → optimum 0 for all three
+    mid = jnp.full((30,), 0.5)
+    assert float(sphere(mid)) == pytest.approx(0.0, abs=1e-4)
+    assert float(rastrigin(mid)) == pytest.approx(0.0, abs=1e-3)
+    assert float(ackley(mid)) == pytest.approx(0.0, abs=1e-3)
+    off = jnp.full((30,), 0.9)
+    assert float(rastrigin(off)) < -1.0
+
+
+def test_knapsack_reference_semantics():
+    # Reference instance (test2/test.cu:22-26): feasible → value,
+    # infeasible → capacity - weight.
+    # counts decode as int(g*2): g=0.6 → 1 copy
+    g = jnp.array([0.0, 0.0, 0.6, 0.6, 0.0, 0.0])  # item2 + item3: w=10 v=285
+    assert float(default_knapsack(g)) == pytest.approx(285.0)
+    g_over = jnp.array([0.6, 0.6, 0.6, 0.0, 0.0, 0.0])  # w=21 > 10
+    assert float(default_knapsack(g_over)) == pytest.approx(10.0 - 21.0)
+
+
+def test_knapsack_custom():
+    kp = make_knapsack([10.0], [1.0], capacity=5.0, max_item_count=4)
+    g = jnp.array([0.99])  # count 3
+    assert float(kp(g)) == pytest.approx(30.0)
+
+
+def test_tsp_reference_semantics():
+    L = 4
+    m = np.full((L, L), 100.0, dtype=np.float32)
+    np.fill_diagonal(m, 0.0)
+    m[0, 1] = m[1, 2] = m[2, 3] = 1.0
+    tsp = make_tsp(m)
+    tour = (jnp.arange(L) + 0.5) / L  # 0→1→2→3
+    assert float(tsp(tour)) == pytest.approx(-3.0)
+    # duplicated city → +10000 penalty per ordered pair (test3/test.cu:36-44)
+    dup = jnp.array([0.5 / L, 0.5 / L, 2.5 / L, 3.5 / L])
+    assert float(tsp(dup)) <= -(2 * 10_000)
+
+
+def test_tsp_matrix_generator_plants_path():
+    m = random_tsp_matrix(10, seed=0)
+    assert m.shape == (10, 10)
+    np.testing.assert_allclose(m[np.arange(9), np.arange(1, 10)], 10.0)
+    assert np.all(np.diag(m) == 0.0)
+
+
+def test_nk_landscape_properties(key):
+    nk = make_nk_landscape(n=16, k=3, seed=0)
+    g = jax.random.uniform(key, (16,))
+    v = float(nk(g))
+    assert 0.0 <= v <= 1.0
+    # deterministic
+    assert float(nk(g)) == v
+    # flipping a bit changes fitness (epistasis wired up)
+    g2 = g.at[0].set(1.0 - g[0])
+    assert float(nk(g2)) != v
+
+
+def test_deceptive_trap():
+    trap = make_deceptive_trap(trap_size=5)
+    all_ones = jnp.ones(20)
+    all_zeros = jnp.zeros(20)
+    assert float(trap(all_ones)) == pytest.approx(20.0)  # global optimum
+    assert float(trap(all_zeros)) == pytest.approx(16.0)  # deceptive attractor
+    # one block solved, rest zeros
+    g = jnp.zeros(20).at[:5].set(1.0)
+    assert float(trap(g)) == pytest.approx(5.0 + 12.0)
+
+
+def test_objectives_vmap_and_jit(key):
+    genomes = jax.random.uniform(key, (64, 30))
+    for fn in [onemax, sphere, rastrigin, ackley, make_nk_landscape(30, 2),
+               make_deceptive_trap(5)]:
+        out = jax.jit(jax.vmap(fn))(genomes)
+        assert out.shape == (64,)
+        assert bool(jnp.all(jnp.isfinite(out)))

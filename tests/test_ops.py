@@ -1,0 +1,232 @@
+"""Deterministic seeded unit tests per operator (survey §4 plan):
+selection pressure, crossover/mutation distribution properties, golden
+semantics pinned to fixed PRNG keys."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from libpga_tpu import ops
+from libpga_tpu.ops.select import tournament_select, select_parent_pairs
+from libpga_tpu.ops.crossover import (
+    uniform_crossover,
+    one_point_crossover,
+    arithmetic_crossover,
+    order_preserving_crossover,
+)
+from libpga_tpu.ops.mutate import point_mutate, gaussian_mutate, swap_mutate
+from libpga_tpu.ops.topk import top_k_genomes, best_index
+from libpga_tpu.ops.step import make_step
+
+
+class TestTournamentSelect:
+    def test_shapes_and_range(self, key):
+        scores = jax.random.normal(key, (100,))
+        idx = tournament_select(key, scores, 50, k=2)
+        assert idx.shape == (50,)
+        assert idx.dtype == jnp.int32
+        assert bool(jnp.all((idx >= 0) & (idx < 100)))
+
+    def test_selection_pressure(self, key):
+        # Winners' mean score must exceed the population mean — the whole
+        # point of tournament selection (reference pga.cu:280-292).
+        scores = jnp.arange(1000, dtype=jnp.float32)
+        idx = tournament_select(key, scores, 10_000, k=2)
+        assert float(jnp.mean(scores[idx])) > float(jnp.mean(scores)) + 50
+
+    def test_larger_k_more_pressure(self, key):
+        scores = jnp.arange(1000, dtype=jnp.float32)
+        m2 = float(jnp.mean(scores[tournament_select(key, scores, 10_000, k=2)]))
+        m8 = float(jnp.mean(scores[tournament_select(key, scores, 10_000, k=8)]))
+        assert m8 > m2
+
+    def test_deterministic_under_same_key(self, key):
+        scores = jax.random.normal(key, (64,))
+        a = tournament_select(key, scores, 32)
+        b = tournament_select(key, scores, 32)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_parent_pairs(self, key):
+        scores = jnp.arange(10, dtype=jnp.float32)
+        p1, p2 = select_parent_pairs(key, scores, 7, k=2)
+        assert p1.shape == (7,) and p2.shape == (7,)
+
+
+class TestCrossover:
+    def test_uniform_matches_reference_semantics(self):
+        # rand[i] > 0.5 → take p1, else p2 (reference pga.cu:135-143).
+        p1 = jnp.ones(6)
+        p2 = jnp.zeros(6)
+        rand = jnp.array([0.9, 0.1, 0.51, 0.5, 0.0, 1.0])
+        child = uniform_crossover(p1, p2, rand)
+        np.testing.assert_array_equal(
+            np.asarray(child), [1.0, 0.0, 1.0, 0.0, 0.0, 1.0]
+        )
+
+    def test_uniform_mixes_both_parents(self, key):
+        p1 = jnp.zeros(1000)
+        p2 = jnp.ones(1000)
+        rand = jax.random.uniform(key, (1000,))
+        child = uniform_crossover(p1, p2, rand)
+        frac = float(jnp.mean(child))
+        assert 0.4 < frac < 0.6
+
+    def test_one_point(self):
+        p1 = jnp.zeros(10)
+        p2 = jnp.ones(10)
+        rand = jnp.full((10,), 0.5)  # cut at 5
+        child = one_point_crossover(p1, p2, rand)
+        np.testing.assert_array_equal(np.asarray(child[:5]), np.zeros(5))
+        np.testing.assert_array_equal(np.asarray(child[5:]), np.ones(5))
+
+    def test_arithmetic_convex(self, key):
+        p1 = jax.random.uniform(key, (32,))
+        p2 = jax.random.uniform(jax.random.fold_in(key, 1), (32,))
+        rand = jax.random.uniform(jax.random.fold_in(key, 2), (32,))
+        child = arithmetic_crossover(p1, p2, rand)
+        lo = jnp.minimum(p1, p2) - 1e-6
+        hi = jnp.maximum(p1, p2) + 1e-6
+        assert bool(jnp.all((child >= lo) & (child <= hi)))
+
+    def test_order_preserving_keeps_unique_cities(self, key):
+        # Two valid permutations in, child must not duplicate any city that
+        # either parent could supply (reference test3/test.cu:48-64).
+        L = 16
+        k1, k2, k3 = jax.random.split(key, 3)
+        perm1 = jax.random.permutation(k1, L)
+        perm2 = jax.random.permutation(k2, L)
+        # encode city c as (c + 0.5)/L so int(g*L) decodes exactly
+        p1 = (perm1 + 0.5) / L
+        p2 = (perm2 + 0.5) / L
+        rand = jax.random.uniform(k3, (L,))
+        child = order_preserving_crossover(p1, p2, rand)
+        cities = np.floor(np.asarray(child) * L).astype(int)
+        # Positions that came from a parent (match p1 or p2 gene) must be
+        # unique among themselves.
+        from_parent = [
+            c
+            for c, g, g1, g2 in zip(
+                cities, np.asarray(child), np.asarray(p1), np.asarray(p2)
+            )
+            if g == g1 or g == g2
+        ]
+        assert len(from_parent) == len(set(from_parent))
+
+    def test_order_preserving_identical_parents(self):
+        L = 8
+        perm = jnp.arange(L)
+        p = (perm + 0.5) / L
+        rand = jnp.zeros(L)
+        child = order_preserving_crossover(p, p, rand)
+        np.testing.assert_allclose(np.asarray(child), np.asarray(p))
+
+
+class TestMutate:
+    def test_point_mutate_fires(self):
+        g = jnp.zeros(10)
+        # rand[1] <= rate → fire; position floor(rand[0]*L)=3; value rand[2]
+        rand = jnp.zeros(10).at[0].set(0.35).at[1].set(0.0).at[2].set(0.77)
+        out = point_mutate(g, rand, rate=0.01)
+        assert out[3] == pytest.approx(0.77)
+        assert float(jnp.sum(out != 0)) == 1
+
+    def test_point_mutate_holds_fire(self):
+        g = jnp.zeros(10)
+        rand = jnp.zeros(10).at[1].set(0.5).at[2].set(0.77)
+        out = point_mutate(g, rand, rate=0.01)
+        np.testing.assert_array_equal(np.asarray(out), np.zeros(10))
+
+    def test_point_mutate_rate_statistics(self, key):
+        P, L = 20_000, 8
+        genomes = jnp.zeros((P, L))
+        rand = jax.random.uniform(key, (P, L))
+        out = jax.vmap(lambda g, r: point_mutate(g, r, rate=0.01))(genomes, rand)
+        changed = float(jnp.mean(jnp.any(out != 0, axis=1)))
+        assert 0.005 < changed < 0.02  # ~1% of individuals mutate
+
+    def test_gaussian_mutate_bounds(self, key):
+        g = jax.random.uniform(key, (64,))
+        rand = jax.random.uniform(jax.random.fold_in(key, 1), (64,))
+        out = gaussian_mutate(g, rand, rate=1.0, sigma=5.0)
+        assert bool(jnp.all((out >= 0.0) & (out < 1.0)))
+
+    def test_swap_mutate_is_permutation(self):
+        g = jnp.arange(10, dtype=jnp.float32) / 10
+        rand = jnp.zeros(10).at[0].set(0.25).at[1].set(0.85).at[2].set(0.0)
+        out = swap_mutate(g, rand, rate=0.5)
+        assert sorted(np.asarray(out).tolist()) == sorted(np.asarray(g).tolist())
+        assert out[2] == g[8] and out[8] == g[2]
+
+
+class TestTopK:
+    def test_top_k(self, key):
+        genomes = jax.random.uniform(key, (100, 4))
+        scores = jnp.arange(100, dtype=jnp.float32)
+        g, s = top_k_genomes(genomes, scores, 3)
+        np.testing.assert_array_equal(np.asarray(s), [99.0, 98.0, 97.0])
+        np.testing.assert_allclose(np.asarray(g[0]), np.asarray(genomes[99]))
+
+    def test_best_index(self):
+        scores = jnp.array([1.0, 5.0, 3.0])
+        assert int(best_index(scores)) == 1
+
+
+class TestStep:
+    def test_step_shapes_and_purity(self, key):
+        from libpga_tpu.ops.mutate import make_point_mutate
+
+        step = make_step(
+            lambda g: jnp.sum(g), uniform_crossover, make_point_mutate(0.01)
+        )
+        genomes = jax.random.uniform(key, (128, 16))
+        g2, scores = jax.jit(step)(genomes, jax.random.fold_in(key, 1))
+        assert g2.shape == genomes.shape
+        assert scores.shape == (128,)
+        # Same key → identical result (pure function).
+        g3, _ = jax.jit(step)(genomes, jax.random.fold_in(key, 1))
+        np.testing.assert_array_equal(np.asarray(g2), np.asarray(g3))
+
+    def test_step_improves_onemax(self, key):
+        from libpga_tpu.ops.mutate import make_point_mutate
+
+        step = jax.jit(
+            make_step(
+                lambda g: jnp.sum(g), uniform_crossover, make_point_mutate(0.01)
+            )
+        )
+        genomes = jax.random.uniform(key, (512, 32))
+        first_mean = float(jnp.mean(jnp.sum(genomes, axis=1)))
+        k = key
+        for i in range(20):
+            k, sub = jax.random.split(k)
+            genomes, scores = step(genomes, sub)
+        last_mean = float(jnp.mean(jnp.sum(genomes, axis=1)))
+        assert last_mean > first_mean + 2.0
+
+    def test_elitism_preserves_best(self, key):
+        from libpga_tpu.ops.mutate import make_point_mutate
+
+        obj = lambda g: jnp.sum(g)
+        step = jax.jit(
+            make_step(obj, uniform_crossover, make_point_mutate(0.5), elitism=4)
+        )
+        genomes = jax.random.uniform(key, (64, 8))
+        best_before = float(jnp.max(jnp.sum(genomes, axis=1)))
+        g2, _ = step(genomes, jax.random.fold_in(key, 1))
+        best_after = float(jnp.max(jnp.sum(g2, axis=1)))
+        assert best_after >= best_before - 1e-5
+
+
+class TestRegressionFindings:
+    def test_gaussian_mutate_sign_balance(self, key):
+        # The fire gate must be independent of the Box-Muller angle: at low
+        # rates both positive AND negative deltas must occur.
+        g = jnp.full((4096,), 0.5)
+        rand = jax.random.uniform(key, (4096,))
+        out = gaussian_mutate(g, rand, rate=0.1, sigma=0.1)
+        delta = np.asarray(out - g)
+        fired = delta[delta != 0]
+        assert len(fired) > 100
+        pos = (fired > 0).mean()
+        assert 0.3 < pos < 0.7  # roughly symmetric noise
