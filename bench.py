@@ -1,0 +1,80 @@
+"""Headline benchmark: generations/sec on 1M-population OneMax, one chip.
+
+The workload is the reference's first driver scaled to the BASELINE.json
+target: the reference runs pop 40,000 × 100 genes × 100 generations
+(``/root/reference/test/test.cu:37,43,22``) as ~79 chunked kernel launches ×
+3 operators × 100 generations, each followed by a full device sync
+(``/root/reference/src/pga.cu:62-77,269``). Here the same GA — tournament-2
+selection, uniform crossover, 0.01 point mutation — runs as ONE jitted XLA
+program per whole run at pop 1,048,576 × 100.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "generations/sec", "vs_baseline": N}
+
+``vs_baseline`` is measured against an analytic model of the reference on a
+modern datacenter GPU (see BASELINE.md — the reference publishes no numbers,
+so the baseline is its launch-bound execution model: ceil(pop/512) serialized
+launches × 3 operators × ~3.5 µs launch+sync overhead per generation), i.e.
+values > 1 mean faster than the reference's architecture could possibly go
+regardless of its per-thread compute speed.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+POP = 1 << 20  # 1,048,576
+GENOME_LEN = 100
+WARMUP_GENS = 10
+BENCH_GENS = 200
+
+
+def reference_floor_seconds_per_gen() -> float:
+    """Analytic lower bound on the reference's per-generation wall time.
+
+    The reference serializes ceil(pop/512) kernel launches per operator, 3
+    operators per generation, each launch followed by cudaDeviceSynchronize
+    (``src/pga.cu:62-77``: blocks=8 × threads=64 = 512 individuals/launch),
+    plus one cuRAND pool refill. Taking ~3.5 µs as an optimistic
+    launch+sync round-trip on a modern GPU and ignoring ALL compute and
+    memory time, the floor is launches × 3.5 µs.
+    """
+    launches_per_op = math.ceil(POP / 512)
+    return launches_per_op * 3 * 3.5e-6
+
+
+def main() -> None:
+    from libpga_tpu import PGA, PGAConfig
+
+    pga = PGA(seed=42, config=PGAConfig(use_pallas=True))
+    pop = pga.create_population(POP, GENOME_LEN)
+    pga.set_objective("onemax")
+
+    pga.run(WARMUP_GENS)  # compile + warm caches
+    t0 = time.perf_counter()
+    gens = pga.run(BENCH_GENS)
+    jax.block_until_ready(pga.population(pop).genomes)
+    dt = time.perf_counter() - t0
+
+    gps = gens / dt
+    baseline_gps = 1.0 / reference_floor_seconds_per_gen()
+    print(
+        json.dumps(
+            {
+                "metric": "onemax_1M_generations_per_sec",
+                "value": round(gps, 2),
+                "unit": "generations/sec",
+                "vs_baseline": round(gps / baseline_gps, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -36,7 +36,13 @@ class PGAConfig:
         "random" (random island permutation each migration event, matching
         the "randomly migrate" wording of ``pga.h:108-111``).
       use_pallas: route the default-operator generation step through the
-        fused Pallas kernel instead of the XLA-fused path.
+        fused Pallas deme kernel instead of the XLA-fused path. ``None``
+        (default) = auto: on when running on TPU, off elsewhere. The
+        kernel's selection is tournament-2 within per-generation shuffled
+        demes (see ``ops/pallas_step.py``); set False for exact panmictic
+        tournament semantics.
+      pallas_deme_size: rows per VMEM deme in the Pallas kernel (power of
+        two; population must divide by it or the engine falls back).
       donate_buffers: donate the genome buffer to jit so XLA updates it in
         place (the TPU-native replacement for the reference's
         current/next-generation pointer swap, ``pga.h:124-129``).
@@ -51,9 +57,21 @@ class PGAConfig:
     gene_dtype: jnp.dtype = jnp.float32
     max_populations: Optional[int] = None
     migration_topology: str = "ring"
-    use_pallas: bool = False
+    use_pallas: Optional[bool] = None
+    pallas_deme_size: int = 256
     donate_buffers: bool = True
     seed: Optional[int] = None
+
+    def pallas_enabled(self) -> bool:
+        """Resolve the use_pallas auto setting against the live backend."""
+        if self.use_pallas is not None:
+            return self.use_pallas
+        import jax
+
+        try:
+            return jax.default_backend() == "tpu"
+        except RuntimeError:
+            return False
 
     def __post_init__(self):
         if self.tournament_size < 1:

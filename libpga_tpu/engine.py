@@ -199,7 +199,7 @@ class PGA:
 
         obj = self._require_objective()
         breed = self._breed_fn()
-        use_pallas = self.config.use_pallas
+        use_pallas = self.config.pallas_enabled()
 
         def run_loop(genomes, key, n, target):
             scores0 = _evaluate(obj, genomes)
@@ -221,16 +221,29 @@ class PGA:
 
         donate = (0,) if self.config.donate_buffers else ()
         fn = jax.jit(run_loop, donate_argnums=donate)
-        if use_pallas:
+        if (
+            use_pallas
+            and self._is_default_operators()
+            and self.config.elitism == 0
+            and self.config.gene_dtype == jnp.float32  # kernel is f32-only
+        ):
             from libpga_tpu.ops.pallas_step import make_pallas_run
 
-            pallas_fn = make_pallas_run(
-                self._require_objective(),
+            factory = make_pallas_run(
+                obj,
                 tournament_size=self.config.tournament_size,
-                mutation_rate=self.config.mutation_rate,
+                # The rate bound into the active operator, not the config
+                # default — set_mutate(make_point_mutate(r)) must win.
+                mutation_rate=getattr(
+                    self._mutate, "rate", self.config.mutation_rate
+                ),
+                deme_size=self.config.pallas_deme_size,
+                donate=self.config.donate_buffers,
             )
-            if pallas_fn is not None and self._is_default_operators():
-                fn = pallas_fn
+            if factory is not None:
+                pallas_fn = factory(size, genome_len)
+                if pallas_fn is not None:
+                    fn = pallas_fn
         self._compiled[cache_key] = fn
         return fn
 
@@ -314,23 +327,37 @@ class PGA:
         if which == "crossover":
             cross = self._crossover
             k = self.config.tournament_size
+            batched = getattr(cross, "batched", None)
+            cols = getattr(cross, "rand_cols", None)
 
             def op(genomes, scores, key):
                 P, L = genomes.shape
                 k_sel, k_c = jax.random.split(key)
                 i1, i2 = select_parent_pairs(k_sel, scores, P, k=k)
-                rand = jax.random.uniform(k_c, (P, L), dtype=jnp.float32)
-                return jax.vmap(cross)(
-                    jnp.take(genomes, i1, axis=0), jnp.take(genomes, i2, axis=0), rand
-                ).astype(genomes.dtype)
+                p1 = jnp.take(genomes, i1, axis=0)
+                p2 = jnp.take(genomes, i2, axis=0)
+                rand = jax.random.uniform(k_c, (P, cols or L), dtype=jnp.float32)
+                out = (
+                    batched(p1, p2, rand)
+                    if batched is not None
+                    else jax.vmap(cross)(p1, p2, rand)
+                )
+                return out.astype(genomes.dtype)
 
         elif which == "mutate":
             mut = self._mutate
+            batched = getattr(mut, "batched", None)
+            cols = getattr(mut, "rand_cols", None)
 
             def op(genomes, key):
                 P, L = genomes.shape
-                rand = jax.random.uniform(key, (P, L), dtype=jnp.float32)
-                return jax.vmap(mut)(genomes, rand).astype(genomes.dtype)
+                rand = jax.random.uniform(key, (P, cols or L), dtype=jnp.float32)
+                out = (
+                    batched(genomes, rand)
+                    if batched is not None
+                    else jax.vmap(mut)(genomes, rand)
+                )
+                return out.astype(genomes.dtype)
 
         else:
             raise ValueError(which)

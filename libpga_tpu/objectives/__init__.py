@@ -21,6 +21,7 @@ from libpga_tpu.objectives.classic import (
     make_knapsack,
     default_knapsack,
     make_tsp,
+    random_tsp_matrix,
     make_nk_landscape,
     make_deceptive_trap,
 )
@@ -68,6 +69,7 @@ __all__ = [
     "make_knapsack",
     "default_knapsack",
     "make_tsp",
+    "random_tsp_matrix",
     "make_nk_landscape",
     "make_deceptive_trap",
 ]

@@ -26,6 +26,11 @@ def uniform_crossover(p1: jax.Array, p2: jax.Array, rand: jax.Array) -> jax.Arra
     return jnp.where(rand > 0.5, p1, p2)
 
 
+# Already elementwise: the identical expression is the whole-population
+# implementation (see the operator protocol in ops/step.py).
+uniform_crossover.batched = uniform_crossover
+
+
 def one_point_crossover(p1: jax.Array, p2: jax.Array, rand: jax.Array) -> jax.Array:
     """Single cut point drawn from ``rand[0]``; prefix from p1, suffix from p2."""
     L = p1.shape[0]
@@ -34,9 +39,23 @@ def one_point_crossover(p1: jax.Array, p2: jax.Array, rand: jax.Array) -> jax.Ar
     return jnp.where(pos < cut, p1, p2)
 
 
+def _one_point_batched(p1, p2, rand):
+    L = p1.shape[1]
+    cut = jnp.floor(rand[:, 0] * L).astype(jnp.int32)
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    return jnp.where(pos < cut[:, None], p1, p2)
+
+
+one_point_crossover.batched = _one_point_batched
+one_point_crossover.rand_cols = 1
+
+
 def arithmetic_crossover(p1: jax.Array, p2: jax.Array, rand: jax.Array) -> jax.Array:
     """Per-gene convex blend ``a*p1 + (1-a)*p2`` with ``a = rand`` (real-coded GAs)."""
     return rand * p1 + (1.0 - rand) * p2
+
+
+arithmetic_crossover.batched = arithmetic_crossover
 
 
 def order_preserving_crossover(

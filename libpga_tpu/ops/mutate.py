@@ -19,20 +19,61 @@ def point_mutate(genome: jax.Array, rand: jax.Array, rate: float = 0.01) -> jax.
     """With probability ``rate``, set one random gene to a random value.
 
     Semantics of the reference default ``__default_mutate``
-    (``src/pga.cu:127-133``): fires when ``rand[1] <= rate``; target position
-    ``floor(rand[0]*L)``; new value ``rand[2]``. This consumption pattern is
-    why the reference requires ``genome_len >= 4``.
+    (``src/pga.cu:127-133``): fires when ``rand[1]`` is below ``rate``;
+    target position ``floor(rand[0]*L)``; new value ``rand[2]``. This
+    consumption pattern is why the reference requires ``genome_len >= 4``.
+    The gate is strict ``<`` (the reference's ``<=`` differs only on a
+    measure-zero event for rates in (0,1)) so rate=0 disables mutation.
     """
     L = genome.shape[0]
     pos = jnp.clip(jnp.floor(rand[0] * L).astype(jnp.int32), 0, L - 1)
-    fire = rand[1] <= rate
+    fire = rand[1] < rate
     mutated = genome.at[pos].set(rand[2].astype(genome.dtype))
     return jax.lax.select(fire, mutated, genome)
 
 
+def point_mutate_batched(
+    genomes: jax.Array, rand: jax.Array, rate: float = 0.01
+) -> jax.Array:
+    """Population-batched point mutation without a scatter.
+
+    Same semantics as :func:`point_mutate` (rand columns 0..2 = position /
+    gate / value) but expressed as an iota-compare mask over the whole
+    ``(P, L)`` matrix — a pure elementwise program. On TPU this is ~10×
+    faster than the vmap'd per-row ``at[pos].set`` scatter at 1M-population
+    scale (measured: 30 ms → 2.8 ms per generation at 1M×100).
+    """
+    L = genomes.shape[1]
+    pos = jnp.clip(jnp.floor(rand[:, 0] * L).astype(jnp.int32), 0, L - 1)
+    fire = rand[:, 1] < rate
+    hit = (jnp.arange(L, dtype=jnp.int32)[None, :] == pos[:, None]) & fire[:, None]
+    return jnp.where(hit, rand[:, 2:3].astype(genomes.dtype), genomes)
+
+
 def make_point_mutate(rate: float = 0.01):
-    """Bind a rate into the standard ``(genome, rand)`` signature."""
-    return partial(point_mutate, rate=rate)
+    """Bind a rate into the standard ``(genome, rand)`` signature.
+
+    The returned callable carries two optional-protocol attributes the
+    engine's breed step exploits when present (see
+    :func:`libpga_tpu.ops.step.make_breed`):
+
+    - ``batched``: ``(genomes (P,L), rand (P, rand_cols)) -> genomes`` —
+      whole-population implementation used instead of ``vmap``.
+    - ``rand_cols``: how many uniforms per individual the operator actually
+      consumes (the default mutate reads only rand[0..2], reference
+      ``pga.cu:127-133``), so the engine can generate a ``(P, 3)`` random
+      block instead of ``(P, L)``.
+    """
+    fn = partial(point_mutate, rate=rate)
+
+    def mut(genome, rand):
+        return fn(genome, rand)
+
+    mut.func = point_mutate  # identity marker for default-operator checks
+    mut.batched = partial(point_mutate_batched, rate=rate)
+    mut.rand_cols = 3
+    mut.rate = rate  # inspected by the engine's Pallas fast-path gate
+    return mut
 
 
 def gaussian_mutate(
@@ -66,7 +107,15 @@ def gaussian_mutate(
 
 
 def make_gaussian_mutate(rate: float = 0.1, sigma: float = 0.1):
-    return partial(gaussian_mutate, rate=rate, sigma=sigma)
+    fn = partial(gaussian_mutate, rate=rate, sigma=sigma)
+
+    def mut(genome, rand):
+        return fn(genome, rand)
+
+    mut.func = gaussian_mutate
+    # Already elementwise — the batched form is the same computation.
+    mut.batched = partial(gaussian_mutate, rate=rate, sigma=sigma)
+    return mut
 
 
 def swap_mutate(genome: jax.Array, rand: jax.Array, rate: float = 0.5) -> jax.Array:
@@ -74,11 +123,35 @@ def swap_mutate(genome: jax.Array, rand: jax.Array, rate: float = 0.5) -> jax.Ar
     L = genome.shape[0]
     i = jnp.clip(jnp.floor(rand[0] * L).astype(jnp.int32), 0, L - 1)
     j = jnp.clip(jnp.floor(rand[1] * L).astype(jnp.int32), 0, L - 1)
-    fire = rand[2] <= rate
+    fire = rand[2] < rate
     gi, gj = genome[i], genome[j]
     swapped = genome.at[i].set(gj).at[j].set(gi)
     return jax.lax.select(fire, swapped, genome)
 
 
+def swap_mutate_batched(
+    genomes: jax.Array, rand: jax.Array, rate: float = 0.5
+) -> jax.Array:
+    """Population-batched swap mutation via two iota-compare masks
+    (scatter-free; same semantics as :func:`swap_mutate`)."""
+    L = genomes.shape[1]
+    i = jnp.clip(jnp.floor(rand[:, 0] * L).astype(jnp.int32), 0, L - 1)
+    j = jnp.clip(jnp.floor(rand[:, 1] * L).astype(jnp.int32), 0, L - 1)
+    fire = (rand[:, 2] < rate)[:, None]
+    cols = jnp.arange(L, dtype=jnp.int32)[None, :]
+    gi = jnp.take_along_axis(genomes, i[:, None], axis=1)
+    gj = jnp.take_along_axis(genomes, j[:, None], axis=1)
+    out = jnp.where((cols == i[:, None]) & fire, gj, genomes)
+    return jnp.where((cols == j[:, None]) & fire, gi, out)
+
+
 def make_swap_mutate(rate: float = 0.5):
-    return partial(swap_mutate, rate=rate)
+    fn = partial(swap_mutate, rate=rate)
+
+    def mut(genome, rand):
+        return fn(genome, rand)
+
+    mut.func = swap_mutate
+    mut.batched = partial(swap_mutate_batched, rate=rate)
+    mut.rand_cols = 3
+    return mut

@@ -1,20 +1,259 @@
-"""Fused Pallas generation step for the default operators.
+"""Fused Pallas generation step — the TPU fast path for default operators.
 
-Placeholder for the Pallas-kernel fast path (survey §7 step 4): a fused
-tournament-select + uniform-crossover + point-mutate kernel with in-kernel
-PRNG (``pltpu.prng_random_bits``), avoiding the HBM materialization of the
-``(pop, genome_len)`` random pools the XLA path generates.
+One kernel = one whole generation of breeding: tournament-2 selection,
+uniform crossover, and point mutation, fused over a VMEM-resident deme of
+the population. This is the TPU answer to the reference's hot loop, which
+issues ceil(pop/512) chunked launches per operator with a full device sync
+after each (``/root/reference/src/pga.cu:62-77,269``): here the entire
+population breeds in one pass over HBM with zero intermediate HBM traffic.
 
-``make_pallas_run`` returns ``None`` until the kernel lands; the engine
-falls back to the XLA-fused path.
+Why not XLA alone? The naive formulation is random-access bound: tournament
+score lookups and parent row gathers are scalar/row gathers that XLA lowers
+at ~10 ns per access (measured ~60 ms per generation at 1M×100 on v5e).
+This kernel removes all HBM random access:
+
+- **Demes**: the population is processed in blocks ("demes") of ``K``
+  rows that live entirely in VMEM. Selection happens *within* a deme, so
+  every random access is on-chip.
+- **Selection + gather on the MXU**: a k=2 tournament needs ``s[idx]``
+  lookups and parent-row gathers; both become one-hot matmuls
+  (``onehot @ scores`` and ``onehot @ genomes``), which the MXU executes
+  at full tilt. Gene matrices multiply as a bf16 hi/lo split
+  (``g ≈ hi + lo``), giving ~1e-5 absolute accuracy on [0,1) genes —
+  far below mutation noise — at 2× bf16 FLOPs instead of slow f32 MXU.
+- **In-kernel PRNG**: ``pltpu.prng_random_bits`` generates tournament
+  indices, crossover masks, and mutation draws in registers, so no
+  ``(P, L)`` random pool ever touches HBM (the reference materializes
+  exactly such a pool per generation, ``pga.cu:99-105``).
+- **Free global mixing**: each deme's children are written through the
+  output ``BlockSpec`` index map into a ``(K, G, L)`` layout; a free
+  row-major reshape back to ``(P, L)`` interleaves all demes (a riffle
+  shuffle), so deme membership changes every generation and selection is
+  panmictic over a few-generation horizon.
+
+Semantics note: selection is tournament-2 *within the current deme* (a
+random cohort of ``K`` that reshuffles every generation), not i.i.d. over
+the full population. Selection intensity is identical to panmictic
+tournament-2; only opponent locality differs, and the per-generation
+riffle shuffle randomizes it. The exact-panmictic path remains available
+via the XLA breed step (``use_pallas=False``).
 """
 
 from __future__ import annotations
 
+import math
+from functools import partial
 from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+LANE = 128
+
+
+def _supported() -> bool:
+    try:
+        from jax.experimental import pallas as pl  # noqa: F401
+        from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+    except ImportError:
+        return False
+    return True
+
+
+def _breed_kernel(seed_ref, scores_ref, genomes_ref, out_ref, *, K, L, Lp, rate):
+    """One deme: select parents, crossover, mutate. All VMEM/register work."""
+    import jax.lax as lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    i = pl.program_id(0)
+    pltpu.prng_seed(seed_ref[0, 0] ^ (i * jnp.int32(-1640531527)))  # golden-ratio mix
+
+    # NOTE on shapes: Mosaic only supports minor-dim insertion/transpose
+    # for 32-bit types, so every bool/bf16 value here is built directly in
+    # its final 2-D/3-D orientation; only f32/i32 get transposed.
+    s3 = scores_ref[:]   # (1, 1, K) f32
+    g = genomes_ref[:]   # (K, Lp) f32
+
+    # ---- tournament-2 ×2: four candidate index vectors in [0, K) --------
+    idx_bits = pltpu.bitcast(pltpu.prng_random_bits((4, K)), jnp.uint32)
+    idx = pltpu.bitcast(idx_bits & jnp.uint32(K - 1), jnp.int32)  # K = 2^m
+
+    cand = lax.broadcasted_iota(jnp.int32, (4, K, K), 2) == idx[:, :, None]
+    oh = cand.astype(jnp.bfloat16)  # (4, K, K) one-hots, child-major
+
+    # Candidate scores: masked f32 reduce on the VPU — exact (no rounding
+    # of scores). A second, source-major iota-compare (axis 1 = source row
+    # = sublanes) makes the reduction run over sublanes, which the VPU
+    # does ~2× faster than a lane reduction (measured 10.2 → 8.3 ms/gen
+    # at 1M×100).
+    cand_src = lax.broadcasted_iota(jnp.int32, (4, K, K), 1) == idx[:, None, :]
+    sc = jnp.sum(jnp.where(cand_src, s3.reshape(1, K, 1), 0.0), axis=1)  # (4, K)
+    sc_t = sc.T  # (K, 4) — f32 transpose is supported
+
+    # Tie -> first candidate, matching the reference's strict '>'
+    # (pga.cu:286). Comparisons are built as (K, 1) so they broadcast over
+    # the (K, K) selectors without any bool reshape.
+    w1 = sc_t[:, 0:1] >= sc_t[:, 1:2]  # (K, 1) bool
+    w2 = sc_t[:, 2:3] >= sc_t[:, 3:4]
+    oh1 = jnp.where(w1, oh[0], oh[1])  # (K, K) winner selectors
+    oh2 = jnp.where(w2, oh[2], oh[3])
+
+    # ---- parent rows via one-hot matmul, bf16 hi/lo split ---------------
+    g_hi = g.astype(jnp.bfloat16)
+    g_lo = (g - g_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+
+    def sel(oh_w):
+        hi = jnp.dot(oh_w, g_hi, preferred_element_type=jnp.float32)
+        lo = jnp.dot(oh_w, g_lo, preferred_element_type=jnp.float32)
+        return hi + lo
+
+    p1 = sel(oh1)  # (K, Lp) f32
+    p2 = sel(oh2)
+
+    # ---- uniform crossover: per-gene coin flip (pga.cu:135-143) ---------
+    mask_bits = pltpu.bitcast(pltpu.prng_random_bits((K, Lp)), jnp.uint32)
+    child = jnp.where(mask_bits >> 31 == 0, p1, p2)
+
+    # ---- point mutation (pga.cu:127-133): one random gene per firing row
+    mut_bits = pltpu.bitcast(pltpu.prng_random_bits((4, K)), jnp.uint32)
+    # uint32 -> f32 isn't a supported Mosaic cast; the >>8 result fits in
+    # 24 bits, so bitcast to i32 first.
+    u = pltpu.bitcast(mut_bits >> 8, jnp.int32).astype(jnp.float32) * jnp.float32(
+        2**-24
+    )
+    u_t = u.T  # (K, 4) f32
+    pos = jnp.floor(u_t[:, 0:1] * L).astype(jnp.int32)  # (K, 1) in [0, L)
+    cols = lax.broadcasted_iota(jnp.int32, (K, Lp), 1)
+    # Strict '<' so rate=0 disables mutation exactly (the reference's
+    # ``rand[1] <= chance`` gate, pga.cu:128, differs only on a
+    # measure-zero event for rate in (0,1)).
+    hit = (cols == pos) & (u_t[:, 1:2] < rate)
+    child = jnp.where(hit, u_t[:, 2:3], child)
+
+    # Write through the (K, 1, 1, Lp) block: deme i becomes column i of the
+    # (K, G, 1, Lp) output, so the row-major reshape interleaves demes.
+    out_ref[:] = child.reshape(K, 1, 1, Lp)
+
+
+def make_pallas_breed(
+    pop_size: int,
+    genome_len: int,
+    *,
+    deme_size: int = 256,
+    mutation_rate: float = 0.01,
+) -> Optional[Callable]:
+    """Build the fused breed: ``(genomes (P,L) f32, scores (P,), key) ->
+    next_genomes (P, L)``. Returns None when the shape is unsupported
+    (population not divisible into power-of-two demes)."""
+    if not _supported():
+        return None
+    K = deme_size
+    P, L = pop_size, genome_len
+    if K & (K - 1) or P % K or P // K < 1:
+        return None
+    G = P // K
+    Lp = math.ceil(L / LANE) * LANE
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    kernel = partial(_breed_kernel, K=K, L=L, Lp=Lp, rate=mutation_rate)
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(G,),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, K), lambda i: (i, 0, 0)),
+            pl.BlockSpec((K, Lp), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((K, 1, 1, Lp), lambda i: (0, i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((K, G, 1, Lp), jnp.float32),
+    )
+
+    def breed_padded(gp: jax.Array, scores: jax.Array, key: jax.Array):
+        """(P, Lp)-padded variant for loops that keep the pad resident."""
+        seed = jax.random.randint(
+            key, (1, 1), jnp.iinfo(jnp.int32).min, jnp.iinfo(jnp.int32).max,
+            dtype=jnp.int32,
+        )
+        out = call(seed, scores.reshape(G, 1, K).astype(jnp.float32), gp)
+        return out.reshape(P, Lp)
+
+    def breed(genomes: jax.Array, scores: jax.Array, key: jax.Array):
+        gp = genomes.astype(jnp.float32)
+        if Lp != L:
+            gp = jnp.pad(gp, ((0, 0), (0, Lp - L)))
+        out = breed_padded(gp, scores, key)
+        return out[:, :L] if Lp != L else out
+
+    breed.padded = breed_padded
+    breed.Lp = Lp
+    return breed
 
 
 def make_pallas_run(
-    obj: Callable, *, tournament_size: int = 2, mutation_rate: float = 0.01
+    obj: Callable,
+    *,
+    tournament_size: int = 2,
+    mutation_rate: float = 0.01,
+    deme_size: int = 256,
+    donate: bool = True,
 ) -> Optional[Callable]:
-    return None
+    """Build a per-shape factory for the fused run loop used by ``PGA.run``:
+    ``build(pop_size, genome_len)`` returns a jitted
+    ``(genomes, key, n, target) -> (genomes, scores, gens)`` with the same
+    contract as the XLA path in ``engine._compiled_run``, or None when
+    unsupported (k != 2, non-TPU backend, or per-shape inside the factory)
+    — the engine then falls back to the XLA path."""
+    if tournament_size != 2 or not _supported():
+        return None
+    # The Mosaic kernel only lowers on TPU; an explicit use_pallas=True on
+    # CPU/GPU must fall back, not crash at trace time. (make_pallas_breed
+    # itself stays platform-agnostic so force_tpu_interpret_mode tests can
+    # call it on CPU.)
+    import jax as _jax
+
+    if _jax.default_backend() != "tpu":
+        return None
+
+    from libpga_tpu.ops.evaluate import evaluate as _evaluate
+
+    def build(pop_size: int, genome_len: int):
+        breed = make_pallas_breed(
+            pop_size, genome_len,
+            deme_size=deme_size, mutation_rate=mutation_rate,
+        )
+        if breed is None:
+            return None
+
+        L, Lp = genome_len, breed.Lp
+
+        def run_loop(genomes, key, n, target):
+            # Pad once; the loop carries the lane-aligned (P, Lp) matrix.
+            # Evaluation reads the [:, :L] view (the slice fuses into the
+            # objective's reduction — nothing materializes).
+            gp = genomes.astype(jnp.float32)
+            if Lp != L:
+                gp = jnp.pad(gp, ((0, 0), (0, Lp - L)))
+            scores0 = _evaluate(obj, gp[:, :L])
+
+            def cond(carry):
+                g, s, k, gen = carry
+                return jnp.logical_and(gen < n, jnp.max(s) < target)
+
+            def body(carry):
+                g, s, k, gen = carry
+                k, sub = jax.random.split(k)
+                g2 = breed.padded(g, s, sub)
+                s2 = _evaluate(obj, g2[:, :L])
+                return (g2, s2, k, gen + 1)
+
+            init = (gp, scores0, key, jnp.int32(0))
+            g, s, k, gens = jax.lax.while_loop(cond, body, init)
+            return g[:, :L] if Lp != L else g, s, gens
+
+        return jax.jit(run_loop, donate_argnums=(0,) if donate else ())
+
+    return build

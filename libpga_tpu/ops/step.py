@@ -56,6 +56,16 @@ def make_breed(
       ``breed(genomes, scores, key) -> next_genomes``. Pure.
     """
 
+    # Optional operator protocol: a callback may expose ``.batched``
+    # (whole-population implementation, used instead of vmap — lets the
+    # default point mutation run as an iota-compare mask instead of a
+    # per-row scatter) and ``.rand_cols`` (how many uniforms per individual
+    # it consumes, so the rand block can be (P, 3) instead of (P, L)).
+    cross_batched = getattr(crossover_fn, "batched", None)
+    cross_cols = getattr(crossover_fn, "rand_cols", None)
+    mut_batched = getattr(mutate_fn, "batched", None)
+    mut_cols = getattr(mutate_fn, "rand_cols", None)
+
     def breed(genomes: jax.Array, scores: jax.Array, key: jax.Array):
         P, L = genomes.shape
         k_sel, k_cross, k_mut = jax.random.split(key, 3)
@@ -63,11 +73,19 @@ def make_breed(
         p1 = jnp.take(genomes, p1_idx, axis=0)
         p2 = jnp.take(genomes, p2_idx, axis=0)
 
-        rand_c = jax.random.uniform(k_cross, (P, L), dtype=jnp.float32)
-        children = jax.vmap(crossover_fn)(p1, p2, rand_c)
+        rand_c = jax.random.uniform(
+            k_cross, (P, cross_cols or L), dtype=jnp.float32
+        )
+        if cross_batched is not None:
+            children = cross_batched(p1, p2, rand_c)
+        else:
+            children = jax.vmap(crossover_fn)(p1, p2, rand_c)
 
-        rand_m = jax.random.uniform(k_mut, (P, L), dtype=jnp.float32)
-        nxt = jax.vmap(mutate_fn)(children, rand_m)
+        rand_m = jax.random.uniform(k_mut, (P, mut_cols or L), dtype=jnp.float32)
+        if mut_batched is not None:
+            nxt = mut_batched(children, rand_m)
+        else:
+            nxt = jax.vmap(mutate_fn)(children, rand_m)
 
         if elitism > 0:
             _, elite_idx = jax.lax.top_k(scores, elitism)

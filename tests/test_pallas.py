@@ -1,0 +1,105 @@
+"""Tests for the fused Pallas generation step (ops/pallas_step.py).
+
+The kernel's PRNG (``pltpu.prng_random_bits``) only produces real entropy
+on TPU hardware; under ``force_tpu_interpret_mode`` on CPU it yields
+all-zero bits. That still deterministically exercises everything
+*structural* — block mappings, the riffle-shuffle output layout, the
+one-hot selection matmuls, padding — because zero bits mean "every
+tournament candidate is deme row 0", giving an exactly predictable output.
+Distributional properties (selection pressure, mutation statistics) are
+validated on real TPU by ``tools/tpu_kernel_checks.py``, which the
+benchmark path runs against hardware.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from libpga_tpu.ops.pallas_step import make_pallas_breed, make_pallas_run
+from libpga_tpu.objectives import onemax
+
+
+def _interpret():
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.force_tpu_interpret_mode()
+
+
+def test_unsupported_shapes_return_none():
+    assert make_pallas_breed(1000, 10, deme_size=256) is None  # 1000 % 256 != 0
+    assert make_pallas_breed(1024, 10, deme_size=96) is None  # not a power of 2
+
+
+def test_run_factory_gates_on_tournament_size():
+    assert make_pallas_run(onemax, tournament_size=3) is None
+
+
+def test_run_factory_gates_on_backend():
+    """On the CPU test platform the run factory must decline entirely —
+    an explicit use_pallas=True off-TPU falls back instead of crashing at
+    Mosaic trace time."""
+    assert jax.default_backend() != "tpu"
+    assert make_pallas_run(onemax, tournament_size=2) is None
+
+
+def test_kernel_structure_zero_bits():
+    """With zero PRNG bits every child is deme-row-0 crossed with itself:
+    output row r must be a copy of row 0 of deme ``r % G`` — this pins the
+    input block mapping, the shuffle output mapping, and padding at once."""
+    P, L, K = 1024, 20, 128
+    G = P // K
+    with _interpret():
+        breed = make_pallas_breed(P, L, deme_size=K, mutation_rate=0.0)
+        assert breed is not None
+        genomes = (
+            jnp.broadcast_to(jnp.arange(P, dtype=jnp.float32)[:, None], (P, L))
+            / P
+        )
+        scores = jnp.zeros((P,), jnp.float32)
+        out = np.asarray(breed(genomes, scores, jax.random.key(0)))
+    assert out.shape == (P, L)
+    expect = np.asarray([(r % G) * K / P for r in range(P)], dtype=np.float32)
+    np.testing.assert_allclose(out, np.broadcast_to(expect[:, None], (P, L)))
+
+
+def test_kernel_gene_values_near_exact():
+    """The bf16 hi/lo one-hot matmul reproduces f32 genes to the documented
+    ~1e-5 bound (hi+lo covers ~16 mantissa bits; residual ≤ ~2^-17 on
+    [0,1) genes)."""
+    P, L, K = 512, 130, 128  # L > 128 exercises multi-lane padding
+    G = P // K
+    key = jax.random.key(3)
+    genomes = jax.random.uniform(key, (P, L), dtype=jnp.float32)
+    with _interpret():
+        breed = make_pallas_breed(P, L, deme_size=K, mutation_rate=0.0)
+        out = np.asarray(breed(genomes, jnp.zeros((P,)), jax.random.key(1)))
+    gn = np.asarray(genomes)
+    # zero bits -> child r = row 0 of deme r % G
+    for r in range(0, P, 37):
+        src = (r % G) * K
+        np.testing.assert_allclose(out[r], gn[src], atol=2e-5, rtol=0)
+
+
+def test_engine_falls_back_when_pallas_unavailable():
+    """On CPU the auto setting disables Pallas and the XLA path runs."""
+    from libpga_tpu import PGA, PGAConfig
+
+    pga = PGA(seed=0, config=PGAConfig())
+    assert pga.config.pallas_enabled() is False  # CPU test platform
+    pop = pga.create_population(256, 8)
+    pga.set_objective("onemax")
+    pga.run(3)
+    best = pga.get_best(pop)
+    assert best.shape == (8,)
+
+
+def test_mutation_rate_zero_never_fires():
+    """rate=0 must be a strict no-op even for zero random bits (the gate
+    is strict '<'; the reference's '<=' would fire on u == 0)."""
+    P, L, K = 256, 8, 128
+    with _interpret():
+        breed = make_pallas_breed(P, L, deme_size=K, mutation_rate=0.0)
+        genomes = jnp.full((P, L), 0.5, dtype=jnp.float32)
+        out = np.asarray(breed(genomes, jnp.zeros((P,)), jax.random.key(0)))
+    np.testing.assert_array_equal(out, np.full((P, L), 0.5, dtype=np.float32))

@@ -1,0 +1,98 @@
+"""Hardware validation for the fused Pallas breed kernel.
+
+Run on a real TPU (``python tools/tpu_kernel_checks.py``). Complements the
+CPU interpret-mode structural tests in ``tests/test_pallas.py`` with the
+distributional properties that need real in-kernel PRNG entropy:
+
+1. Parentage: every child's genes come from ≤2 parents, both inside the
+   child's source deme (validates in-deme one-hot selection + the
+   riffle-shuffle output mapping under random indices).
+2. Gene exactness: selected gene values match the parent rows bit-exactly
+   (bf16 hi/lo one-hot matmul reconstruction).
+3. Selection pressure: mean parent score ≈ 2/3 quantile of uniform scores
+   (tournament-2 expectation E[max(U1,U2)] = 2/3).
+4. Mutation: at rate=1 exactly one gene per row changes, uniformly over
+   positions; at rate=0 nothing changes.
+5. Convergence: the engine's Pallas path solves OneMax to >99% optimum.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from libpga_tpu.ops.pallas_step import make_pallas_breed
+
+
+def check(name, ok):
+    print(("PASS" if ok else "FAIL"), name, flush=True)
+    return ok
+
+
+def main() -> int:
+    if jax.default_backend() != "tpu":
+        print("SKIP: not running on TPU")
+        return 0
+    good = True
+    P, L, K = 4096, 100, 256
+    G = P // K
+
+    breed = make_pallas_breed(P, L, deme_size=K, mutation_rate=0.0)
+    genomes = (
+        jnp.broadcast_to(jnp.arange(P, dtype=jnp.float32)[:, None], (P, L)) / P
+    )
+    scores = jax.random.uniform(jax.random.key(1), (P,))
+    out = np.asarray(breed(genomes, scores, jax.random.key(2)))
+    sn = np.asarray(scores)
+
+    parent_ok, exact_ok = True, True
+    parent_scores = []
+    for r in range(P):
+        ids = np.round(out[r] * P)
+        # i/P genes round-trip the bf16 hi/lo split exactly for P=4096
+        exact_ok &= bool(np.all(ids == out[r] * P))
+        ids = np.unique(ids.astype(int))
+        d = r % G
+        parent_ok &= len(ids) <= 2 and all(d * K <= p < (d + 1) * K for p in ids)
+        parent_scores.extend(sn[ids])
+    good &= check("parentage within shuffled demes", parent_ok)
+    good &= check("gene values exact for 16-bit genes", exact_ok)
+    pressure = float(np.mean(parent_scores))
+    good &= check(
+        f"selection pressure ~2/3 (got {pressure:.3f})", 0.63 < pressure < 0.70
+    )
+
+    breed1 = make_pallas_breed(P, L, deme_size=K, mutation_rate=1.0)
+    outm = np.asarray(breed1(jnp.zeros((P, L)), scores, jax.random.key(3)))
+    changed = (outm != 0).sum(axis=1)
+    pos = np.argmax(outm != 0, axis=1)
+    good &= check(
+        "mutation rate=1: exactly one gene per row",
+        float((changed == 1).mean()) > 0.99,  # val==0.0 draws are ~2^-24
+    )
+    good &= check(
+        f"mutation positions uniform (mean {pos.mean():.1f} ~ {(L-1)/2})",
+        abs(pos.mean() - (L - 1) / 2) < 2.0,
+    )
+
+    from libpga_tpu import PGA, PGAConfig
+
+    pga = PGA(seed=7, config=PGAConfig(use_pallas=True))
+    pga.create_population(1 << 16, 100)
+    pga.set_objective("onemax")
+    pga.run(300)
+    _, best = pga.get_best_with_score(
+        __import__("libpga_tpu.engine", fromlist=["PopulationHandle"]).PopulationHandle(0)
+    )
+    good &= check(f"OneMax convergence (best {best:.1f}/100)", best > 99.0)
+
+    print("ALL PASS" if good else "FAILURES", flush=True)
+    return 0 if good else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
