@@ -1,0 +1,319 @@
+/* pga_tpu.cc — native C ABI shim over the libpga_tpu Python package.
+ *
+ * Architecture: this shared library embeds a CPython interpreter
+ * (initialized lazily on the first pga_init) and forwards every API call
+ * to libpga_tpu.capi_bridge, which owns the JAX/TPU engine. All marshal
+ * traffic is ints/floats/strings/bytes; genome arrays cross the boundary
+ * as raw float32 bytes and are re-exposed to C as malloc'd gene buffers
+ * (the reference's ownership contract, pga.cu:231-235).
+ *
+ * Host callbacks (custom objective/mutate/crossover) are passed as raw
+ * function-pointer addresses; the bridge wraps them with ctypes and
+ * evaluates through jax.pure_callback. See pga_tpu.h for the tradeoff.
+ */
+
+#include "pga_tpu.h"
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+constexpr const char *kBridge = "libpga_tpu.capi_bridge";
+
+bool g_py_owner = false;  /* we called Py_Initialize */
+
+struct Bridge {
+    PyObject *mod = nullptr;
+};
+
+Bridge &bridge() {
+    static Bridge b;
+    return b;
+}
+
+void print_py_error(const char *where) {
+    std::fprintf(stderr, "pga_tpu: python error in %s:\n", where);
+    PyErr_Print();
+}
+
+/* Initialize the embedded interpreter and import the bridge module. */
+bool ensure_python() {
+    if (bridge().mod) return true;
+    if (!Py_IsInitialized()) {
+        Py_InitializeEx(0);
+        g_py_owner = true;
+    }
+    PyObject *mod = PyImport_ImportModule(kBridge);
+    if (!mod) {
+        print_py_error("import libpga_tpu.capi_bridge "
+                       "(is the repo root on PYTHONPATH?)");
+        return false;
+    }
+    bridge().mod = mod;
+    return true;
+}
+
+/* Call bridge.<name>(args...) with a PyObject_CallMethod format string.
+ * Returns a new reference or nullptr (python error printed). */
+PyObject *call(const char *name, const char *fmt, ...) {
+    if (!ensure_python()) return nullptr;
+    va_list ap;
+    va_start(ap, fmt);
+    PyObject *callable = PyObject_GetAttrString(bridge().mod, name);
+    if (!callable) {
+        va_end(ap);
+        print_py_error(name);
+        return nullptr;
+    }
+    PyObject *args = Py_VaBuildValue(fmt, ap);
+    va_end(ap);
+    if (!args) {
+        Py_DECREF(callable);
+        print_py_error(name);
+        return nullptr;
+    }
+    /* Py_VaBuildValue yields a tuple only for multi-arg formats. */
+    if (!PyTuple_Check(args)) {
+        PyObject *t = PyTuple_Pack(1, args);
+        Py_DECREF(args);
+        args = t;
+    }
+    PyObject *out = PyObject_CallObject(callable, args);
+    Py_DECREF(args);
+    Py_DECREF(callable);
+    if (!out) print_py_error(name);
+    return out;
+}
+
+/* Variants returning plain C results; -1/nullptr signal errors. */
+long call_long(const char *name, const char *fmt, ...) {
+    va_list ap;
+    va_start(ap, fmt);
+    PyObject *callable =
+        ensure_python() ? PyObject_GetAttrString(bridge().mod, name) : nullptr;
+    if (!callable) {
+        va_end(ap);
+        if (bridge().mod) print_py_error(name);
+        return -1;
+    }
+    PyObject *args = Py_VaBuildValue(fmt, ap);
+    va_end(ap);
+    if (!args) {
+        Py_DECREF(callable);
+        print_py_error(name);
+        return -1;
+    }
+    if (!PyTuple_Check(args)) {
+        PyObject *t = PyTuple_Pack(1, args);
+        Py_DECREF(args);
+        args = t;
+    }
+    PyObject *out = PyObject_CallObject(callable, args);
+    Py_DECREF(args);
+    Py_DECREF(callable);
+    if (!out) {
+        print_py_error(name);
+        return -1;
+    }
+    long v = out == Py_None ? 0 : PyLong_AsLong(out);
+    if (PyErr_Occurred()) {
+        print_py_error(name);
+        v = -1;
+    }
+    Py_DECREF(out);
+    return v;
+}
+
+/* Convert a bytes result (float32 payload) into a malloc'd gene buffer. */
+gene *bytes_to_genes(PyObject *out) {
+    if (!out) return nullptr;
+    char *buf = nullptr;
+    Py_ssize_t len = 0;
+    if (PyBytes_AsStringAndSize(out, &buf, &len) != 0) {
+        print_py_error("bytes result");
+        Py_DECREF(out);
+        return nullptr;
+    }
+    gene *genes = static_cast<gene *>(std::malloc(len));
+    if (genes) std::memcpy(genes, buf, len);
+    Py_DECREF(out);
+    return genes;
+}
+
+/* Handle packing: pga_t* carries the solver handle; population_t* carries
+ * (solver_handle << 16 | pop_index + 1) so both sides stay opaque,
+ * pointer-shaped, and never collide with NULL. */
+inline pga_t *pack_solver(long h) {
+    return reinterpret_cast<pga_t *>(static_cast<intptr_t>(h));
+}
+inline long solver_of(pga_t *p) {
+    return static_cast<long>(reinterpret_cast<intptr_t>(p));
+}
+inline population_t *pack_pop(long solver, long index) {
+    return reinterpret_cast<population_t *>(
+        static_cast<intptr_t>((solver << 16) | (index + 1)));
+}
+inline long pop_index_of(population_t *pop) {
+    return (static_cast<long>(reinterpret_cast<intptr_t>(pop)) & 0xffff) - 1;
+}
+
+}  // namespace
+
+extern "C" {
+
+pga_t *pga_init(long seed) {
+    long h = call_long("init", "(l)", seed);
+    return h <= 0 ? nullptr : pack_solver(h);
+}
+
+void pga_deinit(pga_t *p) {
+    if (!p) return;
+    call_long("deinit", "(l)", solver_of(p));
+}
+
+population_t *pga_create_population(pga_t *p, unsigned size,
+                                    unsigned genome_len,
+                                    enum population_type type) {
+    if (!p) return nullptr;
+    long idx = call_long("create_population", "(lIIi)", solver_of(p), size,
+                         genome_len, static_cast<int>(type));
+    return idx < 0 ? nullptr : pack_pop(solver_of(p), idx);
+}
+
+int pga_set_objective_function(pga_t *p, obj_f f) {
+    if (!p || !f) return -1;
+    return static_cast<int>(
+        call_long("set_objective_ptr", "(ll)", solver_of(p),
+                  static_cast<long>(reinterpret_cast<intptr_t>(f))));
+}
+
+int pga_set_mutate_function(pga_t *p, mutate_f f) {
+    if (!p) return -1;
+    return static_cast<int>(
+        call_long("set_mutate_ptr", "(ll)", solver_of(p),
+                  static_cast<long>(reinterpret_cast<intptr_t>(f))));
+}
+
+int pga_set_crossover_function(pga_t *p, crossover_f f) {
+    if (!p) return -1;
+    return static_cast<int>(
+        call_long("set_crossover_ptr", "(ll)", solver_of(p),
+                  static_cast<long>(reinterpret_cast<intptr_t>(f))));
+}
+
+int pga_set_objective_name(pga_t *p, const char *name) {
+    if (!p || !name) return -1;
+    return static_cast<int>(
+        call_long("set_objective_name", "(ls)", solver_of(p), name));
+}
+
+gene *pga_get_best(pga_t *p, population_t *pop) {
+    if (!p || !pop) return nullptr;
+    return bytes_to_genes(
+        call("get_best", "(ll)", solver_of(p), pop_index_of(pop)));
+}
+
+gene *pga_get_best_top(pga_t *p, population_t *pop, unsigned length) {
+    if (!p || !pop) return nullptr;
+    return bytes_to_genes(call("get_best_top", "(llI)", solver_of(p),
+                               pop_index_of(pop), length));
+}
+
+gene *pga_get_best_all(pga_t *p) {
+    if (!p) return nullptr;
+    return bytes_to_genes(call("get_best_all", "(l)", solver_of(p)));
+}
+
+gene *pga_get_best_top_all(pga_t *p, unsigned length) {
+    if (!p) return nullptr;
+    return bytes_to_genes(
+        call("get_best_top_all", "(lI)", solver_of(p), length));
+}
+
+int pga_evaluate(pga_t *p, population_t *pop) {
+    if (!p || !pop) return -1;
+    return static_cast<int>(
+        call_long("evaluate", "(ll)", solver_of(p), pop_index_of(pop)));
+}
+
+int pga_evaluate_all(pga_t *p) {
+    if (!p) return -1;
+    return static_cast<int>(call_long("evaluate_all", "(l)", solver_of(p)));
+}
+
+int pga_crossover(pga_t *p, population_t *pop,
+                  enum crossover_selection_type type) {
+    if (!p || !pop) return -1;
+    return static_cast<int>(call_long("crossover", "(lli)", solver_of(p),
+                                      pop_index_of(pop),
+                                      static_cast<int>(type)));
+}
+
+int pga_crossover_all(pga_t *p, enum crossover_selection_type type) {
+    if (!p) return -1;
+    return static_cast<int>(
+        call_long("crossover_all", "(li)", solver_of(p),
+                  static_cast<int>(type)));
+}
+
+int pga_migrate(pga_t *p, float pct) {
+    if (!p) return -1;
+    return static_cast<int>(
+        call_long("migrate", "(lf)", solver_of(p), static_cast<double>(pct)));
+}
+
+int pga_migrate_between(pga_t *p, population_t *from, population_t *to,
+                        float pct) {
+    if (!p || !from || !to) return -1;
+    return static_cast<int>(call_long("migrate_between", "(lllf)",
+                                      solver_of(p), pop_index_of(from),
+                                      pop_index_of(to),
+                                      static_cast<double>(pct)));
+}
+
+int pga_mutate(pga_t *p, population_t *pop) {
+    if (!p || !pop) return -1;
+    return static_cast<int>(
+        call_long("mutate", "(ll)", solver_of(p), pop_index_of(pop)));
+}
+
+int pga_mutate_all(pga_t *p) {
+    if (!p) return -1;
+    return static_cast<int>(call_long("mutate_all", "(l)", solver_of(p)));
+}
+
+int pga_swap_generations(pga_t *p, population_t *pop) {
+    if (!p || !pop) return -1;
+    return static_cast<int>(
+        call_long("swap_generations", "(ll)", solver_of(p), pop_index_of(pop)));
+}
+
+int pga_fill_random_values(pga_t *p, population_t *pop) {
+    if (!p || !pop) return -1;
+    return static_cast<int>(call_long("fill_random_values", "(ll)",
+                                      solver_of(p), pop_index_of(pop)));
+}
+
+int pga_run(pga_t *p, unsigned n, float target) {
+    if (!p) return -1;
+    return static_cast<int>(call_long("run", "(lIif)", solver_of(p), n, 1,
+                                      static_cast<double>(target)));
+}
+
+int pga_run_n(pga_t *p, unsigned n) {
+    if (!p) return -1;
+    return static_cast<int>(
+        call_long("run", "(lIif)", solver_of(p), n, 0, 0.0));
+}
+
+int pga_run_islands(pga_t *p, unsigned n, unsigned m, float pct) {
+    if (!p) return -1;
+    return static_cast<int>(call_long("run_islands", "(lIIf)", solver_of(p),
+                                      n, m, static_cast<double>(pct)));
+}
+
+}  // extern "C"

@@ -1,0 +1,111 @@
+/* pga_tpu.h — C API for the TPU-native genetic-algorithm framework.
+ *
+ * Drop-in shaped after the reference libpga C API (reference repo
+ * include/pga.h:26-150): same types, same 20 entry points, same call
+ * order. Differences, all forced by the hardware model and all additive:
+ *
+ *  - Callbacks are plain HOST function pointers. The reference requires
+ *    CUDA __device__ pointers fetched via cudaMemcpyFromSymbol
+ *    (pga.h:66); a TPU has no device function pointers. Host callbacks
+ *    round-trip genomes to the CPU each operator — correct for any
+ *    driver, fast only for small populations. For on-device speed, use
+ *    pga_set_objective_name() with a builtin (e.g. "onemax",
+ *    "rastrigin") instead.
+ *  - pga_init() takes a seed (pass PGA_SEED_RANDOM for the reference's
+ *    time(NULL) behavior, pga.cu:154).
+ *  - Functions the reference declares but stubs out — pga_get_best_top,
+ *    pga_get_best_all, pga_get_best_top_all (pga.cu:238-248), pga_migrate,
+ *    pga_migrate_between (pga.cu:368-374), pga_run_islands (pga.cu:393-395),
+ *    and pga_run's early-termination variant (pga.h:137-143) — are fully
+ *    implemented here.
+ *
+ * Thread safety: none (matches the reference). One in-process user.
+ */
+#ifndef PGA_TPU_H
+#define PGA_TPU_H
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct pga pga_t;               /* opaque solver (pga.h:26) */
+typedef struct population population_t; /* opaque population (pga.h:27) */
+
+typedef float gene;                     /* pga.h:29 */
+
+#define PGA_SEED_RANDOM (-1)
+
+enum population_type {
+    RANDOM_POPULATION = 0               /* pga.h:31-34 */
+};
+
+enum crossover_selection_type {
+    TOURNAMENT = 0                      /* pga.h:39-42; only strategy */
+};
+
+/* Callback signatures — the reference's exact shapes (pga.h:46-48),
+ * minus the __device__ qualifier. rand is a per-individual slice of
+ * uniform [0,1) values, genome_len long. Higher objective = better. */
+typedef float (*obj_f)(gene *genome, unsigned genome_len);
+typedef void (*mutate_f)(gene *genome, float *rand, unsigned genome_len);
+typedef void (*crossover_f)(gene *p1, gene *p2, gene *child, float *rand,
+                            unsigned genome_len);
+
+/* Lifecycle (pga.h:53,58). */
+pga_t *pga_init(long seed);
+void pga_deinit(pga_t *p);
+
+/* Create a population of `size` genomes, `genome_len >= 4` genes each
+ * (pga.h:63; the length guard mirrors pga.cu:184). Returns NULL on
+ * error. At most 10 populations per solver (pga.h:44). */
+population_t *pga_create_population(pga_t *p, unsigned size,
+                                    unsigned genome_len,
+                                    enum population_type type);
+
+/* Callback registration (pga.h:72,78,85). NULL mutate/crossover restores
+ * the defaults (uniform crossover, 0.01 point mutation — pga.cu:127-143). */
+int pga_set_objective_function(pga_t *p, obj_f f);
+int pga_set_mutate_function(pga_t *p, mutate_f f);
+int pga_set_crossover_function(pga_t *p, crossover_f f);
+
+/* On-device builtin objective by name ("onemax", "onemax_bits", "sphere",
+ * "rastrigin", "ackley", "knapsack"). The fast path: the whole GA stays
+ * on the TPU. Returns 0 on success, -1 on unknown name. */
+int pga_set_objective_name(pga_t *p, const char *name);
+
+/* Result extraction (pga.h:90-93). Return malloc'd gene arrays (caller
+ * frees), genome_len genes per row; NULL on error. The reference returns
+ * NULL unconditionally for the _top/_all variants (pga.cu:238-248). */
+gene *pga_get_best(pga_t *p, population_t *pop);
+gene *pga_get_best_top(pga_t *p, population_t *pop, unsigned length);
+gene *pga_get_best_all(pga_t *p);
+gene *pga_get_best_top_all(pga_t *p, unsigned length);
+
+/* Step-by-step operators (pga.h:98-134). */
+int pga_evaluate(pga_t *p, population_t *pop);
+int pga_evaluate_all(pga_t *p);
+int pga_crossover(pga_t *p, population_t *pop,
+                  enum crossover_selection_type type);
+int pga_crossover_all(pga_t *p, enum crossover_selection_type type);
+int pga_migrate(pga_t *p, float pct);
+int pga_migrate_between(pga_t *p, population_t *from, population_t *to,
+                        float pct);
+int pga_mutate(pga_t *p, population_t *pop);
+int pga_mutate_all(pga_t *p);
+int pga_swap_generations(pga_t *p, population_t *pop);
+int pga_fill_random_values(pga_t *p, population_t *pop);
+
+/* Fused run loops (pga.h:143,150). pga_run returns the number of
+ * generations executed (early termination when the best objective reaches
+ * `target` — pass pga_run_n for the reference's fixed-count behavior).
+ * pga_run_islands evolves ALL populations with top-`pct` migration every
+ * `m` generations. Negative return = error. */
+int pga_run(pga_t *p, unsigned n, float target);
+int pga_run_n(pga_t *p, unsigned n);
+int pga_run_islands(pga_t *p, unsigned n, unsigned m, float pct);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* PGA_TPU_H */

@@ -1,0 +1,362 @@
+"""Python side of the C ABI shim (see ``capi/``).
+
+The native ``libpga_tpu_c.so`` embeds CPython and calls the flat functions
+in this module. Each function takes/returns only ints, floats, strings and
+bytes so the C side can marshal with plain ``PyObject_CallMethod`` format
+strings — no pybind11, no buffer-protocol gymnastics.
+
+Handle model: solvers live in a process-global table keyed by integer
+handles (the C side wraps them in opaque ``pga_t*``); populations are
+addressed by their index inside a solver, mirroring the reference where
+``population_t*`` points into the solver's own array
+(``/root/reference/src/pga.cu:48-56``).
+
+Custom operators through the C ABI: the reference hands CUDA *device*
+function pointers across the API (``include/pga.h:66`` requires callbacks
+be ``__device__``). A TPU has no device function pointers, so the shim
+offers two surfaces:
+
+- named builtin objectives (``pga_set_objective_name``) — the fast path;
+  the whole GA stays on-device;
+- raw *host* C function pointers with the reference's exact signatures
+  (``float (*)(gene*, unsigned)`` etc.) — the compatibility path. The
+  engine evaluates them through ``ctypes`` + ``jax.pure_callback``, so
+  genomes round-trip to the host each generation: correct for any driver,
+  sensible only for small populations.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import ctypes
+import os
+from typing import Dict, Optional, Set
+
+import numpy as np
+
+# Host-callback operators need a backend that supports jax host callbacks;
+# tunneled TPU transports may not (axon: "does not support host send/recv
+# callbacks"). Make sure a CPU backend is also available so host-callback
+# solvers can execute there. Must happen before the first backend init.
+_platforms = os.environ.get("JAX_PLATFORMS", "")
+if _platforms and "cpu" not in _platforms.split(","):
+    os.environ["JAX_PLATFORMS"] = _platforms + ",cpu"
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    except Exception:  # backends already initialized — leave as-is
+        pass
+
+_solvers: Dict[int, object] = {}
+_next_handle = 1
+
+# Keep ctypes callback wrappers alive for the lifetime of their solver.
+_retained: Dict[int, list] = {}
+
+# Which of a solver's operators are host C callbacks ("obj" / "mut" /
+# "cross"): while any is installed, the solver's device code must run on
+# the CPU backend (see note above). Restoring a default via NULL removes
+# the entry so a fully builtin solver returns to the accelerator.
+_host_ops: Dict[int, Set[str]] = {}
+
+
+def _set_host_op(handle: int, kind: str, on: bool) -> None:
+    ops = _host_ops.setdefault(handle, set())
+    (ops.add if on else ops.discard)(kind)
+
+
+def _exec_ctx(handle: int):
+    """Device placement for a solver's jitted programs."""
+    if _host_ops.get(handle):
+        import jax
+
+        return jax.default_device(jax.devices("cpu")[0])
+    return contextlib.nullcontext()
+
+_OBJ_SIG = ctypes.CFUNCTYPE(ctypes.c_float, ctypes.POINTER(ctypes.c_float), ctypes.c_uint)
+_MUT_SIG = ctypes.CFUNCTYPE(
+    None, ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float), ctypes.c_uint
+)
+_CROSS_SIG = ctypes.CFUNCTYPE(
+    None,
+    ctypes.POINTER(ctypes.c_float),
+    ctypes.POINTER(ctypes.c_float),
+    ctypes.POINTER(ctypes.c_float),
+    ctypes.POINTER(ctypes.c_float),
+    ctypes.c_uint,
+)
+
+
+def _solver(handle: int):
+    try:
+        return _solvers[handle]
+    except KeyError:
+        raise ValueError(f"invalid pga handle {handle}") from None
+
+
+def init(seed: int) -> int:
+    """``pga_init`` (pga.h:53). seed < 0 → OS entropy (the reference seeds
+    with time(NULL), pga.cu:154)."""
+    global _next_handle
+    from libpga_tpu.engine import PGA
+    from libpga_tpu.config import PGAConfig
+
+    config = PGAConfig(max_populations=10)  # reference cap, pga.h:44
+    pga = PGA(seed=None if seed < 0 else seed, config=config)
+    h = _next_handle
+    _next_handle += 1
+    _solvers[h] = pga
+    _retained[h] = []
+    return h
+
+
+def deinit(handle: int) -> None:
+    _solvers.pop(handle, None)
+    _retained.pop(handle, None)
+    _host_ops.pop(handle, None)
+
+
+def create_population(handle: int, size: int, genome_len: int, ptype: int) -> int:
+    """Returns the population index, or raises (C side maps to NULL)."""
+    init_name = {0: "random"}.get(ptype)
+    if init_name is None:
+        raise ValueError(f"unknown population_type {ptype}")
+    pga = _solver(handle)
+    return pga.create_population(size, genome_len, init=init_name).index
+
+
+def set_objective_name(handle: int, name: str) -> None:
+    _solver(handle).set_objective(name)
+
+
+def set_objective_ptr(handle: int, addr: int) -> None:
+    """Install a host C objective ``float fn(gene*, unsigned)``.
+
+    Wrapped through jax.pure_callback: genomes come to the host once per
+    evaluation, the C function runs per individual. Matches the reference
+    callback contract (pga.h:46) at host speed.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    cfn = _OBJ_SIG(addr)
+    _retained[handle].append(cfn)
+    _set_host_op(handle, "obj", True)
+
+    def host_eval(batch: np.ndarray) -> np.ndarray:
+        batch = np.ascontiguousarray(batch, dtype=np.float32)
+        out = np.empty(batch.shape[0], dtype=np.float32)
+        n = ctypes.c_uint(batch.shape[1])
+        for i in range(batch.shape[0]):
+            out[i] = cfn(batch[i].ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n)
+        return out
+
+    def objective(genome):
+        # Per-genome signature; the engine vmaps. pure_callback with
+        # vmap_method="expand_dims" turns the vmap into ONE host call on
+        # the whole (P, L) batch.
+        return jax.pure_callback(
+            lambda g: host_eval(g.reshape(1, -1) if g.ndim == 1 else g).reshape(
+                () if g.ndim == 1 else g.shape[:1]
+            ),
+            jax.ShapeDtypeStruct((), jnp.float32),
+            genome,
+            vmap_method="expand_dims",
+        )
+
+    _solver(handle).set_objective(objective)
+
+
+def set_mutate_ptr(handle: int, addr: int) -> None:
+    """Install a host C mutation ``void fn(gene*, float* rand, unsigned)``
+    (pga.h:47, in-place). addr == 0 restores the default."""
+    import jax
+    import jax.numpy as jnp
+
+    pga = _solver(handle)
+    if addr == 0:
+        pga.set_mutate(None)
+        _set_host_op(handle, "mut", False)
+        return
+    cfn = _MUT_SIG(addr)
+    _retained[handle].append(cfn)
+    _set_host_op(handle, "mut", True)
+
+    def host_mut(batch: np.ndarray, rand: np.ndarray) -> np.ndarray:
+        batch = np.ascontiguousarray(batch, dtype=np.float32).copy()
+        rand = np.ascontiguousarray(rand, dtype=np.float32)
+        n = ctypes.c_uint(batch.shape[1])
+        fp = ctypes.POINTER(ctypes.c_float)
+        for i in range(batch.shape[0]):
+            cfn(batch[i].ctypes.data_as(fp), rand[i].ctypes.data_as(fp), n)
+        return batch
+
+    def mutate(genome, rand):
+        return jax.pure_callback(
+            lambda g, r: host_mut(
+                g.reshape(1, -1) if g.ndim == 1 else g,
+                r.reshape(1, -1) if r.ndim == 1 else r,
+            ).reshape(g.shape),
+            jax.ShapeDtypeStruct(genome.shape, jnp.float32),
+            genome,
+            rand,
+            vmap_method="expand_dims",
+        )
+
+    pga.set_mutate(mutate)
+
+
+def set_crossover_ptr(handle: int, addr: int) -> None:
+    """Install a host C crossover
+    ``void fn(gene* p1, gene* p2, gene* child, float* rand, unsigned)``
+    (pga.h:48). addr == 0 restores the default."""
+    import jax
+    import jax.numpy as jnp
+
+    pga = _solver(handle)
+    if addr == 0:
+        pga.set_crossover(None)
+        _set_host_op(handle, "cross", False)
+        return
+    cfn = _CROSS_SIG(addr)
+    _retained[handle].append(cfn)
+    _set_host_op(handle, "cross", True)
+
+    def host_cross(p1: np.ndarray, p2: np.ndarray, rand: np.ndarray) -> np.ndarray:
+        p1 = np.ascontiguousarray(p1, dtype=np.float32)
+        p2 = np.ascontiguousarray(p2, dtype=np.float32)
+        rand = np.ascontiguousarray(rand, dtype=np.float32)
+        child = np.zeros_like(p1)
+        n = ctypes.c_uint(p1.shape[1])
+        fp = ctypes.POINTER(ctypes.c_float)
+        for i in range(p1.shape[0]):
+            cfn(
+                p1[i].ctypes.data_as(fp),
+                p2[i].ctypes.data_as(fp),
+                child[i].ctypes.data_as(fp),
+                rand[i].ctypes.data_as(fp),
+                n,
+            )
+        return child
+
+    def crossover(p1, p2, rand):
+        return jax.pure_callback(
+            lambda a, b, r: host_cross(
+                a.reshape(1, -1) if a.ndim == 1 else a,
+                b.reshape(1, -1) if b.ndim == 1 else b,
+                r.reshape(1, -1) if r.ndim == 1 else r,
+            ).reshape(a.shape),
+            jax.ShapeDtypeStruct(p1.shape, jnp.float32),
+            p1,
+            p2,
+            rand,
+            vmap_method="expand_dims",
+        )
+
+    pga.set_crossover(crossover)
+
+
+def _handle_pop(handle: int, pop: int):
+    from libpga_tpu.engine import PopulationHandle
+
+    pga = _solver(handle)
+    if not (0 <= pop < pga.num_populations):
+        raise ValueError(f"invalid population index {pop}")
+    return pga, PopulationHandle(pop)
+
+
+def evaluate(handle: int, pop: int) -> None:
+    pga, h = _handle_pop(handle, pop)
+    with _exec_ctx(handle):
+        pga.evaluate(h)
+
+
+def evaluate_all(handle: int) -> None:
+    with _exec_ctx(handle):
+        _solver(handle).evaluate_all()
+
+
+def crossover(handle: int, pop: int, selection: int) -> None:
+    del selection  # TOURNAMENT is the only strategy (reference pga.cu:329)
+    pga, h = _handle_pop(handle, pop)
+    with _exec_ctx(handle):
+        pga.crossover(h)
+
+
+def crossover_all(handle: int, selection: int) -> None:
+    del selection
+    with _exec_ctx(handle):
+        _solver(handle).crossover_all()
+
+
+def mutate(handle: int, pop: int) -> None:
+    pga, h = _handle_pop(handle, pop)
+    with _exec_ctx(handle):
+        pga.mutate(h)
+
+
+def mutate_all(handle: int) -> None:
+    with _exec_ctx(handle):
+        _solver(handle).mutate_all()
+
+
+def swap_generations(handle: int, pop: int) -> None:
+    pga, h = _handle_pop(handle, pop)
+    pga.swap_generations(h)
+
+
+def fill_random_values(handle: int, pop: int) -> None:
+    pga, h = _handle_pop(handle, pop)
+    pga.fill_random_values(h)
+
+
+def migrate(handle: int, pct: float) -> None:
+    _solver(handle).migrate(pct)
+
+
+def migrate_between(handle: int, src: int, dst: int, pct: float) -> None:
+    pga, hs = _handle_pop(handle, src)
+    _, hd = _handle_pop(handle, dst)
+    pga.migrate_between(hs, hd, pct)
+
+
+def run(handle: int, n: int, has_target: int, target: float) -> int:
+    pga = _solver(handle)
+    with _exec_ctx(handle):
+        return pga.run(n, target=target if has_target else None)
+
+
+def run_islands(handle: int, n: int, m: int, pct: float) -> int:
+    with _exec_ctx(handle):
+        return _solver(handle).run_islands(n, m, pct)
+
+
+def get_best(handle: int, pop: int) -> bytes:
+    """Best genome as raw float32 little-endian bytes (len = 4*genome_len)."""
+    pga, h = _handle_pop(handle, pop)
+    return np.ascontiguousarray(pga.get_best(h), dtype=np.float32).tobytes()
+
+
+def get_best_top(handle: int, pop: int, k: int) -> bytes:
+    pga, h = _handle_pop(handle, pop)
+    return np.ascontiguousarray(
+        pga.get_best_top(h, k), dtype=np.float32
+    ).tobytes()
+
+
+def get_best_all(handle: int) -> bytes:
+    return np.ascontiguousarray(
+        _solver(handle).get_best_all(), dtype=np.float32
+    ).tobytes()
+
+
+def get_best_top_all(handle: int, k: int) -> bytes:
+    return np.ascontiguousarray(
+        _solver(handle).get_best_top_all(k), dtype=np.float32
+    ).tobytes()
+
+
+def genome_len(handle: int, pop: int) -> int:
+    pga, h = _handle_pop(handle, pop)
+    return pga.population(h).genome_len

@@ -1,0 +1,69 @@
+"""Build + run the native C ABI shim (capi/) against the CPU backend.
+
+These tests compile ``libpga_tpu_c.so`` (a C++ shared library embedding
+CPython that forwards the reference-shaped ``pga_*`` C API to this
+package) and run its two C smoke drivers as subprocesses:
+
+- ``test_onemax``: builtin named objective, the reference ``test/test.cu``
+  workload shape;
+- ``test_custom_obj``: a custom HOST C objective function pointer
+  (bounded knapsack, the reference ``test2/test.cu`` workload) through
+  the ctypes + pure_callback compatibility path.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+CAPI = Path(__file__).resolve().parent.parent / "capi"
+REPO = CAPI.parent
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = f"{REPO}:{env.get('PYTHONPATH', '')}"
+    return env
+
+
+@pytest.fixture(scope="module")
+def built_shim():
+    if shutil.which("g++") is None or shutil.which("make") is None:
+        pytest.skip("no native toolchain")
+    proc = subprocess.run(
+        ["make", "-C", str(CAPI), f"PYTHON={sys.executable}"],
+        capture_output=True,
+        text=True,
+    )
+    if proc.returncode != 0:
+        pytest.fail(f"capi build failed:\n{proc.stdout}\n{proc.stderr}")
+    return CAPI
+
+
+def _run(built, name, timeout=420):
+    proc = subprocess.run(
+        [str(built / name)],
+        capture_output=True,
+        text=True,
+        env=_env(),
+        timeout=timeout,
+    )
+    assert proc.returncode == 0, (
+        f"{name} failed (rc={proc.returncode}):\n{proc.stdout}\n{proc.stderr}"
+    )
+    assert "PASS" in proc.stdout
+    return proc.stdout
+
+
+def test_capi_onemax_builtin_objective(built_shim):
+    out = _run(built_shim, "test_onemax")
+    assert "onemax best sum" in out
+
+
+def test_capi_custom_host_objective(built_shim):
+    out = _run(built_shim, "test_custom_obj")
+    assert "knapsack best" in out

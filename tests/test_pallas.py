@@ -35,11 +35,12 @@ def test_run_factory_gates_on_tournament_size():
     assert make_pallas_run(onemax, tournament_size=3) is None
 
 
+@pytest.mark.skipif(
+    jax.default_backend() == "tpu", reason="gate only applies off-TPU"
+)
 def test_run_factory_gates_on_backend():
-    """On the CPU test platform the run factory must decline entirely —
-    an explicit use_pallas=True off-TPU falls back instead of crashing at
-    Mosaic trace time."""
-    assert jax.default_backend() != "tpu"
+    """Off-TPU the run factory must decline entirely — an explicit
+    use_pallas=True falls back instead of crashing at Mosaic trace time."""
     assert make_pallas_run(onemax, tournament_size=2) is None
 
 
@@ -81,12 +82,15 @@ def test_kernel_gene_values_near_exact():
         np.testing.assert_allclose(out[r], gn[src], atol=2e-5, rtol=0)
 
 
+@pytest.mark.skipif(
+    jax.default_backend() == "tpu", reason="auto-off only applies off-TPU"
+)
 def test_engine_falls_back_when_pallas_unavailable():
     """On CPU the auto setting disables Pallas and the XLA path runs."""
     from libpga_tpu import PGA, PGAConfig
 
     pga = PGA(seed=0, config=PGAConfig())
-    assert pga.config.pallas_enabled() is False  # CPU test platform
+    assert pga.config.pallas_enabled() is False
     pop = pga.create_population(256, 8)
     pga.set_objective("onemax")
     pga.run(3)

@@ -82,12 +82,10 @@ def main() -> int:
     from libpga_tpu import PGA, PGAConfig
 
     pga = PGA(seed=7, config=PGAConfig(use_pallas=True))
-    pga.create_population(1 << 16, 100)
+    h = pga.create_population(1 << 16, 100)
     pga.set_objective("onemax")
     pga.run(300)
-    _, best = pga.get_best_with_score(
-        __import__("libpga_tpu.engine", fromlist=["PopulationHandle"]).PopulationHandle(0)
-    )
+    _, best = pga.get_best_with_score(h)
     good &= check(f"OneMax convergence (best {best:.1f}/100)", best > 99.0)
 
     print("ALL PASS" if good else "FAILURES", flush=True)
