@@ -24,8 +24,6 @@ namespace {
 
 constexpr const char *kBridge = "libpga_tpu.capi_bridge";
 
-bool g_py_owner = false;  /* we called Py_Initialize */
-
 struct Bridge {
     PyObject *mod = nullptr;
 };
@@ -43,10 +41,7 @@ void print_py_error(const char *where) {
 /* Initialize the embedded interpreter and import the bridge module. */
 bool ensure_python() {
     if (bridge().mod) return true;
-    if (!Py_IsInitialized()) {
-        Py_InitializeEx(0);
-        g_py_owner = true;
-    }
+    if (!Py_IsInitialized()) Py_InitializeEx(0);
     PyObject *mod = PyImport_ImportModule(kBridge);
     if (!mod) {
         print_py_error("import libpga_tpu.capi_bridge "
@@ -57,68 +52,39 @@ bool ensure_python() {
     return true;
 }
 
-/* Call bridge.<name>(args...) with a PyObject_CallMethod format string.
- * Returns a new reference or nullptr (python error printed). */
-PyObject *call(const char *name, const char *fmt, ...) {
+/* Core marshaling: bridge.<name>(*args) with a Py_BuildValue format
+ * string (always parenthesized at call sites, so the built value is a
+ * tuple). Returns a new reference or nullptr (python error printed). */
+PyObject *call_va(const char *name, const char *fmt, va_list ap) {
     if (!ensure_python()) return nullptr;
-    va_list ap;
-    va_start(ap, fmt);
     PyObject *callable = PyObject_GetAttrString(bridge().mod, name);
     if (!callable) {
-        va_end(ap);
         print_py_error(name);
         return nullptr;
     }
     PyObject *args = Py_VaBuildValue(fmt, ap);
-    va_end(ap);
-    if (!args) {
-        Py_DECREF(callable);
-        print_py_error(name);
-        return nullptr;
-    }
-    /* Py_VaBuildValue yields a tuple only for multi-arg formats. */
-    if (!PyTuple_Check(args)) {
-        PyObject *t = PyTuple_Pack(1, args);
-        Py_DECREF(args);
-        args = t;
-    }
-    PyObject *out = PyObject_CallObject(callable, args);
-    Py_DECREF(args);
+    PyObject *out = args ? PyObject_CallObject(callable, args) : nullptr;
+    Py_XDECREF(args);
     Py_DECREF(callable);
     if (!out) print_py_error(name);
     return out;
 }
 
-/* Variants returning plain C results; -1/nullptr signal errors. */
+PyObject *call(const char *name, const char *fmt, ...) {
+    va_list ap;
+    va_start(ap, fmt);
+    PyObject *out = call_va(name, fmt, ap);
+    va_end(ap);
+    return out;
+}
+
+/* Integer-returning variant; -1 signals an error (None maps to 0). */
 long call_long(const char *name, const char *fmt, ...) {
     va_list ap;
     va_start(ap, fmt);
-    PyObject *callable =
-        ensure_python() ? PyObject_GetAttrString(bridge().mod, name) : nullptr;
-    if (!callable) {
-        va_end(ap);
-        if (bridge().mod) print_py_error(name);
-        return -1;
-    }
-    PyObject *args = Py_VaBuildValue(fmt, ap);
+    PyObject *out = call_va(name, fmt, ap);
     va_end(ap);
-    if (!args) {
-        Py_DECREF(callable);
-        print_py_error(name);
-        return -1;
-    }
-    if (!PyTuple_Check(args)) {
-        PyObject *t = PyTuple_Pack(1, args);
-        Py_DECREF(args);
-        args = t;
-    }
-    PyObject *out = PyObject_CallObject(callable, args);
-    Py_DECREF(args);
-    Py_DECREF(callable);
-    if (!out) {
-        print_py_error(name);
-        return -1;
-    }
+    if (!out) return -1;
     long v = out == Py_None ? 0 : PyLong_AsLong(out);
     if (PyErr_Occurred()) {
         print_py_error(name);

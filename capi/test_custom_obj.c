@@ -47,8 +47,8 @@ int main(void) {
     free(best);
     pga_deinit(p);
 
-    /* optimum is 250 (one copy of item 2, weight 6 <= 10; adding item 4
-     * at weight 4 gives 285: counts [0 0 1 1 0 0]) */
+    /* true optimum is 285: items 2+3 (values 250+35, weights 6+4 = 10);
+     * require >= 250 so a near-optimal run still passes */
     if (score < 250.0f) {
         fprintf(stderr, "FAIL: best %.1f below 250\n", score);
         return 1;

@@ -1,0 +1,53 @@
+"""Example 4: Rastrigin-30D with Gaussian mutation on an island model.
+
+The "Rastrigin-30D real-valued GA (float chromosome, Gaussian mutation)"
+config from BASELINE.json, run as the island GA the reference declared
+but never implemented (``pga_run_islands`` spec ``include/pga.h:144-150``,
+empty stub ``src/pga.cu:393-395``): 8 islands, ring migration of the top
+5% every 20 generations. Pass --mesh to shard islands across all visible
+devices (one island group per core, migration over ICI).
+
+Optimum is 0 at x=0 (genes 0.5); typical single-island GA stalls in a
+local optimum — migration keeps diversity flowing.
+
+Run: python examples/rastrigin_islands.py [--mesh]
+"""
+
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+import sys
+
+import libpga_tpu as lp
+from libpga_tpu import PGAConfig, parallel
+from libpga_tpu.ops.mutate import make_gaussian_mutate
+
+
+def main():
+    use_mesh = "--mesh" in sys.argv
+    # Elitism (a capability the reference lacks) matters on multimodal
+    # surfaces: the per-island best survives between migration events.
+    config = PGAConfig(elitism=2)
+    pga = lp.pga_init(seed=3, config=config)
+    for _ in range(8):
+        lp.pga_create_population(pga, 4096, 30, lp.RANDOM_POPULATION)
+    lp.pga_set_objective_function(pga, "rastrigin")
+    lp.pga_set_mutate_function(pga, make_gaussian_mutate(rate=0.15, sigma=0.05))
+
+    mesh = parallel.default_mesh() if use_mesh else None
+    if mesh is not None:
+        print(f"sharding 8 islands across {mesh.devices.size} device(s)")
+
+    gens = lp.pga_run_islands(pga, 400, 20, 0.05, mesh=mesh)
+    best = lp.pga_get_best_all(pga)
+    from libpga_tpu.objectives import rastrigin
+
+    print(f"ran {gens} generations over 8 islands")
+    print(f"best Rastrigin value: {float(rastrigin(best)):.3f} (optimum 0)")
+    top = lp.pga_get_best_top_all(pga, 3)
+    print(f"global top-3 values: "
+          f"{[round(float(rastrigin(g)), 3) for g in top]}")
+
+
+if __name__ == "__main__":
+    main()

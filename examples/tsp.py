@@ -1,0 +1,51 @@
+"""Example 3: travelling salesman — the reference's third driver.
+
+Reproduces ``/root/reference/test3/``: a random distance matrix with a
+planted cheap path i→i+1 of weight 10 (the construction of
+``test3/gen.c:27-38``), tour decoded as ``city[i] = int(g[i] * L)``
+(``test3/test.cu:31-32``), +10000 penalty per duplicate city
+(``test3/test.cu:40-45``), and the driver's custom uniqueness-preserving
+crossover (``test3/test.cu:48-64``) — here the builtin
+``order_preserving_crossover``, a ``lax.scan`` over gene positions
+vmapped across the population. Reference budget: pop 1000 × 1000 gens.
+
+Run: python examples/tsp.py [n_cities]
+"""
+
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+import sys
+
+import numpy as np
+
+import libpga_tpu as lp
+from libpga_tpu.objectives import make_tsp, random_tsp_matrix
+from libpga_tpu.ops.crossover import order_preserving_crossover
+from libpga_tpu.ops.mutate import make_swap_mutate
+
+
+def main():
+    n_cities = int(sys.argv[1]) if len(sys.argv) > 1 else 100
+    matrix = random_tsp_matrix(n_cities, seed=7)  # planted path length: 10*(L-1)
+
+    pga = lp.pga_init(seed=5)
+    pop = lp.pga_create_population(pga, 1000, n_cities, lp.RANDOM_POPULATION)
+    lp.pga_set_objective_function(pga, make_tsp(matrix))
+    lp.pga_set_crossover_function(pga, order_preserving_crossover)
+    lp.pga_set_mutate_function(pga, make_swap_mutate(rate=0.5))
+
+    lp.pga_run(pga, 1000)
+
+    best = lp.pga_get_best(pga, pop)
+    tour = np.clip(np.floor(best * n_cities).astype(int), 0, n_cities - 1)
+    unique = len(set(tour.tolist()))
+    length = float(matrix[tour[:-1], tour[1:]].sum())
+    print(f"cities: {n_cities}  unique in best tour: {unique}")
+    print(f"tour length: {length:.0f}  (planted cheap path: {10*(n_cities-1)}, "
+          f"random tour ~{int(matrix.mean() * (n_cities-1))})")
+    assert unique == n_cities, "custom crossover must preserve uniqueness"
+
+
+if __name__ == "__main__":
+    main()

@@ -62,8 +62,19 @@ _host_ops: Dict[int, Set[str]] = {}
 
 
 def _set_host_op(handle: int, kind: str, on: bool) -> None:
+    import dataclasses
+
     ops = _host_ops.setdefault(handle, set())
     (ops.add if on else ops.discard)(kind)
+    # The Pallas fast path must not be selected for a CPU-pinned solver:
+    # the engine's backend gate checks jax.default_backend(), which still
+    # reports "tpu" inside a jax.default_device(cpu) context. Force the
+    # config off while any host op is installed; restore auto when clear.
+    pga = _solver(handle)
+    want = False if ops else None
+    if pga.config.use_pallas != want:
+        pga.config = dataclasses.replace(pga.config, use_pallas=want)
+        pga._compiled.clear()
 
 
 def _exec_ctx(handle: int):
@@ -128,6 +139,7 @@ def create_population(handle: int, size: int, genome_len: int, ptype: int) -> in
 
 def set_objective_name(handle: int, name: str) -> None:
     _solver(handle).set_objective(name)
+    _set_host_op(handle, "obj", False)
 
 
 def set_objective_ptr(handle: int, addr: int) -> None:
