@@ -12,18 +12,35 @@ import jax.numpy as jnp
 import numpy as np
 
 
+# Fusable objectives are written ONCE in rowwise batched form
+# (``(P, L) -> (P,)`` with axis=1 reductions) and the per-genome form is
+# derived from it, so the two can never drift. The rowwise form is what
+# lowers inside the Pallas breed kernel (a vmap'd per-genome form unrolls
+# to P scalar reductions under Mosaic); the engine's fast path fuses it
+# into the generation kernel so children are scored while still in VMEM.
+
+
+def _rowwise(rows_fn, doc):
+    def per_genome(genome: jax.Array) -> jax.Array:
+        return rows_fn(genome[None, :])[0]
+
+    per_genome.kernel_rowwise = rows_fn
+    per_genome.__doc__ = doc
+    return per_genome
+
+
 # ------------------------------------------------------------------ OneMax
 
-
-def onemax(genome: jax.Array) -> jax.Array:
+onemax = _rowwise(
+    lambda m: jnp.sum(m, axis=1),
     """Continuous OneMax: sum of genes. The reference's first driver
-    objective (``test/test.cu:24-30``). Optimum = genome_len (as genes → 1)."""
-    return jnp.sum(genome)
+    objective (``test/test.cu:24-30``). Optimum = genome_len (genes → 1).""",
+)
 
-
-def onemax_bits(genome: jax.Array) -> jax.Array:
-    """Bitstring OneMax: count of genes that round to 1. Optimum = L."""
-    return jnp.sum((genome >= 0.5).astype(jnp.float32))
+onemax_bits = _rowwise(
+    lambda m: jnp.sum((m >= 0.5).astype(jnp.float32), axis=1),
+    """Bitstring OneMax: count of genes that round to 1. Optimum = L.""",
+)
 
 
 # ------------------------------------------------- real-coded test functions
@@ -34,27 +51,43 @@ def _to_box(genome: jax.Array, lo: float, hi: float) -> jax.Array:
     return lo + genome * (hi - lo)
 
 
-def sphere(genome: jax.Array) -> jax.Array:
-    """Negated sphere function on [-5.12, 5.12]^L. Optimum 0 at x=0."""
-    x = _to_box(genome, -5.12, 5.12)
-    return -jnp.sum(x * x)
+def _sphere_rows(m):
+    x = _to_box(m, -5.12, 5.12)
+    return -jnp.sum(x * x, axis=1)
 
 
-def rastrigin(genome: jax.Array) -> jax.Array:
-    """Negated Rastrigin on [-5.12, 5.12]^L (BASELINE.json config
-    "Rastrigin-30D real-valued GA"). Optimum 0 at x=0; highly multimodal."""
-    x = _to_box(genome, -5.12, 5.12)
-    return -(10.0 * x.shape[0] + jnp.sum(x * x - 10.0 * jnp.cos(2.0 * jnp.pi * x)))
+def _rastrigin_rows(m):
+    x = _to_box(m, -5.12, 5.12)
+    return -(
+        10.0 * m.shape[1]
+        + jnp.sum(x * x - 10.0 * jnp.cos(2.0 * jnp.pi * x), axis=1)
+    )
 
 
-def ackley(genome: jax.Array) -> jax.Array:
-    """Negated Ackley on [-32.768, 32.768]^L. Optimum 0 at x=0."""
-    x = _to_box(genome, -32.768, 32.768)
-    n = x.shape[0]
+def _ackley_rows(m):
+    x = _to_box(m, -32.768, 32.768)
+    n = m.shape[1]
     a, b, c = 20.0, 0.2, 2.0 * jnp.pi
-    s1 = jnp.sqrt(jnp.sum(x * x) / n)
-    s2 = jnp.sum(jnp.cos(c * x)) / n
+    s1 = jnp.sqrt(jnp.sum(x * x, axis=1) / n)
+    s2 = jnp.sum(jnp.cos(c * x), axis=1) / n
     return -(-a * jnp.exp(-b * s1) - jnp.exp(s2) + a + jnp.e)
+
+
+sphere = _rowwise(
+    _sphere_rows,
+    """Negated sphere function on [-5.12, 5.12]^L. Optimum 0 at x=0.""",
+)
+
+rastrigin = _rowwise(
+    _rastrigin_rows,
+    """Negated Rastrigin on [-5.12, 5.12]^L (BASELINE.json config
+    "Rastrigin-30D real-valued GA"). Optimum 0 at x=0; highly multimodal.""",
+)
+
+ackley = _rowwise(
+    _ackley_rows,
+    """Negated Ackley on [-32.768, 32.768]^L. Optimum 0 at x=0.""",
+)
 
 
 # ---------------------------------------------------------------- knapsack

@@ -60,8 +60,12 @@ def _supported() -> bool:
     return True
 
 
-def _breed_kernel(seed_ref, scores_ref, genomes_ref, out_ref, *, K, L, Lp, rate):
-    """One deme: select parents, crossover, mutate. All VMEM/register work."""
+def _breed_kernel(
+    seed_ref, scores_ref, genomes_ref, out_ref, *rest, K, L, Lp, rate, obj=None
+):
+    """One deme: select parents, crossover, mutate — and, when ``obj`` is
+    given, evaluate the children in-kernel (skipping a whole extra HBM
+    pass per generation). All VMEM/register work."""
     import jax.lax as lax
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -135,6 +139,20 @@ def _breed_kernel(seed_ref, scores_ref, genomes_ref, out_ref, *, K, L, Lp, rate)
     # (K, G, 1, Lp) output, so the row-major reshape interleaves demes.
     out_ref[:] = child.reshape(K, 1, 1, Lp)
 
+    if obj is not None:
+        # Fused evaluation: score the children while they're in VMEM,
+        # skipping the separate per-generation evaluation pass over HBM.
+        # ``obj`` here is the objective's ROWWISE form ((K, L) -> (K,)
+        # with axis=1 reductions): a per-genome fn under jax.vmap unrolls
+        # into K scalar reductions in Mosaic (~100× slower, measured).
+        # Scores write as ONE contiguous (1,1,K) row per deme — routing
+        # them through the genome output's column mapping would mean a
+        # K-element stride-G scatter per grid step, which costs ~12 ms/gen
+        # at 1M pop (measured); the caller instead applies a cheap (G,K)
+        # transpose to match the riffle-shuffled genome row order.
+        child_scores = obj(child[:, :L]).astype(jnp.float32)
+        rest[0][:] = child_scores.reshape(1, 1, K)
+
 
 def make_pallas_breed(
     pop_size: int,
@@ -142,10 +160,13 @@ def make_pallas_breed(
     *,
     deme_size: int = 256,
     mutation_rate: float = 0.01,
+    fused_obj: Optional[Callable] = None,
 ) -> Optional[Callable]:
     """Build the fused breed: ``(genomes (P,L) f32, scores (P,), key) ->
-    next_genomes (P, L)``. Returns None when the shape is unsupported
-    (population not divisible into power-of-two demes)."""
+    next_genomes (P, L)`` — or, with ``fused_obj``, ``-> (next_genomes,
+    next_scores)`` with evaluation done inside the kernel. Returns None
+    when the shape is unsupported (population not divisible into
+    power-of-two demes)."""
     if not _supported():
         return None
     K = deme_size
@@ -158,7 +179,15 @@ def make_pallas_breed(
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    kernel = partial(_breed_kernel, K=K, L=L, Lp=Lp, rate=mutation_rate)
+    kernel = partial(
+        _breed_kernel, K=K, L=L, Lp=Lp, rate=mutation_rate, obj=fused_obj
+    )
+
+    out_specs = [pl.BlockSpec((K, 1, 1, Lp), lambda i: (0, i, 0, 0))]
+    out_shape = [jax.ShapeDtypeStruct((K, G, 1, Lp), jnp.float32)]
+    if fused_obj is not None:
+        out_specs.append(pl.BlockSpec((1, 1, K), lambda i: (i, 0, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((G, 1, K), jnp.float32))
 
     call = pl.pallas_call(
         kernel,
@@ -168,17 +197,26 @@ def make_pallas_breed(
             pl.BlockSpec((1, 1, K), lambda i: (i, 0, 0)),
             pl.BlockSpec((K, Lp), lambda i: (i, 0)),
         ],
-        out_specs=pl.BlockSpec((K, 1, 1, Lp), lambda i: (0, i, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((K, G, 1, Lp), jnp.float32),
+        out_specs=out_specs if fused_obj is not None else out_specs[0],
+        out_shape=out_shape if fused_obj is not None else out_shape[0],
     )
 
     def breed_padded(gp: jax.Array, scores: jax.Array, key: jax.Array):
-        """(P, Lp)-padded variant for loops that keep the pad resident."""
+        """(P, Lp)-padded variant for loops that keep the pad resident.
+        Returns genomes (P, Lp), or (genomes, scores (P,)) when fused."""
         seed = jax.random.randint(
             key, (1, 1), jnp.iinfo(jnp.int32).min, jnp.iinfo(jnp.int32).max,
             dtype=jnp.int32,
         )
         out = call(seed, scores.reshape(G, 1, K).astype(jnp.float32), gp)
+        if fused_obj is not None:
+            genomes, child_scores = out
+            # Genome row order after reshape is (child r)·G + (deme i);
+            # kernel scores come out deme-major (G, K) — transpose to match.
+            return (
+                genomes.reshape(P, Lp),
+                child_scores.reshape(G, K).T.reshape(P),
+            )
         return out.reshape(P, Lp)
 
     def breed(genomes: jax.Array, scores: jax.Array, key: jax.Array):
@@ -186,10 +224,14 @@ def make_pallas_breed(
         if Lp != L:
             gp = jnp.pad(gp, ((0, 0), (0, Lp - L)))
         out = breed_padded(gp, scores, key)
+        if fused_obj is not None:
+            g2, s2 = out
+            return (g2[:, :L] if Lp != L else g2), s2
         return out[:, :L] if Lp != L else out
 
     breed.padded = breed_padded
     breed.Lp = Lp
+    breed.fused = fused_obj is not None
     return breed
 
 
@@ -220,10 +262,18 @@ def make_pallas_run(
 
     from libpga_tpu.ops.evaluate import evaluate as _evaluate
 
+    # Objectives carrying a ``kernel_rowwise`` batched form evaluate
+    # INSIDE the breed kernel (children are scored while still in VMEM),
+    # eliminating the separate per-generation evaluation pass over HBM
+    # (~2 ms/gen at 1M×100; see BASELINE.md). The attribute is an explicit
+    # opt-in set only on builtins verified to lower under Mosaic.
+    fused_obj = getattr(obj, "kernel_rowwise", None)
+
     def build(pop_size: int, genome_len: int):
         breed = make_pallas_breed(
             pop_size, genome_len,
             deme_size=deme_size, mutation_rate=mutation_rate,
+            fused_obj=fused_obj,
         )
         if breed is None:
             return None
@@ -246,8 +296,11 @@ def make_pallas_run(
             def body(carry):
                 g, s, k, gen = carry
                 k, sub = jax.random.split(k)
-                g2 = breed.padded(g, s, sub)
-                s2 = _evaluate(obj, g2[:, :L])
+                if breed.fused:
+                    g2, s2 = breed.padded(g, s, sub)
+                else:
+                    g2 = breed.padded(g, s, sub)
+                    s2 = _evaluate(obj, g2[:, :L])
                 return (g2, s2, k, gen + 1)
 
             init = (gp, scores0, key, jnp.int32(0))

@@ -98,6 +98,35 @@ def test_engine_falls_back_when_pallas_unavailable():
     assert best.shape == (8,)
 
 
+def test_fused_evaluation_scores_match_genome_order():
+    """With fused evaluation (kernel_rowwise objective) the scores output
+    must be reordered to match the riffle-shuffled genome rows: with zero
+    PRNG bits child r is a copy of row 0 of deme r % G, so its fused score
+    must equal obj(that row) — this pins the (G,K) transpose in
+    breed_padded against the genome output's k*G+i interleave."""
+    from libpga_tpu.objectives import onemax
+
+    P, L, K = 1024, 20, 128
+    G = P // K
+    with _interpret():
+        breed = make_pallas_breed(
+            P, L, deme_size=K, mutation_rate=0.0,
+            fused_obj=onemax.kernel_rowwise,
+        )
+        genomes = (
+            jnp.broadcast_to(jnp.arange(P, dtype=jnp.float32)[:, None], (P, L))
+            / P
+        )
+        scores = jnp.zeros((P,), jnp.float32)
+        g2, s2 = breed(genomes, scores, jax.random.key(0))
+    g2, s2 = np.asarray(g2), np.asarray(s2)
+    assert s2.shape == (P,)
+    # fused score r == onemax(genome row r) == L * (deme base)/P
+    expect = np.asarray([L * ((r % G) * K) / P for r in range(P)], np.float32)
+    np.testing.assert_allclose(s2, expect, atol=1e-4, rtol=0)
+    np.testing.assert_allclose(g2.sum(axis=1), s2, atol=1e-4, rtol=0)
+
+
 def test_mutation_rate_zero_never_fires():
     """rate=0 must be a strict no-op even for zero random bits (the gate
     is strict '<'; the reference's '<=' would fire on u == 0)."""
