@@ -254,6 +254,43 @@ class PGA:
             getattr(self._mutate, "func", None) is _m.point_mutate
         )
 
+    def _pallas_island_breed(self, island_size: int, genome_len: int):
+        """Fused Pallas breed for one island, or None if ineligible.
+
+        Same gating as the single-population fast path; the returned
+        callable is vmapped across islands by the runner, so the kernel's
+        deme shuffle stays island-local and island semantics hold."""
+        if not (
+            self.config.pallas_enabled()
+            and self._is_default_operators()
+            and self.config.elitism == 0
+            and self.config.tournament_size == 2
+            and self.config.gene_dtype == jnp.float32
+        ):
+            return None
+        import jax as _jax
+
+        if _jax.default_backend() != "tpu":
+            return None
+        from libpga_tpu.ops.pallas_step import make_pallas_breed
+
+        obj = self._require_objective()
+        fused = getattr(obj, "kernel_rowwise", None)
+        # Cached: runner caching downstream keys on the breed's identity,
+        # so rebuilding it per call would defeat compilation reuse.
+        cache_key = ("island_breed", island_size, genome_len, obj, fused)
+        if cache_key in self._compiled:
+            return self._compiled[cache_key]
+        pb = make_pallas_breed(
+            island_size,
+            genome_len,
+            deme_size=self.config.pallas_deme_size,
+            mutation_rate=getattr(self._mutate, "rate", self.config.mutation_rate),
+            fused_obj=fused,
+        )
+        self._compiled[cache_key] = pb
+        return pb
+
     def run(
         self,
         n: int,
@@ -535,9 +572,11 @@ class PGA:
         if len(sizes) != 1:
             return self._run_islands_hetero(n, m, pct, target)
         stacked = jnp.stack([p.genomes for p in self._populations])
+        S, L = stacked.shape[1], stacked.shape[2]
+        breed = self._pallas_island_breed(S, L) or self._breed_fn()
         t0 = time.perf_counter()
         genomes, scores, gens = run_islands_stacked(
-            self._breed_fn(),
+            breed,
             self._require_objective(),
             stacked,
             self.next_key(),

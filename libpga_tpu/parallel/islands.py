@@ -38,19 +38,42 @@ from libpga_tpu.ops.evaluate import evaluate as _evaluate
 
 def make_island_epoch(breed: Callable, obj: Callable, m: int) -> Callable:
     """``(genomes (S,L), scores (S,), key) -> (genomes, scores, key)`` —
-    m generations of breed-then-evaluate on one island."""
+    m generations of breed-then-evaluate on one island.
+
+    A breed carrying ``fused=True`` (the Pallas path built with a
+    ``fused_obj`` — see :func:`libpga_tpu.ops.pallas_step.make_pallas_breed`)
+    supplies the next scores itself and the separate evaluation is
+    skipped. For lane-unaligned genome lengths the epoch pads once at
+    entry, scans over the breed's padded variant, and slices once at exit
+    — not once per generation."""
+    fused = getattr(breed, "fused", False)
+    padded_fn = getattr(breed, "padded", None)
+    Lp = getattr(breed, "Lp", None)
 
     def epoch(genomes, scores, key):
+        L = genomes.shape[1]
+        pad = fused and padded_fn is not None and Lp is not None and Lp != L
+        g0 = (
+            jnp.pad(genomes.astype(jnp.float32), ((0, 0), (0, Lp - L)))
+            if pad
+            else genomes
+        )
+
         def body(carry, _):
             g, s, k = carry
             k, sub = jax.random.split(k)
-            g2 = breed(g, s, sub)
-            s2 = _evaluate(obj, g2)
+            if fused:
+                g2, s2 = padded_fn(g, s, sub) if pad else breed(g, s, sub)
+            else:
+                g2 = breed(g, s, sub)
+                s2 = _evaluate(obj, g2)
             return (g2, s2, k), None
 
         (genomes, scores, key), _ = jax.lax.scan(
-            body, (genomes, scores, key), None, length=m
+            body, (g0, scores, key), None, length=m
         )
+        if pad:
+            genomes = genomes[:, :L]
         return genomes, scores, key
 
     return epoch

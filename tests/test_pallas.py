@@ -127,6 +127,31 @@ def test_fused_evaluation_scores_match_genome_order():
     np.testing.assert_allclose(g2.sum(axis=1), s2, atol=1e-4, rtol=0)
 
 
+def test_fused_breed_through_island_runner():
+    """run_islands_stacked must dispatch on breed.fused: a fused Pallas
+    breed runs under the island runner's vmap with its in-kernel scores
+    kept consistent with the carried genomes (scores == rowwise(genomes)
+    after every epoch, including migration bookkeeping)."""
+    from libpga_tpu.objectives import onemax
+    from libpga_tpu.parallel.islands import run_islands_stacked
+
+    I, S, L, K = 2, 512, 20, 128
+    with _interpret():
+        breed = make_pallas_breed(
+            S, L, deme_size=K, mutation_rate=0.0,
+            fused_obj=onemax.kernel_rowwise,
+        )
+        assert breed.fused
+        stacked = jax.random.uniform(jax.random.key(0), (I, S, L))
+        genomes, scores, gens = run_islands_stacked(
+            breed, onemax, stacked, jax.random.key(1), n=4, m=2, pct=0.05
+        )
+    genomes, scores = np.asarray(genomes), np.asarray(scores)
+    assert gens == 4
+    assert genomes.shape == (I, S, L) and scores.shape == (I, S)
+    np.testing.assert_allclose(scores, genomes.sum(axis=2), atol=2e-4, rtol=0)
+
+
 def test_mutation_rate_zero_never_fires():
     """rate=0 must be a strict no-op even for zero random bits (the gate
     is strict '<'; the reference's '<=' would fire on u == 0)."""
