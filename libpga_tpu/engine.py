@@ -199,7 +199,6 @@ class PGA:
 
         obj = self._require_objective()
         breed = self._breed_fn()
-        use_pallas = self.config.pallas_enabled()
 
         def run_loop(genomes, key, n, target):
             scores0 = _evaluate(obj, genomes)
@@ -221,12 +220,7 @@ class PGA:
 
         donate = (0,) if self.config.donate_buffers else ()
         fn = jax.jit(run_loop, donate_argnums=donate)
-        if (
-            use_pallas
-            and self._is_default_operators()
-            and self.config.elitism == 0
-            and self.config.gene_dtype == jnp.float32  # kernel is f32-only
-        ):
+        if self._pallas_gate():
             from libpga_tpu.ops.pallas_step import make_pallas_run
 
             factory = make_pallas_run(
@@ -254,12 +248,11 @@ class PGA:
             getattr(self._mutate, "func", None) is _m.point_mutate
         )
 
-    def _pallas_island_breed(self, island_size: int, genome_len: int):
-        """Fused Pallas breed for one island, or None if ineligible.
-
-        Same gating as the single-population fast path; the returned
-        callable is vmapped across islands by the runner, so the kernel's
-        deme shuffle stays island-local and island semantics hold."""
+    def _pallas_gate(self) -> bool:
+        """Single source of truth for Pallas fast-path eligibility, shared
+        by the single-population run loop and the island runner. The
+        kernel only implements default operators, tournament-2, pure
+        generational replacement, f32 genes, and requires a real TPU."""
         if not (
             self.config.pallas_enabled()
             and self._is_default_operators()
@@ -267,10 +260,18 @@ class PGA:
             and self.config.tournament_size == 2
             and self.config.gene_dtype == jnp.float32
         ):
-            return None
+            return False
         import jax as _jax
 
-        if _jax.default_backend() != "tpu":
+        return _jax.default_backend() == "tpu"
+
+    def _pallas_island_breed(self, island_size: int, genome_len: int):
+        """Fused Pallas breed for one island, or None if ineligible.
+
+        The returned callable is vmapped across islands by the runner, so
+        the kernel's deme shuffle stays island-local and island semantics
+        hold."""
+        if not self._pallas_gate():
             return None
         from libpga_tpu.ops.pallas_step import make_pallas_breed
 
@@ -316,9 +317,12 @@ class PGA:
             pop.genomes, self.next_key(), jnp.int32(n), tgt
         )
         gens = int(gens_done)
-        self.metrics.record_run(gens, pop.size, time.perf_counter() - t0)
+        # Install the new population BEFORE notifying metrics listeners:
+        # the old genome buffer was donated to the jit and is dead, and
+        # listeners (e.g. AutoCheckpointer) read solver state.
         self._populations[handle.index] = Population(genomes=genomes, scores=scores)
         self._staged[handle.index] = None
+        self.metrics.record_run(gens, pop.size, time.perf_counter() - t0)
         return gens
 
     # ------------------------------------------------- step-by-step operators
@@ -588,16 +592,17 @@ class PGA:
             mesh=mesh,
             runner_cache=self._compiled,
         )
-        self.metrics.record_run(
-            gens, sum(p.size for p in self._populations),
-            time.perf_counter() - t0,
-        )
         for i in range(len(self._populations)):
             # genomes[i] on a jax.Array stays on device (no host round trip).
             self._populations[i] = Population(
                 genomes=genomes[i], scores=scores[i]
             )
             self._staged[i] = None
+        # Metrics listeners run after the state swap (see run()).
+        self.metrics.record_run(
+            gens, sum(p.size for p in self._populations),
+            time.perf_counter() - t0,
+        )
         return gens
 
     def _run_islands_hetero(

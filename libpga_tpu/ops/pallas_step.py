@@ -51,6 +51,19 @@ import jax.numpy as jnp
 LANE = 128
 
 
+def _pick_deme_size(pop_size: int, preferred: int):
+    """The preferred deme size if it divides the population, else the
+    largest power-of-two divisor in [128, 1024] (K=128 is the smallest
+    MXU-efficient tile; above 1024 the one-hot matmul FLOPs dominate).
+    None when nothing fits — the caller falls back to the XLA path."""
+    if preferred and not (preferred & (preferred - 1)) and pop_size % preferred == 0:
+        return preferred
+    for k in (1024, 512, 256, 128):
+        if pop_size % k == 0:
+            return k
+    return None
+
+
 def _supported() -> bool:
     try:
         from jax.experimental import pallas as pl  # noqa: F401
@@ -169,9 +182,9 @@ def make_pallas_breed(
     power-of-two demes)."""
     if not _supported():
         return None
-    K = deme_size
     P, L = pop_size, genome_len
-    if K & (K - 1) or P % K or P // K < 1:
+    K = _pick_deme_size(P, deme_size)
+    if K is None:
         return None
     G = P // K
     Lp = math.ceil(L / LANE) * LANE

@@ -52,7 +52,7 @@ def make_island_epoch(breed: Callable, obj: Callable, m: int) -> Callable:
 
     def epoch(genomes, scores, key):
         L = genomes.shape[1]
-        pad = fused and padded_fn is not None and Lp is not None and Lp != L
+        pad = padded_fn is not None and Lp is not None and Lp != L
         g0 = (
             jnp.pad(genomes.astype(jnp.float32), ((0, 0), (0, Lp - L)))
             if pad
@@ -62,11 +62,12 @@ def make_island_epoch(breed: Callable, obj: Callable, m: int) -> Callable:
         def body(carry, _):
             g, s, k = carry
             k, sub = jax.random.split(k)
+            step = padded_fn if pad else breed
             if fused:
-                g2, s2 = padded_fn(g, s, sub) if pad else breed(g, s, sub)
+                g2, s2 = step(g, s, sub)
             else:
-                g2 = breed(g, s, sub)
-                s2 = _evaluate(obj, g2)
+                g2 = step(g, s, sub)
+                s2 = _evaluate(obj, g2[:, :L] if pad else g2)
             return (g2, s2, k), None
 
         (genomes, scores, key), _ = jax.lax.scan(

@@ -1,4 +1,5 @@
 from libpga_tpu.utils.metrics import Metrics
 from libpga_tpu.utils import checkpoint
+from libpga_tpu.utils import profiling
 
-__all__ = ["Metrics", "checkpoint"]
+__all__ = ["Metrics", "checkpoint", "profiling"]

@@ -33,6 +33,41 @@ def save(pga: "PGA", path: str) -> None:
     np.savez(path, **arrays)
 
 
+class AutoCheckpointer:
+    """Periodic checkpointing for long / preemptible runs.
+
+    Hooks the engine's metrics callback and saves the full solver state
+    every ``every_generations`` completed generations::
+
+        ckpt = AutoCheckpointer(pga, "state.npz", every_generations=1000)
+        for _ in range(100):
+            pga.run_islands(500, 50, 0.05)
+        ckpt.close()
+
+    On restart, ``checkpoint.restore(pga, "state.npz")`` resumes from the
+    last save (populations + PRNG stream). The reference has no recovery
+    story at all — any CUDA error exits the process (``pga.cu:31``).
+    """
+
+    def __init__(self, pga: "PGA", path: str, every_generations: int = 1000):
+        self._pga = pga
+        self._path = path
+        self._every = every_generations
+        self._since_save = 0
+        pga.metrics.add_listener(self._on_run)
+
+    def _on_run(self, rec):
+        self._since_save += rec.generations
+        if self._since_save >= self._every:
+            save(self._pga, self._path)
+            self._since_save = 0
+
+    def close(self, final_save: bool = True):
+        if final_save:
+            save(self._pga, self._path)
+        self._pga.metrics.remove_listener(self._on_run)
+
+
 def restore(pga: "PGA", path: str) -> None:
     """Load populations and PRNG state saved by :func:`save` into ``pga``.
 

@@ -26,11 +26,30 @@ class RunRecord:
 
 
 class Metrics:
-    """Accumulates per-run statistics for a PGA instance."""
+    """Accumulates per-run statistics for a PGA instance.
+
+    Listeners: multiple independent consumers (loggers, checkpointers)
+    register with :meth:`add_listener` / :meth:`remove_listener` — a
+    single overwritable callback slot forces consumers to hand-roll
+    wrap-and-restore chains that break when tear-down order differs from
+    set-up order. ``on_run`` remains as a simple extra slot for ad-hoc
+    use.
+    """
 
     def __init__(self):
         self.runs: List[RunRecord] = []
         self.on_run: Optional[Callable[[RunRecord], None]] = None
+        self._listeners: List[Callable[[RunRecord], None]] = []
+
+    def add_listener(self, fn: Callable[[RunRecord], None]) -> Callable:
+        self._listeners.append(fn)
+        return fn
+
+    def remove_listener(self, fn: Callable[[RunRecord], None]) -> None:
+        try:
+            self._listeners.remove(fn)
+        except ValueError:
+            pass
 
     def record_run(self, generations: int, population_size: int, seconds: float):
         rec = RunRecord(
@@ -40,6 +59,8 @@ class Metrics:
             timestamp=time.time(),
         )
         self.runs.append(rec)
+        for fn in list(self._listeners):
+            fn(rec)
         if self.on_run is not None:
             self.on_run(rec)
         return rec

@@ -1,0 +1,55 @@
+"""Profiling hooks.
+
+The reference has no tracing at all — its only possible timing is external
+``nvprof`` (survey §5). Here two layers:
+
+- :func:`trace` — context manager around ``jax.profiler`` emitting a
+  TensorBoard/Perfetto trace of everything run inside (kernel timings,
+  HBM usage, fusion boundaries).
+- :func:`timed_runs` — lightweight generations/sec reporting built on the
+  engine's :class:`~libpga_tpu.utils.metrics.Metrics`, no profiler
+  overhead; suitable for always-on logging.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Callable, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a device trace of the enclosed block::
+
+        with profiling.trace("/tmp/pga-trace"):
+            pga.run(100)
+
+    View with TensorBoard's profile plugin or ui.perfetto.dev.
+    """
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+@contextlib.contextmanager
+def timed_runs(pga, log: Optional[Callable[[str], None]] = print):
+    """Log generations/sec for every ``run``/``run_islands`` completed
+    inside the block, via the engine's metrics callback::
+
+        with profiling.timed_runs(pga):
+            pga.run(1000)   # -> "run: 1000 gens @ 83.1 gens/sec (pop 1048576)"
+    """
+    def on_run(rec):
+        if log is not None:
+            log(
+                f"run: {rec.generations} gens @ "
+                f"{rec.generations_per_sec:.1f} gens/sec "
+                f"(pop {rec.population_size})"
+            )
+
+    pga.metrics.add_listener(on_run)
+    try:
+        yield pga.metrics
+    finally:
+        pga.metrics.remove_listener(on_run)

@@ -27,8 +27,21 @@ def _interpret():
 
 
 def test_unsupported_shapes_return_none():
-    assert make_pallas_breed(1000, 10, deme_size=256) is None  # 1000 % 256 != 0
-    assert make_pallas_breed(1024, 10, deme_size=96) is None  # not a power of 2
+    # no power-of-two deme in [128, 1024] divides 1000
+    assert make_pallas_breed(1000, 10, deme_size=256) is None
+
+
+def test_deme_size_auto_fallback():
+    """An undivisible or invalid preferred deme size falls back to a
+    power-of-two divisor instead of abandoning the fast path."""
+    from libpga_tpu.ops.pallas_step import _pick_deme_size
+
+    assert _pick_deme_size(1 << 20, 256) == 256
+    assert _pick_deme_size(1 << 20, 96) == 1024  # invalid preferred -> largest
+    assert _pick_deme_size(40_960, 256) == 256
+    assert _pick_deme_size(128 * 3, 256) == 128  # only 128 divides
+    assert _pick_deme_size(1000, 256) is None
+    assert make_pallas_breed(1024, 10, deme_size=96) is not None
 
 
 def test_run_factory_gates_on_tournament_size():

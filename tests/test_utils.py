@@ -1,0 +1,63 @@
+"""Tests for the auxiliary subsystems: profiling hooks and automatic
+checkpointing (both new capabilities — the reference's observability is a
+single printf and its recovery story is exit-on-error, survey §5)."""
+
+import numpy as np
+
+from libpga_tpu import PGA, PGAConfig
+from libpga_tpu.utils import checkpoint, profiling
+
+
+def _solver(seed=0, pop=64, length=8):
+    pga = PGA(seed=seed, config=PGAConfig())
+    handle = pga.create_population(pop, length)
+    pga.set_objective("onemax")
+    return pga, handle
+
+
+def test_timed_runs_logs_every_run():
+    pga, _ = _solver()
+    lines = []
+    with profiling.timed_runs(pga, log=lines.append) as metrics:
+        pga.run(3)
+        pga.run(2)
+    assert len(lines) == 2
+    assert "3 gens" in lines[0] and "gens/sec" in lines[0]
+    assert metrics.total_generations == 5
+    # restored: no more logging outside the block
+    pga.run(1)
+    assert len(lines) == 2
+
+
+def test_trace_writes_profile(tmp_path):
+    pga, _ = _solver()
+    with profiling.trace(str(tmp_path)):
+        pga.run(2)
+    # jax writes trace artifacts under plugins/profile/<ts>/
+    assert any(tmp_path.rglob("*")), "no trace output written"
+
+
+def test_auto_checkpointer_saves_and_resumes(tmp_path):
+    path = str(tmp_path / "state.npz")
+    pga, handle = _solver(seed=7)
+    ckpt = checkpoint.AutoCheckpointer(pga, path, every_generations=5)
+    pga.run(3)  # below threshold: no save yet
+    assert not (tmp_path / "state.npz").exists()
+    pga.run(3)  # crosses 5: saves
+    assert (tmp_path / "state.npz").exists()
+    saved_best = pga.get_best(handle).copy()
+    pga.run(4)  # not yet re-saved (4 < 5)
+    ckpt.close()  # final save
+
+    fresh = PGA(seed=99, config=PGAConfig())
+    fresh.set_objective("onemax")
+    checkpoint.restore(fresh, path)
+    from libpga_tpu.engine import PopulationHandle
+
+    restored_best = fresh.get_best(PopulationHandle(0))
+    # close() saved the final state, which includes the last run
+    assert fresh.num_populations == 1
+    assert restored_best.shape == saved_best.shape
+    np.testing.assert_array_equal(
+        restored_best, pga.get_best(handle)
+    )
