@@ -100,8 +100,12 @@ def make_knapsack(values, weights, capacity: float, max_item_count: int = 2):
     decode per-item count as ``int(g[i] * max_item_count)``; feasible →
     total value; infeasible → ``capacity - weight`` (negative overweight).
     """
-    values = jnp.asarray(values, dtype=jnp.float32)
-    weights = jnp.asarray(weights, dtype=jnp.float32)
+    # numpy, not jnp: this factory runs at import time for
+    # default_knapsack, and touching a device buffer here would
+    # initialize the XLA backend before jax.distributed.initialize can
+    # run in multi-host programs. The arrays convert under trace.
+    values = np.asarray(values, dtype=np.float32)
+    weights = np.asarray(weights, dtype=np.float32)
 
     def knapsack(genome: jax.Array) -> jax.Array:
         counts = jnp.floor(genome * max_item_count).astype(jnp.float32)

@@ -120,6 +120,43 @@ def _migrate_local(genomes, scores, key, count, topology):
     return _immigrate(genomes, scores, em_g[src], em_s[src])
 
 
+def _shard_host_array(arr: jax.Array, sharding: NamedSharding) -> jax.Array:
+    """Place a host-replicated array onto a (possibly multi-host) mesh.
+
+    ``jax.make_array_from_callback`` asks each process only for the
+    shards it can address, which is the multi-host-safe equivalent of
+    ``device_put`` with a NamedSharding (the latter requires every mesh
+    device to be addressable by the calling process). Typed PRNG key
+    arrays round-trip through their uint32 key data (numpy cannot hold
+    the key dtype); the extra trailing data axis is replicated.
+
+    Single-process meshes short-circuit to ``device_put`` — an on-device
+    reshard with no host round trip (the callback path would pull the
+    whole population to host and back, gigabytes at framework scale)."""
+    import numpy as np
+
+    if all(
+        d.process_index == jax.process_index()
+        for d in sharding.mesh.devices.flat
+    ):
+        return jax.device_put(arr, sharding)
+    if jnp.issubdtype(arr.dtype, jax.dtypes.prng_key):
+        impl = jax.random.key_impl(arr)
+        data = np.asarray(jax.random.key_data(arr))
+        extra = data.ndim - arr.ndim
+        spec = P(*(tuple(sharding.spec) + (None,) * extra))
+        data_sharded = jax.make_array_from_callback(
+            data.shape,
+            NamedSharding(sharding.mesh, spec),
+            lambda idx: data[idx],
+        )
+        return jax.random.wrap_key_data(data_sharded, impl=impl)
+    host = np.asarray(arr)
+    return jax.make_array_from_callback(
+        host.shape, sharding, lambda idx: host[idx]
+    )
+
+
 # --------------------------------------------------------------- local path
 
 
@@ -323,10 +360,15 @@ def run_islands_stacked(
         ),
     )
     if mesh is not None:
-        stacked = jax.device_put(
+        # make_array_from_callback rather than device_put: each process
+        # supplies only its addressable shards, so the same code works on
+        # a multi-host mesh (device_put rejects shardings with
+        # non-addressable devices). Host arrays are identical on every
+        # process (same PRNG keys), so the callback slices consistently.
+        stacked = _shard_host_array(
             stacked, NamedSharding(mesh, P(axis_name, None, None))
         )
-        island_keys = jax.device_put(
+        island_keys = _shard_host_array(
             island_keys, NamedSharding(mesh, P(axis_name))
         )
     genomes, scores, epochs_done = runner(
@@ -336,7 +378,9 @@ def run_islands_stacked(
 
     # Remainder generations (< m) run without a following migration. Only
     # executed when the epoch loop wasn't cut short by the target.
-    if rem > 0 and (target is None or float(jnp.max(scores)) < float(tgt)):
+    from libpga_tpu.parallel.mesh import global_max
+
+    if rem > 0 and (target is None or global_max(scores, mesh) < float(tgt)):
         rem_runner = cached(
             "rem", rem,
             lambda: build_runner(
@@ -346,7 +390,7 @@ def run_islands_stacked(
         )
         rem_keys = jax.random.split(jax.random.fold_in(mig_key, 7), I)
         if mesh is not None:
-            rem_keys = jax.device_put(
+            rem_keys = _shard_host_array(
                 rem_keys, NamedSharding(mesh, P(axis_name))
             )
         genomes, scores, _ = rem_runner(

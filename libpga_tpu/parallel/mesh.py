@@ -27,3 +27,19 @@ def island_sharding(mesh: Mesh, axis_name: str = ISLAND_AXIS) -> NamedSharding:
     """Sharding for a stacked ``(islands, size, genome_len)`` array:
     islands split across the mesh, genomes local to a core."""
     return NamedSharding(mesh, P(axis_name, None, None))
+
+
+def global_max(arr, mesh: Optional[Mesh] = None) -> float:
+    """Max of a (possibly multi-host-sharded) array as a host float.
+
+    Plain ``jnp.max`` on a global array with non-addressable shards
+    raises; reducing under jit with a replicated output sharding gives
+    every process the scalar. Fully addressable arrays take the direct
+    path (no host round trip beyond the scalar)."""
+    import jax.numpy as jnp
+
+    if getattr(arr, "is_fully_addressable", True) or mesh is None:
+        return float(jnp.max(arr))
+    return float(
+        jax.jit(jnp.max, out_shardings=NamedSharding(mesh, P()))(arr)
+    )
