@@ -233,6 +233,7 @@ class PGA:
                 ),
                 deme_size=self.config.pallas_deme_size,
                 donate=self.config.donate_buffers,
+                gene_dtype=self.config.gene_dtype,
             )
             if factory is not None:
                 pallas_fn = factory(size, genome_len)
@@ -252,13 +253,14 @@ class PGA:
         """Single source of truth for Pallas fast-path eligibility, shared
         by the single-population run loop and the island runner. The
         kernel only implements default operators, tournament-2, pure
-        generational replacement, f32 genes, and requires a real TPU."""
+        generational replacement, f32/bf16 genes, and requires a real
+        TPU."""
         if not (
             self.config.pallas_enabled()
             and self._is_default_operators()
             and self.config.elitism == 0
             and self.config.tournament_size == 2
-            and self.config.gene_dtype == jnp.float32
+            and self.config.gene_dtype in (jnp.float32, jnp.bfloat16)
         ):
             return False
         import jax as _jax
@@ -288,6 +290,7 @@ class PGA:
             deme_size=self.config.pallas_deme_size,
             mutation_rate=getattr(self._mutate, "rate", self.config.mutation_rate),
             fused_obj=fused,
+            gene_dtype=self.config.gene_dtype,
         )
         self._compiled[cache_key] = pb
         return pb

@@ -74,7 +74,17 @@ def _supported() -> bool:
 
 
 def _breed_kernel(
-    seed_ref, scores_ref, genomes_ref, out_ref, *rest, K, L, Lp, rate, obj=None
+    seed_ref,
+    scores_ref,
+    genomes_ref,
+    out_ref,
+    *rest,
+    K,
+    L,
+    Lp,
+    rate,
+    obj=None,
+    bf16_genes=False,
 ):
     """One deme: select parents, crossover, mutate — and, when ``obj`` is
     given, evaluate the children in-kernel (skipping a whole extra HBM
@@ -116,14 +126,23 @@ def _breed_kernel(
     oh1 = jnp.where(w1, oh[0], oh[1])  # (K, K) winner selectors
     oh2 = jnp.where(w2, oh[2], oh[3])
 
-    # ---- parent rows via one-hot matmul, bf16 hi/lo split ---------------
-    g_hi = g.astype(jnp.bfloat16)
-    g_lo = (g - g_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    # ---- parent rows via one-hot matmul -------------------------------
+    if bf16_genes:
+        # bf16 genomes are selected exactly by a single bf16 matmul
+        # (0/1 selector rows; f32 accumulation) — half the FLOPs and HBM
+        # traffic of the f32 hi/lo path.
+        def sel(oh_w):
+            return jnp.dot(oh_w, g, preferred_element_type=jnp.float32)
 
-    def sel(oh_w):
-        hi = jnp.dot(oh_w, g_hi, preferred_element_type=jnp.float32)
-        lo = jnp.dot(oh_w, g_lo, preferred_element_type=jnp.float32)
-        return hi + lo
+    else:
+        # f32 genomes: bf16 hi/lo split, ~1e-5 absolute gene accuracy.
+        g_hi = g.astype(jnp.bfloat16)
+        g_lo = (g - g_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+
+        def sel(oh_w):
+            hi = jnp.dot(oh_w, g_hi, preferred_element_type=jnp.float32)
+            lo = jnp.dot(oh_w, g_lo, preferred_element_type=jnp.float32)
+            return hi + lo
 
     p1 = sel(oh1)  # (K, Lp) f32
     p2 = sel(oh2)
@@ -150,7 +169,13 @@ def _breed_kernel(
 
     # Write through the (K, 1, 1, Lp) block: deme i becomes column i of the
     # (K, G, 1, Lp) output, so the row-major reshape interleaves demes.
+    out_dtype = jnp.bfloat16 if bf16_genes else jnp.float32
+    child = child.astype(out_dtype)
     out_ref[:] = child.reshape(K, 1, 1, Lp)
+    if bf16_genes:
+        # Score the STORED genes: evaluating the pre-rounding f32 child
+        # would return scores the written bf16 genomes don't achieve.
+        child = child.astype(jnp.float32)
 
     if obj is not None:
         # Fused evaluation: score the children while they're in VMEM,
@@ -174,14 +199,20 @@ def make_pallas_breed(
     deme_size: int = 256,
     mutation_rate: float = 0.01,
     fused_obj: Optional[Callable] = None,
+    gene_dtype=jnp.float32,
 ) -> Optional[Callable]:
-    """Build the fused breed: ``(genomes (P,L) f32, scores (P,), key) ->
+    """Build the fused breed: ``(genomes (P,L), scores (P,), key) ->
     next_genomes (P, L)`` — or, with ``fused_obj``, ``-> (next_genomes,
-    next_scores)`` with evaluation done inside the kernel. Returns None
-    when the shape is unsupported (population not divisible into
-    power-of-two demes)."""
+    next_scores)`` with evaluation done inside the kernel. ``gene_dtype``
+    bfloat16 selects parents with a single exact bf16 matmul (half the
+    FLOPs/traffic of the f32 hi/lo path) at bf16 gene resolution.
+    Returns None when unsupported (population not divisible into
+    power-of-two demes, or an unsupported dtype)."""
     if not _supported():
         return None
+    if gene_dtype not in (jnp.float32, jnp.bfloat16):
+        return None
+    bf16_genes = gene_dtype == jnp.bfloat16
     P, L = pop_size, genome_len
     K = _pick_deme_size(P, deme_size)
     if K is None:
@@ -193,11 +224,17 @@ def make_pallas_breed(
     from jax.experimental.pallas import tpu as pltpu
 
     kernel = partial(
-        _breed_kernel, K=K, L=L, Lp=Lp, rate=mutation_rate, obj=fused_obj
+        _breed_kernel,
+        K=K,
+        L=L,
+        Lp=Lp,
+        rate=mutation_rate,
+        obj=fused_obj,
+        bf16_genes=bf16_genes,
     )
 
     out_specs = [pl.BlockSpec((K, 1, 1, Lp), lambda i: (0, i, 0, 0))]
-    out_shape = [jax.ShapeDtypeStruct((K, G, 1, Lp), jnp.float32)]
+    out_shape = [jax.ShapeDtypeStruct((K, G, 1, Lp), gene_dtype)]
     if fused_obj is not None:
         out_specs.append(pl.BlockSpec((1, 1, K), lambda i: (i, 0, 0)))
         out_shape.append(jax.ShapeDtypeStruct((G, 1, K), jnp.float32))
@@ -233,7 +270,7 @@ def make_pallas_breed(
         return out.reshape(P, Lp)
 
     def breed(genomes: jax.Array, scores: jax.Array, key: jax.Array):
-        gp = genomes.astype(jnp.float32)
+        gp = genomes.astype(gene_dtype)
         if Lp != L:
             gp = jnp.pad(gp, ((0, 0), (0, Lp - L)))
         out = breed_padded(gp, scores, key)
@@ -245,6 +282,7 @@ def make_pallas_breed(
     breed.padded = breed_padded
     breed.Lp = Lp
     breed.fused = fused_obj is not None
+    breed.gene_dtype = gene_dtype
     return breed
 
 
@@ -255,6 +293,7 @@ def make_pallas_run(
     mutation_rate: float = 0.01,
     deme_size: int = 256,
     donate: bool = True,
+    gene_dtype=jnp.float32,
 ) -> Optional[Callable]:
     """Build a per-shape factory for the fused run loop used by ``PGA.run``:
     ``build(pop_size, genome_len)`` returns a jitted
@@ -286,7 +325,7 @@ def make_pallas_run(
         breed = make_pallas_breed(
             pop_size, genome_len,
             deme_size=deme_size, mutation_rate=mutation_rate,
-            fused_obj=fused_obj,
+            fused_obj=fused_obj, gene_dtype=gene_dtype,
         )
         if breed is None:
             return None
@@ -297,7 +336,7 @@ def make_pallas_run(
             # Pad once; the loop carries the lane-aligned (P, Lp) matrix.
             # Evaluation reads the [:, :L] view (the slice fuses into the
             # objective's reduction — nothing materializes).
-            gp = genomes.astype(jnp.float32)
+            gp = genomes.astype(gene_dtype)
             if Lp != L:
                 gp = jnp.pad(gp, ((0, 0), (0, Lp - L)))
             scores0 = _evaluate(obj, gp[:, :L])

@@ -49,12 +49,18 @@ def make_island_epoch(breed: Callable, obj: Callable, m: int) -> Callable:
     fused = getattr(breed, "fused", False)
     padded_fn = getattr(breed, "padded", None)
     Lp = getattr(breed, "Lp", None)
+    gdtype = getattr(breed, "gene_dtype", None)
 
     def epoch(genomes, scores, key):
         L = genomes.shape[1]
         pad = padded_fn is not None and Lp is not None and Lp != L
+        # Cast to the breed's gene dtype (bf16 mode outputs bf16; a f32
+        # carry would fail the scan's carry-dtype check).
         g0 = (
-            jnp.pad(genomes.astype(jnp.float32), ((0, 0), (0, Lp - L)))
+            jnp.pad(
+                genomes.astype(gdtype or genomes.dtype),
+                ((0, 0), (0, Lp - L)),
+            )
             if pad
             else genomes
         )

@@ -165,6 +165,41 @@ def test_fused_breed_through_island_runner():
     np.testing.assert_allclose(scores, genomes.sum(axis=2), atol=2e-4, rtol=0)
 
 
+def test_bf16_gene_mode_structure():
+    """bf16 gene mode: single-matmul selection must still reproduce the
+    deme-row-0 structure exactly (bf16 one-hot selection of bf16 genes is
+    exact) and preserve the dtype."""
+    P, L, K = 512, 16, 128
+    G = P // K
+    with _interpret():
+        breed = make_pallas_breed(
+            P, L, deme_size=K, mutation_rate=0.0, gene_dtype=jnp.bfloat16
+        )
+        genomes = (
+            jnp.broadcast_to(jnp.arange(P, dtype=jnp.float32)[:, None], (P, L))
+            / P
+        ).astype(jnp.bfloat16)
+        out = breed(genomes, jnp.zeros((P,)), jax.random.key(0))
+    assert out.dtype == jnp.bfloat16
+    out = np.asarray(out.astype(jnp.float32))
+    gn = np.asarray(genomes.astype(jnp.float32))
+    for r in range(0, P, 31):
+        np.testing.assert_array_equal(out[r], gn[(r % G) * K])
+
+
+def test_engine_bf16_genes_on_xla_path():
+    """gene_dtype=bfloat16 works end-to-end on the XLA path (CPU) and the
+    population keeps its dtype through runs."""
+    from libpga_tpu import PGA, PGAConfig
+
+    pga = PGA(seed=0, config=PGAConfig(gene_dtype=jnp.bfloat16))
+    pop = pga.create_population(256, 8)
+    pga.set_objective("onemax")
+    pga.run(5)
+    assert pga.population(pop).genomes.dtype == jnp.bfloat16
+    assert pga.get_best(pop).shape == (8,)
+
+
 def test_mutation_rate_zero_never_fires():
     """rate=0 must be a strict no-op even for zero random bits (the gate
     is strict '<'; the reference's '<=' would fire on u == 0)."""
