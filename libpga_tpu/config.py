@@ -41,8 +41,10 @@ class PGAConfig:
         kernel's selection is tournament-2 within per-generation shuffled
         demes (see ``ops/pallas_step.py``); set False for exact panmictic
         tournament semantics.
-      pallas_deme_size: rows per VMEM deme in the Pallas kernel (power of
-        two; population must divide by it or the engine falls back).
+      pallas_deme_size: rows per VMEM deme in the Pallas kernel. Honored
+        when it is a power of two in [128, 1024] that divides the
+        population; otherwise the kernel picks the largest such divisor
+        itself, or the engine falls back to the XLA path when none exists.
       donate_buffers: donate the genome buffer to jit so XLA updates it in
         place (the TPU-native replacement for the reference's
         current/next-generation pointer swap, ``pga.h:124-129``).

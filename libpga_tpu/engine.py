@@ -228,9 +228,7 @@ class PGA:
                 tournament_size=self.config.tournament_size,
                 # The rate bound into the active operator, not the config
                 # default — set_mutate(make_point_mutate(r)) must win.
-                mutation_rate=getattr(
-                    self._mutate, "rate", self.config.mutation_rate
-                ),
+                mutation_rate=self._mutation_rate(),
                 deme_size=self.config.pallas_deme_size,
                 donate=self.config.donate_buffers,
                 gene_dtype=self.config.gene_dtype,
@@ -248,6 +246,28 @@ class PGA:
         return self._crossover is uniform_crossover and (
             getattr(self._mutate, "func", None) is _m.point_mutate
         )
+
+    def _mutation_rate(self) -> float:
+        """The rate bound into the active mutate operator. A raw
+        ``partial(point_mutate, rate=r)`` passes the default-operator gate
+        but lacks the ``.rate`` attribute ``make_point_mutate`` sets — read
+        its ``keywords`` so the kernel runs at r, not the config default.
+        When no rate is discoverable at all (bare ``partial(point_mutate)``)
+        the operator executes at its own signature default, so that — not
+        the config value — is what the kernel must match."""
+        rate = getattr(self._mutate, "rate", None)
+        if rate is None:
+            rate = getattr(self._mutate, "keywords", {}).get("rate")
+        if rate is None:
+            func = getattr(self._mutate, "func", None)
+            if func is not None:
+                import inspect
+
+                p = inspect.signature(func).parameters.get("rate")
+                if p is not None and p.default is not inspect.Parameter.empty:
+                    return p.default
+            rate = self.config.mutation_rate
+        return rate
 
     def _pallas_gate(self) -> bool:
         """Single source of truth for Pallas fast-path eligibility, shared
@@ -288,7 +308,7 @@ class PGA:
             island_size,
             genome_len,
             deme_size=self.config.pallas_deme_size,
-            mutation_rate=getattr(self._mutate, "rate", self.config.mutation_rate),
+            mutation_rate=self._mutation_rate(),
             fused_obj=fused,
             gene_dtype=self.config.gene_dtype,
         )

@@ -56,7 +56,12 @@ def _pick_deme_size(pop_size: int, preferred: int):
     largest power-of-two divisor in [128, 1024] (K=128 is the smallest
     MXU-efficient tile; above 1024 the one-hot matmul FLOPs dominate).
     None when nothing fits — the caller falls back to the XLA path."""
-    if preferred and not (preferred & (preferred - 1)) and pop_size % preferred == 0:
+    if (
+        preferred
+        and not (preferred & (preferred - 1))
+        and 128 <= preferred <= 1024  # same bound as the fallback search:
+        and pop_size % preferred == 0  # tiny demes collapse tournament-2
+    ):                                 # toward cloning + sub-tile shapes
         return preferred
     for k in (1024, 512, 256, 128):
         if pop_size % k == 0:

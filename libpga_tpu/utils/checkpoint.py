@@ -17,7 +17,25 @@ import numpy as np
 if TYPE_CHECKING:
     from libpga_tpu.engine import PGA
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2
+
+
+def _encode(arr: np.ndarray):
+    """npz-safe encoding: ml_dtypes bfloat16 has no npy representation
+    (np.savez writes it as raw void '|V2' that jnp.asarray cannot read
+    back), so non-npy dtypes are stored as their uint bit patterns with
+    the true dtype name recorded alongside."""
+    if arr.dtype.kind == "V" or arr.dtype.name == "bfloat16":
+        return arr.view(np.uint16 if arr.dtype.itemsize == 2 else np.uint8), arr.dtype.name
+    return arr, ""
+
+
+def _decode(arr: np.ndarray, dtype_name: str) -> np.ndarray:
+    if not dtype_name:
+        return arr
+    import ml_dtypes
+
+    return arr.view(np.dtype(getattr(ml_dtypes, dtype_name)))
 
 
 def save(pga: "PGA", path: str) -> None:
@@ -28,7 +46,9 @@ def save(pga: "PGA", path: str) -> None:
         "__key__": np.asarray(jax.random.key_data(pga._key)),
     }
     for i, pop in enumerate(pga.populations):
-        arrays[f"genomes_{i}"] = np.asarray(pop.genomes)
+        genomes, dtype_name = _encode(np.asarray(pop.genomes))
+        arrays[f"genomes_{i}"] = genomes
+        arrays[f"genomes_dtype_{i}"] = np.asarray(dtype_name)
         arrays[f"scores_{i}"] = np.asarray(pop.scores)
     np.savez(path, **arrays)
 
@@ -77,13 +97,20 @@ def restore(pga: "PGA", path: str) -> None:
 
     with np.load(path) as data:
         version = int(data["__version__"])
-        if version != FORMAT_VERSION:
+        if version not in (1, FORMAT_VERSION):
             raise ValueError(f"unsupported checkpoint version {version}")
         n = int(data["__num_populations__"])
         pga._key = jax.random.wrap_key_data(jnp.asarray(data["__key__"]))
+
+        def genomes(i):
+            g = data[f"genomes_{i}"]
+            if version >= 2:
+                g = _decode(g, str(data[f"genomes_dtype_{i}"]))
+            return jnp.asarray(g)
+
         pga._populations = [
             Population(
-                genomes=jnp.asarray(data[f"genomes_{i}"]),
+                genomes=genomes(i),
                 scores=jnp.asarray(data[f"scores_{i}"]),
             )
             for i in range(n)

@@ -26,6 +26,32 @@ def test_save_restore_roundtrip(tmp_path):
     )
 
 
+def test_bf16_roundtrip(tmp_path):
+    """bf16 genomes must survive save/restore: np.savez has no native
+    bfloat16 representation, so the checkpoint stores bit patterns plus
+    the dtype name (advisor round-1 finding: raw '|V2' saves were
+    unrestorable)."""
+    import jax.numpy as jnp
+
+    from libpga_tpu import PGAConfig
+
+    pga = PGA(seed=0, config=PGAConfig(gene_dtype=jnp.bfloat16))
+    h = pga.create_population(64, 8)
+    pga.set_objective("onemax")
+    pga.run(3)
+    path = str(tmp_path / "ckpt_bf16.npz")
+    checkpoint.save(pga, path)
+
+    fresh = PGA(seed=1)
+    checkpoint.restore(fresh, path)
+    restored = fresh.population(h).genomes
+    assert restored.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(restored.astype(jnp.float32)),
+        np.asarray(pga.population(h).genomes.astype(jnp.float32)),
+    )
+
+
 def test_resume_continues_deterministically(tmp_path):
     """save → run(k) must equal restore → run(k): PRNG state round-trips."""
     path = str(tmp_path / "ckpt.npz")

@@ -41,7 +41,32 @@ def test_deme_size_auto_fallback():
     assert _pick_deme_size(40_960, 256) == 256
     assert _pick_deme_size(128 * 3, 256) == 128  # only 128 divides
     assert _pick_deme_size(1000, 256) is None
+    # power-of-two but out-of-range preferred sizes are clamped to the
+    # documented [128, 1024] band, not accepted verbatim (tiny demes
+    # collapse tournament-2 toward cloning; advisor round-1 finding)
+    assert _pick_deme_size(1 << 20, 2) == 1024
+    assert _pick_deme_size(1 << 20, 64) == 1024
+    assert _pick_deme_size(1 << 20, 2048) == 1024
     assert make_pallas_breed(1024, 10, deme_size=96) is not None
+
+
+def test_engine_mutation_rate_from_raw_partial():
+    """A raw functools.partial(point_mutate, rate=r) passes the
+    default-operator gate; the engine must surface r (via .keywords), not
+    silently fall back to the config default (advisor round-1 finding)."""
+    from functools import partial
+
+    from libpga_tpu import PGA
+    from libpga_tpu.ops.mutate import make_point_mutate, point_mutate
+
+    pga = PGA(seed=0)
+    pga.set_mutate(partial(point_mutate, rate=0.42))
+    assert pga._is_default_operators()
+    assert pga._mutation_rate() == 0.42
+    pga.set_mutate(make_point_mutate(0.13))
+    assert pga._mutation_rate() == 0.13
+    pga.set_mutate(None)
+    assert pga._mutation_rate() == pga.config.mutation_rate
 
 
 def test_run_factory_gates_on_tournament_size():
