@@ -1,0 +1,115 @@
+/* pga.h — source-compatible C API, exactly shaped after the reference
+ * libpga header (reference repo include/pga.h:26-150: same type names,
+ * same 20 entry points, same signatures — void returns, seedless init,
+ * gene** top-k results). Implemented by libpga.so (pga_compat.cc) over
+ * the TPU-native engine.
+ *
+ * A driver written against the reference header compiles against this
+ * one unchanged, minus the CUDA-isms its toolchain required:
+ *
+ *  - callbacks are plain HOST function pointers — drop the __device__
+ *    qualifiers and pass the function directly (the reference makes you
+ *    fetch a device pointer with cudaMemcpyFromSymbol, pga.h:66);
+ *  - problem data lives in ordinary host arrays — drop __constant__.
+ *
+ * Semantics notes (all matching the reference's behavior, not just its
+ * header):
+ *  - pga_init() seeds from OS entropy, the analog of the reference's
+ *    time(NULL) cuRAND seed (pga.cu:154). For reproducible runs use the
+ *    improved ABI (pga_tpu.h) which takes an explicit seed.
+ *  - pga_run(p, n) runs exactly n generations on the FIRST population,
+ *    as the reference implements it (pga.cu:376-391). The header-promised
+ *    early termination is available via the improved ABI's pga_run target.
+ *  - The functions the reference declares but leaves as stubs — the
+ *    _top/_all best getters (pga.cu:238-248), pga_migrate(_between)
+ *    (pga.cu:368-374) and pga_run_islands (pga.cu:393-395) — are fully
+ *    implemented here per their documented contracts.
+ *  - pga_get_best_top(_all) return a malloc'd array of `length` pointers,
+ *    each a malloc'd genome row (best first); free each row, then the
+ *    array. NULL when `length` exceeds the (total) population size.
+ *
+ * Do NOT link libpga.so and libpga_tpu_c.so into the same image: they
+ * define the same symbol names with different signatures on purpose.
+ * Thread safety: none (matches the reference). One in-process user.
+ */
+#ifndef PGA_H
+#define PGA_H
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct pga pga_t;
+typedef struct population population_t;
+
+typedef float gene;
+
+enum population_type {
+    RANDOM_POPULATION,
+    MAX_POPULATION_TYPE
+};
+
+enum crossover_selection_type {
+    TOURNAMENT,
+    MAX_SELECTION_TYPE
+};
+
+#define MAX_POPULATIONS 10
+
+typedef float (*obj_f)(gene *, unsigned);
+typedef void (*mutate_f)(gene *, float *, unsigned);
+typedef void (*crossover_f)(gene *, gene *, gene *, float *, unsigned);
+
+/* Solver lifecycle. */
+pga_t *pga_init();
+void pga_deinit(pga_t *);
+
+/* Add a population of `size` genomes, `genome_len` >= 4 genes each;
+ * at most MAX_POPULATIONS per solver. NULL on error. */
+population_t *pga_create_population(pga_t *, unsigned long size,
+                                    unsigned genome_len,
+                                    enum population_type type);
+
+/* Callback registration. Higher objective = better. NULL mutate /
+ * crossover restores the defaults (0.01 point mutation, uniform
+ * crossover — reference pga.cu:127-143). */
+void pga_set_objective_function(pga_t *, obj_f);
+void pga_set_mutate_function(pga_t *, mutate_f);
+void pga_set_crossover_function(pga_t *, crossover_f);
+
+/* Best-individual extraction. Single-genome getters return one malloc'd
+ * row; the _top variants return length malloc'd rows behind a malloc'd
+ * pointer array, best first. */
+gene *pga_get_best(pga_t *, population_t *);
+gene **pga_get_best_top(pga_t *, population_t *, unsigned length);
+gene *pga_get_best_all(pga_t *);
+gene **pga_get_best_top_all(pga_t *, unsigned length);
+
+/* Step-by-step generation operators. */
+void pga_evaluate(pga_t *, population_t *);
+void pga_evaluate_all(pga_t *);
+
+void pga_crossover(pga_t *, population_t *, enum crossover_selection_type);
+void pga_crossover_all(pga_t *, enum crossover_selection_type);
+
+void pga_migrate(pga_t *, float pct);
+void pga_migrate_between(pga_t *, population_t *, population_t *, float pct);
+
+void pga_mutate(pga_t *, population_t *);
+void pga_mutate_all(pga_t *);
+
+void pga_swap_generations(pga_t *, population_t *);
+
+void pga_fill_random_values(pga_t *, population_t *);
+
+/* Fused run loops: n generations of evaluate/crossover/mutate on the
+ * first population (pga_run), or across ALL populations as islands with
+ * top-`pct` migration every m generations (pga_run_islands). */
+void pga_run(pga_t *, unsigned n);
+void pga_run_islands(pga_t *, unsigned n, unsigned m, float pct);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif

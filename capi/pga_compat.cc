@@ -1,0 +1,198 @@
+/* pga_compat.cc — the exact-reference-ABI shim (libpga.so).
+ *
+ * Implements capi/pga.h: the reference repo's include/pga.h signatures,
+ * verbatim — seedless pga_init, void returns, fixed-count pga_run,
+ * gene** top-k getters — over the same libpga_tpu.capi_bridge the
+ * improved shim (pga_tpu.cc) uses. A reference driver's source compiles
+ * against this header unchanged once its CUDA-isms (__device__,
+ * __constant__, cudaMemcpyFromSymbol) are dropped; tests/test_capi.py
+ * proves that by de-CUDA-ing the reference's own knapsack driver at test
+ * time and running it against this library.
+ *
+ * Error model: the reference aborts the process on any CUDA error
+ * (pga.cu:25-33) so its void returns never report failure; here a failed
+ * call prints the Python error and the program continues with NULL
+ * results where applicable — strictly more survivable.
+ */
+
+#include "pga.h"
+
+#include "pga_marshal.h"
+
+namespace {
+using namespace pga_marshal;
+
+/* Split a flat float32 payload of `rows` genome rows into the reference's
+ * gene** ownership contract: a malloc'd array of `rows` pointers, each a
+ * malloc'd row copy. Frees the flat buffer. */
+gene **split_rows(float *flat, size_t nbytes, unsigned rows) {
+    if (!flat || rows == 0) {
+        std::free(flat);
+        return nullptr;
+    }
+    size_t total = nbytes / sizeof(gene);
+    if (total % rows != 0) {
+        std::free(flat);
+        return nullptr;
+    }
+    size_t row_len = total / rows;
+    gene **out = static_cast<gene **>(std::malloc(rows * sizeof(gene *)));
+    if (!out) {
+        std::free(flat);
+        return nullptr;
+    }
+    for (unsigned r = 0; r < rows; ++r) {
+        out[r] = static_cast<gene *>(std::malloc(row_len * sizeof(gene)));
+        if (!out[r]) {
+            for (unsigned q = 0; q < r; ++q) std::free(out[q]);
+            std::free(out);
+            std::free(flat);
+            return nullptr;
+        }
+        std::memcpy(out[r], flat + r * row_len, row_len * sizeof(gene));
+    }
+    std::free(flat);
+    return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+pga_t *pga_init() {
+    /* seed < 0 = OS entropy: the analog of the reference's time(NULL)
+     * cuRAND seeding (pga.cu:154). */
+    long h = call_long("init", "(l)", -1L);
+    return h <= 0 ? nullptr : pack_solver<pga_t *>(h);
+}
+
+void pga_deinit(pga_t *p) {
+    if (!p) return;
+    call_long("deinit", "(l)", solver_of(p));
+}
+
+population_t *pga_create_population(pga_t *p, unsigned long size,
+                                    unsigned genome_len,
+                                    enum population_type type) {
+    if (!p) return nullptr;
+    long idx = call_long("create_population", "(lkIi)", solver_of(p), size,
+                         genome_len, static_cast<int>(type));
+    return idx < 0 ? nullptr
+                   : pack_pop<population_t *>(solver_of(p), idx);
+}
+
+void pga_set_objective_function(pga_t *p, obj_f f) {
+    if (!p || !f) return;
+    call_long("set_objective_ptr", "(ll)", solver_of(p),
+              static_cast<long>(reinterpret_cast<intptr_t>(f)));
+}
+
+void pga_set_mutate_function(pga_t *p, mutate_f f) {
+    if (!p) return;
+    call_long("set_mutate_ptr", "(ll)", solver_of(p),
+              static_cast<long>(reinterpret_cast<intptr_t>(f)));
+}
+
+void pga_set_crossover_function(pga_t *p, crossover_f f) {
+    if (!p) return;
+    call_long("set_crossover_ptr", "(ll)", solver_of(p),
+              static_cast<long>(reinterpret_cast<intptr_t>(f)));
+}
+
+gene *pga_get_best(pga_t *p, population_t *pop) {
+    if (!p || !pop) return nullptr;
+    return bytes_to_floats(
+        call("get_best", "(ll)", solver_of(p), pop_index_of(pop)));
+}
+
+gene **pga_get_best_top(pga_t *p, population_t *pop, unsigned length) {
+    if (!p || !pop || length == 0) return nullptr;
+    size_t nbytes = 0;
+    float *flat = bytes_to_floats(
+        call("get_best_top", "(llI)", solver_of(p), pop_index_of(pop),
+             length),
+        &nbytes);
+    return split_rows(flat, nbytes, length);
+}
+
+gene *pga_get_best_all(pga_t *p) {
+    if (!p) return nullptr;
+    return bytes_to_floats(call("get_best_all", "(l)", solver_of(p)));
+}
+
+gene **pga_get_best_top_all(pga_t *p, unsigned length) {
+    if (!p || length == 0) return nullptr;
+    size_t nbytes = 0;
+    float *flat = bytes_to_floats(
+        call("get_best_top_all", "(lI)", solver_of(p), length), &nbytes);
+    return split_rows(flat, nbytes, length);
+}
+
+void pga_evaluate(pga_t *p, population_t *pop) {
+    if (!p || !pop) return;
+    call_long("evaluate", "(ll)", solver_of(p), pop_index_of(pop));
+}
+
+void pga_evaluate_all(pga_t *p) {
+    if (!p) return;
+    call_long("evaluate_all", "(l)", solver_of(p));
+}
+
+void pga_crossover(pga_t *p, population_t *pop,
+                   enum crossover_selection_type type) {
+    if (!p || !pop) return;
+    call_long("crossover", "(lli)", solver_of(p), pop_index_of(pop),
+              static_cast<int>(type));
+}
+
+void pga_crossover_all(pga_t *p, enum crossover_selection_type type) {
+    if (!p) return;
+    call_long("crossover_all", "(li)", solver_of(p), static_cast<int>(type));
+}
+
+void pga_migrate(pga_t *p, float pct) {
+    if (!p) return;
+    call_long("migrate", "(lf)", solver_of(p), static_cast<double>(pct));
+}
+
+void pga_migrate_between(pga_t *p, population_t *from, population_t *to,
+                         float pct) {
+    if (!p || !from || !to) return;
+    call_long("migrate_between", "(lllf)", solver_of(p), pop_index_of(from),
+              pop_index_of(to), static_cast<double>(pct));
+}
+
+void pga_mutate(pga_t *p, population_t *pop) {
+    if (!p || !pop) return;
+    call_long("mutate", "(ll)", solver_of(p), pop_index_of(pop));
+}
+
+void pga_mutate_all(pga_t *p) {
+    if (!p) return;
+    call_long("mutate_all", "(l)", solver_of(p));
+}
+
+void pga_swap_generations(pga_t *p, population_t *pop) {
+    if (!p || !pop) return;
+    call_long("swap_generations", "(ll)", solver_of(p), pop_index_of(pop));
+}
+
+void pga_fill_random_values(pga_t *p, population_t *pop) {
+    if (!p || !pop) return;
+    call_long("fill_random_values", "(ll)", solver_of(p), pop_index_of(pop));
+}
+
+void pga_run(pga_t *p, unsigned n) {
+    /* Fixed generation count on the first population — the reference's
+     * implemented behavior (pga.cu:376-391). */
+    if (!p) return;
+    call_long("run", "(lIif)", solver_of(p), n, 0, 0.0);
+}
+
+void pga_run_islands(pga_t *p, unsigned n, unsigned m, float pct) {
+    if (!p) return;
+    call_long("run_islands", "(lIIf)", solver_of(p), n, m,
+              static_cast<double>(pct));
+}
+
+}  // extern "C"

@@ -1,0 +1,134 @@
+/* pga_marshal.h — shared internals of the native C ABI shims.
+ *
+ * Both shim flavors — pga_tpu.cc (the improved, int-returning ABI) and
+ * pga_compat.cc (the exact reference-shaped ABI from the reference repo's
+ * include/pga.h) — embed one CPython interpreter and forward calls to
+ * libpga_tpu.capi_bridge. This header holds the embedding + marshaling
+ * machinery they share. Internal: not installed, not a public API.
+ *
+ * Everything is `static` so each shim gets its own copy; the two shared
+ * libraries are never linked into the same image (they define colliding
+ * pga_* symbols by design — same names, different signatures).
+ */
+#ifndef PGA_MARSHAL_H
+#define PGA_MARSHAL_H
+
+#include <Python.h>
+
+#include <cstdarg>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+namespace pga_marshal {
+
+constexpr const char *kBridge = "libpga_tpu.capi_bridge";
+
+inline PyObject *&bridge_module() {
+    static PyObject *mod = nullptr;
+    return mod;
+}
+
+static void print_py_error(const char *where) {
+    std::fprintf(stderr, "pga_tpu: python error in %s:\n", where);
+    PyErr_Print();
+}
+
+/* Initialize the embedded interpreter and import the bridge module. */
+static bool ensure_python() {
+    if (bridge_module()) return true;
+    if (!Py_IsInitialized()) Py_InitializeEx(0);
+    PyObject *mod = PyImport_ImportModule(kBridge);
+    if (!mod) {
+        print_py_error("import libpga_tpu.capi_bridge "
+                       "(is the repo root on PYTHONPATH?)");
+        return false;
+    }
+    bridge_module() = mod;
+    return true;
+}
+
+/* Core marshaling: bridge.<name>(*args) with a Py_BuildValue format
+ * string (always parenthesized at call sites, so the built value is a
+ * tuple). Returns a new reference or nullptr (python error printed). */
+static PyObject *call_va(const char *name, const char *fmt, va_list ap) {
+    if (!ensure_python()) return nullptr;
+    PyObject *callable = PyObject_GetAttrString(bridge_module(), name);
+    if (!callable) {
+        print_py_error(name);
+        return nullptr;
+    }
+    PyObject *args = Py_VaBuildValue(fmt, ap);
+    PyObject *out = args ? PyObject_CallObject(callable, args) : nullptr;
+    Py_XDECREF(args);
+    Py_DECREF(callable);
+    if (!out) print_py_error(name);
+    return out;
+}
+
+static PyObject *call(const char *name, const char *fmt, ...) {
+    va_list ap;
+    va_start(ap, fmt);
+    PyObject *out = call_va(name, fmt, ap);
+    va_end(ap);
+    return out;
+}
+
+/* Integer-returning variant; -1 signals an error (None maps to 0). */
+static long call_long(const char *name, const char *fmt, ...) {
+    va_list ap;
+    va_start(ap, fmt);
+    PyObject *out = call_va(name, fmt, ap);
+    va_end(ap);
+    if (!out) return -1;
+    long v = out == Py_None ? 0 : PyLong_AsLong(out);
+    if (PyErr_Occurred()) {
+        print_py_error(name);
+        v = -1;
+    }
+    Py_DECREF(out);
+    return v;
+}
+
+/* Convert a bytes result (float32 payload) into a malloc'd float buffer.
+ * Consumes the reference. Optionally reports the byte length. */
+static float *bytes_to_floats(PyObject *out, size_t *nbytes = nullptr) {
+    if (!out) return nullptr;
+    char *buf = nullptr;
+    Py_ssize_t len = 0;
+    if (PyBytes_AsStringAndSize(out, &buf, &len) != 0) {
+        print_py_error("bytes result");
+        Py_DECREF(out);
+        return nullptr;
+    }
+    float *vals = static_cast<float *>(std::malloc(len));
+    if (vals) std::memcpy(vals, buf, len);
+    if (nbytes) *nbytes = static_cast<size_t>(len);
+    Py_DECREF(out);
+    return vals;
+}
+
+/* Handle packing: pga_t* carries the solver handle; population_t* carries
+ * (solver_handle << 16 | pop_index + 1) so both sides stay opaque,
+ * pointer-shaped, and never collide with NULL. */
+template <typename SolverPtr>
+static SolverPtr pack_solver(long h) {
+    return reinterpret_cast<SolverPtr>(static_cast<intptr_t>(h));
+}
+template <typename SolverPtr>
+static long solver_of(SolverPtr p) {
+    return static_cast<long>(reinterpret_cast<intptr_t>(p));
+}
+template <typename PopPtr>
+static PopPtr pack_pop(long solver, long index) {
+    return reinterpret_cast<PopPtr>(
+        static_cast<intptr_t>((solver << 16) | (index + 1)));
+}
+template <typename PopPtr>
+static long pop_index_of(PopPtr pop) {
+    return (static_cast<long>(reinterpret_cast<intptr_t>(pop)) & 0xffff) - 1;
+}
+
+}  // namespace pga_marshal
+
+#endif /* PGA_MARSHAL_H */

@@ -10,130 +10,30 @@
  * Host callbacks (custom objective/mutate/crossover) are passed as raw
  * function-pointer addresses; the bridge wraps them with ctypes and
  * evaluates through jax.pure_callback. See pga_tpu.h for the tradeoff.
+ *
+ * This is the IMPROVED ABI (int error returns, explicit seed, run
+ * targets). For source compatibility with drivers written against the
+ * reference's exact include/pga.h, link libpga.so (pga_compat.cc)
+ * instead.
  */
 
 #include "pga_tpu.h"
 
-#include <Python.h>
-
-#include <cstdio>
-#include <cstdlib>
-#include <cstring>
+#include "pga_marshal.h"
 
 namespace {
+using namespace pga_marshal;
 
-constexpr const char *kBridge = "libpga_tpu.capi_bridge";
+gene *bytes_to_genes(PyObject *out) { return bytes_to_floats(out); }
 
-struct Bridge {
-    PyObject *mod = nullptr;
-};
-
-Bridge &bridge() {
-    static Bridge b;
-    return b;
-}
-
-void print_py_error(const char *where) {
-    std::fprintf(stderr, "pga_tpu: python error in %s:\n", where);
-    PyErr_Print();
-}
-
-/* Initialize the embedded interpreter and import the bridge module. */
-bool ensure_python() {
-    if (bridge().mod) return true;
-    if (!Py_IsInitialized()) Py_InitializeEx(0);
-    PyObject *mod = PyImport_ImportModule(kBridge);
-    if (!mod) {
-        print_py_error("import libpga_tpu.capi_bridge "
-                       "(is the repo root on PYTHONPATH?)");
-        return false;
-    }
-    bridge().mod = mod;
-    return true;
-}
-
-/* Core marshaling: bridge.<name>(*args) with a Py_BuildValue format
- * string (always parenthesized at call sites, so the built value is a
- * tuple). Returns a new reference or nullptr (python error printed). */
-PyObject *call_va(const char *name, const char *fmt, va_list ap) {
-    if (!ensure_python()) return nullptr;
-    PyObject *callable = PyObject_GetAttrString(bridge().mod, name);
-    if (!callable) {
-        print_py_error(name);
-        return nullptr;
-    }
-    PyObject *args = Py_VaBuildValue(fmt, ap);
-    PyObject *out = args ? PyObject_CallObject(callable, args) : nullptr;
-    Py_XDECREF(args);
-    Py_DECREF(callable);
-    if (!out) print_py_error(name);
-    return out;
-}
-
-PyObject *call(const char *name, const char *fmt, ...) {
-    va_list ap;
-    va_start(ap, fmt);
-    PyObject *out = call_va(name, fmt, ap);
-    va_end(ap);
-    return out;
-}
-
-/* Integer-returning variant; -1 signals an error (None maps to 0). */
-long call_long(const char *name, const char *fmt, ...) {
-    va_list ap;
-    va_start(ap, fmt);
-    PyObject *out = call_va(name, fmt, ap);
-    va_end(ap);
-    if (!out) return -1;
-    long v = out == Py_None ? 0 : PyLong_AsLong(out);
-    if (PyErr_Occurred()) {
-        print_py_error(name);
-        v = -1;
-    }
-    Py_DECREF(out);
-    return v;
-}
-
-/* Convert a bytes result (float32 payload) into a malloc'd gene buffer. */
-gene *bytes_to_genes(PyObject *out) {
-    if (!out) return nullptr;
-    char *buf = nullptr;
-    Py_ssize_t len = 0;
-    if (PyBytes_AsStringAndSize(out, &buf, &len) != 0) {
-        print_py_error("bytes result");
-        Py_DECREF(out);
-        return nullptr;
-    }
-    gene *genes = static_cast<gene *>(std::malloc(len));
-    if (genes) std::memcpy(genes, buf, len);
-    Py_DECREF(out);
-    return genes;
-}
-
-/* Handle packing: pga_t* carries the solver handle; population_t* carries
- * (solver_handle << 16 | pop_index + 1) so both sides stay opaque,
- * pointer-shaped, and never collide with NULL. */
-inline pga_t *pack_solver(long h) {
-    return reinterpret_cast<pga_t *>(static_cast<intptr_t>(h));
-}
-inline long solver_of(pga_t *p) {
-    return static_cast<long>(reinterpret_cast<intptr_t>(p));
-}
-inline population_t *pack_pop(long solver, long index) {
-    return reinterpret_cast<population_t *>(
-        static_cast<intptr_t>((solver << 16) | (index + 1)));
-}
-inline long pop_index_of(population_t *pop) {
-    return (static_cast<long>(reinterpret_cast<intptr_t>(pop)) & 0xffff) - 1;
-}
-
+pga_t *pack(long h) { return pack_solver<pga_t *>(h); }
 }  // namespace
 
 extern "C" {
 
 pga_t *pga_init(long seed) {
     long h = call_long("init", "(l)", seed);
-    return h <= 0 ? nullptr : pack_solver(h);
+    return h <= 0 ? nullptr : pack(h);
 }
 
 void pga_deinit(pga_t *p) {
@@ -147,7 +47,8 @@ population_t *pga_create_population(pga_t *p, unsigned size,
     if (!p) return nullptr;
     long idx = call_long("create_population", "(lIIi)", solver_of(p), size,
                          genome_len, static_cast<int>(type));
-    return idx < 0 ? nullptr : pack_pop(solver_of(p), idx);
+    return idx < 0 ? nullptr
+                   : pack_pop<population_t *>(solver_of(p), idx);
 }
 
 int pga_set_objective_function(pga_t *p, obj_f f) {

@@ -74,8 +74,11 @@ int pga_set_crossover_function(pga_t *p, crossover_f f);
 int pga_set_objective_name(pga_t *p, const char *name);
 
 /* Result extraction (pga.h:90-93). Return malloc'd gene arrays (caller
- * frees), genome_len genes per row; NULL on error. The reference returns
- * NULL unconditionally for the _top/_all variants (pga.cu:238-248). */
+ * frees), genome_len genes per row; NULL on error — including a _top
+ * `length` larger than the (total) population, since the caller's buffer
+ * arithmetic assumes exactly length rows come back. The reference
+ * returns NULL unconditionally for the _top/_all variants
+ * (pga.cu:238-248). */
 gene *pga_get_best(pga_t *p, population_t *pop);
 gene *pga_get_best_top(pga_t *p, population_t *pop, unsigned length);
 gene *pga_get_best_all(pga_t *p);

@@ -1,0 +1,161 @@
+/* test_compat.c — full-surface smoke driver for the exact-reference ABI
+ * (capi/pga.h / libpga.so).
+ *
+ * Exercises every entry point of the compat header at least once, in the
+ * reference's calling style (void returns, gene** top-k), with all three
+ * callback kinds installed as plain host function pointers:
+ *
+ *   init → 4 populations → custom objective + mutate + crossover →
+ *   step-by-step evaluate/crossover/mutate/swap → fill_random_values →
+ *   run → run_islands → migrate → migrate_between →
+ *   get_best / get_best_top / get_best_all / get_best_top_all → deinit
+ *
+ * Problem: maximize the sum of 8 genes in [0,1) — optimum approaches 8.
+ */
+#include <pga.h>
+
+#include <stdio.h>
+#include <stdlib.h>
+
+#define GENOME_LEN 8
+#define POP_SIZE 32
+#define N_POPS 4
+
+static int checks_failed = 0;
+
+#define CHECK(cond, msg)                                       \
+    do {                                                       \
+        if (!(cond)) {                                         \
+            printf("FAIL: %s\n", msg);                         \
+            checks_failed++;                                   \
+        }                                                      \
+    } while (0)
+
+static float sum_obj(gene *g, unsigned len) {
+    float s = 0.0f;
+    unsigned i;
+    for (i = 0; i < len; ++i) s += g[i];
+    return s;
+}
+
+/* Write an out-of-band marker (genes are otherwise in [0,1)): the later
+ * "custom mutate applied" check can only pass if this ran. */
+static void my_mutate(gene *g, float *rand, unsigned len) {
+    (void)len;
+    g[0] = 2.0f + rand[2];
+}
+
+/* One-point crossover at a random cut. */
+static void my_crossover(gene *p1, gene *p2, gene *child, float *rand,
+                         unsigned len) {
+    unsigned cut = (unsigned)(rand[0] * len);
+    unsigned i;
+    for (i = 0; i < len; ++i) child[i] = i < cut ? p1[i] : p2[i];
+}
+
+int main() {
+    unsigned i;
+
+    pga_t *p = pga_init();
+    CHECK(p != NULL, "pga_init");
+
+    population_t *pops[N_POPS];
+    for (i = 0; i < N_POPS; ++i) {
+        pops[i] = pga_create_population(p, POP_SIZE, GENOME_LEN,
+                                        RANDOM_POPULATION);
+        CHECK(pops[i] != NULL, "pga_create_population");
+    }
+
+    pga_set_objective_function(p, sum_obj);
+    pga_set_mutate_function(p, my_mutate);
+    pga_set_crossover_function(p, my_crossover);
+
+    /* --- step-by-step generation, reference calling order ------------- */
+    pga_fill_random_values(p, pops[0]);
+    pga_evaluate(p, pops[0]);
+    pga_evaluate_all(p);
+    pga_crossover(p, pops[0], TOURNAMENT);
+    pga_mutate(p, pops[0]);
+    pga_swap_generations(p, pops[0]);
+    pga_crossover_all(p, TOURNAMENT);
+    pga_mutate_all(p);
+    pga_evaluate_all(p);
+
+    /* every individual of pops[0]'s current generation went through
+     * my_mutate exactly once (staged → mutated → swapped), so gene 0
+     * must carry the out-of-band marker. */
+    gene *after = pga_get_best(p, pops[0]);
+    CHECK(after != NULL, "pga_get_best after step ops");
+    CHECK(after[0] >= 2.0f, "custom mutate applied");
+    free(after);
+
+    /* oversized top-k must fail cleanly, not hand back short buffers */
+    CHECK(pga_get_best_top(p, pops[0], POP_SIZE + 1) == NULL,
+          "oversized top-k returns NULL");
+
+    /* --- restore default operators via NULL, then fused runs ---------- */
+    pga_set_mutate_function(p, NULL);
+    pga_set_crossover_function(p, NULL);
+
+    pga_run(p, 10);
+
+    gene *b0 = pga_get_best(p, pops[0]);
+    CHECK(b0 != NULL, "pga_get_best");
+    float best_run = sum_obj(b0, GENOME_LEN);
+    free(b0);
+    CHECK(best_run > 4.0f, "run improves over random (~4)");
+
+    pga_run_islands(p, 12, 4, 0.25f);
+    pga_migrate(p, 0.25f);
+    pga_migrate_between(p, pops[0], pops[1], 0.25f);
+    pga_evaluate_all(p);
+
+    /* migrate_between copies pops[0]'s best over pops[1]'s worst: the two
+     * populations must now share their best individual's score. */
+    gene *src_best = pga_get_best(p, pops[0]);
+    gene *dst_best = pga_get_best(p, pops[1]);
+    CHECK(src_best && dst_best, "get_best after migrate_between");
+    CHECK(sum_obj(dst_best, GENOME_LEN) >= sum_obj(src_best, GENOME_LEN) - 1e-5f,
+          "migrated elite visible in destination");
+    free(src_best);
+    free(dst_best);
+
+    /* --- top-k getters: reference gene** ownership contract ----------- */
+    gene **top = pga_get_best_top(p, pops[0], 3);
+    CHECK(top != NULL, "pga_get_best_top");
+    if (top) {
+        float prev = 1e30f;
+        for (i = 0; i < 3; ++i) {
+            float s = sum_obj(top[i], GENOME_LEN);
+            CHECK(s <= prev + 1e-5f, "top-k sorted best-first");
+            prev = s;
+            free(top[i]);
+        }
+        free(top);
+    }
+
+    gene *gall = pga_get_best_all(p);
+    CHECK(gall != NULL, "pga_get_best_all");
+    float global_best = gall ? sum_obj(gall, GENOME_LEN) : 0.0f;
+    free(gall);
+
+    gene **topall = pga_get_best_top_all(p, 5);
+    CHECK(topall != NULL, "pga_get_best_top_all");
+    if (topall) {
+        /* global top-1 must equal get_best_all's score */
+        CHECK(sum_obj(topall[0], GENOME_LEN) >= global_best - 1e-5f,
+              "top_all[0] is the global best");
+        for (i = 0; i < 5; ++i) free(topall[i]);
+        free(topall);
+    }
+
+    pga_deinit(p);
+
+    if (checks_failed) {
+        printf("compat ABI: %d checks FAILED\n", checks_failed);
+        return 1;
+    }
+    printf("compat best sum %.3f / %d\n", global_best, GENOME_LEN);
+    printf("PASS\n");
+    return 0;
+}

@@ -1,0 +1,116 @@
+/* test_islands.c — improved-ABI (pga_tpu.h) coverage of the entry points
+ * the other smoke drivers don't touch: the island run loop, both
+ * migration calls, the top-k getters, the step-by-step operator chain,
+ * and early-terminating pga_run — all on a builtin named objective so
+ * the whole GA stays on-device.
+ */
+#include "pga_tpu.h"
+
+#include <stdio.h>
+#include <stdlib.h>
+
+#define GENOME_LEN 16
+#define POP_SIZE 64
+#define N_POPS 4
+
+static int checks_failed = 0;
+
+#define CHECK(cond, msg)                                       \
+    do {                                                       \
+        if (!(cond)) {                                         \
+            printf("FAIL: %s\n", msg);                         \
+            checks_failed++;                                   \
+        }                                                      \
+    } while (0)
+
+static float sum_of(const gene *g, unsigned len) {
+    float s = 0.0f;
+    unsigned i;
+    for (i = 0; i < len; ++i) s += g[i];
+    return s;
+}
+
+int main() {
+    unsigned i;
+
+    pga_t *p = pga_init(42);
+    CHECK(p != NULL, "pga_init");
+
+    population_t *pops[N_POPS];
+    for (i = 0; i < N_POPS; ++i) {
+        pops[i] = pga_create_population(p, POP_SIZE, GENOME_LEN,
+                                        RANDOM_POPULATION);
+        CHECK(pops[i] != NULL, "pga_create_population");
+    }
+
+    CHECK(pga_set_objective_name(p, "onemax") == 0, "builtin objective");
+    CHECK(pga_set_objective_name(p, "no_such_objective") != 0,
+          "unknown objective rejected");
+    CHECK(pga_set_objective_name(p, "onemax") == 0, "re-set objective");
+
+    /* step-by-step operator chain */
+    CHECK(pga_fill_random_values(p, pops[0]) == 0, "fill_random_values");
+    CHECK(pga_evaluate(p, pops[0]) == 0, "evaluate");
+    CHECK(pga_evaluate_all(p) == 0, "evaluate_all");
+    CHECK(pga_crossover(p, pops[0], TOURNAMENT) == 0, "crossover");
+    CHECK(pga_mutate(p, pops[0]) == 0, "mutate");
+    CHECK(pga_swap_generations(p, pops[0]) == 0, "swap_generations");
+    CHECK(pga_crossover_all(p, TOURNAMENT) == 0, "crossover_all");
+    CHECK(pga_mutate_all(p) == 0, "mutate_all");
+    CHECK(pga_evaluate_all(p) == 0, "evaluate_all 2");
+
+    /* islands + migration */
+    int gens = pga_run_islands(p, 20, 5, 0.1f);
+    CHECK(gens == 20, "run_islands generation count");
+    CHECK(pga_migrate(p, 0.1f) == 0, "migrate");
+    CHECK(pga_migrate_between(p, pops[1], pops[2], 0.1f) == 0,
+          "migrate_between");
+    CHECK(pga_evaluate_all(p) == 0, "evaluate after migration");
+
+    /* top-k getters (flat rows, best first) */
+    gene *top = pga_get_best_top(p, pops[0], 4);
+    CHECK(top != NULL, "get_best_top");
+    if (top) {
+        float prev = 1e30f;
+        for (i = 0; i < 4; ++i) {
+            float s = sum_of(top + i * GENOME_LEN, GENOME_LEN);
+            CHECK(s <= prev + 1e-5f, "get_best_top sorted");
+            prev = s;
+        }
+        free(top);
+    }
+
+    gene *ball = pga_get_best_all(p);
+    CHECK(ball != NULL, "get_best_all");
+    float global_best = ball ? sum_of(ball, GENOME_LEN) : 0.0f;
+    free(ball);
+
+    gene *topall = pga_get_best_top_all(p, 6);
+    CHECK(topall != NULL, "get_best_top_all");
+    if (topall) {
+        CHECK(sum_of(topall, GENOME_LEN) >= global_best - 1e-5f,
+              "top_all row 0 is the global best");
+        free(topall);
+    }
+
+    /* early termination: a target pop 0 already meets must stop at 0
+     * generations (pga_run operates on the first population only) */
+    gene *b0 = pga_get_best(p, pops[0]);
+    CHECK(b0 != NULL, "get_best");
+    float b0_score = b0 ? sum_of(b0, GENOME_LEN) : 0.0f;
+    free(b0);
+    int done = pga_run(p, 100000, b0_score - 0.1f);
+    CHECK(done == 0, "target already met -> 0 generations");
+    int done2 = pga_run_n(p, 3);
+    CHECK(done2 == 3, "fixed-count run");
+
+    pga_deinit(p);
+
+    if (checks_failed) {
+        printf("islands ABI: %d checks FAILED\n", checks_failed);
+        return 1;
+    }
+    printf("islands best sum %.3f / %d\n", global_best, GENOME_LEN);
+    printf("PASS\n");
+    return 0;
+}

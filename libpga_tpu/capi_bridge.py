@@ -352,6 +352,12 @@ def get_best(handle: int, pop: int) -> bytes:
 
 def get_best_top(handle: int, pop: int, k: int) -> bytes:
     pga, h = _handle_pop(handle, pop)
+    # The Python engine clamps k to the population size; a C caller has
+    # no way to see the clamp and would read k rows out of a shorter
+    # buffer — make an oversized request an error (C side returns NULL).
+    size = pga.population(h).size
+    if k > size:
+        raise ValueError(f"top-k length {k} exceeds population size {size}")
     return np.ascontiguousarray(
         pga.get_best_top(h, k), dtype=np.float32
     ).tobytes()
@@ -364,8 +370,12 @@ def get_best_all(handle: int) -> bytes:
 
 
 def get_best_top_all(handle: int, k: int) -> bytes:
+    pga = _solver(handle)
+    total = sum(p.size for p in pga.populations)
+    if k > total:  # same C-caller buffer contract as get_best_top
+        raise ValueError(f"top-k length {k} exceeds total population {total}")
     return np.ascontiguousarray(
-        _solver(handle).get_best_top_all(k), dtype=np.float32
+        pga.get_best_top_all(k), dtype=np.float32
     ).tobytes()
 
 
