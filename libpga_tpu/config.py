@@ -43,8 +43,12 @@ class PGAConfig:
         tournament semantics.
       pallas_deme_size: rows per VMEM deme in the Pallas kernel. Honored
         when it is a power of two in [128, 1024] that divides the
-        population; otherwise the kernel picks the largest such divisor
-        itself, or the engine falls back to the XLA path when none exists.
+        population; other exact divisors are tried next, and remaining
+        populations of >= 128 rows are padded internally to a deme
+        multiple (pad rows are masked out of selection) using the size
+        that minimizes padding. The engine falls back to the XLA path
+        only for sub-tile populations (< 128) or when every padded fit
+        would leave a degenerate tail deme.
       donate_buffers: donate the genome buffer to jit so XLA updates it in
         place (the TPU-native replacement for the reference's
         current/next-generation pointer swap, ``pga.h:124-129``).

@@ -51,22 +51,53 @@ import jax.numpy as jnp
 LANE = 128
 
 
+def _valid_deme(k: int) -> bool:
+    """Power of two in [128, 1024]: K=128 is the smallest MXU-efficient
+    tile; above 1024 the one-hot matmul FLOPs dominate; tiny demes
+    collapse tournament-2 toward cloning and produce sub-tile shapes."""
+    return bool(k) and not (k & (k - 1)) and 128 <= k <= 1024
+
+
 def _pick_deme_size(pop_size: int, preferred: int):
-    """The preferred deme size if it divides the population, else the
-    largest power-of-two divisor in [128, 1024] (K=128 is the smallest
-    MXU-efficient tile; above 1024 the one-hot matmul FLOPs dominate).
-    None when nothing fits — the caller falls back to the XLA path."""
-    if (
-        preferred
-        and not (preferred & (preferred - 1))
-        and 128 <= preferred <= 1024  # same bound as the fallback search:
-        and pop_size % preferred == 0  # tiny demes collapse tournament-2
-    ):                                 # toward cloning + sub-tile shapes
+    """Deme size for a population: exact divisors first (zero padding),
+    then a padded fit — the kernel pads the population up to the next
+    deme multiple and masks the pad rows out of selection.
+
+    Padded fits must keep the short tail deme healthy: a tail of
+    ``tail = P - (G-1)K`` valid rows breeds K children from only
+    ``tail`` candidates, so tails under K/4 rows (degenerate case: a
+    single row cloning itself into ~1/G of the population with zero
+    fitness pressure) are rejected. Among healthy fits, wastes up to
+    12.5% of the population are treated as equivalent (per-deme
+    overheads outweigh small waste: K=128's minimal padding at 40,000
+    measured 27% slower than K=256's 192 pad rows) and the caller's
+    configured size, then the larger deme, is preferred; beyond that
+    the least-waste fit wins. None (→ XLA path) for populations under
+    one 128-row tile or with only degenerate-tail fits."""
+    if _valid_deme(preferred) and pop_size % preferred == 0:
         return preferred
     for k in (1024, 512, 256, 128):
         if pop_size % k == 0:
             return k
-    return None
+    if pop_size < 128:
+        return None
+    best = None
+    for k in (1024, 512, 256, 128):
+        if k > pop_size:
+            continue
+        g = -(-pop_size // k)
+        tail = pop_size - (g - 1) * k
+        if tail < max(k // 4, 2):
+            continue
+        waste = g * k - pop_size
+        rank = (
+            waste if waste > pop_size // 8 else 0,
+            0 if k == preferred else 1,
+            -k,
+        )
+        if best is None or rank < best[0]:
+            best = (rank, k)
+    return best[1] if best else None
 
 
 def _supported() -> bool:
@@ -90,6 +121,7 @@ def _breed_kernel(
     rate,
     obj=None,
     bf16_genes=False,
+    P=None,
 ):
     """One deme: select parents, crossover, mutate — and, when ``obj`` is
     given, evaluate the children in-kernel (skipping a whole extra HBM
@@ -107,9 +139,21 @@ def _breed_kernel(
     s3 = scores_ref[:]   # (1, 1, K) f32
     g = genomes_ref[:]   # (K, Lp) f32
 
-    # ---- tournament-2 ×2: four candidate index vectors in [0, K) --------
+    # ---- tournament-2 ×2: four candidate index vectors over valid rows -
     idx_bits = pltpu.bitcast(pltpu.prng_random_bits((4, K)), jnp.uint32)
-    idx = pltpu.bitcast(idx_bits & jnp.uint32(K - 1), jnp.int32)  # K = 2^m
+    if P is None or P % K == 0:
+        # exact-divisor population: K = 2^m, mask the bits directly
+        idx = pltpu.bitcast(idx_bits & jnp.uint32(K - 1), jnp.int32)
+    else:
+        # padded population: the last deme holds V = P - i*K < K real
+        # rows (pads beyond them). Sample idx = floor(u * V) so a pad row
+        # can never enter a tournament — the masked-score route would
+        # still clone pad genomes when both candidates land on pads.
+        V = jnp.maximum(jnp.minimum(jnp.int32(K), jnp.int32(P) - i * K), 1)
+        u4 = pltpu.bitcast(idx_bits >> 8, jnp.int32).astype(
+            jnp.float32
+        ) * jnp.float32(2**-24)
+        idx = jnp.minimum((u4 * V.astype(jnp.float32)).astype(jnp.int32), V - 1)
 
     cand = lax.broadcasted_iota(jnp.int32, (4, K, K), 2) == idx[:, :, None]
     oh = cand.astype(jnp.bfloat16)  # (4, K, K) one-hots, child-major
@@ -211,8 +255,12 @@ def make_pallas_breed(
     next_scores)`` with evaluation done inside the kernel. ``gene_dtype``
     bfloat16 selects parents with a single exact bf16 matmul (half the
     FLOPs/traffic of the f32 hi/lo path) at bf16 gene resolution.
-    Returns None when unsupported (population not divisible into
-    power-of-two demes, or an unsupported dtype)."""
+    Populations that no deme size divides exactly are padded internally
+    to the next deme multiple: pad rows are excluded from tournaments
+    in-kernel (see ``_breed_kernel``) and tail children carry -inf fused
+    scores, so the padded rows are inert — the caller still sees exactly
+    ``(P, L)``. Returns None when unsupported (population under one deme
+    tile, or an unsupported dtype)."""
     if not _supported():
         return None
     if gene_dtype not in (jnp.float32, jnp.bfloat16):
@@ -222,7 +270,8 @@ def make_pallas_breed(
     K = _pick_deme_size(P, deme_size)
     if K is None:
         return None
-    G = P // K
+    G = math.ceil(P / K)
+    Pp = G * K  # padded row count; == P for exact-divisor populations
     Lp = math.ceil(L / LANE) * LANE
 
     from jax.experimental import pallas as pl
@@ -236,6 +285,7 @@ def make_pallas_breed(
         rate=mutation_rate,
         obj=fused_obj,
         bf16_genes=bf16_genes,
+        P=P,
     )
 
     out_specs = [pl.BlockSpec((K, 1, 1, Lp), lambda i: (0, i, 0, 0))]
@@ -257,8 +307,10 @@ def make_pallas_breed(
     )
 
     def breed_padded(gp: jax.Array, scores: jax.Array, key: jax.Array):
-        """(P, Lp)-padded variant for loops that keep the pad resident.
-        Returns genomes (P, Lp), or (genomes, scores (P,)) when fused."""
+        """(Pp, Lp)-padded variant for loops that keep the pad resident.
+        Takes/returns genomes (Pp, Lp) and scores (Pp,); when fused, tail
+        child scores (rows >= P) come back masked to -inf so loop
+        reductions and target checks never see a discarded child."""
         seed = jax.random.randint(
             key, (1, 1), jnp.iinfo(jnp.int32).min, jnp.iinfo(jnp.int32).max,
             dtype=jnp.int32,
@@ -268,24 +320,29 @@ def make_pallas_breed(
             genomes, child_scores = out
             # Genome row order after reshape is (child r)·G + (deme i);
             # kernel scores come out deme-major (G, K) — transpose to match.
-            return (
-                genomes.reshape(P, Lp),
-                child_scores.reshape(G, K).T.reshape(P),
-            )
-        return out.reshape(P, Lp)
+            s2 = child_scores.reshape(G, K).T.reshape(Pp)
+            if Pp != P:
+                s2 = jnp.where(
+                    jnp.arange(Pp, dtype=jnp.int32) < P, s2, -jnp.inf
+                )
+            return genomes.reshape(Pp, Lp), s2
+        return out.reshape(Pp, Lp)
 
     def breed(genomes: jax.Array, scores: jax.Array, key: jax.Array):
         gp = genomes.astype(gene_dtype)
-        if Lp != L:
-            gp = jnp.pad(gp, ((0, 0), (0, Lp - L)))
+        if Lp != L or Pp != P:
+            gp = jnp.pad(gp, ((0, Pp - P), (0, Lp - L)))
+        if Pp != P:
+            scores = jnp.pad(scores, (0, Pp - P), constant_values=-jnp.inf)
         out = breed_padded(gp, scores, key)
         if fused_obj is not None:
             g2, s2 = out
-            return (g2[:, :L] if Lp != L else g2), s2
-        return out[:, :L] if Lp != L else out
+            return g2[:P, :L], s2[:P]
+        return out[:P, :L]
 
     breed.padded = breed_padded
     breed.Lp = Lp
+    breed.Pp = Pp
     breed.fused = fused_obj is not None
     breed.gene_dtype = gene_dtype
     return breed
@@ -335,16 +392,25 @@ def make_pallas_run(
         if breed is None:
             return None
 
-        L, Lp = genome_len, breed.Lp
+        P, L, Pp, Lp = pop_size, genome_len, breed.Pp, breed.Lp
+
+        def masked_tail(s):
+            """Scores for pad rows pinned to -inf: they must never win the
+            target check or surface from the final population."""
+            if Pp == P:
+                return s
+            return jnp.where(jnp.arange(Pp, dtype=jnp.int32) < P, s, -jnp.inf)
 
         def run_loop(genomes, key, n, target):
-            # Pad once; the loop carries the lane-aligned (P, Lp) matrix.
-            # Evaluation reads the [:, :L] view (the slice fuses into the
+            # Pad once; the loop carries the deme-aligned (Pp, Lp) matrix.
+            # Evaluation reads the [:P, :L] view (the slice fuses into the
             # objective's reduction — nothing materializes).
             gp = genomes.astype(gene_dtype)
-            if Lp != L:
-                gp = jnp.pad(gp, ((0, 0), (0, Lp - L)))
-            scores0 = _evaluate(obj, gp[:, :L])
+            if Lp != L or Pp != P:
+                gp = jnp.pad(gp, ((0, Pp - P), (0, Lp - L)))
+            scores0 = masked_tail(
+                jnp.pad(_evaluate(obj, gp[:P, :L]), (0, Pp - P))
+            )
 
             def cond(carry):
                 g, s, k, gen = carry
@@ -354,15 +420,17 @@ def make_pallas_run(
                 g, s, k, gen = carry
                 k, sub = jax.random.split(k)
                 if breed.fused:
-                    g2, s2 = breed.padded(g, s, sub)
+                    g2, s2 = breed.padded(g, s, sub)  # tail already -inf
                 else:
                     g2 = breed.padded(g, s, sub)
-                    s2 = _evaluate(obj, g2[:, :L])
+                    s2 = masked_tail(jnp.pad(
+                        _evaluate(obj, g2[:P, :L]), (0, Pp - P)
+                    ))
                 return (g2, s2, k, gen + 1)
 
             init = (gp, scores0, key, jnp.int32(0))
             g, s, k, gens = jax.lax.while_loop(cond, body, init)
-            return g[:, :L] if Lp != L else g, s, gens
+            return g[:P, :L], s[:P], gens
 
         return jax.jit(run_loop, donate_argnums=(0,) if donate else ())
 

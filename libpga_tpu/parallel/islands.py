@@ -43,27 +43,29 @@ def make_island_epoch(breed: Callable, obj: Callable, m: int) -> Callable:
     A breed carrying ``fused=True`` (the Pallas path built with a
     ``fused_obj`` — see :func:`libpga_tpu.ops.pallas_step.make_pallas_breed`)
     supplies the next scores itself and the separate evaluation is
-    skipped. For lane-unaligned genome lengths the epoch pads once at
-    entry, scans over the breed's padded variant, and slices once at exit
-    — not once per generation."""
+    skipped. For lane-unaligned genome lengths or deme-padded island
+    sizes the epoch pads once at entry, scans over the breed's padded
+    variant (pad rows carry -inf scores and are inert — see
+    ``make_pallas_breed``), and slices once at exit — not once per
+    generation."""
     fused = getattr(breed, "fused", False)
     padded_fn = getattr(breed, "padded", None)
     Lp = getattr(breed, "Lp", None)
+    Pp = getattr(breed, "Pp", None)
     gdtype = getattr(breed, "gene_dtype", None)
 
     def epoch(genomes, scores, key):
-        L = genomes.shape[1]
-        pad = padded_fn is not None and Lp is not None and Lp != L
+        S, L = genomes.shape
+        pad = padded_fn is not None and (
+            (Lp is not None and Lp != L) or (Pp is not None and Pp != S)
+        )
         # Cast to the breed's gene dtype (bf16 mode outputs bf16; a f32
         # carry would fail the scan's carry-dtype check).
-        g0 = (
-            jnp.pad(
-                genomes.astype(gdtype or genomes.dtype),
-                ((0, 0), (0, Lp - L)),
-            )
-            if pad
-            else genomes
-        )
+        g0 = genomes.astype(gdtype or genomes.dtype)
+        s0 = scores
+        if pad:
+            g0 = jnp.pad(g0, ((0, Pp - S), (0, Lp - L)))
+            s0 = jnp.pad(scores, (0, Pp - S), constant_values=-jnp.inf)
 
         def body(carry, _):
             g, s, k = carry
@@ -73,14 +75,17 @@ def make_island_epoch(breed: Callable, obj: Callable, m: int) -> Callable:
                 g2, s2 = step(g, s, sub)
             else:
                 g2 = step(g, s, sub)
-                s2 = _evaluate(obj, g2[:, :L] if pad else g2)
+                s2 = _evaluate(obj, g2[:S, :L] if pad else g2)
+                if pad:
+                    s2 = jnp.pad(s2, (0, Pp - S), constant_values=-jnp.inf)
             return (g2, s2, k), None
 
         (genomes, scores, key), _ = jax.lax.scan(
-            body, (g0, scores, key), None, length=m
+            body, (g0, s0, key), None, length=m
         )
         if pad:
-            genomes = genomes[:, :L]
+            genomes = genomes[:S, :L]
+            scores = scores[:S]
         return genomes, scores, key
 
     return epoch

@@ -27,20 +27,33 @@ def _interpret():
 
 
 def test_unsupported_shapes_return_none():
-    # no power-of-two deme in [128, 1024] divides 1000
-    assert make_pallas_breed(1000, 10, deme_size=256) is None
+    # sub-tile populations (under one 128-row deme) stay on the XLA path
+    assert make_pallas_breed(100, 10, deme_size=256) is None
+    # anything >= 128 is served, via internal padding when necessary
+    breed = make_pallas_breed(1000, 10, deme_size=256)
+    assert breed is not None and breed.Pp == 1024
 
 
 def test_deme_size_auto_fallback():
     """An undivisible or invalid preferred deme size falls back to a
-    power-of-two divisor instead of abandoning the fast path."""
+    power-of-two divisor (zero padding) or, failing that, to padding the
+    population up to a deme multiple."""
     from libpga_tpu.ops.pallas_step import _pick_deme_size
 
     assert _pick_deme_size(1 << 20, 256) == 256
     assert _pick_deme_size(1 << 20, 96) == 1024  # invalid preferred -> largest
     assert _pick_deme_size(40_960, 256) == 256
-    assert _pick_deme_size(128 * 3, 256) == 128  # only 128 divides
-    assert _pick_deme_size(1000, 256) is None
+    assert _pick_deme_size(128 * 3, 256) == 128  # only 128 divides exactly
+    assert _pick_deme_size(1000, 256) == 256  # padded to 1024 (tie -> preferred)
+    assert _pick_deme_size(40_000, 256) == 256  # 192 pad rows: negligible
+    # egregious padding loses to a lean fit: 1100 at K=256 wastes 16%
+    # (180/1100) vs 4.7% at K=128
+    assert _pick_deme_size(1100, 256) == 128
+    assert _pick_deme_size(100, 256) is None  # sub-tile
+    # degenerate tails are rejected, not served: 1025 = 4*256 + 1 would
+    # breed 256 clones of the tail's single row every generation
+    assert _pick_deme_size(1025, 256) is None
+    assert make_pallas_breed(1025, 10, deme_size=256) is None
     # power-of-two but out-of-range preferred sizes are clamped to the
     # documented [128, 1024] band, not accepted verbatim (tiny demes
     # collapse tournament-2 toward cloning; advisor round-1 finding)
@@ -134,6 +147,82 @@ def test_engine_falls_back_when_pallas_unavailable():
     pga.run(3)
     best = pga.get_best(pop)
     assert best.shape == (8,)
+
+
+def test_kernel_padded_population_structure():
+    """A population with no power-of-two deme divisor (here 300 = 128·2 +
+    44) pads internally to G·K rows; with zero PRNG bits each child is
+    deme-row-0, exactly as in the unpadded case, and only P rows come
+    back."""
+    P, L, K = 300, 12, 128
+    with _interpret():
+        breed = make_pallas_breed(P, L, deme_size=K, mutation_rate=0.0)
+        assert breed is not None
+        G = breed.Pp // K
+        assert breed.Pp == 384 and G == 3
+        genomes = (
+            jnp.broadcast_to(jnp.arange(P, dtype=jnp.float32)[:, None], (P, L))
+            / P
+        )
+        scores = jnp.zeros((P,), jnp.float32)
+        out = np.asarray(breed(genomes, scores, jax.random.key(0)))
+    assert out.shape == (P, L)
+    expect = np.asarray([((r % G) * K) / P for r in range(P)], np.float32)
+    # atol: gene values ride the bf16 hi/lo one-hot matmul (~1e-5 bound);
+    # unlike the unpadded structure test, these genes are not dyadic.
+    np.testing.assert_allclose(
+        out, np.broadcast_to(expect[:, None], (P, L)), atol=2e-5, rtol=0
+    )
+
+
+def test_kernel_padded_fused_scores_inert_tail():
+    """Fused evaluation on a padded population: returned scores match the
+    returned genomes row-for-row, and the run loop contract (tail masked
+    to -inf) holds for the padded variant."""
+    from libpga_tpu.objectives import onemax
+
+    P, L, K = 300, 12, 128
+    with _interpret():
+        breed = make_pallas_breed(
+            P, L, deme_size=K, mutation_rate=0.0,
+            fused_obj=onemax.kernel_rowwise,
+        )
+        genomes = jax.random.uniform(jax.random.key(2), (P, L))
+        scores = jnp.zeros((P,), jnp.float32)
+        g2, s2 = breed(genomes, scores, jax.random.key(0))
+        # padded variant: feed (Pp, Lp)/(Pp,) directly, check the tail
+        Pp, Lp = breed.Pp, breed.Lp
+        gp = jnp.pad(genomes, ((0, Pp - P), (0, Lp - L)))
+        sp = jnp.pad(scores, (0, Pp - P), constant_values=-jnp.inf)
+        gp2, sp2 = breed.padded(gp, sp, jax.random.key(0))
+    g2, s2 = np.asarray(g2), np.asarray(s2)
+    assert g2.shape == (P, L) and s2.shape == (P,)
+    np.testing.assert_allclose(s2, g2.sum(axis=1), atol=1e-4, rtol=0)
+    sp2 = np.asarray(sp2)
+    assert np.all(np.isneginf(sp2[P:])), "pad-row scores must be -inf"
+    np.testing.assert_allclose(sp2[:P], s2, atol=1e-6, rtol=0)
+
+
+def test_padded_population_through_island_runner():
+    """Island sizes with no deme divisor run through the island epoch's
+    padded path with carried scores consistent with carried genomes."""
+    from libpga_tpu.objectives import onemax
+    from libpga_tpu.parallel.islands import run_islands_stacked
+
+    I, S, L, K = 2, 300, 12, 128
+    with _interpret():
+        breed = make_pallas_breed(
+            S, L, deme_size=K, mutation_rate=0.0,
+            fused_obj=onemax.kernel_rowwise,
+        )
+        stacked = jax.random.uniform(jax.random.key(0), (I, S, L))
+        genomes, scores, gens = run_islands_stacked(
+            breed, onemax, stacked, jax.random.key(1), n=4, m=2, pct=0.05
+        )
+    genomes, scores = np.asarray(genomes), np.asarray(scores)
+    assert gens == 4
+    assert genomes.shape == (I, S, L) and scores.shape == (I, S)
+    np.testing.assert_allclose(scores, genomes.sum(axis=2), atol=2e-4, rtol=0)
 
 
 def test_fused_evaluation_scores_match_genome_order():

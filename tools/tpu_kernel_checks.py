@@ -79,6 +79,29 @@ def main() -> int:
         abs(pos.mean() - (L - 1) / 2) < 2.0,
     )
 
+    # Padded population (no deme divides 3000): with real entropy, every
+    # child must still descend from VALID rows only — the last deme holds
+    # 3000 - 11*256 = 184 real rows and 72 pads the tournament sampler
+    # must never pick.
+    Pq = 3000
+    breedp = make_pallas_breed(Pq, L, deme_size=K, mutation_rate=0.0)
+    Gp = breedp.Pp // K
+    genomesq = (
+        jnp.broadcast_to(jnp.arange(Pq, dtype=jnp.float32)[:, None], (Pq, L))
+        / 4096.0  # /4096 keeps genes bf16-hi/lo-exact like the main check
+    )
+    outq = np.asarray(
+        breedp(genomesq, jax.random.uniform(jax.random.key(4), (Pq,)),
+               jax.random.key(5))
+    )
+    pad_ok = True
+    for r in range(Pq):
+        ids = np.unique(np.round(outq[r] * 4096).astype(int))
+        d = r % Gp
+        lo, hi = d * K, min((d + 1) * K, Pq)
+        pad_ok &= len(ids) <= 2 and all(lo <= p < hi for p in ids)
+    good &= check("padded population: pad rows never selected", pad_ok)
+
     from libpga_tpu import PGA, PGAConfig
 
     pga = PGA(seed=7, config=PGAConfig(use_pallas=True))
