@@ -5,11 +5,22 @@ target: the reference runs pop 40,000 × 100 genes × 100 generations
 (``/root/reference/test/test.cu:37,43,22``) as ~79 chunked kernel launches ×
 3 operators × 100 generations, each followed by a full device sync
 (``/root/reference/src/pga.cu:62-77,269``). Here the same GA — tournament-2
-selection, uniform crossover, 0.01 point mutation — runs as ONE jitted XLA
+selection, uniform crossover, 0.01 point mutation — runs as ONE jitted
 program per whole run at pop 1,048,576 × 100.
 
-Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": "generations/sec", "vs_baseline": N}
+Prints exactly one JSON line. Headline fields:
+  metric/value/unit/vs_baseline — f32 gens/sec vs the reference's analytic
+    launch-bound floor (see below);
+  ms_per_gen, achieved_tflops, mfu — chip-relative figures so progress is
+    measured against the hardware, not only against the reference's worst
+    property (the kernel's dominant cost is the one-hot parent-selection
+    matmuls: 2·K²·Lp FLOPs per (K,K)@(K,Lp) matmul, 4 matmuls/deme for
+    f32 hi/lo genes, 2 for bf16 → P·K·Lp·8 (f32) or ·4 (bf16)
+    FLOPs/generation);
+  bf16_* — the bfloat16 gene mode (single exact selection matmul, half
+    the FLOPs; genes at bf16 resolution);
+  islands_* — 8-island × 131,072 OneMax with ring migration every 10
+    generations, the BASELINE.json island config on one chip.
 
 ``vs_baseline`` is measured against an analytic model of the reference on a
 modern datacenter GPU (see BASELINE.md — the reference publishes no numbers,
@@ -17,6 +28,14 @@ so the baseline is its launch-bound execution model: ceil(pop/512) serialized
 launches × 3 operators × ~3.5 µs launch+sync overhead per generation), i.e.
 values > 1 mean faster than the reference's architecture could possibly go
 regardless of its per-thread compute speed.
+
+Timing: the tunneled bench chip memoizes identical executions and varies
+~±15% between process states, so every figure is a two-length
+subtraction — (min over tries of time(150 gens)) − (min over tries of
+time(50 gens)), divided by 100. Warm-up, compile, and dispatch overheads
+cancel in the difference, and taking the per-length minima FIRST keeps
+the estimator bounded by true hardware speed (a max over per-try deltas
+would instead select the try where noise shrank the difference).
 """
 
 from __future__ import annotations
@@ -28,8 +47,7 @@ import time
 
 POP = 1 << 20  # 1,048,576
 GENOME_LEN = 100
-WARMUP_GENS = 10
-BENCH_GENS = 200
+V5E_BF16_PEAK = 197e12  # TPU v5e: 197 TFLOP/s bf16 per chip
 
 
 def reference_floor_seconds_per_gen() -> float:
@@ -46,35 +64,100 @@ def reference_floor_seconds_per_gen() -> float:
     return launches_per_op * 3 * 3.5e-6
 
 
-def main() -> None:
+def _best_gps(run, lo: int = 50, hi: int = 150, tries: int = 3) -> float:
+    """Generations/sec via two-length subtraction of per-length minima.
+
+    min(t_hi) − min(t_lo) across tries: each minimum is the least-noisy
+    observation of that length, so the difference cannot be shrunk below
+    the true hardware time by a single lucky/unlucky pairing (the failure
+    mode of max-over-deltas). Raises when the subtraction is degenerate
+    rather than publishing a fabricated figure.
+    """
+    t_lo, t_hi = [], []
+    for _ in range(tries):
+        t0 = time.perf_counter()
+        run(lo)
+        t_lo.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        run(hi)
+        t_hi.append(time.perf_counter() - t0)
+    delta = min(t_hi) - min(t_lo)
+    if delta <= 0:
+        raise RuntimeError(
+            f"degenerate timing: min t({hi})={min(t_hi):.4f}s <= "
+            f"min t({lo})={min(t_lo):.4f}s — refusing to report"
+        )
+    return (hi - lo) / delta
+
+
+def bench_single(gene_dtype) -> dict:
+    """One-population 1M×100 OneMax at the given gene dtype."""
+    import jax.numpy as jnp
+
     from libpga_tpu import PGA, PGAConfig
 
-    pga = PGA(seed=42, config=PGAConfig(use_pallas=True))
+    pga = PGA(seed=42, config=PGAConfig(use_pallas=True, gene_dtype=gene_dtype))
     pga.create_population(POP, GENOME_LEN)
     pga.set_objective("onemax")
-
-    pga.run(WARMUP_GENS)  # compile + warm caches
-    # Best-of-3: the tunneled chip's throughput varies ~±15% between
-    # process states; the max is the stable hardware-limited figure.
-    # pga.run() itself blocks on device completion (it fetches the
-    # executed-generation count), so the timed region is fully synchronous.
-    gps = 0.0
-    for _ in range(3):
-        t0 = time.perf_counter()
-        gens = pga.run(BENCH_GENS)
-        dt = time.perf_counter() - t0
-        gps = max(gps, gens / dt)
-    baseline_gps = 1.0 / reference_floor_seconds_per_gen()
-    print(
-        json.dumps(
-            {
-                "metric": "onemax_1M_generations_per_sec",
-                "value": round(gps, 2),
-                "unit": "generations/sec",
-                "vs_baseline": round(gps / baseline_gps, 2),
-            }
+    if not pga._pallas_gate():
+        raise RuntimeError(
+            "Pallas fast path not engaged (non-TPU backend?) — the FLOPs "
+            "model below describes matmuls that would never execute"
         )
-    )
+    pga.run(5)  # compile + warm caches
+    gps = _best_gps(lambda n: pga.run(n))
+
+    from libpga_tpu.ops.pallas_step import _pick_deme_size, auto_deme_size
+
+    K = _pick_deme_size(POP, auto_deme_size(gene_dtype))
+    Lp = math.ceil(GENOME_LEN / 128) * 128
+    matmuls = 2 if gene_dtype == jnp.bfloat16 else 4
+    flops_per_gen = POP * K * Lp * 2 * matmuls
+    achieved = gps * flops_per_gen
+    return {
+        "gens_per_sec": round(gps, 2),
+        "ms_per_gen": round(1000.0 / gps, 3) if gps else None,
+        "achieved_tflops": round(achieved / 1e12, 2),
+        "mfu": round(achieved / V5E_BF16_PEAK, 4),
+    }
+
+
+def bench_islands() -> dict:
+    """8 islands × 131,072 × 100, ring migration of the top 5% every 10
+    generations (BASELINE.json island config), vmapped on one chip."""
+    from libpga_tpu import PGA, PGAConfig
+
+    pga = PGA(seed=7, config=PGAConfig(use_pallas=True))
+    for _ in range(8):
+        pga.create_population(131_072, GENOME_LEN)
+    pga.set_objective("onemax")
+    pga.run_islands(10, 10, 0.05)  # compile
+    gps = _best_gps(lambda n: pga.run_islands(n, 10, 0.05), lo=50, hi=150)
+    return {"islands_8x128k_gens_per_sec": round(gps, 2)}
+
+
+def main() -> None:
+    import jax.numpy as jnp
+
+    f32 = bench_single(jnp.float32)
+    bf16 = bench_single(jnp.bfloat16)
+    isl = bench_islands()
+
+    baseline_gps = 1.0 / reference_floor_seconds_per_gen()
+    out = {
+        "metric": "onemax_1M_generations_per_sec",
+        "value": f32["gens_per_sec"],
+        "unit": "generations/sec",
+        "vs_baseline": round(f32["gens_per_sec"] / baseline_gps, 2),
+        "ms_per_gen": f32["ms_per_gen"],
+        "achieved_tflops": f32["achieved_tflops"],
+        "mfu": f32["mfu"],
+        "bf16_gens_per_sec": bf16["gens_per_sec"],
+        "bf16_achieved_tflops": bf16["achieved_tflops"],
+        "bf16_mfu": bf16["mfu"],
+    }
+    out.update(isl)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

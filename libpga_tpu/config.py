@@ -41,14 +41,17 @@ class PGAConfig:
         kernel's selection is tournament-2 within per-generation shuffled
         demes (see ``ops/pallas_step.py``); set False for exact panmictic
         tournament semantics.
-      pallas_deme_size: rows per VMEM deme in the Pallas kernel. Honored
-        when it is a power of two in [128, 1024] that divides the
-        population; other exact divisors are tried next, and remaining
-        populations of >= 128 rows are padded internally to a deme
-        multiple (pad rows are masked out of selection) using the size
-        that minimizes padding. The engine falls back to the XLA path
-        only for sub-tile populations (< 128) or when every padded fit
-        would leave a degenerate tail deme.
+      pallas_deme_size: rows per VMEM deme in the Pallas kernel. None
+        (default) auto-selects the measured sweet spot for the gene
+        dtype (256 for float32, 512 for bfloat16 — the bf16 selection
+        matmul is cheap enough that larger demes win). An explicit size
+        is honored when it is a power of two in [128, 1024] that
+        divides the population; other exact divisors are tried next,
+        and remaining populations of >= 128 rows are padded internally
+        to a deme multiple (pad rows are masked out of selection) using
+        the size that minimizes padding. The engine falls back to the
+        XLA path only for sub-tile populations (< 128) or when every
+        padded fit would leave a degenerate tail deme.
       donate_buffers: donate the genome buffer to jit so XLA updates it in
         place (the TPU-native replacement for the reference's
         current/next-generation pointer swap, ``pga.h:124-129``).
@@ -64,7 +67,7 @@ class PGAConfig:
     max_populations: Optional[int] = None
     migration_topology: str = "ring"
     use_pallas: Optional[bool] = None
-    pallas_deme_size: int = 256
+    pallas_deme_size: Optional[int] = None
     donate_buffers: bool = True
     seed: Optional[int] = None
 

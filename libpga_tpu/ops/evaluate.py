@@ -25,5 +25,11 @@ def evaluate(obj: Callable[[jax.Array], jax.Array], genomes: jax.Array) -> jax.A
     Returns:
       ``(pop,)`` float32 scores.
     """
+    if genomes.dtype in (jnp.bfloat16, jnp.float16):
+        # Score low-precision genes in f32 arithmetic: a bf16 reduction
+        # loses ~0.25 absolute resolution at sums near 100, collapsing
+        # late-run selection pressure. This matches the fused kernel
+        # path, which upcasts the stored bf16 child before scoring.
+        genomes = genomes.astype(jnp.float32)
     scores = jax.vmap(obj)(genomes)
     return scores.astype(jnp.float32)

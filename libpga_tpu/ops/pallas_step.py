@@ -100,6 +100,13 @@ def _pick_deme_size(pop_size: int, preferred: int):
     return best[1] if best else None
 
 
+def auto_deme_size(gene_dtype) -> int:
+    """Measured per-dtype deme sweet spot at 1M×100 (see BASELINE.md):
+    bf16's single selection matmul makes the larger deme worthwhile.
+    Single source of truth — bench.py derives its FLOPs model from this."""
+    return 512 if gene_dtype == jnp.bfloat16 else 256
+
+
 def _supported() -> bool:
     try:
         from jax.experimental import pallas as pl  # noqa: F401
@@ -155,12 +162,9 @@ def _breed_kernel(
         ) * jnp.float32(2**-24)
         idx = jnp.minimum((u4 * V.astype(jnp.float32)).astype(jnp.int32), V - 1)
 
-    cand = lax.broadcasted_iota(jnp.int32, (4, K, K), 2) == idx[:, :, None]
-    oh = cand.astype(jnp.bfloat16)  # (4, K, K) one-hots, child-major
-
     # Candidate scores: masked f32 reduce on the VPU — exact (no rounding
-    # of scores). A second, source-major iota-compare (axis 1 = source row
-    # = sublanes) makes the reduction run over sublanes, which the VPU
+    # of scores). The source-major iota-compare (axis 1 = source row =
+    # sublanes) makes the reduction run over sublanes, which the VPU
     # does ~2× faster than a lane reduction (measured 10.2 → 8.3 ms/gen
     # at 1M×100).
     cand_src = lax.broadcasted_iota(jnp.int32, (4, K, K), 1) == idx[:, None, :]
@@ -168,12 +172,20 @@ def _breed_kernel(
     sc_t = sc.T  # (K, 4) — f32 transpose is supported
 
     # Tie -> first candidate, matching the reference's strict '>'
-    # (pga.cu:286). Comparisons are built as (K, 1) so they broadcast over
-    # the (K, K) selectors without any bool reshape.
+    # (pga.cu:286). Winner INDICES are resolved first and only the two
+    # winning one-hots are materialized. The alternative — build all
+    # four candidate one-hots and where-select between them — costs two
+    # extra (K, K) mask builds and two (K, K) bf16 selects per deme and
+    # measured ~30% of the whole generation (89 → 126 gens/sec at
+    # 1M×100 f32 K=256; 99 → 147 at K=512 bf16).
     w1 = sc_t[:, 0:1] >= sc_t[:, 1:2]  # (K, 1) bool
     w2 = sc_t[:, 2:3] >= sc_t[:, 3:4]
-    oh1 = jnp.where(w1, oh[0], oh[1])  # (K, K) winner selectors
-    oh2 = jnp.where(w2, oh[2], oh[3])
+    idx_t = idx.T  # (K, 4) i32 transpose is supported
+    widx1 = jnp.where(w1, idx_t[:, 0:1], idx_t[:, 1:2])  # (K, 1)
+    widx2 = jnp.where(w2, idx_t[:, 2:3], idx_t[:, 3:4])
+    src_cols = lax.broadcasted_iota(jnp.int32, (K, K), 1)
+    oh1 = (src_cols == widx1).astype(jnp.bfloat16)  # (K, K) winner selectors
+    oh2 = (src_cols == widx2).astype(jnp.bfloat16)
 
     # ---- parent rows via one-hot matmul -------------------------------
     if bf16_genes:
@@ -245,7 +257,7 @@ def make_pallas_breed(
     pop_size: int,
     genome_len: int,
     *,
-    deme_size: int = 256,
+    deme_size: Optional[int] = None,
     mutation_rate: float = 0.01,
     fused_obj: Optional[Callable] = None,
     gene_dtype=jnp.float32,
@@ -266,6 +278,8 @@ def make_pallas_breed(
     if gene_dtype not in (jnp.float32, jnp.bfloat16):
         return None
     bf16_genes = gene_dtype == jnp.bfloat16
+    if not deme_size:
+        deme_size = auto_deme_size(gene_dtype)
     P, L = pop_size, genome_len
     K = _pick_deme_size(P, deme_size)
     if K is None:
@@ -343,6 +357,7 @@ def make_pallas_breed(
     breed.padded = breed_padded
     breed.Lp = Lp
     breed.Pp = Pp
+    breed.K = K
     breed.fused = fused_obj is not None
     breed.gene_dtype = gene_dtype
     return breed
@@ -353,7 +368,7 @@ def make_pallas_run(
     *,
     tournament_size: int = 2,
     mutation_rate: float = 0.01,
-    deme_size: int = 256,
+    deme_size: Optional[int] = None,
     donate: bool = True,
     gene_dtype=jnp.float32,
 ) -> Optional[Callable]:
