@@ -38,7 +38,16 @@ def main():
     if mesh is not None:
         print(f"sharding 8 islands across {mesh.devices.size} device(s)")
 
-    gens = lp.pga_run_islands(pga, 400, 20, 0.05, mesh=mesh)
+    # Anneal sigma across phases: a constant step size equilibrates around
+    # -60; shrinking it walks the population into the global basin. On the
+    # fused TPU path mutation rate/sigma are runtime inputs, so all phases
+    # reuse ONE compiled program.
+    gens = 0
+    for sigma in (0.05, 0.01, 0.002):
+        lp.pga_set_mutate_function(
+            pga, make_gaussian_mutate(rate=0.15, sigma=sigma)
+        )
+        gens += lp.pga_run_islands(pga, 134, 20, 0.05, mesh=mesh)
     best = lp.pga_get_best_all(pga)
     from libpga_tpu.objectives import rastrigin
 

@@ -184,23 +184,68 @@ class PGA:
         target against the carried scores BEFORE breeding again, so the
         generation that reaches the target is the one returned — its
         offspring never overwrite it.
+
+        Returns ``fn(genomes, key, n, target, mparams)``. On the Pallas
+        path ``mparams`` is the runtime mutation-parameter input (so
+        annealing schedules share one compilation — the cache key holds
+        the mutation KIND, not the operator instance); the XLA path bakes
+        the operator in and ignores it.
         """
-        cache_key = (
-            "run",
-            size,
-            genome_len,
-            self._objective,
-            self._crossover,
-            self._mutate,
-        )
+        pallas_kind = self._mutate_kind() if self._pallas_gate() else None
+        if pallas_kind is not None:
+            cache_key = (
+                "runP", size, genome_len, self._objective, pallas_kind,
+                self.config.elitism,
+            )
+        else:
+            cache_key = (
+                "run", size, genome_len, self._objective, self._crossover,
+                self._mutate,
+            )
         fn = self._compiled.get(cache_key)
         if fn is not None:
             return fn
 
         obj = self._require_objective()
+
+        if pallas_kind is not None:
+            from libpga_tpu.ops.pallas_step import make_pallas_run
+
+            factory = make_pallas_run(
+                obj,
+                tournament_size=self.config.tournament_size,
+                # Defaults for callers that pass no runtime params; the
+                # engine always passes self._mutate_params().
+                mutation_rate=self._mutation_rate(),
+                mutation_sigma=self._operator_param("sigma", 0.0),
+                mutate_kind=pallas_kind,
+                elitism=self.config.elitism,
+                deme_size=self.config.pallas_deme_size,
+                donate=self.config.donate_buffers,
+                gene_dtype=self.config.gene_dtype,
+            )
+            pallas_fn = factory(size, genome_len) if factory else None
+            if pallas_fn is not None:
+                self._compiled[cache_key] = pallas_fn
+                return pallas_fn
+            # Shape/kind unsupported by the kernel — fall through to XLA,
+            # caching the fallback under BOTH keys so later calls don't
+            # re-attempt the factory on every run().
+            pallas_key, cache_key = cache_key, (
+                "run", size, genome_len, self._objective, self._crossover,
+                self._mutate,
+            )
+            fn = self._compiled.get(cache_key)
+            if fn is not None:
+                self._compiled[pallas_key] = fn
+                return fn
+        else:
+            pallas_key = None
+
         breed = self._breed_fn()
 
-        def run_loop(genomes, key, n, target):
+        def run_loop(genomes, key, n, target, mparams):
+            del mparams  # operator parameters are baked into breed
             scores0 = _evaluate(obj, genomes)
 
             def cond(carry):
@@ -220,32 +265,40 @@ class PGA:
 
         donate = (0,) if self.config.donate_buffers else ()
         fn = jax.jit(run_loop, donate_argnums=donate)
-        if self._pallas_gate():
-            from libpga_tpu.ops.pallas_step import make_pallas_run
-
-            factory = make_pallas_run(
-                obj,
-                tournament_size=self.config.tournament_size,
-                # The rate bound into the active operator, not the config
-                # default — set_mutate(make_point_mutate(r)) must win.
-                mutation_rate=self._mutation_rate(),
-                deme_size=self.config.pallas_deme_size,
-                donate=self.config.donate_buffers,
-                gene_dtype=self.config.gene_dtype,
-            )
-            if factory is not None:
-                pallas_fn = factory(size, genome_len)
-                if pallas_fn is not None:
-                    fn = pallas_fn
         self._compiled[cache_key] = fn
+        if pallas_key is not None:
+            self._compiled[pallas_key] = fn
         return fn
 
-    def _is_default_operators(self) -> bool:
+    def _mutate_kind(self) -> Optional[str]:
+        """Kernel-implementable mutation kind of the active operator, or
+        None. The kind (not the operator instance) keys the compiled
+        fast path; rate/sigma are runtime inputs, so e.g. an annealing
+        schedule swapping ``make_gaussian_mutate(rate, sigma)`` per phase
+        reuses one compilation."""
         from libpga_tpu.ops import mutate as _m
 
-        return self._crossover is uniform_crossover and (
-            getattr(self._mutate, "func", None) is _m.point_mutate
-        )
+        func = getattr(self._mutate, "func", None)
+        if func is _m.point_mutate:
+            return "point"
+        if func is _m.gaussian_mutate:
+            return "gaussian"
+        return None
+
+    def _operator_param(self, name: str, default: float) -> float:
+        v = getattr(self._mutate, name, None)
+        if v is None:
+            v = getattr(self._mutate, "keywords", {}).get(name)
+        return default if v is None else v
+
+    def _mutate_params(self) -> jax.Array:
+        """(1, 2) f32 [rate, sigma] runtime input for the Pallas kernel."""
+        if self._mutate_kind() == "gaussian":
+            rate = self._operator_param("rate", 0.1)
+            sigma = self._operator_param("sigma", 0.1)
+        else:
+            rate, sigma = self._mutation_rate(), 0.0
+        return jnp.asarray([[rate, sigma]], dtype=jnp.float32)
 
     def _mutation_rate(self) -> float:
         """The rate bound into the active mutate operator. A raw
@@ -272,13 +325,13 @@ class PGA:
     def _pallas_gate(self) -> bool:
         """Single source of truth for Pallas fast-path eligibility, shared
         by the single-population run loop and the island runner. The
-        kernel only implements default operators, tournament-2, pure
-        generational replacement, f32/bf16 genes, and requires a real
-        TPU."""
+        kernel implements uniform crossover with point or gaussian
+        mutation, tournament-2, elitism (fused objectives), and f32/bf16
+        genes, and requires a real TPU."""
         if not (
             self.config.pallas_enabled()
-            and self._is_default_operators()
-            and self.config.elitism == 0
+            and self._crossover is uniform_crossover
+            and self._mutate_kind() is not None
             and self.config.tournament_size == 2
             and self.config.gene_dtype in (jnp.float32, jnp.bfloat16)
         ):
@@ -292,16 +345,25 @@ class PGA:
 
         The returned callable is vmapped across islands by the runner, so
         the kernel's deme shuffle stays island-local and island semantics
-        hold."""
+        hold. Mutation rate/sigma are runtime inputs of the breed (the
+        runner passes the engine's current ``_mutate_params()``), so the
+        cache key carries only the mutation KIND."""
         if not self._pallas_gate():
+            return None
+        obj = self._require_objective()
+        fused = getattr(obj, "kernel_rowwise", None)
+        if self.config.elitism > 0 and fused is None:
+            # The island-epoch elitism epilogue needs in-breed scores;
+            # the XLA breed handles elitism itself.
             return None
         from libpga_tpu.ops.pallas_step import make_pallas_breed
 
-        obj = self._require_objective()
-        fused = getattr(obj, "kernel_rowwise", None)
         # Cached: runner caching downstream keys on the breed's identity,
         # so rebuilding it per call would defeat compilation reuse.
-        cache_key = ("island_breed", island_size, genome_len, obj, fused)
+        cache_key = (
+            "island_breed", island_size, genome_len, obj, fused,
+            self._mutate_kind(), self.config.elitism,
+        )
         if cache_key in self._compiled:
             return self._compiled[cache_key]
         pb = make_pallas_breed(
@@ -309,6 +371,9 @@ class PGA:
             genome_len,
             deme_size=self.config.pallas_deme_size,
             mutation_rate=self._mutation_rate(),
+            mutation_sigma=self._operator_param("sigma", 0.0),
+            mutate_kind=self._mutate_kind(),
+            elitism=self.config.elitism,
             fused_obj=fused,
             gene_dtype=self.config.gene_dtype,
         )
@@ -337,7 +402,8 @@ class PGA:
         tgt = jnp.float32(jnp.inf if target is None else target)
         t0 = time.perf_counter()
         genomes, scores, gens_done = fn(
-            pop.genomes, self.next_key(), jnp.int32(n), tgt
+            pop.genomes, self.next_key(), jnp.int32(n), tgt,
+            self._mutate_params(),
         )
         gens = int(gens_done)
         # Install the new population BEFORE notifying metrics listeners:
@@ -614,6 +680,7 @@ class PGA:
             topology=self.config.migration_topology,
             mesh=mesh,
             runner_cache=self._compiled,
+            mparams=self._mutate_params(),
         )
         for i in range(len(self._populations)):
             # genomes[i] on a jax.Array stays on device (no host round trip).

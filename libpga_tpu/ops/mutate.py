@@ -115,6 +115,9 @@ def make_gaussian_mutate(rate: float = 0.1, sigma: float = 0.1):
     mut.func = gaussian_mutate
     # Already elementwise — the batched form is the same computation.
     mut.batched = partial(gaussian_mutate, rate=rate, sigma=sigma)
+    # Inspected by the engine's Pallas fast path (runtime mutation params).
+    mut.rate = rate
+    mut.sigma = sigma
     return mut
 
 

@@ -107,6 +107,20 @@ def auto_deme_size(gene_dtype) -> int:
     return 512 if gene_dtype == jnp.bfloat16 else 256
 
 
+def _carry_elites(g_prev, s_prev, g2, s2, elitism: int):
+    """Carry the top-e of the previous generation into rows 0..e-1 of the
+    new one, scores included — the same slots the XLA breed uses
+    (``ops/step.py``). Works on padded arrays: pad rows carry -inf
+    scores, so they can never be selected as elites, and rows 0..e-1 are
+    always real rows. The single definition serves both the fused breed
+    and the non-fused run loop so the two paths cannot drift."""
+    top_s, top_i = jax.lax.top_k(s_prev, elitism)
+    elites = jnp.take(g_prev, top_i, axis=0).astype(g2.dtype)
+    g2 = jax.lax.dynamic_update_slice(g2, elites, (0, 0))
+    s2 = jax.lax.dynamic_update_slice(s2, top_s, (0,))
+    return g2, s2
+
+
 def _supported() -> bool:
     try:
         from jax.experimental import pallas as pl  # noqa: F401
@@ -118,6 +132,7 @@ def _supported() -> bool:
 
 def _breed_kernel(
     seed_ref,
+    mparams_ref,
     scores_ref,
     genomes_ref,
     out_ref,
@@ -125,14 +140,20 @@ def _breed_kernel(
     K,
     L,
     Lp,
-    rate,
+    mutate="point",
     obj=None,
     bf16_genes=False,
     P=None,
 ):
     """One deme: select parents, crossover, mutate — and, when ``obj`` is
     given, evaluate the children in-kernel (skipping a whole extra HBM
-    pass per generation). All VMEM/register work."""
+    pass per generation). All VMEM/register work.
+
+    ``mparams_ref`` is a (1, 2) f32 SMEM block carrying the mutation
+    operator's runtime parameters ([rate, _] for point mutation,
+    [rate, sigma] for gaussian) — runtime scalars so an annealing
+    schedule (e.g. Rastrigin's shrinking sigma) reuses one compilation
+    instead of recompiling per phase."""
     import jax.lax as lax
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -212,21 +233,44 @@ def _breed_kernel(
     mask_bits = pltpu.bitcast(pltpu.prng_random_bits((K, Lp)), jnp.uint32)
     child = jnp.where(mask_bits >> 31 == 0, p1, p2)
 
-    # ---- point mutation (pga.cu:127-133): one random gene per firing row
-    mut_bits = pltpu.bitcast(pltpu.prng_random_bits((4, K)), jnp.uint32)
-    # uint32 -> f32 isn't a supported Mosaic cast; the >>8 result fits in
-    # 24 bits, so bitcast to i32 first.
-    u = pltpu.bitcast(mut_bits >> 8, jnp.int32).astype(jnp.float32) * jnp.float32(
-        2**-24
-    )
-    u_t = u.T  # (K, 4) f32
-    pos = jnp.floor(u_t[:, 0:1] * L).astype(jnp.int32)  # (K, 1) in [0, L)
-    cols = lax.broadcasted_iota(jnp.int32, (K, Lp), 1)
-    # Strict '<' so rate=0 disables mutation exactly (the reference's
-    # ``rand[1] <= chance`` gate, pga.cu:128, differs only on a
-    # measure-zero event for rate in (0,1)).
-    hit = (cols == pos) & (u_t[:, 1:2] < rate)
-    child = jnp.where(hit, u_t[:, 2:3], child)
+    # ---- mutation -----------------------------------------------------
+    # uint32 -> f32 isn't a supported Mosaic cast; >>8 leaves 24 bits, so
+    # bitcast to i32 before the float convert.
+    def uniform(shape):
+        bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+        return pltpu.bitcast(bits >> 8, jnp.int32).astype(
+            jnp.float32
+        ) * jnp.float32(2**-24)
+
+    rate = mparams_ref[0, 0]
+    if mutate == "point":
+        # Point mutation (pga.cu:127-133): one random gene per firing row.
+        u_t = uniform((4, K)).T  # (K, 4) f32
+        pos = jnp.floor(u_t[:, 0:1] * L).astype(jnp.int32)  # (K, 1) in [0, L)
+        cols = lax.broadcasted_iota(jnp.int32, (K, Lp), 1)
+        # Strict '<' so rate=0 disables mutation exactly (the reference's
+        # ``rand[1] <= chance`` gate, pga.cu:128, differs only on a
+        # measure-zero event for rate in (0,1)).
+        hit = (cols == pos) & (u_t[:, 1:2] < rate)
+        child = jnp.where(hit, u_t[:, 2:3], child)
+    elif mutate == "gaussian":
+        # Per-gene Gaussian perturbation (ops/mutate.gaussian_mutate
+        # semantics): each gene independently fires with probability
+        # ``rate`` and receives N(0, sigma^2) noise, clipped to [0, 1).
+        # Box-Muller from two independent in-kernel uniform draws; the
+        # gate draw is a third stream, so noise sign stays independent
+        # of firing (see the XLA operator's docstring).
+        sigma = mparams_ref[0, 1]
+        gate = uniform((K, Lp))
+        u1 = jnp.clip(uniform((K, Lp)), 1e-7, 1.0 - 1e-7)
+        u2 = uniform((K, Lp))
+        normal = jnp.sqrt(-2.0 * jnp.log(u1)) * jnp.cos(
+            2.0 * jnp.float32(math.pi) * u2
+        )
+        mutated = jnp.clip(child + sigma * normal, 0.0, 1.0 - 1e-7)
+        child = jnp.where(gate < rate, mutated, child)
+    else:
+        raise ValueError(f"unknown mutate kind {mutate!r}")
 
     # Write through the (K, 1, 1, Lp) block: deme i becomes column i of the
     # (K, G, 1, Lp) output, so the row-major reshape interleaves demes.
@@ -259,23 +303,42 @@ def make_pallas_breed(
     *,
     deme_size: Optional[int] = None,
     mutation_rate: float = 0.01,
+    mutation_sigma: float = 0.0,
+    mutate_kind: str = "point",
+    elitism: int = 0,
     fused_obj: Optional[Callable] = None,
     gene_dtype=jnp.float32,
 ) -> Optional[Callable]:
-    """Build the fused breed: ``(genomes (P,L), scores (P,), key) ->
-    next_genomes (P, L)`` — or, with ``fused_obj``, ``-> (next_genomes,
+    """Build the fused breed: ``(genomes (P,L), scores (P,), key[, mparams])
+    -> next_genomes (P, L)`` — or, with ``fused_obj``, ``-> (next_genomes,
     next_scores)`` with evaluation done inside the kernel. ``gene_dtype``
     bfloat16 selects parents with a single exact bf16 matmul (half the
     FLOPs/traffic of the f32 hi/lo path) at bf16 gene resolution.
+
+    ``mutate_kind`` selects the in-kernel mutation ("point" or
+    "gaussian"); its parameters are RUNTIME inputs — pass ``mparams``
+    (shape (1, 2) f32: [rate, sigma]) per call to anneal without
+    recompiling, or omit it to use the construction-time defaults.
+
+    ``elitism`` > 0 (fused only): the top-e of the incoming generation
+    overwrite rows 0..e-1 of the outgoing one, with their scores — the
+    same slots the XLA breed uses (``ops/step.py``).
+
     Populations that no deme size divides exactly are padded internally
     to the next deme multiple: pad rows are excluded from tournaments
     in-kernel (see ``_breed_kernel``) and tail children carry -inf fused
     scores, so the padded rows are inert — the caller still sees exactly
     ``(P, L)``. Returns None when unsupported (population under one deme
-    tile, or an unsupported dtype)."""
+    tile, an unsupported dtype, or elitism without fused scores)."""
     if not _supported():
         return None
     if gene_dtype not in (jnp.float32, jnp.bfloat16):
+        return None
+    if mutate_kind not in ("point", "gaussian"):
+        return None
+    if elitism > 0 and fused_obj is None:
+        # The epilogue needs next-generation scores; without fused
+        # evaluation the caller (engine run loop) applies elitism itself.
         return None
     bf16_genes = gene_dtype == jnp.bfloat16
     if not deme_size:
@@ -296,7 +359,7 @@ def make_pallas_breed(
         K=K,
         L=L,
         Lp=Lp,
-        rate=mutation_rate,
+        mutate=mutate_kind,
         obj=fused_obj,
         bf16_genes=bf16_genes,
         P=P,
@@ -313,6 +376,7 @@ def make_pallas_breed(
         grid=(G,),
         in_specs=[
             pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 2), lambda i: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, K), lambda i: (i, 0, 0)),
             pl.BlockSpec((K, Lp), lambda i: (i, 0)),
         ],
@@ -320,16 +384,24 @@ def make_pallas_breed(
         out_shape=out_shape if fused_obj is not None else out_shape[0],
     )
 
-    def breed_padded(gp: jax.Array, scores: jax.Array, key: jax.Array):
+    default_params = jnp.asarray(
+        [[mutation_rate, mutation_sigma]], dtype=jnp.float32
+    )
+
+    def breed_padded(gp, scores, key, mparams=None):
         """(Pp, Lp)-padded variant for loops that keep the pad resident.
         Takes/returns genomes (Pp, Lp) and scores (Pp,); when fused, tail
         child scores (rows >= P) come back masked to -inf so loop
         reductions and target checks never see a discarded child."""
+        if mparams is None:
+            mparams = default_params
         seed = jax.random.randint(
             key, (1, 1), jnp.iinfo(jnp.int32).min, jnp.iinfo(jnp.int32).max,
             dtype=jnp.int32,
         )
-        out = call(seed, scores.reshape(G, 1, K).astype(jnp.float32), gp)
+        out = call(
+            seed, mparams, scores.reshape(G, 1, K).astype(jnp.float32), gp
+        )
         if fused_obj is not None:
             genomes, child_scores = out
             # Genome row order after reshape is (child r)·G + (deme i);
@@ -339,16 +411,19 @@ def make_pallas_breed(
                 s2 = jnp.where(
                     jnp.arange(Pp, dtype=jnp.int32) < P, s2, -jnp.inf
                 )
-            return genomes.reshape(Pp, Lp), s2
+            g2 = genomes.reshape(Pp, Lp)
+            if elitism > 0:
+                g2, s2 = _carry_elites(gp, scores, g2, s2, elitism)
+            return g2, s2
         return out.reshape(Pp, Lp)
 
-    def breed(genomes: jax.Array, scores: jax.Array, key: jax.Array):
+    def breed(genomes, scores, key, mparams=None):
         gp = genomes.astype(gene_dtype)
         if Lp != L or Pp != P:
             gp = jnp.pad(gp, ((0, Pp - P), (0, Lp - L)))
         if Pp != P:
             scores = jnp.pad(scores, (0, Pp - P), constant_values=-jnp.inf)
-        out = breed_padded(gp, scores, key)
+        out = breed_padded(gp, scores, key, mparams)
         if fused_obj is not None:
             g2, s2 = out
             return g2[:P, :L], s2[:P]
@@ -360,6 +435,9 @@ def make_pallas_breed(
     breed.K = K
     breed.fused = fused_obj is not None
     breed.gene_dtype = gene_dtype
+    breed.takes_params = True
+    breed.default_params = default_params
+    breed.elitism = elitism
     return breed
 
 
@@ -368,16 +446,20 @@ def make_pallas_run(
     *,
     tournament_size: int = 2,
     mutation_rate: float = 0.01,
+    mutation_sigma: float = 0.0,
+    mutate_kind: str = "point",
+    elitism: int = 0,
     deme_size: Optional[int] = None,
     donate: bool = True,
     gene_dtype=jnp.float32,
 ) -> Optional[Callable]:
     """Build a per-shape factory for the fused run loop used by ``PGA.run``:
     ``build(pop_size, genome_len)`` returns a jitted
-    ``(genomes, key, n, target) -> (genomes, scores, gens)`` with the same
-    contract as the XLA path in ``engine._compiled_run``, or None when
-    unsupported (k != 2, non-TPU backend, or per-shape inside the factory)
-    — the engine then falls back to the XLA path."""
+    ``(genomes, key, n, target, mparams) -> (genomes, scores, gens)`` with
+    the same contract as the XLA path in ``engine._compiled_run`` (plus
+    the runtime mutation-params input — see ``make_pallas_breed``), or
+    None when unsupported (k != 2, non-TPU backend, or per-shape inside
+    the factory) — the engine then falls back to the XLA path."""
     if tournament_size != 2 or not _supported():
         return None
     # The Mosaic kernel only lowers on TPU; an explicit use_pallas=True on
@@ -402,6 +484,8 @@ def make_pallas_run(
         breed = make_pallas_breed(
             pop_size, genome_len,
             deme_size=deme_size, mutation_rate=mutation_rate,
+            mutation_sigma=mutation_sigma, mutate_kind=mutate_kind,
+            elitism=elitism if fused_obj is not None else 0,
             fused_obj=fused_obj, gene_dtype=gene_dtype,
         )
         if breed is None:
@@ -416,7 +500,7 @@ def make_pallas_run(
                 return s
             return jnp.where(jnp.arange(Pp, dtype=jnp.int32) < P, s, -jnp.inf)
 
-        def run_loop(genomes, key, n, target):
+        def run_loop(genomes, key, n, target, mparams):
             # Pad once; the loop carries the deme-aligned (Pp, Lp) matrix.
             # Evaluation reads the [:P, :L] view (the slice fuses into the
             # objective's reduction — nothing materializes).
@@ -435,12 +519,15 @@ def make_pallas_run(
                 g, s, k, gen = carry
                 k, sub = jax.random.split(k)
                 if breed.fused:
-                    g2, s2 = breed.padded(g, s, sub)  # tail already -inf
+                    # tail already -inf; elitism applied inside breed
+                    g2, s2 = breed.padded(g, s, sub, mparams)
                 else:
-                    g2 = breed.padded(g, s, sub)
+                    g2 = breed.padded(g, s, sub, mparams)
                     s2 = masked_tail(jnp.pad(
                         _evaluate(obj, g2[:P, :L]), (0, Pp - P)
                     ))
+                    if elitism > 0:
+                        g2, s2 = _carry_elites(g, s, g2, s2, elitism)
                 return (g2, s2, k, gen + 1)
 
             init = (gp, scores0, key, jnp.int32(0))

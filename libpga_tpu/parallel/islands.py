@@ -53,8 +53,9 @@ def make_island_epoch(breed: Callable, obj: Callable, m: int) -> Callable:
     Lp = getattr(breed, "Lp", None)
     Pp = getattr(breed, "Pp", None)
     gdtype = getattr(breed, "gene_dtype", None)
+    takes_params = getattr(breed, "takes_params", False)
 
-    def epoch(genomes, scores, key):
+    def epoch(genomes, scores, key, mparams=None):
         S, L = genomes.shape
         pad = padded_fn is not None and (
             (Lp is not None and Lp != L) or (Pp is not None and Pp != S)
@@ -71,10 +72,13 @@ def make_island_epoch(breed: Callable, obj: Callable, m: int) -> Callable:
             g, s, k = carry
             k, sub = jax.random.split(k)
             step = padded_fn if pad else breed
+            args = (g, s, sub) + (
+                (mparams,) if takes_params and mparams is not None else ()
+            )
             if fused:
-                g2, s2 = step(g, s, sub)
+                g2, s2 = step(*args)
             else:
-                g2 = step(g, s, sub)
+                g2 = step(*args)
                 s2 = _evaluate(obj, g2[:S, :L] if pad else g2)
                 if pad:
                     s2 = jnp.pad(s2, (0, Pp - S), constant_values=-jnp.inf)
@@ -177,12 +181,19 @@ def build_local_runner(
     """Single-device (vmapped-islands) epoch loop.
 
     Returns ``runner(genomes (I,S,L), island_keys (I,), mig_key,
-    num_epochs, target) -> (genomes, scores (I,S), epochs_done)``.
+    num_epochs, target) -> (genomes, scores (I,S), epochs_done)``. For a
+    breed with runtime mutation params (``breed.takes_params``) the
+    runner takes a trailing ``mparams`` argument and sets its own
+    ``takes_params`` marker.
     """
+    takes_params = getattr(breed, "takes_params", False)
     epoch = make_island_epoch(breed, obj, m)
-    vepoch = jax.vmap(epoch)
+    vepoch = (
+        jax.vmap(epoch, in_axes=(0, 0, 0, None)) if takes_params
+        else jax.vmap(epoch)
+    )
 
-    def loop(genomes, island_keys, mig_key, num_epochs, target):
+    def loop(genomes, island_keys, mig_key, num_epochs, target, mparams=None):
         scores = jax.vmap(lambda gi: _evaluate(obj, gi))(genomes)
 
         def cond(c):
@@ -191,7 +202,10 @@ def build_local_runner(
 
         def body(c):
             g, s, keys, mk, e = c
-            g, s, keys = vepoch(g, s, keys)
+            if takes_params:
+                g, s, keys = vepoch(g, s, keys, mparams)
+            else:
+                g, s, keys = vepoch(g, s, keys)
             if count > 0:
                 mk, sub = jax.random.split(mk)
                 g, s = _migrate_local(g, s, sub, count, topology)
@@ -201,7 +215,13 @@ def build_local_runner(
         g, s, keys, mk, e = jax.lax.while_loop(cond, body, init)
         return g, s, e
 
-    return jax.jit(loop)
+    jitted = jax.jit(loop)
+
+    def runner(*args):
+        return jitted(*args)
+
+    runner.takes_params = takes_params
+    return runner
 
 
 # ------------------------------------------------------------- sharded path
@@ -252,11 +272,18 @@ def build_sharded_runner(
     axis_name: str = "islands",
 ) -> Callable:
     """shard_map'd epoch loop: islands split over the mesh axis, migration
-    over ICI. Same signature as :func:`build_local_runner`'s return."""
+    over ICI. Same signature as :func:`build_local_runner`'s return
+    (including the trailing ``mparams`` for a ``takes_params`` breed —
+    replicated across the mesh)."""
+    takes_params = getattr(breed, "takes_params", False)
     epoch = make_island_epoch(breed, obj, m)
-    vepoch = jax.vmap(epoch)
+    vepoch = (
+        jax.vmap(epoch, in_axes=(0, 0, 0, None)) if takes_params
+        else jax.vmap(epoch)
+    )
 
-    def shard_body(genomes, island_keys, mig_key, num_epochs, target):
+    def shard_body(genomes, island_keys, mig_key, num_epochs, target,
+                   mparams=None):
         # genomes: (I_loc, S, L); island_keys: (I_loc,); mig_key replicated.
         scores = jax.vmap(lambda gi: _evaluate(obj, gi))(genomes)
         best0 = jax.lax.pmax(jnp.max(scores), axis_name)
@@ -267,7 +294,10 @@ def build_sharded_runner(
 
         def body(c):
             g, s, keys, mk, e, best = c
-            g, s, keys = vepoch(g, s, keys)
+            if takes_params:
+                g, s, keys = vepoch(g, s, keys, mparams)
+            else:
+                g, s, keys = vepoch(g, s, keys)
             if count > 0:
                 mk, sub = jax.random.split(mk)
                 g, s = _migrate_sharded(g, s, sub, count, topology, axis_name)
@@ -281,14 +311,21 @@ def build_sharded_runner(
         g, s, keys, mk, e, best = jax.lax.while_loop(cond, body, init)
         return g, s, e
 
+    base_specs = (P(axis_name, None, None), P(axis_name), P(), P(), P())
     mapped = jax.shard_map(
         shard_body,
         mesh=mesh,
-        in_specs=(P(axis_name, None, None), P(axis_name), P(), P(), P()),
+        in_specs=base_specs + ((P(),) if takes_params else ()),
         out_specs=(P(axis_name, None, None), P(axis_name, None), P()),
         check_vma=False,
     )
-    return jax.jit(mapped)
+    jitted = jax.jit(mapped)
+
+    def runner(*args):
+        return jitted(*args)
+
+    runner.takes_params = takes_params
+    return runner
 
 
 def build_runner(
@@ -326,13 +363,17 @@ def run_islands_stacked(
     mesh: Optional[Mesh] = None,
     axis_name: str = "islands",
     runner_cache: Optional[dict] = None,
+    mparams: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array, int]:
     """Run the island GA on a stacked ``(I, S, L)`` population array.
 
     ``step_or_breed`` takes ``(genomes, scores, key)`` (a breed fn from
     :func:`libpga_tpu.ops.step.make_breed`). ``pct`` of the island size is
     the emigrant count (``int(S*pct)``; 0 → no migration). Pass a dict as
-    ``runner_cache`` to reuse compiled runners across calls.
+    ``runner_cache`` to reuse compiled runners across calls. ``mparams``
+    is forwarded to a ``takes_params`` breed (runtime mutation rate/sigma
+    — see ``ops/pallas_step.make_pallas_breed``); None uses the breed's
+    construction-time defaults.
 
     Returns ``(genomes (I,S,L), scores (I,S), generations_executed)``.
     """
@@ -382,8 +423,14 @@ def run_islands_stacked(
         island_keys = _shard_host_array(
             island_keys, NamedSharding(mesh, P(axis_name))
         )
+    if getattr(runner, "takes_params", False):
+        if mparams is None:
+            mparams = getattr(breed, "default_params", None)
+        extra = (mparams,)
+    else:
+        extra = ()
     genomes, scores, epochs_done = runner(
-        stacked, island_keys, mig_key, jnp.int32(epochs), tgt
+        stacked, island_keys, mig_key, jnp.int32(epochs), tgt, *extra
     )
     gens = int(epochs_done) * m
 
@@ -406,7 +453,7 @@ def run_islands_stacked(
             )
         genomes, scores, _ = rem_runner(
             genomes, rem_keys, jax.random.fold_in(mig_key, 11),
-            jnp.int32(1), jnp.float32(jnp.inf),
+            jnp.int32(1), jnp.float32(jnp.inf), *extra
         )
         gens += rem
     return genomes, scores, gens

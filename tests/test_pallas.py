@@ -74,7 +74,7 @@ def test_engine_mutation_rate_from_raw_partial():
 
     pga = PGA(seed=0)
     pga.set_mutate(partial(point_mutate, rate=0.42))
-    assert pga._is_default_operators()
+    assert pga._mutate_kind() == "point"
     assert pga._mutation_rate() == 0.42
     pga.set_mutate(make_point_mutate(0.13))
     assert pga._mutation_rate() == 0.13
@@ -312,6 +312,112 @@ def test_engine_bf16_genes_on_xla_path():
     pga.run(5)
     assert pga.population(pop).genomes.dtype == jnp.bfloat16
     assert pga.get_best(pop).shape == (8,)
+
+
+def test_gaussian_kernel_rate_zero_and_sigma_zero_are_noops():
+    """Gaussian in-kernel mutation: rate=0 never fires; rate=1 with
+    sigma=0 fires everywhere but perturbs nothing (clip is identity on
+    [0,1) genes) — both must reproduce the zero-bits breeding structure
+    exactly."""
+    P, L, K = 256, 8, 128
+    G = P // K
+    genomes = (
+        jnp.broadcast_to(jnp.arange(P, dtype=jnp.float32)[:, None], (P, L)) / P
+    )
+    outs = {}
+    with _interpret():
+        for rate, sigma in ((0.0, 0.5), (1.0, 0.0)):
+            breed = make_pallas_breed(
+                P, L, deme_size=K, mutation_rate=rate,
+                mutation_sigma=sigma, mutate_kind="gaussian",
+            )
+            assert breed is not None
+            outs[(rate, sigma)] = np.asarray(
+                breed(genomes, jnp.zeros((P,)), jax.random.key(0))
+            )
+    expect = np.asarray([((r % G) * K) / P for r in range(P)], np.float32)
+    for out in outs.values():
+        np.testing.assert_allclose(
+            out, np.broadcast_to(expect[:, None], (P, L)), atol=2e-5, rtol=0
+        )
+
+
+def test_runtime_mutation_params_override_defaults():
+    """mparams passed at call time must override the construction-time
+    rate — the mechanism that lets annealing schedules reuse one
+    compilation. Zero PRNG bits: point mutation at rate 1 sets gene 0 of
+    every row to draw 0 (= 0.0); at the default rate 0 nothing fires."""
+    P, L, K = 256, 8, 128
+    genomes = jnp.full((P, L), 0.5, dtype=jnp.float32)
+    with _interpret():
+        breed = make_pallas_breed(P, L, deme_size=K, mutation_rate=0.0)
+        quiet = np.asarray(breed(genomes, jnp.zeros((P,)), jax.random.key(0)))
+        fired = np.asarray(
+            breed(
+                genomes, jnp.zeros((P,)), jax.random.key(0),
+                jnp.asarray([[1.0, 0.0]], dtype=jnp.float32),
+            )
+        )
+    np.testing.assert_array_equal(quiet, np.full((P, L), 0.5, np.float32))
+    np.testing.assert_array_equal(fired[:, 0], np.zeros((P,), np.float32))
+    np.testing.assert_array_equal(fired[:, 1:], np.full((P, L - 1), 0.5, np.float32))
+
+
+def test_fused_elitism_preserves_top_rows():
+    """Fused breed with elitism=e: rows 0..e-1 of the output must be the
+    previous generation's top-e genomes with their scores — the same
+    slots the XLA breed uses — while the rest follow the zero-bits
+    breeding structure."""
+    from libpga_tpu.objectives import onemax
+
+    P, L, K = 256, 8, 128
+    G = P // K
+    genomes = (
+        jnp.broadcast_to(jnp.arange(P, dtype=jnp.float32)[:, None], (P, L)) / P
+    )
+    # scores unrelated to genome content: rows 131 and 7 are the elite
+    scores = jnp.zeros((P,), jnp.float32).at[131].set(9.0).at[7].set(5.0)
+    with _interpret():
+        breed = make_pallas_breed(
+            P, L, deme_size=K, mutation_rate=0.0, elitism=2,
+            fused_obj=onemax.kernel_rowwise,
+        )
+        assert breed is not None and breed.elitism == 2
+        g2, s2 = breed(genomes, scores, jax.random.key(0))
+    g2, s2 = np.asarray(g2), np.asarray(s2)
+    gn = np.asarray(genomes)
+    np.testing.assert_array_equal(g2[0], gn[131])
+    np.testing.assert_array_equal(g2[1], gn[7])
+    assert s2[0] == 9.0 and s2[1] == 5.0
+    # non-elite rows keep the zero-bits structure (copy of deme row 0)
+    for r in range(2, P, 41):
+        np.testing.assert_allclose(
+            g2[r], gn[(r % G) * K], atol=2e-5, rtol=0
+        )
+    np.testing.assert_allclose(s2[2:], g2[2:].sum(axis=1), atol=1e-4, rtol=0)
+
+
+def test_gaussian_islands_with_params_through_runner():
+    """A gaussian takes_params breed runs through run_islands_stacked
+    with explicit mparams, keeping carried scores consistent."""
+    from libpga_tpu.objectives import onemax
+    from libpga_tpu.parallel.islands import run_islands_stacked
+
+    I, S, L, K = 2, 256, 8, 128
+    with _interpret():
+        breed = make_pallas_breed(
+            S, L, deme_size=K, mutate_kind="gaussian", mutation_rate=0.0,
+            fused_obj=onemax.kernel_rowwise,
+        )
+        assert breed.takes_params
+        stacked = jax.random.uniform(jax.random.key(0), (I, S, L))
+        genomes, scores, gens = run_islands_stacked(
+            breed, onemax, stacked, jax.random.key(1), n=4, m=2, pct=0.05,
+            mparams=jnp.asarray([[0.0, 0.0]], dtype=jnp.float32),
+        )
+    genomes, scores = np.asarray(genomes), np.asarray(scores)
+    assert gens == 4
+    np.testing.assert_allclose(scores, genomes.sum(axis=2), atol=2e-4, rtol=0)
 
 
 def test_mutation_rate_zero_never_fires():

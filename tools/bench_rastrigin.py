@@ -1,0 +1,73 @@
+"""Rastrigin-30D annealed benchmark (BASELINE.json config 2).
+
+8 islands × 16,384 individuals × 30 genes, elitism 2, ring migration of
+the top 5% every 20 generations, Gaussian mutation annealed over 5
+phases (sigma 0.05 → 0.001, rate 0.15), 400 generations per phase =
+2,000 total — the exact scenario BASELINE.md's round-1 row measured at
+~96 s wall on the XLA path.
+
+The Pallas fast path takes mutation rate/sigma as RUNTIME inputs, so all
+5 phases reuse one compilation; the XLA path re-jits per phase (each
+``make_gaussian_mutate`` instance is a new trace constant).
+
+Run: python tools/bench_rastrigin.py [--xla]
+Prints one JSON line with wall time (including compiles), generations/sec
+steady-state, and solution quality.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from libpga_tpu import PGA, PGAConfig
+from libpga_tpu.objectives import rastrigin
+from libpga_tpu.ops.mutate import make_gaussian_mutate
+
+ISLANDS = 8
+ISLAND_SIZE = 16_384
+GENES = 30
+PHASES = [(0.15, 0.05), (0.15, 0.02), (0.15, 0.008), (0.15, 0.003), (0.15, 0.001)]
+GENS_PER_PHASE = 400
+
+
+def main() -> None:
+    use_pallas = "--xla" not in sys.argv
+    if "--no-cache" not in sys.argv:
+        from libpga_tpu.utils.profiling import enable_compilation_cache
+
+        enable_compilation_cache()
+    config = PGAConfig(elitism=2, use_pallas=use_pallas)
+    pga = PGA(seed=11, config=config)
+    for _ in range(ISLANDS):
+        pga.create_population(ISLAND_SIZE, GENES)
+    pga.set_objective("rastrigin")
+
+    t0 = time.perf_counter()
+    for rate, sigma in PHASES:
+        pga.set_mutate(make_gaussian_mutate(rate=rate, sigma=sigma))
+        pga.run_islands(GENS_PER_PHASE, 20, 0.05)
+    wall = time.perf_counter() - t0
+
+    # steady-state rate at the final phase settings (post-compile)
+    t0 = time.perf_counter()
+    pga.run_islands(100, 20, 0.05)
+    steady = 100 / (time.perf_counter() - t0)
+
+    best = pga.get_best_all()
+    best_val = float(rastrigin(best))
+    print(json.dumps({
+        "path": "pallas" if use_pallas else "xla",
+        "wall_s_2000gens_incl_compiles": round(wall, 2),
+        "steady_gens_per_sec": round(steady, 1),
+        "best_rastrigin": round(best_val, 4),
+        "genes_at_half": round(float(np.abs(np.asarray(best) - 0.5).mean()), 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -102,6 +102,46 @@ def main() -> int:
         pad_ok &= len(ids) <= 2 and all(lo <= p < hi for p in ids)
     good &= check("padded population: pad rows never selected", pad_ok)
 
+    # Gaussian mutation statistics: uniform population at 0.5 with equal
+    # scores makes selection and crossover no-ops, isolating the mutation.
+    # rate=0.3, sigma=0.05 -> ~30% of genes perturbed with std ~sigma
+    # (clipping is an 8-sigma event, negligible).
+    breedg = make_pallas_breed(
+        P, L, deme_size=K, mutate_kind="gaussian",
+        mutation_rate=0.3, mutation_sigma=0.05,
+    )
+    outg = np.asarray(
+        breedg(jnp.full((P, L), 0.5), jnp.zeros((P,)), jax.random.key(6))
+    )
+    delta = outg - 0.5
+    fired = delta != 0
+    frac = float(fired.mean())
+    stdev = float(delta[fired].std()) if fired.any() else 0.0
+    good &= check(
+        f"gaussian fire fraction ~0.30 (got {frac:.3f})", 0.27 < frac < 0.33
+    )
+    good &= check(
+        f"gaussian noise std ~0.050 (got {stdev:.4f})", 0.045 < stdev < 0.055
+    )
+
+    # Elitism epilogue (fused): rows 0..1 must be the previous top-2.
+    from libpga_tpu.objectives import onemax as _om
+
+    breede = make_pallas_breed(
+        P, L, deme_size=K, mutation_rate=0.01, elitism=2,
+        fused_obj=_om.kernel_rowwise,
+    )
+    ge = jax.random.uniform(jax.random.key(8), (P, L))
+    se = jnp.sum(ge, axis=1)
+    g2e, s2e = breede(ge, se, jax.random.key(9))
+    top_i = np.argsort(-np.asarray(se))[:2]
+    elite_ok = np.allclose(
+        np.asarray(g2e[:2]), np.asarray(ge)[top_i], atol=2e-5
+    ) and np.allclose(
+        np.asarray(s2e[:2]), np.asarray(se)[top_i], atol=1e-5
+    )
+    good &= check("elitism: prev top-2 carried into rows 0..1", elite_ok)
+
     from libpga_tpu import PGA, PGAConfig
 
     pga = PGA(seed=7, config=PGAConfig(use_pallas=True))
