@@ -48,6 +48,13 @@ from libpga_tpu.ops.topk import top_k_genomes
 from libpga_tpu.utils.metrics import Metrics
 
 
+# Cache marker: the Pallas factory declined this (shape, kind) — skip
+# re-probing it, but dispatch through the operator-instance-keyed XLA
+# cache (the XLA fn bakes the operator in, so it must never be reused
+# across operator swaps via a kind-only key).
+_XLA_FALLBACK = object()
+
+
 @dataclasses.dataclass(frozen=True)
 class PopulationHandle:
     """Opaque handle to a population owned by a :class:`PGA` instance.
@@ -191,56 +198,48 @@ class PGA:
         the mutation KIND, not the operator instance); the XLA path bakes
         the operator in and ignores it.
         """
+        obj = self._require_objective()
         pallas_kind = self._mutate_kind() if self._pallas_gate() else None
         if pallas_kind is not None:
-            cache_key = (
-                "runP", size, genome_len, self._objective, pallas_kind,
+            # Keyed by mutation KIND: rate/sigma are runtime inputs of the
+            # compiled fn. A declined shape caches the _XLA_FALLBACK
+            # sentinel — NOT the XLA fn itself, which bakes the operator
+            # instance in and must stay keyed by it below.
+            pkey = (
+                "runP", size, genome_len, obj, pallas_kind,
                 self.config.elitism,
             )
-        else:
-            cache_key = (
-                "run", size, genome_len, self._objective, self._crossover,
-                self._mutate,
-            )
+            cached = self._compiled.get(pkey)
+            if cached is None:
+                from libpga_tpu.ops.pallas_step import make_pallas_run
+
+                factory = make_pallas_run(
+                    obj,
+                    tournament_size=self.config.tournament_size,
+                    # Defaults for callers that pass no runtime params;
+                    # the engine always passes self._mutate_params().
+                    mutation_rate=self._mutation_rate(),
+                    mutation_sigma=self._operator_param("sigma", 0.0),
+                    mutate_kind=pallas_kind,
+                    elitism=self.config.elitism,
+                    deme_size=self.config.pallas_deme_size,
+                    donate=self.config.donate_buffers,
+                    gene_dtype=self.config.gene_dtype,
+                )
+                pallas_fn = factory(size, genome_len) if factory else None
+                cached = (
+                    pallas_fn if pallas_fn is not None else _XLA_FALLBACK
+                )
+                self._compiled[pkey] = cached
+            if cached is not _XLA_FALLBACK:
+                return cached
+
+        cache_key = (
+            "run", size, genome_len, obj, self._crossover, self._mutate,
+        )
         fn = self._compiled.get(cache_key)
         if fn is not None:
             return fn
-
-        obj = self._require_objective()
-
-        if pallas_kind is not None:
-            from libpga_tpu.ops.pallas_step import make_pallas_run
-
-            factory = make_pallas_run(
-                obj,
-                tournament_size=self.config.tournament_size,
-                # Defaults for callers that pass no runtime params; the
-                # engine always passes self._mutate_params().
-                mutation_rate=self._mutation_rate(),
-                mutation_sigma=self._operator_param("sigma", 0.0),
-                mutate_kind=pallas_kind,
-                elitism=self.config.elitism,
-                deme_size=self.config.pallas_deme_size,
-                donate=self.config.donate_buffers,
-                gene_dtype=self.config.gene_dtype,
-            )
-            pallas_fn = factory(size, genome_len) if factory else None
-            if pallas_fn is not None:
-                self._compiled[cache_key] = pallas_fn
-                return pallas_fn
-            # Shape/kind unsupported by the kernel — fall through to XLA,
-            # caching the fallback under BOTH keys so later calls don't
-            # re-attempt the factory on every run().
-            pallas_key, cache_key = cache_key, (
-                "run", size, genome_len, self._objective, self._crossover,
-                self._mutate,
-            )
-            fn = self._compiled.get(cache_key)
-            if fn is not None:
-                self._compiled[pallas_key] = fn
-                return fn
-        else:
-            pallas_key = None
 
         breed = self._breed_fn()
 
@@ -266,8 +265,6 @@ class PGA:
         donate = (0,) if self.config.donate_buffers else ()
         fn = jax.jit(run_loop, donate_argnums=donate)
         self._compiled[cache_key] = fn
-        if pallas_key is not None:
-            self._compiled[pallas_key] = fn
         return fn
 
     def _mutate_kind(self) -> Optional[str]:

@@ -2,13 +2,23 @@
 
 The reference has no serialization at all — the only state extraction is the
 host copy of one winning genome in ``pga_get_best`` (``src/pga.cu:218-236``).
-Here whole solver states (all populations + PRNG key) round-trip through a
-single ``.npz`` file, so long island runs can resume after preemption.
+Here whole solver states (all populations + PRNG key) round-trip through
+``.npz`` files, so long island runs can resume after preemption.
+
+Multi-host safety: on a multi-process mesh a population's device buffers
+may live entirely on another host — ``np.asarray`` on such an array
+raises. ``save`` therefore writes only the ADDRESSABLE shards of each
+array, one ``<path>.proc<k>.npz`` file per process (all processes must
+call it — it is a collective); ``restore`` merges every process file it
+finds (shared filesystem, the norm for pod jobs) back into full host
+arrays. Single-process solvers keep the flat single-file format.
 """
 
 from __future__ import annotations
 
-from typing import TYPE_CHECKING
+import glob
+import os
+from typing import TYPE_CHECKING, Dict, List, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -17,7 +27,8 @@ import numpy as np
 if TYPE_CHECKING:
     from libpga_tpu.engine import PGA
 
-FORMAT_VERSION = 2
+FORMAT_VERSION = 2  # single-file format
+SHARD_FORMAT_VERSION = 3  # per-process shard format
 
 
 def _encode(arr: np.ndarray):
@@ -38,8 +49,109 @@ def _decode(arr: np.ndarray, dtype_name: str) -> np.ndarray:
     return arr.view(np.dtype(getattr(ml_dtypes, dtype_name)))
 
 
+def _addressable_shards(arr) -> List[Tuple[Tuple[int, ...], np.ndarray]]:
+    """(start_offsets, data) for every shard this process can read.
+
+    A plain numpy/host array is one full shard; a jax.Array contributes
+    its addressable shards only (possibly none, when the whole array
+    lives on another host's devices)."""
+    if not isinstance(arr, jax.Array):
+        a = np.asarray(arr)
+        return [((0,) * a.ndim, a)]
+    out = []
+    seen = set()
+    for s in arr.addressable_shards:
+        starts = tuple(
+            0 if sl.start is None else int(sl.start) for sl in s.index
+        )
+        if starts in seen:  # replicated shard — one copy is enough
+            continue
+        seen.add(starts)
+        out.append((starts, np.asarray(s.data)))
+    return out
+
+
+def _pack_array(arrays: Dict[str, np.ndarray], name: str, arr) -> None:
+    """Store an array's addressable shards under ``name`` in ``arrays``."""
+    shape = tuple(getattr(arr, "shape", np.shape(arr)))
+    arrays[f"{name}_shape"] = np.asarray(shape, dtype=np.int64)
+    for j, (starts, data) in enumerate(_addressable_shards(arr)):
+        enc, dtype_name = _encode(data)
+        arrays[f"{name}_shard{j}"] = enc
+        arrays[f"{name}_shard{j}_dtype"] = np.asarray(dtype_name)
+        arrays[f"{name}_shard{j}_start"] = np.asarray(starts, dtype=np.int64)
+
+
+def _merge_array(files: List, name: str):
+    """Reassemble a full host array for ``name`` from all process files."""
+    shape = dtype = None
+    pieces = []
+    for data in files:
+        if f"{name}_shape" not in data:
+            continue
+        shape = tuple(int(x) for x in data[f"{name}_shape"])
+        j = 0
+        while f"{name}_shard{j}" in data:
+            piece = _decode(
+                data[f"{name}_shard{j}"], str(data[f"{name}_shard{j}_dtype"])
+            )
+            starts = tuple(int(x) for x in data[f"{name}_shard{j}_start"])
+            pieces.append((starts, piece))
+            dtype = piece.dtype
+            j += 1
+    if shape is None:
+        raise ValueError(f"checkpoint is missing array {name!r}")
+    full = np.zeros(shape, dtype=dtype)
+    covered = np.zeros(shape, dtype=bool) if pieces else None
+    for starts, piece in pieces:
+        idx = tuple(
+            slice(st, st + dim) for st, dim in zip(starts, piece.shape)
+        )
+        full[idx] = piece
+        covered[idx] = True
+    if covered is None or not covered.all():
+        raise ValueError(
+            f"checkpoint shards for {name!r} do not cover the full array "
+            "(missing a process file?)"
+        )
+    return full
+
+
 def save(pga: "PGA", path: str) -> None:
-    """Serialize all populations and the PRNG state to ``path`` (.npz)."""
+    """Serialize all populations and the PRNG state.
+
+    Single-process: one ``path`` .npz file. Multi-process (after
+    ``jax.distributed.initialize``): a COLLECTIVE — every process writes
+    ``<path>.proc<k>.npz`` with its addressable shards; no process ever
+    touches a non-addressable buffer.
+    """
+    # Monotonic per-solver save sequence: every process runs the same
+    # engine calls, so the counter is identical across the fleet — at
+    # restore it catches a checkpoint torn by preemption mid-save (one
+    # process wrote generation N's shards, another still has N-1's).
+    seq = getattr(pga, "_ckpt_seq", 0) + 1
+    pga._ckpt_seq = seq
+
+    if jax.process_count() > 1:
+        if jax.process_index() == 0 and os.path.exists(path):
+            # A stale single-process file at `path` would shadow the
+            # shard set at restore time — remove it.
+            os.remove(path)
+        arrays = {
+            "__version__": np.asarray(SHARD_FORMAT_VERSION),
+            "__num_populations__": np.asarray(len(pga.populations)),
+            "__num_processes__": np.asarray(jax.process_count()),
+            "__save_seq__": np.asarray(seq),
+            "__key__": np.asarray(jax.random.key_data(pga._key)),
+        }
+        for i, pop in enumerate(pga.populations):
+            _pack_array(arrays, f"genomes_{i}", pop.genomes)
+            _pack_array(arrays, f"scores_{i}", pop.scores)
+        np.savez(f"{path}.proc{jax.process_index()}.npz", **arrays)
+        return
+
+    for stale in glob.glob(f"{path}.proc*.npz"):  # see shadow note above
+        os.remove(stale)
     arrays = {
         "__version__": np.asarray(FORMAT_VERSION),
         "__num_populations__": np.asarray(len(pga.populations)),
@@ -65,8 +177,11 @@ class AutoCheckpointer:
         ckpt.close()
 
     On restart, ``checkpoint.restore(pga, "state.npz")`` resumes from the
-    last save (populations + PRNG stream). The reference has no recovery
-    story at all — any CUDA error exits the process (``pga.cu:31``).
+    last save (populations + PRNG stream). Multi-host safe: every process
+    runs the same engine calls, so the metrics listener fires on all of
+    them in lockstep and :func:`save`'s collective contract holds. The
+    reference has no recovery story at all — any CUDA error exits the
+    process (``pga.cu:31``).
     """
 
     def __init__(self, pga: "PGA", path: str, every_generations: int = 1000):
@@ -91,8 +206,55 @@ class AutoCheckpointer:
 def restore(pga: "PGA", path: str) -> None:
     """Load populations and PRNG state saved by :func:`save` into ``pga``.
 
-    Replaces any populations already in the engine.
+    Replaces any populations already in the engine. Accepts both the
+    single-file format and the per-process shard format (all
+    ``<path>.proc*.npz`` files are merged; on a multi-host job the
+    filesystem must be shared, and the caller should barrier after
+    ``save`` before restoring — e.g.
+    ``jax.experimental.multihost_utils.sync_global_devices``).
     """
+    from libpga_tpu.population import Population
+
+    if os.path.exists(path):
+        _restore_single(pga, path)
+        return
+
+    proc_files = sorted(glob.glob(f"{path}.proc*.npz"))
+    if not proc_files:
+        raise FileNotFoundError(f"no checkpoint at {path} (or {path}.proc*.npz)")
+    datas = [np.load(f) for f in proc_files]
+    try:
+        version = int(datas[0]["__version__"])
+        if version != SHARD_FORMAT_VERSION:
+            raise ValueError(f"unsupported shard-checkpoint version {version}")
+        n = int(datas[0]["__num_populations__"])
+        expect = int(datas[0]["__num_processes__"])
+        if len(datas) != expect:
+            raise ValueError(
+                f"found {len(datas)} process files, checkpoint was written "
+                f"by {expect} processes"
+            )
+        seqs = {int(d["__save_seq__"]) for d in datas}
+        if len(seqs) != 1:
+            raise ValueError(
+                f"inconsistent checkpoint: process files carry save "
+                f"sequences {sorted(seqs)} (torn by preemption mid-save?)"
+            )
+        pga._key = jax.random.wrap_key_data(jnp.asarray(datas[0]["__key__"]))
+        pga._populations = [
+            Population(
+                genomes=jnp.asarray(_merge_array(datas, f"genomes_{i}")),
+                scores=jnp.asarray(_merge_array(datas, f"scores_{i}")),
+            )
+            for i in range(n)
+        ]
+        pga._staged = [None] * n
+    finally:
+        for d in datas:
+            d.close()
+
+
+def _restore_single(pga: "PGA", path: str) -> None:
     from libpga_tpu.population import Population
 
     with np.load(path) as data:

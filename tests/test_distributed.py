@@ -1,0 +1,32 @@
+"""Multi-process distributed smoke, as a test.
+
+Runs ``tools/multihost_smoke.py`` — two worker processes, a shared
+8-device global CPU mesh via ``jax.distributed.initialize``, sharded
+island GA with cross-process ring migration, engine-path run with an
+``AutoCheckpointer`` (populations half non-addressable per process),
+per-process shard checkpoint save + merged restore — and asserts the
+harness's own verdict. This is the test the reference's "+MPI" claim
+never had (survey §2.3: zero MPI code in the tree).
+"""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+TOOL = Path(__file__).resolve().parent.parent / "tools" / "multihost_smoke.py"
+
+
+def test_multihost_smoke_with_checkpointing():
+    proc = subprocess.run(
+        [sys.executable, str(TOOL)],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, (
+        f"multihost smoke failed:\n{proc.stdout}\n{proc.stderr}"
+    )
+    assert "MULTIHOST SMOKE: PASS" in proc.stdout
+    assert "checkpoint best" in proc.stdout

@@ -66,6 +66,44 @@ def worker(process_id: int) -> None:
     assert gens == 32
     assert best > 12.0, f"no convergence: {best}"
 
+    # --- engine path + multi-host checkpointing -------------------------
+    # Drive the same workload through the PGA engine with an
+    # AutoCheckpointer attached: after run_islands the engine's
+    # populations are slices of the mesh-sharded result, so roughly half
+    # of them are NON-addressable from each process — save() must write
+    # per-process shard files without ever touching a remote buffer.
+    from jax.experimental import multihost_utils
+
+    from libpga_tpu import PGA, PGAConfig
+    from libpga_tpu.parallel.mesh import ISLAND_AXIS  # noqa: F401
+    from libpga_tpu.utils import checkpoint
+    from libpga_tpu.utils.checkpoint import AutoCheckpointer
+
+    ckpt_path = os.environ["PGA_SMOKE_CKPT"]
+    pga = PGA(seed=5, config=PGAConfig(mutation_rate=0.05))
+    for _ in range(islands):
+        pga.create_population(size, length)
+    pga.set_objective("onemax")
+    ckpt = AutoCheckpointer(pga, ckpt_path, every_generations=10)
+    gens2 = pga.run_islands(20, 5, 0.1, mesh=mesh)
+    assert gens2 == 20
+    best_before = max(
+        global_max(p.scores, mesh) for p in pga.populations
+    )
+    ckpt.close()  # collective: every process writes its shard file
+    multihost_utils.sync_global_devices("pga-smoke-ckpt-saved")
+
+    fresh = PGA(seed=999)
+    checkpoint.restore(fresh, ckpt_path)
+    assert fresh.num_populations == islands
+    best_after = max(float(jnp.max(p.scores)) for p in fresh.populations)
+    print(
+        f"[proc {process_id}] checkpoint best {best_before:.3f} -> "
+        f"restored {best_after:.3f}",
+        flush=True,
+    )
+    assert abs(best_after - best_before) < 1e-5
+
 
 def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
@@ -81,6 +119,10 @@ def main() -> int:
         if not k.startswith("PALLAS_AXON") and not k.startswith("TPU_")
     }
     env["JAX_PLATFORMS"] = "cpu"
+    import tempfile
+
+    ckpt_dir = tempfile.mkdtemp(prefix="pga_smoke_ckpt_")
+    env["PGA_SMOKE_CKPT"] = os.path.join(ckpt_dir, "state.npz")
     procs = [
         subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--worker", str(i)],
