@@ -1,0 +1,79 @@
+"""Island-model scaling over a virtual device mesh.
+
+Runs the sharded island runner (``shard_map`` + ``ppermute`` ring
+migration) for the SAME total workload — 8 islands × 2,048 × 64 OneMax —
+over meshes of 1, 2, 4 and 8 virtual CPU devices, recording wall time per
+epoch at each width. One real TPU chip cannot exercise multi-device
+sharding, so this tracks the collective/sharding overhead trend (NOT
+absolute accelerator speed: all virtual devices share the host's cores,
+so ideal scaling is flat-to-modest here; on real hardware each width adds
+chips). The artifact the trend guards: epoch time must not BLOW UP with
+mesh width — a regression in the ppermute ring or the shard_map layout
+shows up as superlinear growth.
+
+Run: python tools/bench_islands_scaling.py   (forces CPU backend)
+Prints one JSON line.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from libpga_tpu.objectives import onemax
+from libpga_tpu.ops.crossover import uniform_crossover
+from libpga_tpu.ops.mutate import make_point_mutate
+from libpga_tpu.ops.step import make_breed
+from libpga_tpu.parallel.islands import run_islands_stacked
+
+ISLANDS, SIZE, LENGTH = 8, 2048, 64
+
+
+def epoch_seconds(n_devices: int) -> float:
+    mesh = Mesh(np.asarray(jax.devices()[:n_devices]), ("islands",))
+    breed = make_breed(uniform_crossover, make_point_mutate(0.05))
+    stacked = jax.random.uniform(
+        jax.random.key(0), (ISLANDS, SIZE, LENGTH), dtype=jnp.float32
+    )
+    cache = {}
+
+    def run(n):
+        run_islands_stacked(
+            breed, onemax, stacked, jax.random.key(1),
+            n=n, m=5, pct=0.1, mesh=mesh, runner_cache=cache,
+        )
+
+    run(5)  # compile
+    t0 = time.perf_counter()
+    run(10)
+    t_lo = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    run(30)
+    t_hi = time.perf_counter() - t0
+    return max(t_hi - t_lo, 1e-9) / 20  # seconds per generation
+
+
+def main() -> None:
+    per_gen = {d: epoch_seconds(d) for d in (1, 2, 4, 8)}
+    out = {
+        "workload": f"{ISLANDS}x{SIZE}x{LENGTH} onemax, ring m=5 pct=0.1",
+        "backend": "virtual-cpu-mesh",
+        **{f"ms_per_gen_{d}dev": round(v * 1000, 3) for d, v in per_gen.items()},
+        "growth_8dev_vs_1dev": round(per_gen[8] / per_gen[1], 2),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
