@@ -372,6 +372,7 @@ class PGA:
             mutate_kind=self._mutate_kind(),
             elitism=self.config.elitism,
             fused_obj=fused,
+            fused_consts=tuple(getattr(obj, "kernel_rowwise_consts", ())),
             gene_dtype=self.config.gene_dtype,
         )
         self._compiled[cache_key] = pb

@@ -107,14 +107,29 @@ def make_knapsack(values, weights, capacity: float, max_item_count: int = 2):
     values = np.asarray(values, dtype=np.float32)
     weights = np.asarray(weights, dtype=np.float32)
 
-    def knapsack(genome: jax.Array) -> jax.Array:
-        counts = jnp.floor(genome * max_item_count).astype(jnp.float32)
-        total_value = jnp.sum(values * counts)
-        total_weight = jnp.sum(weights * counts)
+    values2 = values.reshape(1, -1)
+    weights2 = weights.reshape(1, -1)
+
+    def knapsack_rows(m: jax.Array, vals=None, wts=None) -> jax.Array:
+        # ``vals``/``wts`` arrive as kernel inputs on the fused path
+        # (Pallas forbids captured array constants); outside a kernel the
+        # closure's host copies serve.
+        vals = values2 if vals is None else vals
+        wts = weights2 if wts is None else wts
+        counts = jnp.floor(m * max_item_count).astype(jnp.float32)
+        total_value = jnp.sum(vals * counts, axis=1)
+        total_weight = jnp.sum(wts * counts, axis=1)
         return jnp.where(
             total_weight <= capacity, total_value, capacity - total_weight
         )
 
+    def knapsack(genome: jax.Array) -> jax.Array:
+        return knapsack_rows(genome[None, :])[0]
+
+    # Pure elementwise + axis-1 reductions: lowers inside the Pallas
+    # breed kernel, so knapsack children are scored in VMEM.
+    knapsack.kernel_rowwise = knapsack_rows
+    knapsack.kernel_rowwise_consts = (values2, weights2)
     return knapsack
 
 
@@ -211,6 +226,31 @@ def make_nk_landscape(n: int, k: int, seed: int = 0):
             contrib = table[jnp.arange(n), codes]
         return jnp.mean(contrib)
 
+    if n_codes <= 64:
+        # Rowwise form for in-kernel fused evaluation: circular rolls
+        # become lane-axis concats of two slices (Mosaic-friendly; no
+        # gathers), the table lookup an accumulated per-code mask against
+        # the (1, n) table rows. Separate-eval NK at 4M population spent
+        # ~half the generation in the evaluation HBM pass. The transposed
+        # table is declared as a kernel-input constant (Pallas forbids
+        # captured arrays).
+        table_t = np.ascontiguousarray(np.asarray(table).T)  # (2^(k+1), n)
+
+        def nk_rows(m: jax.Array, tab_t=None) -> jax.Array:
+            tab_t = table_t if tab_t is None else tab_t
+            bits = (m >= 0.5).astype(jnp.int32)
+            codes = bits
+            for j in range(1, k + 1):
+                rolled = jnp.concatenate([bits[:, j:], bits[:, :j]], axis=1)
+                codes = codes + rolled * (2**j)
+            contrib = jnp.zeros(m.shape, dtype=jnp.float32)
+            for c in range(n_codes):
+                contrib = contrib + jnp.where(codes == c, tab_t[c : c + 1, :], 0.0)
+            return jnp.mean(contrib, axis=1)
+
+        nk.kernel_rowwise = nk_rows
+        nk.kernel_rowwise_consts = (table_t,)
+
     return nk
 
 
@@ -222,14 +262,24 @@ def make_deceptive_trap(trap_size: int = 5):
     away from the optimum. Global optimum = all ones = genome_len.
     """
 
-    def trap(genome: jax.Array) -> jax.Array:
-        L = genome.shape[0]
+    def trap_rows(m: jax.Array) -> jax.Array:
+        # Written once in rowwise form (the per-genome form derives from
+        # it — module convention, see header). Per-block bit counts come
+        # from one small (L, nblocks) one-hot matmul instead of a 3-D
+        # reshape (minor-dim reshapes don't lower in Mosaic), so the
+        # same code serves CPU/XLA and the fused Pallas kernel.
+        L = m.shape[1]
         nblocks = L // trap_size
-        bits = (genome[: nblocks * trap_size] >= 0.5).astype(jnp.float32)
-        ones = jnp.sum(bits.reshape(nblocks, trap_size), axis=1)
+        used = nblocks * trap_size
+        bits = (m[:, :used] >= 0.5).astype(jnp.float32)
+        block_of = jnp.arange(used, dtype=jnp.int32) // trap_size
+        seg = (block_of[:, None] == jnp.arange(nblocks)[None, :]).astype(
+            jnp.float32
+        )
+        ones = jnp.dot(bits, seg, preferred_element_type=jnp.float32)
         block_score = jnp.where(
             ones == trap_size, jnp.float32(trap_size), trap_size - 1.0 - ones
         )
-        return jnp.sum(block_score)
+        return jnp.sum(block_score, axis=1)
 
-    return trap
+    return _rowwise(trap_rows, make_deceptive_trap.__doc__)

@@ -135,13 +135,13 @@ def _breed_kernel(
     mparams_ref,
     scores_ref,
     genomes_ref,
-    out_ref,
     *rest,
     K,
     L,
     Lp,
     mutate="point",
     obj=None,
+    n_consts=0,
     bf16_genes=False,
     P=None,
 ):
@@ -153,10 +153,19 @@ def _breed_kernel(
     operator's runtime parameters ([rate, _] for point mutation,
     [rate, sigma] for gaussian) — runtime scalars so an annealing
     schedule (e.g. Rastrigin's shrinking sigma) reuses one compilation
-    instead of recompiling per phase."""
+    instead of recompiling per phase.
+
+    ``rest`` holds, in order: ``n_consts`` objective-constant input refs
+    (problem data like the NK table — Pallas forbids captured array
+    constants, so fused objectives declare them via
+    ``kernel_rowwise_consts`` and receive them as call arguments), the
+    genome output ref, and (when ``obj`` is set) the score output ref."""
     import jax.lax as lax
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    const_refs = rest[:n_consts]
+    out_ref = rest[n_consts]
 
     i = pl.program_id(0)
     pltpu.prng_seed(seed_ref[0, 0] ^ (i * jnp.int32(-1640531527)))  # golden-ratio mix
@@ -293,8 +302,10 @@ def _breed_kernel(
         # K-element stride-G scatter per grid step, which costs ~12 ms/gen
         # at 1M pop (measured); the caller instead applies a cheap (G,K)
         # transpose to match the riffle-shuffled genome row order.
-        child_scores = obj(child[:, :L]).astype(jnp.float32)
-        rest[0][:] = child_scores.reshape(1, 1, K)
+        child_scores = obj(
+            child[:, :L], *[r[:] for r in const_refs]
+        ).astype(jnp.float32)
+        rest[n_consts + 1][:] = child_scores.reshape(1, 1, K)
 
 
 def make_pallas_breed(
@@ -307,6 +318,7 @@ def make_pallas_breed(
     mutate_kind: str = "point",
     elitism: int = 0,
     fused_obj: Optional[Callable] = None,
+    fused_consts: tuple = (),
     gene_dtype=jnp.float32,
 ) -> Optional[Callable]:
     """Build the fused breed: ``(genomes (P,L), scores (P,), key[, mparams])
@@ -354,6 +366,13 @@ def make_pallas_breed(
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    # Objective constants (problem data) become real kernel inputs:
+    # Pallas rejects captured array constants. Stored 2-D, replicated to
+    # every grid step (index map pinned to the origin).
+    consts = tuple(jnp.atleast_2d(jnp.asarray(c)) for c in fused_consts)
+    if fused_obj is None:
+        consts = ()
+
     kernel = partial(
         _breed_kernel,
         K=K,
@@ -361,6 +380,7 @@ def make_pallas_breed(
         Lp=Lp,
         mutate=mutate_kind,
         obj=fused_obj,
+        n_consts=len(consts),
         bf16_genes=bf16_genes,
         P=P,
     )
@@ -371,6 +391,9 @@ def make_pallas_breed(
         out_specs.append(pl.BlockSpec((1, 1, K), lambda i: (i, 0, 0)))
         out_shape.append(jax.ShapeDtypeStruct((G, 1, K), jnp.float32))
 
+    def _const_spec(c):
+        return pl.BlockSpec(c.shape, lambda i: (0,) * c.ndim)
+
     call = pl.pallas_call(
         kernel,
         grid=(G,),
@@ -379,7 +402,7 @@ def make_pallas_breed(
             pl.BlockSpec((1, 2), lambda i: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, K), lambda i: (i, 0, 0)),
             pl.BlockSpec((K, Lp), lambda i: (i, 0)),
-        ],
+        ] + [_const_spec(c) for c in consts],
         out_specs=out_specs if fused_obj is not None else out_specs[0],
         out_shape=out_shape if fused_obj is not None else out_shape[0],
     )
@@ -400,7 +423,8 @@ def make_pallas_breed(
             dtype=jnp.int32,
         )
         out = call(
-            seed, mparams, scores.reshape(G, 1, K).astype(jnp.float32), gp
+            seed, mparams, scores.reshape(G, 1, K).astype(jnp.float32), gp,
+            *consts,
         )
         if fused_obj is not None:
             genomes, child_scores = out
@@ -477,8 +501,11 @@ def make_pallas_run(
     # INSIDE the breed kernel (children are scored while still in VMEM),
     # eliminating the separate per-generation evaluation pass over HBM
     # (~2 ms/gen at 1M×100; see BASELINE.md). The attribute is an explicit
-    # opt-in set only on builtins verified to lower under Mosaic.
+    # opt-in set only on builtins verified to lower under Mosaic. Problem
+    # data the rowwise form needs (e.g. the NK table) is declared via
+    # ``kernel_rowwise_consts`` and becomes extra kernel inputs.
     fused_obj = getattr(obj, "kernel_rowwise", None)
+    fused_consts = tuple(getattr(obj, "kernel_rowwise_consts", ()))
 
     def build(pop_size: int, genome_len: int):
         breed = make_pallas_breed(
@@ -486,7 +513,8 @@ def make_pallas_run(
             deme_size=deme_size, mutation_rate=mutation_rate,
             mutation_sigma=mutation_sigma, mutate_kind=mutate_kind,
             elitism=elitism if fused_obj is not None else 0,
-            fused_obj=fused_obj, gene_dtype=gene_dtype,
+            fused_obj=fused_obj, fused_consts=fused_consts,
+            gene_dtype=gene_dtype,
         )
         if breed is None:
             return None

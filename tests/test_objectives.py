@@ -109,3 +109,40 @@ def test_objectives_vmap_and_jit(key):
         out = jax.jit(jax.vmap(fn))(genomes)
         assert out.shape == (64,)
         assert bool(jnp.all(jnp.isfinite(out)))
+
+
+def test_kernel_rowwise_forms_match_per_genome(key):
+    """Every objective carrying a ``kernel_rowwise`` batched form (the
+    one the fused Pallas kernel actually evaluates) must agree with its
+    per-genome form — including the factory-built NK / trap / knapsack
+    forms added for in-kernel fused evaluation."""
+    import jax
+
+    from libpga_tpu import objectives
+    from libpga_tpu.objectives import (
+        default_knapsack,
+        make_deceptive_trap,
+        make_nk_landscape,
+    )
+
+    cases = [
+        (objectives.onemax, 24),
+        (objectives.onemax_bits, 24),
+        (objectives.rastrigin, 30),
+        (make_nk_landscape(24, 3, seed=1), 24),
+        (make_deceptive_trap(5), 23),  # 23: exercises the unused tail
+        (default_knapsack, 6),
+    ]
+    for obj, L in cases:
+        rows = getattr(obj, "kernel_rowwise", None)
+        assert rows is not None, obj
+        g = jax.random.uniform(jax.random.fold_in(key, L), (17, L))
+        a = np.asarray(jax.vmap(obj)(g))
+        b = np.asarray(rows(g))
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-6)
+        # Explicit-consts call form — what the fused kernel actually
+        # executes (consts become kernel inputs, not closure copies).
+        consts = tuple(getattr(obj, "kernel_rowwise_consts", ()))
+        if consts:
+            c = np.asarray(rows(g, *(jnp.asarray(x) for x in consts)))
+            np.testing.assert_allclose(b, c, atol=0, rtol=0)
