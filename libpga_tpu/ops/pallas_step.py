@@ -58,10 +58,17 @@ def _valid_deme(k: int) -> bool:
     return bool(k) and not (k & (k - 1)) and 128 <= k <= 1024
 
 
-def _pick_deme_size(pop_size: int, preferred: int):
+def _pick_deme_size(pop_size: int, preferred: int, genome_lanes: int = LANE):
     """Deme size for a population: exact divisors first (zero padding),
     then a padded fit — the kernel pads the population up to the next
     deme multiple and masks the pad rows out of selection.
+
+    ``genome_lanes`` (the lane-padded genome length) bounds the deme:
+    the kernel holds ~6 K×Lp f32-sized buffers in VMEM (parents, child,
+    hi/lo splits, crossover mask), so K·Lp is capped at 600K elements —
+    K=512 at Lp=2048 needs ~23 MB of scoped VMEM and fails to compile,
+    K=256 fits (measured). Genomes too long for even K=128 fall back to
+    the XLA path.
 
     Padded fits must keep the short tail deme healthy: a tail of
     ``tail = P - (G-1)K`` valid rows breeds K children from only
@@ -74,16 +81,19 @@ def _pick_deme_size(pop_size: int, preferred: int):
     configured size, then the larger deme, is preferred; beyond that
     the least-waste fit wins. None (→ XLA path) for populations under
     one 128-row tile or with only degenerate-tail fits."""
-    if _valid_deme(preferred) and pop_size % preferred == 0:
+    def fits(k: int) -> bool:
+        return k * genome_lanes <= 600_000
+
+    if _valid_deme(preferred) and fits(preferred) and pop_size % preferred == 0:
         return preferred
     for k in (1024, 512, 256, 128):
-        if pop_size % k == 0:
+        if fits(k) and pop_size % k == 0:
             return k
     if pop_size < 128:
         return None
     best = None
     for k in (1024, 512, 256, 128):
-        if k > pop_size:
+        if k > pop_size or not fits(k):
             continue
         g = -(-pop_size // k)
         tail = pop_size - (g - 1) * k
@@ -137,6 +147,7 @@ def _breed_kernel(
     genomes_ref,
     *rest,
     K,
+    D,
     L,
     Lp,
     mutate="point",
@@ -145,9 +156,16 @@ def _breed_kernel(
     bf16_genes=False,
     P=None,
 ):
-    """One deme: select parents, crossover, mutate — and, when ``obj`` is
-    given, evaluate the children in-kernel (skipping a whole extra HBM
-    pass per generation). All VMEM/register work.
+    """One grid step = ``D`` consecutive demes: select parents, crossover,
+    mutate — and, when ``obj`` is given, evaluate the children in-kernel
+    (skipping a whole extra HBM pass per generation). All VMEM/register
+    work; the per-deme loop unrolls at trace time.
+
+    Why group demes: each deme's children land in output column g of a
+    ``(K, G/D, D, Lp)`` layout, so a row's writes for one grid step are
+    ``D·Lp`` contiguous values instead of ``Lp`` — D× fewer, larger HBM
+    bursts for the riffle shuffle (whose strided writes grew per-row cost
+    ~25% from 64k to 1M population at D=1).
 
     ``mparams_ref`` is a (1, 2) f32 SMEM block carrying the mutation
     operator's runtime parameters ([rate, _] for point mutation,
@@ -173,76 +191,9 @@ def _breed_kernel(
     # NOTE on shapes: Mosaic only supports minor-dim insertion/transpose
     # for 32-bit types, so every bool/bf16 value here is built directly in
     # its final 2-D/3-D orientation; only f32/i32 get transposed.
-    s3 = scores_ref[:]   # (1, 1, K) f32
-    g = genomes_ref[:]   # (K, Lp) f32
+    s_all = scores_ref[:]   # (1, D, K) f32
+    g_all = genomes_ref[:]  # (D*K, Lp)
 
-    # ---- tournament-2 ×2: four candidate index vectors over valid rows -
-    idx_bits = pltpu.bitcast(pltpu.prng_random_bits((4, K)), jnp.uint32)
-    if P is None or P % K == 0:
-        # exact-divisor population: K = 2^m, mask the bits directly
-        idx = pltpu.bitcast(idx_bits & jnp.uint32(K - 1), jnp.int32)
-    else:
-        # padded population: the last deme holds V = P - i*K < K real
-        # rows (pads beyond them). Sample idx = floor(u * V) so a pad row
-        # can never enter a tournament — the masked-score route would
-        # still clone pad genomes when both candidates land on pads.
-        V = jnp.maximum(jnp.minimum(jnp.int32(K), jnp.int32(P) - i * K), 1)
-        u4 = pltpu.bitcast(idx_bits >> 8, jnp.int32).astype(
-            jnp.float32
-        ) * jnp.float32(2**-24)
-        idx = jnp.minimum((u4 * V.astype(jnp.float32)).astype(jnp.int32), V - 1)
-
-    # Candidate scores: masked f32 reduce on the VPU — exact (no rounding
-    # of scores). The source-major iota-compare (axis 1 = source row =
-    # sublanes) makes the reduction run over sublanes, which the VPU
-    # does ~2× faster than a lane reduction (measured 10.2 → 8.3 ms/gen
-    # at 1M×100).
-    cand_src = lax.broadcasted_iota(jnp.int32, (4, K, K), 1) == idx[:, None, :]
-    sc = jnp.sum(jnp.where(cand_src, s3.reshape(1, K, 1), 0.0), axis=1)  # (4, K)
-    sc_t = sc.T  # (K, 4) — f32 transpose is supported
-
-    # Tie -> first candidate, matching the reference's strict '>'
-    # (pga.cu:286). Winner INDICES are resolved first and only the two
-    # winning one-hots are materialized. The alternative — build all
-    # four candidate one-hots and where-select between them — costs two
-    # extra (K, K) mask builds and two (K, K) bf16 selects per deme and
-    # measured ~30% of the whole generation (89 → 126 gens/sec at
-    # 1M×100 f32 K=256; 99 → 147 at K=512 bf16).
-    w1 = sc_t[:, 0:1] >= sc_t[:, 1:2]  # (K, 1) bool
-    w2 = sc_t[:, 2:3] >= sc_t[:, 3:4]
-    idx_t = idx.T  # (K, 4) i32 transpose is supported
-    widx1 = jnp.where(w1, idx_t[:, 0:1], idx_t[:, 1:2])  # (K, 1)
-    widx2 = jnp.where(w2, idx_t[:, 2:3], idx_t[:, 3:4])
-    src_cols = lax.broadcasted_iota(jnp.int32, (K, K), 1)
-    oh1 = (src_cols == widx1).astype(jnp.bfloat16)  # (K, K) winner selectors
-    oh2 = (src_cols == widx2).astype(jnp.bfloat16)
-
-    # ---- parent rows via one-hot matmul -------------------------------
-    if bf16_genes:
-        # bf16 genomes are selected exactly by a single bf16 matmul
-        # (0/1 selector rows; f32 accumulation) — half the FLOPs and HBM
-        # traffic of the f32 hi/lo path.
-        def sel(oh_w):
-            return jnp.dot(oh_w, g, preferred_element_type=jnp.float32)
-
-    else:
-        # f32 genomes: bf16 hi/lo split, ~1e-5 absolute gene accuracy.
-        g_hi = g.astype(jnp.bfloat16)
-        g_lo = (g - g_hi.astype(jnp.float32)).astype(jnp.bfloat16)
-
-        def sel(oh_w):
-            hi = jnp.dot(oh_w, g_hi, preferred_element_type=jnp.float32)
-            lo = jnp.dot(oh_w, g_lo, preferred_element_type=jnp.float32)
-            return hi + lo
-
-    p1 = sel(oh1)  # (K, Lp) f32
-    p2 = sel(oh2)
-
-    # ---- uniform crossover: per-gene coin flip (pga.cu:135-143) ---------
-    mask_bits = pltpu.bitcast(pltpu.prng_random_bits((K, Lp)), jnp.uint32)
-    child = jnp.where(mask_bits >> 31 == 0, p1, p2)
-
-    # ---- mutation -----------------------------------------------------
     # uint32 -> f32 isn't a supported Mosaic cast; >>8 leaves 24 bits, so
     # bitcast to i32 before the float convert.
     def uniform(shape):
@@ -252,60 +203,148 @@ def _breed_kernel(
         ) * jnp.float32(2**-24)
 
     rate = mparams_ref[0, 0]
-    if mutate == "point":
-        # Point mutation (pga.cu:127-133): one random gene per firing row.
-        u_t = uniform((4, K)).T  # (K, 4) f32
-        pos = jnp.floor(u_t[:, 0:1] * L).astype(jnp.int32)  # (K, 1) in [0, L)
-        cols = lax.broadcasted_iota(jnp.int32, (K, Lp), 1)
-        # Strict '<' so rate=0 disables mutation exactly (the reference's
-        # ``rand[1] <= chance`` gate, pga.cu:128, differs only on a
-        # measure-zero event for rate in (0,1)).
-        hit = (cols == pos) & (u_t[:, 1:2] < rate)
-        child = jnp.where(hit, u_t[:, 2:3], child)
-    elif mutate == "gaussian":
-        # Per-gene Gaussian perturbation (ops/mutate.gaussian_mutate
-        # semantics): each gene independently fires with probability
-        # ``rate`` and receives N(0, sigma^2) noise, clipped to [0, 1).
-        # Box-Muller from two independent in-kernel uniform draws; the
-        # gate draw is a third stream, so noise sign stays independent
-        # of firing (see the XLA operator's docstring).
-        sigma = mparams_ref[0, 1]
-        gate = uniform((K, Lp))
-        u1 = jnp.clip(uniform((K, Lp)), 1e-7, 1.0 - 1e-7)
-        u2 = uniform((K, Lp))
-        normal = jnp.sqrt(-2.0 * jnp.log(u1)) * jnp.cos(
-            2.0 * jnp.float32(math.pi) * u2
+
+    for d in range(D):
+        g = g_all[d * K : (d + 1) * K, :]  # (K, Lp)
+        s3 = s_all[:, d, :]  # (1, K)
+
+        # ---- tournament-2 ×2: four candidate indices over valid rows --
+        idx_bits = pltpu.bitcast(pltpu.prng_random_bits((4, K)), jnp.uint32)
+        if P is None or P % K == 0:
+            # exact-divisor population: K = 2^m, mask the bits directly
+            idx = pltpu.bitcast(idx_bits & jnp.uint32(K - 1), jnp.int32)
+        else:
+            # padded population: the last deme holds V = P - g·K < K real
+            # rows (pads beyond them). Sample idx = floor(u * V) so a pad
+            # row can never enter a tournament — the masked-score route
+            # would still clone pad genomes when both candidates land on
+            # pads.
+            deme = i * D + d
+            V = jnp.maximum(
+                jnp.minimum(jnp.int32(K), jnp.int32(P) - deme * K), 1
+            )
+            u4 = pltpu.bitcast(idx_bits >> 8, jnp.int32).astype(
+                jnp.float32
+            ) * jnp.float32(2**-24)
+            idx = jnp.minimum(
+                (u4 * V.astype(jnp.float32)).astype(jnp.int32), V - 1
+            )
+
+        # Candidate scores: masked f32 reduce on the VPU — exact (no
+        # rounding of scores). The source-major iota-compare (axis 1 =
+        # source row = sublanes) makes the reduction run over sublanes,
+        # which the VPU does ~2× faster than a lane reduction (measured
+        # 10.2 → 8.3 ms/gen at 1M×100).
+        cand_src = (
+            lax.broadcasted_iota(jnp.int32, (4, K, K), 1) == idx[:, None, :]
         )
-        mutated = jnp.clip(child + sigma * normal, 0.0, 1.0 - 1e-7)
-        child = jnp.where(gate < rate, mutated, child)
-    else:
-        raise ValueError(f"unknown mutate kind {mutate!r}")
+        sc = jnp.sum(
+            jnp.where(cand_src, s3.reshape(1, K, 1), 0.0), axis=1
+        )  # (4, K)
+        sc_t = sc.T  # (K, 4) — f32 transpose is supported
 
-    # Write through the (K, 1, 1, Lp) block: deme i becomes column i of the
-    # (K, G, 1, Lp) output, so the row-major reshape interleaves demes.
-    out_dtype = jnp.bfloat16 if bf16_genes else jnp.float32
-    child = child.astype(out_dtype)
-    out_ref[:] = child.reshape(K, 1, 1, Lp)
-    if bf16_genes:
-        # Score the STORED genes: evaluating the pre-rounding f32 child
-        # would return scores the written bf16 genomes don't achieve.
-        child = child.astype(jnp.float32)
+        # Tie -> first candidate, matching the reference's strict '>'
+        # (pga.cu:286). Winner INDICES are resolved first and only the
+        # two winning one-hots are materialized. The alternative — build
+        # all four candidate one-hots and where-select between them —
+        # costs two extra (K, K) mask builds and two (K, K) bf16 selects
+        # per deme and measured ~30% of the whole generation (89 → 126
+        # gens/sec at 1M×100 f32 K=256; 99 → 147 at K=512 bf16).
+        w1 = sc_t[:, 0:1] >= sc_t[:, 1:2]  # (K, 1) bool
+        w2 = sc_t[:, 2:3] >= sc_t[:, 3:4]
+        idx_t = idx.T  # (K, 4) i32 transpose is supported
+        widx1 = jnp.where(w1, idx_t[:, 0:1], idx_t[:, 1:2])  # (K, 1)
+        widx2 = jnp.where(w2, idx_t[:, 2:3], idx_t[:, 3:4])
+        src_cols = lax.broadcasted_iota(jnp.int32, (K, K), 1)
+        oh1 = (src_cols == widx1).astype(jnp.bfloat16)  # winner selectors
+        oh2 = (src_cols == widx2).astype(jnp.bfloat16)
 
-    if obj is not None:
-        # Fused evaluation: score the children while they're in VMEM,
-        # skipping the separate per-generation evaluation pass over HBM.
-        # ``obj`` here is the objective's ROWWISE form ((K, L) -> (K,)
-        # with axis=1 reductions): a per-genome fn under jax.vmap unrolls
-        # into K scalar reductions in Mosaic (~100× slower, measured).
-        # Scores write as ONE contiguous (1,1,K) row per deme — routing
-        # them through the genome output's column mapping would mean a
-        # K-element stride-G scatter per grid step, which costs ~12 ms/gen
-        # at 1M pop (measured); the caller instead applies a cheap (G,K)
-        # transpose to match the riffle-shuffled genome row order.
-        child_scores = obj(
-            child[:, :L], *[r[:] for r in const_refs]
-        ).astype(jnp.float32)
-        rest[n_consts + 1][:] = child_scores.reshape(1, 1, K)
+        # ---- parent rows via one-hot matmul ---------------------------
+        if bf16_genes:
+            # bf16 genomes are selected exactly by a single bf16 matmul
+            # (0/1 selector rows; f32 accumulation) — half the FLOPs and
+            # HBM traffic of the f32 hi/lo path.
+            def sel(oh_w):
+                return jnp.dot(oh_w, g, preferred_element_type=jnp.float32)
+
+        else:
+            # f32 genomes: bf16 hi/lo split, ~1e-5 absolute gene accuracy.
+            g_hi = g.astype(jnp.bfloat16)
+            g_lo = (g - g_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+
+            def sel(oh_w):
+                hi = jnp.dot(oh_w, g_hi, preferred_element_type=jnp.float32)
+                lo = jnp.dot(oh_w, g_lo, preferred_element_type=jnp.float32)
+                return hi + lo
+
+        p1 = sel(oh1)  # (K, Lp) f32
+        p2 = sel(oh2)
+
+        # ---- uniform crossover: per-gene coin flip (pga.cu:135-143) ----
+        mask_bits = pltpu.bitcast(pltpu.prng_random_bits((K, Lp)), jnp.uint32)
+        child = jnp.where(mask_bits >> 31 == 0, p1, p2)
+
+        # ---- mutation -------------------------------------------------
+        if mutate == "point":
+            # Point mutation (pga.cu:127-133): one random gene per firing
+            # row.
+            u_t = uniform((4, K)).T  # (K, 4) f32
+            pos = jnp.floor(u_t[:, 0:1] * L).astype(jnp.int32)  # in [0, L)
+            cols = lax.broadcasted_iota(jnp.int32, (K, Lp), 1)
+            # Strict '<' so rate=0 disables mutation exactly (the
+            # reference's ``rand[1] <= chance`` gate, pga.cu:128, differs
+            # only on a measure-zero event for rate in (0,1)).
+            hit = (cols == pos) & (u_t[:, 1:2] < rate)
+            child = jnp.where(hit, u_t[:, 2:3], child)
+        elif mutate == "gaussian":
+            # Per-gene Gaussian perturbation (ops/mutate.gaussian_mutate
+            # semantics): each gene independently fires with probability
+            # ``rate`` and receives N(0, sigma^2) noise, clipped to
+            # [0, 1). Box-Muller from two independent in-kernel uniform
+            # draws; the gate draw is a third stream, so noise sign stays
+            # independent of firing (see the XLA operator's docstring).
+            sigma = mparams_ref[0, 1]
+            gate = uniform((K, Lp))
+            u1 = jnp.clip(uniform((K, Lp)), 1e-7, 1.0 - 1e-7)
+            u2 = uniform((K, Lp))
+            normal = jnp.sqrt(-2.0 * jnp.log(u1)) * jnp.cos(
+                2.0 * jnp.float32(math.pi) * u2
+            )
+            mutated = jnp.clip(child + sigma * normal, 0.0, 1.0 - 1e-7)
+            child = jnp.where(gate < rate, mutated, child)
+        else:
+            raise ValueError(f"unknown mutate kind {mutate!r}")
+
+        # Write deme d into output column d of the group: the row-major
+        # reshape of (K, G/D, D, Lp) interleaves all demes (row index
+        # r·G + i·D + d — the same riffle as the D=1 layout).
+        out_dtype = jnp.bfloat16 if bf16_genes else jnp.float32
+        child = child.astype(out_dtype)
+        out_ref[:, 0, d, :] = child
+        if bf16_genes:
+            # Score the STORED genes: evaluating the pre-rounding f32
+            # child would return scores the written bf16 genomes don't
+            # achieve.
+            child = child.astype(jnp.float32)
+
+        if obj is not None:
+            # Fused evaluation: score the children while they're in VMEM,
+            # skipping the separate per-generation evaluation pass over
+            # HBM. ``obj`` here is the objective's ROWWISE form
+            # ((K, L) -> (K,) with axis=1 reductions): a per-genome fn
+            # under jax.vmap unrolls into K scalar reductions in Mosaic
+            # (~100× slower, measured). Scores write as ONE contiguous
+            # (1,1,K) row per deme — routing them through the genome
+            # output's column mapping would mean a K-element strided
+            # scatter per deme, which costs ~12 ms/gen at 1M pop
+            # (measured); the caller instead applies a cheap (G,K)
+            # transpose to match the riffle-shuffled genome row order.
+            child_scores = obj(
+                child[:, :L], *[r[:] for r in const_refs]
+            ).astype(jnp.float32)
+            rest[n_consts + 1][d : d + 1, :, :] = child_scores.reshape(
+                1, 1, K
+            )
 
 
 def make_pallas_breed(
@@ -320,6 +359,7 @@ def make_pallas_breed(
     fused_obj: Optional[Callable] = None,
     fused_consts: tuple = (),
     gene_dtype=jnp.float32,
+    _demes_per_step: Optional[int] = None,
 ) -> Optional[Callable]:
     """Build the fused breed: ``(genomes (P,L), scores (P,), key[, mparams])
     -> next_genomes (P, L)`` — or, with ``fused_obj``, ``-> (next_genomes,
@@ -356,12 +396,36 @@ def make_pallas_breed(
     if not deme_size:
         deme_size = auto_deme_size(gene_dtype)
     P, L = pop_size, genome_len
-    K = _pick_deme_size(P, deme_size)
+    Lp = math.ceil(L / LANE) * LANE
+    K = _pick_deme_size(P, deme_size, genome_lanes=Lp)
     if K is None:
         return None
     G = math.ceil(P / K)
     Pp = G * K  # padded row count; == P for exact-divisor populations
-    Lp = math.ceil(L / LANE) * LANE
+    # Demes per grid step: larger groups write D·Lp-contiguous bursts
+    # through the riffle layout (see _breed_kernel). Measured at 1M×100:
+    # bf16 genes gain ~7% at D=8 (write-bound: half the bytes per FLOP);
+    # f32 genes are fastest at D=1 (the hi/lo path's extra VMEM pressure
+    # with D·K-row blocks outweighs the burst win) — so the default
+    # groups only for bf16. Candidates must divide G and keep the
+    # (D·K, Lp) genome block within a VMEM budget (long genomes that
+    # compile at D=1 must not start failing grouped).
+    # Budget note: the ~16 MiB scoped VMEM also holds the output block
+    # (same size), one deme's f32 parent/child intermediates (K·Lp·4B
+    # each), and the tournament masks — 2 MiB of input block is the
+    # measured safe bound (4 MiB OOMs at Lp=2048).
+    gene_bytes = 2 if bf16_genes else 4
+    d_candidates = [
+        d for d in (8, 4, 2, 1)
+        if G % d == 0 and d * K * Lp * gene_bytes <= 2 * 1024 * 1024
+    ] or [1]
+    if _demes_per_step:
+        # round an explicit request down to the largest valid candidate
+        D = next((d for d in d_candidates if d <= _demes_per_step), 1)
+    elif bf16_genes:
+        D = d_candidates[0]
+    else:
+        D = 1
 
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -376,6 +440,7 @@ def make_pallas_breed(
     kernel = partial(
         _breed_kernel,
         K=K,
+        D=D,
         L=L,
         Lp=Lp,
         mutate=mutate_kind,
@@ -385,10 +450,10 @@ def make_pallas_breed(
         P=P,
     )
 
-    out_specs = [pl.BlockSpec((K, 1, 1, Lp), lambda i: (0, i, 0, 0))]
-    out_shape = [jax.ShapeDtypeStruct((K, G, 1, Lp), gene_dtype)]
+    out_specs = [pl.BlockSpec((K, 1, D, Lp), lambda i: (0, i, 0, 0))]
+    out_shape = [jax.ShapeDtypeStruct((K, G // D, D, Lp), gene_dtype)]
     if fused_obj is not None:
-        out_specs.append(pl.BlockSpec((1, 1, K), lambda i: (i, 0, 0)))
+        out_specs.append(pl.BlockSpec((D, 1, K), lambda i: (i, 0, 0)))
         out_shape.append(jax.ShapeDtypeStruct((G, 1, K), jnp.float32))
 
     def _const_spec(c):
@@ -396,12 +461,12 @@ def make_pallas_breed(
 
     call = pl.pallas_call(
         kernel,
-        grid=(G,),
+        grid=(G // D,),
         in_specs=[
             pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 2), lambda i: (0, 0), memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, K), lambda i: (i, 0, 0)),
-            pl.BlockSpec((K, Lp), lambda i: (i, 0)),
+            pl.BlockSpec((1, D, K), lambda i: (i, 0, 0)),
+            pl.BlockSpec((D * K, Lp), lambda i: (i, 0)),
         ] + [_const_spec(c) for c in consts],
         out_specs=out_specs if fused_obj is not None else out_specs[0],
         out_shape=out_shape if fused_obj is not None else out_shape[0],
@@ -423,7 +488,8 @@ def make_pallas_breed(
             dtype=jnp.int32,
         )
         out = call(
-            seed, mparams, scores.reshape(G, 1, K).astype(jnp.float32), gp,
+            seed, mparams,
+            scores.reshape(G // D, D, K).astype(jnp.float32), gp,
             *consts,
         )
         if fused_obj is not None:
@@ -457,6 +523,7 @@ def make_pallas_breed(
     breed.Lp = Lp
     breed.Pp = Pp
     breed.K = K
+    breed.D = D  # actual demes-per-step (an explicit request may round down)
     breed.fused = fused_obj is not None
     breed.gene_dtype = gene_dtype
     breed.takes_params = True

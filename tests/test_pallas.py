@@ -314,6 +314,30 @@ def test_engine_bf16_genes_on_xla_path():
     assert pga.get_best(pop).shape == (8,)
 
 
+def test_deme_grouping_selection_and_vmem_cap():
+    """bf16 groups demes (D>1) when G divides; f32 stays at D=1; long
+    genomes whose grouped block would blow the VMEM budget fall back to
+    D=1 instead of failing at Mosaic compile time; explicit requests
+    round down to a valid divisor and are reported via breed.D."""
+    b = make_pallas_breed(4096, 16, deme_size=256, gene_dtype=jnp.bfloat16)
+    assert b.D == 8  # G=16, divisible
+    b = make_pallas_breed(4096, 16, deme_size=256)
+    assert b.D == 1  # f32 default
+    # bf16, genome_len 2000 -> Lp=2048: K=512 would need ~23 MB of
+    # scoped VMEM (fails to compile), so the deme is capped at K=256;
+    # grouping stays within its block budget at D=2 (verified to compile
+    # and run on hardware)
+    b = make_pallas_breed(1 << 20, 2000, deme_size=512, gene_dtype=jnp.bfloat16)
+    assert b.K == 256 and b.D == 2
+    # genomes too long for even K=128 fall back to the XLA path
+    from libpga_tpu.ops.pallas_step import _pick_deme_size
+
+    assert _pick_deme_size(1 << 20, 256, genome_lanes=8192) is None
+    # explicit request with G=12 (not divisible by 8) rounds down to 4
+    b = make_pallas_breed(12 * 256, 16, deme_size=256, _demes_per_step=8)
+    assert b.D == 4
+
+
 def test_gaussian_kernel_rate_zero_and_sigma_zero_are_noops():
     """Gaussian in-kernel mutation: rate=0 never fires; rate=1 with
     sigma=0 fires everywhere but perturbs nothing (clip is identity on
