@@ -207,7 +207,7 @@ class PGA:
             # instance in and must stay keyed by it below.
             pkey = (
                 "runP", size, genome_len, obj, pallas_kind,
-                self.config.elitism,
+                self.config.elitism, self.config.tournament_size,
             )
             cached = self._compiled.get(pkey)
             if cached is None:
@@ -323,13 +323,13 @@ class PGA:
         """Single source of truth for Pallas fast-path eligibility, shared
         by the single-population run loop and the island runner. The
         kernel implements uniform crossover with point or gaussian
-        mutation, tournament-2, elitism (fused objectives), and f32/bf16
-        genes, and requires a real TPU."""
+        mutation, k-way tournaments (k ≤ 16), elitism (fused
+        objectives), and f32/bf16 genes, and requires a real TPU."""
         if not (
             self.config.pallas_enabled()
             and self._crossover is uniform_crossover
             and self._mutate_kind() is not None
-            and self.config.tournament_size == 2
+            and 1 <= self.config.tournament_size <= 16
             and self.config.gene_dtype in (jnp.float32, jnp.bfloat16)
         ):
             return False
@@ -360,6 +360,7 @@ class PGA:
         cache_key = (
             "island_breed", island_size, genome_len, obj, fused,
             self._mutate_kind(), self.config.elitism,
+            self.config.tournament_size,
         )
         if cache_key in self._compiled:
             return self._compiled[cache_key]
@@ -367,6 +368,7 @@ class PGA:
             island_size,
             genome_len,
             deme_size=self.config.pallas_deme_size,
+            tournament_size=self.config.tournament_size,
             mutation_rate=self._mutation_rate(),
             mutation_sigma=self._operator_param("sigma", 0.0),
             mutate_kind=self._mutate_kind(),

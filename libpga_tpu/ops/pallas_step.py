@@ -1,8 +1,9 @@
-"""Fused Pallas generation step — the TPU fast path for default operators.
+"""Fused Pallas generation step — the TPU fast path.
 
-One kernel = one whole generation of breeding: tournament-2 selection,
-uniform crossover, and point mutation, fused over a VMEM-resident deme of
-the population. This is the TPU answer to the reference's hot loop, which
+One kernel = one whole generation of breeding: k-way tournament selection
+(k ≤ 16; default 2), uniform crossover, and point or gaussian mutation,
+fused over a VMEM-resident deme of the population — plus optional
+in-kernel evaluation and elitism. This is the TPU answer to the reference's hot loop, which
 issues ceil(pop/512) chunked launches per operator with a full device sync
 after each (``/root/reference/src/pga.cu:62-77,269``): here the entire
 population breeds in one pass over HBM with zero intermediate HBM traffic.
@@ -15,7 +16,7 @@ This kernel removes all HBM random access:
 - **Demes**: the population is processed in blocks ("demes") of ``K``
   rows that live entirely in VMEM. Selection happens *within* a deme, so
   every random access is on-chip.
-- **Selection + gather on the MXU**: a k=2 tournament needs ``s[idx]``
+- **Selection + gather on the MXU**: a k-way tournament needs ``s[idx]``
   lookups and parent-row gathers; both become one-hot matmuls
   (``onehot @ scores`` and ``onehot @ genomes``), which the MXU executes
   at full tilt. Gene matrices multiply as a bf16 hi/lo split
@@ -31,10 +32,10 @@ This kernel removes all HBM random access:
   shuffle), so deme membership changes every generation and selection is
   panmictic over a few-generation horizon.
 
-Semantics note: selection is tournament-2 *within the current deme* (a
+Semantics note: selection is a tournament *within the current deme* (a
 random cohort of ``K`` that reshuffles every generation), not i.i.d. over
-the full population. Selection intensity is identical to panmictic
-tournament-2; only opponent locality differs, and the per-generation
+the full population. Selection intensity is identical to the panmictic
+tournament; only opponent locality differs, and the per-generation
 riffle shuffle randomizes it. The exact-panmictic path remains available
 via the XLA breed step (``use_pallas=False``).
 """
@@ -150,6 +151,7 @@ def _breed_kernel(
     D,
     L,
     Lp,
+    tk=2,
     mutate="point",
     obj=None,
     n_consts=0,
@@ -204,12 +206,14 @@ def _breed_kernel(
 
     rate = mparams_ref[0, 0]
 
+    T = 2 * tk  # candidate index vectors: tk per parent, two parents
+
     for d in range(D):
         g = g_all[d * K : (d + 1) * K, :]  # (K, Lp)
         s3 = s_all[:, d, :]  # (1, K)
 
-        # ---- tournament-2 ×2: four candidate indices over valid rows --
-        idx_bits = pltpu.bitcast(pltpu.prng_random_bits((4, K)), jnp.uint32)
+        # ---- tournament-k ×2: 2k candidate indices over valid rows ----
+        idx_bits = pltpu.bitcast(pltpu.prng_random_bits((T, K)), jnp.uint32)
         if P is None or P % K == 0:
             # exact-divisor population: K = 2^m, mask the bits directly
             idx = pltpu.bitcast(idx_bits & jnp.uint32(K - 1), jnp.int32)
@@ -236,25 +240,36 @@ def _breed_kernel(
         # which the VPU does ~2× faster than a lane reduction (measured
         # 10.2 → 8.3 ms/gen at 1M×100).
         cand_src = (
-            lax.broadcasted_iota(jnp.int32, (4, K, K), 1) == idx[:, None, :]
+            lax.broadcasted_iota(jnp.int32, (T, K, K), 1) == idx[:, None, :]
         )
         sc = jnp.sum(
             jnp.where(cand_src, s3.reshape(1, K, 1), 0.0), axis=1
-        )  # (4, K)
-        sc_t = sc.T  # (K, 4) — f32 transpose is supported
+        )  # (T, K)
+        sc_t = sc.T  # (K, T) — f32 transpose is supported
 
         # Tie -> first candidate, matching the reference's strict '>'
-        # (pga.cu:286). Winner INDICES are resolved first and only the
-        # two winning one-hots are materialized. The alternative — build
-        # all four candidate one-hots and where-select between them —
-        # costs two extra (K, K) mask builds and two (K, K) bf16 selects
-        # per deme and measured ~30% of the whole generation (89 → 126
-        # gens/sec at 1M×100 f32 K=256; 99 → 147 at K=512 bf16).
-        w1 = sc_t[:, 0:1] >= sc_t[:, 1:2]  # (K, 1) bool
-        w2 = sc_t[:, 2:3] >= sc_t[:, 3:4]
-        idx_t = idx.T  # (K, 4) i32 transpose is supported
-        widx1 = jnp.where(w1, idx_t[:, 0:1], idx_t[:, 1:2])  # (K, 1)
-        widx2 = jnp.where(w2, idx_t[:, 2:3], idx_t[:, 3:4])
+        # (pga.cu:286). Winner INDICES are resolved first (a strict-'>'
+        # fold over each parent's k candidates, so the earliest best
+        # wins) and only the two winning one-hots are materialized. The
+        # alternative — build all candidate one-hots and where-select
+        # between them — measured ~30% of the whole generation at k=2
+        # (89 → 126 gens/sec at 1M×100 f32 K=256; 99 → 147 at K=512
+        # bf16).
+        idx_t = idx.T  # (K, T) i32 transpose is supported
+
+        def tourney(base):
+            best_s = sc_t[:, base : base + 1]  # (K, 1)
+            best_i = idx_t[:, base : base + 1]
+            for c in range(1, tk):
+                s_c = sc_t[:, base + c : base + c + 1]
+                i_c = idx_t[:, base + c : base + c + 1]
+                better = s_c > best_s
+                best_s = jnp.where(better, s_c, best_s)
+                best_i = jnp.where(better, i_c, best_i)
+            return best_i
+
+        widx1 = tourney(0)
+        widx2 = tourney(tk)
         src_cols = lax.broadcasted_iota(jnp.int32, (K, K), 1)
         oh1 = (src_cols == widx1).astype(jnp.bfloat16)  # winner selectors
         oh2 = (src_cols == widx2).astype(jnp.bfloat16)
@@ -352,6 +367,7 @@ def make_pallas_breed(
     genome_len: int,
     *,
     deme_size: Optional[int] = None,
+    tournament_size: int = 2,
     mutation_rate: float = 0.01,
     mutation_sigma: float = 0.0,
     mutate_kind: str = "point",
@@ -388,6 +404,10 @@ def make_pallas_breed(
         return None
     if mutate_kind not in ("point", "gaussian"):
         return None
+    if not (1 <= tournament_size <= 16):
+        # k-way selection materializes 2k (K, K) candidate masks; cap
+        # where their VMEM cost stops making sense.
+        return None
     if elitism > 0 and fused_obj is None:
         # The epilogue needs next-generation scores; without fused
         # evaluation the caller (engine run loop) applies elitism itself.
@@ -398,6 +418,18 @@ def make_pallas_breed(
     P, L = pop_size, genome_len
     Lp = math.ceil(L / LANE) * LANE
     K = _pick_deme_size(P, deme_size, genome_lanes=Lp)
+
+    # k-way selection materializes 2k (K, K) candidate masks; keep their
+    # footprint within the scoped-VMEM budget (2k·K² ≤ 2M elements — the
+    # verified k=2/K=512 and k=4/K=256 shapes sit at ~1M/0.5M). Large k
+    # retries with the smallest deme before declining to the XLA path.
+    def _mask_ok(k_deme):
+        return k_deme is not None and 2 * tournament_size * k_deme**2 <= 2_000_000
+
+    if not _mask_ok(K):
+        K = _pick_deme_size(P, 128, genome_lanes=Lp)
+        if not _mask_ok(K):
+            return None
     if K is None:
         return None
     G = math.ceil(P / K)
@@ -443,6 +475,7 @@ def make_pallas_breed(
         D=D,
         L=L,
         Lp=Lp,
+        tk=tournament_size,
         mutate=mutate_kind,
         obj=fused_obj,
         n_consts=len(consts),
@@ -549,9 +582,10 @@ def make_pallas_run(
     ``(genomes, key, n, target, mparams) -> (genomes, scores, gens)`` with
     the same contract as the XLA path in ``engine._compiled_run`` (plus
     the runtime mutation-params input — see ``make_pallas_breed``), or
-    None when unsupported (k != 2, non-TPU backend, or per-shape inside
-    the factory) — the engine then falls back to the XLA path."""
-    if tournament_size != 2 or not _supported():
+    None when unsupported (non-TPU backend, tournament size out of the
+    kernel's 1..16 range, or per-shape inside the factory) — the engine
+    then falls back to the XLA path."""
+    if not _supported():
         return None
     # The Mosaic kernel only lowers on TPU; an explicit use_pallas=True on
     # CPU/GPU must fall back, not crash at trace time. (make_pallas_breed
@@ -577,7 +611,8 @@ def make_pallas_run(
     def build(pop_size: int, genome_len: int):
         breed = make_pallas_breed(
             pop_size, genome_len,
-            deme_size=deme_size, mutation_rate=mutation_rate,
+            deme_size=deme_size, tournament_size=tournament_size,
+            mutation_rate=mutation_rate,
             mutation_sigma=mutation_sigma, mutate_kind=mutate_kind,
             elitism=elitism if fused_obj is not None else 0,
             fused_obj=fused_obj, fused_consts=fused_consts,

@@ -82,8 +82,33 @@ def test_engine_mutation_rate_from_raw_partial():
     assert pga._mutation_rate() == pga.config.mutation_rate
 
 
-def test_run_factory_gates_on_tournament_size():
-    assert make_pallas_run(onemax, tournament_size=3) is None
+def test_run_factory_tournament_size_bounds():
+    """k-way tournaments are served in-kernel up to k=16; absurd sizes
+    decline to the XLA path instead of materializing 2k (K,K) masks."""
+    assert make_pallas_breed(1024, 10, tournament_size=0) is None
+    assert make_pallas_breed(1024, 10, tournament_size=17) is None
+    assert make_pallas_breed(1024, 10, tournament_size=3) is not None
+
+
+def test_kernel_structure_tournament_k3():
+    """Zero PRNG bits with k=3: every candidate is deme row 0, so the
+    winner fold (strict '>', first-best retained) must still produce the
+    deme-row-0 child structure."""
+    P, L, K = 512, 12, 128
+    G = P // K
+    with _interpret():
+        breed = make_pallas_breed(
+            P, L, deme_size=K, mutation_rate=0.0, tournament_size=3
+        )
+        genomes = (
+            jnp.broadcast_to(jnp.arange(P, dtype=jnp.float32)[:, None], (P, L))
+            / P
+        )
+        out = np.asarray(breed(genomes, jnp.zeros((P,)), jax.random.key(0)))
+    expect = np.asarray([((r % G) * K) / P for r in range(P)], np.float32)
+    np.testing.assert_allclose(
+        out, np.broadcast_to(expect[:, None], (P, L)), atol=2e-5, rtol=0
+    )
 
 
 @pytest.mark.skipif(

@@ -79,6 +79,21 @@ def main() -> int:
         abs(pos.mean() - (L - 1) / 2) < 2.0,
     )
 
+    # k=4 tournament: mean winner score of uniform scores is E[max of 4]
+    # = 4/5 (tournament-2's 2/3 analog) — validates the k-way winner fold.
+    breed4 = make_pallas_breed(P, L, deme_size=K, mutation_rate=0.0,
+                               tournament_size=4)
+    out4 = np.asarray(breed4(genomes, scores, jax.random.key(11)))
+    p4 = []
+    for r in range(0, P, 3):
+        ids = np.unique(np.round(out4[r] * P).astype(int))
+        p4.extend(sn[ids])
+    pressure4 = float(np.mean(p4))
+    good &= check(
+        f"k=4 selection pressure ~4/5 (got {pressure4:.3f})",
+        0.77 < pressure4 < 0.83,
+    )
+
     # Padded population (no deme divides 3000): with real entropy, every
     # child must still descend from VALID rows only — the last deme holds
     # 3000 - 11*256 = 184 real rows and 72 pads the tournament sampler
