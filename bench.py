@@ -122,6 +122,20 @@ def bench_single(gene_dtype) -> dict:
     }
 
 
+def bench_reference_scale() -> dict:
+    """The reference driver's EXACT workload shape: population 40,000
+    (no power-of-two deme divisor — exercises the internal padding
+    path) × 100 genes, f32."""
+    from libpga_tpu import PGA, PGAConfig
+
+    pga = PGA(seed=3, config=PGAConfig(use_pallas=True))
+    pga.create_population(40_000, GENOME_LEN)
+    pga.set_objective("onemax")
+    pga.run(5)
+    gps = _best_gps(lambda n: pga.run(n), lo=200, hi=600)
+    return {"ref40k_gens_per_sec": round(gps, 1)}
+
+
 def bench_islands() -> dict:
     """8 islands × 131,072 × 100, ring migration of the top 5% every 10
     generations (BASELINE.json island config), vmapped on one chip."""
@@ -141,6 +155,7 @@ def main() -> None:
 
     f32 = bench_single(jnp.float32)
     bf16 = bench_single(jnp.bfloat16)
+    ref = bench_reference_scale()
     isl = bench_islands()
 
     baseline_gps = 1.0 / reference_floor_seconds_per_gen()
@@ -156,6 +171,7 @@ def main() -> None:
         "bf16_achieved_tflops": bf16["achieved_tflops"],
         "bf16_mfu": bf16["mfu"],
     }
+    out.update(ref)
     out.update(isl)
     print(json.dumps(out))
 

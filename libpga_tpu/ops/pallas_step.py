@@ -59,7 +59,9 @@ def _valid_deme(k: int) -> bool:
     return bool(k) and not (k & (k - 1)) and 128 <= k <= 1024
 
 
-def _pick_deme_size(pop_size: int, preferred: int, genome_lanes: int = LANE):
+def _pick_deme_size(
+    pop_size: int, preferred: int, genome_lanes: int = LANE, max_k: int = 1024
+):
     """Deme size for a population: exact divisors first (zero padding),
     then a padded fit — the kernel pads the population up to the next
     deme multiple and masks the pad rows out of selection.
@@ -83,7 +85,9 @@ def _pick_deme_size(pop_size: int, preferred: int, genome_lanes: int = LANE):
     the least-waste fit wins. None (→ XLA path) for populations under
     one 128-row tile or with only degenerate-tail fits."""
     def fits(k: int) -> bool:
-        return k * genome_lanes <= 600_000
+        # ``max_k`` additionally bounds the tournament candidate masks
+        # (see make_pallas_breed's k_budget).
+        return k <= max_k and k * genome_lanes <= 600_000
 
     if _valid_deme(preferred) and fits(preferred) and pop_size % preferred == 0:
         return preferred
@@ -238,7 +242,10 @@ def _breed_kernel(
         # rounding of scores). The source-major iota-compare (axis 1 =
         # source row = sublanes) makes the reduction run over sublanes,
         # which the VPU does ~2× faster than a lane reduction (measured
-        # 10.2 → 8.3 ms/gen at 1M×100).
+        # 10.2 → 8.3 ms/gen at 1M×100). An MXU one-hot mat-vec
+        # alternative measured ~40% SLOWER end-to-end: the
+        # (2k·K, K)@(K, 1) matvec runs at N=1 efficiency and the bf16
+        # mask cast costs a pass anyway.
         cand_src = (
             lax.broadcasted_iota(jnp.int32, (T, K, K), 1) == idx[:, None, :]
         )
@@ -417,19 +424,18 @@ def make_pallas_breed(
         deme_size = auto_deme_size(gene_dtype)
     P, L = pop_size, genome_len
     Lp = math.ceil(L / LANE) * LANE
-    K = _pick_deme_size(P, deme_size, genome_lanes=Lp)
 
-    # k-way selection materializes 2k (K, K) candidate masks; keep their
-    # footprint within the scoped-VMEM budget (2k·K² ≤ 2M elements — the
-    # verified k=2/K=512 and k=4/K=256 shapes sit at ~1M/0.5M). Large k
-    # retries with the smallest deme before declining to the XLA path.
-    def _mask_ok(k_deme):
-        return k_deme is not None and 2 * tournament_size * k_deme**2 <= 2_000_000
-
-    if not _mask_ok(K):
-        K = _pick_deme_size(P, 128, genome_lanes=Lp)
-        if not _mask_ok(K):
-            return None
+    # k-way selection materializes 2k (K, K) candidate masks; bound the
+    # deme so their footprint stays at or below the largest verified
+    # shape (k=2 at K=1024: 2·2·1024² ≈ 4.2M elements, which compiles
+    # and runs). The budget shrinks the deme as k grows — k=4 caps at
+    # K=512, k=16 at K=256 — rather than declining the fast path.
+    k_budget = 128
+    while k_budget < 1024 and (
+        2 * tournament_size * (k_budget * 2) ** 2 <= 4_194_304
+    ):
+        k_budget *= 2
+    K = _pick_deme_size(P, deme_size, genome_lanes=Lp, max_k=k_budget)
     if K is None:
         return None
     G = math.ceil(P / K)

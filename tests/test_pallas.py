@@ -90,6 +90,19 @@ def test_run_factory_tournament_size_bounds():
     assert make_pallas_breed(1024, 10, tournament_size=3) is not None
 
 
+def test_tournament_mask_budget_shrinks_deme():
+    """Large k shrinks the deme to keep the 2k (K,K) candidate masks
+    within the largest verified footprint, preferring the biggest K that
+    fits: k=2 keeps K=1024 (the pre-k-way behavior), k=4 caps at 512,
+    k=16 at 256."""
+    b = make_pallas_breed(1 << 20, 10, deme_size=1024, tournament_size=2)
+    assert b is not None and b.K == 1024
+    b = make_pallas_breed(1 << 20, 10, deme_size=1024, tournament_size=4)
+    assert b is not None and b.K == 512
+    b = make_pallas_breed(1 << 20, 10, deme_size=1024, tournament_size=16)
+    assert b is not None and b.K == 256
+
+
 def test_kernel_structure_tournament_k3():
     """Zero PRNG bits with k=3: every candidate is deme row 0, so the
     winner fold (strict '>', first-best retained) must still produce the
