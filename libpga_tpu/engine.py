@@ -286,6 +286,17 @@ class PGA:
         v = getattr(self._mutate, name, None)
         if v is None:
             v = getattr(self._mutate, "keywords", {}).get(name)
+        if v is None:
+            # A bare ``partial(gaussian_mutate)`` executes at the
+            # operator's own signature defaults — the kernel must match
+            # those, not a literal copy that can drift out of sync.
+            func = getattr(self._mutate, "func", None)
+            if func is not None:
+                import inspect
+
+                p = inspect.signature(func).parameters.get(name)
+                if p is not None and p.default is not inspect.Parameter.empty:
+                    return p.default
         return default if v is None else v
 
     def _mutate_params(self) -> jax.Array:
@@ -305,19 +316,7 @@ class PGA:
         When no rate is discoverable at all (bare ``partial(point_mutate)``)
         the operator executes at its own signature default, so that — not
         the config value — is what the kernel must match."""
-        rate = getattr(self._mutate, "rate", None)
-        if rate is None:
-            rate = getattr(self._mutate, "keywords", {}).get("rate")
-        if rate is None:
-            func = getattr(self._mutate, "func", None)
-            if func is not None:
-                import inspect
-
-                p = inspect.signature(func).parameters.get("rate")
-                if p is not None and p.default is not inspect.Parameter.empty:
-                    return p.default
-            rate = self.config.mutation_rate
-        return rate
+        return self._operator_param("rate", self.config.mutation_rate)
 
     def _pallas_gate(self) -> bool:
         """Single source of truth for Pallas fast-path eligibility, shared

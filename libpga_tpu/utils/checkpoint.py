@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import glob
 import os
+import re
 from typing import TYPE_CHECKING, Dict, List, Tuple
 
 import jax
@@ -29,6 +30,7 @@ if TYPE_CHECKING:
 
 FORMAT_VERSION = 2  # single-file format
 SHARD_FORMAT_VERSION = 3  # per-process shard format
+_PROC_RE = re.compile(r"\.proc(\d+)\.npz$")  # shard-file suffix, save+restore
 
 
 def _encode(arr: np.ndarray):
@@ -69,6 +71,27 @@ def _addressable_shards(arr) -> List[Tuple[Tuple[int, ...], np.ndarray]]:
         seen.add(starts)
         out.append((starts, np.asarray(s.data)))
     return out
+
+
+def _atomic_savez(path: str, arrays: Dict[str, np.ndarray]) -> str:
+    """np.savez via temp-file + os.replace: a preemption mid-write must
+    never truncate the previous good checkpoint (the exact scenario this
+    module exists for). Returns the final filename actually written
+    (np.savez's ``.npz``-appending naming is preserved)."""
+    final = path if path.endswith(".npz") else path + ".npz"
+    # Sweep tmps of THIS final name from earlier hard-killed saves. Only
+    # our own target's tmps: peers' in-flight tmps have different finals,
+    # so a collective save can't race itself here.
+    for orphan in glob.glob(f"{glob.escape(final)}.*.tmp.npz"):
+        os.remove(orphan)
+    tmp = f"{final}.{os.getpid()}.tmp.npz"  # .npz suffix: stop savez renaming
+    try:
+        np.savez(tmp, **arrays)
+        os.replace(tmp, final)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+    return final
 
 
 def _pack_array(arrays: Dict[str, np.ndarray], name: str, arr) -> None:
@@ -133,10 +156,18 @@ def save(pga: "PGA", path: str) -> None:
     pga._ckpt_seq = seq
 
     if jax.process_count() > 1:
-        if jax.process_index() == 0 and os.path.exists(path):
-            # A stale single-process file at `path` would shadow the
-            # shard set at restore time — remove it.
-            os.remove(path)
+        if jax.process_index() == 0:
+            if os.path.exists(path):
+                # A stale single-process file at `path` would shadow the
+                # shard set at restore time — remove it.
+                os.remove(path)
+            # Shard files from an earlier, WIDER run (job resized, e.g.
+            # 4 hosts -> 2) would fail restore's count/seq consistency
+            # checks — remove every proc file this fleet won't rewrite.
+            for stale in glob.glob(f"{path}.proc*.npz"):
+                m = _PROC_RE.search(stale)
+                if m and int(m.group(1)) >= jax.process_count():
+                    os.remove(stale)
         arrays = {
             "__version__": np.asarray(SHARD_FORMAT_VERSION),
             "__num_populations__": np.asarray(len(pga.populations)),
@@ -147,7 +178,7 @@ def save(pga: "PGA", path: str) -> None:
         for i, pop in enumerate(pga.populations):
             _pack_array(arrays, f"genomes_{i}", pop.genomes)
             _pack_array(arrays, f"scores_{i}", pop.scores)
-        np.savez(f"{path}.proc{jax.process_index()}.npz", **arrays)
+        _atomic_savez(f"{path}.proc{jax.process_index()}.npz", arrays)
         return
 
     for stale in glob.glob(f"{path}.proc*.npz"):  # see shadow note above
@@ -162,7 +193,7 @@ def save(pga: "PGA", path: str) -> None:
         arrays[f"genomes_{i}"] = genomes
         arrays[f"genomes_dtype_{i}"] = np.asarray(dtype_name)
         arrays[f"scores_{i}"] = np.asarray(pop.scores)
-    np.savez(path, **arrays)
+    _atomic_savez(path, arrays)
 
 
 class AutoCheckpointer:
@@ -219,21 +250,30 @@ def restore(pga: "PGA", path: str) -> None:
         _restore_single(pga, path)
         return
 
-    proc_files = sorted(glob.glob(f"{path}.proc*.npz"))
-    if not proc_files:
+    by_idx = {}
+    for f in glob.glob(f"{path}.proc*.npz"):
+        m = _PROC_RE.search(f)
+        if m:
+            by_idx[int(m.group(1))] = f
+    if 0 not in by_idx:
         raise FileNotFoundError(f"no checkpoint at {path} (or {path}.proc*.npz)")
-    datas = [np.load(f) for f in proc_files]
-    try:
-        version = int(datas[0]["__version__"])
+    with np.load(by_idx[0]) as head:
+        version = int(head["__version__"])
         if version != SHARD_FORMAT_VERSION:
             raise ValueError(f"unsupported shard-checkpoint version {version}")
+        expect = int(head["__num_processes__"])
+    # Read exactly the file set the checkpoint declares: stale .proc<k>
+    # leftovers with k >= expect (older, wider run) are ignored rather
+    # than failing the count/seq consistency checks.
+    missing = [k for k in range(expect) if k not in by_idx]
+    if missing:
+        raise ValueError(
+            f"checkpoint written by {expect} processes is missing process "
+            f"files {missing}"
+        )
+    datas = [np.load(by_idx[k]) for k in range(expect)]
+    try:
         n = int(datas[0]["__num_populations__"])
-        expect = int(datas[0]["__num_processes__"])
-        if len(datas) != expect:
-            raise ValueError(
-                f"found {len(datas)} process files, checkpoint was written "
-                f"by {expect} processes"
-            )
         seqs = {int(d["__save_seq__"]) for d in datas}
         if len(seqs) != 1:
             raise ValueError(

@@ -82,6 +82,33 @@ def test_engine_mutation_rate_from_raw_partial():
     assert pga._mutation_rate() == pga.config.mutation_rate
 
 
+def test_engine_gaussian_params_follow_signature_defaults():
+    """A bare partial(gaussian_mutate) executes at the operator's own
+    signature defaults, so the kernel params must be read from the
+    signature, not from literal copies that can drift (advisor round-2
+    finding)."""
+    import inspect
+    from functools import partial
+
+    import numpy as np
+
+    from libpga_tpu import PGA
+    from libpga_tpu.ops.mutate import gaussian_mutate
+
+    sig = inspect.signature(gaussian_mutate).parameters
+    pga = PGA(seed=0)
+    pga.set_mutate(partial(gaussian_mutate))
+    assert pga._mutate_kind() == "gaussian"
+    np.testing.assert_allclose(
+        np.asarray(pga._mutate_params())[0],
+        [sig["rate"].default, sig["sigma"].default],
+    )
+    pga.set_mutate(partial(gaussian_mutate, rate=0.3, sigma=0.05))
+    np.testing.assert_allclose(
+        np.asarray(pga._mutate_params())[0], [0.3, 0.05], rtol=1e-6
+    )
+
+
 def test_run_factory_tournament_size_bounds():
     """k-way tournaments are served in-kernel up to k=16; absurd sizes
     decline to the XLA path instead of materializing 2k (K,K) masks."""
