@@ -172,7 +172,10 @@ class PGA:
 
     def _breed_fn(self) -> Callable:
         """Cached breed (select+crossover+mutate) for the current callbacks."""
-        cache_key = ("breed", self._crossover, self._mutate)
+        cache_key = (
+            "breed", self._crossover, self._mutate,
+            self.config.tournament_size, self.config.elitism,
+        )
         fn = self._compiled.get(cache_key)
         if fn is None:
             fn = make_breed(
@@ -236,6 +239,7 @@ class PGA:
 
         cache_key = (
             "run", size, genome_len, obj, self._crossover, self._mutate,
+            self.config.tournament_size, self.config.elitism,
         )
         fn = self._compiled.get(cache_key)
         if fn is not None:
@@ -348,10 +352,6 @@ class PGA:
             return None
         obj = self._require_objective()
         fused = getattr(obj, "kernel_rowwise", None)
-        if self.config.elitism > 0 and fused is None:
-            # The island-epoch elitism epilogue needs in-breed scores;
-            # the XLA breed handles elitism itself.
-            return None
         from libpga_tpu.ops.pallas_step import make_pallas_breed
 
         # Cached: runner caching downstream keys on the breed's identity,
@@ -371,7 +371,10 @@ class PGA:
             mutation_rate=self._mutation_rate(),
             mutation_sigma=self._operator_param("sigma", 0.0),
             mutate_kind=self._mutate_kind(),
-            elitism=self.config.elitism,
+            # Without fused scores the kernel can't carry elites itself;
+            # the island epoch applies them after its separate evaluation
+            # (run_islands passes the epoch-level elitism).
+            elitism=self.config.elitism if fused is not None else 0,
             fused_obj=fused,
             fused_consts=tuple(getattr(obj, "kernel_rowwise_consts", ())),
             gene_dtype=self.config.gene_dtype,
@@ -666,6 +669,15 @@ class PGA:
         stacked = jnp.stack([p.genomes for p in self._populations])
         S, L = stacked.shape[1], stacked.shape[2]
         breed = self._pallas_island_breed(S, L) or self._breed_fn()
+        # Epoch-level elite carry: only for a Pallas breed whose kernel
+        # couldn't apply it (non-fused objective). The XLA breed and the
+        # fused kernel both handle elitism themselves.
+        epoch_elitism = (
+            self.config.elitism
+            if getattr(breed, "padded", None) is not None
+            and not getattr(breed, "fused", False)
+            else 0
+        )
         t0 = time.perf_counter()
         genomes, scores, gens = run_islands_stacked(
             breed,
@@ -680,6 +692,7 @@ class PGA:
             mesh=mesh,
             runner_cache=self._compiled,
             mparams=self._mutate_params(),
+            elitism=epoch_elitism,
         )
         for i in range(len(self._populations)):
             # genomes[i] on a jax.Array stays on device (no host round trip).

@@ -12,8 +12,9 @@ Split into two pieces:
 - :func:`make_breed` — select+crossover+mutate: ``(genomes, scores, key) ->
   next_genomes``. Selection reads the *given* scores, i.e. the fitness of
   the current generation, matching the reference (``pga.cu:294-317``).
-- :func:`make_step` — breed then evaluate: ``(genomes, key) ->
-  (next_genomes, next_scores)``.
+- :func:`make_step` — breed then evaluate: ``(genomes, key[, scores]) ->
+  (next_genomes, next_scores)``; the returned scores describe the
+  returned genomes.
 
 Run loops carry ``(genomes, scores)`` together and check termination
 targets against the carried scores BEFORE breeding again — so the
@@ -104,19 +105,21 @@ def make_step(
     tournament_size: int = 2,
     elitism: int = 0,
 ) -> Callable[[jax.Array, jax.Array], Tuple[jax.Array, jax.Array]]:
-    """One full generation: ``step(genomes, key) -> (next, next_scores)``.
+    """One full generation: ``step(genomes, key[, scores]) -> (next, next_scores)``.
 
-    Requires the caller to seed the process with an initial evaluation
-    (``evaluate(obj, genomes)``) — after that, the returned scores always
-    describe the returned genomes.
+    The returned scores always describe the returned genomes. Selection
+    reads the CURRENT generation's fitness: pass it as ``scores`` to
+    avoid re-evaluating (a loop threads the returned scores back in —
+    one evaluation per generation); when omitted it is computed here.
     """
     breed = make_breed(
         crossover_fn, mutate_fn, tournament_size=tournament_size, elitism=elitism
     )
 
-    def step(genomes: jax.Array, key: jax.Array):
-        scores = evaluate(obj, genomes)
+    def step(genomes: jax.Array, key: jax.Array, scores: jax.Array = None):
+        if scores is None:
+            scores = evaluate(obj, genomes)
         nxt = breed(genomes, scores, key)
-        return nxt, scores
+        return nxt, evaluate(obj, nxt)
 
     return step

@@ -34,9 +34,12 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from libpga_tpu.ops.evaluate import evaluate as _evaluate
+from libpga_tpu.ops.pallas_step import _carry_elites
 
 
-def make_island_epoch(breed: Callable, obj: Callable, m: int) -> Callable:
+def make_island_epoch(
+    breed: Callable, obj: Callable, m: int, *, elitism: int = 0
+) -> Callable:
     """``(genomes (S,L), scores (S,), key) -> (genomes, scores, key)`` —
     m generations of breed-then-evaluate on one island.
 
@@ -47,7 +50,13 @@ def make_island_epoch(breed: Callable, obj: Callable, m: int) -> Callable:
     sizes the epoch pads once at entry, scans over the breed's padded
     variant (pad rows carry -inf scores and are inert — see
     ``make_pallas_breed``), and slices once at exit — not once per
-    generation."""
+    generation.
+
+    ``elitism`` > 0 applies the elite carry HERE, after the separate
+    evaluation — for breeds that neither handle elitism internally (the
+    XLA breed does) nor score children in-kernel (the fused Pallas breed
+    applies its own epilogue). This is what lets a custom, non-rowwise
+    objective with elitism keep the Pallas island fast path."""
     fused = getattr(breed, "fused", False)
     padded_fn = getattr(breed, "padded", None)
     Lp = getattr(breed, "Lp", None)
@@ -82,6 +91,8 @@ def make_island_epoch(breed: Callable, obj: Callable, m: int) -> Callable:
                 s2 = _evaluate(obj, g2[:S, :L] if pad else g2)
                 if pad:
                     s2 = jnp.pad(s2, (0, Pp - S), constant_values=-jnp.inf)
+                if elitism > 0:
+                    g2, s2 = _carry_elites(g, s, g2, s2, elitism)
             return (g2, s2, k), None
 
         (genomes, scores, key), _ = jax.lax.scan(
@@ -176,7 +187,8 @@ def _shard_host_array(arr: jax.Array, sharding: NamedSharding) -> jax.Array:
 
 
 def build_local_runner(
-    breed: Callable, obj: Callable, *, m: int, count: int, topology: str
+    breed: Callable, obj: Callable, *, m: int, count: int, topology: str,
+    elitism: int = 0,
 ) -> Callable:
     """Single-device (vmapped-islands) epoch loop.
 
@@ -184,10 +196,12 @@ def build_local_runner(
     num_epochs, target) -> (genomes, scores (I,S), epochs_done)``. For a
     breed with runtime mutation params (``breed.takes_params``) the
     runner takes a trailing ``mparams`` argument and sets its own
-    ``takes_params`` marker.
+    ``takes_params`` marker. ``elitism`` is the epoch-level elite carry
+    for breeds that don't handle it themselves (see
+    :func:`make_island_epoch`).
     """
     takes_params = getattr(breed, "takes_params", False)
-    epoch = make_island_epoch(breed, obj, m)
+    epoch = make_island_epoch(breed, obj, m, elitism=elitism)
     vepoch = (
         jax.vmap(epoch, in_axes=(0, 0, 0, None)) if takes_params
         else jax.vmap(epoch)
@@ -270,13 +284,14 @@ def build_sharded_runner(
     topology: str,
     mesh: Mesh,
     axis_name: str = "islands",
+    elitism: int = 0,
 ) -> Callable:
     """shard_map'd epoch loop: islands split over the mesh axis, migration
     over ICI. Same signature as :func:`build_local_runner`'s return
     (including the trailing ``mparams`` for a ``takes_params`` breed —
     replicated across the mesh)."""
     takes_params = getattr(breed, "takes_params", False)
-    epoch = make_island_epoch(breed, obj, m)
+    epoch = make_island_epoch(breed, obj, m, elitism=elitism)
     vepoch = (
         jax.vmap(epoch, in_axes=(0, 0, 0, None)) if takes_params
         else jax.vmap(epoch)
@@ -337,12 +352,15 @@ def build_runner(
     topology: str,
     mesh: Optional[Mesh] = None,
     axis_name: str = "islands",
+    elitism: int = 0,
 ) -> Callable:
     if mesh is None:
-        return build_local_runner(breed, obj, m=m, count=count, topology=topology)
+        return build_local_runner(
+            breed, obj, m=m, count=count, topology=topology, elitism=elitism
+        )
     return build_sharded_runner(
         breed, obj, m=m, count=count, topology=topology, mesh=mesh,
-        axis_name=axis_name,
+        axis_name=axis_name, elitism=elitism,
     )
 
 
@@ -364,6 +382,7 @@ def run_islands_stacked(
     axis_name: str = "islands",
     runner_cache: Optional[dict] = None,
     mparams: Optional[jax.Array] = None,
+    elitism: int = 0,
 ) -> Tuple[jax.Array, jax.Array, int]:
     """Run the island GA on a stacked ``(I, S, L)`` population array.
 
@@ -373,7 +392,10 @@ def run_islands_stacked(
     ``runner_cache`` to reuse compiled runners across calls. ``mparams``
     is forwarded to a ``takes_params`` breed (runtime mutation rate/sigma
     — see ``ops/pallas_step.make_pallas_breed``); None uses the breed's
-    construction-time defaults.
+    construction-time defaults. ``elitism`` is the epoch-level elite
+    carry for breeds that don't apply it themselves (see
+    :func:`make_island_epoch`) — leave 0 for XLA breeds built with
+    ``make_breed(..., elitism=...)`` and fused Pallas breeds.
 
     Returns ``(genomes (I,S,L), scores (I,S), generations_executed)``.
     """
@@ -399,7 +421,7 @@ def run_islands_stacked(
     def cached(tag, mm, build):
         if runner_cache is None:
             return build()
-        ck = (tag, mm, count, topology, mesh, axis_name, breed, obj)
+        ck = (tag, mm, count, topology, mesh, axis_name, breed, obj, elitism)
         if ck not in runner_cache:
             runner_cache[ck] = build()
         return runner_cache[ck]
@@ -408,7 +430,7 @@ def run_islands_stacked(
         "main", m,
         lambda: build_runner(
             breed, obj, m=m, count=count, topology=topology, mesh=mesh,
-            axis_name=axis_name,
+            axis_name=axis_name, elitism=elitism,
         ),
     )
     if mesh is not None:
@@ -443,7 +465,7 @@ def run_islands_stacked(
             "rem", rem,
             lambda: build_runner(
                 breed, obj, m=rem, count=0, topology=topology, mesh=mesh,
-                axis_name=axis_name,
+                axis_name=axis_name, elitism=elitism,
             ),
         )
         rem_keys = jax.random.split(jax.random.fold_in(mig_key, 7), I)

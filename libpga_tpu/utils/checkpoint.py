@@ -156,18 +156,15 @@ def save(pga: "PGA", path: str) -> None:
     pga._ckpt_seq = seq
 
     if jax.process_count() > 1:
-        if jax.process_index() == 0:
-            if os.path.exists(path):
-                # A stale single-process file at `path` would shadow the
-                # shard set at restore time — remove it.
-                os.remove(path)
-            # Shard files from an earlier, WIDER run (job resized, e.g.
-            # 4 hosts -> 2) would fail restore's count/seq consistency
-            # checks — remove every proc file this fleet won't rewrite.
-            for stale in glob.glob(f"{path}.proc*.npz"):
-                m = _PROC_RE.search(stale)
-                if m and int(m.group(1)) >= jax.process_count():
-                    os.remove(stale)
+        if jax.process_index() == 0 and os.path.exists(path):
+            # A stale single-process file at `path` would shadow the
+            # shard set at restore time — remove it. Stale .proc<k>
+            # files from an earlier WIDER run are deliberately left in
+            # place: restore() reads only the file set the checkpoint
+            # declares, and deleting them before this save's shard set
+            # is durably written would destroy the only restorable
+            # checkpoint if preemption hits mid-save.
+            os.remove(path)
         arrays = {
             "__version__": np.asarray(SHARD_FORMAT_VERSION),
             "__num_populations__": np.asarray(len(pga.populations)),

@@ -173,14 +173,17 @@ def test_restore_ignores_stale_wider_shard_files(tmp_path):
     )
 
 
-def test_multiprocess_save_cleans_stale_wider_shards(tmp_path, monkeypatch):
-    """Process 0 of a multi-process save removes .proc<k> files with
-    k >= process_count so a resized-down job leaves a consistent set."""
+def test_multiprocess_save_leaves_wider_shards_intact(tmp_path, monkeypatch):
+    """A multi-process save must NOT delete .proc<k> files from an
+    earlier wider run before its own shard set is durably written —
+    until every process has saved, those files are part of the only
+    restorable checkpoint. restore() ignores them via the declared
+    process count instead."""
     import jax
 
     path = str(tmp_path / "ckpt.npz")
-    (tmp_path / "ckpt.npz.proc2.npz").write_bytes(b"stale")
-    (tmp_path / "ckpt.npz.proc3.npz").write_bytes(b"stale")
+    (tmp_path / "ckpt.npz.proc2.npz").write_bytes(b"old-wide-run")
+    (tmp_path / "ckpt.npz.proc3.npz").write_bytes(b"old-wide-run")
 
     pga = PGA(seed=0)
     pga.create_population(64, 8)
@@ -192,7 +195,9 @@ def test_multiprocess_save_cleans_stale_wider_shards(tmp_path, monkeypatch):
     checkpoint.save(pga, path)
 
     names = sorted(p.name for p in tmp_path.iterdir())
-    assert names == ["ckpt.npz.proc0.npz"]
+    assert names == [
+        "ckpt.npz.proc0.npz", "ckpt.npz.proc2.npz", "ckpt.npz.proc3.npz"
+    ]
 
 
 def test_resume_continues_deterministically(tmp_path):

@@ -204,6 +204,26 @@ class TestStep:
         g3, _ = jax.jit(step)(genomes, jax.random.fold_in(key, 1))
         np.testing.assert_array_equal(np.asarray(g2), np.asarray(g3))
 
+    def test_step_scores_describe_returned_genomes(self, key):
+        """Round-2 verdict finding: step's returned scores must be the
+        NEXT generation's fitness, not the input generation's."""
+        from libpga_tpu.ops.evaluate import evaluate
+        from libpga_tpu.ops.mutate import make_point_mutate
+
+        obj = lambda g: jnp.sum(g)
+        step = jax.jit(make_step(obj, uniform_crossover, make_point_mutate(0.2)))
+        genomes = jax.random.uniform(key, (128, 16))
+        g2, scores = step(genomes, jax.random.fold_in(key, 1))
+        np.testing.assert_allclose(
+            np.asarray(scores), np.asarray(evaluate(obj, g2)), rtol=1e-6
+        )
+        # Threading the returned scores back in skips the re-evaluation
+        # and must give the identical generation.
+        g3a, s3a = step(g2, jax.random.fold_in(key, 2))
+        g3b, s3b = step(g2, jax.random.fold_in(key, 2), scores)
+        np.testing.assert_array_equal(np.asarray(g3a), np.asarray(g3b))
+        np.testing.assert_array_equal(np.asarray(s3a), np.asarray(s3b))
+
     def test_step_improves_onemax(self, key):
         from libpga_tpu.ops.mutate import make_point_mutate
 
