@@ -1,0 +1,43 @@
+/* pga_rowloop.c — batched marshaling for host C callbacks.
+ *
+ * The compatibility path for the reference's device-function-pointer
+ * operators (include/pga.h:46-48 in the reference tree) runs the user's
+ * C callback once per individual. Doing that loop in Python costs one
+ * ctypes crossing per ROW; these helpers take the whole generation's
+ * batch and loop in C, so the Python side pays exactly ONE crossing per
+ * generation regardless of population size.
+ *
+ * Pure C, no Python: loaded by libpga_tpu/capi_bridge.py via ctypes
+ * (which releases the GIL for the duration of the call).
+ *
+ * Row-major contiguous float32 buffers; `len` is the genome length.
+ */
+
+#include <stddef.h>
+
+typedef float (*pga_obj_f)(float *, unsigned);
+typedef void (*pga_mut_f)(float *, float *, unsigned);
+typedef void (*pga_cross_f)(float *, float *, float *, float *, unsigned);
+
+void pga_rowloop_obj(void *fn, float *batch, float *out, unsigned rows,
+                     unsigned len) {
+    pga_obj_f f = (pga_obj_f)fn;
+    for (unsigned i = 0; i < rows; ++i)
+        out[i] = f(batch + (size_t)i * len, len);
+}
+
+/* Mutation is in-place on `batch` (the caller passes a copy). */
+void pga_rowloop_mut(void *fn, float *batch, float *rand, unsigned rows,
+                     unsigned len) {
+    pga_mut_f f = (pga_mut_f)fn;
+    for (unsigned i = 0; i < rows; ++i)
+        f(batch + (size_t)i * len, rand + (size_t)i * len, len);
+}
+
+void pga_rowloop_cross(void *fn, float *p1, float *p2, float *child,
+                       float *rand, unsigned rows, unsigned len) {
+    pga_cross_f f = (pga_cross_f)fn;
+    for (unsigned i = 0; i < rows; ++i)
+        f(p1 + (size_t)i * len, p2 + (size_t)i * len,
+          child + (size_t)i * len, rand + (size_t)i * len, len);
+}

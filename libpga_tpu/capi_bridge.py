@@ -21,8 +21,9 @@ offers two surfaces:
 - raw *host* C function pointers with the reference's exact signatures
   (``float (*)(gene*, unsigned)`` etc.) — the compatibility path. The
   engine evaluates them through ``ctypes`` + ``jax.pure_callback``, so
-  genomes round-trip to the host each generation: correct for any driver,
-  sensible only for small populations.
+  genomes round-trip to the host each generation. The per-row callback
+  loop itself runs in C (``capi/pga_rowloop.c``): one Python<->C
+  crossing per generation, whatever the population size.
 """
 
 from __future__ import annotations
@@ -84,6 +85,65 @@ def _exec_ctx(handle: int):
 
         return jax.default_device(jax.devices("cpu")[0])
     return contextlib.nullcontext()
+
+# ------------------------------------------------------------- row loop
+# Batched marshaling: the per-row callback loop runs in C
+# (capi/pga_rowloop.c), so a whole generation costs ONE Python<->C
+# crossing instead of one per individual. Loaded lazily; when the shared
+# library is absent a best-effort local build is attempted, and failing
+# that the pure-Python row loop below remains the fallback.
+
+_ROWLOOP = None  # None = not probed; False = unavailable; else CDLL
+
+
+def _rowloop_lib():
+    global _ROWLOOP
+    if _ROWLOOP is None:
+        _ROWLOOP = _load_rowloop() or False
+    return _ROWLOOP or None
+
+
+def _load_rowloop():
+    import shutil
+    import subprocess
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    src = os.path.join(here, "..", "capi", "pga_rowloop.c")
+    so = os.path.join(here, "..", "capi", "libpga_rowloop.so")
+    stale = (
+        os.path.exists(so) and os.path.exists(src)
+        and os.path.getmtime(src) > os.path.getmtime(so)
+    )
+    if (not os.path.exists(so) or stale) and os.path.exists(src):
+        cc = shutil.which("cc") or shutil.which("gcc")
+        if cc:
+            target = so if os.access(os.path.dirname(so), os.W_OK) else (
+                os.path.join(tempfile.mkdtemp(), "libpga_rowloop.so")
+            )
+            try:
+                subprocess.run(
+                    [cc, "-O2", "-fPIC", "-shared", src, "-o", target],
+                    check=True, capture_output=True, timeout=60,
+                )
+                so = target
+            except Exception:
+                return None
+    if not os.path.exists(so):
+        return None
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError:
+        return None
+    u, fp, vp = ctypes.c_uint, ctypes.POINTER(ctypes.c_float), ctypes.c_void_p
+    lib.pga_rowloop_obj.argtypes = [vp, fp, fp, u, u]
+    lib.pga_rowloop_obj.restype = None
+    lib.pga_rowloop_mut.argtypes = [vp, fp, fp, u, u]
+    lib.pga_rowloop_mut.restype = None
+    lib.pga_rowloop_cross.argtypes = [vp, fp, fp, fp, fp, u, u]
+    lib.pga_rowloop_cross.restype = None
+    return lib
+
 
 _OBJ_SIG = ctypes.CFUNCTYPE(ctypes.c_float, ctypes.POINTER(ctypes.c_float), ctypes.c_uint)
 _MUT_SIG = ctypes.CFUNCTYPE(
@@ -159,9 +219,17 @@ def set_objective_ptr(handle: int, addr: int) -> None:
     def host_eval(batch: np.ndarray) -> np.ndarray:
         batch = np.ascontiguousarray(batch, dtype=np.float32)
         out = np.empty(batch.shape[0], dtype=np.float32)
+        fp = ctypes.POINTER(ctypes.c_float)
+        lib = _rowloop_lib()
+        if lib is not None:  # one crossing for the whole generation
+            lib.pga_rowloop_obj(
+                addr, batch.ctypes.data_as(fp), out.ctypes.data_as(fp),
+                batch.shape[0], batch.shape[1],
+            )
+            return out
         n = ctypes.c_uint(batch.shape[1])
         for i in range(batch.shape[0]):
-            out[i] = cfn(batch[i].ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n)
+            out[i] = cfn(batch[i].ctypes.data_as(fp), n)
         return out
 
     def objective(genome):
@@ -198,8 +266,15 @@ def set_mutate_ptr(handle: int, addr: int) -> None:
     def host_mut(batch: np.ndarray, rand: np.ndarray) -> np.ndarray:
         batch = np.ascontiguousarray(batch, dtype=np.float32).copy()
         rand = np.ascontiguousarray(rand, dtype=np.float32)
-        n = ctypes.c_uint(batch.shape[1])
         fp = ctypes.POINTER(ctypes.c_float)
+        lib = _rowloop_lib()
+        if lib is not None:
+            lib.pga_rowloop_mut(
+                addr, batch.ctypes.data_as(fp), rand.ctypes.data_as(fp),
+                batch.shape[0], batch.shape[1],
+            )
+            return batch
+        n = ctypes.c_uint(batch.shape[1])
         for i in range(batch.shape[0]):
             cfn(batch[i].ctypes.data_as(fp), rand[i].ctypes.data_as(fp), n)
         return batch
@@ -240,8 +315,16 @@ def set_crossover_ptr(handle: int, addr: int) -> None:
         p2 = np.ascontiguousarray(p2, dtype=np.float32)
         rand = np.ascontiguousarray(rand, dtype=np.float32)
         child = np.zeros_like(p1)
-        n = ctypes.c_uint(p1.shape[1])
         fp = ctypes.POINTER(ctypes.c_float)
+        lib = _rowloop_lib()
+        if lib is not None:
+            lib.pga_rowloop_cross(
+                addr, p1.ctypes.data_as(fp), p2.ctypes.data_as(fp),
+                child.ctypes.data_as(fp), rand.ctypes.data_as(fp),
+                p1.shape[0], p1.shape[1],
+            )
+            return child
+        n = ctypes.c_uint(p1.shape[1])
         for i in range(p1.shape[0]):
             cfn(
                 p1[i].ctypes.data_as(fp),

@@ -178,8 +178,6 @@ def save(pga: "PGA", path: str) -> None:
         _atomic_savez(f"{path}.proc{jax.process_index()}.npz", arrays)
         return
 
-    for stale in glob.glob(f"{path}.proc*.npz"):  # see shadow note above
-        os.remove(stale)
     arrays = {
         "__version__": np.asarray(FORMAT_VERSION),
         "__num_populations__": np.asarray(len(pga.populations)),
@@ -191,6 +189,12 @@ def save(pga: "PGA", path: str) -> None:
         arrays[f"genomes_dtype_{i}"] = np.asarray(dtype_name)
         arrays[f"scores_{i}"] = np.asarray(pop.scores)
     _atomic_savez(path, arrays)
+    # Only now is it safe to drop a previous run's shard set (see shadow
+    # note above): restore() prefers the single file, and deleting the
+    # shards BEFORE the new file durably exists would leave nothing
+    # restorable if preemption hit mid-save.
+    for stale in glob.glob(f"{path}.proc*.npz"):
+        os.remove(stale)
 
 
 class AutoCheckpointer:

@@ -16,11 +16,17 @@ subprocesses:
 - ``test_compat``: the full exact-reference ABI surface, including
   custom mutate/crossover host pointers and the ``gene**`` ownership
   contract of the top-k getters;
-- source-compat proof: the reference's own knapsack driver
-  (``test2/test.cu``) de-CUDA'd mechanically at test time (drop
-  ``__device__``/``__constant__``, assign the function pointer directly
-  instead of ``cudaMemcpyFromSymbol``) compiles against ``capi/pga.h``
-  and runs to completion.
+- source-compat proof: ALL THREE of the reference's own drivers —
+  ``test/test.cu`` (custom objective at 40k×100), ``test2/test.cu``
+  (knapsack), and ``test3/test.cu`` (TSP: custom crossover,
+  ``__constant__`` city matrix via ``cudaMemcpyToSymbol``, stdin input
+  from ``gen.c``) — de-CUDA'd mechanically at test time (drop
+  ``__device__``/``__constant__``, ``cudaMemcpyFromSymbol`` → direct
+  assignment, ``cudaMemcpyToSymbol`` → ``memcpy``) compile against
+  ``capi/pga.h`` and run correctly against ``libpga.so``;
+- batched marshaling: the host-callback row loop runs in C
+  (``capi/pga_rowloop.c``) — asserted ≥5× faster than the Python loop
+  at 40k×100 with bit-identical results.
 """
 
 import os
@@ -93,12 +99,82 @@ def test_capi_compat_full_abi(built_shim):
     assert "compat best sum" in out
 
 
+def test_rowloop_batched_marshaling_speedup_and_parity(built_shim, tmp_path):
+    """Host-callback marshaling must loop over rows in C, not Python:
+    one Python<->C crossing per generation (round-2 verdict finding).
+    Asserts the C row loop returns bit-identical scores and is >= 5x
+    faster than the Python fallback at the reference's 40k x 100 shape."""
+    import ctypes
+    import time
+
+    import numpy as np
+
+    from libpga_tpu import capi_bridge as cb
+
+    obj_src = tmp_path / "obj.c"
+    obj_src.write_text(
+        "float sum_obj(float *g, unsigned n) {\n"
+        "    float s = 0;\n"
+        "    for (unsigned i = 0; i < n; ++i) s += g[i];\n"
+        "    return s;\n"
+        "}\n"
+    )
+    obj_so = tmp_path / "obj.so"
+    subprocess.run(
+        ["gcc", "-O2", "-fPIC", "-shared", str(obj_src), "-o", str(obj_so)],
+        check=True,
+    )
+    lib = ctypes.CDLL(str(obj_so))
+    addr = ctypes.cast(lib.sum_obj, ctypes.c_void_p).value
+
+    h = cb.init(0)
+    try:
+        p = cb.create_population(h, 40_000, 100, 0)
+        cb.set_objective_ptr(h, addr)
+        assert cb._rowloop_lib() is not None, "row-loop library must load"
+
+        def timed_eval():
+            t0 = time.perf_counter()
+            cb.evaluate(h, p)
+            return time.perf_counter() - t0
+
+        from libpga_tpu.engine import PopulationHandle
+
+        def all_scores():
+            return np.asarray(
+                cb._solver(h).population(PopulationHandle(p)).scores
+            )
+
+        timed_eval()  # compile
+        t_c = min(timed_eval() for _ in range(3))
+        scores_c = all_scores()
+
+        cb._ROWLOOP = False  # force the Python row-loop fallback
+        try:
+            timed_eval()
+            t_py = min(timed_eval() for _ in range(2))
+            scores_py = all_scores()
+        finally:
+            cb._ROWLOOP = None  # re-probe on next use
+
+        # every stored fitness value, not just the argmax genome
+        np.testing.assert_array_equal(scores_c, scores_py)
+        assert t_py / t_c >= 5, (
+            f"C row loop only {t_py / t_c:.1f}x faster "
+            f"(C {t_c * 1e3:.1f} ms, Python {t_py * 1e3:.1f} ms)"
+        )
+    finally:
+        cb.deinit(h)
+
+
 def _decuda(src: str) -> str:
     """The minimal mechanical CUDA→host transform for reference drivers:
-    drop the __device__/__constant__ qualifiers and replace the
-    cudaMemcpyFromSymbol device-pointer fetch with a direct assignment.
-    Nothing else changes."""
+    drop the __device__/__constant__ qualifiers, replace the
+    cudaMemcpyFromSymbol device-pointer fetch with a direct assignment,
+    and cudaMemcpyToSymbol with memcpy (same dst/src/size argument
+    order). Nothing else changes."""
     src = src.replace("__constant__ ", "").replace("__device__ ", "")
+    src = src.replace("cudaMemcpyToSymbol(", "memcpy(")
     return re.sub(
         r"cudaMemcpyFromSymbol\(\s*&(\w+)\s*,\s*(\w+)\s*,.*;",
         r"\1 = (void *)\2;",
@@ -106,34 +182,38 @@ def _decuda(src: str) -> str:
     )
 
 
-@pytest.mark.skipif(
-    not REFERENCE_DRIVER.exists(), reason="reference tree not mounted"
-)
-def test_reference_driver_source_compat(built_shim, tmp_path):
-    """The reference's own knapsack driver source, de-CUDA'd mechanically,
-    must compile against capi/pga.h and run correctly against libpga.so —
-    the drop-in source-compatibility contract."""
-    driver_c = tmp_path / "ref_test2.c"
-    driver_c.write_text(_decuda(REFERENCE_DRIVER.read_text()))
-
-    exe = tmp_path / "ref_test2"
+def _compile_decuda_driver(driver_path: Path, tmp_path: Path, name: str):
+    out_c = tmp_path / f"{name}.c"
+    out_c.write_text(_decuda(driver_path.read_text()))
+    exe = tmp_path / name
     proc = subprocess.run(
         [
             "gcc", "-std=gnu11", "-O2",
-            # the driver calls free() without <stdlib.h> (nvcc's headers
-            # pull it in); keep the source untouched and allow the
-            # implicit declaration instead
+            # nvcc's headers pull in stdlib/string prototypes the drivers
+            # rely on implicitly; keep the sources untouched and allow
+            # the implicit declarations instead
             "-Wno-implicit-function-declaration",
-            f"-I{CAPI}", str(driver_c), "-o", str(exe),
+            f"-I{CAPI}", str(out_c), "-o", str(exe),
             f"-L{CAPI}", "-lpga", f"-Wl,-rpath,{CAPI}",
         ],
         capture_output=True,
         text=True,
     )
     assert proc.returncode == 0, (
-        f"de-CUDA'd reference driver failed to compile:\n{proc.stderr}"
+        f"de-CUDA'd {driver_path} failed to compile:\n{proc.stderr}"
     )
+    return exe
 
+
+@pytest.mark.skipif(
+    not REFERENCE_DRIVER.exists(), reason="reference tree not mounted"
+)
+def test_reference_driver_source_compat(built_shim, tmp_path):
+    """The reference's own knapsack driver source (test2/test.cu),
+    de-CUDA'd mechanically, must compile against capi/pga.h and run
+    correctly against libpga.so — the drop-in source-compatibility
+    contract."""
+    exe = _compile_decuda_driver(REFERENCE_DRIVER, tmp_path, "ref_test2")
     run = subprocess.run(
         [str(exe)], capture_output=True, text=True, env=_env(), timeout=420
     )
@@ -145,3 +225,68 @@ def test_reference_driver_source_compat(built_shim, tmp_path):
     counts = [int(tok) for tok in run.stdout.split()]
     assert len(counts) == 6
     assert all(0 <= c <= 2 for c in counts)
+
+
+REFERENCE_DRIVER_ONEMAX = Path("/root/reference/test/test.cu")
+REFERENCE_DRIVER_TSP = Path("/root/reference/test3/test.cu")
+REFERENCE_TSP_GEN = Path("/root/reference/test3/gen.c")
+
+
+@pytest.mark.skipif(
+    not REFERENCE_DRIVER_ONEMAX.exists(), reason="reference tree not mounted"
+)
+def test_reference_onemax_driver_source_compat(built_shim, tmp_path):
+    """The reference's first driver (test/test.cu): a custom host
+    objective function pointer at the full 40,000 x 100 scale, 100
+    generations. Feasible through the compat path because the callback
+    marshaling row loop runs in C (one crossing per generation)."""
+    exe = _compile_decuda_driver(REFERENCE_DRIVER_ONEMAX, tmp_path, "ref_test1")
+    run = subprocess.run(
+        [str(exe)], capture_output=True, text=True, env=_env(), timeout=420
+    )
+    assert run.returncode == 0, (
+        f"onemax reference driver failed (rc={run.returncode}):\n"
+        f"{run.stdout}\n{run.stderr}"
+    )
+
+
+@pytest.mark.skipif(
+    not REFERENCE_DRIVER_TSP.exists(), reason="reference tree not mounted"
+)
+def test_reference_tsp_driver_source_compat(built_shim, tmp_path):
+    """The reference's third driver (test3/test.cu): custom objective AND
+    custom crossover host pointers, a __constant__ city matrix loaded via
+    cudaMemcpyToSymbol (de-CUDA'd to memcpy), city input on stdin from
+    the reference's own gen.c generator, and a freed pga_get_best result
+    (gene* ownership contract)."""
+    gen_exe = tmp_path / "gen"
+    proc = subprocess.run(
+        ["gcc", "-O2", str(REFERENCE_TSP_GEN), "-o", str(gen_exe)],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, f"gen.c failed to compile:\n{proc.stderr}"
+    gen_run = subprocess.run(
+        [str(gen_exe)], capture_output=True, text=True, timeout=60
+    )
+    assert gen_run.returncode == 0, f"gen failed:\n{gen_run.stderr}"
+    cities = gen_run.stdout
+
+    exe = _compile_decuda_driver(REFERENCE_DRIVER_TSP, tmp_path, "ref_test3")
+    run = subprocess.run(
+        [str(exe)], input=cities, capture_output=True, text=True,
+        env=_env(), timeout=420,
+    )
+    assert run.returncode == 0, (
+        f"tsp reference driver failed (rc={run.returncode}):\n"
+        f"{run.stdout[-2000:]}\n{run.stderr[-2000:]}"
+    )
+    # The driver prints the best tour as 100 decoded city indices (plus
+    # "HERE" markers if any duplicates survived — the reference does the
+    # same). Valid result: exactly 100 in-range indices, mostly unique
+    # (random decoding would give ~63 unique; an evolved tour far more).
+    tour = [int(t) for t in run.stdout.split() if t.lstrip("-").isdigit()]
+    assert len(tour) == 100
+    assert all(0 <= c < 100 for c in tour)
+    assert len(set(tour)) >= 80, (
+        f"evolved tour only has {len(set(tour))}/100 unique cities"
+    )
