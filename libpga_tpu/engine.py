@@ -210,7 +210,8 @@ class PGA:
             # instance in and must stay keyed by it below.
             pkey = (
                 "runP", size, genome_len, obj, pallas_kind,
-                self.config.elitism, self.config.tournament_size,
+                self._crossover_kind(), self.config.elitism,
+                self.config.tournament_size,
             )
             cached = self._compiled.get(pkey)
             if cached is None:
@@ -223,6 +224,7 @@ class PGA:
                     # the engine always passes self._mutate_params().
                     mutation_rate=self._mutation_rate(),
                     mutation_sigma=self._operator_param("sigma", 0.0),
+                    crossover_kind=self._crossover_kind(),
                     mutate_kind=pallas_kind,
                     elitism=self.config.elitism,
                     deme_size=self.config.pallas_deme_size,
@@ -284,6 +286,21 @@ class PGA:
             return "point"
         if func is _m.gaussian_mutate:
             return "gaussian"
+        if func is _m.swap_mutate:
+            return "swap"
+        return None
+
+    def _crossover_kind(self) -> Optional[str]:
+        """Kernel-implementable crossover kind of the active operator:
+        uniform (the reference default) or order-preserving (the
+        reference TSP driver's custom crossover, in-kernel as an
+        unrolled VMEM visited-table walk)."""
+        from libpga_tpu.ops import crossover as _c
+
+        if self._crossover is _c.uniform_crossover:
+            return "uniform"
+        if self._crossover is _c.order_preserving_crossover:
+            return "order"
         return None
 
     def _operator_param(self, name: str, default: float) -> float:
@@ -325,12 +342,14 @@ class PGA:
     def _pallas_gate(self) -> bool:
         """Single source of truth for Pallas fast-path eligibility, shared
         by the single-population run loop and the island runner. The
-        kernel implements uniform crossover with point or gaussian
-        mutation, k-way tournaments (k ≤ 16), elitism (fused
-        objectives), and f32/bf16 genes, and requires a real TPU."""
+        kernel implements uniform or order-preserving crossover with
+        point, gaussian, or swap mutation, k-way tournaments (k ≤ 16),
+        elitism (fused objectives), and f32/bf16 genes (order crossover:
+        f32 only — make_pallas_breed declines bf16), and requires a real
+        TPU."""
         if not (
             self.config.pallas_enabled()
-            and self._crossover is uniform_crossover
+            and self._crossover_kind() is not None
             and self._mutate_kind() is not None
             and 1 <= self.config.tournament_size <= 16
             and self.config.gene_dtype in (jnp.float32, jnp.bfloat16)
@@ -358,8 +377,8 @@ class PGA:
         # so rebuilding it per call would defeat compilation reuse.
         cache_key = (
             "island_breed", island_size, genome_len, obj, fused,
-            self._mutate_kind(), self.config.elitism,
-            self.config.tournament_size,
+            self._crossover_kind(), self._mutate_kind(),
+            self.config.elitism, self.config.tournament_size,
         )
         if cache_key in self._compiled:
             return self._compiled[cache_key]
@@ -370,6 +389,7 @@ class PGA:
             tournament_size=self.config.tournament_size,
             mutation_rate=self._mutation_rate(),
             mutation_sigma=self._operator_param("sigma", 0.0),
+            crossover_kind=self._crossover_kind(),
             mutate_kind=self._mutate_kind(),
             # Without fused scores the kernel can't carry elites itself;
             # the island epoch applies them after its separate evaluation

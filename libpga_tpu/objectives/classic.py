@@ -152,8 +152,18 @@ def make_tsp(city_matrix, duplicate_penalty: float = 10_000.0):
     city i = ``int(g[i] * L)``; fitness = −(path length + penalty per
     ordered duplicate pair). The O(L²) duplicate check is a vectorized
     comparison matrix here rather than the reference's nested loop.
+
+    The batched form (``.rows``, used by :func:`ops.evaluate.evaluate`)
+    is gather-free: edge costs come from a one-hot matmul (exact in f32
+    — each output element selects exactly one matrix entry), and the
+    duplicate count from per-city occupancy counts
+    (``Σ_c n_c(n_c−1) = Σ_c n_c² − L``). TPU gathers cost ~10 ns/element,
+    which made the indexed formulation dominate the whole TSP generation
+    at large populations (6.6 ms/eval at 8192×100 vs ~0.5 ms for the
+    matmul form).
     """
     city_matrix = jnp.asarray(city_matrix, dtype=jnp.float32)
+    C = city_matrix.shape[0]
 
     def tsp(genome: jax.Array) -> jax.Array:
         L = genome.shape[0]
@@ -164,6 +174,57 @@ def make_tsp(city_matrix, duplicate_penalty: float = 10_000.0):
         length = length + duplicate_penalty * jnp.sum(off_diag)
         return -length
 
+    def tsp_rows(m: jax.Array) -> jax.Array:
+        P, L = m.shape
+        cities = jnp.clip(jnp.floor(m * L).astype(jnp.int32), 0, L - 1)
+        # Duplicate counting must bucket the same values the per-genome
+        # form compares (cities in [0, L)), while the matmul one-hot
+        # must stay inside the matrix (clamped to C-1, matching the
+        # clamped gather of the indexed form when L > C).
+        CC = max(C, L)
+
+        def score_chunk(c):
+            B = c.shape[0]
+            onehot = (
+                c[:, :, None] == jnp.arange(CC, dtype=jnp.int32)
+            ).astype(jnp.float32)  # (B, L, CC)
+            if CC == C:  # cities already in-range: reuse slices
+                src_oh, dst_oh = onehot[:, :-1], onehot[:, 1:]
+            else:
+                src_oh = (
+                    jnp.clip(c[:, :-1], 0, C - 1)[:, :, None]
+                    == jnp.arange(C, dtype=jnp.int32)
+                ).astype(jnp.float32)
+                dst_oh = (
+                    jnp.clip(c[:, 1:], 0, C - 1)[:, :, None]
+                    == jnp.arange(C, dtype=jnp.int32)
+                ).astype(jnp.float32)
+            # HIGHEST precision: the default TPU matmul downcasts the
+            # matrix to bf16 (±0.4% per distance — tens of units over a
+            # 99-edge tour, measured 28.5 max divergence from the exact
+            # per-genome form; HIGHEST brings it to ~0.1 at ~2x the
+            # matmul cost, still ~0.5 ms/eval at 8192×100).
+            picked = jnp.matmul(
+                src_oh.reshape(-1, C), city_matrix,
+                precision=jax.lax.Precision.HIGHEST,
+            ).reshape(B, L - 1, C)
+            length = jnp.sum(picked * dst_oh, axis=(1, 2))
+            counts = jnp.sum(onehot, axis=1)  # (B, CC)
+            dups = jnp.sum(counts * counts, axis=1) - L
+            return -(length + duplicate_penalty * dups)
+
+        # Chunk so the (B, L, C) one-hots stay tens of MB, not
+        # gigabytes, at framework-scale populations; a non-multiple
+        # tail pads up to the chunk size and is sliced away.
+        B = 2048
+        if P <= B:
+            return score_chunk(cities)
+        n_chunks = -(-P // B)
+        padded = jnp.pad(cities, ((0, n_chunks * B - P), (0, 0)))
+        out = jax.lax.map(score_chunk, padded.reshape(n_chunks, B, L))
+        return out.reshape(n_chunks * B)[:P]
+
+    tsp.rows = tsp_rows
     return tsp
 
 

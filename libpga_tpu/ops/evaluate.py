@@ -31,5 +31,14 @@ def evaluate(obj: Callable[[jax.Array], jax.Array], genomes: jax.Array) -> jax.A
         # late-run selection pressure. This matches the fused kernel
         # path, which upcasts the stored bf16 child before scoring.
         genomes = genomes.astype(jnp.float32)
-    scores = jax.vmap(obj)(genomes)
+    # An objective carrying a whole-population form evaluates through it
+    # directly — e.g. make_tsp's gather-free one-hot matmul (``.rows``)
+    # or the Mosaic-safe rowwise form of the fusable builtins (whose
+    # const parameters all carry closure defaults, so the bare call is
+    # valid outside a kernel).
+    rows = getattr(obj, "rows", None) or getattr(obj, "kernel_rowwise", None)
+    if rows is not None:
+        scores = rows(genomes)
+    else:
+        scores = jax.vmap(obj)(genomes)
     return scores.astype(jnp.float32)

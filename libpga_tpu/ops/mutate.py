@@ -157,4 +157,6 @@ def make_swap_mutate(rate: float = 0.5):
     mut.func = swap_mutate
     mut.batched = partial(swap_mutate_batched, rate=rate)
     mut.rand_cols = 3
+    # Inspected by the engine's Pallas fast path (runtime mutation params).
+    mut.rate = rate
     return mut

@@ -156,6 +156,7 @@ def _breed_kernel(
     L,
     Lp,
     tk=2,
+    crossover="uniform",
     mutate="point",
     obj=None,
     n_consts=0,
@@ -302,9 +303,52 @@ def _breed_kernel(
         p1 = sel(oh1)  # (K, Lp) f32
         p2 = sel(oh2)
 
-        # ---- uniform crossover: per-gene coin flip (pga.cu:135-143) ----
-        mask_bits = pltpu.bitcast(pltpu.prng_random_bits((K, Lp)), jnp.uint32)
-        child = jnp.where(mask_bits >> 31 == 0, p1, p2)
+        if crossover == "uniform":
+            # ---- uniform crossover: per-gene coin flip (pga.cu:135-143)
+            mask_bits = pltpu.bitcast(
+                pltpu.prng_random_bits((K, Lp)), jnp.uint32
+            )
+            child = jnp.where(mask_bits >> 31 == 0, p1, p2)
+        elif crossover == "order":
+            # ---- order-preserving crossover (reference TSP driver,
+            # test3/test.cu:48-64): walk gene positions left to right,
+            # take p1's gene if its decoded city is unvisited, else
+            # p2's, else the raw random value. Inherently sequential in
+            # L, but each step is a handful of (Lp, K) VPU ops on
+            # VMEM-resident data — unrolled at trace time, zero HBM
+            # traffic — unlike the XLA scan path whose per-step launch
+            # overhead dominates large populations (ops/crossover.py).
+            # Transposed (gene-major) layout: a step's slice is then a
+            # static SUBLANE row, and the visited set indexes cities on
+            # sublanes.
+            p1t = p1.T  # (Lp, K) f32 — 32-bit transpose is supported
+            p2t = p2.T
+            c1t = jnp.clip(jnp.floor(p1t * L), 0, L - 1).astype(jnp.int32)
+            c2t = jnp.clip(jnp.floor(p2t * L), 0, L - 1).astype(jnp.int32)
+            randt = uniform((Lp, K))
+            sub = lax.broadcasted_iota(jnp.int32, (Lp, K), 0)
+            visited = jnp.zeros((Lp, K), dtype=jnp.bool_)
+            childt = jnp.zeros((Lp, K), dtype=jnp.float32)
+            for l in range(L):
+                g1l, c1l = p1t[l : l + 1, :], c1t[l : l + 1, :]
+                g2l, c2l = p2t[l : l + 1, :], c2t[l : l + 1, :]
+                seen1 = jnp.any(
+                    visited & (sub == c1l), axis=0, keepdims=True
+                )
+                seen2 = jnp.any(
+                    visited & (sub == c2l), axis=0, keepdims=True
+                )
+                take1 = ~seen1
+                take2 = seen1 & ~seen2
+                gene = jnp.where(
+                    take1, g1l, jnp.where(take2, g2l, randt[l : l + 1, :])
+                )
+                mark_city = jnp.where(take1, c1l, c2l)
+                visited = visited | ((sub == mark_city) & (take1 | take2))
+                childt = jnp.where(sub == l, gene, childt)
+            child = childt.T  # (K, Lp); pad columns are 0
+        else:
+            raise ValueError(f"unknown crossover kind {crossover!r}")
 
         # ---- mutation -------------------------------------------------
         if mutate == "point":
@@ -334,6 +378,21 @@ def _breed_kernel(
             )
             mutated = jnp.clip(child + sigma * normal, 0.0, 1.0 - 1e-7)
             child = jnp.where(gate < rate, mutated, child)
+        elif mutate == "swap":
+            # Swap two random positions with probability ``rate``
+            # (ops/mutate.swap_mutate semantics — permutation GAs).
+            # Scatter-free: two lane one-hots select/exchange the genes.
+            u_t = uniform((4, K)).T  # (K, 4) f32
+            pi = jnp.floor(u_t[:, 0:1] * L).astype(jnp.int32)
+            pj = jnp.floor(u_t[:, 1:2] * L).astype(jnp.int32)
+            fire = u_t[:, 2:3] < rate
+            cols = lax.broadcasted_iota(jnp.int32, (K, Lp), 1)
+            ohi = cols == pi
+            ohj = cols == pj
+            gi = jnp.sum(jnp.where(ohi, child, 0.0), axis=1, keepdims=True)
+            gj = jnp.sum(jnp.where(ohj, child, 0.0), axis=1, keepdims=True)
+            child = jnp.where(ohi & fire, gj, child)
+            child = jnp.where(ohj & fire, gi, child)
         else:
             raise ValueError(f"unknown mutate kind {mutate!r}")
 
@@ -377,6 +436,7 @@ def make_pallas_breed(
     tournament_size: int = 2,
     mutation_rate: float = 0.01,
     mutation_sigma: float = 0.0,
+    crossover_kind: str = "uniform",
     mutate_kind: str = "point",
     elitism: int = 0,
     fused_obj: Optional[Callable] = None,
@@ -409,7 +469,19 @@ def make_pallas_breed(
         return None
     if gene_dtype not in (jnp.float32, jnp.bfloat16):
         return None
-    if mutate_kind not in ("point", "gaussian"):
+    if crossover_kind not in ("uniform", "order"):
+        return None
+    if mutate_kind not in ("point", "gaussian", "swap"):
+        return None
+    if crossover_kind == "order" and gene_dtype != jnp.float32:
+        # Permutation genomes decode cities as floor(g*L); bf16 gene
+        # resolution (~0.004 near 1.0) would corrupt decodes wholesale.
+        return None
+    if crossover_kind == "order" and genome_len > 256:
+        # The order crossover unrolls L trace-time steps; beyond a few
+        # hundred the Mosaic program size balloons (only L≈100, the
+        # reference driver's scale, is measured). Longer permutations
+        # fall back to the XLA scan path.
         return None
     if not (1 <= tournament_size <= 16):
         # k-way selection materializes 2k (K, K) candidate masks; cap
@@ -457,7 +529,12 @@ def make_pallas_breed(
         d for d in (8, 4, 2, 1)
         if G % d == 0 and d * K * Lp * gene_bytes <= 2 * 1024 * 1024
     ] or [1]
-    if _demes_per_step:
+    if crossover_kind == "order":
+        # The order crossover unrolls L trace-time steps per deme; D>1
+        # would multiply compile size for no burst-write benefit (the
+        # permutation path is compute-, not write-bound).
+        D = 1
+    elif _demes_per_step:
         # round an explicit request down to the largest valid candidate
         D = next((d for d in d_candidates if d <= _demes_per_step), 1)
     elif bf16_genes:
@@ -482,6 +559,7 @@ def make_pallas_breed(
         L=L,
         Lp=Lp,
         tk=tournament_size,
+        crossover=crossover_kind,
         mutate=mutate_kind,
         obj=fused_obj,
         n_consts=len(consts),
@@ -568,6 +646,7 @@ def make_pallas_breed(
     breed.takes_params = True
     breed.default_params = default_params
     breed.elitism = elitism
+    breed.crossover_kind = crossover_kind
     return breed
 
 
@@ -577,6 +656,7 @@ def make_pallas_run(
     tournament_size: int = 2,
     mutation_rate: float = 0.01,
     mutation_sigma: float = 0.0,
+    crossover_kind: str = "uniform",
     mutate_kind: str = "point",
     elitism: int = 0,
     deme_size: Optional[int] = None,
@@ -619,7 +699,8 @@ def make_pallas_run(
             pop_size, genome_len,
             deme_size=deme_size, tournament_size=tournament_size,
             mutation_rate=mutation_rate,
-            mutation_sigma=mutation_sigma, mutate_kind=mutate_kind,
+            mutation_sigma=mutation_sigma,
+            crossover_kind=crossover_kind, mutate_kind=mutate_kind,
             elitism=elitism if fused_obj is not None else 0,
             fused_obj=fused_obj, fused_consts=fused_consts,
             gene_dtype=gene_dtype,

@@ -547,6 +547,69 @@ def test_island_pallas_path_custom_objective_with_elitism(monkeypatch):
         )
 
 
+def test_order_crossover_kernel_structure():
+    """Zero-entropy interpret mode: every tournament candidate is deme
+    row 0, so both parents are that row and the kernel's order crossover
+    must reproduce the XLA operator's semantics exactly: first
+    occurrence of each decoded city is kept, later duplicates fall back
+    to the raw random value (0.0 under zero bits). Swap mutation under
+    zero bits swaps position 0 with itself — a no-op."""
+    from libpga_tpu.ops.crossover import order_preserving_crossover
+
+    P, L, K = 256, 10, 128
+    G = P // K
+    rng = np.random.default_rng(3)
+    genomes = np.asarray(
+        (rng.permuted(np.tile(np.arange(L), (P, 1)), axis=1) + 0.5) / L,
+        dtype=np.float32,
+    )
+    # Plant duplicates in each deme's row 0 so the rand-fallback path is
+    # exercised: positions 3 and 7 decode to the same city as 0 and 1.
+    for d in range(G):
+        genomes[d * K, 3] = genomes[d * K, 0]
+        genomes[d * K, 7] = genomes[d * K, 1]
+
+    with _interpret():
+        breed = make_pallas_breed(
+            P, L, deme_size=K, crossover_kind="order", mutate_kind="swap",
+            mutation_rate=0.9,
+        )
+        assert breed is not None and breed.crossover_kind == "order"
+        out = np.asarray(
+            breed(jnp.asarray(genomes), jnp.zeros((P,)), jax.random.key(0))
+        )
+
+    for d in range(G):
+        row0 = jnp.asarray(genomes[d * K])
+        expect = np.asarray(
+            order_preserving_crossover(row0, row0, jnp.zeros((L,)))
+        )
+        # children of deme d land at output rows r*G + d (riffle layout)
+        np.testing.assert_allclose(
+            out[np.arange(K) * G + d], np.tile(expect, (K, 1)), atol=2e-5
+        )
+
+
+def test_order_crossover_gating():
+    """Order crossover serves f32 only (bf16 decode resolution corrupts
+    cities) and maps from the engine's operator registry."""
+    from libpga_tpu import PGA
+    from libpga_tpu.ops.crossover import order_preserving_crossover
+    from libpga_tpu.ops.mutate import make_swap_mutate
+
+    assert make_pallas_breed(
+        1024, 10, crossover_kind="order", gene_dtype=jnp.bfloat16
+    ) is None
+    assert make_pallas_breed(1024, 10, crossover_kind="nope") is None
+
+    pga = PGA(seed=0)
+    pga.set_crossover(order_preserving_crossover)
+    pga.set_mutate(make_swap_mutate(0.3))
+    assert pga._crossover_kind() == "order"
+    assert pga._mutate_kind() == "swap"
+    assert float(np.asarray(pga._mutate_params())[0, 0]) == np.float32(0.3)
+
+
 def test_mutation_rate_zero_never_fires():
     """rate=0 must be a strict no-op even for zero random bits (the gate
     is strict '<'; the reference's '<=' would fire on u == 0)."""

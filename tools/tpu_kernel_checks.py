@@ -157,6 +157,46 @@ def main() -> int:
     )
     good &= check("elitism: prev top-2 carried into rows 0..1", elite_ok)
 
+    # Permutation path: order-preserving crossover + swap mutation.
+    # From a population of PERFECT permutations, every child must itself
+    # be a perfect permutation: OPC from a duplicate-free p1 reduces to
+    # p1 (no fallback can fire), and a swap preserves uniqueness. From
+    # continuous random parents (~63.4 distinct decoded cities per 100),
+    # OPC repairs duplicates — children must decode strictly more unique
+    # cities on average.
+    def uniq_counts(arr):
+        c = np.clip(np.floor(arr * L).astype(int), 0, L - 1)
+        return np.array([len(set(row.tolist())) for row in c])
+
+    breedo = make_pallas_breed(
+        P, L, deme_size=K, crossover_kind="order", mutate_kind="swap",
+        mutation_rate=1.0,
+    )
+    perm_rng = np.random.default_rng(12)
+    perms = (
+        perm_rng.permuted(np.tile(np.arange(L), (P, 1)), axis=1) + 0.5
+    ).astype(np.float32) / L
+    outo = np.asarray(
+        breedo(jnp.asarray(perms), jax.random.uniform(jax.random.key(13), (P,)),
+               jax.random.key(14))
+    )
+    good &= check(
+        "order+swap: permutation parents -> permutation children",
+        bool((uniq_counts(outo) == L).all()),
+    )
+    randg = jax.random.uniform(jax.random.key(15), (P, L))
+    outr = np.asarray(
+        breedo(randg, jax.random.uniform(jax.random.key(16), (P,)),
+               jax.random.key(17))
+    )
+    u_parent = float(uniq_counts(np.asarray(randg)).mean())
+    u_child = float(uniq_counts(outr).mean())
+    good &= check(
+        f"order crossover repairs duplicates ({u_parent:.1f} -> {u_child:.1f} "
+        "unique cities)",
+        u_child > u_parent + 5.0,
+    )
+
     from libpga_tpu import PGA, PGAConfig
 
     pga = PGA(seed=7, config=PGAConfig(use_pallas=True))
