@@ -13,10 +13,13 @@ Prints exactly one JSON line. Headline fields:
     launch-bound floor (see below);
   ms_per_gen, achieved_tflops, mfu — chip-relative figures so progress is
     measured against the hardware, not only against the reference's worst
-    property (the kernel's dominant cost is the one-hot parent-selection
-    matmuls: 2·K²·Lp FLOPs per (K,K)@(K,Lp) matmul, 4 matmuls/deme for
+    property. The FLOPs model counts ONLY the one-hot parent-selection
+    matmuls (2·K²·Lp FLOPs per (K,K)@(K,Lp) matmul, 4 matmuls/deme for
     f32 hi/lo genes, 2 for bf16 → P·K·Lp·8 (f32) or ·4 (bf16)
-    FLOPs/generation);
+    FLOPs/generation) — selection sampling, the per-generation rank
+    sort, PRNG, crossover/mutation, and fused evaluation are real work
+    the model deliberately excludes, so treat mfu as a matmul-
+    utilization gauge (gens/sec is the headline; see BASELINE.md);
   bf16_* — the bfloat16 gene mode (single exact selection matmul, half
     the FLOPs; genes at bf16 resolution);
   islands_* — 8-island × 131,072 OneMax with ring migration every 10
@@ -109,8 +112,14 @@ def bench_single(gene_dtype) -> dict:
 
     from libpga_tpu.ops.pallas_step import _pick_deme_size, auto_deme_size
 
-    K = _pick_deme_size(POP, auto_deme_size(gene_dtype))
     Lp = math.ceil(GENOME_LEN / 128) * 128
+    # Mirror make_pallas_breed's exact K choice (lane- and dtype-aware)
+    # so the FLOPs model can never describe a deme size the kernel
+    # didn't run.
+    K = _pick_deme_size(
+        POP, auto_deme_size(gene_dtype), genome_lanes=Lp,
+        gene_bytes=2 if gene_dtype == jnp.bfloat16 else 4,
+    )
     matmuls = 2 if gene_dtype == jnp.bfloat16 else 4
     flops_per_gen = POP * K * Lp * 2 * matmuls
     achieved = gps * flops_per_gen

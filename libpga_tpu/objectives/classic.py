@@ -20,10 +20,15 @@ import numpy as np
 # into the generation kernel so children are scored while still in VMEM.
 
 
-def _rowwise(rows_fn, doc):
+def _rowwise(rows_fn, doc, pad_ok=False):
     def per_genome(genome: jax.Array) -> jax.Array:
         return rows_fn(genome[None, :])[0]
 
+    # ``pad_ok``: the rowwise reduction is invariant to extra all-zero
+    # gene columns, so the breed kernel may pass the full lane-aligned
+    # (K, Lp) child instead of the misaligned (K, L) slice (which costs
+    # a relayout per deme — see pallas_step's fused-evaluation note).
+    rows_fn.pad_ok = pad_ok
     per_genome.kernel_rowwise = rows_fn
     per_genome.__doc__ = doc
     return per_genome
@@ -35,11 +40,13 @@ onemax = _rowwise(
     lambda m: jnp.sum(m, axis=1),
     """Continuous OneMax: sum of genes. The reference's first driver
     objective (``test/test.cu:24-30``). Optimum = genome_len (genes → 1).""",
+    pad_ok=True,  # sum of zero pads is zero
 )
 
 onemax_bits = _rowwise(
     lambda m: jnp.sum((m >= 0.5).astype(jnp.float32), axis=1),
     """Bitstring OneMax: count of genes that round to 1. Optimum = L.""",
+    pad_ok=True,  # zero pads count as 0-bits
 )
 
 

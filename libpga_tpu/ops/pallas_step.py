@@ -16,12 +16,24 @@ This kernel removes all HBM random access:
 - **Demes**: the population is processed in blocks ("demes") of ``K``
   rows that live entirely in VMEM. Selection happens *within* a deme, so
   every random access is on-chip.
-- **Selection + gather on the MXU**: a k-way tournament needs ``s[idx]``
-  lookups and parent-row gathers; both become one-hot matmuls
-  (``onehot @ scores`` and ``onehot @ genomes``), which the MXU executes
-  at full tilt. Gene matrices multiply as a bf16 hi/lo split
-  (``g ≈ hi + lo``), giving ~1e-5 absolute accuracy on [0,1) genes —
-  far below mutation noise — at 2× bf16 FLOPs instead of slow f32 MXU.
+- **Selection in rank space**: each deme's rows are ranked outside the
+  kernel (one two-key sort per generation — score first, then a fresh
+  random word so ties shuffle uniformly; NaNs last among real rows,
+  pads strictly last), and the k-way tournament winner is *sampled
+  directly in rank space* — the winner's rank is the minimum of k
+  i.i.d. uniform candidate ranks, whose inverse CDF is
+  ``floor(V·(1-(1-u)^{1/k}))``. The winner-SCORE distribution is exact
+  (``P(rank=r) = ((V-r)^k - (V-r-1)^k)/V^k``, identical to drawing k
+  candidates and keeping the best score), and within a score-tie block
+  the per-generation random tie order makes each row's expected
+  selection mass exactly uniform (draws within one generation share the
+  realized order — the only deviation from fully independent candidate
+  draws). No per-candidate score lookups, no winner fold, and the cost
+  is independent of k. The winner's parent row is then gathered by a
+  one-hot matmul (``onehot @ genomes``), which the MXU executes at full
+  tilt. Gene matrices multiply as a bf16 hi/lo split (``g ≈ hi + lo``),
+  giving ~1e-5 absolute accuracy on [0,1) genes — far below mutation
+  noise — at 2× bf16 FLOPs instead of slow f32 MXU.
 - **In-kernel PRNG**: ``pltpu.prng_random_bits`` generates tournament
   indices, crossover masks, and mutation draws in registers, so no
   ``(P, L)`` random pool ever touches HBM (the reference materializes
@@ -36,8 +48,12 @@ Semantics note: selection is a tournament *within the current deme* (a
 random cohort of ``K`` that reshuffles every generation), not i.i.d. over
 the full population. Selection intensity is identical to the panmictic
 tournament; only opponent locality differs, and the per-generation
-riffle shuffle randomizes it. The exact-panmictic path remains available
-via the XLA breed step (``use_pallas=False``).
+riffle shuffle randomizes it. Measured equivalence
+(``tools/selection_equivalence.py``, BASELINE.md): selection intensity
+within 0.6% of the panmictic XLA path at every deme size, takeover time
+within 1.5%, OneMax generations-to-99%-optimum within 0.5%. The
+exact-panmictic path remains available via the XLA breed step
+(``use_pallas=False``).
 """
 
 from __future__ import annotations
@@ -59,19 +75,55 @@ def _valid_deme(k: int) -> bool:
     return bool(k) and not (k & (k - 1)) and 128 <= k <= 1024
 
 
+def _scoped_vmem_bytes(K: int, D: int, Lp: int, gene_bytes: int) -> int:
+    """Conservative model of the kernel's scoped-VMEM stack for one grid
+    step, calibrated against hardware compiles (Mosaic's scoped limit is
+    16 MiB): genome in+out blocks (D·K·Lp each), the selection one-hots
+    (two bf16 K×K planes plus an f32 temp's worth of headroom), and one
+    deme's row intermediates (f32 parents/child, bf16 hi/lo for f32
+    genes, the crossover mask). Measured anchors (with the former
+    in-kernel rank cube, which this model retains as headroom): f32
+    K=1024 D=1 at Lp=128 compiles, D=4 OOMs at 18.26M reported; bf16
+    K=256 Lp=2048 D=2 compiles, K=512 Lp=2048 fails (row term alone
+    16.8M)."""
+    blocks = 2 * D * K * Lp * gene_bytes
+    cubes = K * K * (4 + 2 + 2)
+    rows = K * Lp * (3 * 4 + 4 + (4 if gene_bytes == 4 else 0))
+    return blocks + cubes + rows
+
+
+_SCOPED_VMEM_LIMIT = 14_500_000  # of the 16 MiB scoped stack; f32 K=1024
+# D=4 at Lp=128 models 15.2M and OOMs on hardware, D=2 models 13.1M and runs
+
+# Mosaic double-buffers the pipelined genome in+out blocks, so their raw
+# bytes are bounded separately from the additive stack model. Anchors:
+# f32 K=256 D=16 at Lp=128 compiles (8.4M doubled), D=32 OOMs (16.8M);
+# bf16 K=256 D=32 compiles (8.4M doubled).
+_BLOCK_BYTES_LIMIT = 8_650_000
+
+
+def _blocks_fit(K: int, D: int, Lp: int, gene_bytes: int) -> bool:
+    return (
+        4 * D * K * Lp * gene_bytes <= _BLOCK_BYTES_LIMIT
+        and _scoped_vmem_bytes(K, D, Lp, gene_bytes) <= _SCOPED_VMEM_LIMIT
+    )
+
+
 def _pick_deme_size(
-    pop_size: int, preferred: int, genome_lanes: int = LANE, max_k: int = 1024
+    pop_size: int,
+    preferred: int,
+    genome_lanes: int = LANE,
+    max_k: int = 1024,
+    gene_bytes: int = 4,
 ):
     """Deme size for a population: exact divisors first (zero padding),
     then a padded fit — the kernel pads the population up to the next
     deme multiple and masks the pad rows out of selection.
 
-    ``genome_lanes`` (the lane-padded genome length) bounds the deme:
-    the kernel holds ~6 K×Lp f32-sized buffers in VMEM (parents, child,
-    hi/lo splits, crossover mask), so K·Lp is capped at 600K elements —
-    K=512 at Lp=2048 needs ~23 MB of scoped VMEM and fails to compile,
-    K=256 fits (measured). Genomes too long for even K=128 fall back to
-    the XLA path.
+    ``genome_lanes`` (the lane-padded genome length) bounds the deme via
+    the scoped-VMEM model (``_scoped_vmem_bytes`` at D=1) — e.g. K=512
+    at Lp=2048 needs ~23 MB and fails to compile, K=256 fits (measured).
+    Genomes too long for even K=128 fall back to the XLA path.
 
     Padded fits must keep the short tail deme healthy: a tail of
     ``tail = P - (G-1)K`` valid rows breeds K children from only
@@ -85,9 +137,7 @@ def _pick_deme_size(
     the least-waste fit wins. None (→ XLA path) for populations under
     one 128-row tile or with only degenerate-tail fits."""
     def fits(k: int) -> bool:
-        # ``max_k`` additionally bounds the tournament candidate masks
-        # (see make_pallas_breed's k_budget).
-        return k <= max_k and k * genome_lanes <= 600_000
+        return k <= max_k and _blocks_fit(k, 1, genome_lanes, gene_bytes)
 
     if _valid_deme(preferred) and fits(preferred) and pop_size % preferred == 0:
         return preferred
@@ -159,9 +209,11 @@ def _breed_kernel(
     crossover="uniform",
     mutate="point",
     obj=None,
+    obj_pad_ok=False,
     n_consts=0,
     bf16_genes=False,
     P=None,
+    ablate=(),
 ):
     """One grid step = ``D`` consecutive demes: select parents, crossover,
     mutate — and, when ``obj`` is given, evaluate the children in-kernel
@@ -211,76 +263,84 @@ def _breed_kernel(
 
     rate = mparams_ref[0, 0]
 
-    T = 2 * tk  # candidate index vectors: tk per parent, two parents
+    if crossover == "uniform" and "no_cross" not in ablate:
+        # Crossover coin flips need ONE bit per gene, not a 32-bit draw:
+        # a single (K, Lp) PRNG tile per grid step serves every deme in
+        # the group — deme d reads bit d of each word (distinct bits of
+        # one generator call are independent streams), cutting mask PRNG
+        # volume D× (the mask draw measured ~1.3 ms/gen of the 1M×100
+        # generation at one-draw-per-deme).
+        mask_words = pltpu.bitcast(pltpu.prng_random_bits((K, Lp)), jnp.uint32)
+
+    if mutate == "gaussian" and Lp > L:
+        # Keep pad lanes untouched by gaussian noise so the pads-stay-
+        # zero invariant holds for every mutation kind (pad_ok fused
+        # objectives rely on it; point/swap positions are < L already).
+        lane_ok = lax.broadcasted_iota(jnp.int32, (K, Lp), 1) < L
 
     for d in range(D):
         g = g_all[d * K : (d + 1) * K, :]  # (K, Lp)
-        s3 = s_all[:, d, :]  # (1, K)
 
-        # ---- tournament-k ×2: 2k candidate indices over valid rows ----
-        idx_bits = pltpu.bitcast(pltpu.prng_random_bits((T, K)), jnp.uint32)
-        if P is None or P % K == 0:
-            # exact-divisor population: K = 2^m, mask the bits directly
-            idx = pltpu.bitcast(idx_bits & jnp.uint32(K - 1), jnp.int32)
+        # ---- rank-space tournament selection --------------------------
+        if "sel_const" in ablate:
+            # Ablation harness (tools/ablate_kernel.py): identity
+            # selection isolates the sampling + one-hot cost from the
+            # parent matmuls.
+            oh1 = oh2 = (
+                lax.broadcasted_iota(jnp.int32, (K, K), 0)
+                == lax.broadcasted_iota(jnp.int32, (K, K), 1)
+            ).astype(jnp.bfloat16)
         else:
-            # padded population: the last deme holds V = P - g·K < K real
-            # rows (pads beyond them). Sample idx = floor(u * V) so a pad
-            # row can never enter a tournament — the masked-score route
-            # would still clone pad genomes when both candidates land on
-            # pads.
-            deme = i * D + d
-            V = jnp.maximum(
-                jnp.minimum(jnp.int32(K), jnp.int32(P) - deme * K), 1
-            )
-            u4 = pltpu.bitcast(idx_bits >> 8, jnp.int32).astype(
-                jnp.float32
-            ) * jnp.float32(2**-24)
-            idx = jnp.minimum(
-                (u4 * V.astype(jnp.float32)).astype(jnp.int32), V - 1
-            )
+            # ``scores_ref`` carries each row's PRE-COMPUTED in-deme
+            # rank (0 = best; strict total order, score ties broken by
+            # row index, NaNs last) — the caller derives them from the
+            # scores with one stable double-argsort per generation
+            # (``breed_padded``), which costs ~0.8 ms/gen at 1M×100 and
+            # replaces what used to be a K×K compare+reduce cube per
+            # deme in here (~1–2 ms/gen, growing linearly with K).
+            R = s_all[:, d, :]  # (1, K) f32 ranks
 
-        # Candidate scores: masked f32 reduce on the VPU — exact (no
-        # rounding of scores). The source-major iota-compare (axis 1 =
-        # source row = sublanes) makes the reduction run over sublanes,
-        # which the VPU does ~2× faster than a lane reduction (measured
-        # 10.2 → 8.3 ms/gen at 1M×100). An MXU one-hot mat-vec
-        # alternative measured ~40% SLOWER end-to-end: the
-        # (2k·K, K)@(K, 1) matvec runs at N=1 efficiency and the bf16
-        # mask cast costs a pass anyway.
-        cand_src = (
-            lax.broadcasted_iota(jnp.int32, (T, K, K), 1) == idx[:, None, :]
-        )
-        sc = jnp.sum(
-            jnp.where(cand_src, s3.reshape(1, K, 1), 0.0), axis=1
-        )  # (T, K)
-        sc_t = sc.T  # (K, T) — f32 transpose is supported
+            # The k-way tournament winner is the candidate with the
+            # minimum rank; for k i.i.d. uniform candidate draws over V
+            # valid rows that minimum has inverse CDF
+            # rank = floor(V·(1-(1-u)^{1/k})):
+            # P(rank=r) = ((V-r)^k - (V-r-1)^k)/V^k, exactly the
+            # distribution of drawing k candidates and keeping the best
+            # score. One uniform per parent replaces 2k candidate draws
+            # + 2k score lookups, at k-independent cost. Power-of-two k
+            # uses repeated sqrt; other k the exp/log form.
+            if P is None or P % K == 0:
+                Vf = jnp.float32(K)
+            else:
+                # padded population: the last deme holds V = P - deme·K
+                # < K real rows (pads beyond them, carrying -inf
+                # scores). Ranks 0..V-1 are exactly the real rows (index
+                # tie-break puts any -inf real row before the pads), so
+                # sampling rank < V means a pad row can never be
+                # selected.
+                deme = i * D + d
+                Vf = jnp.maximum(
+                    jnp.minimum(jnp.int32(K), jnp.int32(P) - deme * K), 1
+                ).astype(jnp.float32)
 
-        # Tie -> first candidate, matching the reference's strict '>'
-        # (pga.cu:286). Winner INDICES are resolved first (a strict-'>'
-        # fold over each parent's k candidates, so the earliest best
-        # wins) and only the two winning one-hots are materialized. The
-        # alternative — build all candidate one-hots and where-select
-        # between them — measured ~30% of the whole generation at k=2
-        # (89 → 126 gens/sec at 1M×100 f32 K=256; 99 → 147 at K=512
-        # bf16).
-        idx_t = idx.T  # (K, T) i32 transpose is supported
+            u_t = uniform((2, K)).T  # (K, 2): one winner draw per parent
+            if tk == 1:
+                x = u_t
+            elif tk & (tk - 1) == 0:
+                t = 1.0 - u_t
+                for _ in range(tk.bit_length() - 1):
+                    t = jnp.sqrt(t)
+                x = 1.0 - t
+            else:
+                x = 1.0 - jnp.exp(jnp.log(1.0 - u_t) * jnp.float32(1.0 / tk))
+            # floor can graze V at f32 precision (x·V rounds up); clamp.
+            wr = jnp.minimum(jnp.floor(x * Vf), Vf - 1.0)  # (K, 2) ranks
 
-        def tourney(base):
-            best_s = sc_t[:, base : base + 1]  # (K, 1)
-            best_i = idx_t[:, base : base + 1]
-            for c in range(1, tk):
-                s_c = sc_t[:, base + c : base + c + 1]
-                i_c = idx_t[:, base + c : base + c + 1]
-                better = s_c > best_s
-                best_s = jnp.where(better, s_c, best_s)
-                best_i = jnp.where(better, i_c, best_i)
-            return best_i
-
-        widx1 = tourney(0)
-        widx2 = tourney(tk)
-        src_cols = lax.broadcasted_iota(jnp.int32, (K, K), 1)
-        oh1 = (src_cols == widx1).astype(jnp.bfloat16)  # winner selectors
-        oh2 = (src_cols == widx2).astype(jnp.bfloat16)
+            # Winner one-hots by rank equality: ranks are distinct
+            # integers 0..K-1 (exact in f32), so each row of the compare
+            # is an exact one-hot over the deme's source rows.
+            oh1 = (R == wr[:, 0:1]).astype(jnp.bfloat16)
+            oh2 = (R == wr[:, 1:2]).astype(jnp.bfloat16)
 
         # ---- parent rows via one-hot matmul ---------------------------
         if bf16_genes:
@@ -300,15 +360,19 @@ def _breed_kernel(
                 lo = jnp.dot(oh_w, g_lo, preferred_element_type=jnp.float32)
                 return hi + lo
 
-        p1 = sel(oh1)  # (K, Lp) f32
-        p2 = sel(oh2)
+        if "no_matmul" in ablate:
+            p1 = p2 = g.astype(jnp.float32)
+        else:
+            p1 = sel(oh1)  # (K, Lp) f32
+            p2 = sel(oh2)
 
-        if crossover == "uniform":
+        if "no_cross" in ablate:
+            child = p1
+        elif crossover == "uniform":
             # ---- uniform crossover: per-gene coin flip (pga.cu:135-143)
-            mask_bits = pltpu.bitcast(
-                pltpu.prng_random_bits((K, Lp)), jnp.uint32
+            child = jnp.where(
+                ((mask_words >> d) & jnp.uint32(1)) == 0, p1, p2
             )
-            child = jnp.where(mask_bits >> 31 == 0, p1, p2)
         elif crossover == "order":
             # ---- order-preserving crossover (reference TSP driver,
             # test3/test.cu:48-64): walk gene positions left to right,
@@ -351,7 +415,9 @@ def _breed_kernel(
             raise ValueError(f"unknown crossover kind {crossover!r}")
 
         # ---- mutation -------------------------------------------------
-        if mutate == "point":
+        if "no_mut" in ablate:
+            pass
+        elif mutate == "point":
             # Point mutation (pga.cu:127-133): one random gene per firing
             # row.
             u_t = uniform((4, K)).T  # (K, 4) f32
@@ -377,7 +443,10 @@ def _breed_kernel(
                 2.0 * jnp.float32(math.pi) * u2
             )
             mutated = jnp.clip(child + sigma * normal, 0.0, 1.0 - 1e-7)
-            child = jnp.where(gate < rate, mutated, child)
+            fire = gate < rate
+            if Lp > L:
+                fire = fire & lane_ok
+            child = jnp.where(fire, mutated, child)
         elif mutate == "swap":
             # Swap two random positions with probability ``rate``
             # (ops/mutate.swap_mutate semantics — permutation GAs).
@@ -401,7 +470,10 @@ def _breed_kernel(
         # r·G + i·D + d — the same riffle as the D=1 layout).
         out_dtype = jnp.bfloat16 if bf16_genes else jnp.float32
         child = child.astype(out_dtype)
-        out_ref[:, 0, d, :] = child
+        if "no_riffle" in ablate:
+            out_ref[d * K : (d + 1) * K, :] = child
+        else:
+            out_ref[:, 0, d, :] = child
         if bf16_genes:
             # Score the STORED genes: evaluating the pre-rounding f32
             # child would return scores the written bf16 genomes don't
@@ -414,14 +486,19 @@ def _breed_kernel(
             # HBM. ``obj`` here is the objective's ROWWISE form
             # ((K, L) -> (K,) with axis=1 reductions): a per-genome fn
             # under jax.vmap unrolls into K scalar reductions in Mosaic
-            # (~100× slower, measured). Scores write as ONE contiguous
-            # (1,1,K) row per deme — routing them through the genome
-            # output's column mapping would mean a K-element strided
-            # scatter per deme, which costs ~12 ms/gen at 1M pop
-            # (measured); the caller instead applies a cheap (G,K)
-            # transpose to match the riffle-shuffled genome row order.
+            # (~100× slower, measured). Objectives whose reductions are
+            # invariant to zero pad lanes declare ``pad_ok`` and receive
+            # the full lane-aligned (K, Lp) child — the (K, L) slice is
+            # a misaligned relayout that measured ~1 ms/gen at 1M×100.
+            # Scores write as ONE contiguous (1,1,K) row per deme —
+            # routing them through the genome output's column mapping
+            # would mean a K-element strided scatter per deme, which
+            # costs ~12 ms/gen at 1M pop (measured); the caller instead
+            # applies a cheap (G,K) transpose to match the
+            # riffle-shuffled genome row order.
             child_scores = obj(
-                child[:, :L], *[r[:] for r in const_refs]
+                child if obj_pad_ok else child[:, :L],
+                *[r[:] for r in const_refs],
             ).astype(jnp.float32)
             rest[n_consts + 1][d : d + 1, :, :] = child_scores.reshape(
                 1, 1, K
@@ -443,6 +520,7 @@ def make_pallas_breed(
     fused_consts: tuple = (),
     gene_dtype=jnp.float32,
     _demes_per_step: Optional[int] = None,
+    _ablate: tuple = (),
 ) -> Optional[Callable]:
     """Build the fused breed: ``(genomes (P,L), scores (P,), key[, mparams])
     -> next_genomes (P, L)`` — or, with ``fused_obj``, ``-> (next_genomes,
@@ -484,8 +562,10 @@ def make_pallas_breed(
         # fall back to the XLA scan path.
         return None
     if not (1 <= tournament_size <= 16):
-        # k-way selection materializes 2k (K, K) candidate masks; cap
-        # where their VMEM cost stops making sense.
+        # Documented engine contract (k beyond 16 is a configuration
+        # smell — selection pressure ~k/(k+1) saturates). Rank-space
+        # sampling makes the in-kernel cost k-independent, so the cap is
+        # a contract bound, not a resource one.
         return None
     if elitism > 0 and fused_obj is None:
         # The epilogue needs next-generation scores; without fused
@@ -497,37 +577,24 @@ def make_pallas_breed(
     P, L = pop_size, genome_len
     Lp = math.ceil(L / LANE) * LANE
 
-    # k-way selection materializes 2k (K, K) candidate masks; bound the
-    # deme so their footprint stays at or below the largest verified
-    # shape (k=2 at K=1024: 2·2·1024² ≈ 4.2M elements, which compiles
-    # and runs). The budget shrinks the deme as k grows — k=4 caps at
-    # K=512, k=16 at K=256 — rather than declining the fast path.
-    k_budget = 128
-    while k_budget < 1024 and (
-        2 * tournament_size * (k_budget * 2) ** 2 <= 4_194_304
-    ):
-        k_budget *= 2
-    K = _pick_deme_size(P, deme_size, genome_lanes=Lp, max_k=k_budget)
+    # Rank-space selection holds one (K, K) rank cube regardless of k,
+    # so the deme size no longer shrinks with tournament size.
+    gene_bytes = 2 if bf16_genes else 4
+    K = _pick_deme_size(P, deme_size, genome_lanes=Lp, gene_bytes=gene_bytes)
     if K is None:
         return None
     G = math.ceil(P / K)
     Pp = G * K  # padded row count; == P for exact-divisor populations
     # Demes per grid step: larger groups write D·Lp-contiguous bursts
-    # through the riffle layout (see _breed_kernel). Measured at 1M×100:
-    # bf16 genes gain ~7% at D=8 (write-bound: half the bytes per FLOP);
-    # f32 genes are fastest at D=1 (the hi/lo path's extra VMEM pressure
-    # with D·K-row blocks outweighs the burst win) — so the default
-    # groups only for bf16. Candidates must divide G and keep the
-    # (D·K, Lp) genome block within a VMEM budget (long genomes that
-    # compile at D=1 must not start failing grouped).
-    # Budget note: the ~16 MiB scoped VMEM also holds the output block
-    # (same size), one deme's f32 parent/child intermediates (K·Lp·4B
-    # each), and the tournament masks — 2 MiB of input block is the
-    # measured safe bound (4 MiB OOMs at Lp=2048).
-    gene_bytes = 2 if bf16_genes else 4
+    # through the riffle layout (see _breed_kernel) — the riffle's
+    # strided HBM writes are a top non-matmul cost at D=1 (512-byte
+    # bursts for f32 at Lp=128). Candidates must divide G and keep the
+    # whole grid step within the scoped-VMEM model (long genomes that
+    # compile at D=1 must not start failing grouped; K=1024 at D≥2
+    # OOMs the 16 MiB scoped limit — measured).
     d_candidates = [
-        d for d in (8, 4, 2, 1)
-        if G % d == 0 and d * K * Lp * gene_bytes <= 2 * 1024 * 1024
+        d for d in (32, 16, 8, 4, 2, 1)
+        if G % d == 0 and _blocks_fit(K, d, Lp, gene_bytes)
     ] or [1]
     if crossover_kind == "order":
         # The order crossover unrolls L trace-time steps per deme; D>1
@@ -538,9 +605,14 @@ def make_pallas_breed(
         # round an explicit request down to the largest valid candidate
         D = next((d for d in d_candidates if d <= _demes_per_step), 1)
     elif bf16_genes:
-        D = d_candidates[0]
+        # Measured sweet spots at 1M×100 (tools/sweep_kernel.py, round
+        # 3): bf16 peaks at D=4 (K=512: 159 gens/sec vs 156-158 at
+        # D∈{2,8}); f32 keeps gaining through D=16 (K=256: 134 vs 133 at
+        # D=8, 124 at D=4) — its 4-byte rows need bigger bursts before
+        # the riffle's strided writes stop hurting.
+        D = next((d for d in d_candidates if d <= 4), 1)
     else:
-        D = 1
+        D = next((d for d in d_candidates if d <= 16), 1)
 
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -562,13 +634,21 @@ def make_pallas_breed(
         crossover=crossover_kind,
         mutate=mutate_kind,
         obj=fused_obj,
+        obj_pad_ok=bool(getattr(fused_obj, "pad_ok", False)),
         n_consts=len(consts),
         bf16_genes=bf16_genes,
         P=P,
+        ablate=tuple(_ablate),
     )
 
-    out_specs = [pl.BlockSpec((K, 1, D, Lp), lambda i: (0, i, 0, 0))]
-    out_shape = [jax.ShapeDtypeStruct((K, G // D, D, Lp), gene_dtype)]
+    if "no_riffle" in _ablate:
+        # Ablation: contiguous deme-major writes, no inter-deme mixing —
+        # measures the riffle layout's strided-write cost.
+        out_specs = [pl.BlockSpec((D * K, Lp), lambda i: (i, 0))]
+        out_shape = [jax.ShapeDtypeStruct((Pp, Lp), gene_dtype)]
+    else:
+        out_specs = [pl.BlockSpec((K, 1, D, Lp), lambda i: (0, i, 0, 0))]
+        out_shape = [jax.ShapeDtypeStruct((K, G // D, D, Lp), gene_dtype)]
     if fused_obj is not None:
         out_specs.append(pl.BlockSpec((D, 1, K), lambda i: (i, 0, 0)))
         out_shape.append(jax.ShapeDtypeStruct((G, 1, K), jnp.float32))
@@ -600,20 +680,54 @@ def make_pallas_breed(
         reductions and target checks never see a discarded child."""
         if mparams is None:
             mparams = default_params
+        k_seed, k_tie = jax.random.split(key)
         seed = jax.random.randint(
-            key, (1, 1), jnp.iinfo(jnp.int32).min, jnp.iinfo(jnp.int32).max,
+            k_seed, (1, 1), jnp.iinfo(jnp.int32).min, jnp.iinfo(jnp.int32).max,
             dtype=jnp.int32,
         )
-        out = call(
-            seed, mparams,
-            scores.reshape(G // D, D, K).astype(jnp.float32), gp,
-            *consts,
+        # In-deme ranks (0 = best): one two-key sort per generation over
+        # each deme's scores, replacing what used to be a K×K
+        # compare+reduce cube per deme inside the kernel. Keys, in
+        # order:
+        #  1. negated scores, with NaN pinned to -inf first so NaN rows
+        #     rank last among real rows instead of after the pads
+        #     (XLA's sort order puts NaN above +inf);
+        #  2. a fresh random word per row, so SCORE TIES are broken in a
+        #     new uniform random order every generation — each tied
+        #     row's expected selection mass is then exactly uniform over
+        #     the tie block (an index tie-break would systematically
+        #     favor low-index rows of wide tie blocks, e.g. onemax_bits
+        #     with its L+1 distinct score levels). Pad rows get the
+        #     maximal tie key (real rows' keys are shifted into [0,
+        #     2^31)), so they still sort strictly after every real row
+        #     and sampling rank < V can never select one.
+        s_real = jnp.where(jnp.isnan(scores), -jnp.inf, scores)
+        neg = -s_real.reshape(G, K).astype(jnp.float32)
+        tb = jax.lax.shift_right_logical(
+            jax.random.bits(k_tie, (Pp,)), jnp.uint32(1)
         )
+        if Pp != P:
+            tb = jnp.where(
+                jnp.arange(Pp, dtype=jnp.int32) < P,
+                tb,
+                jnp.uint32(0xFFFFFFFF),
+            )
+        row_iota = jnp.broadcast_to(
+            jnp.arange(K, dtype=jnp.int32)[None, :], (G, K)
+        )
+        _, _, order = jax.lax.sort(
+            (neg, tb.reshape(G, K), row_iota), dimension=1, num_keys=2
+        )
+        ranks = jnp.argsort(order, axis=1, stable=True).astype(jnp.float32)
+        out = call(seed, mparams, ranks.reshape(G // D, D, K), gp, *consts)
         if fused_obj is not None:
             genomes, child_scores = out
             # Genome row order after reshape is (child r)·G + (deme i);
             # kernel scores come out deme-major (G, K) — transpose to match.
-            s2 = child_scores.reshape(G, K).T.reshape(Pp)
+            if "no_riffle" in _ablate:
+                s2 = child_scores.reshape(Pp)
+            else:
+                s2 = child_scores.reshape(G, K).T.reshape(Pp)
             if Pp != P:
                 s2 = jnp.where(
                     jnp.arange(Pp, dtype=jnp.int32) < P, s2, -jnp.inf

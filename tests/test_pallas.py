@@ -4,8 +4,12 @@ The kernel's PRNG (``pltpu.prng_random_bits``) only produces real entropy
 on TPU hardware; under ``force_tpu_interpret_mode`` on CPU it yields
 all-zero bits. That still deterministically exercises everything
 *structural* — block mappings, the riffle-shuffle output layout, the
-one-hot selection matmuls, padding — because zero bits mean "every
-tournament candidate is deme row 0", giving an exactly predictable output.
+one-hot selection matmuls, padding — because zero bits mean "the sampled
+winner rank is 0", i.e. every child descends from its deme's BEST-scoring
+row. Structure tests feed strictly-decreasing in-deme scores
+(``deme_rank0_scores``) so that row is deme row 0 deterministically (score
+ties are shuffled randomly per generation since round 3), giving an
+exactly predictable output.
 Distributional properties (selection pressure, mutation statistics) are
 validated on real TPU by ``tools/tpu_kernel_checks.py``, which the
 benchmark path runs against hardware.
@@ -24,6 +28,14 @@ def _interpret():
     from jax.experimental.pallas import tpu as pltpu
 
     return pltpu.force_tpu_interpret_mode()
+
+
+def deme_rank0_scores(P, K):
+    """Strictly decreasing scores within every deme (row d·K+j scores
+    -j): rank 0 is deme row 0 with no ties, so the per-generation random
+    score-tie shuffle cannot fire and zero-PRNG-bits structure
+    expectations ("every child copies deme row 0") stay exact."""
+    return -(jnp.arange(P, dtype=jnp.float32) % K)
 
 
 def test_unsupported_shapes_return_none():
@@ -110,30 +122,27 @@ def test_engine_gaussian_params_follow_signature_defaults():
 
 
 def test_run_factory_tournament_size_bounds():
-    """k-way tournaments are served in-kernel up to k=16; absurd sizes
-    decline to the XLA path instead of materializing 2k (K,K) masks."""
+    """k-way tournaments are served in-kernel up to the documented k=16
+    contract bound; sizes outside it decline to the XLA path."""
     assert make_pallas_breed(1024, 10, tournament_size=0) is None
     assert make_pallas_breed(1024, 10, tournament_size=17) is None
     assert make_pallas_breed(1024, 10, tournament_size=3) is not None
 
 
-def test_tournament_mask_budget_shrinks_deme():
-    """Large k shrinks the deme to keep the 2k (K,K) candidate masks
-    within the largest verified footprint, preferring the biggest K that
-    fits: k=2 keeps K=1024 (the pre-k-way behavior), k=4 caps at 512,
-    k=16 at 256."""
-    b = make_pallas_breed(1 << 20, 10, deme_size=1024, tournament_size=2)
-    assert b is not None and b.K == 1024
-    b = make_pallas_breed(1 << 20, 10, deme_size=1024, tournament_size=4)
-    assert b is not None and b.K == 512
-    b = make_pallas_breed(1 << 20, 10, deme_size=1024, tournament_size=16)
-    assert b is not None and b.K == 256
+def test_tournament_size_no_longer_shrinks_deme():
+    """Rank-space selection holds one (K,K) rank cube regardless of k, so
+    large tournaments keep the full deme (the former candidate-mask
+    budget capped k=4 at K=512 and k=16 at K=256)."""
+    for k in (2, 4, 16):
+        b = make_pallas_breed(1 << 20, 10, deme_size=1024, tournament_size=k)
+        assert b is not None and b.K == 1024, k
 
 
 def test_kernel_structure_tournament_k3():
-    """Zero PRNG bits with k=3: every candidate is deme row 0, so the
-    winner fold (strict '>', first-best retained) must still produce the
-    deme-row-0 child structure."""
+    """Zero PRNG bits with k=3 (a non-power-of-two, exercising the
+    exp/log branch of the inverse-CDF sampler): the sampled winner rank
+    is 0 and scores are equal, so the deme-row-0 child structure must
+    hold."""
     P, L, K = 512, 12, 128
     G = P // K
     with _interpret():
@@ -144,7 +153,9 @@ def test_kernel_structure_tournament_k3():
             jnp.broadcast_to(jnp.arange(P, dtype=jnp.float32)[:, None], (P, L))
             / P
         )
-        out = np.asarray(breed(genomes, jnp.zeros((P,)), jax.random.key(0)))
+        out = np.asarray(
+            breed(genomes, deme_rank0_scores(P, K), jax.random.key(0))
+        )
     expect = np.asarray([((r % G) * K) / P for r in range(P)], np.float32)
     np.testing.assert_allclose(
         out, np.broadcast_to(expect[:, None], (P, L)), atol=2e-5, rtol=0
@@ -173,8 +184,9 @@ def test_kernel_structure_zero_bits():
             jnp.broadcast_to(jnp.arange(P, dtype=jnp.float32)[:, None], (P, L))
             / P
         )
-        scores = jnp.zeros((P,), jnp.float32)
-        out = np.asarray(breed(genomes, scores, jax.random.key(0)))
+        out = np.asarray(
+            breed(genomes, deme_rank0_scores(P, K), jax.random.key(0))
+        )
     assert out.shape == (P, L)
     expect = np.asarray([(r % G) * K / P for r in range(P)], dtype=np.float32)
     np.testing.assert_allclose(out, np.broadcast_to(expect[:, None], (P, L)))
@@ -190,7 +202,9 @@ def test_kernel_gene_values_near_exact():
     genomes = jax.random.uniform(key, (P, L), dtype=jnp.float32)
     with _interpret():
         breed = make_pallas_breed(P, L, deme_size=K, mutation_rate=0.0)
-        out = np.asarray(breed(genomes, jnp.zeros((P,)), jax.random.key(1)))
+        out = np.asarray(
+            breed(genomes, deme_rank0_scores(P, K), jax.random.key(1))
+        )
     gn = np.asarray(genomes)
     # zero bits -> child r = row 0 of deme r % G
     for r in range(0, P, 37):
@@ -229,8 +243,9 @@ def test_kernel_padded_population_structure():
             jnp.broadcast_to(jnp.arange(P, dtype=jnp.float32)[:, None], (P, L))
             / P
         )
-        scores = jnp.zeros((P,), jnp.float32)
-        out = np.asarray(breed(genomes, scores, jax.random.key(0)))
+        out = np.asarray(
+            breed(genomes, deme_rank0_scores(P, K), jax.random.key(0))
+        )
     assert out.shape == (P, L)
     expect = np.asarray([((r % G) * K) / P for r in range(P)], np.float32)
     # atol: gene values ride the bf16 hi/lo one-hot matmul (~1e-5 bound);
@@ -266,6 +281,25 @@ def test_kernel_padded_fused_scores_inert_tail():
     sp2 = np.asarray(sp2)
     assert np.all(np.isneginf(sp2[P:])), "pad-row scores must be -inf"
     np.testing.assert_allclose(sp2[:P], s2, atol=1e-6, rtol=0)
+
+
+def test_padded_tail_nan_scores_never_select_pads():
+    """Round-3 review finding: with the rank sort done outside the
+    kernel, a NaN score in the tail deme sorted AFTER the pads' -inf
+    (XLA places NaN above +inf once negated), handing pad rows real
+    ranks < V — all-zero pad genomes could then be selected as parents.
+    NaN scores must rank last among REAL rows and pads strictly after
+    every real row."""
+    P, L, K = 300, 12, 128
+    with _interpret():
+        breed = make_pallas_breed(P, L, deme_size=K, mutation_rate=0.0)
+        genomes = jnp.full((P, L), 0.5, dtype=jnp.float32)
+        # every real score in the tail deme (rows 256..299) is NaN
+        scores = deme_rank0_scores(P, K).at[256:].set(jnp.nan)
+        out = np.asarray(breed(genomes, scores, jax.random.key(0)))
+    # zero PRNG bits -> every child copies its deme's rank-0 row, which
+    # must be a REAL row (gene 0.5), never an all-zero pad
+    np.testing.assert_array_equal(out, np.full((P, L), 0.5, np.float32))
 
 
 def test_padded_population_through_island_runner():
@@ -309,8 +343,7 @@ def test_fused_evaluation_scores_match_genome_order():
             jnp.broadcast_to(jnp.arange(P, dtype=jnp.float32)[:, None], (P, L))
             / P
         )
-        scores = jnp.zeros((P,), jnp.float32)
-        g2, s2 = breed(genomes, scores, jax.random.key(0))
+        g2, s2 = breed(genomes, deme_rank0_scores(P, K), jax.random.key(0))
     g2, s2 = np.asarray(g2), np.asarray(s2)
     assert s2.shape == (P,)
     # fused score r == onemax(genome row r) == L * (deme base)/P
@@ -358,7 +391,7 @@ def test_bf16_gene_mode_structure():
             jnp.broadcast_to(jnp.arange(P, dtype=jnp.float32)[:, None], (P, L))
             / P
         ).astype(jnp.bfloat16)
-        out = breed(genomes, jnp.zeros((P,)), jax.random.key(0))
+        out = breed(genomes, deme_rank0_scores(P, K), jax.random.key(0))
     assert out.dtype == jnp.bfloat16
     out = np.asarray(out.astype(jnp.float32))
     gn = np.asarray(genomes.astype(jnp.float32))
@@ -380,14 +413,15 @@ def test_engine_bf16_genes_on_xla_path():
 
 
 def test_deme_grouping_selection_and_vmem_cap():
-    """bf16 groups demes (D>1) when G divides; f32 stays at D=1; long
-    genomes whose grouped block would blow the VMEM budget fall back to
-    D=1 instead of failing at Mosaic compile time; explicit requests
-    round down to a valid divisor and are reported via breed.D."""
+    """Both dtypes group demes when G divides (bf16 capped at D=4, f32
+    at D=16 — measured sweet spots); long genomes whose grouped block
+    would blow the VMEM budget fall back to smaller D instead of
+    failing at Mosaic compile time; explicit requests round down to a
+    valid divisor and are reported via breed.D."""
     b = make_pallas_breed(4096, 16, deme_size=256, gene_dtype=jnp.bfloat16)
-    assert b.D == 8  # G=16, divisible
+    assert b.D == 4  # G=16, divisible; bf16 cap
     b = make_pallas_breed(4096, 16, deme_size=256)
-    assert b.D == 1  # f32 default
+    assert b.D == 16  # f32 cap
     # bf16, genome_len 2000 -> Lp=2048: K=512 would need ~23 MB of
     # scoped VMEM (fails to compile), so the deme is capped at K=256;
     # grouping stays within its block budget at D=2 (verified to compile
@@ -422,7 +456,7 @@ def test_gaussian_kernel_rate_zero_and_sigma_zero_are_noops():
             )
             assert breed is not None
             outs[(rate, sigma)] = np.asarray(
-                breed(genomes, jnp.zeros((P,)), jax.random.key(0))
+                breed(genomes, deme_rank0_scores(P, K), jax.random.key(0))
             )
     expect = np.asarray([((r % G) * K) / P for r in range(P)], np.float32)
     for out in outs.values():
@@ -478,10 +512,13 @@ def test_fused_elitism_preserves_top_rows():
     np.testing.assert_array_equal(g2[0], gn[131])
     np.testing.assert_array_equal(g2[1], gn[7])
     assert s2[0] == 9.0 and s2[1] == 5.0
-    # non-elite rows keep the zero-bits structure (copy of deme row 0)
+    # non-elite rows keep the zero-bits structure: each child copies its
+    # deme's BEST-scoring row (rank 0) — row 7 in deme 0, row 131 in
+    # deme 1
+    deme_best = {0: 7, 1: 131}
     for r in range(2, P, 41):
         np.testing.assert_allclose(
-            g2[r], gn[(r % G) * K], atol=2e-5, rtol=0
+            g2[r], gn[deme_best[r % G]], atol=2e-5, rtol=0
         )
     np.testing.assert_allclose(s2[2:], g2[2:].sum(axis=1), atol=1e-4, rtol=0)
 
@@ -576,7 +613,10 @@ def test_order_crossover_kernel_structure():
         )
         assert breed is not None and breed.crossover_kind == "order"
         out = np.asarray(
-            breed(jnp.asarray(genomes), jnp.zeros((P,)), jax.random.key(0))
+            breed(
+                jnp.asarray(genomes), deme_rank0_scores(P, K),
+                jax.random.key(0),
+            )
         )
 
     for d in range(G):

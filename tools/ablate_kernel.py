@@ -1,0 +1,106 @@
+"""Stage ablation of the fused breed kernel at 1M×100 OneMax.
+
+Usage: python tools/ablate_kernel.py [f32|bf16] [K] [D]
+Measures gens/sec with kernel stages disabled one at a time (the
+``_ablate`` hook in make_pallas_breed), so per-stage cost falls out by
+subtraction:
+
+  full            — the production kernel (fused evaluation on)
+  no_eval         — fused evaluation off          -> eval cost
+  no_mut          — mutation off                  -> mutation cost
+  no_cross        — crossover mask+select off     -> crossover PRNG cost
+  sel_const       — identity selection            -> rank cube + sampling
+  no_matmul       — parent matmuls bypassed       -> MXU cost
+  floor           — all of the above off          -> HBM IO + grid floor
+
+Feeds BASELINE.md's per-stage table after kernel changes.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from libpga_tpu.objectives import onemax
+from libpga_tpu.ops.pallas_step import make_pallas_breed
+
+POP = 1 << 20
+L = 100
+
+
+def make_loop(breed):
+    def body(_, carry):
+        g, s, key = carry
+        key, sub = jax.random.split(key)
+        out = breed.padded(g, s, sub)
+        g, s = out if breed.fused else (out, s)
+        return g, s, key
+
+    def loop(gp, sp, n):
+        g, s, _ = jax.lax.fori_loop(0, n, body, (gp, sp, jax.random.key(0)))
+        return g, s
+
+    return jax.jit(loop)
+
+
+def best_gps(fn, lo=30, hi=90, tries=3):
+    t_lo, t_hi = [], []
+    for _ in range(tries):
+        t0 = time.perf_counter(); fn(lo); t_lo.append(time.perf_counter() - t0)
+        t0 = time.perf_counter(); fn(hi); t_hi.append(time.perf_counter() - t0)
+    delta = min(t_hi) - min(t_lo)
+    return (hi - lo) / delta if delta > 0 else float("nan")
+
+
+def measure(dt, K, D, ablate, fused=True):
+    breed = make_pallas_breed(
+        POP, L, deme_size=K,
+        fused_obj=onemax.kernel_rowwise if fused else None,
+        gene_dtype=dt, _demes_per_step=D, _ablate=ablate,
+    )
+    assert breed is not None and breed.K == K and breed.D == D, (K, D)
+    gp = jax.random.uniform(jax.random.key(1), (breed.Pp, breed.Lp)).astype(dt)
+    sp = jnp.sum(gp[:, :L].astype(jnp.float32), axis=1)
+    loop = make_loop(breed)
+
+    def run(n):
+        jax.block_until_ready(loop(gp, sp, n))
+
+    run(5)
+    return best_gps(run)
+
+
+def main():
+    assert jax.default_backend() == "tpu"
+    dt = jnp.bfloat16 if "bf16" in sys.argv[1:2] else jnp.float32
+    K = int(sys.argv[2]) if len(sys.argv) > 2 else 512
+    D = int(sys.argv[3]) if len(sys.argv) > 3 else 4
+    name = "bf16" if dt == jnp.bfloat16 else "f32"
+    variants = [
+        ("full", (), True),
+        ("no_eval", (), False),
+        ("no_mut", ("no_mut",), True),
+        ("no_cross", ("no_cross",), True),
+        ("sel_const", ("sel_const",), True),
+        ("no_matmul", ("no_matmul",), True),
+        ("floor", ("sel_const", "no_matmul", "no_cross", "no_mut"), False),
+    ]
+    base = None
+    for label, abl, fused in variants:
+        gps = measure(dt, K, D, abl, fused)
+        ms = 1000.0 / gps
+        if label == "full":
+            base = ms
+            print(f"{name} K={K} D={D} {label:10s} {gps:7.2f} gps  {ms:6.3f} ms/gen",
+                  flush=True)
+        else:
+            print(f"{name} K={K} D={D} {label:10s} {gps:7.2f} gps  {ms:6.3f} ms/gen"
+                  f"  (stage ≈ {base - ms:+6.3f} ms)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,150 @@
+"""Measured equivalence study: deme-kernel selection vs exact panmictic.
+
+Round-2 verdict item 5: the fused Pallas kernel runs tournaments inside
+VMEM demes (a random cohort reshuffled every generation by the riffle
+layout) instead of the reference's exact panmictic sampling over the
+whole population (``/root/reference/src/pga.cu:280-292``). This script
+quantifies how much that matters, on real hardware, with three measures:
+
+1. **One-step selection intensity** I = (E[winner score] − mean)/std on
+   a Gaussian score population. Theory for tournament-2 is
+   E[max(Z1,Z2)] = 1/√π ≈ 0.5642 and for k=4 ≈ 1.0294 — *independent of
+   whether candidates are drawn from P rows or a uniform-random cohort
+   of K*, because a uniform deme is an unbiased sample of the score
+   distribution. Any deme-induced bias would show here.
+2. **Takeover dynamics**: generations for the population score std to
+   collapse below 1% of its initial value under selection+crossover only
+   (mutation off). Deme-local selection could only slow takeover via
+   opponent locality; the per-generation riffle reshuffle is designed to
+   erase it.
+3. **End-to-end convergence**: generations to reach 99% of the OneMax
+   optimum with the standard operator stack on both paths.
+
+Run on TPU: ``python tools/selection_equivalence.py``. Prints a markdown
+table for BASELINE.md.
+
+Method note: scores are N(0.5, 0.05²) encoded as constant-gene rows with
+a mean-gene objective, so a child's score is a convex mix of its two
+parents' scores and E[child score] = E[winner score] for both paths —
+the same trick the structural tests use, here measuring distributions.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+P, L = 1 << 17, 128
+SEEDS = 5
+
+
+def xla_breed(tournament_size=2):
+    from libpga_tpu.ops.mutate import make_point_mutate
+    from libpga_tpu.ops.crossover import uniform_crossover
+    from libpga_tpu.ops.step import make_breed
+
+    return jax.jit(make_breed(
+        uniform_crossover, make_point_mutate(0.0),
+        tournament_size=tournament_size,
+    ))
+
+
+def pallas_breed(K, tournament_size=2):
+    from libpga_tpu.ops.pallas_step import make_pallas_breed
+
+    b = make_pallas_breed(
+        P, L, deme_size=K, mutation_rate=0.0,
+        tournament_size=tournament_size,
+    )
+    assert b is not None and b.K == K
+    return b
+
+
+def const_pop(key):
+    c = jnp.clip(0.5 + 0.05 * jax.random.normal(key, (P,)), 0.0, 1.0 - 1e-6)
+    return jnp.broadcast_to(c[:, None], (P, L)).astype(jnp.float32)
+
+
+def scores_of(g):
+    return jnp.mean(g, axis=1)
+
+
+def intensity(breed, seed):
+    g = const_pop(jax.random.key(seed))
+    s = scores_of(g)
+    g2 = breed(g, s, jax.random.key(seed + 1000))
+    s2 = scores_of(g2)
+    m, sd = float(jnp.mean(s)), float(jnp.std(s))
+    return (float(jnp.mean(s2)) - m) / sd
+
+
+def takeover(breed, seed, cap=200):
+    """Generations until the score std collapses below 5% of its initial
+    value under selection+uniform crossover only (mutation off) — the
+    population-convergence analog of takeover time. Uniform crossover of
+    constant-gene rows blends parent scores, so the collapse is gradual;
+    5% marks near-fixation."""
+    g = const_pop(jax.random.key(seed))
+    s = scores_of(g)
+    sd0 = float(jnp.std(s))
+    for gen in range(1, cap + 1):
+        g = breed(g, s, jax.random.fold_in(jax.random.key(seed + 2000), gen))
+        s = scores_of(g)
+        if float(jnp.std(s)) < 0.05 * sd0:
+            return gen
+    return cap
+
+
+def onemax_gens(use_pallas, seed, target_frac=0.99, cap=400):
+    from libpga_tpu import PGA, PGAConfig
+
+    pga = PGA(seed=seed, config=PGAConfig(use_pallas=use_pallas))
+    h = pga.create_population(P, 100)
+    pga.set_objective("onemax")
+    return pga.run(cap, target=target_frac * 100.0)
+
+
+def main():
+    assert jax.default_backend() == "tpu", "study needs real kernel entropy"
+    rows = []
+    for k, theory in ((2, 1 / np.sqrt(np.pi)), (4, 1.0294)):
+        xb = xla_breed(k)
+        i_x = np.mean([intensity(xb, s) for s in range(SEEDS)])
+        row = [f"k={k}", f"{theory:.4f}", f"{i_x:.4f}"]
+        for K in (128, 256, 512, 1024):
+            pb = pallas_breed(K, k)
+            i_p = np.mean([intensity(pb, s) for s in range(SEEDS)])
+            row.append(f"{i_p:.4f}")
+        rows.append(row)
+        print("intensity", row, flush=True)
+
+    xb = xla_breed(2)
+    t_x = np.mean([takeover(xb, s) for s in range(SEEDS)])
+    trow = ["takeover (gens)", "-", f"{t_x:.1f}"]
+    for K in (128, 256, 512, 1024):
+        pb = pallas_breed(K, 2)
+        t_p = np.mean([takeover(pb, s) for s in range(SEEDS)])
+        trow.append(f"{t_p:.1f}")
+    rows.append(trow)
+    print("takeover", trow, flush=True)
+
+    g_x = np.mean([onemax_gens(False, s) for s in range(3)])
+    g_p = np.mean([onemax_gens(True, s) for s in range(3)])
+    print(f"onemax 99% gens: xla={g_x:.1f} pallas={g_p:.1f}", flush=True)
+
+    print("\n| measure | theory | panmictic (XLA) | K=128 | K=256 | K=512 | K=1024 |")
+    print("|---|---|---|---|---|---|---|")
+    for r in rows:
+        print("| " + " | ".join(r) + " |")
+    print(f"\nOneMax 131k×100 generations to 99% optimum: "
+          f"panmictic XLA {g_x:.1f}, deme kernel {g_p:.1f} "
+          f"(n=3 seeds each).")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+"""One-off (K, D) sweep of the fused breed kernel at 1M×100 OneMax.
+
+Usage: python tools/sweep_kernel.py [--quick]
+Prints gens/sec for each (dtype, K, D) combination using bench.py's
+two-length subtraction estimator. Used to re-pick auto_deme_size and the
+demes-per-step default after kernel changes; results land in BASELINE.md.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from libpga_tpu.objectives import onemax
+from libpga_tpu.ops.pallas_step import make_pallas_breed
+
+POP = 1 << 20
+L = 100
+
+
+def make_loop(breed):
+    """One jitted program running n fused breed steps (matching the
+    engine's while_loop structure — per-call dispatch over the tunnel
+    would otherwise dominate the timing)."""
+
+    def body(_, carry):
+        g, s, key = carry
+        key, sub = jax.random.split(key)
+        g, s = breed.padded(g, s, sub)
+        return g, s, key
+
+    def loop(gp, sp, n):
+        g, s, _ = jax.lax.fori_loop(0, n, body, (gp, sp, jax.random.key(0)))
+        return g, s
+
+    return jax.jit(loop)
+
+
+def best_gps(fn, lo=30, hi=90, tries=3):
+    t_lo, t_hi = [], []
+    for _ in range(tries):
+        t0 = time.perf_counter(); fn(lo); t_lo.append(time.perf_counter() - t0)
+        t0 = time.perf_counter(); fn(hi); t_hi.append(time.perf_counter() - t0)
+    delta = min(t_hi) - min(t_lo)
+    return (hi - lo) / delta if delta > 0 else float("nan")
+
+
+def main():
+    assert jax.default_backend() == "tpu", "sweep needs the real chip"
+    quick = "--quick" in sys.argv
+    combos = []
+    for dt in (jnp.float32, jnp.bfloat16):
+        for K in (128, 256, 512, 1024):
+            for D in (1, 2, 4, 8):
+                combos.append((dt, K, D))
+    for dt, K, D in combos:
+        breed = make_pallas_breed(
+            POP, L, deme_size=K, fused_obj=onemax.kernel_rowwise,
+            gene_dtype=dt, _demes_per_step=D,
+        )
+        if breed is None or breed.K != K or breed.D != D:
+            continue  # combination rounded away; skip duplicates
+        gp = jax.random.uniform(jax.random.key(1), (breed.Pp, breed.Lp)).astype(dt)
+        sp = jnp.sum(gp[:, :L].astype(jnp.float32), axis=1)
+        loop = make_loop(breed)
+
+        def run(n, gp=gp, sp=sp, loop=loop):
+            jax.block_until_ready(loop(gp, sp, n))
+
+        try:
+            run(5)  # compile + warm
+        except Exception as e:
+            name = "bf16" if dt == jnp.bfloat16 else "f32"
+            print(f"{name} K={K:4d} D={D}  FAILED: {str(e)[:90]}", flush=True)
+            continue
+        gps = best_gps(run, lo=20 if quick else 30, hi=60 if quick else 90,
+                       tries=2 if quick else 3)
+        name = "bf16" if dt == jnp.bfloat16 else "f32"
+        print(f"{name} K={K:4d} D={D}  {gps:8.2f} gens/sec", flush=True)
+
+
+if __name__ == "__main__":
+    main()
