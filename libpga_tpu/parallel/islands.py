@@ -106,6 +106,85 @@ def make_island_epoch(
     return epoch
 
 
+def make_stacked_pallas_epoch(breed: Callable, m: int) -> Callable:
+    """m generations over ALL islands at once for a fused Pallas breed:
+    ``(genomes (I,S,L), scores (I,S), keys (I,)[, mparams]) ->
+    (genomes, scores, keys)``.
+
+    Per generation, the deme ranks for every island come from ONE
+    flattened (I·G, K) two-key sort (``breed.compute_ranks``) and only
+    the kernel call is vmapped. Hoisting matters: a per-island vmapped
+    sort measured 3.4 ms per 8×131,072 generation vs 0.9 ms flattened —
+    it was the island path's largest overhead (see BASELINE.md round 3).
+    Serves fused breeds only (they score children in-kernel and apply
+    their own elitism epilogue); everything else goes through
+    :func:`make_island_epoch` under ``jax.vmap``."""
+    Lp, Pp = breed.Lp, breed.Pp
+    gdtype = breed.gene_dtype
+    takes_params = breed.takes_params
+
+    def epoch(genomes, scores, keys, mparams=None):
+        I, S, L = genomes.shape
+        pad = Lp != L or Pp != S
+        g0 = genomes.astype(gdtype)
+        s0 = scores
+        if pad:
+            g0 = jnp.pad(g0, ((0, 0), (0, Pp - S), (0, Lp - L)))
+            s0 = jnp.pad(
+                scores, ((0, 0), (0, Pp - S)), constant_values=-jnp.inf
+            )
+
+        def body(carry, _):
+            g, s, ks = carry
+            split2 = jax.vmap(jax.random.split)(ks)
+            ks2, subs = split2[:, 0], split2[:, 1]
+            # One tie-break stream for the whole flattened sort,
+            # disjoint from every island's kernel-seed stream (fold_in
+            # is a PRF; padded_ranks only consumes split(key)[0]).
+            tie_key = jax.random.fold_in(subs[0], 0x72616E6B)
+            ranks = breed.compute_ranks(s, tie_key)
+            if takes_params and mparams is not None:
+                g2, s2 = jax.vmap(
+                    lambda gi, si, ri, ki: breed.padded_ranks(
+                        gi, si, ri, ki, mparams
+                    )
+                )(g, s, ranks, subs)
+            else:
+                g2, s2 = jax.vmap(breed.padded_ranks)(g, s, ranks, subs)
+            return (g2, s2, ks2), None
+
+        (g, s, ks), _ = jax.lax.scan(body, (g0, s0, keys), None, length=m)
+        if pad:
+            g = g[:, :S, :L]
+            s = s[:, :S]
+        return g, s, ks
+
+    return epoch
+
+
+def _use_stacked_epoch(breed, elitism: int) -> bool:
+    """Fused Pallas breeds with the rank hooks take the stacked epoch
+    (their elitism runs in-breed, so the epoch-level carry must be 0)."""
+    return (
+        getattr(breed, "fused", False)
+        and hasattr(breed, "padded_ranks")
+        and elitism == 0
+    )
+
+
+def _make_vepoch(breed, obj, m: int, elitism: int):
+    """The epoch actually run over stacked islands — shared by the local
+    and sharded runners so the stacked/vmapped selection can never
+    diverge between them. Signature either way:
+    ``(g (I,S,L), s (I,S), keys (I,)[, mparams]) -> (g, s, keys)``."""
+    if _use_stacked_epoch(breed, elitism):
+        return make_stacked_pallas_epoch(breed, m)
+    epoch = make_island_epoch(breed, obj, m, elitism=elitism)
+    if getattr(breed, "takes_params", False):
+        return jax.vmap(epoch, in_axes=(0, 0, 0, None))
+    return jax.vmap(epoch)
+
+
 def _select_emigrants(genomes, scores, count):
     """Per-island top-``count``: genomes (I,S,L), scores (I,S) →
     emigrants (I,count,L), escores (I,count)."""
@@ -201,11 +280,7 @@ def build_local_runner(
     :func:`make_island_epoch`).
     """
     takes_params = getattr(breed, "takes_params", False)
-    epoch = make_island_epoch(breed, obj, m, elitism=elitism)
-    vepoch = (
-        jax.vmap(epoch, in_axes=(0, 0, 0, None)) if takes_params
-        else jax.vmap(epoch)
-    )
+    vepoch = _make_vepoch(breed, obj, m, elitism)
 
     def loop(genomes, island_keys, mig_key, num_epochs, target, mparams=None):
         scores = jax.vmap(lambda gi: _evaluate(obj, gi))(genomes)
@@ -291,11 +366,9 @@ def build_sharded_runner(
     (including the trailing ``mparams`` for a ``takes_params`` breed —
     replicated across the mesh)."""
     takes_params = getattr(breed, "takes_params", False)
-    epoch = make_island_epoch(breed, obj, m, elitism=elitism)
-    vepoch = (
-        jax.vmap(epoch, in_axes=(0, 0, 0, None)) if takes_params
-        else jax.vmap(epoch)
-    )
+    # Same flattened-rank-sort hoist as the local runner, applied to
+    # each shard's local islands.
+    vepoch = _make_vepoch(breed, obj, m, elitism)
 
     def shard_body(genomes, island_keys, mig_key, num_epochs, target,
                    mparams=None):

@@ -283,6 +283,51 @@ def test_kernel_padded_fused_scores_inert_tail():
     np.testing.assert_allclose(sp2[:P], s2, atol=1e-6, rtol=0)
 
 
+def test_padded_ranks_matches_breed_padded():
+    """The documented contract behind the island stacked epoch:
+    ``padded_ranks(gp, s, compute_ranks(s, k_tie), key)`` with
+    ``(_, k_tie) = split(key)`` must return exactly what
+    ``breed_padded(gp, s, key)`` returns — the hoisted-sort path cannot
+    drift from the all-in-one one."""
+    from libpga_tpu.objectives import onemax
+
+    P, L, K = 512, 20, 128
+    with _interpret():
+        breed = make_pallas_breed(
+            P, L, deme_size=K, mutation_rate=0.0, elitism=2,
+            fused_obj=onemax.kernel_rowwise,
+        )
+        gp = jax.random.uniform(jax.random.key(0), (breed.Pp, breed.Lp))
+        sp = jnp.sum(gp, axis=1)
+        key = jax.random.key(7)
+        g_a, s_a = breed.padded(gp, sp, key)
+        _, k_tie = jax.random.split(key)
+        ranks = breed.compute_ranks(sp, k_tie)
+        g_b, s_b = breed.padded_ranks(gp, sp, ranks, key)
+    np.testing.assert_array_equal(np.asarray(g_a), np.asarray(g_b))
+    np.testing.assert_array_equal(np.asarray(s_a), np.asarray(s_b))
+
+
+def test_compute_ranks_stacked_matches_per_island():
+    """compute_ranks on stacked (I, Pp) scores — ONE flattened (I·G, K)
+    sort, the island runner's hoist — must pair each island with its own
+    demes: for tie-free scores the ranks are tie-stream independent, so
+    the stacked result must equal per-island calls exactly."""
+    P, L, K = 384, 8, 128
+    with _interpret():
+        breed = make_pallas_breed(P, L, deme_size=K, mutation_rate=0.0)
+        I = 4
+        scores = jax.random.normal(jax.random.key(1), (I, breed.Pp))
+        k = jax.random.key(2)
+        stacked = breed.compute_ranks(scores, k)
+        per_island = jnp.stack(
+            [breed.compute_ranks(scores[i], jax.random.fold_in(k, i))
+             for i in range(I)]
+        )
+    assert stacked.shape == per_island.shape
+    np.testing.assert_array_equal(np.asarray(stacked), np.asarray(per_island))
+
+
 def test_padded_tail_nan_scores_never_select_pads():
     """Round-3 review finding: with the rank sort done outside the
     kernel, a NaN score in the tail deme sorted AFTER the pads' -inf
