@@ -113,7 +113,6 @@ def _pick_deme_size(
     pop_size: int,
     preferred: int,
     genome_lanes: int = LANE,
-    max_k: int = 1024,
     gene_bytes: int = 4,
 ):
     """Deme size for a population: exact divisors first (zero padding),
@@ -137,7 +136,7 @@ def _pick_deme_size(
     the least-waste fit wins. None (→ XLA path) for populations under
     one 128-row tile or with only degenerate-tail fits."""
     def fits(k: int) -> bool:
-        return k <= max_k and _blocks_fit(k, 1, genome_lanes, gene_bytes)
+        return _blocks_fit(k, 1, genome_lanes, gene_bytes)
 
     if _valid_deme(preferred) and fits(preferred) and pop_size % preferred == 0:
         return preferred
