@@ -249,7 +249,7 @@ def _breed_kernel(
     # NOTE on shapes: Mosaic only supports minor-dim insertion/transpose
     # for 32-bit types, so every bool/bf16 value here is built directly in
     # its final 2-D/3-D orientation; only f32/i32 get transposed.
-    s_all = scores_ref[:]   # (1, D, K) f32
+    s_all = scores_ref[:]   # (1, D, K) f32 — per-deme ranks (see below)
     g_all = genomes_ref[:]  # (D*K, Lp)
 
     # uint32 -> f32 isn't a supported Mosaic cast; >>8 leaves 24 bits, so
@@ -297,7 +297,7 @@ def _breed_kernel(
             # (``breed_padded``), which costs ~0.8 ms/gen at 1M×100 and
             # replaces what used to be a K×K compare+reduce cube per
             # deme in here (~1–2 ms/gen, growing linearly with K).
-            R = s_all[:, d, :]  # (1, K) f32 ranks
+            R = s_all[0, d : d + 1, :]  # (1, K) f32 ranks
 
             # The k-way tournament winner is the candidate with the
             # minimum rank; for k i.i.d. uniform candidate draws over V
@@ -499,7 +499,7 @@ def _breed_kernel(
                 child if obj_pad_ok else child[:, :L],
                 *[r[:] for r in const_refs],
             ).astype(jnp.float32)
-            rest[n_consts + 1][d : d + 1, :, :] = child_scores.reshape(
+            rest[n_consts + 1][0:1, d : d + 1, :] = child_scores.reshape(
                 1, 1, K
             )
 
@@ -649,8 +649,14 @@ def make_pallas_breed(
         out_specs = [pl.BlockSpec((K, 1, D, Lp), lambda i: (0, i, 0, 0))]
         out_shape = [jax.ShapeDtypeStruct((K, G // D, D, Lp), gene_dtype)]
     if fused_obj is not None:
-        out_specs.append(pl.BlockSpec((D, 1, K), lambda i: (i, 0, 0)))
-        out_shape.append(jax.ShapeDtypeStruct((G, 1, K), jnp.float32))
+        # (G//D, D, K) score array tiled on its LAST TWO dims (D, K): the
+        # former (G, 1, K) layout's middle singleton was sublane-padded
+        # 1→8 by Mosaic tiling, making every score write move 8× the
+        # bytes. (A flat (G, K) array with (D, K) blocks would be ideal
+        # but Pallas requires block dims divisible by (8, 128) unless
+        # they equal the array dims — D=4 would be rejected.)
+        out_specs.append(pl.BlockSpec((1, D, K), lambda i: (i, 0, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((G // D, D, K), jnp.float32))
 
     def _const_spec(c):
         return pl.BlockSpec(c.shape, lambda i: (0,) * c.ndim)
