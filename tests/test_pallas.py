@@ -328,6 +328,27 @@ def test_compute_ranks_stacked_matches_per_island():
     np.testing.assert_array_equal(np.asarray(stacked), np.asarray(per_island))
 
 
+def test_gaussian_keeps_pad_lanes_zero():
+    """Gaussian mutation fires per-gene over the whole (K, Lp) tile, so
+    without the lane guard it would write noise into pad lanes (L..Lp)
+    and break the pads-stay-zero invariant that ``pad_ok`` fused
+    objectives (and the final [:, :L] slice's cheapness) rely on. Zero
+    PRNG bits fire the gate everywhere at rate=1 — pad lanes must still
+    come back exactly zero."""
+    P, L, K = 256, 100, 128  # Lp=128 > L
+    with _interpret():
+        breed = make_pallas_breed(
+            P, L, deme_size=K, mutate_kind="gaussian",
+            mutation_rate=1.0, mutation_sigma=0.1,
+        )
+        gp = jnp.pad(jnp.full((P, L), 0.5, jnp.float32), ((0, 0), (0, 28)))
+        out = np.asarray(breed.padded(gp, jnp.zeros((P,)), jax.random.key(0)))
+    assert out.shape == (P, 128)
+    assert np.all(out[:, L:] == 0.0), "pad lanes must stay zero"
+    # and the real lanes did mutate (gate fired at rate=1)
+    assert np.all(out[:, :L] != 0.5)
+
+
 def test_padded_tail_nan_scores_never_select_pads():
     """Round-3 review finding: with the rank sort done outside the
     kernel, a NaN score in the tail deme sorted AFTER the pads' -inf
