@@ -23,6 +23,17 @@ class PGAConfig:
     Attributes:
       tournament_size: number of candidates per tournament (reference
         hardcodes 2, ``pga.cu:278``).
+      selection: parent-selection strategy — "tournament" (the only one
+        the reference implements; its ``crossover_selection_type`` enum
+        is a self-described placeholder, ``pga.h:37-42``), "truncation"
+        (uniform over the top ``selection_param`` fraction, default τ
+        0.5), or "linear_rank" (linear ranking with pressure
+        ``selection_param`` in (1, 2], default 2.0 — same intensity as
+        tournament-2 at s=2). Every strategy runs in-kernel at identical
+        cost: the fused kernel samples winners in rank space, so a
+        strategy is just an inverse CDF (``ops/pallas_step.py``).
+      selection_param: strategy parameter (τ or s above); None uses the
+        strategy's default.
       mutation_rate: probability an individual receives a point mutation
         (reference default-callback rate 0.01, ``pga.cu:128``).
       elitism: number of top individuals copied unchanged into the next
@@ -61,6 +72,8 @@ class PGAConfig:
     """
 
     tournament_size: int = 2
+    selection: str = "tournament"
+    selection_param: Optional[float] = None
     mutation_rate: float = 0.01
     elitism: int = 0
     gene_dtype: jnp.dtype = jnp.float32

@@ -175,6 +175,7 @@ class PGA:
         cache_key = (
             "breed", self._crossover, self._mutate,
             self.config.tournament_size, self.config.elitism,
+            self.config.selection, self.config.selection_param,
         )
         fn = self._compiled.get(cache_key)
         if fn is None:
@@ -182,6 +183,8 @@ class PGA:
                 self._crossover,
                 self._mutate,
                 tournament_size=self.config.tournament_size,
+                selection_kind=self.config.selection,
+                selection_param=self.config.selection_param,
                 elitism=self.config.elitism,
             )
             self._compiled[cache_key] = fn
@@ -211,7 +214,8 @@ class PGA:
             pkey = (
                 "runP", size, genome_len, obj, pallas_kind,
                 self._crossover_kind(), self.config.elitism,
-                self.config.tournament_size,
+                self.config.tournament_size, self.config.selection,
+                self.config.selection_param,
             )
             cached = self._compiled.get(pkey)
             if cached is None:
@@ -220,6 +224,8 @@ class PGA:
                 factory = make_pallas_run(
                     obj,
                     tournament_size=self.config.tournament_size,
+                    selection_kind=self.config.selection,
+                    selection_param=self.config.selection_param,
                     # Defaults for callers that pass no runtime params;
                     # the engine always passes self._mutate_params().
                     mutation_rate=self._mutation_rate(),
@@ -242,6 +248,7 @@ class PGA:
         cache_key = (
             "run", size, genome_len, obj, self._crossover, self._mutate,
             self.config.tournament_size, self.config.elitism,
+            self.config.selection, self.config.selection_param,
         )
         fn = self._compiled.get(cache_key)
         if fn is not None:
@@ -379,6 +386,7 @@ class PGA:
             "island_breed", island_size, genome_len, obj, fused,
             self._crossover_kind(), self._mutate_kind(),
             self.config.elitism, self.config.tournament_size,
+            self.config.selection, self.config.selection_param,
         )
         if cache_key in self._compiled:
             return self._compiled[cache_key]
@@ -387,6 +395,8 @@ class PGA:
             genome_len,
             deme_size=self.config.pallas_deme_size,
             tournament_size=self.config.tournament_size,
+            selection_kind=self.config.selection,
+            selection_param=self.config.selection_param,
             mutation_rate=self._mutation_rate(),
             mutation_sigma=self._operator_param("sigma", 0.0),
             crossover_kind=self._crossover_kind(),
@@ -472,7 +482,11 @@ class PGA:
             self.crossover(h, selection)
 
     def _compiled_op(self, which: str):
-        cache_key = (which, self._crossover, self._mutate, self.config.tournament_size)
+        cache_key = (
+            which, self._crossover, self._mutate,
+            self.config.tournament_size, self.config.selection,
+            self.config.selection_param,
+        )
         fn = self._compiled.get(cache_key)
         if fn is not None:
             return fn
@@ -482,10 +496,15 @@ class PGA:
             batched = getattr(cross, "batched", None)
             cols = getattr(cross, "rand_cols", None)
 
+            sel_kind = self.config.selection
+            sel_param = self.config.selection_param
+
             def op(genomes, scores, key):
                 P, L = genomes.shape
                 k_sel, k_c = jax.random.split(key)
-                i1, i2 = select_parent_pairs(k_sel, scores, P, k=k)
+                i1, i2 = select_parent_pairs(
+                    k_sel, scores, P, k=k, kind=sel_kind, param=sel_param
+                )
                 p1 = jnp.take(genomes, i1, axis=0)
                 p2 = jnp.take(genomes, i2, axis=0)
                 rand = jax.random.uniform(k_c, (P, cols or L), dtype=jnp.float32)

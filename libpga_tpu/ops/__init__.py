@@ -13,7 +13,11 @@ Reference kernel → op mapping (all in reference ``src/pga.cu``):
 """
 
 from libpga_tpu.ops.evaluate import evaluate
-from libpga_tpu.ops.select import tournament_select
+from libpga_tpu.ops.select import (
+    linear_rank_select,
+    tournament_select,
+    truncation_select,
+)
 from libpga_tpu.ops.crossover import (
     uniform_crossover,
     one_point_crossover,
@@ -27,6 +31,8 @@ from libpga_tpu.ops.step import make_step
 __all__ = [
     "evaluate",
     "tournament_select",
+    "truncation_select",
+    "linear_rank_select",
     "uniform_crossover",
     "one_point_crossover",
     "arithmetic_crossover",

@@ -205,6 +205,8 @@ def _breed_kernel(
     L,
     Lp,
     tk=2,
+    sel="tournament",
+    sel_param=None,
     crossover="uniform",
     mutate="point",
     obj=None,
@@ -323,7 +325,21 @@ def _breed_kernel(
                 ).astype(jnp.float32)
 
             u_t = uniform((2, K)).T  # (K, 2): one winner draw per parent
-            if tk == 1:
+            if sel == "truncation":
+                # Uniform over the deme's top ceil(tau·V) ranks — same
+                # one-line inverse-CDF shape as the tournament; the
+                # cohort argument for panmictic equivalence applies
+                # identically (see module docstring).
+                x = u_t * jnp.float32(sel_param)
+            elif sel == "linear_rank":
+                # Linear ranking, pressure s in (1, 2]: rank-fraction
+                # density f(x) = s - 2(s-1)x, inverse CDF below. s=2
+                # matches tournament-2 selection intensity exactly.
+                s_p = jnp.float32(sel_param)
+                x = (
+                    s_p - jnp.sqrt(s_p * s_p - 4.0 * (s_p - 1.0) * u_t)
+                ) / (2.0 * (s_p - 1.0))
+            elif tk == 1:
                 x = u_t
             elif tk & (tk - 1) == 0:
                 t = 1.0 - u_t
@@ -332,8 +348,11 @@ def _breed_kernel(
                 x = 1.0 - t
             else:
                 x = 1.0 - jnp.exp(jnp.log(1.0 - u_t) * jnp.float32(1.0 / tk))
-            # floor can graze V at f32 precision (x·V rounds up); clamp.
-            wr = jnp.minimum(jnp.floor(x * Vf), Vf - 1.0)  # (K, 2) ranks
+            # Two-sided clamp: floor can graze V at f32 precision (x·V
+            # rounding up), and linear_rank's x can go fractionally
+            # NEGATIVE at u≈0 if the VPU's sqrt(s²-4(s-1)u) rounds a ulp
+            # above s — wr=-1 would match no rank and breed a zero row.
+            wr = jnp.clip(jnp.floor(x * Vf), 0.0, Vf - 1.0)  # (K, 2) ranks
 
             # Winner one-hots by rank equality: ranks are distinct
             # integers 0..K-1 (exact in f32), so each row of the compare
@@ -342,11 +361,14 @@ def _breed_kernel(
             oh2 = (R == wr[:, 1:2]).astype(jnp.bfloat16)
 
         # ---- parent rows via one-hot matmul ---------------------------
+        # (named gather_rows, NOT "sel": rebinding the ``sel`` strategy
+        # param here would silently turn every deme after the first back
+        # into a tournament — caught by the hardware truncation check.)
         if bf16_genes:
             # bf16 genomes are selected exactly by a single bf16 matmul
             # (0/1 selector rows; f32 accumulation) — half the FLOPs and
             # HBM traffic of the f32 hi/lo path.
-            def sel(oh_w):
+            def gather_rows(oh_w):
                 return jnp.dot(oh_w, g, preferred_element_type=jnp.float32)
 
         else:
@@ -354,7 +376,7 @@ def _breed_kernel(
             g_hi = g.astype(jnp.bfloat16)
             g_lo = (g - g_hi.astype(jnp.float32)).astype(jnp.bfloat16)
 
-            def sel(oh_w):
+            def gather_rows(oh_w):
                 hi = jnp.dot(oh_w, g_hi, preferred_element_type=jnp.float32)
                 lo = jnp.dot(oh_w, g_lo, preferred_element_type=jnp.float32)
                 return hi + lo
@@ -362,8 +384,8 @@ def _breed_kernel(
         if "no_matmul" in ablate:
             p1 = p2 = g.astype(jnp.float32)
         else:
-            p1 = sel(oh1)  # (K, Lp) f32
-            p2 = sel(oh2)
+            p1 = gather_rows(oh1)  # (K, Lp) f32
+            p2 = gather_rows(oh2)
 
         if "no_cross" in ablate:
             child = p1
@@ -510,6 +532,8 @@ def make_pallas_breed(
     *,
     deme_size: Optional[int] = None,
     tournament_size: int = 2,
+    selection_kind: str = "tournament",
+    selection_param: Optional[float] = None,
     mutation_rate: float = 0.01,
     mutation_sigma: float = 0.0,
     crossover_kind: str = "uniform",
@@ -566,6 +590,14 @@ def make_pallas_breed(
         # sampling makes the in-kernel cost k-independent, so the cap is
         # a contract bound, not a resource one.
         return None
+    # Selection strategies beyond the reference's single-member enum
+    # (``pga.h:37-42``): each is one inverse-CDF line in rank space.
+    # Defaults/ranges live in ONE place (ops/select.resolve_selection,
+    # shared with the XLA path) so the two paths cannot drift; invalid
+    # kinds/params raise rather than silently falling back.
+    from libpga_tpu.ops.select import resolve_selection
+
+    selection_param = resolve_selection(selection_kind, selection_param)
     if elitism > 0 and fused_obj is None:
         # The epilogue needs next-generation scores; without fused
         # evaluation the caller (engine run loop) applies elitism itself.
@@ -630,6 +662,8 @@ def make_pallas_breed(
         L=L,
         Lp=Lp,
         tk=tournament_size,
+        sel=selection_kind,
+        sel_param=selection_param,
         crossover=crossover_kind,
         mutate=mutate_kind,
         obj=fused_obj,
@@ -800,6 +834,8 @@ def make_pallas_run(
     obj: Callable,
     *,
     tournament_size: int = 2,
+    selection_kind: str = "tournament",
+    selection_param: Optional[float] = None,
     mutation_rate: float = 0.01,
     mutation_sigma: float = 0.0,
     crossover_kind: str = "uniform",
@@ -844,6 +880,8 @@ def make_pallas_run(
         breed = make_pallas_breed(
             pop_size, genome_len,
             deme_size=deme_size, tournament_size=tournament_size,
+            selection_kind=selection_kind,
+            selection_param=selection_param,
             mutation_rate=mutation_rate,
             mutation_sigma=mutation_sigma,
             crossover_kind=crossover_kind, mutate_kind=mutate_kind,

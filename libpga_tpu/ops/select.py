@@ -52,12 +52,117 @@ def tournament_select(
     return jnp.take_along_axis(idx, win[:, None], axis=-1)[:, 0]
 
 
+SELECTION_KINDS = ("tournament", "truncation", "linear_rank")
+
+
+def resolve_selection(kind: str, param: float | None) -> float | None:
+    """Default and validate a selection strategy's parameter — the ONE
+    place defaults/ranges live, shared by the XLA operators here and the
+    fused Pallas kernel (``ops/pallas_step.py``), so the two paths can
+    never drift. Returns the resolved param (None for tournament);
+    raises ValueError for an unknown kind or out-of-range param."""
+    if kind == "tournament":
+        return None
+    if kind == "truncation":
+        param = 0.5 if param is None else param
+        if not 0.0 < param <= 1.0:
+            raise ValueError(f"truncation tau must be in (0, 1], got {param}")
+        return param
+    if kind == "linear_rank":
+        param = 2.0 if param is None else param
+        if not 1.0 < param <= 2.0:
+            raise ValueError(
+                f"linear ranking pressure must be in (1, 2], got {param}"
+            )
+        return param
+    raise ValueError(
+        f"unknown selection kind {kind!r}; one of {SELECTION_KINDS}"
+    )
+
+
+def _rank_order(scores: jax.Array, key: jax.Array) -> jax.Array:
+    """Row indices sorted best-first (rank r → row). Score ties break in
+    a fresh uniform random order per call — matching the fused kernel's
+    per-generation tie shuffle. A stable index tie-break would make
+    rank-cutoff strategies (truncation) permanently exclude the
+    high-index half of a tie block: on a flat fitness plateau only the
+    first ``tau·pop`` ROWS would ever breed."""
+    tb = jax.random.bits(key, scores.shape)
+    iota = jnp.arange(scores.shape[0], dtype=jnp.int32)
+    _, _, order = jax.lax.sort((-scores, tb, iota), num_keys=2)
+    return order
+
+
+def truncation_select(
+    key: jax.Array,
+    scores: jax.Array,
+    num: int,
+    tau: float,
+) -> jax.Array:
+    """``num`` parents drawn uniformly from the top ``tau`` fraction.
+
+    Classic (μ, λ)-style truncation: every individual ranked in the top
+    ``ceil(tau·pop)`` is equally likely, everyone else never selected.
+    Not in the reference (its selection enum is a single-member
+    placeholder, ``pga.h:37-42``) — this completes that declared
+    surface. Selection runs in rank space exactly like the fused
+    kernel's inverse-CDF sampler (``ops/pallas_step.py``).
+    """
+    pop = scores.shape[0]
+    tau = resolve_selection("truncation", tau)
+    k_tie, k_u = jax.random.split(key)
+    order = _rank_order(scores, k_tie)
+    u = jax.random.uniform(k_u, (num,))
+    r = jnp.minimum((u * (tau * pop)).astype(jnp.int32), pop - 1)
+    return order[r]
+
+
+def linear_rank_select(
+    key: jax.Array,
+    scores: jax.Array,
+    num: int,
+    pressure: float,
+) -> jax.Array:
+    """Linear ranking selection with pressure ``s`` in (1, 2].
+
+    The best rank is selected ``s`` times as often as the average and
+    the worst ``2-s`` times; the rank-fraction density is
+    ``f(x) = s - 2(s-1)x`` with inverse CDF
+    ``x = (s - sqrt(s² - 4(s-1)u)) / (2(s-1))``. At s=2 the selection
+    intensity equals tournament-2 (E[winner] = 2/3 quantile on uniform
+    scores); s→1 approaches uniform selection.
+    """
+    pop = scores.shape[0]
+    pressure = resolve_selection("linear_rank", pressure)
+    k_tie, k_u = jax.random.split(key)
+    order = _rank_order(scores, k_tie)
+    s = jnp.float32(pressure)
+    u = jax.random.uniform(k_u, (num,))
+    x = (s - jnp.sqrt(s * s - 4.0 * (s - 1.0) * u)) / (2.0 * (s - 1.0))
+    r = jnp.clip((x * pop).astype(jnp.int32), 0, pop - 1)
+    return order[r]
+
+
 def select_parent_pairs(
     key: jax.Array,
     scores: jax.Array,
     num_children: int,
     k: int = 2,
+    kind: str = "tournament",
+    param: float | None = None,
 ) -> tuple[jax.Array, jax.Array]:
-    """Two tournaments per child → (p1_idx, p2_idx), each ``(num_children,)``."""
-    winners = tournament_select(key, scores, num_children * 2, k=k)
+    """Two independent selections per child → (p1_idx, p2_idx), each
+    ``(num_children,)``. ``kind`` picks the strategy: "tournament"
+    (k-way, the reference's only implemented strategy), "truncation"
+    (param = top fraction τ, default 0.5), or "linear_rank" (param =
+    pressure s, default 2.0)."""
+    if kind == "tournament":
+        winners = tournament_select(key, scores, num_children * 2, k=k)
+    elif kind == "truncation":
+        winners = truncation_select(key, scores, num_children * 2, param)
+    elif kind == "linear_rank":
+        winners = linear_rank_select(key, scores, num_children * 2, param)
+    else:
+        resolve_selection(kind, param)  # raises with the canonical message
+        raise AssertionError("unreachable")
     return winners[:num_children], winners[num_children:]

@@ -27,7 +27,7 @@ replaces the current one (no implicit elitism unless configured).
 
 from __future__ import annotations
 
-from typing import Callable, Tuple
+from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -41,6 +41,8 @@ def make_breed(
     mutate_fn: Callable,
     *,
     tournament_size: int = 2,
+    selection_kind: str = "tournament",
+    selection_param: Optional[float] = None,
     elitism: int = 0,
 ) -> Callable[[jax.Array, jax.Array, jax.Array], jax.Array]:
     """Build the selection+variation half of a generation.
@@ -49,6 +51,9 @@ def make_breed(
       crossover_fn: per-child ``(p1, p2, rand) -> child``.
       mutate_fn: per-genome ``(genome, rand) -> genome``.
       tournament_size: k of the k-way tournament.
+      selection_kind: "tournament" (the reference's strategy),
+        "truncation", or "linear_rank" (see ``ops/select.py``).
+      selection_param: τ for truncation, pressure s for linear ranking.
       elitism: copy the top-e of the current generation unchanged into the
         next one (slots 0..e-1). 0 = pure generational replacement (the
         reference's behavior).
@@ -70,7 +75,10 @@ def make_breed(
     def breed(genomes: jax.Array, scores: jax.Array, key: jax.Array):
         P, L = genomes.shape
         k_sel, k_cross, k_mut = jax.random.split(key, 3)
-        p1_idx, p2_idx = select_parent_pairs(k_sel, scores, P, k=tournament_size)
+        p1_idx, p2_idx = select_parent_pairs(
+            k_sel, scores, P, k=tournament_size,
+            kind=selection_kind, param=selection_param,
+        )
         p1 = jnp.take(genomes, p1_idx, axis=0)
         p2 = jnp.take(genomes, p2_idx, axis=0)
 
@@ -103,6 +111,8 @@ def make_step(
     mutate_fn: Callable,
     *,
     tournament_size: int = 2,
+    selection_kind: str = "tournament",
+    selection_param: Optional[float] = None,
     elitism: int = 0,
 ) -> Callable[[jax.Array, jax.Array], Tuple[jax.Array, jax.Array]]:
     """One full generation: ``step(genomes, key[, scores]) -> (next, next_scores)``.
@@ -113,7 +123,9 @@ def make_step(
     one evaluation per generation); when omitted it is computed here.
     """
     breed = make_breed(
-        crossover_fn, mutate_fn, tournament_size=tournament_size, elitism=elitism
+        crossover_fn, mutate_fn, tournament_size=tournament_size,
+        selection_kind=selection_kind, selection_param=selection_param,
+        elitism=elitism,
     )
 
     def step(genomes: jax.Array, key: jax.Array, scores: jax.Array = None):

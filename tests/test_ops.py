@@ -53,6 +53,86 @@ class TestTournamentSelect:
         assert p1.shape == (7,) and p2.shape == (7,)
 
 
+class TestSelectionStrategies:
+    """Truncation and linear ranking — the strategies the reference's
+    placeholder ``crossover_selection_type`` enum (pga.h:37-42) declared
+    room for but never implemented."""
+
+    def test_truncation_only_top_fraction(self, key):
+        from libpga_tpu.ops.select import truncation_select
+
+        scores = jax.random.uniform(key, (1000,))
+        idx = truncation_select(jax.random.fold_in(key, 1), scores, 20_000,
+                                tau=0.25)
+        picked = np.asarray(scores[idx])
+        cutoff = np.quantile(np.asarray(scores), 0.75)
+        assert picked.min() >= cutoff - 1e-6  # never below the top quartile
+        # uniform within the top quartile: mean ≈ E[U | U > q75] = 0.875
+        assert abs(picked.mean() - 0.875) < 0.01
+
+    def test_truncation_param_validation(self, key):
+        import pytest
+
+        from libpga_tpu.ops.select import truncation_select
+
+        with pytest.raises(ValueError):
+            truncation_select(key, jnp.ones(10), 5, tau=0.0)
+        with pytest.raises(ValueError):
+            truncation_select(key, jnp.ones(10), 5, tau=1.5)
+
+    def test_linear_rank_pressure(self, key):
+        from libpga_tpu.ops.select import linear_rank_select
+
+        scores = jax.random.uniform(key, (1000,))
+        # s=2 has tournament-2 intensity: E[winner score] = 2/3 on
+        # uniform scores; s→1 approaches uniform selection (mean 1/2).
+        i2 = linear_rank_select(jax.random.fold_in(key, 1), scores, 20_000,
+                                pressure=2.0)
+        i1 = linear_rank_select(jax.random.fold_in(key, 2), scores, 20_000,
+                                pressure=1.01)
+        m2 = float(jnp.mean(scores[i2]))
+        m1 = float(jnp.mean(scores[i1]))
+        assert abs(m2 - 2 / 3) < 0.01
+        assert abs(m1 - 0.5) < 0.01
+
+    def test_linear_rank_param_validation(self, key):
+        import pytest
+
+        from libpga_tpu.ops.select import linear_rank_select
+
+        with pytest.raises(ValueError):
+            linear_rank_select(key, jnp.ones(10), 5, pressure=1.0)
+        with pytest.raises(ValueError):
+            linear_rank_select(key, jnp.ones(10), 5, pressure=2.5)
+
+    def test_select_parent_pairs_kinds(self, key):
+        scores = jax.random.uniform(key, (256,))
+        for kind in ("truncation", "linear_rank"):
+            p1, p2 = select_parent_pairs(key, scores, 64, kind=kind)
+            assert p1.shape == (64,) and p2.shape == (64,)
+        import pytest
+
+        with pytest.raises(ValueError):
+            select_parent_pairs(key, scores, 4, kind="roulette")
+
+    def test_engine_selection_config_end_to_end(self, key):
+        """The engine threads config.selection through the XLA run loop:
+        a truncation-selection OneMax run must still converge."""
+        from libpga_tpu import PGA, PGAConfig
+
+        for kind, param in (("truncation", 0.3), ("linear_rank", 1.8)):
+            pga = PGA(seed=0, config=PGAConfig(
+                selection=kind, selection_param=param, use_pallas=False,
+            ))
+            h = pga.create_population(512, 32)
+            pga.set_objective("onemax")
+            pga.evaluate(h)
+            before = float(jnp.mean(pga.population(h).scores))
+            pga.run(15)
+            after = float(jnp.mean(pga.population(h).scores))
+            assert after > before + 1.0, (kind, before, after)
+
+
 class TestCrossover:
     def test_uniform_matches_reference_semantics(self):
         # rand[i] > 0.5 → take p1, else p2 (reference pga.cu:135-143).

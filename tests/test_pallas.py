@@ -328,6 +328,48 @@ def test_compute_ranks_stacked_matches_per_island():
     np.testing.assert_array_equal(np.asarray(stacked), np.asarray(per_island))
 
 
+def test_selection_strategies_in_kernel():
+    """Truncation and linear-rank selection run in-kernel as alternate
+    inverse CDFs over the same rank machinery: zero PRNG bits sample
+    rank 0 for every strategy, so the deme-row-0 structure must hold;
+    invalid params raise at build time; unknown kinds decline."""
+    import pytest
+
+    P, L, K = 512, 12, 128
+    G = P // K
+    genomes = (
+        jnp.broadcast_to(jnp.arange(P, dtype=jnp.float32)[:, None], (P, L)) / P
+    )
+    expect = np.asarray([((r % G) * K) / P for r in range(P)], np.float32)
+    with _interpret():
+        for kind, param in (
+            ("truncation", 0.25), ("truncation", None),
+            ("linear_rank", 1.5), ("linear_rank", None),
+        ):
+            breed = make_pallas_breed(
+                P, L, deme_size=K, mutation_rate=0.0,
+                selection_kind=kind, selection_param=param,
+            )
+            assert breed is not None, (kind, param)
+            out = np.asarray(
+                breed(genomes, deme_rank0_scores(P, K), jax.random.key(0))
+            )
+            np.testing.assert_allclose(
+                out, np.broadcast_to(expect[:, None], (P, L)),
+                atol=2e-5, rtol=0, err_msg=str((kind, param)),
+            )
+    with pytest.raises(ValueError):
+        make_pallas_breed(P, L, selection_kind="truncation",
+                          selection_param=1.5)
+    with pytest.raises(ValueError):
+        make_pallas_breed(P, L, selection_kind="linear_rank",
+                          selection_param=1.0)
+    with pytest.raises(ValueError):
+        # unknown kinds are config errors (canonical message from
+        # ops/select.resolve_selection), not silent XLA fallbacks
+        make_pallas_breed(P, L, selection_kind="roulette")
+
+
 def test_gaussian_keeps_pad_lanes_zero():
     """Gaussian mutation fires per-gene over the whole (K, Lp) tile, so
     without the lane guard it would write noise into pad lanes (L..Lp)

@@ -136,6 +136,37 @@ def main() -> int:
         abs(rcorr) < 0.05,
     )
 
+    # Alternate selection strategies (round 3: the reference's
+    # placeholder enum made real). Truncation tau=0.25 on uniform
+    # scores: winners uniform over the top quartile -> mean 0.875.
+    # Linear ranking s=2 has tournament-2 intensity -> mean 2/3.
+    breedq = make_pallas_breed(P, L, deme_size=K, mutation_rate=0.0,
+                               selection_kind="truncation",
+                               selection_param=0.25)
+    outq2 = np.asarray(breedq(genomes, scores, jax.random.key(31)))
+    pq = []
+    for r in range(0, P, 3):
+        ids = np.unique(np.round(outq2[r] * P).astype(int))
+        pq.extend(sn[ids])
+    mq = float(np.mean(pq))
+    good &= check(
+        f"truncation tau=.25 mean winner ~0.875 (got {mq:.3f})",
+        0.85 < mq < 0.90,
+    )
+    breedl = make_pallas_breed(P, L, deme_size=K, mutation_rate=0.0,
+                               selection_kind="linear_rank",
+                               selection_param=2.0)
+    outl = np.asarray(breedl(genomes, scores, jax.random.key(32)))
+    pl_ = []
+    for r in range(0, P, 3):
+        ids = np.unique(np.round(outl[r] * P).astype(int))
+        pl_.extend(sn[ids])
+    ml = float(np.mean(pl_))
+    good &= check(
+        f"linear_rank s=2 mean winner ~2/3 (got {ml:.3f})",
+        0.63 < ml < 0.70,
+    )
+
     # Gaussian mutation statistics: uniform population at 0.5 with equal
     # scores makes selection and crossover no-ops, isolating the mutation.
     # rate=0.3, sigma=0.05 -> ~30% of genes perturbed with std ~sigma
