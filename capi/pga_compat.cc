@@ -140,14 +140,19 @@ void pga_evaluate_all(pga_t *p) {
 
 void pga_crossover(pga_t *p, population_t *pop,
                    enum crossover_selection_type type) {
+    /* The reference ignores `type` entirely (pga.cu:329) — a driver may
+     * legally pass any value. The improved-ABI bridge honors non-zero
+     * values, so this exact-reference shim pins TOURNAMENT to keep the
+     * reference's observable behavior verbatim. */
+    (void)type;
     if (!p || !pop) return;
-    call_long("crossover", "(lli)", solver_of(p), pop_index_of(pop),
-              static_cast<int>(type));
+    call_long("crossover", "(lli)", solver_of(p), pop_index_of(pop), 0);
 }
 
 void pga_crossover_all(pga_t *p, enum crossover_selection_type type) {
+    (void)type;
     if (!p) return;
-    call_long("crossover_all", "(li)", solver_of(p), static_cast<int>(type));
+    call_long("crossover_all", "(li)", solver_of(p), 0);
 }
 
 void pga_migrate(pga_t *p, float pct) {

@@ -78,6 +78,14 @@ int pga_set_objective_name(pga_t *p, const char *name) {
         call_long("set_objective_name", "(ls)", solver_of(p), name));
 }
 
+int pga_set_selection(pga_t *p, enum crossover_selection_type type,
+                      float param) {
+    if (!p) return -1;
+    return static_cast<int>(
+        call_long("set_selection", "(lid)", solver_of(p),
+                  static_cast<int>(type), static_cast<double>(param)));
+}
+
 gene *pga_get_best(pga_t *p, population_t *pop) {
     if (!p || !pop) return nullptr;
     return bytes_to_genes(

@@ -39,9 +39,18 @@ enum population_type {
     RANDOM_POPULATION = 0               /* pga.h:31-34 */
 };
 
+/* Parent-selection strategies. The reference declares this enum as a
+ * self-described placeholder with one member and ignores the argument
+ * (pga.h:37-42, pga.cu:329); here every member is implemented — in the
+ * fused TPU kernel each strategy is just a different inverse CDF over
+ * rank space, at identical cost. */
 enum crossover_selection_type {
-    TOURNAMENT = 0                      /* pga.h:39-42; only strategy */
+    TOURNAMENT = 0,                     /* k-way tournament (default) */
+    TRUNCATION = 1,                     /* uniform over the top-tau ranks */
+    LINEAR_RANK = 2                     /* linear ranking, pressure s */
 };
+
+#define PGA_SELECTION_DEFAULT_PARAM (-1.0f)
 
 /* Callback signatures — the reference's exact shapes (pga.h:46-48),
  * minus the __device__ qualifier. rand is a per-individual slice of
@@ -84,7 +93,19 @@ gene *pga_get_best_top(pga_t *p, population_t *pop, unsigned length);
 gene *pga_get_best_all(pga_t *p);
 gene *pga_get_best_top_all(pga_t *p, unsigned length);
 
-/* Step-by-step operators (pga.h:98-134). */
+/* Select the parent-selection strategy for all subsequent breeding
+ * (crossover, run, run_islands). param: tau in (0,1] for TRUNCATION,
+ * pressure s in (1,2] for LINEAR_RANK, or PGA_SELECTION_DEFAULT_PARAM
+ * for the strategy default (tau 0.5 / s 2.0); ignored for TOURNAMENT.
+ * Returns 0, or -1 for an unknown strategy / out-of-range param. */
+int pga_set_selection(pga_t *p, enum crossover_selection_type type,
+                      float param);
+
+/* Step-by-step operators (pga.h:98-134). The crossover calls honor a
+ * NON-tournament `type` by switching the solver's strategy at its
+ * default parameter (the reference ignores this argument entirely);
+ * passing TOURNAMENT is inert so reference-style drivers that pass it
+ * on every call cannot clobber a pga_set_selection choice. */
 int pga_evaluate(pga_t *p, population_t *pop);
 int pga_evaluate_all(pga_t *p);
 int pga_crossover(pga_t *p, population_t *pop,

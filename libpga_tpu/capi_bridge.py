@@ -372,17 +372,60 @@ def evaluate_all(handle: int) -> None:
         _solver(handle).evaluate_all()
 
 
+def set_selection(handle: int, kind: int, param: float) -> None:
+    """Selection strategy for the improved ABI (``pga_set_selection``):
+    kind indexes ``crossover_selection_type`` in pga_tpu.h (0 tournament,
+    1 truncation, 2 linear_rank); ``param`` < 0 means the strategy
+    default (τ 0.5 / pressure 2.0). Validation (and defaults) come from
+    the same resolver both compute paths use."""
+    import dataclasses
+
+    from libpga_tpu.ops.select import SELECTION_KINDS, resolve_selection
+
+    pga = _solver(handle)
+    if not 0 <= kind < len(SELECTION_KINDS):
+        raise ValueError(
+            f"unknown selection kind id {kind}; 0..{len(SELECTION_KINDS)-1}"
+        )
+    name = SELECTION_KINDS[kind]
+    p = None if param < 0 else float(param)
+    resolve_selection(name, p)  # raise before mutating solver state
+    pga.config = dataclasses.replace(
+        pga.config, selection=name, selection_param=p
+    )
+
+
+def _apply_selection_arg(handle: int, selection: int) -> None:
+    """The reference ignores pga_crossover's selection argument
+    (pga.cu:329, enum is a placeholder). The improved ABI honors
+    NON-tournament values: they switch the solver's strategy at its
+    default parameter (use pga_set_selection for an explicit
+    τ/pressure). TOURNAMENT (0) — what every reference-style driver
+    passes on each call — is left inert so it cannot clobber a strategy
+    chosen via pga_set_selection; switch back explicitly with
+    pga_set_selection(p, TOURNAMENT, -1)."""
+    from libpga_tpu.ops.select import SELECTION_KINDS
+
+    if 1 <= selection < len(SELECTION_KINDS):
+        name = SELECTION_KINDS[selection]
+        if _solver(handle).config.selection != name:
+            set_selection(handle, selection, -1.0)
+
+
 def crossover(handle: int, pop: int, selection: int) -> None:
-    del selection  # TOURNAMENT is the only strategy (reference pga.cu:329)
+    # Validate the handles BEFORE the selection side effect: a failed
+    # call must not leave the solver on a different strategy.
     pga, h = _handle_pop(handle, pop)
+    _apply_selection_arg(handle, selection)
     with _exec_ctx(handle):
         pga.crossover(h)
 
 
 def crossover_all(handle: int, selection: int) -> None:
-    del selection
+    pga = _solver(handle)
+    _apply_selection_arg(handle, selection)
     with _exec_ctx(handle):
-        _solver(handle).crossover_all()
+        pga.crossover_all()
 
 
 def mutate(handle: int, pop: int) -> None:

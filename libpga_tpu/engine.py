@@ -469,10 +469,24 @@ class PGA:
 
     def crossover(self, handle: PopulationHandle, selection: str = "tournament") -> None:
         """Select parents from the current generation and stage children as
-        the next generation (reference ``pga_crossover``; the selection-type
-        argument is accepted for parity and, as in the reference
-        (``pga.cu:329``), tournament is the only strategy)."""
-        del selection
+        the next generation (reference ``pga_crossover``).
+
+        The reference accepts-and-ignores its selection-type argument
+        (``pga.cu:329``, single-member placeholder enum); here a
+        NON-tournament value ("truncation" / "linear_rank") switches the
+        solver's strategy at its default parameter — the same contract
+        as the C ABI's ``pga_crossover`` — while "tournament" (the value
+        reference-style callers pass on every call) is inert so it never
+        clobbers a strategy chosen via ``config.selection``. Set
+        ``PGAConfig(selection=..., selection_param=...)`` for an
+        explicit τ/pressure."""
+        if selection != "tournament" and selection != self.config.selection:
+            from libpga_tpu.ops.select import resolve_selection
+
+            resolve_selection(selection, None)  # validate before mutating
+            self.config = dataclasses.replace(
+                self.config, selection=selection, selection_param=None
+            )
         pop = self._populations[handle.index]
         fn = self._compiled_op("crossover")
         self._staged[handle.index] = fn(pop.genomes, pop.scores, self.next_key())

@@ -325,20 +325,15 @@ def _breed_kernel(
                 ).astype(jnp.float32)
 
             u_t = uniform((2, K)).T  # (K, 2): one winner draw per parent
-            if sel == "truncation":
-                # Uniform over the deme's top ceil(tau·V) ranks — same
-                # one-line inverse-CDF shape as the tournament; the
-                # cohort argument for panmictic equivalence applies
+            if sel != "tournament":
+                # Truncation / linear ranking: the SAME inverse-CDF
+                # helper the XLA operators use (ops/select.py), so the
+                # two paths sample provably identical distributions.
+                # The cohort argument for panmictic equivalence applies
                 # identically (see module docstring).
-                x = u_t * jnp.float32(sel_param)
-            elif sel == "linear_rank":
-                # Linear ranking, pressure s in (1, 2]: rank-fraction
-                # density f(x) = s - 2(s-1)x, inverse CDF below. s=2
-                # matches tournament-2 selection intensity exactly.
-                s_p = jnp.float32(sel_param)
-                x = (
-                    s_p - jnp.sqrt(s_p * s_p - 4.0 * (s_p - 1.0) * u_t)
-                ) / (2.0 * (s_p - 1.0))
+                from libpga_tpu.ops.select import rank_fraction_icdf
+
+                x = rank_fraction_icdf(sel, sel_param, u_t)
             elif tk == 1:
                 x = u_t
             elif tk & (tk - 1) == 0:

@@ -80,6 +80,23 @@ def resolve_selection(kind: str, param: float | None) -> float | None:
     )
 
 
+def rank_fraction_icdf(kind: str, param: float, u: jax.Array) -> jax.Array:
+    """Map uniform draws ``u`` to winner rank FRACTIONS x in [0, 1) —
+    the strategy's inverse CDF, shared verbatim by the XLA operators
+    below and the fused Pallas kernel (``ops/pallas_step.py``) so the
+    two paths sample provably identical distributions. Tournament is
+    not here: the kernel specializes its k cases (sqrt chains), and the
+    XLA tournament samples candidate indices directly."""
+    if kind == "truncation":
+        return u * jnp.float32(param)
+    if kind == "linear_rank":
+        s = jnp.float32(param)
+        return (s - jnp.sqrt(s * s - 4.0 * (s - 1.0) * u)) / (
+            2.0 * (s - 1.0)
+        )
+    raise ValueError(f"no rank-fraction ICDF for selection kind {kind!r}")
+
+
 def _rank_order(scores: jax.Array, key: jax.Array) -> jax.Array:
     """Row indices sorted best-first (rank r → row). Score ties break in
     a fresh uniform random order per call — matching the fused kernel's
@@ -113,7 +130,8 @@ def truncation_select(
     k_tie, k_u = jax.random.split(key)
     order = _rank_order(scores, k_tie)
     u = jax.random.uniform(k_u, (num,))
-    r = jnp.minimum((u * (tau * pop)).astype(jnp.int32), pop - 1)
+    x = rank_fraction_icdf("truncation", tau, u)
+    r = jnp.clip((x * pop).astype(jnp.int32), 0, pop - 1)
     return order[r]
 
 
@@ -136,9 +154,8 @@ def linear_rank_select(
     pressure = resolve_selection("linear_rank", pressure)
     k_tie, k_u = jax.random.split(key)
     order = _rank_order(scores, k_tie)
-    s = jnp.float32(pressure)
     u = jax.random.uniform(k_u, (num,))
-    x = (s - jnp.sqrt(s * s - 4.0 * (s - 1.0) * u)) / (2.0 * (s - 1.0))
+    x = rank_fraction_icdf("linear_rank", pressure, u)
     r = jnp.clip((x * pop).astype(jnp.int32), 0, pop - 1)
     return order[r]
 

@@ -115,6 +115,28 @@ class TestSelectionStrategies:
         with pytest.raises(ValueError):
             select_parent_pairs(key, scores, 4, kind="roulette")
 
+    def test_crossover_selection_arg_contract(self, key):
+        """PGA.crossover mirrors the C ABI: a non-tournament selection
+        argument switches the solver's strategy (default param);
+        "tournament" is inert so reference-style per-call passing can't
+        clobber a configured strategy; unknown kinds raise without
+        mutating state."""
+        import pytest
+
+        from libpga_tpu import PGA
+
+        pga = PGA(seed=0)
+        h = pga.create_population(256, 8)
+        pga.set_objective("onemax")
+        pga.evaluate(h)
+        pga.crossover(h, "truncation")
+        assert pga.config.selection == "truncation"
+        pga.crossover(h, "tournament")  # inert: must not clobber
+        assert pga.config.selection == "truncation"
+        with pytest.raises(ValueError):
+            pga.crossover(h, "roulette")
+        assert pga.config.selection == "truncation"
+
     def test_engine_selection_config_end_to_end(self, key):
         """The engine threads config.selection through the XLA run loop:
         a truncation-selection OneMax run must still converge."""
