@@ -98,6 +98,15 @@ void pga_migrate_between(pga_t *, population_t *, population_t *, float pct);
 void pga_mutate(pga_t *, population_t *);
 void pga_mutate_all(pga_t *);
 
+/* Promote the staged next generation to current. Deliberate semantic
+ * divergence: the reference's pointer swap (pga.cu:362-366) leaves the
+ * PREVIOUS generation's stale scores readable until the next
+ * pga_evaluate; here the swapped-in population's scores read as -INF
+ * until evaluated. A driver calling pga_get_best between swap and
+ * evaluate sees an arbitrary not-yet-scored genome either way — this
+ * implementation just makes the staleness visible instead of
+ * plausible-looking. Call pga_evaluate after swapping, as the
+ * reference drivers do. */
 void pga_swap_generations(pga_t *, population_t *);
 
 void pga_fill_random_values(pga_t *, population_t *);

@@ -43,7 +43,14 @@ enum population_type {
  * self-described placeholder with one member and ignores the argument
  * (pga.h:37-42, pga.cu:329); here every member is implemented — in the
  * fused TPU kernel each strategy is just a different inverse CDF over
- * rank space, at identical cost. */
+ * rank space, at identical cost.
+ *
+ * Porting note: the reference enum's MAX_SELECTION_TYPE sentinel has
+ * value 1, which here is TRUNCATION. A driver ported from pga.h that
+ * forwards MAX_SELECTION_TYPE into pga_crossover* would switch the
+ * solver to truncation selection — pass TOURNAMENT (0, inert) instead.
+ * Values outside the enum return -1 from pga_crossover*, matching
+ * pga_set_selection's error surface. */
 enum crossover_selection_type {
     TOURNAMENT = 0,                     /* k-way tournament (default) */
     TRUNCATION = 1,                     /* uniform over the top-tau ranks */
@@ -116,6 +123,10 @@ int pga_migrate_between(pga_t *p, population_t *from, population_t *to,
                         float pct);
 int pga_mutate(pga_t *p, population_t *pop);
 int pga_mutate_all(pga_t *p);
+/* Promote the staged next generation to current. The new generation's
+ * scores read as -INF until pga_evaluate runs (the reference's pointer
+ * swap instead exposes the previous generation's stale scores — see
+ * the semantics note in pga.h). */
 int pga_swap_generations(pga_t *p, population_t *pop);
 int pga_fill_random_values(pga_t *p, population_t *pop);
 

@@ -54,6 +54,20 @@ int main(void) {
         return fprintf(stderr, "bad enum accepted\n"), 1;
     if (pga_set_selection(p, TOURNAMENT, -1.0f) != 0)
         return fprintf(stderr, "tournament reset failed\n"), 1;
+    /* pga_crossover* must reject unknown enum values with -1 (same
+     * error surface as pga_set_selection), not silently no-op */
+    population_t *pop2 = pga_create_population(p, 256, 8, RANDOM_POPULATION);
+    if (!pop2) return fprintf(stderr, "create_population failed\n"), 1;
+    if (pga_set_objective_name(p, "onemax") != 0)
+        return fprintf(stderr, "set_objective_name failed\n"), 1;
+    if (pga_evaluate(p, pop2) != 0)
+        return fprintf(stderr, "evaluate failed\n"), 1;
+    if (pga_crossover(p, pop2, (enum crossover_selection_type)9) == 0)
+        return fprintf(stderr, "crossover accepted bad enum\n"), 1;
+    if (pga_crossover_all(p, (enum crossover_selection_type)9) == 0)
+        return fprintf(stderr, "crossover_all accepted bad enum\n"), 1;
+    if (pga_crossover(p, pop2, TOURNAMENT) != 0)
+        return fprintf(stderr, "crossover(TOURNAMENT) failed\n"), 1;
     pga_deinit(p);
 
     printf("PASS\n");

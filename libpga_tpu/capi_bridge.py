@@ -380,19 +380,29 @@ def set_selection(handle: int, kind: int, param: float) -> None:
     the same resolver both compute paths use."""
     import dataclasses
 
-    from libpga_tpu.ops.select import SELECTION_KINDS, resolve_selection
+    from libpga_tpu.ops.select import resolve_selection
 
     pga = _solver(handle)
-    if not 0 <= kind < len(SELECTION_KINDS):
-        raise ValueError(
-            f"unknown selection kind id {kind}; 0..{len(SELECTION_KINDS)-1}"
-        )
-    name = SELECTION_KINDS[kind]
+    name = _selection_name(kind)
     p = None if param < 0 else float(param)
     resolve_selection(name, p)  # raise before mutating solver state
     pga.config = dataclasses.replace(
         pga.config, selection=name, selection_param=p
     )
+
+
+def _selection_name(kind: int) -> str:
+    """Validate a C-enum selection id and return its kind name — the ONE
+    range check + diagnostic shared by pga_set_selection and the
+    pga_crossover* selection argument, so their error surfaces cannot
+    drift."""
+    from libpga_tpu.ops.select import SELECTION_KINDS
+
+    if not 0 <= kind < len(SELECTION_KINDS):
+        raise ValueError(
+            f"unknown selection kind id {kind}; 0..{len(SELECTION_KINDS)-1}"
+        )
+    return SELECTION_KINDS[kind]
 
 
 def _apply_selection_arg(handle: int, selection: int) -> None:
@@ -403,11 +413,12 @@ def _apply_selection_arg(handle: int, selection: int) -> None:
     τ/pressure). TOURNAMENT (0) — what every reference-style driver
     passes on each call — is left inert so it cannot clobber a strategy
     chosen via pga_set_selection; switch back explicitly with
-    pga_set_selection(p, TOURNAMENT, -1)."""
-    from libpga_tpu.ops.select import SELECTION_KINDS
-
-    if 1 <= selection < len(SELECTION_KINDS):
-        name = SELECTION_KINDS[selection]
+    pga_set_selection(p, TOURNAMENT, -1). Out-of-range values raise
+    (→ -1 through the ABI) — the same error surface as
+    pga_set_selection, instead of silently behaving like the inert
+    TOURNAMENT."""
+    name = _selection_name(selection)
+    if selection != 0:
         if _solver(handle).config.selection != name:
             set_selection(handle, selection, -1.0)
 

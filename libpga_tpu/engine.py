@@ -566,7 +566,14 @@ class PGA:
 
     def swap_generations(self, handle: PopulationHandle) -> None:
         """Promote the staged next generation to current (reference
-        ``pga_swap_generations`` pointer swap, ``pga.cu:362-366``)."""
+        ``pga_swap_generations`` pointer swap, ``pga.cu:362-366``).
+
+        Deliberate divergence (documented in ``capi/pga.h``): the
+        swapped-in population's scores read -inf until the next
+        :meth:`evaluate`, where the reference's pointer swap leaves the
+        previous generation's stale scores readable. Stale scores are
+        wrong for the new genomes either way; -inf makes that visible
+        instead of plausible-looking."""
         staged = self._staged[handle.index]
         if staged is None:
             raise RuntimeError("no staged generation — call crossover() first")

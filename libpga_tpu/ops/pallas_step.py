@@ -293,8 +293,9 @@ def _breed_kernel(
             ).astype(jnp.bfloat16)
         else:
             # ``scores_ref`` carries each row's PRE-COMPUTED in-deme
-            # rank (0 = best; strict total order, score ties broken by
-            # row index, NaNs last) — the caller derives them from the
+            # rank (0 = best; strict total order, score ties broken by a
+            # fresh random word per generation, NaNs last among real
+            # rows) — the caller derives them from the
             # scores with one stable double-argsort per generation
             # (``breed_padded``), which costs ~0.8 ms/gen at 1M×100 and
             # replaces what used to be a K×K compare+reduce cube per
@@ -315,10 +316,12 @@ def _breed_kernel(
             else:
                 # padded population: the last deme holds V = P - deme·K
                 # < K real rows (pads beyond them, carrying -inf
-                # scores). Ranks 0..V-1 are exactly the real rows (index
-                # tie-break puts any -inf real row before the pads), so
-                # sampling rank < V means a pad row can never be
-                # selected.
+                # scores). Ranks 0..V-1 are exactly the real rows — the
+                # pads carry the maximal 0xFFFFFFFF tie key while real
+                # rows' random tie words are shifted into [0, 2^31), so
+                # even a -inf-scored real row sorts strictly before
+                # every pad — and sampling rank < V means a pad row can
+                # never be selected.
                 deme = i * D + d
                 Vf = jnp.maximum(
                     jnp.minimum(jnp.int32(K), jnp.int32(P) - deme * K), 1

@@ -91,9 +91,19 @@ def rank_fraction_icdf(kind: str, param: float, u: jax.Array) -> jax.Array:
         return u * jnp.float32(param)
     if kind == "linear_rank":
         s = jnp.float32(param)
-        return (s - jnp.sqrt(s * s - 4.0 * (s - 1.0) * u)) / (
-            2.0 * (s - 1.0)
-        )
+        # Clamp the radicand: for s just under 2 and u within a few ulps
+        # of 1, s²-4(s-1)u can round fractionally negative in f32 and
+        # sqrt(NaN) would poison the winner rank (a NaN rank matches no
+        # one-hot row in the kernel and breeds an all-zero child). When
+        # the clamp fires the quotient is s/(2(s-1)), fractionally above
+        # 1; at u≈0, sqrt(s²) can round a ulp above s, going fractionally
+        # negative. Pin to [0, 1) so the documented contract holds at the
+        # source. (Consumers still need their rank clamps: x·V can round
+        # UP to V in f32 even for x < 1, e.g. (1-2^-24)·1024.)
+        x = (
+            s - jnp.sqrt(jnp.maximum(s * s - 4.0 * (s - 1.0) * u, 0.0))
+        ) / (2.0 * (s - 1.0))
+        return jnp.clip(x, 0.0, jnp.float32(1.0 - 2.0**-24))
     raise ValueError(f"no rank-fraction ICDF for selection kind {kind!r}")
 
 
