@@ -63,6 +63,19 @@ class PGAConfig:
         the size that minimizes padding. The engine falls back to the
         XLA path only for sub-tile populations (< 128) or when every
         padded fit would leave a degenerate tail deme.
+      pallas_generations_per_launch: generations bred per fused-kernel
+        launch in ``PGA.run``. ``None`` (default) = auto: the measured
+        per-dtype sweet spot (``ops/pallas_step.multigen_default_t`` —
+        8 for f32, 1 for bf16) when the objective evaluates in-kernel,
+        else 1. Values > 1 hold each deme group VMEM-resident across
+        that many generations (amortizing the exposed part of the HBM
+        round trip; measured +3–6% for f32 at 1M-population scale) at
+        the cost of deme isolation within the launch — the inter-deme
+        riffle reshuffle then happens every T generations instead of
+        every generation (convergence impact unmeasurable at T <= 8,
+        see BASELINE.md) — and launch-granularity target checks. Set 1
+        for the one-generation kernel (per-generation riffle and exact
+        target-generation reporting).
       donate_buffers: donate the genome buffer to jit so XLA updates it in
         place (the TPU-native replacement for the reference's
         current/next-generation pointer swap, ``pga.h:124-129``).
@@ -81,6 +94,7 @@ class PGAConfig:
     migration_topology: str = "ring"
     use_pallas: Optional[bool] = None
     pallas_deme_size: Optional[int] = None
+    pallas_generations_per_launch: Optional[int] = None
     donate_buffers: bool = True
     seed: Optional[int] = None
 
@@ -104,3 +118,8 @@ class PGAConfig:
             raise ValueError("elitism must be >= 0")
         if self.migration_topology not in ("ring", "random"):
             raise ValueError("migration_topology must be 'ring' or 'random'")
+        if (
+            self.pallas_generations_per_launch is not None
+            and self.pallas_generations_per_launch < 1
+        ):
+            raise ValueError("pallas_generations_per_launch must be >= 1")

@@ -216,6 +216,7 @@ class PGA:
                 self._crossover_kind(), self.config.elitism,
                 self.config.tournament_size, self.config.selection,
                 self.config.selection_param,
+                self.config.pallas_generations_per_launch,
             )
             cached = self._compiled.get(pkey)
             if cached is None:
@@ -236,6 +237,9 @@ class PGA:
                     deme_size=self.config.pallas_deme_size,
                     donate=self.config.donate_buffers,
                     gene_dtype=self.config.gene_dtype,
+                    generations_per_launch=(
+                        self.config.pallas_generations_per_launch
+                    ),
                 )
                 pallas_fn = factory(size, genome_len) if factory else None
                 cached = (
@@ -426,7 +430,14 @@ class PGA:
         promised by ``pga.h:137-143`` and missing from the reference
         implementation.
 
-        Returns the number of generations actually executed.
+        Returns the number of generations actually executed. Without a
+        target this is exactly ``n``. With a target, the multi-generation
+        kernel (``config.pallas_generations_per_launch``; f32 default 8)
+        checks it once per launch, so the count on early exit is a
+        multiple of T — up to T-1 high — and a mid-launch achiever is
+        preserved by the kernel's group freeze. Set
+        ``pallas_generations_per_launch=1`` for exact target-generation
+        reporting.
         """
         handle = population or PopulationHandle(0)
         pop = self._populations[handle.index]

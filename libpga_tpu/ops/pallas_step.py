@@ -194,6 +194,266 @@ def _supported() -> bool:
     return True
 
 
+def _deme_child(
+    g,
+    R,
+    Vf,
+    uniform,
+    mask_words,
+    d,
+    *,
+    K,
+    L,
+    Lp,
+    tk,
+    sel,
+    sel_param,
+    crossover,
+    mutate,
+    rate,
+    sigma,
+    lane_ok,
+    bf16_genes,
+    elite_rows=0,
+    ablate=(),
+):
+    """Breed one deme's K children: rank-space selection + crossover +
+    mutation, all on VMEM values. The SINGLE definition of in-kernel
+    breeding, shared by the one-generation kernel (``_breed_kernel``,
+    ranks precomputed outside) and the multi-generation kernel
+    (``_multigen_kernel``, ranks computed in-kernel per sub-generation)
+    so the two cannot drift.
+
+    Args: ``g`` (K, Lp) genomes in their STORED dtype; ``R`` (1, K) f32
+    in-deme ranks (0 = best, strict total order, pads ranked >= V);
+    ``Vf`` f32 valid-row count; ``uniform(shape)`` the kernel's PRNG
+    draw; ``mask_words`` the (K, Lp) crossover-mask PRNG tile shared by
+    the deme group (deme ``d`` reads bit d), or None for non-uniform
+    crossover; ``rate``/``sigma`` runtime mutation params.
+
+    ``elite_rows`` > 0 turns rows 0..e-1 into verbatim copies of the
+    deme's rank-0..e-1 rows: both winner ranks are forced to the row
+    index, the crossover output of those rows is overwritten with the
+    gathered parent (uniform crossover of identical parents is already
+    the identity, but order crossover is NOT — duplicate-city decodes
+    regenerate random genes), and mutation is gated off. Per-deme elites
+    preserve the global top-e: each global top-j row (j <= e) is within
+    the top-e of its own deme. Returns the child block (K, Lp) f32.
+    """
+    import jax.lax as lax
+
+    # ---- rank-space tournament selection --------------------------
+    if "sel_const" in ablate:
+        # Ablation harness (tools/ablate_kernel.py): identity
+        # selection isolates the sampling + one-hot cost from the
+        # parent matmuls.
+        oh = (
+            lax.broadcasted_iota(jnp.int32, (2 * K, K), 0) % K
+            == lax.broadcasted_iota(jnp.int32, (2 * K, K), 1)
+        ).astype(jnp.bfloat16)
+    else:
+        u_t = uniform((2, K)).T  # (K, 2): one winner draw per parent
+        if sel != "tournament":
+            # Truncation / linear ranking: the SAME inverse-CDF
+            # helper the XLA operators use (ops/select.py), so the
+            # two paths sample provably identical distributions.
+            # The cohort argument for panmictic equivalence applies
+            # identically (see module docstring).
+            from libpga_tpu.ops.select import rank_fraction_icdf
+
+            x = rank_fraction_icdf(sel, sel_param, u_t)
+        elif tk == 1:
+            x = u_t
+        elif tk & (tk - 1) == 0:
+            # The k-way tournament winner is the candidate with the
+            # minimum rank; for k i.i.d. uniform candidate draws over V
+            # valid rows that minimum has inverse CDF
+            # rank = floor(V·(1-(1-u)^{1/k})):
+            # P(rank=r) = ((V-r)^k - (V-r-1)^k)/V^k, exactly the
+            # distribution of drawing k candidates and keeping the best
+            # score. One uniform per parent replaces 2k candidate draws
+            # + 2k score lookups, at k-independent cost. Power-of-two k
+            # uses repeated sqrt; other k the exp/log form.
+            t = 1.0 - u_t
+            for _ in range(tk.bit_length() - 1):
+                t = jnp.sqrt(t)
+            x = 1.0 - t
+        else:
+            x = 1.0 - jnp.exp(jnp.log(1.0 - u_t) * jnp.float32(1.0 / tk))
+        # Two-sided clamp: floor can graze V at f32 precision (x·V
+        # rounding up), and linear_rank's x can go fractionally
+        # NEGATIVE at u≈0 if the VPU's sqrt(s²-4(s-1)u) rounds a ulp
+        # above s — wr=-1 would match no rank and breed a zero row.
+        wr = jnp.clip(jnp.floor(x * Vf), 0.0, Vf - 1.0)  # (K, 2) ranks
+
+        if elite_rows:
+            # Rows 0..e-1 reproduce the deme's best e rows verbatim:
+            # both winner ranks are forced to the row index, the
+            # crossover OUTPUT of those rows is overwritten with the
+            # gathered parent below (order crossover is NOT the
+            # identity on identical parents — duplicate city decodes
+            # regenerate random genes), and mutation is gated off.
+            # min() guards a tail deme with fewer than e valid rows.
+            row_col = lax.broadcasted_iota(jnp.int32, (K, 1), 0)
+            forced = jnp.minimum(row_col.astype(jnp.float32), Vf - 1.0)
+            wr = jnp.where(row_col < elite_rows, forced, wr)
+
+        # Winner one-hots by rank equality: ranks are distinct
+        # integers 0..K-1 (exact in f32), so each row of the compare
+        # is an exact one-hot over the deme's source rows; the two
+        # parents' one-hots stack into the (2K, K) selector the single
+        # selection matmul below consumes. (A direct (2K, 1)-rank
+        # compare would save the concat, but Mosaic can't lower the
+        # (K, 2) -> (2K, 1) reshape.)
+        oh = jnp.concatenate(
+            [
+                (R == wr[:, 0:1]).astype(jnp.bfloat16),
+                (R == wr[:, 1:2]).astype(jnp.bfloat16),
+            ],
+            axis=0,
+        )  # (2K, K)
+
+    # ---- parent rows via ONE one-hot matmul -----------------------
+    # Both parents' one-hots stack into a (2K, K) selector so the MXU
+    # runs a single large matmul instead of 2 (bf16) or 4 (f32) K-sized
+    # ones — measured ~1.5× faster at K=256 (small matmuls leave the
+    # systolic array underfed; the bf16 K=512 path's efficiency was the
+    # tell). For f32 genes the bf16 hi/lo split halves concatenate on
+    # the LANE axis, so all four products land in one
+    # (2K, K)@(K, 2Lp) op and two adds reassemble ~1e-5-accurate rows.
+    if "no_matmul" in ablate:
+        p1 = p2 = g.astype(jnp.float32)
+    else:
+        if bf16_genes:
+            # bf16 genomes are selected exactly (0/1 selector rows; f32
+            # accumulation) — half the FLOPs and HBM traffic of f32.
+            pp = jnp.dot(oh, g, preferred_element_type=jnp.float32)
+            p1, p2 = pp[:K, :], pp[K:, :]
+        else:
+            g_hi = g.astype(jnp.bfloat16)
+            g_lo = (g - g_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+            g_cat = jnp.concatenate([g_hi, g_lo], axis=1)  # (K, 2Lp)
+            pp = jnp.dot(oh, g_cat, preferred_element_type=jnp.float32)
+            p1 = pp[:K, :Lp] + pp[:K, Lp:]
+            p2 = pp[K:, :Lp] + pp[K:, Lp:]
+
+    if "no_cross" in ablate:
+        child = p1
+    elif crossover == "uniform":
+        # ---- uniform crossover: per-gene coin flip (pga.cu:135-143)
+        child = jnp.where(
+            ((mask_words >> d) & jnp.uint32(1)) == 0, p1, p2
+        )
+    elif crossover == "order":
+        # ---- order-preserving crossover (reference TSP driver,
+        # test3/test.cu:48-64): walk gene positions left to right,
+        # take p1's gene if its decoded city is unvisited, else
+        # p2's, else the raw random value. Inherently sequential in
+        # L, but each step is a handful of (Lp, K) VPU ops on
+        # VMEM-resident data — unrolled at trace time, zero HBM
+        # traffic — unlike the XLA scan path whose per-step launch
+        # overhead dominates large populations (ops/crossover.py).
+        # Transposed (gene-major) layout: a step's slice is then a
+        # static SUBLANE row, and the visited set indexes cities on
+        # sublanes.
+        p1t = p1.T  # (Lp, K) f32 — 32-bit transpose is supported
+        p2t = p2.T
+        c1t = jnp.clip(jnp.floor(p1t * L), 0, L - 1).astype(jnp.int32)
+        c2t = jnp.clip(jnp.floor(p2t * L), 0, L - 1).astype(jnp.int32)
+        randt = uniform((Lp, K))
+        sub = lax.broadcasted_iota(jnp.int32, (Lp, K), 0)
+        visited = jnp.zeros((Lp, K), dtype=jnp.bool_)
+        childt = jnp.zeros((Lp, K), dtype=jnp.float32)
+        for l in range(L):
+            g1l, c1l = p1t[l : l + 1, :], c1t[l : l + 1, :]
+            g2l, c2l = p2t[l : l + 1, :], c2t[l : l + 1, :]
+            seen1 = jnp.any(
+                visited & (sub == c1l), axis=0, keepdims=True
+            )
+            seen2 = jnp.any(
+                visited & (sub == c2l), axis=0, keepdims=True
+            )
+            take1 = ~seen1
+            take2 = seen1 & ~seen2
+            gene = jnp.where(
+                take1, g1l, jnp.where(take2, g2l, randt[l : l + 1, :])
+            )
+            mark_city = jnp.where(take1, c1l, c2l)
+            visited = visited | ((sub == mark_city) & (take1 | take2))
+            childt = jnp.where(sub == l, gene, childt)
+        child = childt.T  # (K, Lp); pad columns are 0
+    else:
+        raise ValueError(f"unknown crossover kind {crossover!r}")
+
+    if elite_rows:
+        elite_col = (
+            lax.broadcasted_iota(jnp.int32, (K, 1), 0) >= elite_rows
+        )  # True where mutation may fire
+        if "no_matmul" not in ablate and "sel_const" not in ablate:
+            # Elite rows become the gathered parent VERBATIM: uniform
+            # crossover of identical parents is already the identity,
+            # but order crossover regenerates random genes at
+            # duplicate-city positions even for p1 == p2.
+            child = jnp.where(elite_col, child, p1)
+
+    # ---- mutation -------------------------------------------------
+    if "no_mut" in ablate:
+        pass
+    elif mutate == "point":
+        # Point mutation (pga.cu:127-133): one random gene per firing
+        # row.
+        u_t = uniform((4, K)).T  # (K, 4) f32
+        pos = jnp.floor(u_t[:, 0:1] * L).astype(jnp.int32)  # in [0, L)
+        cols = lax.broadcasted_iota(jnp.int32, (K, Lp), 1)
+        # Strict '<' so rate=0 disables mutation exactly (the
+        # reference's ``rand[1] <= chance`` gate, pga.cu:128, differs
+        # only on a measure-zero event for rate in (0,1)).
+        hit = (cols == pos) & (u_t[:, 1:2] < rate)
+        if elite_rows:
+            hit = hit & elite_col
+        child = jnp.where(hit, u_t[:, 2:3], child)
+    elif mutate == "gaussian":
+        # Per-gene Gaussian perturbation (ops/mutate.gaussian_mutate
+        # semantics): each gene independently fires with probability
+        # ``rate`` and receives N(0, sigma^2) noise, clipped to
+        # [0, 1). Box-Muller from two independent in-kernel uniform
+        # draws; the gate draw is a third stream, so noise sign stays
+        # independent of firing (see the XLA operator's docstring).
+        gate = uniform((K, Lp))
+        u1 = jnp.clip(uniform((K, Lp)), 1e-7, 1.0 - 1e-7)
+        u2 = uniform((K, Lp))
+        normal = jnp.sqrt(-2.0 * jnp.log(u1)) * jnp.cos(
+            2.0 * jnp.float32(math.pi) * u2
+        )
+        mutated = jnp.clip(child + sigma * normal, 0.0, 1.0 - 1e-7)
+        fire = gate < rate
+        if lane_ok is not None:
+            fire = fire & lane_ok
+        if elite_rows:
+            fire = fire & elite_col
+        child = jnp.where(fire, mutated, child)
+    elif mutate == "swap":
+        # Swap two random positions with probability ``rate``
+        # (ops/mutate.swap_mutate semantics — permutation GAs).
+        # Scatter-free: two lane one-hots select/exchange the genes.
+        u_t = uniform((4, K)).T  # (K, 4) f32
+        pi = jnp.floor(u_t[:, 0:1] * L).astype(jnp.int32)
+        pj = jnp.floor(u_t[:, 1:2] * L).astype(jnp.int32)
+        fire = u_t[:, 2:3] < rate
+        if elite_rows:
+            fire = fire & elite_col
+        cols = lax.broadcasted_iota(jnp.int32, (K, Lp), 1)
+        ohi = cols == pi
+        ohj = cols == pj
+        gi = jnp.sum(jnp.where(ohi, child, 0.0), axis=1, keepdims=True)
+        gj = jnp.sum(jnp.where(ohj, child, 0.0), axis=1, keepdims=True)
+        child = jnp.where(ohi & fire, gj, child)
+        child = jnp.where(ohj & fire, gi, child)
+    else:
+        raise ValueError(f"unknown mutate kind {mutate!r}")
+    return child
+
+
 def _breed_kernel(
     seed_ref,
     mparams_ref,
@@ -263,7 +523,9 @@ def _breed_kernel(
         ) * jnp.float32(2**-24)
 
     rate = mparams_ref[0, 0]
+    sigma = mparams_ref[0, 1]
 
+    mask_words = None
     if crossover == "uniform" and "no_cross" not in ablate:
         # Crossover coin flips need ONE bit per gene, not a 32-bit draw:
         # a single (K, Lp) PRNG tile per grid step serves every deme in
@@ -273,6 +535,7 @@ def _breed_kernel(
         # generation at one-draw-per-deme).
         mask_words = pltpu.bitcast(pltpu.prng_random_bits((K, Lp)), jnp.uint32)
 
+    lane_ok = None
     if mutate == "gaussian" and Lp > L:
         # Keep pad lanes untouched by gaussian noise so the pads-stay-
         # zero invariant holds for every mutation kind (pad_ok fused
@@ -282,207 +545,37 @@ def _breed_kernel(
     for d in range(D):
         g = g_all[d * K : (d + 1) * K, :]  # (K, Lp)
 
-        # ---- rank-space tournament selection --------------------------
-        if "sel_const" in ablate:
-            # Ablation harness (tools/ablate_kernel.py): identity
-            # selection isolates the sampling + one-hot cost from the
-            # parent matmuls.
-            oh1 = oh2 = (
-                lax.broadcasted_iota(jnp.int32, (K, K), 0)
-                == lax.broadcasted_iota(jnp.int32, (K, K), 1)
-            ).astype(jnp.bfloat16)
+        # ``scores_ref`` carries each row's PRE-COMPUTED in-deme rank
+        # (0 = best; strict total order, score ties broken by a fresh
+        # random word per generation, NaNs last among real rows) — the
+        # caller derives them from the scores with one stable
+        # double-argsort per generation (``breed_padded``), which costs
+        # ~0.8 ms/gen at 1M×100 (the multi-generation kernel instead
+        # ranks in-kernel, see ``_kernel_ranks``).
+        R = s_all[0, d : d + 1, :]  # (1, K) f32 ranks
+
+        if P is None or P % K == 0:
+            Vf = jnp.float32(K)
         else:
-            # ``scores_ref`` carries each row's PRE-COMPUTED in-deme
-            # rank (0 = best; strict total order, score ties broken by a
-            # fresh random word per generation, NaNs last among real
-            # rows) — the caller derives them from the
-            # scores with one stable double-argsort per generation
-            # (``breed_padded``), which costs ~0.8 ms/gen at 1M×100 and
-            # replaces what used to be a K×K compare+reduce cube per
-            # deme in here (~1–2 ms/gen, growing linearly with K).
-            R = s_all[0, d : d + 1, :]  # (1, K) f32 ranks
+            # padded population: the last deme holds V = P - deme·K
+            # < K real rows (pads beyond them, carrying -inf
+            # scores). Ranks 0..V-1 are exactly the real rows — the
+            # pads carry the maximal 0xFFFFFFFF tie key while real
+            # rows' random tie words are shifted into [0, 2^31), so
+            # even a -inf-scored real row sorts strictly before
+            # every pad — and sampling rank < V means a pad row can
+            # never be selected.
+            deme = i * D + d
+            Vf = jnp.maximum(
+                jnp.minimum(jnp.int32(K), jnp.int32(P) - deme * K), 1
+            ).astype(jnp.float32)
 
-            # The k-way tournament winner is the candidate with the
-            # minimum rank; for k i.i.d. uniform candidate draws over V
-            # valid rows that minimum has inverse CDF
-            # rank = floor(V·(1-(1-u)^{1/k})):
-            # P(rank=r) = ((V-r)^k - (V-r-1)^k)/V^k, exactly the
-            # distribution of drawing k candidates and keeping the best
-            # score. One uniform per parent replaces 2k candidate draws
-            # + 2k score lookups, at k-independent cost. Power-of-two k
-            # uses repeated sqrt; other k the exp/log form.
-            if P is None or P % K == 0:
-                Vf = jnp.float32(K)
-            else:
-                # padded population: the last deme holds V = P - deme·K
-                # < K real rows (pads beyond them, carrying -inf
-                # scores). Ranks 0..V-1 are exactly the real rows — the
-                # pads carry the maximal 0xFFFFFFFF tie key while real
-                # rows' random tie words are shifted into [0, 2^31), so
-                # even a -inf-scored real row sorts strictly before
-                # every pad — and sampling rank < V means a pad row can
-                # never be selected.
-                deme = i * D + d
-                Vf = jnp.maximum(
-                    jnp.minimum(jnp.int32(K), jnp.int32(P) - deme * K), 1
-                ).astype(jnp.float32)
-
-            u_t = uniform((2, K)).T  # (K, 2): one winner draw per parent
-            if sel != "tournament":
-                # Truncation / linear ranking: the SAME inverse-CDF
-                # helper the XLA operators use (ops/select.py), so the
-                # two paths sample provably identical distributions.
-                # The cohort argument for panmictic equivalence applies
-                # identically (see module docstring).
-                from libpga_tpu.ops.select import rank_fraction_icdf
-
-                x = rank_fraction_icdf(sel, sel_param, u_t)
-            elif tk == 1:
-                x = u_t
-            elif tk & (tk - 1) == 0:
-                t = 1.0 - u_t
-                for _ in range(tk.bit_length() - 1):
-                    t = jnp.sqrt(t)
-                x = 1.0 - t
-            else:
-                x = 1.0 - jnp.exp(jnp.log(1.0 - u_t) * jnp.float32(1.0 / tk))
-            # Two-sided clamp: floor can graze V at f32 precision (x·V
-            # rounding up), and linear_rank's x can go fractionally
-            # NEGATIVE at u≈0 if the VPU's sqrt(s²-4(s-1)u) rounds a ulp
-            # above s — wr=-1 would match no rank and breed a zero row.
-            wr = jnp.clip(jnp.floor(x * Vf), 0.0, Vf - 1.0)  # (K, 2) ranks
-
-            # Winner one-hots by rank equality: ranks are distinct
-            # integers 0..K-1 (exact in f32), so each row of the compare
-            # is an exact one-hot over the deme's source rows.
-            oh1 = (R == wr[:, 0:1]).astype(jnp.bfloat16)
-            oh2 = (R == wr[:, 1:2]).astype(jnp.bfloat16)
-
-        # ---- parent rows via one-hot matmul ---------------------------
-        # (named gather_rows, NOT "sel": rebinding the ``sel`` strategy
-        # param here would silently turn every deme after the first back
-        # into a tournament — caught by the hardware truncation check.)
-        if bf16_genes:
-            # bf16 genomes are selected exactly by a single bf16 matmul
-            # (0/1 selector rows; f32 accumulation) — half the FLOPs and
-            # HBM traffic of the f32 hi/lo path.
-            def gather_rows(oh_w):
-                return jnp.dot(oh_w, g, preferred_element_type=jnp.float32)
-
-        else:
-            # f32 genomes: bf16 hi/lo split, ~1e-5 absolute gene accuracy.
-            g_hi = g.astype(jnp.bfloat16)
-            g_lo = (g - g_hi.astype(jnp.float32)).astype(jnp.bfloat16)
-
-            def gather_rows(oh_w):
-                hi = jnp.dot(oh_w, g_hi, preferred_element_type=jnp.float32)
-                lo = jnp.dot(oh_w, g_lo, preferred_element_type=jnp.float32)
-                return hi + lo
-
-        if "no_matmul" in ablate:
-            p1 = p2 = g.astype(jnp.float32)
-        else:
-            p1 = gather_rows(oh1)  # (K, Lp) f32
-            p2 = gather_rows(oh2)
-
-        if "no_cross" in ablate:
-            child = p1
-        elif crossover == "uniform":
-            # ---- uniform crossover: per-gene coin flip (pga.cu:135-143)
-            child = jnp.where(
-                ((mask_words >> d) & jnp.uint32(1)) == 0, p1, p2
-            )
-        elif crossover == "order":
-            # ---- order-preserving crossover (reference TSP driver,
-            # test3/test.cu:48-64): walk gene positions left to right,
-            # take p1's gene if its decoded city is unvisited, else
-            # p2's, else the raw random value. Inherently sequential in
-            # L, but each step is a handful of (Lp, K) VPU ops on
-            # VMEM-resident data — unrolled at trace time, zero HBM
-            # traffic — unlike the XLA scan path whose per-step launch
-            # overhead dominates large populations (ops/crossover.py).
-            # Transposed (gene-major) layout: a step's slice is then a
-            # static SUBLANE row, and the visited set indexes cities on
-            # sublanes.
-            p1t = p1.T  # (Lp, K) f32 — 32-bit transpose is supported
-            p2t = p2.T
-            c1t = jnp.clip(jnp.floor(p1t * L), 0, L - 1).astype(jnp.int32)
-            c2t = jnp.clip(jnp.floor(p2t * L), 0, L - 1).astype(jnp.int32)
-            randt = uniform((Lp, K))
-            sub = lax.broadcasted_iota(jnp.int32, (Lp, K), 0)
-            visited = jnp.zeros((Lp, K), dtype=jnp.bool_)
-            childt = jnp.zeros((Lp, K), dtype=jnp.float32)
-            for l in range(L):
-                g1l, c1l = p1t[l : l + 1, :], c1t[l : l + 1, :]
-                g2l, c2l = p2t[l : l + 1, :], c2t[l : l + 1, :]
-                seen1 = jnp.any(
-                    visited & (sub == c1l), axis=0, keepdims=True
-                )
-                seen2 = jnp.any(
-                    visited & (sub == c2l), axis=0, keepdims=True
-                )
-                take1 = ~seen1
-                take2 = seen1 & ~seen2
-                gene = jnp.where(
-                    take1, g1l, jnp.where(take2, g2l, randt[l : l + 1, :])
-                )
-                mark_city = jnp.where(take1, c1l, c2l)
-                visited = visited | ((sub == mark_city) & (take1 | take2))
-                childt = jnp.where(sub == l, gene, childt)
-            child = childt.T  # (K, Lp); pad columns are 0
-        else:
-            raise ValueError(f"unknown crossover kind {crossover!r}")
-
-        # ---- mutation -------------------------------------------------
-        if "no_mut" in ablate:
-            pass
-        elif mutate == "point":
-            # Point mutation (pga.cu:127-133): one random gene per firing
-            # row.
-            u_t = uniform((4, K)).T  # (K, 4) f32
-            pos = jnp.floor(u_t[:, 0:1] * L).astype(jnp.int32)  # in [0, L)
-            cols = lax.broadcasted_iota(jnp.int32, (K, Lp), 1)
-            # Strict '<' so rate=0 disables mutation exactly (the
-            # reference's ``rand[1] <= chance`` gate, pga.cu:128, differs
-            # only on a measure-zero event for rate in (0,1)).
-            hit = (cols == pos) & (u_t[:, 1:2] < rate)
-            child = jnp.where(hit, u_t[:, 2:3], child)
-        elif mutate == "gaussian":
-            # Per-gene Gaussian perturbation (ops/mutate.gaussian_mutate
-            # semantics): each gene independently fires with probability
-            # ``rate`` and receives N(0, sigma^2) noise, clipped to
-            # [0, 1). Box-Muller from two independent in-kernel uniform
-            # draws; the gate draw is a third stream, so noise sign stays
-            # independent of firing (see the XLA operator's docstring).
-            sigma = mparams_ref[0, 1]
-            gate = uniform((K, Lp))
-            u1 = jnp.clip(uniform((K, Lp)), 1e-7, 1.0 - 1e-7)
-            u2 = uniform((K, Lp))
-            normal = jnp.sqrt(-2.0 * jnp.log(u1)) * jnp.cos(
-                2.0 * jnp.float32(math.pi) * u2
-            )
-            mutated = jnp.clip(child + sigma * normal, 0.0, 1.0 - 1e-7)
-            fire = gate < rate
-            if Lp > L:
-                fire = fire & lane_ok
-            child = jnp.where(fire, mutated, child)
-        elif mutate == "swap":
-            # Swap two random positions with probability ``rate``
-            # (ops/mutate.swap_mutate semantics — permutation GAs).
-            # Scatter-free: two lane one-hots select/exchange the genes.
-            u_t = uniform((4, K)).T  # (K, 4) f32
-            pi = jnp.floor(u_t[:, 0:1] * L).astype(jnp.int32)
-            pj = jnp.floor(u_t[:, 1:2] * L).astype(jnp.int32)
-            fire = u_t[:, 2:3] < rate
-            cols = lax.broadcasted_iota(jnp.int32, (K, Lp), 1)
-            ohi = cols == pi
-            ohj = cols == pj
-            gi = jnp.sum(jnp.where(ohi, child, 0.0), axis=1, keepdims=True)
-            gj = jnp.sum(jnp.where(ohj, child, 0.0), axis=1, keepdims=True)
-            child = jnp.where(ohi & fire, gj, child)
-            child = jnp.where(ohj & fire, gi, child)
-        else:
-            raise ValueError(f"unknown mutate kind {mutate!r}")
+        child = _deme_child(
+            g, R, Vf, uniform, mask_words, d,
+            K=K, L=L, Lp=Lp, tk=tk, sel=sel, sel_param=sel_param,
+            crossover=crossover, mutate=mutate, rate=rate, sigma=sigma,
+            lane_ok=lane_ok, bf16_genes=bf16_genes, ablate=ablate,
+        )
 
         # Write deme d into output column d of the group: the row-major
         # reshape of (K, G/D, D, Lp) interleaves all demes (row index
@@ -522,6 +615,317 @@ def _breed_kernel(
             rest[n_consts + 1][0:1, d : d + 1, :] = child_scores.reshape(
                 1, 1, K
             )
+
+
+def _kernel_ranks(s, tie_bits, v_i32, K, padded=True):
+    """In-deme ranks (1, K) f32 computed INSIDE the kernel from raw
+    scores — the multi-generation kernel's replacement for the caller's
+    ``compute_ranks`` sort (sub-generations 2..T have no HBM round trip
+    where a host-side sort could run).
+
+    Same total order as ``compute_ranks``: descending score; NaN pinned
+    to -inf first; score ties broken by a fresh random word per
+    sub-generation (``tie_bits``), made strictly distinct by splicing
+    the lane index into the word's low 10 bits (K <= 1024 — a bare
+    32-bit tie word collides between some pair of rows every ~2³²/K²
+    draws, and two rows sharing a rank would breed a summed two-row
+    genome); pad lanes (>= ``v_i32``) get keys above every real row's
+    (real keys < 2^30, pads >= 0x7FFFFC00), so rank(pad) >= V always.
+
+    Cost: one (K, K) compare cube + sublane reduce per deme per
+    sub-generation — all VPU, no MXU — versus the host sort's ~0.9 ms
+    per 1M×100 generation plus its HBM score round trip.
+    """
+    import jax.lax as lax
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    lane = lax.broadcasted_iota(jnp.int32, (1, K), 1)
+    # Dead slots (rows >= V) are excluded POSITIONALLY, whatever score
+    # they carry — within a launch the dead rows of the tail deme are
+    # its last K-V rows, exactly as the caller's positional tail mask
+    # declares them between launches (children are exchangeable; each
+    # generation re-picks which K-V die). ``padded`` False (exact-
+    # divisor population, V == K statically) skips both dead-slot
+    # passes.
+    dead = jnp.isnan(s)
+    if padded:
+        dead = dead | (lane >= v_i32)
+    s = jnp.where(dead, -jnp.inf, s)  # (1, K) f32
+    t = pltpu.bitcast(
+        lax.shift_right_logical(tie_bits, jnp.uint32(2)), jnp.int32
+    )
+    t = (t & jnp.int32(-1024)) | lane
+    if padded:
+        t = jnp.where(lane < v_i32, t, jnp.int32(0x7FFFFC00) | lane)
+    # better[i, j]: row i strictly precedes row j in the sort order.
+    # (A select-on-bool where-form won't lower in Mosaic.) The column
+    # reduce runs as a (1,K)@(K,K) matmul — 0/1 bf16 entries sum
+    # exactly in f32 accumulation (K <= 1024 < 2^24) and the MXU does
+    # it in a sliver of its idle time while the VPU owns the cube.
+    better = (s.T > s) | ((s.T == s) & (t.T < t))  # (K, K)
+    return jnp.dot(
+        jnp.ones((1, K), dtype=jnp.bfloat16),
+        better.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _multigen_kernel(
+    seed_ref,
+    mparams_ref,
+    steps_ref,
+    target_ref,
+    scores_ref,
+    genomes_ref,
+    *rest,
+    K,
+    D,
+    L,
+    Lp,
+    tk=2,
+    sel="tournament",
+    sel_param=None,
+    crossover="uniform",
+    mutate="point",
+    obj=None,
+    obj_pad_ok=False,
+    n_consts=0,
+    bf16_genes=False,
+    P=None,
+    elitism=0,
+    ablate=(),
+):
+    """Breed ``steps_ref`` consecutive generations with the deme group
+    resident in VMEM scratch — one HBM read + one HBM write of the
+    population per ``steps`` generations instead of per generation,
+    amortizing the IO+grid floor (~46% of f32 generation time at 1M×100,
+    BASELINE.md ablation) across the whole launch.
+
+    Differences from the one-generation ``_breed_kernel``:
+
+    - ``scores_ref`` carries raw SCORES, not precomputed ranks; each
+      sub-generation ranks its demes in-kernel (``_kernel_ranks``).
+    - ``steps_ref`` (SMEM i32) is a RUNTIME trip count — one compiled
+      kernel serves any chunk size, including the ``n % T`` remainder.
+    - ``target_ref`` (SMEM f32) freezes the whole deme group once its
+      best score reaches the target: a target-satisfying individual
+      bred mid-launch is never bred away (the group stops, other groups
+      continue to their own ``steps``), preserving the run loop's
+      early-termination guarantee at launch granularity. +inf = never.
+    - ``elitism`` is applied PER DEME by ``_deme_child`` every
+      sub-generation (rows 0..e-1 clone the deme's best e). This
+      preserves the global top-e — each global top-j row (j <= e) is in
+      the top-e of its own deme — while keeping G·e elites total
+      instead of e (~0.8% of a 1M population at e=2, K=256).
+    - Demes stay fixed for the whole launch (the riffle reshuffle
+      happens at launch boundaries), so the panmictic mixing horizon
+      grows from 1 to ``steps`` generations — measured equivalence in
+      BASELINE.md covers the shipped default.
+    """
+    import jax.lax as lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    const_refs = rest[:n_consts]
+    g_out = rest[n_consts]
+    s_out = rest[n_consts + 1]
+    g_scr = rest[n_consts + 2]
+    s_scr = rest[n_consts + 3]
+
+    i = pl.program_id(0)
+    pltpu.prng_seed(seed_ref[0, 0] ^ (i * jnp.int32(-1640531527)))
+
+    def uniform(shape):
+        bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+        return pltpu.bitcast(bits >> 8, jnp.int32).astype(
+            jnp.float32
+        ) * jnp.float32(2**-24)
+
+    rate = mparams_ref[0, 0]
+    sigma = mparams_ref[0, 1]
+    tgt = target_ref[0, 0]
+
+    g_scr[:] = genomes_ref[:]
+    s_scr[:] = scores_ref[:]
+
+    lane_ok = None
+    if mutate == "gaussian" and Lp > L:
+        lane_ok = lax.broadcasted_iota(jnp.int32, (K, Lp), 1) < L
+
+    def valid_rows(d):
+        if P is None or P % K == 0:
+            return jnp.int32(K)
+        deme = i * D + d
+        return jnp.maximum(
+            jnp.minimum(jnp.int32(K), jnp.int32(P) - deme * K), 1
+        )
+
+    out_dtype = jnp.bfloat16 if bf16_genes else jnp.float32
+
+    # ``s_scr`` carries every child's TRUE score (the caller applies the
+    # positional dead-row mask after the riffle, same as the
+    # one-generation path); in-kernel, dead tail-deme slots are excluded
+    # positionally inside _kernel_ranks and via this mask for the
+    # target-freeze check.
+    if P is not None and P % K != 0:
+        lane3 = lax.broadcasted_iota(jnp.int32, (1, D, K), 2)
+        deme3 = lax.broadcasted_iota(jnp.int32, (1, D, K), 1) + i * D
+        v3 = jnp.clip(jnp.int32(P) - deme3 * K, 1, jnp.int32(K))
+        alive = lane3 < v3
+    else:
+        alive = None
+
+    def sub_gen(t, carry):
+        del t
+
+        # BRANCHLESS freeze: once the group's best (over alive rows)
+        # reaches the target, every write below becomes a keep-old
+        # select. A (1, 1)-vector predicate instead of a pl.when scalar:
+        # the scalar-condition branch measured ~0.5 ms/gen of pipeline
+        # stall at 1M×100; the vector selects cost ~nothing and also
+        # keep the PRNG stream advance identical whether or not a group
+        # is frozen.
+        if "no_freeze" in ablate:
+            frozen = None
+        else:
+            s_all = s_scr[:]
+            if alive is not None:
+                s_all = jnp.where(alive, s_all, -jnp.inf)
+            frozen = (
+                jnp.max(s_all, axis=(0, 1, 2), keepdims=True) >= tgt
+            ).reshape(1, 1)
+
+        mask_words = None
+        if crossover == "uniform" and "no_cross" not in ablate:
+            mask_words = pltpu.bitcast(
+                pltpu.prng_random_bits((K, Lp)), jnp.uint32
+            )
+        tie_bits = pltpu.bitcast(
+            pltpu.prng_random_bits((D, K)), jnp.uint32
+        )
+        for d in range(D):
+            v = valid_rows(d)
+            g_store = g_scr[d * K : (d + 1) * K, :]  # stored gene dtype
+            if "no_rank_cube" in ablate:
+                # Ablation harness: identity "ranks" — selection
+                # semantics are garbage but the cost shape isolates
+                # the in-kernel rank cube.
+                R = lax.broadcasted_iota(
+                    jnp.int32, (1, K), 1
+                ).astype(jnp.float32)
+            else:
+                R = _kernel_ranks(
+                    s_scr[0:1, d, :], tie_bits[d : d + 1, :], v, K,
+                    padded=P is not None and P % K != 0,
+                )
+            child = _deme_child(
+                g_store, R, v.astype(jnp.float32), uniform, mask_words, d,
+                K=K, L=L, Lp=Lp, tk=tk, sel=sel, sel_param=sel_param,
+                crossover=crossover, mutate=mutate, rate=rate,
+                sigma=sigma, lane_ok=lane_ok, bf16_genes=bf16_genes,
+                elite_rows=elitism, ablate=ablate,
+            )
+            child = child.astype(out_dtype)
+            if frozen is not None:
+                child = jnp.where(frozen, g_store, child)
+            g_scr[d * K : (d + 1) * K, :] = child
+            if bf16_genes:
+                # Score the STORED genes (see _breed_kernel).
+                child = child.astype(jnp.float32)
+            cs = obj(
+                child if obj_pad_ok else child[:, :L],
+                *[r[:] for r in const_refs],
+            ).astype(jnp.float32).reshape(1, 1, K)
+            if frozen is not None:
+                cs = jnp.where(
+                    frozen.reshape(1, 1, 1), s_scr[0:1, d : d + 1, :], cs
+                )
+            s_scr[0:1, d : d + 1, :] = cs
+        return carry
+
+    lax.fori_loop(0, steps_ref[0, 0], sub_gen, jnp.int32(0))
+
+    for d in range(D):
+        g_out[:, 0, d, :] = g_scr[d * K : (d + 1) * K, :]
+    s_out[:] = s_scr[:]
+
+
+def _kernel_shape(
+    pop_size,
+    genome_len,
+    deme_size,
+    tournament_size,
+    selection_kind,
+    selection_param,
+    crossover_kind,
+    mutate_kind,
+    gene_dtype,
+    *,
+    blocks_fit,
+    d_pool,
+    d_default,
+    demes_per_step,
+):
+    """Admission gates + shape resolution shared by the one-generation
+    and multi-generation kernel factories — ONE copy so the two paths
+    can never accept different configurations. Returns
+    ``(K, G, D, Pp, Lp, resolved_selection_param)`` or None to decline:
+
+    - supported gene dtype (f32/bf16), crossover/mutate kind;
+    - order crossover: f32 genes only (bf16 resolution ~0.004 near 1.0
+      corrupts ``floor(g*L)`` city decodes) and ``genome_len <= 256``
+      (the visited-table walk unrolls L trace-time steps; beyond a few
+      hundred the Mosaic program size balloons), and D pinned to 1
+      (D>1 would multiply compile size for no burst-write benefit);
+    - tournament size 1..16 (documented engine contract — selection
+      pressure ~k/(k+1) saturates; rank-space sampling makes the
+      in-kernel cost k-independent, so the cap is contractual);
+    - selection kind/param validated by the ONE resolver the XLA path
+      uses (``ops/select.resolve_selection``) — invalid raises;
+    - deme size via ``_pick_deme_size`` under the caller's VMEM model
+      (``blocks_fit``), demes-per-step from ``d_pool`` capped at
+      ``d_default`` (or the caller's explicit ``demes_per_step``,
+      rounded down to a valid candidate).
+    """
+    if not _supported():
+        return None
+    if gene_dtype not in (jnp.float32, jnp.bfloat16):
+        return None
+    if crossover_kind not in ("uniform", "order"):
+        return None
+    if mutate_kind not in ("point", "gaussian", "swap"):
+        return None
+    if crossover_kind == "order" and (
+        gene_dtype != jnp.float32 or genome_len > 256
+    ):
+        return None
+    if not (1 <= tournament_size <= 16):
+        return None
+    from libpga_tpu.ops.select import resolve_selection
+
+    selection_param = resolve_selection(selection_kind, selection_param)
+    if not deme_size:
+        deme_size = auto_deme_size(gene_dtype)
+    Lp = math.ceil(genome_len / LANE) * LANE
+    gene_bytes = 2 if gene_dtype == jnp.bfloat16 else 4
+    K = _pick_deme_size(
+        pop_size, deme_size, genome_lanes=Lp, gene_bytes=gene_bytes
+    )
+    if K is None or not blocks_fit(K, 1, Lp, gene_bytes):
+        return None
+    G = math.ceil(pop_size / K)
+    d_candidates = [
+        d for d in d_pool
+        if G % d == 0 and blocks_fit(K, d, Lp, gene_bytes)
+    ] or [1]
+    if crossover_kind == "order":
+        D = 1
+    elif demes_per_step:
+        D = next((d for d in d_candidates if d <= demes_per_step), 1)
+    else:
+        D = next((d for d in d_candidates if d <= d_default), 1)
+    return K, G, D, G * K, Lp, selection_param
 
 
 def make_pallas_breed(
@@ -564,84 +968,31 @@ def make_pallas_breed(
     scores, so the padded rows are inert — the caller still sees exactly
     ``(P, L)``. Returns None when unsupported (population under one deme
     tile, an unsupported dtype, or elitism without fused scores)."""
-    if not _supported():
+    shape = _kernel_shape(
+        pop_size, genome_len, deme_size, tournament_size,
+        selection_kind, selection_param, crossover_kind, mutate_kind,
+        gene_dtype,
+        blocks_fit=_blocks_fit,
+        # Demes per grid step: larger groups write D·Lp-contiguous
+        # bursts through the riffle layout (see _breed_kernel) — the
+        # riffle's strided HBM writes are a top non-matmul cost at D=1
+        # (512-byte bursts for f32 at Lp=128). Measured sweet spots at
+        # 1M×100 (tools/sweep_kernel.py, round 3): bf16 peaks at D=4;
+        # f32 keeps gaining through D=16 — its 4-byte rows need bigger
+        # bursts before the riffle's strided writes stop hurting.
+        d_pool=(32, 16, 8, 4, 2, 1),
+        d_default=4 if gene_dtype == jnp.bfloat16 else 16,
+        demes_per_step=_demes_per_step,
+    )
+    if shape is None:
         return None
-    if gene_dtype not in (jnp.float32, jnp.bfloat16):
-        return None
-    if crossover_kind not in ("uniform", "order"):
-        return None
-    if mutate_kind not in ("point", "gaussian", "swap"):
-        return None
-    if crossover_kind == "order" and gene_dtype != jnp.float32:
-        # Permutation genomes decode cities as floor(g*L); bf16 gene
-        # resolution (~0.004 near 1.0) would corrupt decodes wholesale.
-        return None
-    if crossover_kind == "order" and genome_len > 256:
-        # The order crossover unrolls L trace-time steps; beyond a few
-        # hundred the Mosaic program size balloons (only L≈100, the
-        # reference driver's scale, is measured). Longer permutations
-        # fall back to the XLA scan path.
-        return None
-    if not (1 <= tournament_size <= 16):
-        # Documented engine contract (k beyond 16 is a configuration
-        # smell — selection pressure ~k/(k+1) saturates). Rank-space
-        # sampling makes the in-kernel cost k-independent, so the cap is
-        # a contract bound, not a resource one.
-        return None
-    # Selection strategies beyond the reference's single-member enum
-    # (``pga.h:37-42``): each is one inverse-CDF line in rank space.
-    # Defaults/ranges live in ONE place (ops/select.resolve_selection,
-    # shared with the XLA path) so the two paths cannot drift; invalid
-    # kinds/params raise rather than silently falling back.
-    from libpga_tpu.ops.select import resolve_selection
-
-    selection_param = resolve_selection(selection_kind, selection_param)
     if elitism > 0 and fused_obj is None:
         # The epilogue needs next-generation scores; without fused
         # evaluation the caller (engine run loop) applies elitism itself.
         return None
     bf16_genes = gene_dtype == jnp.bfloat16
-    if not deme_size:
-        deme_size = auto_deme_size(gene_dtype)
     P, L = pop_size, genome_len
-    Lp = math.ceil(L / LANE) * LANE
-
-    # Rank-space selection holds one (K, K) rank cube regardless of k,
-    # so the deme size no longer shrinks with tournament size.
-    gene_bytes = 2 if bf16_genes else 4
-    K = _pick_deme_size(P, deme_size, genome_lanes=Lp, gene_bytes=gene_bytes)
-    if K is None:
-        return None
-    G = math.ceil(P / K)
-    Pp = G * K  # padded row count; == P for exact-divisor populations
-    # Demes per grid step: larger groups write D·Lp-contiguous bursts
-    # through the riffle layout (see _breed_kernel) — the riffle's
-    # strided HBM writes are a top non-matmul cost at D=1 (512-byte
-    # bursts for f32 at Lp=128). Candidates must divide G and keep the
-    # whole grid step within the scoped-VMEM model (long genomes that
-    # compile at D=1 must not start failing grouped; K=1024 at D≥2
-    # OOMs the 16 MiB scoped limit — measured).
-    d_candidates = [
-        d for d in (32, 16, 8, 4, 2, 1)
-        if G % d == 0 and _blocks_fit(K, d, Lp, gene_bytes)
-    ] or [1]
-    if crossover_kind == "order":
-        # The order crossover unrolls L trace-time steps per deme; D>1
-        # would multiply compile size for no burst-write benefit (the
-        # permutation path is compute-, not write-bound).
-        D = 1
-    elif _demes_per_step:
-        # round an explicit request down to the largest valid candidate
-        D = next((d for d in d_candidates if d <= _demes_per_step), 1)
-    elif bf16_genes:
-        # Measured sweet spots at 1M×100 (tools/sweep_kernel.py, round
-        # 3): bf16 peaks at D=4 (K=512: 159 gens/sec vs 156-158 at
-        # D∈{2,8}); f32 keeps gaining through D=16 (K=256: 134 vs 133 at
-        # D=8, 124 at D=4) — its 4-byte rows need bigger bursts before
-        # the riffle's strided writes stop hurting.
-        D = next((d for d in d_candidates if d <= 4), 1)
-    else:
-        D = next((d for d in d_candidates if d <= 16), 1)
+    K, G, D, Pp, Lp, selection_param = shape
 
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -828,6 +1179,227 @@ def make_pallas_breed(
     return breed
 
 
+def multigen_default_t(gene_dtype) -> int:
+    """Default sub-generations per launch for ``PGA.run``'s fused loop.
+
+    Measured at 1M×100 OneMax (BASELINE.md round 4): the single-
+    generation kernel's grid pipeline already hides most of the HBM
+    round trip under compute, so T only amortizes the *exposed* sliver
+    — f32 gains +3–6% at T=8–16 (the in-kernel rank cube costs about
+    what the amortization saves), bf16 nothing (its launches are
+    cheaper to begin with). Convergence drag from the T-generation
+    deme-isolation window is unmeasurable at T<=8 (OneMax 131k×100 mean
+    score after 64 gens, K=512: 97.19 at T=1 vs 97.15 at T=8 —
+    tools/selection_equivalence.py table in BASELINE.md), so f32
+    defaults to 8 and bf16 stays on the one-generation kernel.
+    """
+    return 8 if gene_dtype == jnp.float32 else 1
+
+
+def _multigen_blocks_fit(K: int, D: int, Lp: int, gene_bytes: int) -> bool:
+    """VMEM gate for the multi-generation kernel: the single-generation
+    model plus the genome/score scratch and the in-kernel rank cube."""
+    scratch = D * K * Lp * gene_bytes + 4 * D * K
+    return (
+        4 * D * K * Lp * gene_bytes + scratch <= _BLOCK_BYTES_LIMIT
+        and _scoped_vmem_bytes(K, D, Lp, gene_bytes) + scratch + 8 * K * K
+        <= _SCOPED_VMEM_LIMIT
+    )
+
+
+def make_pallas_multigen(
+    pop_size: int,
+    genome_len: int,
+    *,
+    deme_size: Optional[int] = None,
+    tournament_size: int = 2,
+    selection_kind: str = "tournament",
+    selection_param: Optional[float] = None,
+    mutation_rate: float = 0.01,
+    mutation_sigma: float = 0.0,
+    crossover_kind: str = "uniform",
+    mutate_kind: str = "point",
+    elitism: int = 0,
+    fused_obj: Optional[Callable] = None,
+    fused_consts: tuple = (),
+    gene_dtype=jnp.float32,
+    _demes_per_step: Optional[int] = None,
+    _ablate: tuple = (),
+) -> Optional[Callable]:
+    """Build the multi-generation fused breed:
+    ``(genomes (P, L), scores (P,), key, steps[, mparams, target])
+    -> (next_genomes, next_scores)`` breeding ``steps`` (a RUNTIME i32)
+    consecutive generations per kernel launch with the deme group held
+    in VMEM scratch — see ``_multigen_kernel`` for semantics (in-kernel
+    ranking, per-deme elitism, per-group target freeze, launch-boundary
+    riffle).
+
+    Requires a fused objective (sub-generations need in-kernel scores);
+    returns None otherwise or wherever ``make_pallas_breed`` would
+    decline. The same deme-size policy applies; D defaults smaller than
+    the one-generation kernel's because scratch shares the VMEM budget.
+    """
+    if fused_obj is None:
+        return None
+    shape = _kernel_shape(
+        pop_size, genome_len, deme_size, tournament_size,
+        selection_kind, selection_param, crossover_kind, mutate_kind,
+        gene_dtype,
+        blocks_fit=_multigen_blocks_fit,
+        # Scratch shares the VMEM budget, so D caps below the
+        # one-generation kernel's (measured: larger D gains nothing —
+        # the riffle write amortizes /T already).
+        d_pool=(16, 8, 4, 2, 1),
+        d_default=4 if gene_dtype == jnp.bfloat16 else 8,
+        demes_per_step=_demes_per_step,
+    )
+    if shape is None:
+        return None
+    bf16_genes = gene_dtype == jnp.bfloat16
+    P, L = pop_size, genome_len
+    K, G, D, Pp, Lp, selection_param = shape
+    if elitism >= K // 4:
+        # Per-deme elitism at this scale would freeze most of each deme.
+        return None
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    consts = tuple(jnp.atleast_2d(jnp.asarray(c)) for c in fused_consts)
+
+    kernel = partial(
+        _multigen_kernel,
+        K=K, D=D, L=L, Lp=Lp,
+        tk=tournament_size, sel=selection_kind, sel_param=selection_param,
+        crossover=crossover_kind, mutate=mutate_kind,
+        obj=fused_obj,
+        obj_pad_ok=bool(getattr(fused_obj, "pad_ok", False)),
+        n_consts=len(consts), bf16_genes=bf16_genes, P=P,
+        elitism=elitism, ablate=tuple(_ablate),
+    )
+
+    def _const_spec(c):
+        return pl.BlockSpec(c.shape, lambda i: (0,) * c.ndim)
+
+    smem = pltpu.SMEM
+    call = pl.pallas_call(
+        kernel,
+        grid=(G // D,),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=smem),
+            pl.BlockSpec((1, 2), lambda i: (0, 0), memory_space=smem),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=smem),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=smem),
+            pl.BlockSpec((1, D, K), lambda i: (i, 0, 0)),
+            pl.BlockSpec((D * K, Lp), lambda i: (i, 0)),
+        ] + [_const_spec(c) for c in consts],
+        out_specs=[
+            pl.BlockSpec((K, 1, D, Lp), lambda i: (0, i, 0, 0)),
+            pl.BlockSpec((1, D, K), lambda i: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((K, G // D, D, Lp), gene_dtype),
+            jax.ShapeDtypeStruct((G // D, D, K), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((D * K, Lp), gene_dtype),
+            pltpu.VMEM((1, D, K), jnp.float32),
+        ],
+    )
+
+    default_params = jnp.asarray(
+        [[mutation_rate, mutation_sigma]], dtype=jnp.float32
+    )
+
+    def breed_padded(gp, scores, key, steps, mparams=None, target=None):
+        """(Pp, Lp)-padded multi-generation breed. ``steps`` is a
+        runtime i32 (0 = identity); pad rows must carry -inf scores on
+        entry and do on exit. ``target`` freezes a deme group once its
+        best reaches it (None/+inf = never)."""
+        if mparams is None:
+            mparams = default_params
+        if target is None:
+            target = jnp.inf
+        seed = jax.random.randint(
+            key, (1, 1), jnp.iinfo(jnp.int32).min, jnp.iinfo(jnp.int32).max,
+            dtype=jnp.int32,
+        )
+        steps_a = jnp.asarray(steps, dtype=jnp.int32).reshape(1, 1)
+        tgt_a = jnp.asarray(target, dtype=jnp.float32).reshape(1, 1)
+        s_in = scores.astype(jnp.float32).reshape(G // D, D, K)
+        genomes, cs = call(seed, mparams, steps_a, tgt_a, s_in, gp, *consts)
+        s2 = cs.reshape(G, K).T.reshape(Pp)
+        if Pp != P:
+            s2 = jnp.where(jnp.arange(Pp, dtype=jnp.int32) < P, s2, -jnp.inf)
+        return genomes.reshape(Pp, Lp), s2
+
+    def breed(genomes, scores, key, steps, mparams=None, target=None):
+        gp = genomes.astype(gene_dtype)
+        if Lp != L or Pp != P:
+            gp = jnp.pad(gp, ((0, Pp - P), (0, Lp - L)))
+        if Pp != P:
+            scores = jnp.pad(scores, (0, Pp - P), constant_values=-jnp.inf)
+        g2, s2 = breed_padded(gp, scores, key, steps, mparams, target)
+        return g2[:P, :L], s2[:P]
+
+    breed.padded = breed_padded
+    breed.Lp = Lp
+    breed.Pp = Pp
+    breed.K = K
+    breed.D = D
+    breed.fused = True
+    breed.gene_dtype = gene_dtype
+    breed.takes_params = True
+    breed.default_params = default_params
+    breed.elitism = elitism
+    breed.crossover_kind = crossover_kind
+    breed.multigen = True
+    return breed
+
+
+def _multigen_run_loop(obj, bm, pop_size, genome_len, T, donate):
+    """Jitted run loop over the multi-generation breed ``bm``: launches
+    chunks of ``min(T, n - gen)`` sub-generations until ``n`` or the
+    target is reached. Same contract as the one-generation loop; the
+    generation count still lands exactly on ``n`` (the runtime ``steps``
+    input serves the remainder), and a target hit reports at launch
+    granularity (its achiever is preserved by the kernel's group
+    freeze)."""
+    from libpga_tpu.ops.evaluate import evaluate as _evaluate
+
+    P, L, Pp, Lp = pop_size, genome_len, bm.Pp, bm.Lp
+
+    def masked_tail(s):
+        if Pp == P:
+            return s
+        return jnp.where(jnp.arange(Pp, dtype=jnp.int32) < P, s, -jnp.inf)
+
+    def run_loop(genomes, key, n, target, mparams):
+        gp = genomes.astype(bm.gene_dtype)
+        if Lp != L or Pp != P:
+            gp = jnp.pad(gp, ((0, Pp - P), (0, Lp - L)))
+        scores0 = masked_tail(
+            jnp.pad(_evaluate(obj, gp[:P, :L]), (0, Pp - P))
+        )
+
+        def cond(carry):
+            g, s, k, gen = carry
+            return jnp.logical_and(gen < n, jnp.max(s) < target)
+
+        def body(carry):
+            g, s, k, gen = carry
+            k, sub = jax.random.split(k)
+            steps = jnp.minimum(jnp.int32(T), n - gen)
+            g2, s2 = bm.padded(g, s, sub, steps, mparams, target)
+            return (g2, s2, k, gen + steps)
+
+        init = (gp, scores0, key, jnp.int32(0))
+        g, s, k, gens = jax.lax.while_loop(cond, body, init)
+        return g[:P, :L], s[:P], gens
+
+    return jax.jit(run_loop, donate_argnums=(0,) if donate else ())
+
+
 def make_pallas_run(
     obj: Callable,
     *,
@@ -842,6 +1414,7 @@ def make_pallas_run(
     deme_size: Optional[int] = None,
     donate: bool = True,
     gene_dtype=jnp.float32,
+    generations_per_launch: Optional[int] = None,
 ) -> Optional[Callable]:
     """Build a per-shape factory for the fused run loop used by ``PGA.run``:
     ``build(pop_size, genome_len)`` returns a jitted
@@ -850,7 +1423,16 @@ def make_pallas_run(
     the runtime mutation-params input — see ``make_pallas_breed``), or
     None when unsupported (non-TPU backend, tournament size out of the
     kernel's 1..16 range, or per-shape inside the factory) — the engine
-    then falls back to the XLA path."""
+    then falls back to the XLA path.
+
+    ``generations_per_launch`` (T): generations bred per kernel launch.
+    None = auto (``multigen_default_t`` when the objective fuses, else
+    1); 1 = the one-generation kernel. T > 1 uses the multi-generation kernel
+    (``_multigen_kernel``): the HBM IO floor amortizes /T, the target
+    check runs every launch (generations reported in launch-granularity
+    chunks; a mid-launch target hit freezes its deme group so the
+    achieving individual survives to the returned population), and
+    elitism is applied per deme."""
     if not _supported():
         return None
     # The Mosaic kernel only lowers on TPU; an explicit use_pallas=True on
@@ -873,19 +1455,47 @@ def make_pallas_run(
     # ``kernel_rowwise_consts`` and becomes extra kernel inputs.
     fused_obj = getattr(obj, "kernel_rowwise", None)
     fused_consts = tuple(getattr(obj, "kernel_rowwise_consts", ()))
+    T = generations_per_launch
+    if T is None:
+        T = multigen_default_t(gene_dtype) if fused_obj is not None else 1
 
     def build(pop_size: int, genome_len: int):
-        breed = make_pallas_breed(
-            pop_size, genome_len,
+        common = dict(
             deme_size=deme_size, tournament_size=tournament_size,
             selection_kind=selection_kind,
             selection_param=selection_param,
             mutation_rate=mutation_rate,
             mutation_sigma=mutation_sigma,
             crossover_kind=crossover_kind, mutate_kind=mutate_kind,
-            elitism=elitism if fused_obj is not None else 0,
             fused_obj=fused_obj, fused_consts=fused_consts,
             gene_dtype=gene_dtype,
+        )
+        if T > 1:
+            bm = make_pallas_multigen(
+                pop_size, genome_len, elitism=elitism, **common
+            )
+            if bm is not None:
+                return _multigen_run_loop(
+                    obj, bm, pop_size, genome_len, T, donate
+                )
+            if generations_per_launch is not None:
+                # An EXPLICIT T > 1 expresses intent (e.g. a T-sweep
+                # benchmark); degrading to the one-generation kernel
+                # silently would make every sweep point measure T=1.
+                import warnings
+
+                warnings.warn(
+                    f"pallas_generations_per_launch={generations_per_launch}"
+                    " requested but the multi-generation kernel declined"
+                    " (objective not in-kernel fusable, elitism too large"
+                    " for the deme, or VMEM misfit) — falling back to the"
+                    " one-generation kernel",
+                    stacklevel=2,
+                )
+        breed = make_pallas_breed(
+            pop_size, genome_len,
+            elitism=elitism if fused_obj is not None else 0,
+            **common,
         )
         if breed is None:
             return None

@@ -767,3 +767,197 @@ def test_mutation_rate_zero_never_fires():
         genomes = jnp.full((P, L), 0.5, dtype=jnp.float32)
         out = np.asarray(breed(genomes, jnp.zeros((P,)), jax.random.key(0)))
     np.testing.assert_array_equal(out, np.full((P, L), 0.5, dtype=np.float32))
+
+
+# ----------------------------------------------------------- multigen kernel
+
+
+def _sum_obj():
+    """The onemax fused rowwise form + consts, as the engine resolves it."""
+    from libpga_tpu.objectives import get as get_obj
+
+    obj = get_obj("onemax")
+    return obj.kernel_rowwise, tuple(getattr(obj, "kernel_rowwise_consts", ()))
+
+
+def test_multigen_requires_fused_objective():
+    from libpga_tpu.ops.pallas_step import make_pallas_multigen
+
+    assert make_pallas_multigen(512, 16, fused_obj=None) is None
+
+
+def test_multigen_zero_steps_is_a_riffle_permutation():
+    """steps=0 must pass the population through untouched (up to the
+    riffle reshuffle of the output layout): the genome ROW multiset and
+    the aligned scores are preserved exactly."""
+    from libpga_tpu.ops.pallas_step import make_pallas_multigen
+
+    P, L = 512, 20
+    with _interpret():
+        fused, consts = _sum_obj()
+        bm = make_pallas_multigen(
+            P, L, deme_size=128, fused_obj=fused, fused_consts=consts
+        )
+        g = jax.random.uniform(jax.random.key(1), (P, L), dtype=jnp.float32)
+        s = jnp.sum(g, axis=1)
+        g0, s0 = bm(g, s, jax.random.key(0), 0)
+    # scores stay aligned with their genomes...
+    np.testing.assert_allclose(
+        np.asarray(s0), np.asarray(jnp.sum(g0, axis=1)), rtol=1e-5
+    )
+    # ...and the population is the same multiset of rows
+    order_in = np.lexsort(np.asarray(g).T)
+    order_out = np.lexsort(np.asarray(g0).T)
+    np.testing.assert_array_equal(
+        np.asarray(g)[order_in], np.asarray(g0)[order_out]
+    )
+
+
+def test_multigen_runtime_step_count_and_consistency():
+    """The SAME compiled kernel serves different runtime step counts,
+    and returned scores always equal the objective of the returned
+    genomes (evaluation happens in-kernel every sub-generation)."""
+    from libpga_tpu.ops.pallas_step import make_pallas_multigen
+
+    P, L = 512, 20
+    with _interpret():
+        fused, consts = _sum_obj()
+        bm = make_pallas_multigen(
+            P, L, deme_size=128, fused_obj=fused, fused_consts=consts
+        )
+        g = jax.random.uniform(jax.random.key(1), (P, L), dtype=jnp.float32)
+        s = jnp.sum(g, axis=1)
+        stepped = jax.jit(lambda t: bm(g, s, jax.random.key(0), t))
+        for t in (1, 3):
+            gt, st = stepped(jnp.int32(t))
+            np.testing.assert_allclose(
+                np.asarray(st), np.asarray(jnp.sum(gt, axis=1)), rtol=1e-5
+            )
+
+
+def test_multigen_structure_matches_single_gen():
+    """Zero PRNG bits + rank-0 scores: after any number of sub-gens the
+    whole deme collapses onto copies of its original row 0 (every child
+    descends from rank 0 and the fused score follows) — the same
+    structural expectation the one-generation kernel satisfies."""
+    from libpga_tpu.ops.pallas_step import make_pallas_multigen
+
+    P, L, K = 512, 12, 128
+    with _interpret():
+        fused, consts = _sum_obj()
+        bm = make_pallas_multigen(
+            P, L, deme_size=K, mutation_rate=0.0,
+            fused_obj=fused, fused_consts=consts,
+        )
+        genomes = (
+            jnp.broadcast_to(jnp.arange(P, dtype=jnp.float32)[:, None], (P, L))
+            / P
+        )
+        # zero tie-break bits -> ties broken by lane index, so use
+        # strictly-decreasing in-deme scores to pin rank 0 at deme row 0
+        scores = deme_rank0_scores(P, K)
+        g2, s2 = bm(genomes, scores, jax.random.key(0), 2)
+    G = P // K
+    expect = np.asarray([((r % G) * K) / P for r in range(P)], np.float32)
+    np.testing.assert_allclose(np.asarray(g2[:, 0]), expect, rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(s2), np.asarray(jnp.sum(g2, axis=1)), rtol=1e-4
+    )
+
+
+def test_multigen_target_freeze_preserves_achiever():
+    """A launch whose group already satisfies the target must return the
+    population unchanged (modulo the riffle permutation) for ANY step
+    count — the in-kernel freeze."""
+    from libpga_tpu.ops.pallas_step import make_pallas_multigen
+
+    P, L = 512, 20
+    with _interpret():
+        fused, consts = _sum_obj()
+        bm = make_pallas_multigen(
+            P, L, deme_size=128, fused_obj=fused, fused_consts=consts
+        )
+        g = jax.random.uniform(jax.random.key(1), (P, L), dtype=jnp.float32)
+        s = jnp.sum(g, axis=1)
+        gf, sf = bm(g, s, jax.random.key(0), 5, None, float(jnp.max(s)) - 0.5)
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(sf)), np.sort(np.asarray(s))
+    )
+
+
+def test_multigen_per_deme_elitism_preserves_global_top():
+    """elitism=e per deme preserves the global top-e across
+    sub-generations: each global top-j row (j <= e) is within the top-e
+    of its own deme."""
+    from libpga_tpu.ops.pallas_step import make_pallas_multigen
+
+    P, L, e = 512, 20, 2
+    with _interpret():
+        fused, consts = _sum_obj()
+        bm = make_pallas_multigen(
+            P, L, deme_size=128, elitism=e,
+            fused_obj=fused, fused_consts=consts,
+        )
+        g = jax.random.uniform(jax.random.key(1), (P, L), dtype=jnp.float32)
+        s = jnp.sum(g, axis=1)
+        g2, s2 = bm(g, s, jax.random.key(0), 3)
+    top_in = np.sort(np.asarray(s))[-e:]
+    top_out = np.sort(np.asarray(s2))[-e:]
+    assert np.all(top_out >= top_in - 1e-4), (top_in, top_out)
+
+
+def test_multigen_padded_population():
+    """A population with no exact deme divisor pads internally; returned
+    rows are all real children with consistent scores."""
+    from libpga_tpu.ops.pallas_step import make_pallas_multigen
+
+    P, L = 300, 33
+    with _interpret():
+        fused, consts = _sum_obj()
+        bm = make_pallas_multigen(
+            P, L, deme_size=128, fused_obj=fused, fused_consts=consts
+        )
+        assert bm.Pp == 384
+        g = jax.random.uniform(jax.random.key(2), (P, L), dtype=jnp.float32)
+        s = jnp.sum(g, axis=1)
+        g2, s2 = bm(g, s, jax.random.key(0), 3)
+    assert g2.shape == (P, L) and s2.shape == (P,)
+    assert np.all(np.isfinite(np.asarray(s2)))
+    np.testing.assert_allclose(
+        np.asarray(s2), np.asarray(jnp.sum(g2, axis=1)), rtol=1e-4
+    )
+    assert float(jnp.mean(s2)) > float(jnp.mean(s))
+
+
+def test_multigen_run_loop_exact_generation_count():
+    """The chunked run loop lands exactly on n via the runtime remainder
+    (n % T != 0), and the fallback contract (genomes, scores, gens)
+    holds."""
+    from libpga_tpu.ops.pallas_step import make_pallas_run
+    from libpga_tpu.objectives import get as get_obj
+
+    obj = get_obj("onemax")
+    P, L = 512, 20
+    with _interpret():
+        factory = make_pallas_run(obj, generations_per_launch=3)
+        # make_pallas_run requires the TPU backend for the real kernel;
+        # under interpret mode on CPU it declines. Exercise the loop
+        # construction directly instead.
+        from libpga_tpu.ops.pallas_step import (
+            make_pallas_multigen, _multigen_run_loop,
+        )
+
+        bm = make_pallas_multigen(
+            P, L, deme_size=128, fused_obj=obj.kernel_rowwise,
+            fused_consts=tuple(getattr(obj, "kernel_rowwise_consts", ())),
+        )
+        run = _multigen_run_loop(obj, bm, P, L, 3, donate=False)
+        g = jax.random.uniform(jax.random.key(1), (P, L), dtype=jnp.float32)
+        g2, s2, gens = run(
+            g, jax.random.key(0), jnp.int32(10), jnp.float32(jnp.inf),
+            bm.default_params,
+        )
+    assert int(gens) == 10
+    np.testing.assert_allclose(
+        np.asarray(s2), np.asarray(jnp.sum(g2, axis=1)), rtol=1e-4
+    )

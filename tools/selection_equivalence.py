@@ -109,6 +109,62 @@ def onemax_gens(use_pallas, seed, target_frac=0.99, cap=400):
     return pga.run(cap, target=target_frac * 100.0)
 
 
+def multigen_breed(T, K=512):
+    """Multi-generation kernel under the same constant-gene trick: the
+    mean-gene objective is onemax/L-scaled, so in-kernel scores stay
+    order-equivalent to scores_of and selection behaves identically."""
+    from libpga_tpu.objectives import get as get_obj
+    from libpga_tpu.ops.pallas_step import make_pallas_multigen
+
+    obj = get_obj("onemax")
+    bm = make_pallas_multigen(
+        P, L, deme_size=K, mutation_rate=0.0,
+        fused_obj=obj.kernel_rowwise,
+        fused_consts=tuple(getattr(obj, "kernel_rowwise_consts", ())),
+    )
+    assert bm is not None
+
+    def breed(g, s, key):
+        g2, _ = bm(g, s, key, T)
+        return g2
+
+    return breed, T
+
+
+def multigen_takeover(T, seed, cap=200):
+    """Takeover granularity is T generations per launch (demes stay
+    isolated within a launch — the horizon this study quantifies)."""
+    breed, step = multigen_breed(T)
+    g = const_pop(jax.random.key(seed))
+    s = scores_of(g)
+    sd0 = float(jnp.std(s))
+    gen = 0
+    while gen < cap:
+        g = breed(g, s, jax.random.fold_in(jax.random.key(seed + 2000), gen))
+        s = scores_of(g)
+        gen += step
+        if float(jnp.std(s)) < 0.05 * sd0:
+            return gen
+    return cap
+
+
+def multigen_onemax_mean(T, seed, gens=64):
+    """Mean population score after a fixed generation count — the
+    granularity-free convergence measure for the multigen path."""
+    from libpga_tpu import PGA, PGAConfig
+
+    # K pinned to 512 for EVERY column (including the T=1 baseline) so
+    # the comparison isolates the launch count from the deme size.
+    pga = PGA(seed=seed, config=PGAConfig(
+        use_pallas=True, pallas_generations_per_launch=T,
+        pallas_deme_size=512,
+    ))
+    h = pga.create_population(P, 100)
+    pga.set_objective("onemax")
+    pga.run(gens)
+    return float(jnp.mean(pga.population(h).scores))
+
+
 def main():
     assert jax.default_backend() == "tpu", "study needs real kernel entropy"
     rows = []
@@ -144,6 +200,18 @@ def main():
     print(f"\nOneMax 131k×100 generations to 99% optimum: "
           f"panmictic XLA {g_x:.1f}, deme kernel {g_p:.1f} "
           f"(n=3 seeds each).")
+
+    # ---- multigen mixing horizon: demes isolated for T generations ----
+    print("\n| measure (multigen, K=512) | T=1 (1-gen kernel) | T=8 | T=16 | T=32 |")
+    print("|---|---|---|---|---|")
+    tk = [f"{np.mean([takeover(pallas_breed(512, 2), s) for s in range(SEEDS)]):.1f}"]
+    for T in (8, 16, 32):
+        tk.append(f"{np.mean([multigen_takeover(T, s) for s in range(SEEDS)]):.1f}")
+    print("| takeover (gens, granularity T) | " + " | ".join(tk) + " |")
+    om = []
+    for T in (1, 8, 16, 32):
+        om.append(f"{np.mean([multigen_onemax_mean(T, s) for s in range(3)]):.2f}")
+    print("| OneMax mean score after 64 gens | " + " | ".join(om) + " |")
 
 
 if __name__ == "__main__":
