@@ -13,6 +13,10 @@
 #ifndef PGA_MARSHAL_H
 #define PGA_MARSHAL_H
 
+/* '#'-format lengths (e.g. the y# used for expression constants) are
+ * Py_ssize_t; CPython >= 3.12 refuses '#' formats without this. */
+#define PY_SSIZE_T_CLEAN
+
 #include <Python.h>
 
 #include <cstdarg>

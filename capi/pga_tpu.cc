@@ -78,6 +78,21 @@ int pga_set_objective_name(pga_t *p, const char *name) {
         call_long("set_objective_name", "(ls)", solver_of(p), name));
 }
 
+int pga_set_objective_expr(pga_t *p, const char *expr) {
+    if (!p || !expr) return -1;
+    return static_cast<int>(
+        call_long("set_objective_expr", "(ls)", solver_of(p), expr));
+}
+
+int pga_set_objective_expr_const(pga_t *p, const char *name,
+                                 const float *data, unsigned n) {
+    if (!p || !name || (n && !data)) return -1;
+    return static_cast<int>(call_long(
+        "set_objective_expr_const", "(lsy#)", solver_of(p), name,
+        reinterpret_cast<const char *>(data),
+        static_cast<Py_ssize_t>(n * sizeof(float))));
+}
+
 int pga_set_selection(pga_t *p, enum crossover_selection_type type,
                       float param) {
     if (!p) return -1;

@@ -89,6 +89,35 @@ int pga_set_crossover_function(pga_t *p, crossover_f f);
  * on the TPU. Returns 0 on success, -1 on unknown name. */
 int pga_set_objective_name(pga_t *p, const char *name);
 
+/* DEVICE-SPEED custom objective from an expression — the TPU answer to
+ * the reference's __device__ objective pointers (pga.h:59,66): where a
+ * CUDA user writes a device function, a pga_tpu user writes a small
+ * expression over the gene vector, which compiles into the evaluation
+ * path of the fused kernel (children scored in on-chip memory; no host
+ * round trip, unlike pga_set_objective_function's host-pointer path).
+ *
+ * Language: `g` (the genome, length-L vector of floats in [0,1)), `i`
+ * (gene index vector), `L`, literals, `pi`, `e`, registered constants
+ * by name; `+ - * / % **`, comparisons `< <= > >= ==` (0/1-valued),
+ * `where(c,a,b)`; elementwise `sin cos tan tanh exp log sqrt abs floor
+ * round`, `min(a,b)`/`max(a,b)`; reductions `sum(x) mean(x) min(x)
+ * max(x)` and `dot(a,b)`. The expression must reduce to ONE scalar per
+ * genome; higher is better. Examples:
+ *     pga_set_objective_expr(p, "sum(g)");               // OneMax
+ *     pga_set_objective_expr(p, "-sum((g*10.24-5.12)**2)"); // sphere
+ *     pga_set_objective_expr_const(p, "w", weights, L);
+ *     pga_set_objective_expr(p, "where(dot(w, floor(g*2)) <= 100,"
+ *                               " dot(v, floor(g*2)),"
+ *                               " 100 - dot(w, floor(g*2)))");
+ *
+ * Constants (scalar: n == 1; per-gene vector: n == genome_len) must be
+ * registered BEFORE the pga_set_objective_expr call that uses them.
+ * Returns 0, or -1 for any syntax/name/arity/shape error (diagnostic
+ * with a character position on stderr). */
+int pga_set_objective_expr(pga_t *p, const char *expr);
+int pga_set_objective_expr_const(pga_t *p, const char *name,
+                                 const float *data, unsigned n);
+
 /* Result extraction (pga.h:90-93). Return malloc'd gene arrays (caller
  * frees), genome_len genes per row; NULL on error — including a _top
  * `length` larger than the (total) population, since the caller's buffer

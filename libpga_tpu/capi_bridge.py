@@ -14,10 +14,14 @@ addressed by their index inside a solver, mirroring the reference where
 Custom operators through the C ABI: the reference hands CUDA *device*
 function pointers across the API (``include/pga.h:66`` requires callbacks
 be ``__device__``). A TPU has no device function pointers, so the shim
-offers two surfaces:
+offers three surfaces:
 
 - named builtin objectives (``pga_set_objective_name``) — the fast path;
   the whole GA stays on-device;
+- CUSTOM objectives at device speed via the expression surface
+  (``pga_set_objective_expr`` + ``_const`` — ``objectives/expr.py``):
+  the expression compiles to the same rowwise form the builtins use and
+  fuses into the breed kernel, constants riding along as kernel inputs;
 - raw *host* C function pointers with the reference's exact signatures
   (``float (*)(gene*, unsigned)`` etc.) — the compatibility path. The
   engine evaluates them through ``ctypes`` + ``jax.pure_callback``, so
@@ -186,6 +190,7 @@ def deinit(handle: int) -> None:
     _solvers.pop(handle, None)
     _retained.pop(handle, None)
     _host_ops.pop(handle, None)
+    _expr_consts.pop(handle, None)
 
 
 def create_population(handle: int, size: int, genome_len: int, ptype: int) -> int:
@@ -200,6 +205,66 @@ def create_population(handle: int, size: int, genome_len: int, ptype: int) -> in
 def set_objective_name(handle: int, name: str) -> None:
     _solver(handle).set_objective(name)
     _set_host_op(handle, "obj", False)
+
+
+# Named constants registered per solver for expression objectives
+# (pga_set_objective_expr_const): consts first, then the expression that
+# references them.
+_expr_consts: Dict[int, Dict[str, np.ndarray]] = {}
+
+
+def set_objective_expr(handle: int, expr: str) -> None:
+    """Install a DEVICE-SPEED custom objective from an expression
+    (``pga_set_objective_expr``). The expression compiles to the same
+    rowwise form the builtin objectives use — eligible for in-kernel
+    fusion, with registered constants riding along as kernel inputs —
+    so, unlike the host-pointer path (``set_objective_ptr``), the whole
+    solver stays on the accelerator. This is the TPU answer to the
+    reference's ``__device__`` objective pointers (``pga.h:66``).
+    Validation errors raise (→ -1 through the ABI, details on stderr).
+    """
+    from libpga_tpu.objectives import from_expression
+
+    pga = _solver(handle)
+    obj = from_expression(expr, **_expr_consts.get(handle, {}))
+    # Vector constants imply a genome length (they broadcast against the
+    # gene axis); catch a mismatch with the solver's populations HERE,
+    # with a diagnostic, rather than as a raw broadcast error inside the
+    # first jitted evaluate (the header promises shape errors -> -1 at
+    # set time).
+    genome_lens = {p.genome_len for p in pga.populations}
+    for c in obj.kernel_rowwise_consts:
+        n = c.shape[-1]
+        if n > 1 and genome_lens and n not in genome_lens:
+            raise ValueError(
+                f"expression uses a length-{n} vector constant but the "
+                f"solver's population genome length is "
+                f"{sorted(genome_lens)}"
+            )
+    pga.set_objective(obj)
+    _set_host_op(handle, "obj", False)
+
+
+def set_objective_expr_const(handle: int, name: str, data: bytes) -> None:
+    """Register/replace a named constant (raw little-endian float32
+    bytes; one value = scalar, else a length-L vector) for use by a
+    SUBSEQUENT set_objective_expr call on this solver."""
+    from libpga_tpu.objectives.expr import _KEYWORDS
+
+    _solver(handle)  # validate before mutating
+    if not name.isidentifier():
+        raise ValueError(f"constant name {name!r} is not an identifier")
+    if name in _KEYWORDS:
+        # Rejecting here keeps the solver's expression surface usable:
+        # a registered shadow name would fail EVERY later
+        # set_objective_expr on this solver, with no unregister API.
+        raise ValueError(f"constant name {name!r} shadows a builtin name")
+    if not data:
+        raise ValueError(f"constant {name!r} has no values (n == 0)")
+    arr = np.frombuffer(data, dtype=np.float32).copy()
+    if arr.size == 1:
+        arr = arr.reshape(())
+    _expr_consts.setdefault(handle, {})[name] = arr
 
 
 def set_objective_ptr(handle: int, addr: int) -> None:

@@ -12,6 +12,7 @@ All objectives: ``(genome,) -> scalar`` on ``(L,)`` genes in [0,1);
 HIGHER IS BETTER (the engine argmaxes, matching reference ``pga.cu:224``).
 """
 
+from libpga_tpu.objectives.expr import ExpressionError, from_expression
 from libpga_tpu.objectives.classic import (
     onemax,
     onemax_bits,
@@ -61,6 +62,8 @@ __all__ = [
     "register",
     "get",
     "names",
+    "from_expression",
+    "ExpressionError",
     "onemax",
     "onemax_bits",
     "sphere",

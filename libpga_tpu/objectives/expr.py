@@ -1,0 +1,428 @@
+"""Device-speed custom objectives from a small expression language.
+
+The reference's central extension point is a user-supplied objective
+running AT DEVICE SPEED — a ``__device__`` function pointer installed via
+``pga_set_objective_function`` (``/root/reference/include/pga.h:59,66``,
+install idiom ``src/pga.cu:157-161``) and compiled into the evaluation
+kernel. A host-language function pointer can't cross into a TPU program,
+so the C ABI's raw-pointer path runs objectives on the HOST (batched,
+but CPU-bound — ``capi_bridge.py``). This module closes that gap the
+TPU-native way: the C (or Python) user supplies a small EXPRESSION over
+the gene vector, which compiles to the same rowwise batched form the
+builtin objectives use — eligible for in-kernel fusion, so a custom
+objective scores children while they are still in VMEM, exactly like a
+builtin.
+
+The language (safe, no ``eval``; a ~100-line recursive-descent parser):
+
+- ``g`` — the genome, a vector of ``L`` genes in [0, 1)
+- ``i`` — the gene index vector ``0..L-1``; ``L`` — the genome length
+- literals (``1.5``, ``2e-3``), ``pi``, ``e``
+- named constants registered alongside the expression (scalars or
+  length-``L`` vectors, broadcast elementwise)
+- arithmetic ``+ - * / % **``, unary ``-``, parentheses
+- comparisons ``< <= > >= ==`` (0/1-valued), ``where(c, a, b)``
+- elementwise ``sin cos tan tanh exp log sqrt abs floor round``,
+  two-argument ``min(a, b)`` / ``max(a, b)``
+- reductions ``sum(x) mean(x) min(x) max(x)`` (one-argument min/max
+  reduce), ``dot(a, b)`` = ``sum(a*b)``
+
+The top-level expression must reduce to one scalar per genome. Higher
+is better, as everywhere in the library.
+
+Examples::
+
+    from_expression("sum(g)")                          # OneMax
+    from_expression("-sum((g*10.24-5.12)**2)")         # sphere
+    from_expression("dot(v, g >= 0.5)", v=values)      # 0/1 knapsack value
+    from_expression(
+        "where(dot(w, floor(g*2)) <= cap,"
+        " dot(v, floor(g*2)), cap - dot(w, floor(g*2)))",
+        w=weights, v=values, cap=100.0)                # reference test2
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ExpressionError(ValueError):
+    """Raised for any syntax, name, arity, or shape error — with a
+    position and a human-readable explanation, so the C ABI can return
+    -1 and print something actionable."""
+
+
+_ELEMENTWISE = {
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan, "tanh": jnp.tanh,
+    "exp": jnp.exp, "log": jnp.log, "sqrt": jnp.sqrt, "abs": jnp.abs,
+    "floor": jnp.floor, "round": jnp.round,
+}
+_CONSTANTS = {"pi": math.pi, "e": math.e}
+_KEYWORDS = (
+    ["g", "i", "L", "where", "dot", "sum", "mean", "min", "max"]
+    + list(_ELEMENTWISE) + list(_CONSTANTS)
+)
+
+
+# ------------------------------------------------------------------ lexer
+
+_TWO_CHAR = ("**", "<=", ">=", "==")
+_ONE_CHAR = "+-*/%(),<>"
+
+
+def _tokenize(src: str) -> List[Tuple[str, str, int]]:
+    """(kind, text, pos) tokens; kinds: num, name, op, end."""
+    out = []
+    n, k = len(src), 0
+    while k < n:
+        c = src[k]
+        if c.isspace():
+            k += 1
+            continue
+        if src[k : k + 2] in _TWO_CHAR:
+            out.append(("op", src[k : k + 2], k))
+            k += 2
+            continue
+        if c in _ONE_CHAR:
+            out.append(("op", c, k))
+            k += 1
+            continue
+        if c.isdigit() or c == ".":
+            j = k
+            while j < n and (src[j].isdigit() or src[j] == "."):
+                j += 1
+            if j < n and src[j] in "eE":
+                j += 1
+                if j < n and src[j] in "+-":
+                    j += 1
+                while j < n and src[j].isdigit():
+                    j += 1
+            try:
+                float(src[k:j])
+            except ValueError:
+                raise ExpressionError(
+                    f"bad number {src[k:j]!r} at position {k}"
+                ) from None
+            out.append(("num", src[k:j], k))
+            k = j
+            continue
+        if c.isalpha() or c == "_":
+            j = k
+            while j < n and (src[j].isalnum() or src[j] == "_"):
+                j += 1
+            out.append(("name", src[k:j], k))
+            k = j
+            continue
+        raise ExpressionError(f"unexpected character {c!r} at position {k}")
+    out.append(("end", "", n))
+    return out
+
+
+# ------------------------------------------------------------------ parser
+#
+# AST nodes are tuples: ("num", x), ("var", name), ("const", name),
+# ("un", op, a), ("bin", op, a, b), ("call", fname, [args]).
+
+
+class _Parser:
+    def __init__(self, src: str, const_names):
+        self.src = src
+        self.toks = _tokenize(src)
+        self.k = 0
+        self.const_names = const_names
+
+    def peek(self):
+        return self.toks[self.k]
+
+    def next(self):
+        t = self.toks[self.k]
+        self.k += 1
+        return t
+
+    def expect(self, text):
+        kind, tok, pos = self.next()
+        if tok != text:
+            raise ExpressionError(
+                f"expected {text!r} at position {pos}, got {tok or 'end'!r}"
+            )
+
+    def parse(self):
+        node = self.comparison()
+        kind, tok, pos = self.peek()
+        if kind != "end":
+            raise ExpressionError(
+                f"unexpected {tok!r} at position {pos}"
+            )
+        return node
+
+    def comparison(self):
+        node = self.addsub()
+        kind, tok, _ = self.peek()
+        if tok in ("<", "<=", ">", ">=", "=="):
+            self.next()
+            node = ("bin", tok, node, self.addsub())
+        return node
+
+    def addsub(self):
+        node = self.muldiv()
+        while self.peek()[1] in ("+", "-"):
+            op = self.next()[1]
+            node = ("bin", op, node, self.muldiv())
+        return node
+
+    def muldiv(self):
+        node = self.unary()
+        while self.peek()[1] in ("*", "/", "%"):
+            op = self.next()[1]
+            node = ("bin", op, node, self.unary())
+        return node
+
+    def unary(self):
+        kind, tok, _ = self.peek()
+        if tok in ("+", "-"):
+            self.next()
+            return ("un", tok, self.unary())
+        return self.power()
+
+    def power(self):
+        node = self.atom()
+        if self.peek()[1] == "**":
+            self.next()
+            node = ("bin", "**", node, self.unary())  # right-assoc
+        return node
+
+    def atom(self):
+        kind, tok, pos = self.next()
+        if kind == "num":
+            return ("num", float(tok))
+        if tok == "(":
+            node = self.comparison()
+            self.expect(")")
+            return node
+        if kind == "name":
+            if self.peek()[1] == "(":
+                self.next()
+                args = [self.comparison()]
+                while self.peek()[1] == ",":
+                    self.next()
+                    args.append(self.comparison())
+                self.expect(")")
+                return self._call(tok, args, pos)
+            if tok in ("g", "i", "L"):
+                return ("var", tok)
+            if tok in _CONSTANTS:
+                return ("num", _CONSTANTS[tok])
+            if tok in self.const_names:
+                return ("const", tok)
+            raise ExpressionError(
+                f"unknown name {tok!r} at position {pos}; available: g, i, "
+                f"L, pi, e" + (
+                    f", constants {sorted(self.const_names)}"
+                    if self.const_names else
+                    " (no constants registered)"
+                )
+            )
+        raise ExpressionError(
+            f"unexpected {tok or 'end of expression'!r} at position {pos}"
+        )
+
+    def _call(self, fname, args, pos):
+        def need(n):
+            if len(args) != n:
+                raise ExpressionError(
+                    f"{fname}() takes {n} argument(s), got {len(args)} "
+                    f"at position {pos}"
+                )
+
+        if fname in _ELEMENTWISE:
+            need(1)
+        elif fname == "where":
+            need(3)
+        elif fname == "dot":
+            need(2)
+        elif fname in ("sum", "mean"):
+            need(1)
+        elif fname in ("min", "max"):
+            if len(args) not in (1, 2):
+                raise ExpressionError(
+                    f"{fname}() takes 1 (reduction) or 2 (elementwise) "
+                    f"arguments, got {len(args)} at position {pos}"
+                )
+        else:
+            raise ExpressionError(
+                f"unknown function {fname!r} at position {pos}; available: "
+                f"{sorted(set(_ELEMENTWISE) | {'sum', 'mean', 'min', 'max', 'where', 'dot'})}"
+            )
+        return ("call", fname, args)
+
+
+# --------------------------------------------------------------- compiler
+
+
+def _emit(node, env) -> jax.Array:
+    """Evaluate the AST over a (P, L) gene block ``env['g']``.
+    Elementwise values carry shape (P, L) (or broadcastable); reductions
+    keep a size-1 gene axis so everything composes by broadcasting.
+    Every op class here (including %, ** with array exponents, tan,
+    round — which no builtin objective uses) is verified to lower
+    through Mosaic inside the fused breed kernel on real TPU:
+    ``tools/tpu_kernel_checks.py`` runs the sweep."""
+    kind = node[0]
+    if kind == "num":
+        return jnp.float32(node[1])
+    if kind == "var":
+        return env[node[1]]
+    if kind == "const":
+        return env["consts"][node[1]]
+    if kind == "un":
+        v = _emit(node[2], env)
+        return -v if node[1] == "-" else v
+    if kind == "bin":
+        op, a, b = node[1], _emit(node[2], env), _emit(node[3], env)
+        if op == "+":
+            return a + b
+        if op == "-":
+            return a - b
+        if op == "*":
+            return a * b
+        if op == "/":
+            return a / b
+        if op == "%":
+            return a % b
+        if op == "**":
+            return a ** b
+        cmp = {"<": jnp.less, "<=": jnp.less_equal, ">": jnp.greater,
+               ">=": jnp.greater_equal, "==": jnp.equal}[op]
+        return cmp(a, b).astype(jnp.float32)
+    fname, args = node[1], node[2]
+    vals = [_emit(a, env) for a in args]
+    if fname in _ELEMENTWISE:
+        return _ELEMENTWISE[fname](vals[0])
+    if fname == "where":
+        return jnp.where(vals[0] != 0.0, vals[1], vals[2])
+    # Reductions keep the gene axis as a size-1 dim so reduced values
+    # compose with everything else by broadcasting — scalars/consts are
+    # (1, 1), elementwise values (P, L), reductions (P, 1); the
+    # top-level squeeze in ``rows`` produces the final (P,).
+    if fname == "dot":
+        return jnp.sum(
+            jnp.broadcast_to(vals[0] * vals[1], env["g"].shape),
+            axis=1, keepdims=True,
+        )
+    reducers = {"sum": jnp.sum, "mean": jnp.mean,
+                "min": jnp.min, "max": jnp.max}
+    if fname in ("min", "max") and len(vals) == 2:
+        return (jnp.minimum if fname == "min" else jnp.maximum)(*vals)
+    v = jnp.broadcast_to(vals[0], env["g"].shape)
+    return reducers[fname](v, axis=1, keepdims=True)
+
+
+def from_expression(expr: str, **consts) -> Callable:
+    """Compile an objective expression to the library's standard
+    objective protocol: a per-genome callable whose ``kernel_rowwise``
+    batched form fuses into the Pallas breed kernel (children scored
+    in VMEM — device speed, no host callback), with any named constants
+    riding along as kernel inputs (``kernel_rowwise_consts``), exactly
+    like the builtin fusable objectives.
+
+    ``consts``: scalars or 1-D float arrays (broadcast elementwise
+    against the genome; a length-L vector pairs with each gene).
+    Raises :class:`ExpressionError` with a position and an explanation
+    for any syntax/name/arity problem, and for expressions that do not
+    reduce to one scalar per genome.
+    """
+    const_vals: Dict[str, np.ndarray] = {}
+    for name, v in consts.items():
+        if name in _KEYWORDS:
+            raise ExpressionError(
+                f"constant name {name!r} shadows a builtin name"
+            )
+        arr = np.asarray(v, dtype=np.float32)
+        if arr.ndim > 1:
+            raise ExpressionError(
+                f"constant {name!r} must be a scalar or 1-D vector, "
+                f"got shape {arr.shape}"
+            )
+        const_vals[name] = arr
+
+    ast = _Parser(expr, set(const_vals)).parse()
+    # Keep only the constants the expression references: the C ABI
+    # registers constants per solver across successive expressions, so
+    # unused ones must not become dead kernel inputs, pin the probe
+    # length, or trip the vector-length check below.
+    used: set = set()
+
+    def _walk(node):
+        if node[0] == "const":
+            used.add(node[1])
+        elif node[0] == "un":
+            _walk(node[2])
+        elif node[0] == "bin":
+            _walk(node[2])
+            _walk(node[3])
+        elif node[0] == "call":
+            for a in node[2]:
+                _walk(a)
+
+    _walk(ast)
+    const_vals = {n: a for n, a in const_vals.items() if n in used}
+    const_names = sorted(const_vals)
+    defaults = tuple(
+        jnp.atleast_2d(jnp.asarray(const_vals[n])) for n in const_names
+    )
+
+    def rows(m, *cargs):
+        cargs = cargs or defaults
+        env = {
+            "g": m,
+            "i": jax.lax.broadcasted_iota(jnp.int32, m.shape, 1).astype(
+                jnp.float32
+            ),
+            "L": jnp.float32(m.shape[1]),
+            # kernel consts arrive atleast_2d'd ((1, n) / (1, 1)) — the
+            # row orientation broadcasts against (P, L) directly
+            "consts": dict(zip(const_names, cargs)),
+        }
+        out = _emit(ast, env)
+        if out.ndim == 2 and out.shape[-1] == 1:
+            out = out[:, 0]
+        elif out.ndim == 2:
+            raise ExpressionError(
+                "expression must reduce to one scalar per genome — wrap "
+                "it in sum()/mean()/min()/max()"
+            )
+        return jnp.broadcast_to(out, (m.shape[0],)).astype(jnp.float32)
+
+    # Validate eagerly: shape/arity/broadcast errors surface at
+    # registration (→ -1 through the C ABI), not at first run. The
+    # probe genome length follows the vector constants (they broadcast
+    # against the gene axis, so any length-n constant implies L == n);
+    # inconsistent vector lengths are their own registration error.
+    vec_lens = {a.shape[0] for a in const_vals.values() if a.ndim == 1}
+    if len(vec_lens) > 1:
+        raise ExpressionError(
+            f"vector constants disagree on genome length: {sorted(vec_lens)}"
+        )
+    probe_len = vec_lens.pop() if vec_lens else 8
+    try:
+        probe = jax.eval_shape(
+            rows, jax.ShapeDtypeStruct((2, probe_len), jnp.float32)
+        )
+    except ExpressionError:
+        raise
+    except Exception as exc:  # noqa: BLE001 — rewrap with the source expr
+        raise ExpressionError(f"invalid expression {expr!r}: {exc}") from exc
+    del probe
+
+    rows.pad_ok = False  # e.g. cos(0) != 0: pad lanes would pollute
+    per_genome = lambda genome: rows(genome[None, :])[0]  # noqa: E731
+    per_genome.kernel_rowwise = rows
+    per_genome.kernel_rowwise_consts = defaults
+    per_genome.expression = expr
+    per_genome.__doc__ = f"Expression objective: {expr}"
+    return per_genome

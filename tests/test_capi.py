@@ -107,6 +107,44 @@ def test_capi_selection_strategies(built_shim):
     assert "linear_rank best sum" in out
 
 
+def test_capi_expression_objective(built_shim):
+    """pga_set_objective_expr: a vector-constant weighted objective and
+    a sphere-style expression both drive the GA from C, and every
+    malformed expression returns -1 without corrupting the solver
+    (device-speed custom objectives — the reference's __device__
+    pointer surface, pga.h:66, done the TPU way)."""
+    out = _run(built_shim, "test_expr_obj")
+    assert "weighted onemax" in out
+    assert "sphere residual" in out
+
+
+def test_capi_expression_objective_stays_on_device(built_shim):
+    """Unlike the host-pointer path, an expression objective must NOT
+    pin the solver to the CPU backend, and must expose the fusable
+    rowwise form the Pallas kernel consumes."""
+    import numpy as np
+
+    from libpga_tpu import capi_bridge as cb
+
+    h = cb.init(5)
+    try:
+        cb.create_population(h, 256, 16, 0)
+        cb.set_objective_expr_const(
+            h, "w", np.arange(16, dtype=np.float32).tobytes()
+        )
+        cb.set_objective_expr(h, "dot(w, g)")
+        pga = cb._solver(h)
+        assert not cb._host_ops.get(h), "expr objective pinned solver to CPU"
+        assert pga.config.use_pallas is None  # auto (accelerator) stays
+        assert getattr(pga._objective, "kernel_rowwise", None) is not None
+        assert len(pga._objective.kernel_rowwise_consts) == 1
+        # and it actually evaluates
+        cb.evaluate(h, 0)
+        assert np.isfinite(float(pga.populations[0].scores.max()))
+    finally:
+        cb.deinit(h)
+
+
 def test_rowloop_batched_marshaling_speedup_and_parity(built_shim, tmp_path):
     """Host-callback marshaling must loop over rows in C, not Python:
     one Python<->C crossing per generation (round-2 verdict finding).

@@ -146,3 +146,111 @@ def test_kernel_rowwise_forms_match_per_genome(key):
         if consts:
             c = np.asarray(rows(g, *(jnp.asarray(x) for x in consts)))
             np.testing.assert_allclose(b, c, atol=0, rtol=0)
+
+
+# --------------------------------------------------- expression objectives
+
+
+class TestExpressionObjectives:
+    def test_arithmetic_matches_numpy(self):
+        from libpga_tpu.objectives import from_expression
+
+        g = np.random.default_rng(0).random((5, 12)).astype(np.float32)
+        cases = [
+            ("sum(g)", g.sum(axis=1)),
+            ("mean(g * g)", (g * g).mean(axis=1)),
+            ("-sum((g*10.24 - 5.12)**2)", -((g * 10.24 - 5.12) ** 2).sum(axis=1)),
+            ("max(g) - min(g)", g.max(axis=1) - g.min(axis=1)),
+            ("sum(min(g, 1 - g))", np.minimum(g, 1 - g).sum(axis=1)),
+            ("sum(where(g >= 0.5, 1, 0))", (g >= 0.5).sum(axis=1)),
+            ("sum(cos(2*pi*g))", np.cos(2 * np.pi * g).sum(axis=1)),
+            ("sum(g % 0.25)", (g % 0.25).sum(axis=1)),
+            ("sum(i * g) / L", (np.arange(12) * g).sum(axis=1) / 12.0),
+            ("-(2**3) + sum(g)*0", np.full(5, -8.0)),
+        ]
+        for expr, want in cases:
+            got = np.asarray(from_expression(expr).kernel_rowwise(jnp.asarray(g)))
+            np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-5, err_msg=expr)
+
+    def test_unary_minus_and_power_precedence(self):
+        from libpga_tpu.objectives import from_expression
+
+        g = np.full((1, 4), 0.5, np.float32)
+        # -x**2 must parse as -(x**2), like Python
+        got = float(from_expression("-sum(g)**2 + 0*sum(g)").kernel_rowwise(jnp.asarray(g))[0])
+        assert got == -4.0
+
+    def test_constants_scalar_and_vector(self):
+        from libpga_tpu.objectives import from_expression
+
+        g = np.random.default_rng(1).random((3, 6)).astype(np.float32)
+        w = np.arange(6, dtype=np.float32)
+        f = from_expression("dot(w, g) + c", w=w, c=2.0)
+        got = np.asarray(f.kernel_rowwise(jnp.asarray(g)))
+        np.testing.assert_allclose(got, (w * g).sum(axis=1) + 2.0, rtol=1e-5)
+        # consts ride along as kernel inputs
+        assert len(f.kernel_rowwise_consts) == 2
+
+    def test_per_genome_form_matches_rowwise(self):
+        from libpga_tpu.objectives import from_expression
+
+        f = from_expression("sum(g*g)")
+        g = np.random.default_rng(2).random(9).astype(np.float32)
+        assert np.isclose(float(f(jnp.asarray(g))), float((g * g).sum()), rtol=1e-5)
+
+    def test_errors(self):
+        from libpga_tpu.objectives import ExpressionError, from_expression
+
+        for bad in ("sum(", "sum(q)", "g * 2", "frobnicate(g)",
+                    "sum(g,)", "where(g)", "1 ++", "sum(g) @ 2"):
+            with pytest.raises(ExpressionError):
+                from_expression(bad)
+        with pytest.raises(ExpressionError):
+            from_expression("dot(v, g)", v=np.ones((2, 2)))  # 2-D const
+        with pytest.raises(ExpressionError):
+            from_expression("sum(g) + sum", )  # name used as value
+        with pytest.raises(ExpressionError):
+            from_expression("dot(a, g) + dot(b, g)",
+                            a=np.ones(3), b=np.ones(5))  # length clash
+        with pytest.raises(ExpressionError):
+            from_expression("sum(g)", where=np.ones(3))  # keyword shadow
+
+    def test_engine_integration_and_vector_const_length(self):
+        """An expression objective drives PGA end-to-end, and a vector
+        constant fixes the probe genome length (docstring example)."""
+        from libpga_tpu import PGA
+        from libpga_tpu.objectives import from_expression
+
+        L = 20
+        w = np.linspace(1.0, 2.0, L).astype(np.float32)
+        pga = PGA(seed=0)
+        h = pga.create_population(256, L)
+        pga.set_objective(from_expression("dot(w, g)", w=w))
+        pga.run(25)
+        _, best = pga.get_best_with_score(h)
+        assert best > 0.8 * w.sum(), best
+
+    def test_fuses_into_pallas_kernel(self):
+        """The compiled rowwise form lowers inside the breed kernel
+        (interpret mode), consts arriving as kernel inputs."""
+        from jax.experimental.pallas import tpu as pltpu
+
+        from libpga_tpu.objectives import from_expression
+        from libpga_tpu.ops.pallas_step import make_pallas_breed
+
+        L = 16
+        w = np.linspace(0.5, 1.5, L).astype(np.float32)
+        f = from_expression("dot(w, g)", w=w)
+        g = np.random.default_rng(3).random((256, L)).astype(np.float32)
+        s = (w * g).sum(axis=1)
+        with pltpu.force_tpu_interpret_mode():
+            breed = make_pallas_breed(
+                256, L, deme_size=128,
+                fused_obj=f.kernel_rowwise,
+                fused_consts=f.kernel_rowwise_consts,
+            )
+            g2, s2 = breed(jnp.asarray(g), jnp.asarray(s), jax.random.key(0))
+        np.testing.assert_allclose(
+            np.asarray(s2), (w * np.asarray(g2)).sum(axis=1),
+            rtol=1e-4, atol=1e-4,
+        )

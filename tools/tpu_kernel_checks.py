@@ -256,6 +256,39 @@ def main() -> int:
     _, best = pga.get_best_with_score(h)
     good &= check(f"OneMax convergence (best {best:.1f}/100)", best > 99.0)
 
+    # Every expression-language op class must LOWER through Mosaic when
+    # fused into the breed kernel (interpret-mode tests can't prove
+    # this; %, ** with array exponents, tan, and round appear in no
+    # builtin objective). One run per expression, real hardware.
+    from libpga_tpu.engine import _XLA_FALLBACK
+    from libpga_tpu.objectives import from_expression
+
+    lowered = True
+    for e in (
+        "sum(g % 0.25)",
+        "sum(g ** g)",
+        "sum(tan(g) * 0.001) + sum(round(g))",
+        "mean(tanh(g)) + min(g) - max(g) + sum(abs(g - 0.5))",
+        "sum(exp(-(g*2)) + log(g + 1) + sqrt(g) + sin(g) + cos(g))",
+        "dot(g, i) / (1 + mean(g)) + where(sum(g) >= L/2, 1, 0)",
+    ):
+        try:
+            solver = PGA(seed=0, config=PGAConfig(use_pallas=True))
+            solver.create_population(512, 32)
+            solver.set_objective(from_expression(e))
+            solver.run(2)
+            entry = [
+                v for k, v in solver._compiled.items() if k[0] == "runP"
+            ]
+            fused = bool(entry) and entry[0] is not _XLA_FALLBACK
+            if not fused:
+                print(f"  NOT FUSED: {e}")
+                lowered = False
+        except Exception as exc:  # noqa: BLE001
+            print(f"  LOWERING FAILED: {e}: {exc}")
+            lowered = False
+    good &= check("expression ops lower fused through Mosaic", lowered)
+
     print("ALL PASS" if good else "FAILURES", flush=True)
     return 0 if good else 1
 
