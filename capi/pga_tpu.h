@@ -106,6 +106,7 @@ int pga_set_objective_name(pga_t *p, const char *name);
  *     pga_set_objective_expr(p, "sum(g)");               // OneMax
  *     pga_set_objective_expr(p, "-sum((g*10.24-5.12)**2)"); // sphere
  *     pga_set_objective_expr_const(p, "w", weights, L);
+ *     pga_set_objective_expr_const(p, "v", values, L);
  *     pga_set_objective_expr(p, "where(dot(w, floor(g*2)) <= 100,"
  *                               " dot(v, floor(g*2)),"
  *                               " 100 - dot(w, floor(g*2)))");

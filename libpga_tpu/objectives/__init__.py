@@ -22,6 +22,8 @@ from libpga_tpu.objectives.classic import (
     make_knapsack,
     default_knapsack,
     make_tsp,
+    make_tsp_coords,
+    random_tsp_coords,
     random_tsp_matrix,
     make_nk_landscape,
     make_deceptive_trap,
@@ -72,6 +74,8 @@ __all__ = [
     "make_knapsack",
     "default_knapsack",
     "make_tsp",
+    "make_tsp_coords",
+    "random_tsp_coords",
     "random_tsp_matrix",
     "make_nk_landscape",
     "make_deceptive_trap",

@@ -102,10 +102,17 @@ _SCOPED_VMEM_LIMIT = 14_500_000  # of the 16 MiB scoped stack; f32 K=1024
 _BLOCK_BYTES_LIMIT = 8_650_000
 
 
-def _blocks_fit(K: int, D: int, Lp: int, gene_bytes: int) -> bool:
+def _blocks_fit(
+    K: int, D: int, Lp: int, gene_bytes: int, extra_scoped: int = 0
+) -> bool:
+    """``extra_scoped``: additional scoped-VMEM bytes the kernel variant
+    carries (e.g. the order-crossover walk's scratch planes) — counted
+    against the SAME budget as the base model, so every admission path
+    (deme pick, D-candidate scan) sees the true total."""
     return (
         4 * D * K * Lp * gene_bytes <= _BLOCK_BYTES_LIMIT
-        and _scoped_vmem_bytes(K, D, Lp, gene_bytes) <= _SCOPED_VMEM_LIMIT
+        and _scoped_vmem_bytes(K, D, Lp, gene_bytes) + extra_scoped
+        <= _SCOPED_VMEM_LIMIT
     )
 
 
@@ -114,6 +121,7 @@ def _pick_deme_size(
     preferred: int,
     genome_lanes: int = LANE,
     gene_bytes: int = 4,
+    fits=None,
 ):
     """Deme size for a population: exact divisors first (zero padding),
     then a padded fit — the kernel pads the population up to the next
@@ -134,9 +142,16 @@ def _pick_deme_size(
     measured 27% slower than K=256's 192 pad rows) and the caller's
     configured size, then the larger deme, is preferred; beyond that
     the least-waste fit wins. None (→ XLA path) for populations under
-    one 128-row tile or with only degenerate-tail fits."""
-    def fits(k: int) -> bool:
-        return _blocks_fit(k, 1, genome_lanes, gene_bytes)
+    one 128-row tile or with only degenerate-tail fits.
+
+    ``fits``: the caller's VMEM admission predicate ``fits(k) -> bool``
+    (default: the one-generation model at D=1). Callers with extra
+    per-kernel VMEM (multigen scratch, order-walk planes) pass their own
+    so the deme pick retries SMALLER sizes when the extras don't fit at
+    the preferred one."""
+    if fits is None:
+        def fits(k: int) -> bool:
+            return _blocks_fit(k, 1, genome_lanes, gene_bytes)
 
     if _valid_deme(preferred) and fits(preferred) and pop_size % preferred == 0:
         return preferred
@@ -185,6 +200,25 @@ def _carry_elites(g_prev, s_prev, g2, s2, elitism: int):
     return g2, s2
 
 
+def _order_scratch_shapes(K: int, L: int, Lp: int):
+    """VMEM scratch for the order-crossover walk (see _deme_child): two
+    gene-major parent planes, their city-decode planes, the gene-major
+    child (prefilled with the random-fallback genes), and the
+    visited-city bitmask (ceil(L/32) i32 words per column,
+    sublane-padded to 8)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    Wp = max(8, math.ceil(math.ceil(L / 32) / 8) * 8)
+    return [
+        pltpu.VMEM((Lp, K), jnp.float32),
+        pltpu.VMEM((Lp, K), jnp.float32),
+        pltpu.VMEM((Lp, K), jnp.int32),
+        pltpu.VMEM((Lp, K), jnp.int32),
+        pltpu.VMEM((Lp, K), jnp.float32),
+        pltpu.VMEM((Wp, K), jnp.int32),
+    ]
+
+
 def _supported() -> bool:
     try:
         from jax.experimental import pallas as pl  # noqa: F401
@@ -215,6 +249,7 @@ def _deme_child(
     lane_ok,
     bf16_genes,
     elite_rows=0,
+    order_refs=None,
     ablate=(),
 ):
     """Breed one deme's K children: rank-space selection + crossover +
@@ -230,6 +265,11 @@ def _deme_child(
     draw; ``mask_words`` the (K, Lp) crossover-mask PRNG tile shared by
     the deme group (deme ``d`` reads bit d), or None for non-uniform
     crossover; ``rate``/``sigma`` runtime mutation params.
+
+    ``order_refs`` (order crossover only): the six VMEM scratch refs of
+    ``_order_scratch_shapes`` — gene-major parent/city planes, the
+    gene-major child, and the per-column visited-city bitmask — declared
+    by the owning pallas_call and reused across demes/sub-generations.
 
     ``elite_rows`` > 0 turns rows 0..e-1 into verbatim copies of the
     deme's rank-0..e-1 rows: both winner ranks are forced to the row
@@ -348,40 +388,82 @@ def _deme_child(
         # ---- order-preserving crossover (reference TSP driver,
         # test3/test.cu:48-64): walk gene positions left to right,
         # take p1's gene if its decoded city is unvisited, else
-        # p2's, else the raw random value. Inherently sequential in
-        # L, but each step is a handful of (Lp, K) VPU ops on
-        # VMEM-resident data — unrolled at trace time, zero HBM
-        # traffic — unlike the XLA scan path whose per-step launch
-        # overhead dominates large populations (ops/crossover.py).
-        # Transposed (gene-major) layout: a step's slice is then a
-        # static SUBLANE row, and the visited set indexes cities on
-        # sublanes.
+        # p2's, else a fresh random value. Inherently sequential in
+        # L, but it runs as an in-kernel ``fori_loop`` over VMEM
+        # scratch (``order_refs``) in gene-major layout:
+        #
+        # - a step reads/writes ONE sublane row via a dynamic ref
+        #   slice — O(K) per step, where the former trace-time unroll
+        #   (and the XLA scan path, ops/crossover.py) spent a full
+        #   (Lp, K) select per step just to address position l;
+        # - the visited set is a per-column CITY BITMASK, ceil(L/32)
+        #   i32 words on sublanes, so each membership test reduces
+        #   over ~L/32 sublanes instead of Lp — together ~30× less
+        #   work per step at L=1000, and the runtime loop keeps the
+        #   Mosaic program size L-independent (the unroll capped
+        #   genome_len at 256; this path lowers for any L the VMEM
+        #   model admits).
+        from jax.experimental import pallas as pl
+
+        p1t_ref, p2t_ref, c1t_ref, c2t_ref, child_ref, vis_ref = order_refs
+        Wp = vis_ref.shape[0]
         p1t = p1.T  # (Lp, K) f32 — 32-bit transpose is supported
         p2t = p2.T
-        c1t = jnp.clip(jnp.floor(p1t * L), 0, L - 1).astype(jnp.int32)
-        c2t = jnp.clip(jnp.floor(p2t * L), 0, L - 1).astype(jnp.int32)
-        randt = uniform((Lp, K))
-        sub = lax.broadcasted_iota(jnp.int32, (Lp, K), 0)
-        visited = jnp.zeros((Lp, K), dtype=jnp.bool_)
-        childt = jnp.zeros((Lp, K), dtype=jnp.float32)
-        for l in range(L):
-            g1l, c1l = p1t[l : l + 1, :], c1t[l : l + 1, :]
-            g2l, c2l = p2t[l : l + 1, :], c2t[l : l + 1, :]
+        p1t_ref[:] = p1t
+        p2t_ref[:] = p2t
+        # Hoisted out of the walk: city decodes as whole planes, and the
+        # random-fallback genes prefilled into the child (a step only
+        # overwrites its row when a parent gene is taken; pad rows
+        # l >= L are never visited and stay 0 via the lane mask).
+        c1t_ref[:] = jnp.clip(jnp.floor(p1t * L), 0, L - 1).astype(jnp.int32)
+        c2t_ref[:] = jnp.clip(jnp.floor(p2t * L), 0, L - 1).astype(jnp.int32)
+        rows_ok = lax.broadcasted_iota(jnp.int32, (Lp, K), 0) < L
+        child_ref[:] = jnp.where(rows_ok, uniform((Lp, K)), 0.0)
+        vis_ref[:] = jnp.zeros((Wp, K), jnp.int32)
+        wiota = lax.broadcasted_iota(jnp.int32, (Wp, K), 0)
+
+        def order_step(l):
+            p1l = p1t_ref[pl.ds(l, 1), :]  # (1, K)
+            p2l = p2t_ref[pl.ds(l, 1), :]
+            c1 = c1t_ref[pl.ds(l, 1), :]
+            c2 = c2t_ref[pl.ds(l, 1), :]
+            vis = vis_ref[:]
+            w1, b1 = c1 >> 5, jnp.int32(1) << (c1 & 31)
+            w2, b2 = c2 >> 5, jnp.int32(1) << (c2 & 31)
             seen1 = jnp.any(
-                visited & (sub == c1l), axis=0, keepdims=True
+                (wiota == w1) & ((vis & b1) != 0), axis=0, keepdims=True
             )
             seen2 = jnp.any(
-                visited & (sub == c2l), axis=0, keepdims=True
+                (wiota == w2) & ((vis & b2) != 0), axis=0, keepdims=True
             )
             take1 = ~seen1
             take2 = seen1 & ~seen2
             gene = jnp.where(
-                take1, g1l, jnp.where(take2, g2l, randt[l : l + 1, :])
+                take1, p1l, jnp.where(take2, p2l, child_ref[pl.ds(l, 1), :])
             )
-            mark_city = jnp.where(take1, c1l, c2l)
-            visited = visited | ((sub == mark_city) & (take1 | take2))
-            childt = jnp.where(sub == l, gene, childt)
-        child = childt.T  # (K, Lp); pad columns are 0
+            mw = jnp.where(take1, w1, w2)
+            mb = jnp.where(take1, b1, b2)
+            vis_ref[:] = vis | jnp.where(
+                (wiota == mw) & (take1 | take2), mb, 0
+            )
+            child_ref[pl.ds(l, 1), :] = gene
+
+        # Partial unroll by hand (Mosaic's fori_loop supports only full
+        # or no unroll): U walk steps per loop iteration cut the
+        # per-iteration loop overhead ~U×; the L % U tail runs at static
+        # trace-time offsets.
+        U = 8
+        if L >= 2 * U:
+
+            def order_block(i, carry):
+                for j in range(U):
+                    order_step(i * U + j)
+                return carry
+
+            lax.fori_loop(0, L // U, order_block, jnp.int32(0))
+        for l in range(L - (L % U if L >= 2 * U else L), L):
+            order_step(l)
+        child = child_ref[:].T  # (K, Lp)
     else:
         raise ValueError(f"unknown crossover kind {crossover!r}")
 
@@ -504,6 +586,7 @@ def _breed_kernel(
 
     const_refs = rest[:n_consts]
     out_ref = rest[n_consts]
+    order_refs = rest[-6:] if crossover == "order" else None
 
     i = pl.program_id(0)
     pltpu.prng_seed(seed_ref[0, 0] ^ (i * jnp.int32(-1640531527)))  # golden-ratio mix
@@ -574,7 +657,8 @@ def _breed_kernel(
             g, R, Vf, uniform, mask_words, d,
             K=K, L=L, Lp=Lp, tk=tk, sel=sel, sel_param=sel_param,
             crossover=crossover, mutate=mutate, rate=rate, sigma=sigma,
-            lane_ok=lane_ok, bf16_genes=bf16_genes, ablate=ablate,
+            lane_ok=lane_ok, bf16_genes=bf16_genes, order_refs=order_refs,
+            ablate=ablate,
         )
 
         # Write deme d into output column d of the group: the row-major
@@ -732,6 +816,7 @@ def _multigen_kernel(
     s_out = rest[n_consts + 1]
     g_scr = rest[n_consts + 2]
     s_scr = rest[n_consts + 3]
+    order_refs = rest[-6:] if crossover == "order" else None
 
     i = pl.program_id(0)
     pltpu.prng_seed(seed_ref[0, 0] ^ (i * jnp.int32(-1640531527)))
@@ -824,7 +909,7 @@ def _multigen_kernel(
                 K=K, L=L, Lp=Lp, tk=tk, sel=sel, sel_param=sel_param,
                 crossover=crossover, mutate=mutate, rate=rate,
                 sigma=sigma, lane_ok=lane_ok, bf16_genes=bf16_genes,
-                elite_rows=elitism, ablate=ablate,
+                elite_rows=elitism, order_refs=order_refs, ablate=ablate,
             )
             child = child.astype(out_dtype)
             if frozen is not None:
@@ -874,10 +959,11 @@ def _kernel_shape(
 
     - supported gene dtype (f32/bf16), crossover/mutate kind;
     - order crossover: f32 genes only (bf16 resolution ~0.004 near 1.0
-      corrupts ``floor(g*L)`` city decodes) and ``genome_len <= 256``
-      (the visited-table walk unrolls L trace-time steps; beyond a few
-      hundred the Mosaic program size balloons), and D pinned to 1
-      (D>1 would multiply compile size for no burst-write benefit);
+      corrupts ``floor(g*L)`` city decodes), D pinned to 1, and the
+      walk's VMEM scratch (``_order_scratch_shapes``) counted against
+      the scoped budget — any L whose scratch fits lowers (the walk is
+      a runtime ``fori_loop``; it no longer unrolls trace-time steps,
+      so the former ``genome_len <= 256`` cap is gone);
     - tournament size 1..16 (documented engine contract — selection
       pressure ~k/(k+1) saturates; rank-space sampling makes the
       in-kernel cost k-independent, so the cap is contractual);
@@ -896,9 +982,7 @@ def _kernel_shape(
         return None
     if mutate_kind not in ("point", "gaussian", "swap"):
         return None
-    if crossover_kind == "order" and (
-        gene_dtype != jnp.float32 or genome_len > 256
-    ):
+    if crossover_kind == "order" and gene_dtype != jnp.float32:
         return None
     if not (1 <= tournament_size <= 16):
         return None
@@ -909,15 +993,31 @@ def _kernel_shape(
         deme_size = auto_deme_size(gene_dtype)
     Lp = math.ceil(genome_len / LANE) * LANE
     gene_bytes = 2 if gene_dtype == jnp.bfloat16 else 4
+
+    def extra_scoped(k: int) -> int:
+        # The order walk's VMEM scratch counts against the same scoped
+        # budget as the caller's own model — threaded through every
+        # admission check (deme pick included, so long genomes retry
+        # smaller K instead of silently dropping to the XLA path).
+        if crossover_kind != "order":
+            return 0
+        return sum(
+            math.prod(s.shape) * 4
+            for s in _order_scratch_shapes(k, genome_len, Lp)
+        )
+
+    def fit(k: int, d: int) -> bool:
+        return blocks_fit(k, d, Lp, gene_bytes, extra_scoped(k))
+
     K = _pick_deme_size(
-        pop_size, deme_size, genome_lanes=Lp, gene_bytes=gene_bytes
+        pop_size, deme_size, genome_lanes=Lp, gene_bytes=gene_bytes,
+        fits=lambda k: fit(k, 1),
     )
-    if K is None or not blocks_fit(K, 1, Lp, gene_bytes):
+    if K is None:
         return None
     G = math.ceil(pop_size / K)
     d_candidates = [
-        d for d in d_pool
-        if G % d == 0 and blocks_fit(K, d, Lp, gene_bytes)
+        d for d in d_pool if G % d == 0 and fit(K, d)
     ] or [1]
     if crossover_kind == "order":
         D = 1
@@ -1055,6 +1155,10 @@ def make_pallas_breed(
         ] + [_const_spec(c) for c in consts],
         out_specs=out_specs if fused_obj is not None else out_specs[0],
         out_shape=out_shape if fused_obj is not None else out_shape[0],
+        scratch_shapes=(
+            _order_scratch_shapes(K, L, Lp)
+            if crossover_kind == "order" else []
+        ),
     )
 
     default_params = jnp.asarray(
@@ -1196,13 +1300,17 @@ def multigen_default_t(gene_dtype) -> int:
     return 8 if gene_dtype == jnp.float32 else 1
 
 
-def _multigen_blocks_fit(K: int, D: int, Lp: int, gene_bytes: int) -> bool:
+def _multigen_blocks_fit(
+    K: int, D: int, Lp: int, gene_bytes: int, extra_scoped: int = 0
+) -> bool:
     """VMEM gate for the multi-generation kernel: the single-generation
-    model plus the genome/score scratch and the in-kernel rank cube."""
+    model plus the genome/score scratch and the in-kernel rank cube
+    (plus any variant extra, same contract as ``_blocks_fit``)."""
     scratch = D * K * Lp * gene_bytes + 4 * D * K
     return (
         4 * D * K * Lp * gene_bytes + scratch <= _BLOCK_BYTES_LIMIT
-        and _scoped_vmem_bytes(K, D, Lp, gene_bytes) + scratch + 8 * K * K
+        and _scoped_vmem_bytes(K, D, Lp, gene_bytes)
+        + scratch + 8 * K * K + extra_scoped
         <= _SCOPED_VMEM_LIMIT
     )
 
@@ -1304,7 +1412,10 @@ def make_pallas_multigen(
         scratch_shapes=[
             pltpu.VMEM((D * K, Lp), gene_dtype),
             pltpu.VMEM((1, D, K), jnp.float32),
-        ],
+        ] + (
+            _order_scratch_shapes(K, L, Lp)
+            if crossover_kind == "order" else []
+        ),
     )
 
     default_params = jnp.asarray(

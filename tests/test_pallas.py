@@ -961,3 +961,48 @@ def test_multigen_run_loop_exact_generation_count():
     np.testing.assert_allclose(
         np.asarray(s2), np.asarray(jnp.sum(g2, axis=1)), rtol=1e-4
     )
+
+
+def test_order_crossover_long_genome_lowers_and_repairs():
+    """The runtime-loop order walk serves genome_len > 256 (the old
+    trace-time unroll declined it): permutation parents breed
+    permutation children at L=300, and the factory no longer returns
+    None."""
+    from libpga_tpu.ops.pallas_step import make_pallas_breed
+
+    P, L = 256, 300
+    with _interpret():
+        breed = make_pallas_breed(
+            P, L, deme_size=128, crossover_kind="order",
+            mutate_kind="swap", mutation_rate=0.0,
+        )
+        assert breed is not None
+        rng = np.random.default_rng(0)
+        perms = (
+            rng.permuted(np.tile(np.arange(L), (P, 1)), axis=1) + 0.5
+        ).astype(np.float32) / L
+        out = np.asarray(
+            breed(
+                jnp.asarray(perms),
+                jnp.asarray(rng.random(P), dtype=jnp.float32),
+                jax.random.key(0),
+            )
+        )
+    cities = np.clip(np.floor(out * L), 0, L - 1).astype(int)
+    uniq = np.array([len(np.unique(r)) for r in cities])
+    assert uniq.min() == L, uniq.min()
+
+
+def test_tsp_coords_matches_per_genome_form():
+    """make_tsp_coords: the batched one-hot-gather form must agree with
+    the per-genome indexed form, duplicates penalized identically."""
+    from libpga_tpu.objectives import make_tsp_coords, random_tsp_coords
+
+    L = 40
+    xy = random_tsp_coords(L, seed=1)
+    obj = make_tsp_coords(xy)
+    rng = np.random.default_rng(2)
+    g = rng.random((16, L)).astype(np.float32)  # duplicates near-certain
+    rows = np.asarray(obj.rows(jnp.asarray(g)))
+    per = np.asarray([float(obj(jnp.asarray(r))) for r in g])
+    np.testing.assert_allclose(rows, per, rtol=1e-4, atol=1e-2)
