@@ -382,7 +382,11 @@ class PGA:
             return None
         obj = self._require_objective()
         fused = getattr(obj, "kernel_rowwise", None)
-        from libpga_tpu.ops.pallas_step import make_pallas_breed
+        from libpga_tpu.ops.pallas_step import (
+            make_pallas_breed,
+            make_pallas_multigen,
+            multigen_default_t,
+        )
 
         # Cached: runner caching downstream keys on the breed's identity,
         # so rebuilding it per call would defeat compilation reuse.
@@ -391,9 +395,44 @@ class PGA:
             self._crossover_kind(), self._mutate_kind(),
             self.config.elitism, self.config.tournament_size,
             self.config.selection, self.config.selection_param,
+            self.config.pallas_generations_per_launch,
         )
         if cache_key in self._compiled:
             return self._compiled[cache_key]
+        # Multi-generation breed first: the island epoch then runs as
+        # ceil(m/T) vmapped launches with in-kernel ranking instead of m
+        # per-generation launches + a hoisted host-side rank sort
+        # (islands.make_multigen_stacked_epoch). Same auto policy as
+        # PGA.run; an explicit config T=1 keeps the one-generation path.
+        T = self.config.pallas_generations_per_launch
+        if T is None:
+            T = multigen_default_t(self.config.gene_dtype)
+        if T > 1 and fused is not None:
+            bm = make_pallas_multigen(
+                island_size,
+                genome_len,
+                deme_size=self.config.pallas_deme_size,
+                tournament_size=self.config.tournament_size,
+                selection_kind=self.config.selection,
+                selection_param=self.config.selection_param,
+                mutation_rate=self._mutation_rate(),
+                mutation_sigma=self._operator_param("sigma", 0.0),
+                crossover_kind=self._crossover_kind(),
+                mutate_kind=self._mutate_kind(),
+                elitism=self.config.elitism,
+                fused_obj=fused,
+                fused_consts=tuple(
+                    getattr(obj, "kernel_rowwise_consts", ())
+                ),
+                gene_dtype=self.config.gene_dtype,
+            )
+            if bm is not None:
+                # An explicit config value bounds the island epoch's
+                # per-launch generation count too (None → the island
+                # default, see islands.make_multigen_stacked_epoch).
+                bm.epoch_chunk = self.config.pallas_generations_per_launch
+                self._compiled[cache_key] = bm
+                return bm
         pb = make_pallas_breed(
             island_size,
             genome_len,

@@ -152,6 +152,22 @@ default_knapsack = make_knapsack(
 # --------------------------------------------------------------------- TSP
 
 
+def _chunked_rows(score_chunk, cities, B: int = 2048):
+    """Shared chunking scaffold for the batched TSP forms: keep each
+    chunk's (B, L, C)-scale one-hots tens of MB, not gigabytes, at
+    framework-scale populations; a non-multiple tail pads up to the
+    chunk size and is sliced away."""
+    P = cities.shape[0]
+    if P <= B:
+        return score_chunk(cities)
+    n_chunks = -(-P // B)
+    padded = jnp.pad(cities, ((0, n_chunks * B - P), (0, 0)))
+    out = jax.lax.map(
+        score_chunk, padded.reshape(n_chunks, B, cities.shape[1])
+    )
+    return out.reshape(n_chunks * B)[:P]
+
+
 def make_tsp(city_matrix, duplicate_penalty: float = 10_000.0):
     """TSP over a distance matrix with duplicate-city penalty.
 
@@ -220,16 +236,7 @@ def make_tsp(city_matrix, duplicate_penalty: float = 10_000.0):
             dups = jnp.sum(counts * counts, axis=1) - L
             return -(length + duplicate_penalty * dups)
 
-        # Chunk so the (B, L, C) one-hots stay tens of MB, not
-        # gigabytes, at framework-scale populations; a non-multiple
-        # tail pads up to the chunk size and is sliced away.
-        B = 2048
-        if P <= B:
-            return score_chunk(cities)
-        n_chunks = -(-P // B)
-        padded = jnp.pad(cities, ((0, n_chunks * B - P), (0, 0)))
-        out = jax.lax.map(score_chunk, padded.reshape(n_chunks, B, L))
-        return out.reshape(n_chunks * B)[:P]
+        return _chunked_rows(score_chunk, cities)
 
     tsp.rows = tsp_rows
     return tsp
@@ -296,23 +303,17 @@ def make_tsp_coords(coords, duplicate_penalty: float = 10_000.0):
             ).reshape(B, L, 2)
             return -(edge_lengths(xy) + duplicate_penalty * dups)
 
-        B = 2048
-        if P <= B:
-            return score_chunk(cities)
-        n_chunks = -(-P // B)
-        padded = jnp.pad(cities, ((0, n_chunks * B - P), (0, 0)))
-        out = jax.lax.map(score_chunk, padded.reshape(n_chunks, B, L))
-        return out.reshape(n_chunks * B)[:P]
+        return _chunked_rows(score_chunk, cities)
 
     tsp.rows = tsp_rows
     return tsp
 
 
 def random_tsp_coords(n_cities: int, seed: int = 0, scale: float = 1000.0):
-    """Uniform-random city coordinates in a ``scale``-sized square, with
-    the city order shuffled so the identity tour is NOT special — the
+    """Uniform-random city coordinates in a ``scale``-sized square — the
     Euclidean analog of :func:`random_tsp_matrix` for long-tour
-    benchmarks."""
+    benchmarks. i.i.d. positions mean no tour order is special (unlike
+    the matrix generator, which plants a cheap 0,1,…,L−1 path)."""
     rng = np.random.default_rng(seed)
     return (rng.random((n_cities, 2)) * scale).astype(np.float32)
 

@@ -162,6 +162,61 @@ def make_stacked_pallas_epoch(breed: Callable, m: int) -> Callable:
     return epoch
 
 
+def make_multigen_stacked_epoch(bm: Callable, m: int) -> Callable:
+    """m generations over ALL islands for a MULTI-GENERATION fused breed
+    (``make_pallas_multigen``): the epoch is a handful of vmapped kernel
+    launches — ceil(m / T) per island, each breeding up to T
+    sub-generations with demes VMEM-resident and ranks computed
+    in-kernel — instead of m per-generation launches with a hoisted
+    host-side rank sort (``make_stacked_pallas_epoch``). The round-3
+    sort-hoist machinery is unnecessary here: sub-generations rank
+    in-kernel, so nothing is left to hoist.
+
+    Signature matches the other stacked epoch:
+    ``(genomes (I,S,L), scores (I,S), keys (I,)[, mparams]) ->
+    (genomes, scores, keys)``. Elitism runs in-breed (per deme).
+    """
+    Lp, Pp = bm.Lp, bm.Pp
+    gdtype = bm.gene_dtype
+    # Whole-epoch launches up to T=16 by default: migration already
+    # bounds the mixing horizon at m, and the measured convergence drag
+    # at T=16 is small (BASELINE.md multigen table: takeover 70.4 vs
+    # 66.6 gens, 64-gen OneMax mean -0.10) — cheaper than paying the
+    # launch's HBM round trip twice per epoch (an 8+2 split for m=10
+    # measured ~4% slower than one 10-generation launch). An EXPLICIT
+    # config.pallas_generations_per_launch still rules: the engine
+    # stamps it on the breed (``epoch_chunk``) so the documented knob
+    # bounds island launches exactly like single-population runs.
+    T = getattr(bm, "epoch_chunk", None) or 16
+
+    def epoch(genomes, scores, keys, mparams=None):
+        I, S, L = genomes.shape
+        pad = Lp != L or Pp != S
+        g = genomes.astype(gdtype)
+        s = scores
+        if pad:
+            g = jnp.pad(g, ((0, 0), (0, Pp - S), (0, Lp - L)))
+            s = jnp.pad(s, ((0, 0), (0, Pp - S)), constant_values=-jnp.inf)
+        ks = keys
+        done = 0
+        while done < m:  # static chunking: m and T are Python ints
+            t = min(T, m - done)
+            split2 = jax.vmap(jax.random.split)(ks)
+            ks, subs = split2[:, 0], split2[:, 1]
+            g, s = jax.vmap(
+                lambda gi, si, ki: bm.padded(
+                    gi, si, ki, jnp.int32(t), mparams
+                )
+            )(g, s, subs)
+            done += t
+        if pad:
+            g = g[:, :S, :L]
+            s = s[:, :S]
+        return g, s, ks
+
+    return epoch
+
+
 def _use_stacked_epoch(breed, elitism: int) -> bool:
     """Fused Pallas breeds with the rank hooks take the stacked epoch
     (their elitism runs in-breed, so the epoch-level carry must be 0)."""
@@ -177,6 +232,8 @@ def _make_vepoch(breed, obj, m: int, elitism: int):
     and sharded runners so the stacked/vmapped selection can never
     diverge between them. Signature either way:
     ``(g (I,S,L), s (I,S), keys (I,)[, mparams]) -> (g, s, keys)``."""
+    if getattr(breed, "multigen", False):
+        return make_multigen_stacked_epoch(breed, m)
     if _use_stacked_epoch(breed, elitism):
         return make_stacked_pallas_epoch(breed, m)
     epoch = make_island_epoch(breed, obj, m, elitism=elitism)

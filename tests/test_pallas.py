@@ -1006,3 +1006,39 @@ def test_tsp_coords_matches_per_genome_form():
     rows = np.asarray(obj.rows(jnp.asarray(g)))
     per = np.asarray([float(obj(jnp.asarray(r))) for r in g])
     np.testing.assert_allclose(rows, per, rtol=1e-4, atol=1e-2)
+
+
+def test_order_crossover_long_genome_visited_semantics():
+    """Deterministic walk check through the DYNAMIC loop body (L=300 >=
+    2*U, so the static tail alone can't mask a bug): zero PRNG bits make
+    every child the dedup-walk of its deme's rank-0 row — the first
+    occurrence of each city keeps its raw gene, every later duplicate
+    falls through take1 AND take2 (same city) to the zero random
+    fallback. Exercises the bitmask membership test, the mark update,
+    and the fallback write at every dynamic step."""
+    from libpga_tpu.ops.pallas_step import make_pallas_breed
+
+    P, L, K = 256, 300, 128
+    # rank-0 rows carry a known duplicate pattern: city l % 150 at
+    # position l (positions 150.. are all duplicates)
+    pattern = ((np.arange(L) % 150) + 0.5).astype(np.float32) / L
+    rng = np.random.default_rng(1)
+    g = rng.random((P, L)).astype(np.float32)
+    g[0] = pattern  # deme 0 rank-0 row
+    g[K] = pattern  # deme 1 rank-0 row
+    with _interpret():
+        breed = make_pallas_breed(
+            P, L, deme_size=K, crossover_kind="order",
+            mutate_kind="swap", mutation_rate=0.0,
+        )
+        out = np.asarray(
+            breed(
+                jnp.asarray(g), deme_rank0_scores(P, K), jax.random.key(0)
+            )
+        )
+    expect = pattern.copy()
+    expect[150:] = 0.0  # duplicates -> zero fallback
+    # atol: parent genes round-trip the hi/lo bf16 selection matmul
+    # (~1e-5 documented accuracy); fallback zeros must be exact.
+    np.testing.assert_allclose(out, np.tile(expect, (P, 1)), atol=2e-5)
+    np.testing.assert_array_equal(out[:, 150:], 0.0)
