@@ -20,6 +20,13 @@ Prints exactly one JSON line. Headline fields:
     sort, PRNG, crossover/mutation, and fused evaluation are real work
     the model deliberately excludes, so treat mfu as a matmul-
     utilization gauge (gens/sec is the headline; see BASELINE.md);
+  achieved_hbm_gbps / hbm_frac_of_peak — population HBM traffic per
+    second under the floor model of ``hbm_bytes_per_gen`` (genome +
+    score read/write per launch, /T for the multi-generation kernel)
+    against the chip's 819 GB/s: the per-round tracker for the round-4
+    finding that launch IO is mostly pipeline-hidden (a LOW fraction at
+    high gens/sec means compute-bound, which is where the kernel now
+    lives — see BASELINE.md);
   bf16_* — the bfloat16 gene mode (single exact selection matmul, half
     the FLOPs; genes at bf16 resolution);
   islands_* — 8-island × 131,072 OneMax with ring migration every 10
@@ -51,6 +58,24 @@ import time
 POP = 1 << 20  # 1,048,576
 GENOME_LEN = 100
 V5E_BF16_PEAK = 197e12  # TPU v5e: 197 TFLOP/s bf16 per chip
+V5E_HBM_PEAK = 819e9  # TPU v5e: 819 GB/s HBM bandwidth per chip
+
+
+def hbm_bytes_per_gen(pop, genome_lanes, gene_bytes, T: int) -> int:
+    """Population HBM traffic per generation under the fused run loop:
+    one genome read + one genome write + one score read + one score
+    write per KERNEL LAUNCH, divided by the T generations each launch
+    breeds (the multi-generation kernel keeps demes VMEM-resident
+    between sub-generations; T=1 is the one-generation kernel, whose
+    score side also carries the rank sort's read+write — folded in as
+    the same 2×4 bytes/row). Deliberately a FLOOR model: PRNG, SMEM
+    scalars, and compiler spills are excluded, so fraction-of-peak
+    overstates nothing. Tracks the round-4 finding that the launch IO
+    is mostly pipeline-hidden — a small fraction means the kernel is
+    compute-bound, not that bandwidth is wasted (see BASELINE.md)."""
+    genome = 2 * pop * genome_lanes * gene_bytes
+    scores = 2 * pop * 4
+    return (genome + scores) // T
 
 
 def reference_floor_seconds_per_gen() -> float:
@@ -110,24 +135,31 @@ def bench_single(gene_dtype) -> dict:
     pga.run(5)  # compile + warm caches
     gps = _best_gps(lambda n: pga.run(n))
 
-    from libpga_tpu.ops.pallas_step import _pick_deme_size, auto_deme_size
+    from libpga_tpu.ops.pallas_step import (
+        _pick_deme_size, auto_deme_size, multigen_default_t,
+    )
 
     Lp = math.ceil(GENOME_LEN / 128) * 128
+    gene_bytes = 2 if gene_dtype == jnp.bfloat16 else 4
     # Mirror make_pallas_breed's exact K choice (lane- and dtype-aware)
     # so the FLOPs model can never describe a deme size the kernel
     # didn't run.
     K = _pick_deme_size(
         POP, auto_deme_size(gene_dtype), genome_lanes=Lp,
-        gene_bytes=2 if gene_dtype == jnp.bfloat16 else 4,
+        gene_bytes=gene_bytes,
     )
     matmuls = 2 if gene_dtype == jnp.bfloat16 else 4
     flops_per_gen = POP * K * Lp * 2 * matmuls
     achieved = gps * flops_per_gen
+    T = multigen_default_t(gene_dtype)  # the engine's auto launch depth
+    hbm = gps * hbm_bytes_per_gen(POP, Lp, gene_bytes, T)
     return {
         "gens_per_sec": round(gps, 2),
         "ms_per_gen": round(1000.0 / gps, 3) if gps else None,
         "achieved_tflops": round(achieved / 1e12, 2),
         "mfu": round(achieved / V5E_BF16_PEAK, 4),
+        "achieved_hbm_gbps": round(hbm / 1e9, 1),
+        "hbm_frac_of_peak": round(hbm / V5E_HBM_PEAK, 4),
     }
 
 
@@ -176,9 +208,13 @@ def main() -> None:
         "ms_per_gen": f32["ms_per_gen"],
         "achieved_tflops": f32["achieved_tflops"],
         "mfu": f32["mfu"],
+        "achieved_hbm_gbps": f32["achieved_hbm_gbps"],
+        "hbm_frac_of_peak": f32["hbm_frac_of_peak"],
         "bf16_gens_per_sec": bf16["gens_per_sec"],
         "bf16_achieved_tflops": bf16["achieved_tflops"],
         "bf16_mfu": bf16["mfu"],
+        "bf16_achieved_hbm_gbps": bf16["achieved_hbm_gbps"],
+        "bf16_hbm_frac_of_peak": bf16["hbm_frac_of_peak"],
     }
     out.update(ref)
     out.update(isl)

@@ -433,6 +433,19 @@ class PGA:
                 bm.epoch_chunk = self.config.pallas_generations_per_launch
                 self._compiled[cache_key] = bm
                 return bm
+            if self.config.pallas_generations_per_launch is not None:
+                # Same contract as make_pallas_run: an explicit T > 1
+                # must not degrade silently (a T-sweep over islands
+                # would measure T=1 at every point).
+                import warnings
+
+                warnings.warn(
+                    "pallas_generations_per_launch="
+                    f"{self.config.pallas_generations_per_launch} requested"
+                    " but the island multi-generation kernel declined —"
+                    " falling back to the one-generation island path",
+                    stacklevel=3,
+                )
         pb = make_pallas_breed(
             island_size,
             genome_len,

@@ -30,3 +30,29 @@ def test_multihost_smoke_with_checkpointing():
     )
     assert "MULTIHOST SMOKE: PASS" in proc.stdout
     assert "checkpoint best" in proc.stdout
+
+
+RESIZE_TOOL = Path(__file__).resolve().parent.parent / "tools" / "resize_smoke.py"
+
+
+def test_job_resize_checkpoint_matrix():
+    """The round-4 multi-process matrix (tools/resize_smoke.py): a
+    4-process fleet runs the sharded island GA and shard-saves; a
+    2-process fleet restores it (resize DOWN: more shard files than
+    processes), verifies the global best survived exactly, evolves, and
+    saves again at the same path; a 4-process fleet restores THAT
+    (resize UP, with stage-1's stale proc2/proc3 files still on disk —
+    restore must honor the checkpoint's declared file set). Asserts the
+    harness's own verdict."""
+    proc = subprocess.run(
+        [sys.executable, str(RESIZE_TOOL)],
+        capture_output=True,
+        text=True,
+        timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, (
+        f"resize smoke failed:\n{proc.stdout}\n{proc.stderr}"
+    )
+    assert "RESIZE SMOKE: PASS" in proc.stdout
+    assert "restored best" in proc.stdout

@@ -1,0 +1,165 @@
+"""Job-resize distributed smoke: save at N processes, restore at M.
+
+Preemptible pod jobs come back at whatever size the scheduler grants, so
+checkpoint/restore must work ACROSS process counts — the scenario the
+per-process shard format (``utils/checkpoint.py``) exists for. Three
+stages over a shared checkpoint path, each a separate fleet of workers
+on a CPU-simulated multi-host mesh (8 global devices throughout):
+
+1. **4 processes × 2 devices**: island GA through the PGA engine,
+   collective shard save (4 ``.proc<k>.npz`` files).
+2. **2 processes × 4 devices**: restore the 4-process checkpoint
+   (resize DOWN — merge more shard files than running processes),
+   verify the global best survived exactly, continue evolving on the
+   2-process mesh, save again (2 shard files, at the SAME path — stage
+   1's proc2/proc3 files remain on disk, exercising restore's
+   declared-file-set rule).
+3. **4 processes × 2 devices**: restore the 2-process checkpoint
+   (resize UP), verify, and evolve again.
+
+Run directly:  python tools/resize_smoke.py
+Exit code 0 and "RESIZE SMOKE: PASS" = every stage agreed.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+GLOBAL_DEVICES = 8
+ISLANDS, SIZE, LENGTH = 8, 256, 16
+STAGES = [  # (num_processes, coordinator_port, restore_first)
+    (4, 12431, False),
+    (2, 12432, True),
+    (4, 12433, True),
+]
+
+
+def worker(stage: int, process_id: int) -> None:
+    num_procs, port, restoring = STAGES[stage]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", GLOBAL_DEVICES // num_procs)
+
+    from libpga_tpu.parallel import distributed
+
+    distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=num_procs,
+        process_id=process_id,
+    )
+
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    from libpga_tpu import PGA, PGAConfig
+    from libpga_tpu.parallel.mesh import default_mesh, global_max
+    from libpga_tpu.utils import checkpoint
+
+    ckpt_path = os.environ["PGA_RESIZE_CKPT"]
+    best_file = os.environ["PGA_RESIZE_BEST"]
+    mesh = default_mesh()
+
+    pga = PGA(seed=5, config=PGAConfig(mutation_rate=0.05))
+    if restoring:
+        checkpoint.restore(pga, ckpt_path)
+        assert pga.num_populations == ISLANDS, pga.num_populations
+        restored_best = max(
+            float(jnp.max(p.scores)) for p in pga.populations
+        )
+        with open(best_file) as f:
+            expected = json.load(f)["best"]
+        assert abs(restored_best - expected) < 1e-5, (
+            f"stage {stage}: restored best {restored_best} != "
+            f"saved {expected}"
+        )
+        print(
+            f"[stage {stage} proc {process_id}] restored best "
+            f"{restored_best:.3f} across {num_procs} processes",
+            flush=True,
+        )
+    else:
+        for _ in range(ISLANDS):
+            pga.create_population(SIZE, LENGTH)
+    pga.set_objective("onemax")
+
+    gens = pga.run_islands(20 if not restoring else 10, 5, 0.1, mesh=mesh)
+    assert gens == (20 if not restoring else 10), gens
+    best = max(global_max(p.scores, mesh) for p in pga.populations)
+    assert best > 12.0, f"stage {stage}: no convergence ({best})"
+
+    checkpoint.save(pga, ckpt_path)  # collective shard save
+    multihost_utils.sync_global_devices(f"resize-smoke-saved-{stage}")
+    if process_id == 0:
+        with open(best_file, "w") as f:
+            json.dump({"best": best, "stage": stage}, f)
+    print(
+        f"[stage {stage} proc {process_id}] best {best:.3f} "
+        f"(saved at {num_procs} processes)",
+        flush=True,
+    )
+
+
+def _run_stage(stage: int, env) -> int:
+    num_procs, _, _ = STAGES[stage]
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, os.path.abspath(__file__),
+                "--worker", str(stage), str(i),
+            ],
+            env=env,
+        )
+        for i in range(num_procs)
+    ]
+    rc = 0
+    try:
+        for p in procs:
+            p.wait(timeout=300)
+            rc |= p.returncode
+    except subprocess.TimeoutExpired:
+        rc = 1
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return rc
+
+
+def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "--worker":
+        worker(int(sys.argv[2]), int(sys.argv[3]))
+        return 0
+
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if not k.startswith("PALLAS_AXON") and not k.startswith("TPU_")
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    import tempfile
+
+    work = tempfile.mkdtemp(prefix="pga_resize_smoke_")
+    env["PGA_RESIZE_CKPT"] = os.path.join(work, "state.npz")
+    env["PGA_RESIZE_BEST"] = os.path.join(work, "best.json")
+
+    for stage in range(len(STAGES)):
+        rc = _run_stage(stage, env)
+        if rc != 0:
+            print(f"RESIZE SMOKE: FAIL (stage {stage})")
+            return rc
+        n, _, restoring = STAGES[stage]
+        print(
+            f"stage {stage} ok: {n} processes"
+            + (" (restored from previous stage)" if restoring else "")
+        )
+    print("RESIZE SMOKE: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
