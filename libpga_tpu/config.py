@@ -79,6 +79,13 @@ class PGAConfig:
       donate_buffers: donate the genome buffer to jit so XLA updates it in
         place (the TPU-native replacement for the reference's
         current/next-generation pointer swap, ``pga.h:124-129``).
+      validate: runtime validation mode — the debug stand-in for a
+        device sanitizer (``utils/validate.py``). After every
+        state-installing operation the engine checks gene domain,
+        score/NaN sanity, and score consistency against the independent
+        XLA evaluation oracle, raising ``ValidationError`` with the
+        operation and population named. Adds a host copy + one XLA
+        evaluation per checked op; off by default.
       seed: base PRNG seed. The reference seeds cuRAND with ``time(NULL)``
         (``pga.cu:154``); here an explicit seed gives reproducibility, and
         ``None`` picks an OS-entropy seed.
@@ -96,6 +103,7 @@ class PGAConfig:
     pallas_deme_size: Optional[int] = None
     pallas_generations_per_launch: Optional[int] = None
     donate_buffers: bool = True
+    validate: bool = False
     seed: Optional[int] = None
 
     def pallas_enabled(self) -> bool:

@@ -168,6 +168,45 @@ class PGA:
             )
         return self._objective
 
+    def _validate(self, where: str, indices=None, staged: bool = False):
+        """Runtime validation mode (``config.validate`` — see
+        ``utils/validate``): check the named populations' invariants
+        against the XLA oracle after a state-installing operation.
+        ``staged`` checks the staged next generation's gene domain
+        instead (it has no scores yet)."""
+        if not self.config.validate:
+            return
+        from libpga_tpu.utils.validate import check_population
+
+        if indices is None:
+            indices = range(len(self._populations))
+
+        def addressable(arr):
+            # On a multi-process mesh a population may live entirely on
+            # another host — np.asarray on it raises. Validate what
+            # this process can see; peers validate their own shards
+            # (every process runs the same engine calls).
+            return not isinstance(arr, jax.Array) or arr.is_fully_addressable
+
+        for i in indices:
+            if staged:
+                if self._staged[i] is not None and addressable(
+                    self._staged[i]
+                ):
+                    check_population(
+                        None, self._staged[i], None, where=where, index=i
+                    )
+                continue
+            pop = self._populations[i]
+            if not (
+                addressable(pop.genomes) and addressable(pop.scores)
+            ):
+                continue
+            check_population(
+                self._objective, pop.genomes, pop.scores,
+                where=where, index=i,
+            )
+
     # --------------------------------------------------------- fused run loop
 
     def _breed_fn(self) -> Callable:
@@ -407,6 +446,21 @@ class PGA:
         T = self.config.pallas_generations_per_launch
         if T is None:
             T = multigen_default_t(self.config.gene_dtype)
+        if T > 1 and fused is None and (
+            self.config.pallas_generations_per_launch is not None
+        ):
+            # Same contract as make_pallas_run: an explicitly requested
+            # T > 1 must not degrade silently, including for objectives
+            # without an in-kernel form.
+            import warnings
+
+            warnings.warn(
+                "pallas_generations_per_launch="
+                f"{self.config.pallas_generations_per_launch} requested"
+                " but the objective has no in-kernel (kernel_rowwise)"
+                " form — islands fall back to the one-generation path",
+                stacklevel=3,
+            )
         if T > 1 and fused is not None:
             bm = make_pallas_multigen(
                 island_size,
@@ -506,6 +560,7 @@ class PGA:
         # listeners (e.g. AutoCheckpointer) read solver state.
         self._populations[handle.index] = Population(genomes=genomes, scores=scores)
         self._staged[handle.index] = None
+        self._validate("run", [handle.index])
         self.metrics.record_run(gens, pop.size, time.perf_counter() - t0)
         return gens
 
@@ -516,6 +571,7 @@ class PGA:
         pop = self._populations[handle.index]
         scores = self._jitted_evaluate()(pop.genomes)
         self._populations[handle.index] = dataclasses.replace(pop, scores=scores)
+        self._validate("evaluate", [handle.index])
 
     def evaluate_all(self) -> None:
         for h in self._handles():
@@ -553,6 +609,7 @@ class PGA:
         pop = self._populations[handle.index]
         fn = self._compiled_op("crossover")
         self._staged[handle.index] = fn(pop.genomes, pop.scores, self.next_key())
+        self._validate("crossover", [handle.index], staged=True)
 
     def crossover_all(self, selection: str = "tournament") -> None:
         for h in self._handles():
@@ -622,6 +679,7 @@ class PGA:
         self._staged[handle.index] = self._compiled_op("mutate")(
             staged, self.next_key()
         )
+        self._validate("mutate", [handle.index], staged=True)
 
     def mutate_all(self) -> None:
         for h in self._handles():
@@ -823,6 +881,7 @@ class PGA:
                 genomes=genomes[i], scores=scores[i]
             )
             self._staged[i] = None
+        self._validate("run_islands")
         # Metrics listeners run after the state swap (see run()).
         self.metrics.record_run(
             gens, sum(p.size for p in self._populations),

@@ -1,0 +1,102 @@
+"""Runtime validation mode — the TPU stand-in for a device sanitizer.
+
+The reference's only correctness net is ``CUDA_CALL`` exit-on-error
+(``/root/reference/src/pga.cu:24-31``); CUDA users reach for
+compute-sanitizer when device code misbehaves. There is no sanitizer to
+point at a Mosaic kernel, so this module provides the equivalent
+observability the survey's aux-subsystem inventory calls for (§5 "race
+detection / sanitizers"): with ``PGAConfig(validate=True)`` the engine
+cross-checks every state-installing operation against the invariants
+the kernels promise, on REAL outputs, using the independent XLA
+evaluation path as the oracle:
+
+- **gene domain**: genomes finite and inside [0, 1) — point/gaussian
+  mutation clip there, uniform/order crossover only move parent genes;
+  a value outside means PRNG/selection/layout corruption;
+- **score consistency**: stored scores must equal the objective
+  re-evaluated on the stored genomes through the XLA path (``evaluate``
+  with the plain rowwise/per-genome form) — catching fused-kernel score
+  drift, riffle-layout mismatches between the genome and score outputs,
+  and stale-score bugs, the exact class of defect a miscompiled kernel
+  produces;
+- **shape/size**: population dimensions unchanged by breeding.
+
+Checks run on host after the jitted step completes (validation mode is
+a debug tool; it adds a device→host copy + one XLA evaluation per
+checked operation and is OFF by default). On a multi-process mesh the
+engine validates only populations fully addressable from this process
+(every process runs the same engine calls, so each validates its own).
+Failures raise :class:`ValidationError` naming the operation and the
+first offending population — instead of the silently-wrong populations
+a corrupted kernel would otherwise evolve for hours.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class ValidationError(AssertionError):
+    """An engine-state invariant failed under ``PGAConfig(validate=True)``."""
+
+
+def check_population(
+    obj: Optional[Callable],
+    genomes,
+    scores,
+    *,
+    where: str,
+    index: int = 0,
+    atol: float = 5e-2,
+) -> None:
+    """Validate one population's invariants; raise ValidationError.
+
+    ``scores`` may be None (not yet evaluated — e.g. right after
+    ``swap_generations``, whose -inf reset is deliberate). ``atol`` is
+    absolute score tolerance: fused evaluation accumulates in f32 but
+    bf16 genes and the hi/lo selection split mean reductions can differ
+    from the XLA oracle by ~1e-2 at 100-gene sums.
+    """
+    g = np.asarray(genomes, dtype=np.float32)
+    if not np.isfinite(g).all():
+        raise ValidationError(
+            f"{where}: population {index} genomes contain "
+            f"{np.count_nonzero(~np.isfinite(g))} non-finite genes"
+        )
+    lo, hi = float(g.min(initial=0.0)), float(g.max(initial=0.0))
+    if lo < 0.0 or hi > 1.0:
+        raise ValidationError(
+            f"{where}: population {index} genes outside [0, 1): "
+            f"min {lo}, max {hi}"
+        )
+    if scores is None or obj is None:
+        return
+    s = np.asarray(scores, dtype=np.float32)
+    if s.shape != (g.shape[0],):
+        raise ValidationError(
+            f"{where}: population {index} scores shape {s.shape} != "
+            f"({g.shape[0]},)"
+        )
+    if np.isnan(s).any():
+        raise ValidationError(
+            f"{where}: population {index} scores contain NaN"
+        )
+    live = np.isfinite(s)
+    if not live.any():
+        return  # all -inf: not yet evaluated (staged swap)
+    from libpga_tpu.ops.evaluate import evaluate as _evaluate
+
+    oracle = np.asarray(_evaluate(obj, jnp.asarray(g[live])))
+    drift = np.abs(oracle - s[live])
+    worst = float(drift.max(initial=0.0))
+    if worst > atol:
+        k = int(drift.argmax())
+        raise ValidationError(
+            f"{where}: population {index} scores drifted from the XLA "
+            f"oracle (worst |Δ| {worst:.4g} at live row {k}: stored "
+            f"{s[live][k]:.6g}, re-evaluated {oracle[k]:.6g}) — fused "
+            "kernel scores inconsistent with stored genomes"
+        )

@@ -219,3 +219,71 @@ def test_resume_continues_deterministically(tmp_path):
     final_b = np.asarray(pga2.population(PopulationHandle(0)).genomes)
 
     np.testing.assert_array_equal(final_a, final_b)
+
+
+def test_sigkill_fault_injection_resume(tmp_path):
+    """IN-RUN fault injection: a worker process evolving with an
+    AutoCheckpointer is SIGKILL'd mid-run (no cleanup, no atexit — the
+    preemption the atomic-save design exists for); a fresh process must
+    restore the last durable checkpoint and resume to completion."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time as _time
+
+    ckpt = tmp_path / "state.npz"
+    marker = tmp_path / "saves.txt"
+    worker_src = f"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+from libpga_tpu import PGA, PGAConfig
+from libpga_tpu.utils.checkpoint import AutoCheckpointer
+
+pga = PGA(seed=11, config=PGAConfig(mutation_rate=0.05))
+for _ in range(4):
+    pga.create_population(256, 16)
+pga.set_objective("onemax")
+ckpt = AutoCheckpointer(pga, {str(ckpt)!r}, every_generations=5)
+for i in range(1000):  # far more work than the parent will allow
+    pga.run_islands(5, 5, 0.1)
+    with open({str(marker)!r}, "a") as f:
+        f.write(f"save {{i}}\\n")
+"""
+    proc = subprocess.Popen(
+        [sys.executable, "-c", worker_src],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    try:
+        # wait until at least two periodic saves are durably on disk,
+        # then kill without warning
+        deadline = _time.time() + 180
+        while _time.time() < deadline:
+            if marker.exists() and len(marker.read_text().splitlines()) >= 2:
+                break
+            if proc.poll() is not None:
+                raise AssertionError("worker exited before being killed")
+            _time.sleep(0.25)
+        else:
+            raise AssertionError("worker never reached two checkpoint saves")
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode != 0  # killed, not exited
+
+    # recovery: a fresh solver restores the durable state and resumes
+    from libpga_tpu import PGA
+    from libpga_tpu.utils import checkpoint
+
+    fresh = PGA(seed=999)
+    checkpoint.restore(fresh, str(ckpt))
+    assert fresh.num_populations == 4
+    fresh.set_objective("onemax")
+    best_restored = max(
+        fresh.get_best_with_score(h)[1] for h in fresh._handles()
+    )
+    assert best_restored > 10.0  # progress from before the kill survived
+    gens = fresh.run_islands(10, 5, 0.1)
+    assert gens == 10  # resumed evolution runs to completion

@@ -256,3 +256,57 @@ def test_run_islands_repeat_calls_reuse_cache():
     n_cached = len(pga._compiled)
     pga.run_islands(10, 5, 0.1)
     assert len(pga._compiled) == n_cached
+
+
+class TestValidationMode:
+    """PGAConfig(validate=True) — the device-sanitizer stand-in
+    (utils/validate.py): clean runs pass; corrupted state is named."""
+
+    def test_clean_run_passes(self):
+        from libpga_tpu import PGA, PGAConfig
+
+        pga = PGA(seed=0, config=PGAConfig(validate=True))
+        h = pga.create_population(256, 16)
+        pga.set_objective("onemax")
+        assert pga.run(5) == 5
+        pga.evaluate(h)
+        pga.crossover(h)
+        pga.mutate(h)
+        pga.swap_generations(h)
+        pga.evaluate(h)
+
+    def test_score_drift_detected(self):
+        import dataclasses
+
+        from libpga_tpu import PGA, PGAConfig
+        from libpga_tpu.population import Population
+        from libpga_tpu.utils.validate import ValidationError
+
+        pga = PGA(seed=0, config=PGAConfig(validate=True))
+        h = pga.create_population(256, 16)
+        pga.set_objective("onemax")
+        pga.run(3)
+        pop = pga.population(h)
+        # corrupt one stored score: the oracle cross-check must name it
+        bad = pop.scores.at[7].add(5.0)
+        pga._populations[h.index] = dataclasses.replace(pop, scores=bad)
+        with pytest.raises(ValidationError, match="drifted"):
+            pga._validate("probe", [0])
+
+    def test_gene_domain_violation_detected(self):
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        from libpga_tpu import PGA, PGAConfig
+        from libpga_tpu.utils.validate import ValidationError
+
+        pga = PGA(seed=0, config=PGAConfig(validate=True))
+        h = pga.create_population(256, 16)
+        pga.set_objective("onemax")
+        pga.run(2)
+        pop = pga.population(h)
+        bad_g = pop.genomes.at[3, 3].set(jnp.float32(jnp.nan))
+        pga._populations[h.index] = dataclasses.replace(pop, genomes=bad_g)
+        with pytest.raises(ValidationError, match="non-finite"):
+            pga._validate("probe", [0])
