@@ -28,6 +28,7 @@ def main():
     pga = lp.pga_init(seed=11)
     h = lp.pga_create_population(pga, pop, n, lp.RANDOM_POPULATION)
     lp.pga_set_objective_function(pga, make_nk_landscape(n, k, seed=0))
+    lp.pga_run(pga, 2)  # compile + warm before timing
     t0 = time.perf_counter()
     gens = lp.pga_run(pga, 50)
     dt = time.perf_counter() - t0

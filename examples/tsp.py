@@ -10,6 +10,13 @@ crossover (``test3/test.cu:48-64``) — here the builtin
 vmapped across the population. Reference budget: pop 1000 × 1000 gens.
 
 Run: python examples/tsp.py [n_cities]
+
+The reference caps at 110 cities (``test3/test.cu:22-24`` — the matrix
+must fit ``__constant__`` memory); here any size runs, on the fused
+kernel's runtime order-crossover walk. Beyond a few hundred cities the
+distance-MATRIX objective's one-hot evaluation is O(L³)/genome, so the
+example switches to the Euclidean coordinate objective
+(``make_tsp_coords``, O(L²)) — try ``python examples/tsp.py 1000``.
 """
 
 import os as _os, sys as _sys
@@ -20,30 +27,54 @@ import sys
 import numpy as np
 
 import libpga_tpu as lp
-from libpga_tpu.objectives import make_tsp, random_tsp_matrix
+from libpga_tpu.objectives import (
+    make_tsp,
+    make_tsp_coords,
+    random_tsp_coords,
+    random_tsp_matrix,
+)
 from libpga_tpu.ops.crossover import order_preserving_crossover
 from libpga_tpu.ops.mutate import make_swap_mutate
 
 
 def main():
     n_cities = int(sys.argv[1]) if len(sys.argv) > 1 else 100
-    matrix = random_tsp_matrix(n_cities, seed=7)  # planted path length: 10*(L-1)
+    euclidean = n_cities > 300
+    if euclidean:
+        coords = random_tsp_coords(n_cities, seed=7)
+        objective = make_tsp_coords(coords)
+    else:
+        matrix = random_tsp_matrix(n_cities, seed=7)  # planted path: 10*(L-1)
+        objective = make_tsp(matrix)
 
     pga = lp.pga_init(seed=5)
-    pop = lp.pga_create_population(pga, 1000, n_cities, lp.RANDOM_POPULATION)
-    lp.pga_set_objective_function(pga, make_tsp(matrix))
+    pop_size = 1000 if not euclidean else 8192
+    gens = 1000  # long tours converge slowly; ~45 gens/sec at 1000 cities
+    pop = lp.pga_create_population(pga, pop_size, n_cities, lp.RANDOM_POPULATION)
+    lp.pga_set_objective_function(pga, objective)
     lp.pga_set_crossover_function(pga, order_preserving_crossover)
     lp.pga_set_mutate_function(pga, make_swap_mutate(rate=0.5))
 
-    lp.pga_run(pga, 1000)
+    lp.pga_run(pga, gens)
 
     best = lp.pga_get_best(pga, pop)
     tour = np.clip(np.floor(best * n_cities).astype(int), 0, n_cities - 1)
     unique = len(set(tour.tolist()))
-    length = float(matrix[tour[:-1], tour[1:]].sum())
     print(f"cities: {n_cities}  unique in best tour: {unique}")
-    print(f"tour length: {length:.0f}  (planted cheap path: {10*(n_cities-1)}, "
-          f"random tour ~{int(matrix.mean() * (n_cities-1))})")
+    if euclidean:
+        xy = coords[tour]
+        length = float(np.sqrt(((xy[1:] - xy[:-1]) ** 2).sum(axis=1)).sum())
+        rand_xy = coords[np.random.default_rng(0).permutation(n_cities)]
+        rand_len = float(
+            np.sqrt(((rand_xy[1:] - rand_xy[:-1]) ** 2).sum(axis=1)).sum()
+        )
+        print(f"tour length: {length:.0f}  (random tour ~{rand_len:.0f})")
+        assert length < 0.8 * rand_len, "no optimization happened"
+    else:
+        length = float(matrix[tour[:-1], tour[1:]].sum())
+        print(f"tour length: {length:.0f}  (planted cheap path: "
+              f"{10*(n_cities-1)}, random tour "
+              f"~{int(matrix.mean() * (n_cities-1))})")
     assert unique == n_cities, "custom crossover must preserve uniqueness"
 
 

@@ -168,12 +168,18 @@ class PGA:
             )
         return self._objective
 
-    def _validate(self, where: str, indices=None, staged: bool = False):
+    def _validate(
+        self, where: str, indices=None, staged: bool = False,
+        oracle: bool = True,
+    ):
         """Runtime validation mode (``config.validate`` — see
         ``utils/validate``): check the named populations' invariants
         against the XLA oracle after a state-installing operation.
         ``staged`` checks the staged next generation's gene domain
-        instead (it has no scores yet)."""
+        instead (it has no scores yet). ``oracle=False`` skips the
+        score re-evaluation — used after :meth:`evaluate`, whose scores
+        COME from the oracle path (comparing it to itself can catch
+        nothing and would double the op's cost)."""
         if not self.config.validate:
             return
         from libpga_tpu.utils.validate import check_population
@@ -203,8 +209,8 @@ class PGA:
             ):
                 continue
             check_population(
-                self._objective, pop.genomes, pop.scores,
-                where=where, index=i,
+                self._objective if oracle else None,
+                pop.genomes, pop.scores, where=where, index=i,
             )
 
     # --------------------------------------------------------- fused run loop
@@ -571,7 +577,7 @@ class PGA:
         pop = self._populations[handle.index]
         scores = self._jitted_evaluate()(pop.genomes)
         self._populations[handle.index] = dataclasses.replace(pop, scores=scores)
-        self._validate("evaluate", [handle.index])
+        self._validate("evaluate", [handle.index], oracle=False)
 
     def evaluate_all(self) -> None:
         for h in self._handles():
