@@ -65,6 +65,7 @@ def check_population(
     relatively (f32 ULP alone is ~0.06 at the TSP objective's 1e6
     magnitudes).
     """
+    raw_dtype = str(getattr(genomes, "dtype", ""))
     g = np.asarray(genomes, dtype=np.float32)
     if not np.isfinite(g).all():
         raise ValidationError(
@@ -74,14 +75,17 @@ def check_population(
     if g.size == 0:
         raise ValidationError(f"{where}: population {index} is empty")
     lo, hi = float(g.min()), float(g.max())
-    if lo < 0.0 or hi >= 1.0:
-        # every operator keeps genes in [0, 1) (gaussian clips to
-        # 1 - 1e-7); exactly 1.0 would decode city/index L, out of range
+    # Operators keep f32 genes strictly below 1 (gaussian clips to
+    # 1 - 1e-7; exactly 1.0 would decode city/index L, out of range) —
+    # but the bf16 gene cast legitimately rounds values >= 1 - 2^-9 up
+    # to exactly 1.0, so the strict bound applies to f32 genomes only.
+    too_high = hi > 1.0 if raw_dtype == "bfloat16" else hi >= 1.0
+    if lo < 0.0 or too_high:
         raise ValidationError(
             f"{where}: population {index} genes outside [0, 1): "
             f"min {lo}, max {hi}"
         )
-    if scores is None or obj is None:
+    if scores is None:
         return
     s = np.asarray(scores, dtype=np.float32)
     if s.shape != (g.shape[0],):
@@ -89,26 +93,38 @@ def check_population(
             f"{where}: population {index} scores shape {s.shape} != "
             f"({g.shape[0]},)"
         )
+    if np.isnan(s).any():
+        raise ValidationError(
+            f"{where}: population {index} scores contain NaN"
+        )
     finite = np.isfinite(s)
     if not finite.any():
         return  # all -inf: not yet evaluated (staged swap)
-    if not finite.all():
-        bad = np.flatnonzero(~finite)
-        raise ValidationError(
-            f"{where}: population {index} has {bad.size} non-finite "
-            f"scores among finite ones (first at row {bad[0]}: "
-            f"{s[bad[0]]}) — stale or overflowed rows"
-        )
+    if obj is None:
+        return
     from libpga_tpu.ops.evaluate import evaluate as _evaluate
 
     oracle = np.asarray(_evaluate(obj, jnp.asarray(g)))
-    tol = atol + rtol * np.abs(oracle)
-    drift = np.abs(oracle - s)
+    # Non-finite stored scores must match the oracle EXACTLY: a
+    # hard-constraint objective legitimately returns -inf for
+    # infeasible rows (and re-evaluates to the same -inf); a stale or
+    # overflowed row does not.
+    nf = ~finite
+    if nf.any() and not np.array_equal(s[nf], oracle[nf]):
+        bad = np.flatnonzero(nf & (s != oracle))
+        raise ValidationError(
+            f"{where}: population {index} has {bad.size} non-finite "
+            f"scores the objective does not reproduce (first at row "
+            f"{bad[0]}: stored {s[bad[0]]}, re-evaluated "
+            f"{oracle[bad[0]]}) — stale or overflowed rows"
+        )
+    tol = atol + rtol * np.abs(oracle[finite])
+    drift = np.abs(oracle[finite] - s[finite])
     if (drift > tol).any():
         k = int((drift - tol).argmax())
         raise ValidationError(
             f"{where}: population {index} scores drifted from the XLA "
-            f"oracle (worst |Δ| {drift[k]:.4g} at row {k}: stored "
-            f"{s[k]:.6g}, re-evaluated {oracle[k]:.6g}) — fused "
-            "kernel scores inconsistent with stored genomes"
+            f"oracle (worst |Δ| {drift[k]:.4g} at finite row {k}: stored "
+            f"{s[finite][k]:.6g}, re-evaluated {oracle[finite][k]:.6g}) — "
+            "fused kernel scores inconsistent with stored genomes"
         )

@@ -289,6 +289,33 @@ def main() -> int:
             lowered = False
     good &= check("expression ops lower fused through Mosaic", lowered)
 
+    # Composition checks, under validation mode (the XLA-oracle
+    # cross-check runs on every installed state): a long genome
+    # (Lp > LANE) through the fused run, and an expression objective
+    # with a vector constant through the island multigen epoch.
+    solver = PGA(seed=0, config=PGAConfig(use_pallas=True, validate=True))
+    hl = solver.create_population(65536, 1500)
+    solver.set_objective("onemax")
+    solver.run(10)
+    _, bl = solver.get_best_with_score(hl)
+    good &= check(
+        f"long genome L=1500 fused+validated (best {bl:.0f}/1500)",
+        bl > 760,
+    )
+    w = np.linspace(0.5, 1.5, 64).astype(np.float32)
+    solver2 = PGA(seed=1, config=PGAConfig(use_pallas=True, validate=True))
+    for _ in range(4):
+        solver2.create_population(16384, 64)
+    solver2.set_objective(from_expression("dot(w, g)", w=w))
+    solver2.run_islands(20, 10, 0.05)
+    b2 = max(
+        solver2.get_best_with_score(h2)[1] for h2 in solver2._handles()
+    )
+    good &= check(
+        f"expr objective + island multigen epoch (best {b2:.1f}/{w.sum():.1f})",
+        b2 > 0.8 * float(w.sum()),
+    )
+
     print("ALL PASS" if good else "FAILURES", flush=True)
     return 0 if good else 1
 
