@@ -194,10 +194,14 @@ def bench_islands() -> dict:
 def main() -> None:
     import jax.numpy as jnp
 
+    # Islands measured immediately after the f32 single-population run:
+    # their RATIO is a tracked health figure, and the chip's throughput
+    # drifts within a process (±5-10% over minutes) — adjacent
+    # measurement keeps the ratio honest.
     f32 = bench_single(jnp.float32)
+    isl = bench_islands()
     bf16 = bench_single(jnp.bfloat16)
     ref = bench_reference_scale()
-    isl = bench_islands()
 
     baseline_gps = 1.0 / reference_floor_seconds_per_gen()
     out = {
