@@ -64,18 +64,19 @@ class PGAConfig:
         XLA path only for sub-tile populations (< 128) or when every
         padded fit would leave a degenerate tail deme.
       pallas_generations_per_launch: generations bred per fused-kernel
-        launch in ``PGA.run``. ``None`` (default) = auto: the measured
-        per-dtype sweet spot (``ops/pallas_step.multigen_default_t`` —
-        8 for f32, 1 for bf16) when the objective evaluates in-kernel,
-        else 1. Values > 1 hold each deme group VMEM-resident across
-        that many generations (amortizing the exposed part of the HBM
-        round trip; measured +3–6% for f32 at 1M-population scale) at
-        the cost of deme isolation within the launch — the inter-deme
-        riffle reshuffle then happens every T generations instead of
-        every generation (convergence impact unmeasurable at T <= 8,
-        see BASELINE.md) — and launch-granularity target checks. Set 1
-        for the one-generation kernel (per-generation riffle and exact
-        target-generation reporting).
+        launch. ``None`` (default) = auto: ``PGA.run`` uses the
+        one-generation kernel (an interleaved A/B showed the
+        multi-generation launch amortization is within measurement
+        drift on single populations — BASELINE.md round 4), while f32
+        ``run_islands`` uses one multi-generation launch per migration
+        interval (a structural, reproducible win; bf16 islands measured
+        faster one-generation and keep it). An explicit value rules
+        both paths: > 1 holds each deme group VMEM-resident across that
+        many generations — the inter-deme riffle reshuffle then happens
+        every T generations instead of every generation (convergence
+        impact unmeasurable at T <= 8, see BASELINE.md) and target
+        checks gain launch granularity; 1 forces the one-generation
+        kernel everywhere.
       donate_buffers: donate the genome buffer to jit so XLA updates it in
         place (the TPU-native replacement for the reference's
         current/next-generation pointer swap, ``pga.h:124-129``).

@@ -430,7 +430,6 @@ class PGA:
         from libpga_tpu.ops.pallas_step import (
             make_pallas_breed,
             make_pallas_multigen,
-            multigen_default_t,
         )
 
         # Cached: runner caching downstream keys on the breed's identity,
@@ -445,16 +444,20 @@ class PGA:
         if cache_key in self._compiled:
             return self._compiled[cache_key]
         # Multi-generation breed first: the island epoch then runs as
-        # ceil(m/T) vmapped launches with in-kernel ranking instead of m
-        # per-generation launches + a hoisted host-side rank sort
-        # (islands.make_multigen_stacked_epoch). Same auto policy as
-        # PGA.run; an explicit config T=1 keeps the one-generation path.
-        T = self.config.pallas_generations_per_launch
-        if T is None:
-            T = multigen_default_t(self.config.gene_dtype)
-        if T > 1 and fused is None and (
-            self.config.pallas_generations_per_launch is not None
-        ):
+        # ONE vmapped launch per migration interval with in-kernel
+        # ranking instead of m per-generation launches + a hoisted
+        # host-side rank sort (islands.make_multigen_stacked_epoch).
+        # Interleaved A/B: statistically TIED with the one-generation
+        # island path on throughput (BASELINE.md round 4) — kept as the
+        # f32 default for structural simplicity; off for bf16 (measured
+        # faster one-generation). An explicit config value rules either
+        # way (1 = one-generation, >1 = epoch chunk cap).
+        T_cfg = self.config.pallas_generations_per_launch
+        if T_cfg is not None:
+            use_island_multigen = T_cfg > 1
+        else:
+            use_island_multigen = self.config.gene_dtype == jnp.float32
+        if use_island_multigen and fused is None and T_cfg is not None:
             # Same contract as make_pallas_run: an explicitly requested
             # T > 1 must not degrade silently, including for objectives
             # without an in-kernel form.
@@ -467,7 +470,7 @@ class PGA:
                 " form — islands fall back to the one-generation path",
                 stacklevel=3,
             )
-        if T > 1 and fused is not None:
+        if use_island_multigen and fused is not None:
             bm = make_pallas_multigen(
                 island_size,
                 genome_len,
@@ -543,13 +546,13 @@ class PGA:
         implementation.
 
         Returns the number of generations actually executed. Without a
-        target this is exactly ``n``. With a target, the multi-generation
-        kernel (``config.pallas_generations_per_launch``; f32 default 8)
-        checks it once per launch, so the count on early exit is a
-        multiple of T — up to T-1 high — and a mid-launch achiever is
-        preserved by the kernel's group freeze. Set
-        ``pallas_generations_per_launch=1`` for exact target-generation
-        reporting.
+        target this is exactly ``n``; with one, the default
+        (one-generation kernel) reports the exact reaching generation.
+        An EXPLICIT ``config.pallas_generations_per_launch`` > 1 runs
+        the multi-generation kernel, which checks the target once per
+        launch — the count on early exit is then a multiple of T (up to
+        T-1 high) and a mid-launch achiever is preserved by the
+        kernel's group freeze.
         """
         handle = population or PopulationHandle(0)
         pop = self._populations[handle.index]

@@ -1286,18 +1286,30 @@ def make_pallas_breed(
 def multigen_default_t(gene_dtype) -> int:
     """Default sub-generations per launch for ``PGA.run``'s fused loop.
 
-    Measured at 1M×100 OneMax (BASELINE.md round 4): the single-
-    generation kernel's grid pipeline already hides most of the HBM
-    round trip under compute, so T only amortizes the *exposed* sliver
-    — f32 gains +3–6% at T=8–16 (the in-kernel rank cube costs about
-    what the amortization saves), bf16 nothing (its launches are
-    cheaper to begin with). Convergence drag from the T-generation
-    deme-isolation window is unmeasurable at T<=8 (OneMax 131k×100 mean
-    score after 64 gens, K=512: 97.19 at T=1 vs 97.15 at T=8 —
-    tools/selection_equivalence.py table in BASELINE.md), so f32
-    defaults to 8 and bf16 stays on the one-generation kernel.
+    1 for every dtype — measured at 1M×100 OneMax (BASELINE.md round
+    4): the single-generation kernel's grid pipeline already hides most
+    of the HBM round trip under compute, and the in-kernel rank cube
+    costs about what the /T amortization saves. Early same-process
+    comparisons suggested +3–6% for f32 at T=8–16, but an INTERLEAVED
+    A/B (5 alternating measurement rounds in one process) put the
+    medians at T=1 142.6 vs T=8 135.5 gens/sec — the apparent wins were
+    within-process drift. T > 1 remains available via
+    ``pallas_generations_per_launch`` (note it trades exact
+    target-generation reporting and per-generation deme mixing for the
+    launch amortization).
+
+    The ISLAND path differs structurally: one whole-epoch launch per
+    migration interval replaces m per-generation launches plus a
+    host-side rank sort. A 6-round interleaved A/B against the
+    one-generation island path is a statistical tie (medians 128.6 vs
+    132.0 on the 8×131k bench shape; ordering flips with chip state) —
+    f32 islands keep the multi-generation epoch as their default for
+    its simplicity, not a measured speedup
+    (``engine._pallas_island_breed``); bf16 islands measured faster on
+    the one-generation path (175 vs 155) and keep it.
     """
-    return 8 if gene_dtype == jnp.float32 else 1
+    del gene_dtype
+    return 1
 
 
 def _multigen_blocks_fit(
