@@ -62,6 +62,7 @@ int main(void) {
     if (pga_run_n(p, GENS) < 0)
         return fprintf(stderr, "sphere run failed\n"), 1;
     gene *best = pga_get_best(p, pop);
+    if (!best) return fprintf(stderr, "get_best failed\n"), 1;
     float err = 0.0f;
     for (unsigned i = 0; i < LEN; i++)
         err += (best[i] - 0.5f) * (best[i] - 0.5f);

@@ -199,6 +199,13 @@ def create_population(handle: int, size: int, genome_len: int, ptype: int) -> in
     if init_name is None:
         raise ValueError(f"unknown population_type {ptype}")
     pga = _solver(handle)
+    # An expression objective with vector constants implies a genome
+    # length; set_objective_expr checks populations that exist AT
+    # REGISTRATION time, so re-check here for populations created
+    # AFTERWARD — same diagnostic, at the call that introduces the
+    # mismatch, instead of a raw broadcast error inside the first
+    # jitted evaluate.
+    _check_expr_const_lens(pga._objective, {genome_len})
     return pga.create_population(size, genome_len, init=init_name).index
 
 
@@ -231,9 +238,24 @@ def set_objective_expr(handle: int, expr: str) -> None:
     # gene axis); catch a mismatch with the solver's populations HERE,
     # with a diagnostic, rather than as a raw broadcast error inside the
     # first jitted evaluate (the header promises shape errors -> -1 at
-    # set time).
-    genome_lens = {p.genome_len for p in pga.populations}
-    for c in obj.kernel_rowwise_consts:
+    # set time). create_population runs the same check for populations
+    # created after this registration.
+    _check_expr_const_lens(obj, {p.genome_len for p in pga.populations})
+    pga.set_objective(obj)
+    _set_host_op(handle, "obj", False)
+
+
+def _check_expr_const_lens(obj, genome_lens) -> None:
+    """The one vector-constant/genome-length diagnostic, shared by
+    set_objective_expr (existing populations) and create_population
+    (populations added after the expression was installed). Scoped to
+    EXPRESSION objectives (from_expression stamps ``.expression``):
+    builtins also carry kernel_rowwise_consts, but setting one by name
+    and creating a differently-shaped population afterward was always
+    legal (the caller may install a matching objective later)."""
+    if getattr(obj, "expression", None) is None:
+        return
+    for c in getattr(obj, "kernel_rowwise_consts", None) or ():
         n = c.shape[-1]
         if n > 1 and genome_lens and n not in genome_lens:
             raise ValueError(
@@ -241,8 +263,6 @@ def set_objective_expr(handle: int, expr: str) -> None:
                 f"solver's population genome length is "
                 f"{sorted(genome_lens)}"
             )
-    pga.set_objective(obj)
-    _set_host_op(handle, "obj", False)
 
 
 def set_objective_expr_const(handle: int, name: str, data: bytes) -> None:

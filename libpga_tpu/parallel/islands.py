@@ -178,16 +178,16 @@ def make_multigen_stacked_epoch(bm: Callable, m: int) -> Callable:
     """
     Lp, Pp = bm.Lp, bm.Pp
     gdtype = bm.gene_dtype
-    # Whole-epoch launches up to T=16 by default: migration already
-    # bounds the mixing horizon at m, and the measured convergence drag
-    # at T=16 is small (BASELINE.md multigen table: takeover 70.4 vs
-    # 66.6 gens, 64-gen OneMax mean -0.10) — cheaper than paying the
-    # launch's HBM round trip twice per epoch (an 8+2 split for m=10
-    # measured ~4% slower than one 10-generation launch). An EXPLICIT
+    # Whole-epoch launches up to T=8 by default: 8 is the measured
+    # convergence-NEUTRAL bound (BASELINE.md multigen table: takeover
+    # 67.2 vs 66.6 gens, 64-gen OneMax mean -0.04), while T=16 shows
+    # measurable drag (takeover 70.4, mean -0.11) and the throughput
+    # A/B against the one-generation island path is a statistical tie —
+    # there is no speed to buy convergence with. An EXPLICIT
     # config.pallas_generations_per_launch still rules: the engine
     # stamps it on the breed (``epoch_chunk``) so the documented knob
     # bounds island launches exactly like single-population runs.
-    T = getattr(bm, "epoch_chunk", None) or 16
+    T = getattr(bm, "epoch_chunk", None) or 8
 
     def epoch(genomes, scores, keys, mparams=None):
         I, S, L = genomes.shape

@@ -145,6 +145,31 @@ def test_capi_expression_objective_stays_on_device(built_shim):
         cb.deinit(h)
 
 
+def test_expr_vector_const_checked_at_create_population(built_shim):
+    """A population created AFTER an expression objective with vector
+    constants is installed gets the same set-time length diagnostic as
+    one existing before (round-4 advisor finding) — not a raw broadcast
+    error inside the first jitted evaluate."""
+    import numpy as np
+    import pytest
+
+    from libpga_tpu import capi_bridge as cb
+
+    h = cb.init(7)
+    try:
+        cb.set_objective_expr_const(
+            h, "w", np.arange(16, dtype=np.float32).tobytes()
+        )
+        cb.set_objective_expr(h, "dot(w, g)")  # no populations yet: ok
+        cb.create_population(h, 128, 16, 0)  # matching length: ok
+        with pytest.raises(ValueError, match="length-16 vector constant"):
+            cb.create_population(h, 128, 24, 0)
+        assert cb._solver(h).num_populations == 1  # failed create added none
+        cb.evaluate(h, 0)
+    finally:
+        cb.deinit(h)
+
+
 def test_rowloop_batched_marshaling_speedup_and_parity(built_shim, tmp_path):
     """Host-callback marshaling must loop over rows in C, not Python:
     one Python<->C crossing per generation (round-2 verdict finding).

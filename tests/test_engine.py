@@ -293,6 +293,39 @@ class TestValidationMode:
         with pytest.raises(ValidationError, match="drifted"):
             pga._validate("probe", [0])
 
+    def test_f32_tolerance_catches_centi_scale_drift(self):
+        """The oracle atol is dtype-aware: a 0.01-magnitude fused-score
+        error on an f32 population (real-bug size — the 100-gene sum's
+        ULP is ~1e-5) must be CAUGHT, while the same perturbation on
+        bf16 genomes stays inside that dtype's legitimate ~1e-2
+        accumulation band."""
+        import numpy as np
+
+        from libpga_tpu.objectives import get as get_obj
+        from libpga_tpu.utils.validate import (
+            ValidationError, check_population,
+        )
+
+        rng = np.random.default_rng(3)
+        g32 = rng.random((64, 100), dtype=np.float32)
+        obj = get_obj("onemax")
+        from libpga_tpu.ops.evaluate import evaluate as _evaluate
+
+        import jax.numpy as jnp
+
+        s = np.asarray(_evaluate(obj, jnp.asarray(g32)))
+        check_population(obj, jnp.asarray(g32), s, where="probe")  # clean
+        bad = s.copy()
+        bad[5] += 0.01
+        with pytest.raises(ValidationError, match="drifted"):
+            check_population(obj, jnp.asarray(g32), bad, where="probe")
+        # bf16 genomes: the SAME 0.01 drift is inside the dtype band
+        g16 = jnp.asarray(g32).astype(jnp.bfloat16)
+        s16 = np.asarray(_evaluate(obj, g16.astype(jnp.float32)))
+        bad16 = s16.copy()
+        bad16[5] += 0.01
+        check_population(obj, g16, bad16, where="probe")
+
     def test_gene_domain_violation_detected(self):
         import dataclasses
 

@@ -151,7 +151,7 @@ def test_engine_run_islands_heterogeneous_fallback():
 
 def test_multigen_stacked_epoch_runs_islands():
     """The multi-generation island epoch (one vmapped kernel launch per
-    <=16-generation chunk, in-kernel ranking) drives run_islands_stacked
+    <=8-generation chunk by default, in-kernel ranking) drives run_islands_stacked
     end-to-end in interpret mode: generations counted exactly, scores
     consistent with genomes, migration applied."""
     from jax.experimental.pallas import tpu as pltpu

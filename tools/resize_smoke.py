@@ -30,15 +30,29 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 GLOBAL_DEVICES = 8
 ISLANDS, SIZE, LENGTH = 8, 256, 16
-STAGES = [  # (num_processes, coordinator_port, restore_first)
-    (4, 12431, False),
-    (2, 12432, True),
-    (4, 12433, True),
+STAGES = [  # (num_processes, restore_first)
+    (4, False),
+    (2, True),
+    (4, True),
 ]
 
 
+def _free_port() -> int:
+    """A port the OS says is free RIGHT NOW. Hard-coded ports collide
+    with concurrent smokes or a lingering TIME_WAIT listener; binding 0
+    per stage makes the coordinator address collision-free in practice
+    (the race between probe-close and coordinator-bind is the standard
+    accepted one)."""
+    import socket
+
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def worker(stage: int, process_id: int) -> None:
-    num_procs, port, restoring = STAGES[stage]
+    num_procs, restoring = STAGES[stage]
+    port = int(os.environ["PGA_RESIZE_PORT"])
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
@@ -105,7 +119,8 @@ def worker(stage: int, process_id: int) -> None:
 
 
 def _run_stage(stage: int, env) -> int:
-    num_procs, _, _ = STAGES[stage]
+    num_procs, _ = STAGES[stage]
+    env = dict(env, PGA_RESIZE_PORT=str(_free_port()))
     procs = [
         subprocess.Popen(
             [
@@ -152,7 +167,7 @@ def main() -> int:
         if rc != 0:
             print(f"RESIZE SMOKE: FAIL (stage {stage})")
             return rc
-        n, _, restoring = STAGES[stage]
+        n, restoring = STAGES[stage]
         print(
             f"stage {stage} ok: {n} processes"
             + (" (restored from previous stage)" if restoring else "")
