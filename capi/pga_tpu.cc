@@ -93,6 +93,18 @@ int pga_set_objective_expr_const(pga_t *p, const char *name,
         static_cast<Py_ssize_t>(n * sizeof(float))));
 }
 
+int pga_set_objective_expr_const2(pga_t *p, const char *name,
+                                  const float *data, unsigned rows,
+                                  unsigned cols) {
+    if (!p || !name || (rows && cols && !data)) return -1;
+    return static_cast<int>(call_long(
+        "set_objective_expr_const2", "(lsy#II)", solver_of(p), name,
+        reinterpret_cast<const char *>(data),
+        static_cast<Py_ssize_t>(static_cast<size_t>(rows) * cols *
+                                sizeof(float)),
+        rows, cols));
+}
+
 int pga_set_selection(pga_t *p, enum crossover_selection_type type,
                       float param) {
     if (!p) return -1;

@@ -111,13 +111,43 @@ int pga_set_objective_name(pga_t *p, const char *name);
  *                               " dot(v, floor(g*2)),"
  *                               " 100 - dot(w, floor(g*2)))");
  *
- * Constants (scalar: n == 1; per-gene vector: n == genome_len) must be
- * registered BEFORE the pga_set_objective_expr call that uses them.
+ * v2 (indexed/adjacency primitives):
+ *   - statements: `name = expr;` bindings before the final expression,
+ *     so decode/lookup/reduce stages are written once;
+ *   - `roll(x, k)`: circular shift along the gene axis by an integer
+ *     literal k — roll(x,k)[i] = x[(i+k) mod L];
+ *   - `gather(t, idx)`: bounded table lookup; `t` must be a registered
+ *     constant (1-D of n entries: shared table t[idx[i]]; 2-D n x L via
+ *     pga_set_objective_expr_const2: per-locus table t[idx[i]][i] — the
+ *     NK-landscape form). idx is floored and clipped into the table;
+ *     n is capped at 512 entries.
+ *   NK landscape (n=16, k=3, table T of 16 rows x 16 loci):
+ *     pga_set_objective_expr_const2(p, "T", table, 16, 16);
+ *     pga_set_objective_expr(p,
+ *         "b = g >= 0.5;"
+ *         "codes = b + 2*roll(b,1) + 4*roll(b,2) + 8*roll(b,3);"
+ *         "mean(gather(T, codes))");
+ *   Euclidean tour cost (C city coordinates in X/Y):
+ *     pga_set_objective_expr_const(p, "X", xs, C);  // 1-D table: its
+ *     pga_set_objective_expr_const(p, "Y", ys, C);  // length is the
+ *     pga_set_objective_expr(p,                     // INDEX domain,
+ *         "c = floor(g * L);"                       // not genome_len
+ *         "x = gather(X, c); y = gather(Y, c);"
+ *         "dx = roll(x, 1) - x; dy = roll(y, 1) - y;"
+ *         "-sum(where(i < L - 1, sqrt(dx*dx + dy*dy + 1e-12), 0))");
+ *
+ * Constants (scalar: n == 1; per-gene vector: n == genome_len; gather
+ * tables: any n <= 512) must be registered BEFORE the
+ * pga_set_objective_expr call that uses them. _const2 registers a 2-D
+ * rows x cols matrix (row-major), usable only as a gather table.
  * Returns 0, or -1 for any syntax/name/arity/shape error (diagnostic
  * with a character position on stderr). */
 int pga_set_objective_expr(pga_t *p, const char *expr);
 int pga_set_objective_expr_const(pga_t *p, const char *name,
                                  const float *data, unsigned n);
+int pga_set_objective_expr_const2(pga_t *p, const char *name,
+                                  const float *data, unsigned rows,
+                                  unsigned cols);
 
 /* Result extraction (pga.h:90-93). Return malloc'd gene arrays (caller
  * frees), genome_len genes per row; NULL on error — including a _top

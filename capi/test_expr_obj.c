@@ -75,7 +75,47 @@ int main(void) {
     if (err > 2.0f)
         return fprintf(stderr, "sphere did not converge\n"), 1;
 
+    /* NK-style epistatic objective via the v2 primitives: bindings,
+     * roll, and a per-locus gather table registered with _const2. The
+     * table rewards 1-bits in each 4-bit neighborhood code (entry =
+     * popcount(code)/4), so the optimum is all-ones with mean
+     * contribution 1.0 — the GA must clear ~0.85 from a random ~0.5. */
+    pga_deinit(p);
+    p = pga_init(23);
+    if (!p) return fprintf(stderr, "pga_init 3 failed\n"), 1;
+    pop = pga_create_population(p, POP, LEN, RANDOM_POPULATION);
+    if (!pop) return fprintf(stderr, "create_population 3 failed\n"), 1;
+    float table[16 * LEN];
+    for (unsigned c = 0; c < 16; c++) {
+        unsigned bits = (c & 1) + ((c >> 1) & 1) + ((c >> 2) & 1) + ((c >> 3) & 1);
+        for (unsigned i = 0; i < LEN; i++)
+            table[c * LEN + i] = (float)bits / 4.0f;
+    }
+    if (pga_set_objective_expr_const2(p, "T", table, 16, LEN) != 0)
+        return fprintf(stderr, "expr_const2 failed\n"), 1;
+    if (pga_set_objective_expr(p,
+            "b = g >= 0.5;"
+            "codes = b + 2*roll(b, 1) + 4*roll(b, 2) + 8*roll(b, 3);"
+            "mean(gather(T, codes))") != 0)
+        return fprintf(stderr, "NK expression failed\n"), 1;
+    if (pga_run_n(p, GENS) < 0)
+        return fprintf(stderr, "NK run failed\n"), 1;
+    gene *nkbest = pga_get_best(p, pop);
+    if (!nkbest) return fprintf(stderr, "NK get_best failed\n"), 1;
+    float ones = 0.0f;
+    for (unsigned i = 0; i < LEN; i++) ones += nkbest[i] >= 0.5f ? 1.0f : 0.0f;
+    free(nkbest);
+    printf("NK-expr best ones: %.0f of %d\n", ones, LEN);
+    if (ones < 0.85f * LEN)
+        return fprintf(stderr, "NK expression did not converge\n"), 1;
+
     /* error paths: each must return -1 and leave the solver usable */
+    if (pga_set_objective_expr_const2(p, "bad", table, 0, LEN) == 0)
+        return fprintf(stderr, "const2 zero rows accepted\n"), 1;
+    if (pga_set_objective_expr(p, "sum(T * g)") == 0)
+        return fprintf(stderr, "elementwise 2-D const accepted\n"), 1;
+    if (pga_set_objective_expr(p, "sum(roll(g, L))") == 0)
+        return fprintf(stderr, "non-literal roll shift accepted\n"), 1;
     if (pga_set_objective_expr(p, "sum(") == 0)
         return fprintf(stderr, "bad syntax accepted\n"), 1;
     if (pga_set_objective_expr(p, "sum(nosuch * g)") == 0)

@@ -252,23 +252,49 @@ def _check_expr_const_lens(obj, genome_lens) -> None:
     EXPRESSION objectives (from_expression stamps ``.expression``):
     builtins also carry kernel_rowwise_consts, but setting one by name
     and creating a differently-shaped population afterward was always
-    legal (the caller may install a matching objective later)."""
+    legal (the caller may install a matching objective later). The
+    pinned length comes from the compiler (``pinned_genome_len``): it
+    counts only constants that pair with the gene axis — a 1-D gather
+    TABLE's length is an index domain, not a genome length."""
     if getattr(obj, "expression", None) is None:
         return
-    for c in getattr(obj, "kernel_rowwise_consts", None) or ():
-        n = c.shape[-1]
-        if n > 1 and genome_lens and n not in genome_lens:
-            raise ValueError(
-                f"expression uses a length-{n} vector constant but the "
-                f"solver's population genome length is "
-                f"{sorted(genome_lens)}"
-            )
+    n = getattr(obj, "pinned_genome_len", None)
+    if n and genome_lens and n not in genome_lens:
+        raise ValueError(
+            f"expression uses a length-{n} vector constant but the "
+            f"solver's population genome length is "
+            f"{sorted(genome_lens)}"
+        )
 
 
 def set_objective_expr_const(handle: int, name: str, data: bytes) -> None:
     """Register/replace a named constant (raw little-endian float32
     bytes; one value = scalar, else a length-L vector) for use by a
     SUBSEQUENT set_objective_expr call on this solver."""
+    arr = _expr_const_array(handle, name, data)
+    if arr.size == 1:
+        arr = arr.reshape(())
+    _expr_consts.setdefault(handle, {})[name] = arr
+
+
+def set_objective_expr_const2(
+    handle: int, name: str, data: bytes, rows: int, cols: int
+) -> None:
+    """Register/replace a 2-D rows×cols constant (row-major float32
+    bytes) — a per-locus gather table for the expression surface
+    (``pga_set_objective_expr_const2``); the compiler rejects any other
+    use of a 2-D constant."""
+    arr = _expr_const_array(handle, name, data)
+    if rows <= 0 or cols <= 0 or arr.size != rows * cols:
+        raise ValueError(
+            f"constant {name!r}: {arr.size} values do not fill "
+            f"{rows}x{cols}"
+        )
+    _expr_consts.setdefault(handle, {})[name] = arr.reshape(rows, cols)
+
+
+def _expr_const_array(handle: int, name: str, data: bytes) -> np.ndarray:
+    """Shared validation for the expression-constant registrations."""
     from libpga_tpu.objectives.expr import _KEYWORDS
 
     _solver(handle)  # validate before mutating
@@ -281,10 +307,7 @@ def set_objective_expr_const(handle: int, name: str, data: bytes) -> None:
         raise ValueError(f"constant name {name!r} shadows a builtin name")
     if not data:
         raise ValueError(f"constant {name!r} has no values (n == 0)")
-    arr = np.frombuffer(data, dtype=np.float32).copy()
-    if arr.size == 1:
-        arr = arr.reshape(())
-    _expr_consts.setdefault(handle, {})[name] = arr
+    return np.frombuffer(data, dtype=np.float32).copy()
 
 
 def set_objective_ptr(handle: int, addr: int) -> None:

@@ -26,6 +26,24 @@ The language (safe, no ``eval``; a ~100-line recursive-descent parser):
   two-argument ``min(a, b)`` / ``max(a, b)``
 - reductions ``sum(x) mean(x) min(x) max(x)`` (one-argument min/max
   reduce), ``dot(a, b)`` = ``sum(a*b)``
+- **v2 — indexed/adjacency primitives** (verdict round 4 item 4):
+
+  - ``name = expr;`` statements before the final expression bind
+    locals, so multi-stage objectives (decode, then look up, then
+    reduce) are written once instead of inlined repeatedly;
+  - ``roll(x, k)`` — circular shift along the gene axis by an INTEGER
+    LITERAL ``k``: ``roll(x, k)[i] = x[(i+k) mod L]``. Lowers as a
+    lane-axis concat of two static slices — the same Mosaic-friendly
+    form the builtin NK objective uses (``classic.py make_nk_landscape``),
+    no gather;
+  - ``gather(t, idx)`` — bounded table lookup: ``t`` must be a
+    REGISTERED CONSTANT, ``idx`` any per-gene value (floored and
+    clipped into the table). A 1-D ``t`` of length n is a shared
+    table (``t[idx[i]]``); a 2-D ``t`` of shape (n, L) is a
+    per-locus table (``t[idx[i], i]`` — the NK form). Lowers as a
+    masked accumulation over the n table entries (pure VPU compare+
+    select — TPU gathers cost ~10 ns/element and do not lower in
+    Mosaic), so n is capped at 512 entries.
 
 The top-level expression must reduce to one scalar per genome. Higher
 is better, as everywhere in the library.
@@ -39,6 +57,17 @@ Examples::
         "where(dot(w, floor(g*2)) <= cap,"
         " dot(v, floor(g*2)), cap - dot(w, floor(g*2)))",
         w=weights, v=values, cap=100.0)                # reference test2
+    from_expression(                                   # NK landscape
+        "b = g >= 0.5;"
+        "codes = b + 2*roll(b, 1) + 4*roll(b, 2) + 8*roll(b, 3);"
+        "mean(gather(T, codes))",
+        T=table_t)                                     # (2^(k+1), n)
+    from_expression(                                   # Euclidean tour cost
+        "c = floor(g * L);"
+        "x = gather(X, c); y = gather(Y, c);"
+        "dx = roll(x, 1) - x; dy = roll(y, 1) - y;"
+        "-sum(where(i < L - 1, sqrt(dx*dx + dy*dy + 1e-12), 0))",
+        X=coords[:, 0], Y=coords[:, 1])
 """
 
 from __future__ import annotations
@@ -64,15 +93,21 @@ _ELEMENTWISE = {
 }
 _CONSTANTS = {"pi": math.pi, "e": math.e}
 _KEYWORDS = (
-    ["g", "i", "L", "where", "dot", "sum", "mean", "min", "max"]
+    ["g", "i", "L", "where", "dot", "sum", "mean", "min", "max",
+     "roll", "gather"]
     + list(_ELEMENTWISE) + list(_CONSTANTS)
 )
+
+# Masked-accumulation gather unrolls one compare+select per table entry;
+# beyond this the kernel program size and VPU cost stop making sense —
+# use a builtin objective (or a coords decomposition) instead.
+_GATHER_MAX_ENTRIES = 512
 
 
 # ------------------------------------------------------------------ lexer
 
 _TWO_CHAR = ("**", "<=", ">=", "==")
-_ONE_CHAR = "+-*/%(),<>"
+_ONE_CHAR = "+-*/%(),<>=;"
 
 
 def _tokenize(src: str) -> List[Tuple[str, str, int]]:
@@ -130,11 +165,14 @@ def _tokenize(src: str) -> List[Tuple[str, str, int]]:
 
 
 class _Parser:
-    def __init__(self, src: str, const_names):
+    def __init__(self, src: str, const_names, var_names=("g", "i", "L")):
         self.src = src
         self.toks = _tokenize(src)
         self.k = 0
         self.const_names = const_names
+        self.var_names = set(var_names)  # role-dependent: objectives see
+        # g/i/L, breeding expressions their own sets (expr_breed.py)
+        self.locals: List[str] = []  # ``name = expr;`` bindings, in order
 
     def peek(self):
         return self.toks[self.k]
@@ -152,13 +190,46 @@ class _Parser:
             )
 
     def parse(self):
+        """``name = expr; ... ; final_expr`` — zero or more bindings,
+        then the result expression (optionally semicolon-terminated).
+        Bindings evaluate in order and are visible to everything after
+        them; returns ``("prog", [(name, ast), ...], final_ast)`` (or
+        just the final AST when there are no bindings)."""
+        stmts = []
+        while (
+            self.peek()[0] == "name"
+            and self.toks[self.k + 1][1] == "="
+        ):
+            _, name, pos = self.next()
+            self.next()  # '='
+            if name in _KEYWORDS or name in self.var_names:
+                raise ExpressionError(
+                    f"cannot bind {name!r} at position {pos}: it is a "
+                    f"builtin name"
+                )
+            if name in self.const_names:
+                raise ExpressionError(
+                    f"cannot bind {name!r} at position {pos}: it is a "
+                    f"registered constant"
+                )
+            if name in self.locals:
+                raise ExpressionError(
+                    f"{name!r} rebound at position {pos}; bindings are "
+                    f"single-assignment"
+                )
+            rhs = self.comparison()
+            self.expect(";")
+            stmts.append((name, rhs))
+            self.locals.append(name)
         node = self.comparison()
+        if self.peek()[1] == ";":
+            self.next()  # tolerate a trailing semicolon
         kind, tok, pos = self.peek()
         if kind != "end":
             raise ExpressionError(
                 f"unexpected {tok!r} at position {pos}"
             )
-        return node
+        return ("prog", stmts, node) if stmts else node
 
     def comparison(self):
         node = self.addsub()
@@ -213,18 +284,23 @@ class _Parser:
                     args.append(self.comparison())
                 self.expect(")")
                 return self._call(tok, args, pos)
-            if tok in ("g", "i", "L"):
+            if tok in self.var_names:
                 return ("var", tok)
             if tok in _CONSTANTS:
                 return ("num", _CONSTANTS[tok])
             if tok in self.const_names:
                 return ("const", tok)
+            if tok in self.locals:
+                return ("local", tok)
+            names = ", ".join(sorted(self.var_names))
             raise ExpressionError(
-                f"unknown name {tok!r} at position {pos}; available: g, i, "
-                f"L, pi, e" + (
+                f"unknown name {tok!r} at position {pos}; available: "
+                f"{names}, pi, e" + (
                     f", constants {sorted(self.const_names)}"
                     if self.const_names else
                     " (no constants registered)"
+                ) + (
+                    f", locals {self.locals}" if self.locals else ""
                 )
             )
         raise ExpressionError(
@@ -253,12 +329,52 @@ class _Parser:
                     f"{fname}() takes 1 (reduction) or 2 (elementwise) "
                     f"arguments, got {len(args)} at position {pos}"
                 )
+        elif fname == "roll":
+            need(2)
+            k = _static_number(args[1])
+            if k is None or k != int(k):
+                raise ExpressionError(
+                    f"roll() shift must be an integer literal at position "
+                    f"{pos} (it sets the static slice layout)"
+                )
+            return ("roll", int(k), args[0])
+        elif fname == "gather":
+            need(2)
+            if args[0][0] != "const":
+                raise ExpressionError(
+                    f"gather()'s first argument at position {pos} must be "
+                    f"a registered constant (the lookup table)"
+                )
+            return ("gather", args[0][1], args[1])
         else:
             raise ExpressionError(
                 f"unknown function {fname!r} at position {pos}; available: "
-                f"{sorted(set(_ELEMENTWISE) | {'sum', 'mean', 'min', 'max', 'where', 'dot'})}"
+                f"{sorted(set(_ELEMENTWISE) | {'sum', 'mean', 'min', 'max', 'where', 'dot', 'roll', 'gather'})}"
             )
         return ("call", fname, args)
+
+
+def _static_number(node):
+    """Fold a numeric-literal subtree (numbers under unary +/- and the
+    four basic operators) to a Python float, or None if it references
+    anything runtime."""
+    if node[0] == "num":
+        return node[1]
+    if node[0] == "un":
+        v = _static_number(node[2])
+        return None if v is None else (-v if node[1] == "-" else v)
+    if node[0] == "bin" and node[1] in ("+", "-", "*", "/"):
+        a, b = _static_number(node[2]), _static_number(node[3])
+        if a is None or b is None:
+            return None
+        if node[1] == "+":
+            return a + b
+        if node[1] == "-":
+            return a - b
+        if node[1] == "*":
+            return a * b
+        return a / b if b else None
+    return None
 
 
 # --------------------------------------------------------------- compiler
@@ -279,6 +395,48 @@ def _emit(node, env) -> jax.Array:
         return env[node[1]]
     if kind == "const":
         return env["consts"][node[1]]
+    if kind == "local":
+        return env["locals"][node[1]]
+    if kind == "prog":
+        env = dict(env, locals=dict(env.get("locals", {})))
+        for name, rhs in node[1]:
+            env["locals"][name] = _emit(rhs, env)
+        return _emit(node[2], env)
+    if kind == "roll":
+        # Circular shift on the gene axis by a static k: two static lane
+        # slices + concat — the exact Mosaic-friendly form the builtin
+        # NK objective lowers (classic.py make_nk_landscape), no gather.
+        x = jnp.broadcast_to(_emit(node[2], env), env["shape"])
+        k = node[1] % env["shape"][1]
+        if k == 0:
+            return x
+        return jnp.concatenate([x[:, k:], x[:, :k]], axis=1)
+    if kind == "gather":
+        # Bounded table lookup as a masked accumulation over the table
+        # entries (one compare+select per entry, all VPU): a 1-D table
+        # (arriving (1, n)) is shared across loci, a 2-D (n, L) table is
+        # per-locus (row c broadcasts against the gene axis) — the
+        # builtin NK lookup's own lowering, generalized. Which kind a
+        # table is follows its REGISTERED rank (``table_kinds``, fixed
+        # at compile time) — the runtime shape is ambiguous: a (1, L)
+        # per-locus table is indistinguishable from a shared L-entry
+        # one. Indices floor+clip into the table like every decode in
+        # the library.
+        t = env["consts"][node[1]]
+        per_locus = env["table_kinds"][node[1]] == "per_locus"
+        if per_locus and t.shape[1] != env["shape"][1]:
+            raise ExpressionError(
+                f"per-locus gather table {node[1]!r} has width "
+                f"{t.shape[1]} but the genome has {env['shape'][1]} genes"
+            )
+        idx = jnp.broadcast_to(_emit(node[2], env), env["shape"])
+        n = t.shape[0] if per_locus else t.shape[1]
+        codes = jnp.clip(jnp.floor(idx), 0.0, float(n - 1)).astype(jnp.int32)
+        acc = jnp.zeros(env["shape"], dtype=jnp.float32)
+        for c in range(n):
+            entry = t[c : c + 1, :] if per_locus else t[:, c : c + 1]
+            acc = acc + jnp.where(codes == c, entry, 0.0)
+        return acc
     if kind == "un":
         v = _emit(node[2], env)
         return -v if node[1] == "-" else v
@@ -343,10 +501,10 @@ def from_expression(expr: str, **consts) -> Callable:
                 f"constant name {name!r} shadows a builtin name"
             )
         arr = np.asarray(v, dtype=np.float32)
-        if arr.ndim > 1:
+        if arr.ndim > 2:
             raise ExpressionError(
-                f"constant {name!r} must be a scalar or 1-D vector, "
-                f"got shape {arr.shape}"
+                f"constant {name!r} must be a scalar, 1-D vector, or 2-D "
+                f"gather table, got shape {arr.shape}"
             )
         const_vals[name] = arr
 
@@ -354,22 +512,67 @@ def from_expression(expr: str, **consts) -> Callable:
     # Keep only the constants the expression references: the C ABI
     # registers constants per solver across successive expressions, so
     # unused ones must not become dead kernel inputs, pin the probe
-    # length, or trip the vector-length check below.
+    # length, or trip the vector-length check below. The same walk
+    # validates gather tables (registered, bounded, and the only legal
+    # use of a 2-D constant — elementwise broadcast of an (n, L) table
+    # would silently misalign against the gene axis).
     used: set = set()
+    gather_tables: set = set()
 
-    def _walk(node):
-        if node[0] == "const":
+    elementwise_consts: set = set()
+
+    def _walk(node, in_gather=False):
+        kind = node[0]
+        if kind == "const":
+            # A ("const",) node is an ELEMENTWISE use (gather tables are
+            # stored by name on the ("gather",) node, never visited
+            # here): it broadcasts against the gene axis, so a vector
+            # shape pins the genome length below.
             used.add(node[1])
-        elif node[0] == "un":
+            elementwise_consts.add(node[1])
+            if const_vals[node[1]].ndim == 2:
+                raise ExpressionError(
+                    f"2-D constant {node[1]!r} may only be used as "
+                    f"gather()'s table"
+                )
+        elif kind == "gather":
+            used.add(node[1])
+            gather_tables.add(node[1])
             _walk(node[2])
-        elif node[0] == "bin":
+        elif kind == "roll":
+            _walk(node[2])
+        elif kind == "un":
+            _walk(node[2])
+        elif kind == "bin":
             _walk(node[2])
             _walk(node[3])
-        elif node[0] == "call":
+        elif kind == "call":
             for a in node[2]:
                 _walk(a)
+        elif kind == "prog":
+            for _, rhs in node[1]:
+                _walk(rhs)
+            _walk(node[2])
 
     _walk(ast)
+    table_kinds: Dict[str, str] = {}
+    for name in gather_tables:
+        t = const_vals[name]
+        if t.ndim == 0:
+            raise ExpressionError(
+                f"gather table {name!r} is a scalar; register a vector "
+                f"or (n, L) matrix"
+            )
+        n = t.shape[0]  # 1-D: table length; 2-D: entry rows (n, L)
+        if n > _GATHER_MAX_ENTRIES:
+            raise ExpressionError(
+                f"gather table {name!r} has {n} entries; the masked-"
+                f"accumulation lowering caps at {_GATHER_MAX_ENTRIES}"
+            )
+        # The REGISTERED rank decides the lookup semantics, once: the
+        # runtime (1, n) form of a 1-D table is shape-identical to a
+        # single-entry (1, L) per-locus table.
+        table_kinds[name] = "per_locus" if t.ndim == 2 else "shared"
     const_vals = {n: a for n, a in const_vals.items() if n in used}
     const_names = sorted(const_vals)
     defaults = tuple(
@@ -384,6 +587,8 @@ def from_expression(expr: str, **consts) -> Callable:
                 jnp.float32
             ),
             "L": jnp.float32(m.shape[1]),
+            "shape": m.shape,  # roll/gather broadcast target
+            "table_kinds": table_kinds,
             # kernel consts arrive atleast_2d'd ((1, n) / (1, 1)) — the
             # row orientation broadcasts against (P, L) directly
             "consts": dict(zip(const_names, cargs)),
@@ -400,15 +605,27 @@ def from_expression(expr: str, **consts) -> Callable:
 
     # Validate eagerly: shape/arity/broadcast errors surface at
     # registration (→ -1 through the C ABI), not at first run. The
-    # probe genome length follows the vector constants (they broadcast
-    # against the gene axis, so any length-n constant implies L == n);
-    # inconsistent vector lengths are their own registration error.
-    vec_lens = {a.shape[0] for a in const_vals.values() if a.ndim == 1}
+    # probe genome length follows the constants that pair with the gene
+    # axis: ELEMENTWISE vector constants (length-n broadcast implies
+    # L == n) and 2-D gather tables' per-locus width (an (n, L) table
+    # implies L). A 1-D gather TABLE does not pin L — its length is the
+    # index domain (e.g. C cities), unrelated to the genome.
+    vec_lens = {
+        const_vals[n].shape[0]
+        for n in elementwise_consts
+        if n in const_vals and const_vals[n].ndim == 1
+    }
+    vec_lens |= {
+        const_vals[n].shape[1]
+        for n in gather_tables
+        if n in const_vals and const_vals[n].ndim == 2
+    }
     if len(vec_lens) > 1:
         raise ExpressionError(
             f"vector constants disagree on genome length: {sorted(vec_lens)}"
         )
-    probe_len = vec_lens.pop() if vec_lens else 8
+    pinned_len = vec_lens.pop() if vec_lens else None
+    probe_len = pinned_len or 8
     try:
         probe = jax.eval_shape(
             rows, jax.ShapeDtypeStruct((2, probe_len), jnp.float32)
@@ -424,5 +641,9 @@ def from_expression(expr: str, **consts) -> Callable:
     per_genome.kernel_rowwise = rows
     per_genome.kernel_rowwise_consts = defaults
     per_genome.expression = expr
+    # Genome length this expression's constants commit it to (None =
+    # any): elementwise vector constants and per-locus gather tables pin
+    # it; the C ABI checks population creation against this.
+    per_genome.pinned_genome_len = pinned_len
     per_genome.__doc__ = f"Expression objective: {expr}"
     return per_genome

@@ -230,6 +230,169 @@ class TestExpressionObjectives:
         _, best = pga.get_best_with_score(h)
         assert best > 0.8 * w.sum(), best
 
+    def test_v2_roll_and_let_bindings(self):
+        """``name = expr;`` statements and roll(x, k) — the circular
+        neighbor shift: roll(x, k)[i] = x[(i+k) mod L]."""
+        from libpga_tpu.objectives import from_expression
+
+        g = np.random.default_rng(7).random((5, 12)).astype(np.float32)
+        f = from_expression("a = roll(g, 1); b = roll(g, -2); sum(a*g + b)")
+        want = (np.roll(g, -1, axis=1) * g + np.roll(g, 2, axis=1)).sum(1)
+        np.testing.assert_allclose(
+            np.asarray(f.kernel_rowwise(jnp.asarray(g))), want, rtol=1e-5
+        )
+
+    def test_v2_gather_shared_and_per_locus(self):
+        from libpga_tpu.objectives import from_expression
+
+        rng = np.random.default_rng(8)
+        g = rng.random((6, 10)).astype(np.float32)
+        t = rng.random(7).astype(np.float32)
+        f = from_expression("sum(gather(t, g * 7))", t=t)
+        idx = np.clip(np.floor(g * 7), 0, 6).astype(int)
+        np.testing.assert_allclose(
+            np.asarray(f.kernel_rowwise(jnp.asarray(g))),
+            t[idx].sum(1), rtol=1e-5,
+        )
+        assert f.pinned_genome_len is None  # a table's n is not L
+        t2 = rng.random((4, 10)).astype(np.float32)  # per-locus (n, L)
+        f2 = from_expression("sum(gather(T, g * 4))", T=t2)
+        idx2 = np.clip(np.floor(g * 4), 0, 3).astype(int)
+        want = t2[idx2, np.arange(10)[None, :]].sum(1)
+        np.testing.assert_allclose(
+            np.asarray(f2.kernel_rowwise(jnp.asarray(g))), want, rtol=1e-5
+        )
+        assert f2.pinned_genome_len == 10  # per-locus width IS L
+
+    def test_v2_gather_table_kind_follows_registered_rank(self):
+        """A (1, L) matrix registered as 2-D is a PER-LOCUS table (one
+        entry row), not a shared L-entry table — the runtime shapes are
+        identical, so the registered rank must decide (review finding).
+        And a per-locus table whose width disagrees with the genome is
+        a shape error, not silent shared-table semantics."""
+        from libpga_tpu.objectives import ExpressionError, from_expression
+
+        t = np.arange(10, dtype=np.float32).reshape(1, 10)
+        f = from_expression("sum(gather(T, g * 1))", T=t)
+        g = np.zeros((3, 10), dtype=np.float32)  # all indices clip to 0
+        np.testing.assert_allclose(
+            np.asarray(f.kernel_rowwise(jnp.asarray(g))),
+            np.full(3, t.sum()),  # row 0 broadcast across loci
+        )
+        assert f.pinned_genome_len == 10
+        with pytest.raises(ExpressionError, match="width"):
+            # (5, 1) per-locus table pins L=1; probing at L=1 works but
+            # an L=8 population must be rejected loudly
+            f2 = from_expression(
+                "sum(gather(T2, g * 5))",
+                T2=np.arange(5, dtype=np.float32).reshape(5, 1),
+            )
+            f2.kernel_rowwise(jnp.zeros((2, 8), dtype=np.float32))
+
+    def test_v2_nk_landscape_expression_matches_builtin(self):
+        """The reference-style NK form is expressible (verdict round-4
+        item 4): codes from rolled bit vectors, per-locus table lookup —
+        identical scores to make_nk_landscape."""
+        from libpga_tpu.objectives import from_expression
+        from libpga_tpu.objectives.classic import make_nk_landscape
+
+        n, k = 16, 3
+        nk = make_nk_landscape(n, k, seed=3)
+        tab_t = np.asarray(nk.kernel_rowwise_consts[0])
+        f = from_expression(
+            "b = g >= 0.5;"
+            "codes = b + 2*roll(b, 1) + 4*roll(b, 2) + 8*roll(b, 3);"
+            "mean(gather(T, codes))",
+            T=tab_t,
+        )
+        g = np.random.default_rng(1).random((16, n)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(f.kernel_rowwise(jnp.asarray(g))),
+            np.asarray(jax.vmap(nk)(jnp.asarray(g))),
+            rtol=1e-5, atol=1e-6,
+        )
+
+    def test_v2_tour_cost_expression_matches_tsp_coords(self):
+        """A Euclidean TSP tour cost is expressible: coordinate gathers
+        + adjacency via roll + open-path masking on ``i``. Matches
+        make_tsp_coords on duplicate-free tours (the expression carries
+        no duplicate penalty; the permutation operators keep tours
+        valid)."""
+        from libpga_tpu.objectives import from_expression
+        from libpga_tpu.objectives.classic import (
+            make_tsp_coords, random_tsp_coords,
+        )
+
+        C = 24
+        coords = random_tsp_coords(C, seed=2)
+        f = from_expression(
+            "c = floor(g * L);"
+            "x = gather(X, c); y = gather(Y, c);"
+            "dx = roll(x, 1) - x; dy = roll(y, 1) - y;"
+            "-sum(where(i < L - 1, sqrt(dx*dx + dy*dy + 1e-12), 0))",
+            X=coords[:, 0], Y=coords[:, 1],
+        )
+        tsp = make_tsp_coords(coords)
+        rng = np.random.default_rng(5)
+        perms = np.stack([rng.permutation(C) for _ in range(8)])
+        g = ((perms + 0.5) / C).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(f.kernel_rowwise(jnp.asarray(g))),
+            np.asarray(jax.vmap(tsp)(jnp.asarray(g))),
+            rtol=1e-4,
+        )
+
+    def test_v2_errors(self):
+        from libpga_tpu.objectives import ExpressionError, from_expression
+
+        with pytest.raises(ExpressionError, match="rebound"):
+            from_expression("x = g; x = g; sum(x)")
+        with pytest.raises(ExpressionError, match="builtin name"):
+            from_expression("g = sum(g); g")
+        with pytest.raises(ExpressionError, match="integer literal"):
+            from_expression("sum(roll(g, L))")
+        with pytest.raises(ExpressionError, match="registered constant"):
+            from_expression("sum(gather(g, g))")
+        with pytest.raises(ExpressionError, match="only be used as"):
+            from_expression("sum(T * g)", T=np.ones((3, 4)))
+        with pytest.raises(ExpressionError, match="caps at 512"):
+            from_expression("sum(gather(t, g))", t=np.ones(600))
+        # folded literal shifts are fine
+        from_expression("sum(roll(g, 2 + 1))")
+
+    def test_v2_fuses_into_pallas_kernel(self):
+        """roll + gather + let-bindings lower inside the breed kernel
+        (interpret mode; hardware lowering in tools/tpu_kernel_checks)."""
+        from jax.experimental.pallas import tpu as pltpu
+
+        from libpga_tpu.objectives import from_expression
+        from libpga_tpu.objectives.classic import make_nk_landscape
+        from libpga_tpu.ops.pallas_step import make_pallas_breed
+
+        n = 16
+        nk = make_nk_landscape(n, 3, seed=3)
+        tab_t = np.asarray(nk.kernel_rowwise_consts[0])
+        f = from_expression(
+            "b = g >= 0.5;"
+            "codes = b + 2*roll(b, 1) + 4*roll(b, 2) + 8*roll(b, 3);"
+            "mean(gather(T, codes))",
+            T=tab_t,
+        )
+        g = np.random.default_rng(3).random((256, n)).astype(np.float32)
+        s = f.kernel_rowwise(jnp.asarray(g))
+        with pltpu.force_tpu_interpret_mode():
+            breed = make_pallas_breed(
+                256, n, deme_size=128,
+                fused_obj=f.kernel_rowwise,
+                fused_consts=f.kernel_rowwise_consts,
+            )
+            g2, s2 = breed(jnp.asarray(g), s, jax.random.key(0))
+        np.testing.assert_allclose(
+            np.asarray(s2),
+            np.asarray(f.kernel_rowwise(jnp.asarray(g2))),
+            rtol=1e-4, atol=1e-4,
+        )
+
     def test_fuses_into_pallas_kernel(self):
         """The compiled rowwise form lowers inside the breed kernel
         (interpret mode), consts arriving as kernel inputs."""

@@ -264,18 +264,28 @@ def main() -> int:
     from libpga_tpu.objectives import from_expression
 
     lowered = True
-    for e in (
-        "sum(g % 0.25)",
-        "sum(g ** g)",
-        "sum(tan(g) * 0.001) + sum(round(g))",
-        "mean(tanh(g)) + min(g) - max(g) + sum(abs(g - 0.5))",
-        "sum(exp(-(g*2)) + log(g + 1) + sqrt(g) + sin(g) + cos(g))",
-        "dot(g, i) / (1 + mean(g)) + where(sum(g) >= L/2, 1, 0)",
+    rng = np.random.default_rng(0)
+    for e, consts in (
+        ("sum(g % 0.25)", {}),
+        ("sum(g ** g)", {}),
+        ("sum(tan(g) * 0.001) + sum(round(g))", {}),
+        ("mean(tanh(g)) + min(g) - max(g) + sum(abs(g - 0.5))", {}),
+        ("sum(exp(-(g*2)) + log(g + 1) + sqrt(g) + sin(g) + cos(g))", {}),
+        ("dot(g, i) / (1 + mean(g)) + where(sum(g) >= L/2, 1, 0)", {}),
+        # v2: let-bindings, roll (static lane concat), gather over a
+        # shared 1-D table and a per-locus (n, L) table
+        ("a = roll(g, 1); b = roll(g, -3); sum(a*g) - mean(b)", {}),
+        ("sum(gather(t, g * 7))",
+         {"t": rng.random(7).astype(np.float32)}),
+        ("b = g >= 0.5;"
+         "codes = b + 2*roll(b, 1) + 4*roll(b, 2) + 8*roll(b, 3);"
+         "mean(gather(T, codes))",
+         {"T": rng.random((16, 32)).astype(np.float32)}),
     ):
         try:
             solver = PGA(seed=0, config=PGAConfig(use_pallas=True))
             solver.create_population(512, 32)
-            solver.set_objective(from_expression(e))
+            solver.set_objective(from_expression(e, **consts))
             solver.run(2)
             entry = [
                 v for k, v in solver._compiled.items() if k[0] == "runP"
