@@ -93,6 +93,20 @@ int pga_set_objective_expr_const(pga_t *p, const char *name,
         static_cast<Py_ssize_t>(n * sizeof(float))));
 }
 
+int pga_set_crossover_expr(pga_t *p, const char *expr) {
+    if (!p || !expr) return -1;
+    return static_cast<int>(
+        call_long("set_crossover_expr", "(ls)", solver_of(p), expr));
+}
+
+int pga_set_mutate_expr(pga_t *p, const char *expr, float rate,
+                        float sigma) {
+    if (!p || !expr) return -1;
+    return static_cast<int>(
+        call_long("set_mutate_expr", "(lsdd)", solver_of(p), expr,
+                  static_cast<double>(rate), static_cast<double>(sigma)));
+}
+
 int pga_set_objective_expr_const2(pga_t *p, const char *name,
                                   const float *data, unsigned rows,
                                   unsigned cols) {

@@ -149,6 +149,34 @@ int pga_set_objective_expr_const2(pga_t *p, const char *name,
                                   const float *data, unsigned rows,
                                   unsigned cols);
 
+/* DEVICE-SPEED custom CROSSOVER and MUTATION from expressions — the
+ * remaining two reference callbacks (pga.h:47-48) at device speed: the
+ * expression compiles into the fused breed kernel and evaluates on the
+ * on-chip parents, unlike pga_set_mutate_function /
+ * pga_set_crossover_function whose host pointers pin the solver to the
+ * CPU. Variables (all per-gene, rows x L):
+ *   crossover: p1, p2 (the selected parents);
+ *   mutation:  g (the child genome), rate, sigma (runtime parameters —
+ *              pass rate/sigma below; negative = defaults 0.01 / 0.0);
+ *   both:      r, r2 (two per-gene uniform [0,1) streams), q, q2 (two
+ *              per-CHILD uniforms — cut points, gates), i, L, literals,
+ *              pi, e, and registered scalar/vector constants.
+ * Breeding expressions are strictly per-gene: reductions (sum/mean/
+ * dot/1-arg min/max) and roll/gather are rejected. Results are clipped
+ * into the gene domain [0, 1). Examples:
+ *   pga_set_crossover_expr(p, "where(r < 0.5, p1, p2)");   // uniform
+ *   pga_set_crossover_expr(p, "where(i < floor(q*L), p1, p2)"); // 1-pt
+ *   pga_set_crossover_expr(p, "r*p1 + (1-r)*p2");          // blend
+ *   pga_set_mutate_expr(p, "where(r < rate, r2, g)", 0.02f, -1); // reset
+ *   pga_set_mutate_expr(p, "where(r < rate, g + sigma*(2*r2-1), g)",
+ *                       0.1f, 0.05f);                      // creep
+ * Returns 0, or -1 for any syntax/name/arity/shape error (diagnostic on
+ * stderr). Restore the defaults with pga_set_mutate_function(p, NULL) /
+ * pga_set_crossover_function(p, NULL). */
+int pga_set_crossover_expr(pga_t *p, const char *expr);
+int pga_set_mutate_expr(pga_t *p, const char *expr, float rate,
+                        float sigma);
+
 /* Result extraction (pga.h:90-93). Return malloc'd gene arrays (caller
  * frees), genome_len genes per row; NULL on error — including a _top
  * `length` larger than the (total) population, since the caller's buffer

@@ -1,0 +1,96 @@
+/* Smoke driver 7: device-speed custom BREEDING operators via the
+ * expression surface (pga_set_crossover_expr / pga_set_mutate_expr) —
+ * the last two reference callbacks (pga.h:47-48) at device speed. The
+ * reference's flagship TSP driver installs a custom crossover
+ * (test3/test.cu:87-91); this is the TPU-native equivalent of that
+ * extension point: no host round trip, no CPU pin (unlike the
+ * function-pointer compatibility path).
+ *
+ * Checks: a NON-builtin blend crossover plus creep mutation drive
+ * OneMax from C; a one-point crossover (per-child cut via q) works; the
+ * per-gene restriction and syntax errors return -1 without corrupting
+ * the solver; NULL restores the builtin defaults. */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "pga_tpu.h"
+
+#define POP 8192
+#define LEN 64
+#define GENS 120
+
+static float best_sum(pga_t *p, population_t *pop) {
+    gene *best = pga_get_best(p, pop);
+    if (!best) return -1e30f;
+    float sum = 0.0f;
+    for (unsigned i = 0; i < LEN; i++) sum += best[i];
+    free(best);
+    return sum;
+}
+
+int main(void) {
+    pga_t *p = pga_init(31);
+    if (!p) return fprintf(stderr, "pga_init failed\n"), 1;
+    population_t *pop = pga_create_population(p, POP, LEN, RANDOM_POPULATION);
+    if (!pop) return fprintf(stderr, "create_population failed\n"), 1;
+    if (pga_set_objective_name(p, "onemax") != 0)
+        return fprintf(stderr, "set_objective_name failed\n"), 1;
+
+    /* blend crossover (NOT a builtin kind: probabilistic parent average)
+     * + creep mutation (+-sigma steps at the runtime rate) */
+    if (pga_set_crossover_expr(
+            p, "where(r < 0.3, (p1 + p2) / 2, where(r2 < 0.5, p1, p2))") != 0)
+        return fprintf(stderr, "set_crossover_expr failed\n"), 1;
+    if (pga_set_mutate_expr(
+            p, "where(r < rate, g + sigma * (2*r2 - 1), g)", 0.1f, 0.15f) != 0)
+        return fprintf(stderr, "set_mutate_expr failed\n"), 1;
+    if (pga_run_n(p, GENS) < 0)
+        return fprintf(stderr, "run failed\n"), 1;
+    float got = best_sum(p, pop);
+    printf("blend+creep best: %.1f of %d\n", got, LEN);
+    if (got < 0.85f * LEN)
+        return fprintf(stderr, "blend+creep did not converge\n"), 1;
+
+    /* one-point crossover via the per-child cut q, on a fresh solver */
+    pga_deinit(p);
+    p = pga_init(32);
+    if (!p) return fprintf(stderr, "pga_init 2 failed\n"), 1;
+    pop = pga_create_population(p, POP, LEN, RANDOM_POPULATION);
+    if (!pop) return fprintf(stderr, "create_population 2 failed\n"), 1;
+    if (pga_set_objective_name(p, "onemax") != 0)
+        return fprintf(stderr, "set_objective_name 2 failed\n"), 1;
+    if (pga_set_crossover_expr(p, "where(i < floor(q * L), p1, p2)") != 0)
+        return fprintf(stderr, "one-point expr failed\n"), 1;
+    if (pga_set_mutate_expr(p, "where(r < rate, r2, g)", 0.02f, -1.0f) != 0)
+        return fprintf(stderr, "reset mutate expr failed\n"), 1;
+    if (pga_run_n(p, GENS) < 0)
+        return fprintf(stderr, "one-point run failed\n"), 1;
+    got = best_sum(p, pop);
+    printf("one-point+reset best: %.1f of %d\n", got, LEN);
+    if (got < 0.8f * LEN)
+        return fprintf(stderr, "one-point did not converge\n"), 1;
+
+    /* error paths: each must return -1 and leave the solver usable */
+    if (pga_set_crossover_expr(p, "sum(p1)") == 0)
+        return fprintf(stderr, "reduction in crossover accepted\n"), 1;
+    if (pga_set_mutate_expr(p, "roll(g, 1)", -1.0f, -1.0f) == 0)
+        return fprintf(stderr, "roll in mutation accepted\n"), 1;
+    if (pga_set_crossover_expr(p, "where(r < 0.5, g, p2)") == 0)
+        return fprintf(stderr, "'g' in crossover accepted\n"), 1;
+    if (pga_set_mutate_expr(p, "where(", -1.0f, -1.0f) == 0)
+        return fprintf(stderr, "bad mutate syntax accepted\n"), 1;
+    if (pga_set_crossover_expr(NULL, "p1") == 0)
+        return fprintf(stderr, "NULL solver accepted\n"), 1;
+
+    /* solver still healthy; NULL restores the builtin defaults */
+    if (pga_set_crossover_function(p, NULL) != 0)
+        return fprintf(stderr, "crossover NULL restore failed\n"), 1;
+    if (pga_set_mutate_function(p, NULL) != 0)
+        return fprintf(stderr, "mutate NULL restore failed\n"), 1;
+    if (pga_run_n(p, 5) < 0)
+        return fprintf(stderr, "post-restore run failed\n"), 1;
+
+    pga_deinit(p);
+    printf("PASS\n");
+    return 0;
+}

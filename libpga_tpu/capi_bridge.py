@@ -199,13 +199,16 @@ def create_population(handle: int, size: int, genome_len: int, ptype: int) -> in
     if init_name is None:
         raise ValueError(f"unknown population_type {ptype}")
     pga = _solver(handle)
-    # An expression objective with vector constants implies a genome
-    # length; set_objective_expr checks populations that exist AT
-    # REGISTRATION time, so re-check here for populations created
-    # AFTERWARD — same diagnostic, at the call that introduces the
-    # mismatch, instead of a raw broadcast error inside the first
-    # jitted evaluate.
+    # An expression with vector constants implies a genome length; the
+    # set_*_expr calls check populations that exist AT REGISTRATION
+    # time, so re-check here for populations created AFTERWARD — same
+    # diagnostic, at the call that introduces the mismatch, instead of
+    # a raw broadcast (or mid-run kernel-build) error at first use.
+    # Breeding expressions carry the same pinned_genome_len contract as
+    # objectives.
     _check_expr_const_lens(pga._objective, {genome_len})
+    _check_expr_const_lens(pga._crossover, {genome_len})
+    _check_expr_const_lens(pga._mutate, {genome_len})
     return pga.create_population(size, genome_len, init=init_name).index
 
 
@@ -308,6 +311,55 @@ def _expr_const_array(handle: int, name: str, data: bytes) -> np.ndarray:
     if not data:
         raise ValueError(f"constant {name!r} has no values (n == 0)")
     return np.frombuffer(data, dtype=np.float32).copy()
+
+
+def set_crossover_expr(handle: int, expr: str) -> None:
+    """Install a DEVICE-SPEED custom crossover from an expression
+    (``pga_set_crossover_expr``): compiles to the rowwise form the fused
+    kernel's ``_deme_child`` evaluates on VMEM-resident parents — the
+    TPU answer to the reference's ``__device__`` crossover pointers
+    (``pga.h:48``; its TSP driver's custom operator, test3/test.cu:48-64,
+    is the motivating workload). Unlike ``set_crossover_ptr``, the
+    solver stays on the accelerator. Registered constants
+    (``set_objective_expr_const``) are visible here too."""
+    from libpga_tpu.ops.breed_expr import crossover_from_expression
+
+    pga = _solver(handle)
+    op = crossover_from_expression(expr, **_scalar_vector_consts(handle))
+    _check_expr_const_lens(op, {p.genome_len for p in pga.populations})
+    pga.set_crossover(op)
+    _set_host_op(handle, "cross", False)
+
+
+def set_mutate_expr(handle: int, expr: str, rate: float, sigma: float) -> None:
+    """Install a DEVICE-SPEED custom mutation from an expression
+    (``pga_set_mutate_expr``) — the custom-``__device__``-mutation
+    analog (``pga.h:47``). ``rate``/``sigma`` bind the expression's
+    runtime variables; negative values take the library defaults
+    (0.01 / 0.0)."""
+    from libpga_tpu.ops.breed_expr import mutate_from_expression
+
+    pga = _solver(handle)
+    op = mutate_from_expression(
+        expr,
+        rate=0.01 if rate < 0 else float(rate),
+        sigma=0.0 if sigma < 0 else float(sigma),
+        **_scalar_vector_consts(handle),
+    )
+    _check_expr_const_lens(op, {p.genome_len for p in pga.populations})
+    pga.set_mutate(op)
+    _set_host_op(handle, "mut", False)
+
+
+def _scalar_vector_consts(handle: int) -> Dict[str, np.ndarray]:
+    """The solver's registered constants minus 2-D gather tables —
+    breeding expressions are strictly per-gene, and passing a table
+    would fail their factory with a confusing shape message."""
+    return {
+        n: a
+        for n, a in _expr_consts.get(handle, {}).items()
+        if a.ndim <= 1
+    }
 
 
 def set_objective_ptr(handle: int, addr: int) -> None:

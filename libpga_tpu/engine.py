@@ -55,6 +55,16 @@ from libpga_tpu.utils.metrics import Metrics
 _XLA_FALLBACK = object()
 
 
+def _kind_key(kind):
+    """Cache identity of a breeding kind: builtin names key by
+    themselves; expression operators key by their COMPILED SEMANTICS
+    (role, source, constant values — ``kernel_cache_key``), so an
+    annealing schedule re-creating the same expression with new
+    rate/sigma reuses the compiled kernel (the parameters are runtime
+    inputs of the compiled fn, passed per call)."""
+    return getattr(kind, "kernel_cache_key", kind)
+
+
 @dataclasses.dataclass(frozen=True)
 class PopulationHandle:
     """Opaque handle to a population owned by a :class:`PGA` instance.
@@ -257,8 +267,8 @@ class PGA:
             # sentinel — NOT the XLA fn itself, which bakes the operator
             # instance in and must stay keyed by it below.
             pkey = (
-                "runP", size, genome_len, obj, pallas_kind,
-                self._crossover_kind(), self.config.elitism,
+                "runP", size, genome_len, obj, _kind_key(pallas_kind),
+                _kind_key(self._crossover_kind()), self.config.elitism,
                 self.config.tournament_size, self.config.selection,
                 self.config.selection_param,
                 self.config.pallas_generations_per_launch,
@@ -329,12 +339,15 @@ class PGA:
         self._compiled[cache_key] = fn
         return fn
 
-    def _mutate_kind(self) -> Optional[str]:
+    def _mutate_kind(self):
         """Kernel-implementable mutation kind of the active operator, or
-        None. The kind (not the operator instance) keys the compiled
-        fast path; rate/sigma are runtime inputs, so e.g. an annealing
-        schedule swapping ``make_gaussian_mutate(rate, sigma)`` per phase
-        reuses one compilation."""
+        None. Builtin operators map to their kind NAME (rate/sigma are
+        runtime inputs, so e.g. an annealing schedule swapping
+        ``make_gaussian_mutate(rate, sigma)`` per phase reuses one
+        compilation); an EXPRESSION operator
+        (``ops/breed_expr.mutate_from_expression``) is itself the kind —
+        its compiled rowwise form evaluates inside the kernel, and the
+        operator instance keys the compiled fast path."""
         from libpga_tpu.ops import mutate as _m
 
         func = getattr(self._mutate, "func", None)
@@ -344,19 +357,25 @@ class PGA:
             return "gaussian"
         if func is _m.swap_mutate:
             return "swap"
+        if getattr(self._mutate, "kernel_rows", None) is not None:
+            return self._mutate
         return None
 
-    def _crossover_kind(self) -> Optional[str]:
+    def _crossover_kind(self):
         """Kernel-implementable crossover kind of the active operator:
-        uniform (the reference default) or order-preserving (the
+        uniform (the reference default), order-preserving (the
         reference TSP driver's custom crossover, in-kernel as an
-        unrolled VMEM visited-table walk)."""
+        unrolled VMEM visited-table walk), or an expression operator
+        (``ops/breed_expr.crossover_from_expression``) evaluated
+        in-kernel."""
         from libpga_tpu.ops import crossover as _c
 
         if self._crossover is _c.uniform_crossover:
             return "uniform"
         if self._crossover is _c.order_preserving_crossover:
             return "order"
+        if getattr(self._crossover, "kernel_rows", None) is not None:
+            return self._crossover
         return None
 
     def _operator_param(self, name: str, default: float) -> float:
@@ -378,9 +397,16 @@ class PGA:
 
     def _mutate_params(self) -> jax.Array:
         """(1, 2) f32 [rate, sigma] runtime input for the Pallas kernel."""
-        if self._mutate_kind() == "gaussian":
+        kind = self._mutate_kind()
+        if kind == "gaussian":
             rate = self._operator_param("rate", 0.1)
             sigma = self._operator_param("sigma", 0.1)
+        elif callable(kind):
+            # Expression mutation: the factory stamps the values its
+            # ``rate``/``sigma`` variables were declared with, so the
+            # kernel and XLA paths agree.
+            rate = self._operator_param("rate", self.config.mutation_rate)
+            sigma = self._operator_param("sigma", 0.0)
         else:
             rate, sigma = self._mutation_rate(), 0.0
         return jnp.asarray([[rate, sigma]], dtype=jnp.float32)
@@ -436,7 +462,8 @@ class PGA:
         # so rebuilding it per call would defeat compilation reuse.
         cache_key = (
             "island_breed", island_size, genome_len, obj, fused,
-            self._crossover_kind(), self._mutate_kind(),
+            _kind_key(self._crossover_kind()),
+            _kind_key(self._mutate_kind()),
             self.config.elitism, self.config.tournament_size,
             self.config.selection, self.config.selection_param,
             self.config.pallas_generations_per_launch,

@@ -380,6 +380,48 @@ def _static_number(node):
 # --------------------------------------------------------------- compiler
 
 
+def walk_ast(node, visit) -> None:
+    """Call ``visit(node)`` on every AST node, parents before children —
+    the ONE traversal the compile-time validators build on (a new node
+    kind added to the parser gets threaded through every validator by
+    updating this single function)."""
+    visit(node)
+    kind = node[0]
+    if kind in ("un", "roll"):
+        walk_ast(node[2], visit)
+    elif kind == "gather":
+        walk_ast(node[2], visit)
+    elif kind == "bin":
+        walk_ast(node[2], visit)
+        walk_ast(node[3], visit)
+    elif kind == "call":
+        for a in node[2]:
+            walk_ast(a, visit)
+    elif kind == "prog":
+        for _, rhs in node[1]:
+            walk_ast(rhs, visit)
+        walk_ast(node[2], visit)
+
+
+def validate_const(name: str, value, *, allow_2d: bool, extra_reserved=()):
+    """Shared constant validation for every expression surface: name
+    hygiene plus the rank contract. Returns the float32 array."""
+    if name in _KEYWORDS or name in extra_reserved:
+        raise ExpressionError(
+            f"constant name {name!r} shadows a builtin name"
+        )
+    arr = np.asarray(value, dtype=np.float32)
+    if arr.ndim > (2 if allow_2d else 1):
+        kinds = (
+            "a scalar, 1-D vector, or 2-D gather table" if allow_2d
+            else "a scalar or 1-D vector in a breeding expression"
+        )
+        raise ExpressionError(
+            f"constant {name!r} must be {kinds}, got shape {arr.shape}"
+        )
+    return arr
+
+
 def _emit(node, env) -> jax.Array:
     """Evaluate the AST over a (P, L) gene block ``env['g']``.
     Elementwise values carry shape (P, L) (or broadcastable); reductions
@@ -469,14 +511,14 @@ def _emit(node, env) -> jax.Array:
     # top-level squeeze in ``rows`` produces the final (P,).
     if fname == "dot":
         return jnp.sum(
-            jnp.broadcast_to(vals[0] * vals[1], env["g"].shape),
+            jnp.broadcast_to(vals[0] * vals[1], env["shape"]),
             axis=1, keepdims=True,
         )
     reducers = {"sum": jnp.sum, "mean": jnp.mean,
                 "min": jnp.min, "max": jnp.max}
     if fname in ("min", "max") and len(vals) == 2:
         return (jnp.minimum if fname == "min" else jnp.maximum)(*vals)
-    v = jnp.broadcast_to(vals[0], env["g"].shape)
+    v = jnp.broadcast_to(vals[0], env["shape"])
     return reducers[fname](v, axis=1, keepdims=True)
 
 
@@ -494,19 +536,10 @@ def from_expression(expr: str, **consts) -> Callable:
     for any syntax/name/arity problem, and for expressions that do not
     reduce to one scalar per genome.
     """
-    const_vals: Dict[str, np.ndarray] = {}
-    for name, v in consts.items():
-        if name in _KEYWORDS:
-            raise ExpressionError(
-                f"constant name {name!r} shadows a builtin name"
-            )
-        arr = np.asarray(v, dtype=np.float32)
-        if arr.ndim > 2:
-            raise ExpressionError(
-                f"constant {name!r} must be a scalar, 1-D vector, or 2-D "
-                f"gather table, got shape {arr.shape}"
-            )
-        const_vals[name] = arr
+    const_vals: Dict[str, np.ndarray] = {
+        name: validate_const(name, v, allow_2d=True)
+        for name, v in consts.items()
+    }
 
     ast = _Parser(expr, set(const_vals)).parse()
     # Keep only the constants the expression references: the C ABI
@@ -518,10 +551,9 @@ def from_expression(expr: str, **consts) -> Callable:
     # would silently misalign against the gene axis).
     used: set = set()
     gather_tables: set = set()
-
     elementwise_consts: set = set()
 
-    def _walk(node, in_gather=False):
+    def visit(node):
         kind = node[0]
         if kind == "const":
             # A ("const",) node is an ELEMENTWISE use (gather tables are
@@ -538,23 +570,8 @@ def from_expression(expr: str, **consts) -> Callable:
         elif kind == "gather":
             used.add(node[1])
             gather_tables.add(node[1])
-            _walk(node[2])
-        elif kind == "roll":
-            _walk(node[2])
-        elif kind == "un":
-            _walk(node[2])
-        elif kind == "bin":
-            _walk(node[2])
-            _walk(node[3])
-        elif kind == "call":
-            for a in node[2]:
-                _walk(a)
-        elif kind == "prog":
-            for _, rhs in node[1]:
-                _walk(rhs)
-            _walk(node[2])
 
-    _walk(ast)
+    walk_ast(ast, visit)
     table_kinds: Dict[str, str] = {}
     for name in gather_tables:
         t = const_vals[name]

@@ -1,0 +1,276 @@
+"""Device-speed custom CROSSOVER and MUTATION from expressions.
+
+The reference's extension mechanism covers all three GA callbacks at
+device speed — ``__device__`` function pointers for the objective,
+mutation, and crossover (``/root/reference/include/pga.h:46-48``, install
+idiom ``src/pga.cu:157-161``); its flagship TSP driver installs a custom
+crossover (``test3/test.cu:48-64,87-91``). Round 4 gave OBJECTIVES their
+TPU-native custom path (``objectives/expr.py``); this module closes the
+remaining two: a custom breeding operator written as an expression
+compiles to the rowwise form the fused Pallas kernel's ``_deme_child``
+evaluates on VMEM-resident parents — no ``jax.pure_callback``, no CPU
+pin, unlike the host-pointer compatibility path (``capi_bridge.py``).
+
+Variables available to the expressions (everything is per-gene and
+broadcasts; ``P`` rows by ``L`` genes):
+
+- crossover: ``p1``, ``p2`` (the selected parents), and
+- mutation: ``g`` (the child genome) plus runtime ``rate`` / ``sigma``
+  (the engine's mutation parameters — annealing schedules share one
+  compilation, like the builtin kinds);
+- both: ``r``, ``r2`` (two independent per-gene uniform [0,1) streams),
+  ``q``, ``q2`` (two per-ROW uniforms, shape (P, 1) — cut points,
+  per-child gates), ``i`` (gene index), ``L``, literals, ``pi``, ``e``,
+  and registered scalar/vector constants.
+
+Breeding expressions are strictly PER-GENE: reductions (``sum``,
+``mean``, one-argument ``min``/``max``, ``dot``) and the indexed
+primitives (``roll``, ``gather``) are rejected at compile time — inside
+the kernel the gene axis is lane-padded, so a reduction would silently
+include pad lanes. Elementwise ops, comparisons, ``where``, and
+two-argument ``min``/``max`` cover the classic operator families:
+
+    # uniform crossover (the library default)
+    crossover_from_expression("where(r < 0.5, p1, p2)")
+    # one-point crossover via the per-row cut q
+    crossover_from_expression("where(i < floor(q * L), p1, p2)")
+    # blend crossover with a per-gene mixing weight
+    crossover_from_expression("r * p1 + (1 - r) * p2")
+    # per-gene reset mutation at the runtime rate
+    mutate_from_expression("where(r < rate, r2, g)")
+    # creep mutation: +/- sigma steps
+    mutate_from_expression(
+        "where(r < rate, g + sigma * (2*r2 - 1), g)")
+
+Results are clipped into the gene domain [0, 1) (exactly like the
+builtin gaussian mutation), so a custom operator cannot corrupt the
+decode invariants the rest of the library relies on.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from libpga_tpu.objectives.expr import (
+    ExpressionError,
+    _Parser,
+    _emit,
+    validate_const,
+    walk_ast,
+)
+
+_GENE_MAX = 1.0 - 1e-7  # the library-wide open-interval gene ceiling
+
+_CROSS_VARS = ("p1", "p2", "r", "r2", "q", "q2", "i", "L")
+_MUT_VARS = ("g", "r", "r2", "q", "q2", "i", "L", "rate", "sigma")
+
+
+def _forbid_non_elementwise(node) -> None:
+    kind = node[0]
+    if kind in ("roll", "gather"):
+        raise ExpressionError(
+            f"{kind}() is not available in breeding expressions — they "
+            f"are strictly per-gene (the kernel block is lane-padded)"
+        )
+    if kind == "call":
+        fname, args = node[1], node[2]
+        if fname in ("sum", "mean", "dot") or (
+            fname in ("min", "max") and len(args) == 1
+        ):
+            raise ExpressionError(
+                f"{fname}() reductions are not available in breeding "
+                f"expressions — they are strictly per-gene (the kernel "
+                f"block is lane-padded, so a reduction would include "
+                f"pad lanes)"
+            )
+
+
+def _compile_breeding(role: str, expr: str, var_names, consts):
+    """Parse + validate a breeding expression; returns
+    ``(ast, const_names, defaults, pinned_len, cache_key)``. The cache
+    key identifies the COMPILED SEMANTICS — role, source, and constant
+    values — so the engine can reuse one kernel compilation across
+    operator instances (annealing schedules re-creating the same
+    expression with new rate/sigma hit the cache; the parameters are
+    runtime kernel inputs)."""
+    const_vals: Dict[str, np.ndarray] = {
+        name: validate_const(
+            name, v, allow_2d=False, extra_reserved=var_names
+        )
+        for name, v in consts.items()
+    }
+
+    ast = _Parser(expr, set(const_vals), var_names=var_names).parse()
+    used: set = set()
+
+    def visit(node):
+        _forbid_non_elementwise(node)
+        if node[0] == "const":
+            used.add(node[1])
+
+    walk_ast(ast, visit)
+    const_vals = {n: a for n, a in const_vals.items() if n in used}
+    const_names = sorted(const_vals)
+    defaults = tuple(
+        jnp.atleast_2d(jnp.asarray(const_vals[n])) for n in const_names
+    )
+    vec_lens = {a.shape[0] for a in const_vals.values() if a.ndim == 1}
+    if len(vec_lens) > 1:
+        raise ExpressionError(
+            f"vector constants disagree on genome length: {sorted(vec_lens)}"
+        )
+    pinned = vec_lens.pop() if vec_lens else None
+    cache_key = (
+        role, expr,
+        tuple(
+            (n, const_vals[n].shape, const_vals[n].tobytes())
+            for n in const_names
+        ),
+    )
+    return ast, const_names, defaults, pinned, cache_key
+
+
+def _derived_streams(r: jax.Array):
+    """Three extra uniform streams bit-mixed from the engine's one
+    ``(P, L)`` rand block (the ``gaussian_mutate`` trick — cheap,
+    stateless, in-register): a second per-gene stream and two per-row
+    scalars taken from gene 0's lineage. The fused kernel draws all
+    four independently from its own PRNG instead."""
+    bits = (r * jnp.float32(2**24)).astype(jnp.uint32)
+    m1 = bits * jnp.uint32(2654435761) + jnp.uint32(0x9E3779B9)
+    r2 = (m1 & jnp.uint32(0xFFFFFF)).astype(jnp.float32) / jnp.float32(2**24)
+    row = bits[:, 0:1]
+    mq = row * jnp.uint32(2246822519) + jnp.uint32(0x85EBCA6B)
+    q = (mq & jnp.uint32(0xFFFFFF)).astype(jnp.float32) / jnp.float32(2**24)
+    mq2 = mq * jnp.uint32(2654435761) + jnp.uint32(0x27220A95)
+    q2 = (mq2 & jnp.uint32(0xFFFFFF)).astype(jnp.float32) / jnp.float32(2**24)
+    return r2, q, q2
+
+
+def _probe(rows, n_gene_args: int, n_row_args: int, probe_len: int):
+    """Eager shape validation — registration errors surface at the
+    factory call (→ -1 through the C ABI), not at first run."""
+    gene = jax.ShapeDtypeStruct((2, probe_len), jnp.float32)
+    row = jax.ShapeDtypeStruct((2, 1), jnp.float32)
+    try:
+        jax.eval_shape(
+            rows, *([gene] * n_gene_args), *([row] * n_row_args)
+        )
+    except ExpressionError:
+        raise
+    except Exception as exc:  # noqa: BLE001 — rewrap with the source
+        raise ExpressionError(f"invalid expression: {exc}") from exc
+
+
+def crossover_from_expression(expr: str, **consts) -> Callable:
+    """Compile a crossover expression to the library's operator protocol
+    (``(p1, p2, rand) -> child`` with ``.batched``) PLUS the kernel hook
+    the fused Pallas path evaluates in VMEM (``.kernel_rows``): the
+    TPU-native answer to the reference's ``__device__`` crossover
+    pointers (``pga.h:48``; its TSP driver's operator,
+    ``test3/test.cu:48-64``, is the motivating workload). See the module
+    docstring for the variable set and examples."""
+    ast, const_names, defaults, pinned, cache_key = _compile_breeding(
+        "crossover-expr", expr, _CROSS_VARS, consts
+    )
+
+    def rows(p1, p2, r, r2, q, q2, *cargs, true_len=None):
+        env = {
+            "p1": p1, "p2": p2, "r": r, "r2": r2, "q": q, "q2": q2,
+            "i": jax.lax.broadcasted_iota(jnp.int32, p1.shape, 1).astype(
+                jnp.float32
+            ),
+            "L": jnp.float32(true_len or p1.shape[1]),
+            "shape": p1.shape,
+            "table_kinds": {},
+            "consts": dict(zip(const_names, cargs or defaults)),
+        }
+        out = jnp.broadcast_to(_emit(ast, env), p1.shape)
+        return jnp.clip(out, 0.0, _GENE_MAX)
+
+    _probe(rows, 4, 2, pinned or 8)
+
+    def batched(p1, p2, rand):
+        r = rand.astype(jnp.float32)
+        r2, q, q2 = _derived_streams(r)
+        return rows(
+            p1.astype(jnp.float32), p2.astype(jnp.float32), r, r2, q, q2
+        ).astype(p1.dtype)
+
+    def op(p1, p2, rand):
+        return batched(p1[None, :], p2[None, :], rand[None, :])[0]
+
+    op.batched = batched
+    op.kernel_rows = rows
+    op.kernel_consts = defaults
+    op.kernel_cache_key = cache_key
+    op.expression = expr
+    op.pinned_genome_len = pinned
+    op.__doc__ = f"Expression crossover: {expr}"
+    return op
+
+
+def mutate_from_expression(
+    expr: str, rate: float = 0.01, sigma: float = 0.0, **consts
+) -> Callable:
+    """Compile a mutation expression to the operator protocol
+    (``(genome, rand) -> genome`` with ``.batched``) plus the
+    ``.kernel_rows`` hook — the custom-``__device__``-mutation analog
+    (``pga.h:47``). ``rate``/``sigma`` are the values the expression's
+    ``rate``/``sigma`` variables take (runtime kernel inputs, so an
+    annealing schedule swapping operators reuses one compilation, like
+    the builtin kinds)."""
+    ast, const_names, defaults, pinned, cache_key = _compile_breeding(
+        "mutate-expr", expr, _MUT_VARS, consts
+    )
+
+    def rows(g, r, r2, q, q2, rate_v, sigma_v, *cargs, true_len=None):
+        env = {
+            "g": g, "r": r, "r2": r2, "q": q, "q2": q2,
+            "rate": jnp.float32(rate_v), "sigma": jnp.float32(sigma_v),
+            "i": jax.lax.broadcasted_iota(jnp.int32, g.shape, 1).astype(
+                jnp.float32
+            ),
+            "L": jnp.float32(true_len or g.shape[1]),
+            "shape": g.shape,
+            "table_kinds": {},
+            "consts": dict(zip(const_names, cargs or defaults)),
+        }
+        out = jnp.broadcast_to(_emit(ast, env), g.shape)
+        return jnp.clip(out, 0.0, _GENE_MAX)
+
+    _probe(
+        lambda g, r, r2, q, q2: rows(g, r, r2, q, q2, 0.5, 0.1), 3, 2,
+        pinned or 8,
+    )
+
+    def batched(g, rand):
+        r = rand.astype(jnp.float32)
+        r2, q, q2 = _derived_streams(r)
+        return rows(
+            g.astype(jnp.float32), r, r2, q, q2,
+            jnp.float32(rate), jnp.float32(sigma),
+        ).astype(g.dtype)
+
+    def op(genome, rand):
+        return batched(genome[None, :], rand[None, :])[0]
+
+    op.batched = batched
+    op.kernel_rows = rows
+    op.kernel_consts = defaults
+    op.kernel_cache_key = cache_key
+    op.expression = expr
+    op.pinned_genome_len = pinned
+    # Inspected by the engine (``_operator_param``): these feed the
+    # kernel's runtime mparams, so kernel and XLA paths agree — and
+    # they are deliberately NOT part of kernel_cache_key, which is what
+    # lets an annealing schedule's re-created operators share one
+    # compilation.
+    op.rate = rate
+    op.sigma = sigma
+    op.__doc__ = f"Expression mutation: {expr}"
+    return op

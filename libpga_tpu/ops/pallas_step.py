@@ -250,6 +250,8 @@ def _deme_child(
     bf16_genes,
     elite_rows=0,
     order_refs=None,
+    cross_consts=(),
+    mut_consts=(),
     ablate=(),
 ):
     """Breed one deme's K children: rank-space selection + crossover +
@@ -258,6 +260,14 @@ def _deme_child(
     ranks precomputed outside) and the multi-generation kernel
     (``_multigen_kernel``, ranks computed in-kernel per sub-generation)
     so the two cannot drift.
+
+    ``crossover`` / ``mutate`` are either builtin kind names or the
+    CALLABLE rowwise forms of expression operators
+    (``ops/breed_expr.py``) — a custom C/Python breeding operator
+    evaluated on the VMEM-resident parents at device speed, the kernel
+    analog of the reference's ``__device__`` callback pointers
+    (``pga.h:47-48``). ``cross_consts``/``mut_consts`` carry their
+    registered constants (already lane-padded kernel inputs).
 
     Args: ``g`` (K, Lp) genomes in their STORED dtype; ``R`` (1, K) f32
     in-deme ranks (0 = best, strict total order, pads ranked >= V);
@@ -377,8 +387,35 @@ def _deme_child(
             p1 = pp[:K, :Lp] + pp[:K, Lp:]
             p2 = pp[K:, :Lp] + pp[K:, Lp:]
 
+    pad_lane = None
+    if Lp > L:
+        pad_lane = lax.broadcasted_iota(jnp.int32, (K, Lp), 1) < L
+
+    def _breeding_draws():
+        """The expression operators' random inputs: two per-gene
+        streams (pad lanes zeroed so ``r``-derived values cannot leak
+        into pad genes before the output mask) and two per-row
+        scalars."""
+        r = uniform((K, Lp))
+        r2 = uniform((K, Lp))
+        if pad_lane is not None:
+            r = jnp.where(pad_lane, r, 0.0)
+            r2 = jnp.where(pad_lane, r2, 0.0)
+        qq = uniform((2, K)).T  # (K, 2)
+        return r, r2, qq[:, 0:1], qq[:, 1:2]
+
     if "no_cross" in ablate:
         child = p1
+    elif callable(crossover):
+        # Expression crossover (ops/breed_expr.py): evaluate the
+        # compiled rowwise form on the freshly gathered parents, in
+        # VMEM — the device-speed custom-crossover path. The rowwise
+        # form clips into the gene domain; pad lanes are re-zeroed
+        # (an expression like ``1 - p1`` would otherwise write pads).
+        r, r2, q, q2 = _breeding_draws()
+        child = crossover(p1, p2, r, r2, q, q2, *cross_consts, true_len=L)
+        if pad_lane is not None:
+            child = jnp.where(pad_lane, child, 0.0)
     elif crossover == "uniform":
         # ---- uniform crossover: per-gene coin flip (pga.cu:135-143)
         child = jnp.where(
@@ -481,6 +518,18 @@ def _deme_child(
     # ---- mutation -------------------------------------------------
     if "no_mut" in ablate:
         pass
+    elif callable(mutate):
+        # Expression mutation: same device-speed path; ``rate``/``sigma``
+        # arrive as the kernel's runtime mparams, so annealing schedules
+        # share this compilation exactly like the builtin kinds. Elite
+        # rows keep the unmutated child.
+        r, r2, q, q2 = _breeding_draws()
+        mutated = mutate(
+            child, r, r2, q, q2, rate, sigma, *mut_consts, true_len=L
+        )
+        if pad_lane is not None:
+            mutated = jnp.where(pad_lane, mutated, 0.0)
+        child = jnp.where(elite_col, mutated, child) if elite_rows else mutated
     elif mutate == "point":
         # Point mutation (pga.cu:127-133): one random gene per firing
         # row.
@@ -554,6 +603,8 @@ def _breed_kernel(
     obj=None,
     obj_pad_ok=False,
     n_consts=0,
+    n_cross=0,
+    n_mut=0,
     bf16_genes=False,
     P=None,
     ablate=(),
@@ -578,14 +629,20 @@ def _breed_kernel(
     ``rest`` holds, in order: ``n_consts`` objective-constant input refs
     (problem data like the NK table — Pallas forbids captured array
     constants, so fused objectives declare them via
-    ``kernel_rowwise_consts`` and receive them as call arguments), the
+    ``kernel_rowwise_consts`` and receive them as call arguments),
+    ``n_cross`` + ``n_mut`` expression-breeding constant refs, the
     genome output ref, and (when ``obj`` is set) the score output ref."""
     import jax.lax as lax
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     const_refs = rest[:n_consts]
-    out_ref = rest[n_consts]
+    cross_consts = tuple(r[:] for r in rest[n_consts : n_consts + n_cross])
+    mut_consts = tuple(
+        r[:] for r in rest[n_consts + n_cross : n_consts + n_cross + n_mut]
+    )
+    base = n_consts + n_cross + n_mut
+    out_ref = rest[base]
     order_refs = rest[-6:] if crossover == "order" else None
 
     i = pl.program_id(0)
@@ -658,6 +715,7 @@ def _breed_kernel(
             K=K, L=L, Lp=Lp, tk=tk, sel=sel, sel_param=sel_param,
             crossover=crossover, mutate=mutate, rate=rate, sigma=sigma,
             lane_ok=lane_ok, bf16_genes=bf16_genes, order_refs=order_refs,
+            cross_consts=cross_consts, mut_consts=mut_consts,
             ablate=ablate,
         )
 
@@ -696,7 +754,7 @@ def _breed_kernel(
                 child if obj_pad_ok else child[:, :L],
                 *[r[:] for r in const_refs],
             ).astype(jnp.float32)
-            rest[n_consts + 1][0:1, d : d + 1, :] = child_scores.reshape(
+            rest[base + 1][0:1, d : d + 1, :] = child_scores.reshape(
                 1, 1, K
             )
 
@@ -775,6 +833,8 @@ def _multigen_kernel(
     obj=None,
     obj_pad_ok=False,
     n_consts=0,
+    n_cross=0,
+    n_mut=0,
     bf16_genes=False,
     P=None,
     elitism=0,
@@ -812,10 +872,15 @@ def _multigen_kernel(
     from jax.experimental.pallas import tpu as pltpu
 
     const_refs = rest[:n_consts]
-    g_out = rest[n_consts]
-    s_out = rest[n_consts + 1]
-    g_scr = rest[n_consts + 2]
-    s_scr = rest[n_consts + 3]
+    cross_consts = tuple(r[:] for r in rest[n_consts : n_consts + n_cross])
+    mut_consts = tuple(
+        r[:] for r in rest[n_consts + n_cross : n_consts + n_cross + n_mut]
+    )
+    base = n_consts + n_cross + n_mut
+    g_out = rest[base]
+    s_out = rest[base + 1]
+    g_scr = rest[base + 2]
+    s_scr = rest[base + 3]
     order_refs = rest[-6:] if crossover == "order" else None
 
     i = pl.program_id(0)
@@ -909,7 +974,9 @@ def _multigen_kernel(
                 K=K, L=L, Lp=Lp, tk=tk, sel=sel, sel_param=sel_param,
                 crossover=crossover, mutate=mutate, rate=rate,
                 sigma=sigma, lane_ok=lane_ok, bf16_genes=bf16_genes,
-                elite_rows=elitism, order_refs=order_refs, ablate=ablate,
+                elite_rows=elitism, order_refs=order_refs,
+                cross_consts=cross_consts, mut_consts=mut_consts,
+                ablate=ablate,
             )
             child = child.astype(out_dtype)
             if frozen is not None:
@@ -978,9 +1045,16 @@ def _kernel_shape(
         return None
     if gene_dtype not in (jnp.float32, jnp.bfloat16):
         return None
-    if crossover_kind not in ("uniform", "order"):
+    # Callable kinds are expression breeding operators (the rowwise
+    # forms of ops/breed_expr.py) — evaluated in-kernel like the
+    # builtin kinds.
+    if not callable(crossover_kind) and crossover_kind not in (
+        "uniform", "order",
+    ):
         return None
-    if mutate_kind not in ("point", "gaussian", "swap"):
+    if not callable(mutate_kind) and mutate_kind not in (
+        "point", "gaussian", "swap",
+    ):
         return None
     if crossover_kind == "order" and gene_dtype != jnp.float32:
         return None
@@ -1026,6 +1100,36 @@ def _kernel_shape(
     else:
         D = next((d for d in d_candidates if d <= d_default), 1)
     return K, G, D, G * K, Lp, selection_param
+
+
+def _breeding_kind(kind, L: int, Lp: int):
+    """Normalize a crossover/mutate kind for the kernel: a builtin name
+    passes through with no constants; an expression operator
+    (``ops/breed_expr.py``) contributes its compiled rowwise form plus
+    its registered constants as lane-padded kernel inputs (vector
+    constants pair with the gene axis, so they pad to Lp exactly like
+    the genomes they broadcast against)."""
+    if not callable(kind):
+        return kind, ()
+    rows = getattr(kind, "kernel_rows", None)
+    if rows is None:
+        raise ValueError(
+            "callable breeding kinds must be expression operators "
+            "carrying .kernel_rows (ops/breed_expr.py)"
+        )
+    pin = getattr(kind, "pinned_genome_len", None)
+    if pin and pin != L:
+        raise ValueError(
+            f"breeding expression uses length-{pin} vector constants "
+            f"but the population genome length is {L}"
+        )
+    consts = []
+    for c in getattr(kind, "kernel_consts", ()) or ():
+        a = jnp.atleast_2d(jnp.asarray(c, jnp.float32))
+        if a.shape[-1] == L and Lp != L:
+            a = jnp.pad(a, ((0, 0), (0, Lp - L)))
+        consts.append(a)
+    return rows, tuple(consts)
 
 
 def make_pallas_breed(
@@ -1103,6 +1207,8 @@ def make_pallas_breed(
     consts = tuple(jnp.atleast_2d(jnp.asarray(c)) for c in fused_consts)
     if fused_obj is None:
         consts = ()
+    cross_kind, cross_consts = _breeding_kind(crossover_kind, L, Lp)
+    mut_kind, mut_consts = _breeding_kind(mutate_kind, L, Lp)
 
     kernel = partial(
         _breed_kernel,
@@ -1113,11 +1219,13 @@ def make_pallas_breed(
         tk=tournament_size,
         sel=selection_kind,
         sel_param=selection_param,
-        crossover=crossover_kind,
-        mutate=mutate_kind,
+        crossover=cross_kind,
+        mutate=mut_kind,
         obj=fused_obj,
         obj_pad_ok=bool(getattr(fused_obj, "pad_ok", False)),
         n_consts=len(consts),
+        n_cross=len(cross_consts),
+        n_mut=len(mut_consts),
         bf16_genes=bf16_genes,
         P=P,
         ablate=tuple(_ablate),
@@ -1152,7 +1260,7 @@ def make_pallas_breed(
             pl.BlockSpec((1, 2), lambda i: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((1, D, K), lambda i: (i, 0, 0)),
             pl.BlockSpec((D * K, Lp), lambda i: (i, 0)),
-        ] + [_const_spec(c) for c in consts],
+        ] + [_const_spec(c) for c in consts + cross_consts + mut_consts],
         out_specs=out_specs if fused_obj is not None else out_specs[0],
         out_shape=out_shape if fused_obj is not None else out_shape[0],
         scratch_shapes=(
@@ -1227,7 +1335,9 @@ def make_pallas_breed(
             k_seed, (1, 1), jnp.iinfo(jnp.int32).min, jnp.iinfo(jnp.int32).max,
             dtype=jnp.int32,
         )
-        out = call(seed, mparams, ranks, gp, *consts)
+        out = call(
+            seed, mparams, ranks, gp, *consts, *cross_consts, *mut_consts
+        )
         if fused_obj is not None:
             genomes, child_scores = out
             # Genome row order after reshape is (child r)·G + (deme i);
@@ -1386,15 +1496,18 @@ def make_pallas_multigen(
     from jax.experimental.pallas import tpu as pltpu
 
     consts = tuple(jnp.atleast_2d(jnp.asarray(c)) for c in fused_consts)
+    cross_kind, cross_consts = _breeding_kind(crossover_kind, L, Lp)
+    mut_kind, mut_consts = _breeding_kind(mutate_kind, L, Lp)
 
     kernel = partial(
         _multigen_kernel,
         K=K, D=D, L=L, Lp=Lp,
         tk=tournament_size, sel=selection_kind, sel_param=selection_param,
-        crossover=crossover_kind, mutate=mutate_kind,
+        crossover=cross_kind, mutate=mut_kind,
         obj=fused_obj,
         obj_pad_ok=bool(getattr(fused_obj, "pad_ok", False)),
-        n_consts=len(consts), bf16_genes=bf16_genes, P=P,
+        n_consts=len(consts), n_cross=len(cross_consts),
+        n_mut=len(mut_consts), bf16_genes=bf16_genes, P=P,
         elitism=elitism, ablate=tuple(_ablate),
     )
 
@@ -1412,7 +1525,7 @@ def make_pallas_multigen(
             pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=smem),
             pl.BlockSpec((1, D, K), lambda i: (i, 0, 0)),
             pl.BlockSpec((D * K, Lp), lambda i: (i, 0)),
-        ] + [_const_spec(c) for c in consts],
+        ] + [_const_spec(c) for c in consts + cross_consts + mut_consts],
         out_specs=[
             pl.BlockSpec((K, 1, D, Lp), lambda i: (0, i, 0, 0)),
             pl.BlockSpec((1, D, K), lambda i: (i, 0, 0)),
@@ -1450,7 +1563,10 @@ def make_pallas_multigen(
         steps_a = jnp.asarray(steps, dtype=jnp.int32).reshape(1, 1)
         tgt_a = jnp.asarray(target, dtype=jnp.float32).reshape(1, 1)
         s_in = scores.astype(jnp.float32).reshape(G // D, D, K)
-        genomes, cs = call(seed, mparams, steps_a, tgt_a, s_in, gp, *consts)
+        genomes, cs = call(
+            seed, mparams, steps_a, tgt_a, s_in, gp,
+            *consts, *cross_consts, *mut_consts,
+        )
         s2 = cs.reshape(G, K).T.reshape(Pp)
         if Pp != P:
             s2 = jnp.where(jnp.arange(Pp, dtype=jnp.int32) < P, s2, -jnp.inf)

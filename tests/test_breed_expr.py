@@ -1,0 +1,355 @@
+"""Expression breeding operators (``ops/breed_expr.py``) — device-speed
+custom crossover/mutation, the TPU answer to the reference's remaining
+``__device__`` callback pointers (``pga.h:47-48``; its TSP driver's
+custom crossover, ``test3/test.cu:48-64``, is the motivating workload).
+
+Covers: XLA operator semantics, the per-gene compile restriction, the
+fused-kernel path in interpret mode (padded populations included),
+engine integration (kind detection, convergence, elitism), and the
+C-ABI bridge's device-path guarantees. Hardware lowering is exercised
+by ``capi/test_expr_breed.c`` (tests/test_capi.py) and
+``tools/tpu_kernel_checks.py``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from libpga_tpu.objectives import ExpressionError
+from libpga_tpu.ops.breed_expr import (
+    crossover_from_expression,
+    mutate_from_expression,
+)
+
+
+class TestOperatorSemantics:
+    def test_one_point_crossover_via_q(self):
+        """``where(i < floor(q*L), p1, p2)`` must produce a contiguous
+        p1-prefix / p2-suffix per child."""
+        cx = crossover_from_expression("where(i < floor(q * L), p1, p2)")
+        p1 = jnp.zeros((8, 12))
+        p2 = jnp.full((8, 12), 0.9)
+        rand = jax.random.uniform(jax.random.PRNGKey(0), (8, 12))
+        child = np.asarray(cx.batched(p1, p2, rand))
+        for row in child:
+            nz = np.flatnonzero(row)
+            if nz.size:  # suffix of p2 genes, no interleaving
+                assert nz[-1] == 11 and np.all(np.diff(nz) == 1)
+
+    def test_blend_stays_in_parent_hull_and_domain(self):
+        cx = crossover_from_expression("r * p1 + (1 - r) * p2")
+        rng = np.random.default_rng(1)
+        p1 = jnp.asarray(rng.random((16, 10), dtype=np.float32))
+        p2 = jnp.asarray(rng.random((16, 10), dtype=np.float32))
+        rand = jax.random.uniform(jax.random.PRNGKey(1), (16, 10))
+        child = np.asarray(cx.batched(p1, p2, rand))
+        lo = np.minimum(np.asarray(p1), np.asarray(p2))
+        hi = np.maximum(np.asarray(p1), np.asarray(p2))
+        assert np.all(child >= lo - 1e-6) and np.all(child <= hi + 1e-6)
+        assert np.all(child >= 0.0) and np.all(child < 1.0)
+
+    def test_reset_mutation_rate_statistics(self):
+        mx = mutate_from_expression("where(r < rate, r2, g)", rate=0.1)
+        g = jnp.full((4096, 32), 0.25)
+        rand = jax.random.uniform(jax.random.PRNGKey(2), (4096, 32))
+        out = np.asarray(mx.batched(g, rand))
+        frac = float((out != 0.25).mean())
+        assert abs(frac - 0.1) < 0.01, frac
+        assert mx.rate == 0.1 and mx.sigma == 0.0
+
+    def test_result_clipped_into_gene_domain(self):
+        mx = mutate_from_expression("g + 5")
+        g = jnp.asarray(np.random.default_rng(3).random((4, 8), dtype=np.float32))
+        out = np.asarray(mx.batched(g, jnp.zeros((4, 8))))
+        assert np.all(out < 1.0) and np.all(out >= 0.0)
+
+    def test_vector_constant_pins_genome_length(self):
+        cx = crossover_from_expression(
+            "where(m > 0.5, p1, p2)", m=np.ones(16, dtype=np.float32)
+        )
+        assert cx.pinned_genome_len == 16
+
+    def test_cache_key_shared_across_instances(self):
+        """Annealing schedules re-create operators with new rate/sigma;
+        the compiled-kernel cache keys on the expression semantics, not
+        the instance, so those recreations reuse one compilation."""
+        from libpga_tpu.engine import _kind_key
+
+        a = mutate_from_expression("where(r < rate, r2, g)", rate=0.1)
+        b = mutate_from_expression("where(r < rate, r2, g)", rate=0.01)
+        assert _kind_key(a) == _kind_key(b)
+        c = mutate_from_expression("where(r < rate, g + r2, g)", rate=0.1)
+        assert _kind_key(a) != _kind_key(c)
+        w = np.ones(8, dtype=np.float32)
+        d = crossover_from_expression("where(m > 0.5, p1, p2)", m=w)
+        e = crossover_from_expression("where(m > 0.5, p1, p2)", m=w * 0.1)
+        assert _kind_key(d) != _kind_key(e)  # different constant VALUES
+        assert _kind_key(a) != _kind_key(
+            crossover_from_expression("where(r < 0.5, p1, p2)")
+        )
+        assert _kind_key("point") == "point"  # builtins key by name
+
+    def test_per_genome_matches_batched(self):
+        cx = crossover_from_expression("where(r < 0.5, p1, p2)")
+        rng = np.random.default_rng(4)
+        p1 = jnp.asarray(rng.random(10, dtype=np.float32))
+        p2 = jnp.asarray(rng.random(10, dtype=np.float32))
+        rand = jnp.asarray(rng.random(10, dtype=np.float32))
+        np.testing.assert_array_equal(
+            np.asarray(cx(p1, p2, rand)),
+            np.asarray(cx.batched(p1[None], p2[None], rand[None])[0]),
+        )
+
+
+class TestCompileRestrictions:
+    def test_reductions_rejected(self):
+        for expr in ("sum(p1)", "p1 * mean(p2)", "min(r) + p1",
+                     "dot(p1, p2)"):
+            with pytest.raises(ExpressionError, match="per-gene"):
+                crossover_from_expression(expr)
+
+    def test_roll_gather_rejected(self):
+        with pytest.raises(ExpressionError, match="per-gene"):
+            mutate_from_expression("roll(g, 1)")
+        with pytest.raises(ExpressionError, match="per-gene"):
+            mutate_from_expression(
+                "gather(t, g)", t=np.ones(4, dtype=np.float32)
+            )
+
+    def test_role_variables_enforced(self):
+        with pytest.raises(ExpressionError, match="unknown name"):
+            crossover_from_expression("where(r < 0.5, g, p2)")  # no g
+        with pytest.raises(ExpressionError, match="unknown name"):
+            mutate_from_expression("p1 + g")  # no parents
+        with pytest.raises(ExpressionError, match="unknown name"):
+            crossover_from_expression("p1 * rate")  # rate is mutate-only
+
+    def test_elementwise_min_max_allowed(self):
+        crossover_from_expression("min(p1, p2) + 0 * max(p1, p2)")
+
+    def test_two_d_constant_rejected(self):
+        with pytest.raises(ExpressionError, match="scalar or 1-D"):
+            mutate_from_expression("g * c", c=np.ones((2, 3)))
+
+
+class TestKernelPath:
+    @pytest.mark.parametrize("pop", [256, 300])  # exact and padded
+    def test_fused_kernel_interpret_mode(self, pop):
+        """Expression crossover + mutation evaluate inside the breed
+        kernel: children in-domain, pads inert, fused scores consistent
+        with the returned genomes."""
+        from jax.experimental.pallas import tpu as pltpu
+
+        from libpga_tpu.objectives import get as get_obj
+        from libpga_tpu.ops.pallas_step import make_pallas_breed
+
+        cx = crossover_from_expression("where(i < floor(q * L), p1, p2)")
+        mx = mutate_from_expression("where(r < rate, r2, g)", rate=0.05)
+        obj = get_obj("onemax")
+        L = 10
+        g = jax.random.uniform(jax.random.PRNGKey(1), (pop, L))
+        s = g.sum(axis=1)
+        with pltpu.force_tpu_interpret_mode():
+            breed = make_pallas_breed(
+                pop, L, deme_size=128, crossover_kind=cx, mutate_kind=mx,
+                fused_obj=obj.kernel_rowwise,
+            )
+            assert breed is not None
+            g2, s2 = breed(g, s, jax.random.PRNGKey(2))
+        g2, s2 = np.asarray(g2), np.asarray(s2)
+        assert g2.shape == (pop, L)
+        assert np.all(g2 >= 0.0) and np.all(g2 < 1.0)
+        np.testing.assert_allclose(s2, g2.sum(axis=1), atol=1e-4)
+
+    def test_multigen_kernel_interpret_mode(self):
+        from jax.experimental.pallas import tpu as pltpu
+
+        from libpga_tpu.objectives import get as get_obj
+        from libpga_tpu.ops.pallas_step import make_pallas_multigen
+
+        cx = crossover_from_expression("where(r < 0.5, p1, p2)")
+        mx = mutate_from_expression("where(r < rate, r2, g)", rate=0.05)
+        obj = get_obj("onemax")
+        P, L = 256, 10
+        g = jax.random.uniform(jax.random.PRNGKey(3), (P, L))
+        s = g.sum(axis=1)
+        with pltpu.force_tpu_interpret_mode():
+            bm = make_pallas_multigen(
+                P, L, deme_size=128, crossover_kind=cx, mutate_kind=mx,
+                fused_obj=obj.kernel_rowwise,
+            )
+            assert bm is not None
+            g2, s2 = bm(g, s, jax.random.PRNGKey(4), jnp.int32(3))
+        np.testing.assert_allclose(
+            np.asarray(s2), np.asarray(g2).sum(axis=1), atol=1e-4
+        )
+
+    def test_vector_const_rides_as_kernel_input(self):
+        """A per-gene mask constant reaches the kernel lane-padded: the
+        masked crossover takes p1 exactly where the mask says."""
+        from jax.experimental.pallas import tpu as pltpu
+
+        from libpga_tpu.objectives import get as get_obj
+        from libpga_tpu.ops.pallas_step import make_pallas_breed
+
+        L = 10
+        mask = (np.arange(L) < 5).astype(np.float32)
+        cx = crossover_from_expression("where(m > 0.5, p1, p2)", m=mask)
+        mx = mutate_from_expression("g")  # identity
+        obj = get_obj("onemax")
+        g = jnp.asarray(
+            np.random.default_rng(5).random((256, L), dtype=np.float32)
+        )
+        with pltpu.force_tpu_interpret_mode():
+            breed = make_pallas_breed(
+                256, L, deme_size=128, crossover_kind=cx, mutate_kind=mx,
+                fused_obj=obj.kernel_rowwise,
+            )
+            g2, _ = breed(g, g.sum(axis=1), jax.random.PRNGKey(6))
+        # every child's genes are copies of SOME population rows in the
+        # masked halves: verify each child's first-half and second-half
+        # each match at least one parent row exactly
+        g2 = np.asarray(g2)
+        gsrc = np.asarray(g)
+        for row in g2[:16]:
+            assert any(np.allclose(row[:5], src[:5]) for src in gsrc)
+            assert any(np.allclose(row[5:], src[5:]) for src in gsrc)
+
+    def test_pinned_length_mismatch_raises(self):
+        from libpga_tpu.ops.pallas_step import make_pallas_breed
+
+        cx = crossover_from_expression(
+            "where(m > 0.5, p1, p2)", m=np.ones(16, dtype=np.float32)
+        )
+        with pytest.raises(ValueError, match="length-16"):
+            make_pallas_breed(256, 32, crossover_kind=cx)
+
+
+class TestEngineIntegration:
+    def test_kind_detection_and_convergence(self):
+        from libpga_tpu import PGA
+
+        cx = crossover_from_expression(
+            "where(r < 0.3, (p1 + p2) / 2, where(r2 < 0.5, p1, p2))"
+        )
+        mx = mutate_from_expression("where(r < rate, r2, g)", rate=0.02)
+        pga = PGA(seed=0)
+        h = pga.create_population(256, 16)
+        pga.set_objective("onemax")
+        pga.set_crossover(cx)
+        pga.set_mutate(mx)
+        assert pga._crossover_kind() is cx
+        assert pga._mutate_kind() is mx
+        # the engine's kernel mparams mirror the operator's declaration
+        params = np.asarray(pga._mutate_params())
+        assert params[0, 0] == np.float32(0.02)
+        pga.run(40)
+        _, best = pga.get_best_with_score(h)
+        assert best > 13.0, best
+
+    def test_elitism_preserved_with_expression_operators(self):
+        from libpga_tpu import PGA, PGAConfig
+
+        cx = crossover_from_expression("where(r < 0.5, p1, p2)")
+        mx = mutate_from_expression("where(r < rate, r2, g)", rate=0.5)
+        pga = PGA(seed=3, config=PGAConfig(elitism=2))
+        h = pga.create_population(128, 12)
+        pga.set_objective("onemax")
+        pga.set_crossover(cx)
+        pga.set_mutate(mx)
+        pga.evaluate(h)
+        top_before = float(jnp.max(pga.population(h).scores))
+        pga.run(5)
+        top_after = float(jnp.max(pga.population(h).scores))
+        assert top_after >= top_before - 1e-5
+
+    def test_null_restore_returns_builtin_kinds(self):
+        from libpga_tpu import PGA
+
+        pga = PGA(seed=0)
+        pga.create_population(128, 8)
+        pga.set_crossover(crossover_from_expression("p1"))
+        pga.set_mutate(mutate_from_expression("g"))
+        pga.set_crossover(None)
+        pga.set_mutate(None)
+        assert pga._crossover_kind() == "uniform"
+        assert pga._mutate_kind() == "point"
+
+
+class TestCapiBridge:
+    def test_expr_breeding_stays_on_device(self):
+        """Unlike the host-pointer path, expression breeding operators
+        must NOT pin the solver to the CPU backend, and must expose the
+        kernel hook (the verdict item-1 'no pure_callback, no CPU pin'
+        contract)."""
+        from libpga_tpu import capi_bridge as cb
+
+        h = cb.init(9)
+        try:
+            cb.create_population(h, 256, 16, 0)
+            cb.set_objective_name(h, "onemax")
+            cb.set_crossover_expr(h, "where(i < floor(q * L), p1, p2)")
+            cb.set_mutate_expr(h, "where(r < rate, r2, g)", 0.05, -1.0)
+            pga = cb._solver(h)
+            assert not cb._host_ops.get(h), "expr breeding pinned to CPU"
+            assert pga.config.use_pallas is None  # auto stays
+            assert getattr(pga._crossover, "kernel_rows", None) is not None
+            assert getattr(pga._mutate, "kernel_rows", None) is not None
+            assert pga._mutate.rate == np.float32(0.05)
+            # and the solver still evolves
+            gens = pga.run(5)
+            assert gens == 5
+        finally:
+            cb.deinit(h)
+
+    def test_expr_breeding_error_paths(self):
+        from libpga_tpu import capi_bridge as cb
+
+        h = cb.init(10)
+        try:
+            cb.create_population(h, 128, 8, 0)
+            with pytest.raises(ExpressionError):
+                cb.set_crossover_expr(h, "sum(p1)")
+            with pytest.raises(ExpressionError):
+                cb.set_mutate_expr(h, "where(", -1.0, -1.0)
+            # a registered 2-D gather table is NOT forwarded to the
+            # breeding factories (strictly per-gene)
+            cb.set_objective_expr_const2(
+                h, "T", np.ones(8 * 4, dtype=np.float32).tobytes(), 4, 8
+            )
+            cb.set_crossover_expr(h, "where(r < 0.5, p1, p2)")  # ok
+        finally:
+            cb.deinit(h)
+
+    def test_breeding_pin_checked_at_create_population(self):
+        """A population created AFTER a breeding expression with vector
+        constants gets the set-time length diagnostic (review finding) —
+        not a mid-run kernel-build error."""
+        from libpga_tpu import capi_bridge as cb
+
+        h = cb.init(11)
+        try:
+            cb.set_objective_expr_const(
+                h, "m", np.ones(16, dtype=np.float32).tobytes()
+            )
+            cb.set_crossover_expr(h, "where(m > 0.5, p1, p2)")
+            cb.create_population(h, 128, 16, 0)  # matching: ok
+            with pytest.raises(ValueError, match="length-16"):
+                cb.create_population(h, 128, 32, 0)
+            assert cb._solver(h).num_populations == 1
+        finally:
+            cb.deinit(h)
+
+
+def test_capi_expression_breeding_driver(built_shim):
+    """The C smoke driver: non-builtin crossover+mutation expressions
+    drive OneMax from C at device speed; error paths return -1; NULL
+    restores the defaults."""
+    out = _run(built_shim, "test_expr_breed")
+    assert "blend+creep best" in out
+    assert "one-point+reset best" in out
+
+
+# Reuse test_capi's build fixture + runner for the C driver test.
+from tests.test_capi import _run, built_shim  # noqa: E402,F401
