@@ -322,10 +322,14 @@ def set_crossover_expr(handle: int, expr: str) -> None:
     is the motivating workload). Unlike ``set_crossover_ptr``, the
     solver stays on the accelerator. Registered constants
     (``set_objective_expr_const``) are visible here too."""
-    from libpga_tpu.ops.breed_expr import crossover_from_expression
+    from libpga_tpu.ops.breed_expr import (
+        _CROSS_VARS, crossover_from_expression,
+    )
 
     pga = _solver(handle)
-    op = crossover_from_expression(expr, **_scalar_vector_consts(handle))
+    op = crossover_from_expression(
+        expr, **_scalar_vector_consts(handle, _CROSS_VARS)
+    )
     _check_expr_const_lens(op, {p.genome_len for p in pga.populations})
     pga.set_crossover(op)
     _set_host_op(handle, "cross", False)
@@ -337,28 +341,32 @@ def set_mutate_expr(handle: int, expr: str, rate: float, sigma: float) -> None:
     analog (``pga.h:47``). ``rate``/``sigma`` bind the expression's
     runtime variables; negative values take the library defaults
     (0.01 / 0.0)."""
-    from libpga_tpu.ops.breed_expr import mutate_from_expression
+    from libpga_tpu.ops.breed_expr import _MUT_VARS, mutate_from_expression
 
     pga = _solver(handle)
     op = mutate_from_expression(
         expr,
         rate=0.01 if rate < 0 else float(rate),
         sigma=0.0 if sigma < 0 else float(sigma),
-        **_scalar_vector_consts(handle),
+        **_scalar_vector_consts(handle, _MUT_VARS),
     )
     _check_expr_const_lens(op, {p.genome_len for p in pga.populations})
     pga.set_mutate(op)
     _set_host_op(handle, "mut", False)
 
 
-def _scalar_vector_consts(handle: int) -> Dict[str, np.ndarray]:
-    """The solver's registered constants minus 2-D gather tables —
-    breeding expressions are strictly per-gene, and passing a table
-    would fail their factory with a confusing shape message."""
+def _scalar_vector_consts(handle: int, reserved=()) -> Dict[str, np.ndarray]:
+    """The solver's registered constants minus 2-D gather tables
+    (breeding expressions are strictly per-gene) and minus any name a
+    breeding VARIABLE reserves (r, q, p1, rate, ...): constants register
+    per solver across surfaces, so a name legal for objectives must not
+    make every later set_*_expr fail its shadow check — and the parser
+    resolves variables before constants anyway, so a colliding constant
+    could never be referenced."""
     return {
         n: a
         for n, a in _expr_consts.get(handle, {}).items()
-        if a.ndim <= 1
+        if a.ndim <= 1 and n not in reserved
     }
 
 

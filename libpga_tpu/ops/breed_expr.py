@@ -106,11 +106,14 @@ def _compile_breeding(role: str, expr: str, var_names, consts):
 
     ast = _Parser(expr, set(const_vals), var_names=var_names).parse()
     used: set = set()
+    used_vars: set = set()
 
     def visit(node):
         _forbid_non_elementwise(node)
         if node[0] == "const":
             used.add(node[1])
+        elif node[0] == "var":
+            used_vars.add(node[1])
 
     walk_ast(ast, visit)
     const_vals = {n: a for n, a in const_vals.items() if n in used}
@@ -131,7 +134,7 @@ def _compile_breeding(role: str, expr: str, var_names, consts):
             for n in const_names
         ),
     )
-    return ast, const_names, defaults, pinned, cache_key
+    return ast, const_names, defaults, pinned, cache_key, used_vars
 
 
 def _derived_streams(r: jax.Array):
@@ -174,8 +177,8 @@ def crossover_from_expression(expr: str, **consts) -> Callable:
     pointers (``pga.h:48``; its TSP driver's operator,
     ``test3/test.cu:48-64``, is the motivating workload). See the module
     docstring for the variable set and examples."""
-    ast, const_names, defaults, pinned, cache_key = _compile_breeding(
-        "crossover-expr", expr, _CROSS_VARS, consts
+    ast, const_names, defaults, pinned, cache_key, used_vars = (
+        _compile_breeding("crossover-expr", expr, _CROSS_VARS, consts)
     )
 
     def rows(p1, p2, r, r2, q, q2, *cargs, true_len=None):
@@ -204,6 +207,10 @@ def crossover_from_expression(expr: str, **consts) -> Callable:
     def op(p1, p2, rand):
         return batched(p1[None, :], p2[None, :], rand[None, :])[0]
 
+    # Which random streams the expression actually references — the
+    # kernel draws only those (a full (K, Lp) PRNG tile per unused
+    # stream is real per-generation cost at 1M-population scale).
+    rows.uses = frozenset(used_vars & {"r", "r2", "q", "q2"})
     op.batched = batched
     op.kernel_rows = rows
     op.kernel_consts = defaults
@@ -224,8 +231,8 @@ def mutate_from_expression(
     ``rate``/``sigma`` variables take (runtime kernel inputs, so an
     annealing schedule swapping operators reuses one compilation, like
     the builtin kinds)."""
-    ast, const_names, defaults, pinned, cache_key = _compile_breeding(
-        "mutate-expr", expr, _MUT_VARS, consts
+    ast, const_names, defaults, pinned, cache_key, used_vars = (
+        _compile_breeding("mutate-expr", expr, _MUT_VARS, consts)
     )
 
     def rows(g, r, r2, q, q2, rate_v, sigma_v, *cargs, true_len=None):
@@ -259,6 +266,7 @@ def mutate_from_expression(
     def op(genome, rand):
         return batched(genome[None, :], rand[None, :])[0]
 
+    rows.uses = frozenset(used_vars & {"r", "r2", "q", "q2"})
     op.batched = batched
     op.kernel_rows = rows
     op.kernel_consts = defaults

@@ -391,18 +391,27 @@ def _deme_child(
     if Lp > L:
         pad_lane = lax.broadcasted_iota(jnp.int32, (K, Lp), 1) < L
 
-    def _breeding_draws():
-        """The expression operators' random inputs: two per-gene
-        streams (pad lanes zeroed so ``r``-derived values cannot leak
-        into pad genes before the output mask) and two per-row
-        scalars."""
-        r = uniform((K, Lp))
-        r2 = uniform((K, Lp))
-        if pad_lane is not None:
-            r = jnp.where(pad_lane, r, 0.0)
-            r2 = jnp.where(pad_lane, r2, 0.0)
-        qq = uniform((2, K)).T  # (K, 2)
-        return r, r2, qq[:, 0:1], qq[:, 1:2]
+    def _breeding_draws(uses):
+        """The expression operators' random inputs, drawn ONLY for the
+        streams the compiled expression references (``rows.uses`` — a
+        (K, Lp) PRNG tile per unused stream is real per-generation cost
+        at scale): per-gene streams get pad lanes zeroed so ``r``-
+        derived values cannot leak into pad genes before the output
+        mask; ``q``/``q2`` share one per-row draw."""
+        zero = jnp.float32(0.0)
+
+        def gene_stream():
+            s = uniform((K, Lp))
+            return jnp.where(pad_lane, s, 0.0) if pad_lane is not None else s
+
+        r = gene_stream() if "r" in uses else zero
+        r2 = gene_stream() if "r2" in uses else zero
+        if uses & {"q", "q2"}:
+            qq = uniform((2, K)).T  # (K, 2)
+            q, q2 = qq[:, 0:1], qq[:, 1:2]
+        else:
+            q = q2 = zero
+        return r, r2, q, q2
 
     if "no_cross" in ablate:
         child = p1
@@ -412,7 +421,9 @@ def _deme_child(
         # VMEM — the device-speed custom-crossover path. The rowwise
         # form clips into the gene domain; pad lanes are re-zeroed
         # (an expression like ``1 - p1`` would otherwise write pads).
-        r, r2, q, q2 = _breeding_draws()
+        r, r2, q, q2 = _breeding_draws(
+            getattr(crossover, "uses", frozenset({"r", "r2", "q", "q2"}))
+        )
         child = crossover(p1, p2, r, r2, q, q2, *cross_consts, true_len=L)
         if pad_lane is not None:
             child = jnp.where(pad_lane, child, 0.0)
@@ -523,7 +534,9 @@ def _deme_child(
         # arrive as the kernel's runtime mparams, so annealing schedules
         # share this compilation exactly like the builtin kinds. Elite
         # rows keep the unmutated child.
-        r, r2, q, q2 = _breeding_draws()
+        r, r2, q, q2 = _breeding_draws(
+            getattr(mutate, "uses", frozenset({"r", "r2", "q", "q2"}))
+        )
         mutated = mutate(
             child, r, r2, q, q2, rate, sigma, *mut_consts, true_len=L
         )

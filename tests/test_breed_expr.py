@@ -90,6 +90,16 @@ class TestOperatorSemantics:
         )
         assert _kind_key("point") == "point"  # builtins key by name
 
+    def test_used_random_streams_recorded(self):
+        """The kernel draws only the streams the expression references
+        (review finding: a (K, Lp) PRNG tile per unused stream is real
+        per-generation cost)."""
+        cx = crossover_from_expression("where(i < floor(q * L), p1, p2)")
+        assert cx.kernel_rows.uses == {"q"}
+        mx = mutate_from_expression("where(r < rate, r2, g)")
+        assert mx.kernel_rows.uses == {"r", "r2"}
+        assert crossover_from_expression("p1").kernel_rows.uses == set()
+
     def test_per_genome_matches_batched(self):
         cx = crossover_from_expression("where(r < 0.5, p1, p2)")
         rng = np.random.default_rng(4)
@@ -319,6 +329,25 @@ class TestCapiBridge:
                 h, "T", np.ones(8 * 4, dtype=np.float32).tobytes(), 4, 8
             )
             cb.set_crossover_expr(h, "where(r < 0.5, p1, p2)")  # ok
+        finally:
+            cb.deinit(h)
+
+    def test_colliding_const_name_does_not_block_breeding(self):
+        """A constant registered under a breeding-variable name (legal
+        for objectives) must not fail every later set_*_expr — it is
+        dropped from the forwarded set (the parser resolves variables
+        first, so it could never be referenced anyway)."""
+        from libpga_tpu import capi_bridge as cb
+
+        h = cb.init(12)
+        try:
+            cb.create_population(h, 128, 8, 0)
+            cb.set_objective_expr_const(
+                h, "q", np.float32(2.0).tobytes()
+            )
+            cb.set_objective_expr(h, "sum(g) * q")  # objective uses it
+            cb.set_crossover_expr(h, "where(r < 0.5, p1, p2)")  # review fix
+            cb.set_mutate_expr(h, "where(r < rate, r2, g)", 0.05, -1.0)
         finally:
             cb.deinit(h)
 
