@@ -49,8 +49,8 @@ would instead select the try where noise shrank the difference).
 
 INTERLEAVED ROUNDS (round-5 protocol, from the round-4 lesson in
 BASELINE.md): sequential same-process measurements minutes apart drift
-more than the effects being compared, so the four benchmarks (f32,
-islands, bf16, ref40k) are measured in ``ROUNDS`` alternating rounds
+more than the effects being compared, so the five benchmarks (f32,
+islands, bf16, ref40k, tsp1k) are measured in ``ROUNDS`` alternating rounds
 with a fixed per-round ordering — every metric reports the MEDIAN and
 IQR across rounds (``*_median`` / ``*_iqr``), and the islands/single-
 population ratio is computed per round from ADJACENT measurements
@@ -183,6 +183,31 @@ def setup_reference_scale():
     return lambda n: pga.run(n)
 
 
+def setup_tsp1k():
+    """1,000-city Euclidean TSP at pop 8,192 — 10× the reference
+    driver's 110-city cap (``test3/test.cu:22-24``): order crossover +
+    swap mutation + the gene-major fused evaluation
+    (``make_tsp_coords(duplicate_mode="genes")``), all inside one
+    kernel launch per generation."""
+    from libpga_tpu import PGA, PGAConfig
+    from libpga_tpu.objectives.classic import (
+        make_tsp_coords, random_tsp_coords,
+    )
+    from libpga_tpu.ops.crossover import order_preserving_crossover
+    from libpga_tpu.ops.mutate import make_swap_mutate
+
+    tsp = make_tsp_coords(
+        random_tsp_coords(1000, seed=2), duplicate_mode="genes"
+    )
+    pga = PGA(seed=11, config=PGAConfig(use_pallas=True))
+    pga.create_population(8192, 1000)
+    pga.set_objective(tsp)
+    pga.set_crossover(order_preserving_crossover)
+    pga.set_mutate(make_swap_mutate(0.5))
+    pga.run(3)
+    return lambda n: pga.run(n)
+
+
 def setup_islands():
     """8 islands × 131,072 × 100, ring migration of the top 5% every 10
     generations (BASELINE.json island config), vmapped on one chip."""
@@ -242,6 +267,7 @@ def main() -> None:
         ("islands", setup_islands(), 50, 150),
         ("bf16", setup_single(jnp.bfloat16), 50, 150),
         ("ref40k", setup_reference_scale(), 200, 600),
+        ("tsp1k", setup_tsp1k(), 20, 60),
     ]
     samples: dict = {name: [] for name, *_ in runners}
     ratios = []
@@ -274,6 +300,9 @@ def main() -> None:
         "ref40k_gens_per_sec_iqr": round(med["ref40k"][1], 1),
         "islands_single_ratio_median": round(ratio_med, 3),
         "islands_single_ratio_iqr": round(ratio_iqr, 3),
+        "tsp1k_gens_per_sec": round(med["tsp1k"][0], 1),
+        "tsp1k_gens_per_sec_median": round(med["tsp1k"][0], 1),
+        "tsp1k_gens_per_sec_iqr": round(med["tsp1k"][1], 1),
     }
     d32 = single_derived(jnp.float32, f32_gps)
     out.update(d32)

@@ -242,7 +242,11 @@ def make_tsp(city_matrix, duplicate_penalty: float = 10_000.0):
     return tsp
 
 
-def make_tsp_coords(coords, duplicate_penalty: float = 10_000.0):
+def make_tsp_coords(
+    coords,
+    duplicate_penalty: float = 10_000.0,
+    duplicate_mode: str = "pairs",
+):
     """Euclidean TSP over city COORDINATES — the scalable form for
     long tours.
 
@@ -256,9 +260,28 @@ def make_tsp_coords(coords, duplicate_penalty: float = 10_000.0):
     few hundred cities; the reference itself caps at 110 cities,
     ``test3/test.cu:22-24``). Use :func:`make_tsp` for arbitrary
     (non-metric) matrices at reference scales.
+
+    ``duplicate_mode``: how repeated cities are penalized. ``"pairs"``
+    (default) counts ordered duplicate pairs — the reference driver's
+    O(L²) loop semantics (``test3/test.cu:37-44``), matching
+    :func:`make_tsp`. ``"genes"`` counts duplicate GENES
+    (``Σ_c max(n_c−1, 0)`` = L − distinct cities) — linear in the
+    duplicate count instead of quadratic, with the same zero set (valid
+    tours score identically; any duplicate still eats ≥ one penalty).
+    The "genes" mode additionally carries an IN-KERNEL gene-major
+    evaluator (``kernel_gene_major``): with order crossover the fused
+    breed kernel scores each child inside VMEM via a factorized
+    one-hot coordinate gather and the walk's city-bitmask machinery —
+    the long-genome TSP evaluation path (the XLA one-hot gather's HBM
+    traffic dominates end-to-end generations at 1,000 cities).
     """
     coords = jnp.asarray(coords, dtype=jnp.float32)
     C = coords.shape[0]
+    if duplicate_mode not in ("pairs", "genes"):
+        raise ValueError(
+            f"duplicate_mode must be 'pairs' or 'genes', got "
+            f"{duplicate_mode!r}"
+        )
 
     def edge_lengths(xy):
         # (..., L, 2) -> (...,) tour length over consecutive pairs
@@ -274,10 +297,16 @@ def make_tsp_coords(coords, duplicate_penalty: float = 10_000.0):
         cities = jnp.clip(jnp.floor(genome * L).astype(jnp.int32), 0, L - 1)
         xy = jnp.take(coords, jnp.clip(cities, 0, C - 1), axis=0)
         dup = cities[:, None] == cities[None, :]
-        off_diag = dup & ~jnp.eye(L, dtype=bool)
-        return -(
-            edge_lengths(xy) + duplicate_penalty * jnp.sum(off_diag)
-        )
+        if duplicate_mode == "pairs":
+            dups = jnp.sum(dup & ~jnp.eye(L, dtype=bool))
+        else:  # "genes": position i is a duplicate if its city appeared
+            # at any earlier position — exactly L − distinct cities.
+            earlier = (
+                jnp.arange(L, dtype=jnp.int32)[None, :]
+                < jnp.arange(L, dtype=jnp.int32)[:, None]
+            )
+            dups = jnp.sum(jnp.any(dup & earlier, axis=1))
+        return -(edge_lengths(xy) + duplicate_penalty * dups)
 
     def tsp_rows(m: jax.Array) -> jax.Array:
         P, L = m.shape
@@ -290,7 +319,10 @@ def make_tsp_coords(coords, duplicate_penalty: float = 10_000.0):
                 c.reshape(-1)[:, None] == jnp.arange(CC, dtype=jnp.int32)
             ).astype(jnp.float32)  # (B*L, CC)
             counts = onehot.reshape(B, L, CC).sum(axis=1)  # (B, CC)
-            dups = jnp.sum(counts * counts, axis=1) - L
+            if duplicate_mode == "pairs":
+                dups = jnp.sum(counts * counts, axis=1) - L
+            else:
+                dups = L - jnp.sum((counts > 0).astype(jnp.float32), axis=1)
             if CC == C:
                 gather_oh = onehot
             else:
@@ -306,6 +338,37 @@ def make_tsp_coords(coords, duplicate_penalty: float = 10_000.0):
         return _chunked_rows(score_chunk, cities)
 
     tsp.rows = tsp_rows
+    if duplicate_mode == "genes":
+        # Factorized city id c = 32a + b. The kernel batches 8 gene
+        # rows into ONE (128, A)@(A, 8K) one-hot matmul over the
+        # a-digit (contracting A on sublanes — no per-step transposes),
+        # then a 32-sublane b-digit select per row: O(K·(A/8 + 32))
+        # work per gene position instead of the O(K·C) of a C-wide
+        # masked accumulation. The table is a bf16 HI/LO SPLIT of the
+        # coordinates (hi = bf16(c), lo = c − hi — the gene matmul's
+        # own trick): Mosaic's MXU runs matmuls at bf16 operand
+        # precision, and raw bf16 coordinates cost ~±2 units each
+        # (~±100 on a 1,000-city tour, measured); the exact 0/1 one-hot
+        # times hi+lo recovers f32 coordinates to ~1e-3. Layout:
+        # rows 0..31 x_hi by b-digit, 32..63 y_hi, 64..95 x_lo,
+        # 96..127 y_lo; a-digit on lanes.
+        A = -(-C // 32)
+        tableT = np.zeros((128, A), dtype=np.float32)
+        cnp = np.asarray(coords)
+        hi = np.asarray(
+            jnp.asarray(cnp).astype(jnp.bfloat16).astype(jnp.float32)
+        )
+        lo = cnp - hi
+        for c in range(C):
+            tableT[c % 32, c // 32] = hi[c, 0]
+            tableT[32 + c % 32, c // 32] = hi[c, 1]
+            tableT[64 + c % 32, c // 32] = lo[c, 0]
+            tableT[96 + c % 32, c // 32] = lo[c, 1]
+        tsp.kernel_gene_major = {
+            "table": tableT,
+            "C": C,
+            "penalty": float(duplicate_penalty),
+        }
     return tsp
 
 

@@ -1042,3 +1042,88 @@ def test_order_crossover_long_genome_visited_semantics():
     # (~1e-5 documented accuracy); fallback zeros must be exact.
     np.testing.assert_allclose(out, np.tile(expect, (P, 1)), atol=2e-5)
     np.testing.assert_array_equal(out[:, 150:], 0.0)
+
+
+class TestFusedTspEval:
+    """Gene-major in-kernel TSP scoring (``_tsp_eval_gene_major``) —
+    the long-genome evaluation path (round-4 verdict item 3): fused
+    scores must equal the objective's XLA ``rows`` oracle, the factory
+    must gate on order crossover, and the "genes" duplicate mode must
+    agree between the per-genome and batched forms."""
+
+    def _tsp(self, C, seed=2):
+        from libpga_tpu.objectives.classic import (
+            make_tsp_coords, random_tsp_coords,
+        )
+
+        coords = random_tsp_coords(C, seed=seed)
+        return make_tsp_coords(coords, duplicate_mode="genes")
+
+    @pytest.mark.parametrize("C", [20, 37])  # 37: tail batch + A > 1
+    def test_fused_scores_match_oracle(self, C):
+        from libpga_tpu.ops.pallas_step import make_pallas_breed
+
+        tsp = self._tsp(C)
+        P = 256
+        rng = np.random.default_rng(0)
+        perms = np.stack([rng.permutation(C) for _ in range(P)])
+        g = jnp.asarray(((perms + 0.5) / C).astype(np.float32))
+        s = tsp.rows(g)
+        with _interpret():
+            breed = make_pallas_breed(
+                P, C, deme_size=128, crossover_kind="order",
+                mutate_kind="swap", fused_tsp=tsp.kernel_gene_major,
+            )
+            assert breed is not None and breed.fused
+            g2, s2 = breed(g, s, jax.random.key(1))
+        oracle = np.asarray(tsp.rows(jnp.asarray(g2)))
+        np.testing.assert_allclose(
+            np.asarray(s2), oracle, rtol=1e-4, atol=0.5
+        )
+
+    def test_duplicate_genes_mode_counts_and_scores(self):
+        """genes mode: dups = L − distinct; per-genome and rows forms
+        agree, including on genomes WITH duplicates; valid permutations
+        score identically to pairs mode."""
+        from libpga_tpu.objectives.classic import (
+            make_tsp_coords, random_tsp_coords,
+        )
+
+        C = 16
+        coords = random_tsp_coords(C, seed=3)
+        genes = make_tsp_coords(coords, duplicate_mode="genes")
+        pairs = make_tsp_coords(coords, duplicate_mode="pairs")
+        rng = np.random.default_rng(1)
+        perm = ((rng.permutation(C) + 0.5) / C).astype(np.float32)
+        g = jnp.asarray(perm)
+        assert np.isclose(float(genes(g)), float(pairs(g)), rtol=1e-5)
+        # introduce a triple: 2 duplicate GENES, 6 ordered pairs
+        gd = g.at[3].set(g[5]).at[7].set(g[5])
+        d_genes = float(genes(gd))
+        d_pairs = float(pairs(gd))
+        assert np.isclose(
+            float(genes.rows(gd[None, :])[0]), d_genes, rtol=1e-5
+        )
+        # the penalty difference between modes is (6-2) * penalty
+        assert np.isclose(d_pairs - d_genes, -4 * 10_000.0, rtol=1e-3)
+
+    def test_factory_gates(self):
+        from libpga_tpu.ops.pallas_step import make_pallas_breed
+
+        tsp = self._tsp(20)
+        # uniform crossover: the gene-major evaluator declines (no
+        # order scratch) -> plain unfused breed
+        breed = make_pallas_breed(
+            256, 20, deme_size=128, crossover_kind="uniform",
+            mutate_kind="point", fused_tsp=tsp.kernel_gene_major,
+        )
+        assert breed is not None and not breed.fused
+        # pairs mode carries no kernel hook at all
+        from libpga_tpu.objectives.classic import (
+            make_tsp_coords, random_tsp_coords,
+        )
+
+        assert not hasattr(
+            make_tsp_coords(random_tsp_coords(20), duplicate_mode="pairs"),
+            "kernel_gene_major",
+        )
