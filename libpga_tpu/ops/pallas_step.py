@@ -598,7 +598,7 @@ def _deme_child(
     return child
 
 
-def _tsp_eval_gene_major(child, tableT, order_refs, *, K, L, Lp, C, penalty):
+def _tsp_eval_gene_major(child, tableT, order_refs, *, K, L, C, penalty):
     """Score one deme's TSP children INSIDE the kernel, gene-major —
     the long-genome evaluation path (round-4 weakness 3: the XLA
     one-hot gather's (P·L, C) materialization is HBM-bound and
@@ -711,14 +711,14 @@ def _tsp_eval_gene_major(child, tableT, order_refs, *, K, L, Lp, C, penalty):
 
     zero = jnp.zeros((1, K), jnp.float32)
     carry = (zero, zero, zero, zero)
-    if L >= 2 * U:
+    if L >= U:  # tail stays < U rows — eval_batch's design width
         carry = lax.fori_loop(
             0,
             L // U,
             lambda i, c: eval_batch(i, i * U, U, c),
             carry,
         )
-    tail0 = L - (L % U if L >= 2 * U else L)
+    tail0 = L - L % U if L >= U else 0
     if tail0 < L:
         carry = eval_batch(None, tail0, L - tail0, carry)
     _, _, total, dups = carry
@@ -772,7 +772,8 @@ def _breed_kernel(
     constants, so fused objectives declare them via
     ``kernel_rowwise_consts`` and receive them as call arguments),
     ``n_cross`` + ``n_mut`` expression-breeding constant refs, the
-    genome output ref, and (when ``obj`` is set) the score output ref."""
+    genome output ref, and (when ``obj`` or ``tsp`` is set) the score
+    output ref."""
     import jax.lax as lax
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -903,7 +904,7 @@ def _breed_kernel(
             # the order walk's scratch planes, free after breeding.
             srow = _tsp_eval_gene_major(
                 child, const_refs[0][:], order_refs,
-                K=K, L=L, Lp=Lp, C=tsp["C"], penalty=tsp["penalty"],
+                K=K, L=L, C=tsp["C"], penalty=tsp["penalty"],
             )
             rest[base + 1][0:1, d : d + 1, :] = srow.reshape(1, 1, K)
 
@@ -1311,7 +1312,11 @@ def make_pallas_breed(
     the gene-major fused TSP scorer instead of a rowwise ``fused_obj``;
     it requires ``crossover_kind="order"`` (whose scratch planes the
     evaluator reuses) and produces fused scores exactly like
-    ``fused_obj`` does — declines (None) otherwise.
+    ``fused_obj`` does. With a different crossover (or when a rowwise
+    ``fused_obj`` is also present) the request is silently DROPPED and
+    an ordinary breed comes back — check ``breed.fused`` before
+    expecting a (genomes, scores) pair; None only results when the
+    drop leaves ``elitism > 0`` without fused scores.
 
     ``mutate_kind`` selects the in-kernel mutation ("point" or
     "gaussian"); its parameters are RUNTIME inputs — pass ``mparams``

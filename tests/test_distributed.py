@@ -36,14 +36,17 @@ RESIZE_TOOL = Path(__file__).resolve().parent.parent / "tools" / "resize_smoke.p
 
 
 def test_job_resize_checkpoint_matrix():
-    """The round-4 multi-process matrix (tools/resize_smoke.py): a
-    4-process fleet runs the sharded island GA and shard-saves; a
-    2-process fleet restores it (resize DOWN: more shard files than
-    processes), verifies the global best survived exactly, evolves, and
-    saves again at the same path; a 4-process fleet restores THAT
-    (resize UP, with stage-1's stale proc2/proc3 files still on disk —
-    restore must honor the checkpoint's declared file set). Asserts the
-    harness's own verdict."""
+    """The multi-process matrix (tools/resize_smoke.py), widened to an
+    8-PROCESS fleet in round 5 (verdict item 9): a 4-process fleet runs
+    the sharded island GA and shard-saves; a 2-process fleet restores
+    it (resize DOWN: more shard files than processes), verifies the
+    global best survived exactly, evolves, and saves again at the same
+    path; an 8-process fleet — one process per device, the full-fleet
+    shape — restores THAT (resize UP, with stage-1's stale proc2/proc3
+    files still on disk — restore must honor the checkpoint's declared
+    file set), evolves, and saves 8 shards; a 4-process fleet restores
+    the 8-shard checkpoint (resize DOWN again). Asserts the harness's
+    own verdict."""
     proc = subprocess.run(
         [sys.executable, str(RESIZE_TOOL)],
         capture_output=True,

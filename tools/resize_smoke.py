@@ -2,7 +2,7 @@
 
 Preemptible pod jobs come back at whatever size the scheduler grants, so
 checkpoint/restore must work ACROSS process counts — the scenario the
-per-process shard format (``utils/checkpoint.py``) exists for. Three
+per-process shard format (``utils/checkpoint.py``) exists for. Four
 stages over a shared checkpoint path, each a separate fleet of workers
 on a CPU-simulated multi-host mesh (8 global devices throughout):
 
@@ -14,8 +14,12 @@ on a CPU-simulated multi-host mesh (8 global devices throughout):
    2-process mesh, save again (2 shard files, at the SAME path — stage
    1's proc2/proc3 files remain on disk, exercising restore's
    declared-file-set rule).
-3. **4 processes × 2 devices**: restore the 2-process checkpoint
-   (resize UP), verify, and evolve again.
+3. **8 processes × 1 device**: restore the 2-process checkpoint
+   (resize UP to a FULL fleet — one process per device, more processes
+   than shard files), verify, evolve, save (8 shard files).
+4. **4 processes × 2 devices**: restore the 8-process checkpoint
+   (resize DOWN again — 8 shard files into 4 processes), verify, and
+   evolve again.
 
 Run directly:  python tools/resize_smoke.py
 Exit code 0 and "RESIZE SMOKE: PASS" = every stage agreed.
@@ -33,6 +37,7 @@ ISLANDS, SIZE, LENGTH = 8, 256, 16
 STAGES = [  # (num_processes, restore_first)
     (4, False),
     (2, True),
+    (8, True),
     (4, True),
 ]
 
