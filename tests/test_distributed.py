@@ -51,7 +51,7 @@ def test_job_resize_checkpoint_matrix():
         [sys.executable, str(RESIZE_TOOL)],
         capture_output=True,
         text=True,
-        timeout=900,
+        timeout=1500,  # 4 stages (the 8-process stage is the heaviest)
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
     assert proc.returncode == 0, (
