@@ -64,19 +64,19 @@ class PGAConfig:
         XLA path only for sub-tile populations (< 128) or when every
         padded fit would leave a degenerate tail deme.
       pallas_generations_per_launch: generations bred per fused-kernel
-        launch. ``None`` (default) = auto: ``PGA.run`` uses the
-        one-generation kernel (an interleaved A/B showed the
-        multi-generation launch amortization is within measurement
-        drift on single populations — BASELINE.md round 4), while f32
-        ``run_islands`` uses one multi-generation launch per migration
-        interval (a structural, reproducible win; bf16 islands measured
-        faster one-generation and keep it). An explicit value rules
-        both paths: > 1 holds each deme group VMEM-resident across that
-        many generations — the inter-deme riffle reshuffle then happens
-        every T generations instead of every generation (convergence
-        impact unmeasurable at T <= 8, see BASELINE.md) and target
-        checks gain launch granularity; 1 forces the one-generation
-        kernel everywhere.
+        launch. ``None`` (default) = auto: BOTH ``PGA.run`` and
+        ``run_islands`` use the one-generation kernel for both dtypes —
+        interleaved A/Bs showed the multi-generation amortization
+        within drift on single populations (BASELINE.md round 4) and
+        LOSING on islands once score stores were batched (round 5:
+        one-generation 149.2 vs multigen 127.0 gens/sec, 5/5 rounds).
+        An explicit value rules both paths: > 1 holds each deme group
+        VMEM-resident across that many generations — the inter-deme
+        riffle reshuffle then happens every T generations instead of
+        every generation (convergence impact unmeasurable at T <= 8,
+        see BASELINE.md), target checks gain launch granularity, and
+        islands run one multigen launch per migration interval; 1
+        forces the one-generation kernel everywhere.
       donate_buffers: donate the genome buffer to jit so XLA updates it in
         place (the TPU-native replacement for the reference's
         current/next-generation pointer swap, ``pga.h:124-129``).

@@ -470,21 +470,19 @@ class PGA:
         )
         if cache_key in self._compiled:
             return self._compiled[cache_key]
-        # Multi-generation breed first: the island epoch then runs as
-        # ONE vmapped launch per migration interval with in-kernel
-        # ranking instead of m per-generation launches + a hoisted
-        # host-side rank sort (islands.make_multigen_stacked_epoch).
-        # Interleaved A/B: statistically TIED with the one-generation
-        # island path on throughput (BASELINE.md round 4) — kept as the
-        # f32 default for structural simplicity; off for bf16 (measured
-        # faster one-generation). An explicit config value rules either
-        # way (1 = one-generation, >1 = epoch chunk cap).
+        # One-generation island epoch by DEFAULT for both dtypes since
+        # round 5: the round-4 f32 tie (multigen whole-interval launches
+        # vs per-generation launches + hoisted sort, medians 128.6 vs
+        # 132.0) flipped decisively once the one-generation kernel's
+        # score stores were batched — 5-round interleaved A/B: one-gen
+        # 149.2 vs multigen 127.0 gens/sec on the 8×131k bench shape,
+        # 5/5 rounds (BASELINE.md round 5; bf16 already measured faster
+        # one-generation in round 4). An explicit config value rules
+        # either way (1 = one-generation, >1 = multigen epoch chunk
+        # cap — the structural one-launch-per-interval option remains).
         T_cfg = self.config.pallas_generations_per_launch
-        if T_cfg is not None:
-            use_island_multigen = T_cfg > 1
-        else:
-            use_island_multigen = self.config.gene_dtype == jnp.float32
-        if use_island_multigen and fused is None and T_cfg is not None:
+        use_island_multigen = T_cfg is not None and T_cfg > 1
+        if use_island_multigen and fused is None:
             # Same contract as make_pallas_run: an explicitly requested
             # T > 1 must not degrade silently, including for objectives
             # without an in-kernel form.

@@ -1597,15 +1597,14 @@ def multigen_default_t(gene_dtype) -> int:
     target-generation reporting and per-generation deme mixing for the
     launch amortization).
 
-    The ISLAND path differs structurally: one whole-epoch launch per
-    migration interval replaces m per-generation launches plus a
-    host-side rank sort. A 6-round interleaved A/B against the
-    one-generation island path is a statistical tie (medians 128.6 vs
-    132.0 on the 8×131k bench shape; ordering flips with chip state) —
-    f32 islands keep the multi-generation epoch as their default for
-    its simplicity, not a measured speedup
-    (``engine._pallas_island_breed``); bf16 islands measured faster on
-    the one-generation path (175 vs 155) and keep it.
+    The ISLAND path also defaults to one-generation since round 5: the
+    round-4 tie (multigen whole-epoch launches vs per-generation
+    launches + hoisted sort, 128.6 vs 132.0) broke once the score
+    stores were batched — one-generation 149.2 vs multigen 127.0
+    gens/sec, 5/5 interleaved rounds (BASELINE.md round 5;
+    ``engine._pallas_island_breed``). An explicit
+    ``pallas_generations_per_launch > 1`` still selects the structural
+    one-launch-per-migration-interval epoch.
     """
     del gene_dtype
     return 1

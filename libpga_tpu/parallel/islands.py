@@ -181,12 +181,12 @@ def make_multigen_stacked_epoch(bm: Callable, m: int) -> Callable:
     # Whole-epoch launches up to T=8 by default: 8 is the measured
     # convergence-NEUTRAL bound (BASELINE.md multigen table: takeover
     # 67.2 vs 66.6 gens, 64-gen OneMax mean -0.04), while T=16 shows
-    # measurable drag (takeover 70.4, mean -0.11) and the throughput
-    # A/B against the one-generation island path is a statistical tie —
-    # there is no speed to buy convergence with. An EXPLICIT
-    # config.pallas_generations_per_launch still rules: the engine
-    # stamps it on the breed (``epoch_chunk``) so the documented knob
-    # bounds island launches exactly like single-population runs.
+    # measurable drag (takeover 70.4, mean -0.11). Since round 5 this
+    # epoch is OPT-IN only (the one-generation island path measured
+    # faster, 149.2 vs 127.0 — BASELINE.md round 5), so T=8 is the cap
+    # a bare pallas_generations_per_launch>1 request gets; an explicit
+    # value rules exactly (the engine stamps it on the breed as
+    # ``epoch_chunk``).
     T = getattr(bm, "epoch_chunk", None) or 8
 
     def epoch(genomes, scores, keys, mparams=None):
