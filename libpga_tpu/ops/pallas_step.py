@@ -179,11 +179,21 @@ def _pick_deme_size(
     return best[1] if best else None
 
 
-def auto_deme_size(gene_dtype) -> int:
-    """Measured per-dtype deme sweet spot at 1M×100 (see BASELINE.md):
-    bf16's single selection matmul makes the larger deme worthwhile.
+def auto_deme_size(gene_dtype, const_carrying: bool = False) -> int:
+    """Measured per-dtype deme sweet spot (see BASELINE.md round 5).
+
+    K=512 by default since round 5: batching the fused-eval score
+    stores shifted the f32 trade-off — at 1M×100 OneMax K=512 D=8 beat
+    the round-4 default K=256 D=16 174.5 vs 167.6 median (4/5
+    interleaved rounds, where the pre-batching kernel measured the
+    opposite ordering), and the trap shape agrees (160.9 vs 147.6).
+    EXCEPTION: f32 objectives whose fused evaluation carries kernel
+    constants (``const_carrying`` — the NK-class table lookups) keep
+    K=256: the NK-4M interleave shows 256/16 at 31.8 vs 512/8 at 28.3.
     Single source of truth — bench.py derives its FLOPs model from this."""
-    return 512 if gene_dtype == jnp.bfloat16 else 256
+    if const_carrying and gene_dtype != jnp.bfloat16:
+        return 256
+    return 512
 
 
 def _carry_elites(g_prev, s_prev, g2, s2, elitism: int):
@@ -1183,6 +1193,7 @@ def _kernel_shape(
     d_pool,
     d_default,
     demes_per_step,
+    const_carrying=False,
 ):
     """Admission gates + shape resolution shared by the one-generation
     and multi-generation kernel factories — ONE copy so the two paths
@@ -1229,7 +1240,7 @@ def _kernel_shape(
 
     selection_param = resolve_selection(selection_kind, selection_param)
     if not deme_size:
-        deme_size = auto_deme_size(gene_dtype)
+        deme_size = auto_deme_size(gene_dtype, const_carrying)
     Lp = math.ceil(genome_len / LANE) * LANE
     gene_bytes = 2 if gene_dtype == jnp.bfloat16 else 4
 
@@ -1348,6 +1359,13 @@ def make_pallas_breed(
     scores, so the padded rows are inert — the caller still sees exactly
     ``(P, L)``. Returns None when unsupported (population under one deme
     tile, an unsupported dtype, or elitism without fused scores)."""
+    # const_carrying deliberately EXCLUDES fused_tsp: its coordinate
+    # table is a bilinear-matmul operand, not an NK-class
+    # masked-accumulation table, and K=512 measured FASTER for the
+    # fused TSP at short genomes too (100-city, 4-round interleave:
+    # 3316 vs 2817 gens/sec; long genomes fall to K<=256 via the order
+    # scratch VMEM gate regardless).
+    const_obj = fused_obj is not None and bool(fused_consts)
     shape = _kernel_shape(
         pop_size, genome_len, deme_size, tournament_size,
         selection_kind, selection_param, crossover_kind, mutate_kind,
@@ -1356,13 +1374,18 @@ def make_pallas_breed(
         # Demes per grid step: larger groups write D·Lp-contiguous
         # bursts through the riffle layout (see _breed_kernel) — the
         # riffle's strided HBM writes are a top non-matmul cost at D=1
-        # (512-byte bursts for f32 at Lp=128). Measured sweet spots at
-        # 1M×100 (tools/sweep_kernel.py, round 3): bf16 peaks at D=4;
-        # f32 keeps gaining through D=16 — its 4-byte rows need bigger
-        # bursts before the riffle's strided writes stop hurting.
+        # (512-byte bursts for f32 at Lp=128). Round-5 sweep under the
+        # batched score stores (BASELINE.md): bf16 keeps D=4 at K=512;
+        # f32 moved to D=8 at K=512 (the round-3 K=256 D=16 sweet spot
+        # predates both the stacked matmul and the batched stores) —
+        # EXCEPT const-carrying fused objectives (NK-class), which
+        # measured fastest at the old K=256 D=16.
         d_pool=(32, 16, 8, 4, 2, 1),
-        d_default=4 if gene_dtype == jnp.bfloat16 else 16,
+        d_default=(
+            4 if gene_dtype == jnp.bfloat16 else (16 if const_obj else 8)
+        ),
         demes_per_step=_demes_per_step,
+        const_carrying=const_obj,
     )
     if shape is None:
         return None
@@ -1666,10 +1689,15 @@ def make_pallas_multigen(
         blocks_fit=_multigen_blocks_fit,
         # Scratch shares the VMEM budget, so D caps below the
         # one-generation kernel's (measured: larger D gains nothing —
-        # the riffle write amortizes /T already).
+        # the riffle write amortizes /T already). With the round-5
+        # K=512 auto default, f32 multigen lands at K=512 D=4 (D=8
+        # fails the scratch-sharing VMEM gate) — which IS the round-4
+        # multigen sweep's measured f32 sweet spot ("bigger K wins in
+        # multigen; K=512 D=4", BASELINE.md round 4).
         d_pool=(16, 8, 4, 2, 1),
         d_default=4 if gene_dtype == jnp.bfloat16 else 8,
         demes_per_step=_demes_per_step,
+        const_carrying=bool(fused_consts),
     )
     if shape is None:
         return None

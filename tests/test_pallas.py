@@ -522,14 +522,35 @@ def test_engine_bf16_genes_on_xla_path():
 
 def test_deme_grouping_selection_and_vmem_cap():
     """Both dtypes group demes when G divides (bf16 capped at D=4, f32
-    at D=16 — measured sweet spots); long genomes whose grouped block
-    would blow the VMEM budget fall back to smaller D instead of
-    failing at Mosaic compile time; explicit requests round down to a
-    valid divisor and are reported via breed.D."""
+    at D=8 since the round-5 re-sweep, D=16 for const-carrying fused
+    objectives); long genomes whose grouped block would blow the VMEM
+    budget fall back to smaller D instead of failing at Mosaic compile
+    time; explicit requests round down to a valid divisor and are
+    reported via breed.D."""
     b = make_pallas_breed(4096, 16, deme_size=256, gene_dtype=jnp.bfloat16)
     assert b.D == 4  # G=16, divisible; bf16 cap
     b = make_pallas_breed(4096, 16, deme_size=256)
-    assert b.D == 16  # f32 cap
+    assert b.D == 8  # f32 cap (round 5)
+    from libpga_tpu.objectives.classic import make_nk_landscape
+
+    nk = make_nk_landscape(16, 3, seed=0)
+    b = make_pallas_breed(
+        4096, 16, deme_size=256, fused_obj=nk.kernel_rowwise,
+        fused_consts=nk.kernel_rowwise_consts,
+    )
+    assert b.D == 16  # const-carrying fused objective keeps D=16
+    # AUTO deme size (no explicit deme_size): const-carrying f32 keeps
+    # K=256 (NK-4M measured 31.8 vs 28.3 gens/sec); everything else
+    # defaults to K=512 since the round-5 re-sweep.
+    b = make_pallas_breed(
+        4096, 16, fused_obj=nk.kernel_rowwise,
+        fused_consts=nk.kernel_rowwise_consts,
+    )
+    assert b.K == 256 and b.D == 16
+    from libpga_tpu.objectives import onemax
+
+    b = make_pallas_breed(4096, 16, fused_obj=onemax.kernel_rowwise)
+    assert b.K == 512 and b.D == 8
     # bf16, genome_len 2000 -> Lp=2048: K=512 would need ~23 MB of
     # scoped VMEM (fails to compile), so the deme is capped at K=256;
     # grouping stays within its block budget at D=2 (verified to compile
