@@ -274,6 +274,25 @@ class TestEngineIntegration:
         top_after = float(jnp.max(pga.population(h).scores))
         assert top_after >= top_before - 1e-5
 
+    def test_islands_with_expression_operators(self):
+        """run_islands works with expression breeding operators
+        installed (the island breed builder receives the operator as
+        its kernel kind on TPU; the XLA path serves here)."""
+        from libpga_tpu import PGA
+
+        cx = crossover_from_expression("where(i < floor(q * L), p1, p2)")
+        mx = mutate_from_expression("where(r < rate, r2, g)", rate=0.03)
+        pga = PGA(seed=5)
+        for _ in range(4):
+            pga.create_population(128, 12)
+        pga.set_objective("onemax")
+        pga.set_crossover(cx)
+        pga.set_mutate(mx)
+        gens = pga.run_islands(30, 10, 0.1)
+        assert gens == 30
+        best = max(pga.get_best_with_score(h)[1] for h in pga._handles())
+        assert best > 9.5, best
+
     def test_null_restore_returns_builtin_kinds(self):
         from libpga_tpu import PGA
 
