@@ -93,6 +93,33 @@ int pga_set_objective_expr_const(pga_t *p, const char *name,
         static_cast<Py_ssize_t>(n * sizeof(float))));
 }
 
+int pga_set_crossover_name(pga_t *p, const char *name) {
+    if (!p || !name) return -1;
+    return static_cast<int>(
+        call_long("set_crossover_name", "(ls)", solver_of(p), name));
+}
+
+int pga_set_mutate_name(pga_t *p, const char *name, float rate,
+                        float sigma) {
+    if (!p || !name) return -1;
+    return static_cast<int>(
+        call_long("set_mutate_name", "(lsdd)", solver_of(p), name,
+                  static_cast<double>(rate), static_cast<double>(sigma)));
+}
+
+int pga_set_objective_tsp_coords(pga_t *p, const float *xy,
+                                 unsigned n_cities, float duplicate_penalty,
+                                 int fused_duplicate_genes) {
+    if (!p || !xy || !n_cities) return -1;
+    return static_cast<int>(call_long(
+        "set_objective_tsp_coords", "(ly#Idi)", solver_of(p),
+        reinterpret_cast<const char *>(xy),
+        static_cast<Py_ssize_t>(static_cast<size_t>(n_cities) * 2 *
+                                sizeof(float)),
+        n_cities, static_cast<double>(duplicate_penalty),
+        fused_duplicate_genes));
+}
+
 int pga_set_crossover_expr(pga_t *p, const char *expr) {
     if (!p || !expr) return -1;
     return static_cast<int>(

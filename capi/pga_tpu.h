@@ -177,6 +177,35 @@ int pga_set_crossover_expr(pga_t *p, const char *expr);
 int pga_set_mutate_expr(pga_t *p, const char *expr, float rate,
                         float sigma);
 
+/* BUILTIN operators by name — the kinds the fused kernel implements
+ * natively, for operator classes expressions cannot express:
+ *   crossover: "uniform", "one_point", "arithmetic", "order" — order
+ *     is the uniqueness-preserving operator of the reference's TSP
+ *     driver (test3/test.cu:48-64), an in-kernel sequential
+ *     visited-bitmask walk (inherently not per-gene);
+ *   mutation: "point", "gaussian", "swap" with runtime rate/sigma
+ *     (negative = operator default; swap pairs with order for
+ *     permutation GAs).
+ * Returns 0, or -1 on an unknown name. */
+int pga_set_crossover_name(pga_t *p, const char *name);
+int pga_set_mutate_name(pga_t *p, const char *name, float rate,
+                        float sigma);
+
+/* Euclidean TSP objective over city coordinates — the reference test3
+ * workload as a first-class objective, beyond its 110-city
+ * __constant__-memory cap (test3/test.cu:22-24). `xy` is n_cities
+ * (x, y) float32 pairs; genes decode as city = floor(g * genome_len).
+ * `duplicate_penalty` < 0 takes the default 10000. Nonzero
+ * `fused_duplicate_genes` counts duplicate GENES (L - distinct; same
+ * zero set as the reference's ordered-pairs count) and — combined with
+ * pga_set_crossover_name(p, "order") — evaluates INSIDE the breed
+ * kernel (the long-genome path: 1,000-city tours at ~300
+ * generations/sec, ~6x the XLA gather evaluation); zero keeps the
+ * reference's ordered-pairs penalty semantics on the XLA path. */
+int pga_set_objective_tsp_coords(pga_t *p, const float *xy,
+                                 unsigned n_cities, float duplicate_penalty,
+                                 int fused_duplicate_genes);
+
 /* Result extraction (pga.h:90-93). Return malloc'd gene arrays (caller
  * frees), genome_len genes per row; NULL on error — including a _top
  * `length` larger than the (total) population, since the caller's buffer

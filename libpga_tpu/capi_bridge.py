@@ -313,6 +313,85 @@ def _expr_const_array(handle: int, name: str, data: bytes) -> np.ndarray:
     return np.frombuffer(data, dtype=np.float32).copy()
 
 
+def set_crossover_name(handle: int, name: str) -> None:
+    """Install a BUILTIN crossover by name (``pga_set_crossover_name``):
+    uniform / one_point / arithmetic / order. ``order`` is the
+    uniqueness-preserving operator of the reference's flagship TSP
+    driver (test3/test.cu:48-64) and runs IN-KERNEL (the VMEM
+    visited-bitmask walk) — the path expressions cannot reach (the walk
+    is sequential, not per-gene). uniform also runs in-kernel;
+    one_point/arithmetic use the XLA path (prefer
+    ``pga_set_crossover_expr`` for per-gene customs)."""
+    from libpga_tpu.ops import crossover as _c
+
+    ops = {
+        "uniform": _c.uniform_crossover,
+        "one_point": _c.one_point_crossover,
+        "arithmetic": _c.arithmetic_crossover,
+        "order": _c.order_preserving_crossover,
+    }
+    if name not in ops:
+        raise ValueError(
+            f"unknown crossover {name!r}; available: {sorted(ops)}"
+        )
+    _solver(handle).set_crossover(ops[name])
+    _set_host_op(handle, "cross", False)
+
+
+def set_mutate_name(handle: int, name: str, rate: float, sigma: float) -> None:
+    """Install a BUILTIN mutation by name (``pga_set_mutate_name``):
+    point / gaussian / swap, all in-kernel with runtime parameters
+    (negative = the operator's default). ``swap`` is the permutation
+    GA's operator (pairs with ``order`` crossover)."""
+    from libpga_tpu.ops import mutate as _m
+
+    if name == "point":
+        op = _m.make_point_mutate(0.01 if rate < 0 else float(rate))
+    elif name == "gaussian":
+        op = _m.make_gaussian_mutate(
+            0.1 if rate < 0 else float(rate),
+            0.1 if sigma < 0 else float(sigma),
+        )
+    elif name == "swap":
+        op = _m.make_swap_mutate(0.5 if rate < 0 else float(rate))
+    else:
+        raise ValueError(
+            f"unknown mutation {name!r}; available: "
+            f"['gaussian', 'point', 'swap']"
+        )
+    _solver(handle).set_mutate(op)
+    _set_host_op(handle, "mut", False)
+
+
+def set_objective_tsp_coords(
+    handle: int, data: bytes, n_cities: int, penalty: float, genes_mode: int
+) -> None:
+    """Install a Euclidean TSP objective over city coordinates
+    (``pga_set_objective_tsp_coords``): ``data`` is n_cities (x, y)
+    float32 pairs. ``genes_mode`` nonzero selects
+    ``duplicate_mode="genes"`` — the form whose evaluation fuses
+    INTO the breed kernel with order crossover (the long-genome TSP
+    path, BASELINE.md round 5); zero keeps the reference driver's
+    ordered-pairs penalty semantics. This is how a C user runs the
+    reference's test3 workload at device speed beyond its 110-city
+    cap."""
+    from libpga_tpu.objectives.classic import make_tsp_coords
+
+    pga = _solver(handle)
+    arr = np.frombuffer(data, dtype=np.float32)
+    if n_cities <= 0 or arr.size != 2 * n_cities:
+        raise ValueError(
+            f"coords carry {arr.size} floats; expected 2*{n_cities}"
+        )
+    obj = make_tsp_coords(
+        arr.reshape(n_cities, 2).copy(),
+        duplicate_penalty=10_000.0 if penalty < 0 else float(penalty),
+        duplicate_mode="genes" if genes_mode else "pairs",
+    )
+    pga.set_objective(obj)
+    _set_host_op(handle, "obj", False)
+
+
 def set_crossover_expr(handle: int, expr: str) -> None:
     """Install a DEVICE-SPEED custom crossover from an expression
     (``pga_set_crossover_expr``): compiles to the rowwise form the fused
