@@ -243,10 +243,15 @@ def single_derived(gene_dtype, gps) -> dict:
     achieved = gps * flops_per_gen
     T = multigen_default_t(gene_dtype)  # the engine's auto launch depth
     hbm = gps * hbm_bytes_per_gen(POP, Lp, gene_bytes, T)
+    mfu = round(achieved / V5E_BF16_PEAK, 4)
     return {
         "ms_per_gen": round(1000.0 / gps, 3) if gps else None,
         "achieved_tflops": round(achieved / 1e12, 2),
-        "mfu": round(achieved / V5E_BF16_PEAK, 4),
+        # selection_matmul_mfu is the honest name: the FLOPs model counts
+        # ONLY the one-hot parent-selection matmuls (module docstring).
+        # "mfu" repeats it for cross-round continuity of the flat keys.
+        "mfu": mfu,
+        "selection_matmul_mfu": mfu,
         "achieved_hbm_gbps": round(hbm / 1e9, 1),
         "hbm_frac_of_peak": round(hbm / V5E_HBM_PEAK, 4),
     }
@@ -312,6 +317,14 @@ def main() -> None:
     out.update(d32)
     d16 = single_derived(jnp.bfloat16, med["bf16"][0])
     out.update({f"bf16_{k}": v for k, v in d16.items() if k != "ms_per_gen"})
+    # The caveat BASELINE.md carries, now ON the scored artifact: mfu is
+    # a matmul-utilization gauge, not a hardware-ceiling claim.
+    out["mfu_note"] = (
+        "mfu/selection_matmul_mfu count ONLY the one-hot parent-selection "
+        "matmul FLOPs — rank sort, PRNG, crossover/mutation, and fused "
+        "evaluation are real kernel work the model excludes; gens/sec is "
+        "the headline metric"
+    )
     print(json.dumps(out))
 
 

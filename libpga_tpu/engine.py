@@ -261,6 +261,8 @@ class PGA:
         """
         obj = self._require_objective()
         pallas_kind = self._mutate_kind() if self._pallas_gate() else None
+        if pallas_kind is None:
+            self._warn_xla_fallback()
         if pallas_kind is not None:
             # Keyed by mutation KIND: rate/sigma are runtime inputs of the
             # compiled fn. A declined shape caches the _XLA_FALLBACK
@@ -361,19 +363,51 @@ class PGA:
             return self._mutate
         return None
 
+    # Fused expression equivalents of the builtin crossovers that have
+    # no named in-kernel kind. one_point: the builtin draws its cut from
+    # rand[0]; the expression draws it from the per-row stream q — a
+    # different PRNG stream but the identical cut distribution
+    # (uniform over gene positions). arithmetic: per-gene convex blend
+    # with a fresh uniform weight, exactly the builtin's semantics (the
+    # expression path's [0, 1) output clip is a no-op on convex blends
+    # of in-domain genes). Compiled once per engine instance and cached
+    # under the module-level expression cache key, so every engine maps
+    # these builtins to ONE kernel compilation.
+    _CROSSOVER_EXPRS = {
+        "one_point": "where(i < floor(q * L), p1, p2)",
+        "arithmetic": "r * p1 + (1 - r) * p2",
+    }
+
+    def _crossover_expr_equivalent(self, name: str):
+        cache_key = ("crossover-expr-builtin", name)
+        op = self._compiled.get(cache_key)
+        if op is None:
+            from libpga_tpu.ops.breed_expr import crossover_from_expression
+
+            op = crossover_from_expression(self._CROSSOVER_EXPRS[name])
+            self._compiled[cache_key] = op
+        return op
+
     def _crossover_kind(self):
         """Kernel-implementable crossover kind of the active operator:
         uniform (the reference default), order-preserving (the
         reference TSP driver's custom crossover, in-kernel as an
-        unrolled VMEM visited-table walk), or an expression operator
+        unrolled VMEM visited-table walk), an expression operator
         (``ops/breed_expr.crossover_from_expression``) evaluated
-        in-kernel."""
+        in-kernel — or, for the builtin one-point/arithmetic operators,
+        their fused expression equivalents (they used to return None
+        here, silently dropping the whole run to the ~10× slower XLA
+        path)."""
         from libpga_tpu.ops import crossover as _c
 
         if self._crossover is _c.uniform_crossover:
             return "uniform"
         if self._crossover is _c.order_preserving_crossover:
             return "order"
+        if self._crossover is _c.one_point_crossover:
+            return self._crossover_expr_equivalent("one_point")
+        if self._crossover is _c.arithmetic_crossover:
+            return self._crossover_expr_equivalent("arithmetic")
         if getattr(self._crossover, "kernel_rows", None) is not None:
             return self._crossover
         return None
@@ -429,17 +463,53 @@ class PGA:
         elitism (fused objectives), and f32/bf16 genes (order crossover:
         f32 only — make_pallas_breed declines bf16), and requires a real
         TPU."""
-        if not (
+        return (
             self.config.pallas_enabled()
             and self._crossover_kind() is not None
             and self._mutate_kind() is not None
             and 1 <= self.config.tournament_size <= 16
             and self.config.gene_dtype in (jnp.float32, jnp.bfloat16)
-        ):
-            return False
+            and self._pallas_backend_ok()
+        )
+
+    def _pallas_backend_ok(self) -> bool:
+        """The Mosaic kernel only lowers on a real TPU backend."""
         import jax as _jax
 
         return _jax.default_backend() == "tpu"
+
+    def _warn_xla_fallback(self) -> None:
+        """Documented fallback warning: the run COULD take the fused
+        Pallas path (config + backend allow it) but the active
+        crossover/mutation operator has no in-kernel form, so the whole
+        run drops to the XLA operator path — ~10× slower at headline
+        scale (BASELINE.md). Builtin operator kinds and expression
+        operators (``ops/breed_expr``) run in-kernel; an opaque Python
+        callable cannot. One warning per distinct cause; the fallback
+        itself is still taken (the result is correct, just slow)."""
+        if not (self.config.pallas_enabled() and self._pallas_backend_ok()):
+            return
+        missing = [
+            name
+            for name, kind in (
+                ("crossover", self._crossover_kind()),
+                ("mutation", self._mutate_kind()),
+            )
+            if kind is None
+        ]
+        if not missing:
+            return
+        import warnings
+
+        warnings.warn(
+            f"custom {' and '.join(missing)} operator(s) have no "
+            "in-kernel form — this run falls back to the XLA operator "
+            "path (~10x slower at 1M scale). Use a builtin operator, "
+            "or compile the operator with "
+            "ops.breed_expr.crossover_from_expression / "
+            "mutate_from_expression to keep the fused Pallas path.",
+            stacklevel=3,
+        )
 
     def _pallas_island_breed(self, island_size: int, genome_len: int):
         """Fused Pallas breed for one island, or None if ineligible.
@@ -700,7 +770,15 @@ class PGA:
 
         else:
             raise ValueError(which)
-        fn = jax.jit(op)
+        # The staged next generation is double-buffer state: mutate()
+        # replaces it wholesale, so the incoming buffer is dead on
+        # return and XLA may update it in place — the same donation the
+        # fused run loop applies to the genome carry. crossover() can't
+        # donate: its input is the live current generation.
+        donate = (
+            (0,) if which == "mutate" and self.config.donate_buffers else ()
+        )
+        fn = jax.jit(op, donate_argnums=donate)
         self._compiled[cache_key] = fn
         return fn
 

@@ -238,6 +238,24 @@ def _supported() -> bool:
     return True
 
 
+def _grid_compiler_params(ablate=()):
+    """Grid dimension declared PARALLEL: every grid step reads only its
+    own deme-group block and writes only its own output blocks (the
+    per-step PRNG reseed is index-keyed, and all scratch is written
+    before read within a step), so Mosaic may overlap step i's output
+    DMA with step i+1's compute instead of enforcing sequential
+    semantics. The ``serial_grid`` ablation flag restores the default
+    "arbitrary" semantics so tools/ablate_floor.py can measure the
+    difference."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    params_cls = getattr(pltpu, "TPUCompilerParams", None) or getattr(
+        pltpu, "CompilerParams"
+    )
+    sem = "arbitrary" if "serial_grid" in ablate else "parallel"
+    return params_cls(dimension_semantics=(sem,))
+
+
 def _deme_child(
     g,
     R,
@@ -798,6 +816,34 @@ def _breed_kernel(
     order_refs = rest[-6:] if crossover == "order" else None
 
     i = pl.program_id(0)
+    if "copy_only" in ablate:
+        # Floor-attribution harness (tools/ablate_floor.py): a PURE COPY
+        # at the production kernel's exact grid/BlockSpec layout — no
+        # PRNG, no selection, no breeding. Genomes pass through to the
+        # output mapping (riffled or contiguous per the other flags) and,
+        # when a score output exists, the ranks input stands in for the
+        # scores so the batched (1, D, K) store cost is included. What
+        # remains is exactly the memory system + grid machinery: HBM
+        # read/write, the output layout's write pattern, and per-step
+        # Mosaic overheads.
+        g_all = genomes_ref[:]
+        score_rows = []
+        for d in range(D):
+            child = g_all[d * K : (d + 1) * K, :]
+            if "no_riffle" in ablate:
+                out_ref[d * K : (d + 1) * K, :] = child
+            else:
+                out_ref[:, 0, d, :] = child
+            if obj is not None or tsp is not None:
+                score_rows.append(
+                    scores_ref[0:1, d : d + 1, :].astype(jnp.float32)
+                )
+        if score_rows:
+            rest[base + 1][:] = (
+                jnp.concatenate(score_rows, axis=1)
+                if D > 1 else score_rows[0]
+            )
+        return
     pltpu.prng_seed(seed_ref[0, 0] ^ (i * jnp.int32(-1640531527)))  # golden-ratio mix
 
     # NOTE on shapes: Mosaic only supports minor-dim insertion/transpose
@@ -1464,6 +1510,18 @@ def make_pallas_breed(
     def _const_spec(c):
         return pl.BlockSpec(c.shape, lambda i: (0,) * c.ndim)
 
+    aliases = {}
+    if "alias_io" in _ablate:
+        # Ablation experiment (tools/ablate_floor.py): write children
+        # IN PLACE over the incoming genome buffer. Only sound for the
+        # contiguous-emit layout, where grid step i reads and writes
+        # the SAME (D·K, Lp) row slab — the riffle layout scatters each
+        # step's children across every other step's read rows, so
+        # aliasing it would corrupt later reads.
+        if "no_riffle" not in _ablate:
+            raise ValueError("alias_io requires no_riffle (see comment)")
+        aliases = {3: 0}  # genomes input -> genome output
+
     call = pl.pallas_call(
         kernel,
         grid=(G // D,),
@@ -1479,6 +1537,8 @@ def make_pallas_breed(
             _order_scratch_shapes(K, L, Lp)
             if crossover_kind == "order" else []
         ),
+        input_output_aliases=aliases,
+        compiler_params=_grid_compiler_params(_ablate),
     )
 
     default_params = jnp.asarray(
@@ -1757,6 +1817,7 @@ def make_pallas_multigen(
             _order_scratch_shapes(K, L, Lp)
             if crossover_kind == "order" else []
         ),
+        compiler_params=_grid_compiler_params(_ablate),
     )
 
     default_params = jnp.asarray(

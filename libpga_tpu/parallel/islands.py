@@ -373,7 +373,8 @@ def build_local_runner(
 # ------------------------------------------------------------- sharded path
 
 
-def _migrate_sharded(genomes, scores, key, count, topology, axis_name):
+def _migrate_sharded(genomes, scores, key, count, topology, axis_name,
+                     n_dev=None):
     """Migration inside shard_map: genomes (I_loc, S, L) per core.
 
     Ring: emigrants shift one island forward globally — a local roll plus a
@@ -381,9 +382,14 @@ def _migrate_sharded(genomes, scores, key, count, topology, axis_name):
     (pure ICI neighbor traffic). Random: all_gather the (small) emigrant
     sets and index by a shared permutation (identical on every core because
     it derives from the replicated migration key).
+
+    ``n_dev``: the STATIC mesh-axis size (the ppermute ring needs a
+    python int); callers inside shard_map pass ``mesh.shape[axis_name]``.
+    ``None`` uses ``jax.lax.axis_size``, which only exists on newer JAX.
     """
     i_loc = genomes.shape[0]
-    n_dev = jax.lax.axis_size(axis_name)
+    if n_dev is None:
+        n_dev = jax.lax.axis_size(axis_name)
     total = i_loc * n_dev
     em_g, em_s = _select_emigrants(genomes, scores, count)
 
@@ -445,7 +451,10 @@ def build_sharded_runner(
                 g, s, keys = vepoch(g, s, keys)
             if count > 0:
                 mk, sub = jax.random.split(mk)
-                g, s = _migrate_sharded(g, s, sub, count, topology, axis_name)
+                g, s = _migrate_sharded(
+                    g, s, sub, count, topology, axis_name,
+                    n_dev=mesh.shape[axis_name],
+                )
             # Global best — every core takes the same branch next epoch.
             # Computed AFTER migration, which only replaces worst-E, so the
             # carried best is still present in some island.
@@ -456,13 +465,14 @@ def build_sharded_runner(
         g, s, keys, mk, e, best = jax.lax.while_loop(cond, body, init)
         return g, s, e
 
+    from libpga_tpu.utils.compat import shard_map as _shard_map
+
     base_specs = (P(axis_name, None, None), P(axis_name), P(), P(), P())
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         shard_body,
         mesh=mesh,
         in_specs=base_specs + ((P(),) if takes_params else ()),
         out_specs=(P(axis_name, None, None), P(axis_name, None), P()),
-        check_vma=False,
     )
     jitted = jax.jit(mapped)
 

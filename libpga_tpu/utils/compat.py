@@ -1,0 +1,55 @@
+"""Version compatibility helpers.
+
+The library tracks current JAX, but several deployment surfaces (the
+test harness, the multi-process smokes, the multichip dryrun) must also
+run on older installs — the container this grows in ships JAX 0.4.37.
+Each helper degrades to the era-appropriate mechanism instead of
+raising ``Unrecognized config option`` / ``AttributeError`` at import.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def force_cpu_device_count(n: int) -> None:
+    """Make the CPU platform present ``n`` devices.
+
+    Newer JAX exposes this as the ``jax_num_cpu_devices`` config option;
+    older versions only honor the ``--xla_force_host_platform_device_count``
+    XLA flag, which must be in the environment BEFORE the backend
+    initializes. Both are applied (the flag is inert once a backend
+    exists, the config option raises on old JAX if called directly), so
+    callers just invoke this before their first device query.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    if hasattr(jax.config, "jax_num_cpu_devices"):
+        jax.config.update("jax_num_cpu_devices", n)
+
+
+def shard_map(fn, *, mesh, in_specs, out_specs, check=False):
+    """``jax.shard_map`` with the pre-0.5 fallback.
+
+    New JAX hosts ``shard_map`` at the top level with the replication
+    check named ``check_vma``; 0.4.x keeps it in ``jax.experimental``
+    with ``check_rep``. Call sites that need replication checking pass
+    ``check=True``; the library's runners disable it (their bodies mix
+    per-shard and replicated values deliberately).
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check,
+    )

@@ -15,7 +15,8 @@ from __future__ import annotations
 
 import contextlib
 import os
-from typing import Callable, Optional
+import time
+from typing import Callable, Dict, Optional
 
 import jax
 
@@ -45,6 +46,72 @@ def trace(log_dir: str):
     """
     with jax.profiler.trace(log_dir):
         yield
+
+
+def best_ms_per_unit(
+    run: Callable[[int], None],
+    lo: int = 30,
+    hi: int = 90,
+    tries: int = 2,
+    units_per_call: int = 1,
+) -> float:
+    """ms per unit of work via two-length subtraction of per-length
+    minima — the estimator bench.py and the ablation harnesses share.
+
+    ``run(n)`` executes n calls and blocks until ready. The difference
+    ``min(t(hi)) − min(t(lo))`` cancels warm-up/compile/dispatch
+    constants, and taking per-length minima FIRST keeps the estimate
+    bounded by true hardware time (a max over per-try deltas would
+    select the try where noise shrank the difference).
+    ``units_per_call`` scales a call that performs several units (e.g. a
+    multi-generation launch breeding T generations). NaN when the
+    subtraction is degenerate.
+    """
+    t_lo, t_hi = [], []
+    for _ in range(tries):
+        t0 = time.perf_counter()
+        run(lo)
+        t_lo.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        run(hi)
+        t_hi.append(time.perf_counter() - t0)
+    delta = min(t_hi) - min(t_lo)
+    units = (hi - lo) * units_per_call
+    return 1000.0 * delta / units if delta > 0 else float("nan")
+
+
+def interleaved_medians(
+    runners: Dict[str, Callable[[int], None]],
+    rounds: int = 5,
+    sample: Optional[Callable[[Callable], float]] = None,
+) -> Dict[str, float]:
+    """Per-runner MEDIAN of ``sample`` over ``rounds`` interleaved
+    rounds with a fixed per-round ordering.
+
+    The round-4/5 measurement lesson (BASELINE.md): on the tunneled
+    bench chip, sequential same-process figures minutes apart drift more
+    than the effects under comparison — only interleaved A/Bs are
+    decision-grade. This is that protocol as a reusable primitive;
+    ``sample`` defaults to :func:`best_ms_per_unit`. NaN samples
+    (degenerate subtractions) are dropped from the median.
+    """
+    if sample is None:
+        sample = best_ms_per_unit
+    samples: Dict[str, list] = {name: [] for name in runners}
+    for _ in range(rounds):
+        for name, run in runners.items():
+            samples[name].append(sample(run))
+    out = {}
+    for name, xs in samples.items():
+        xs = sorted(x for x in xs if x == x)
+        if not xs:
+            out[name] = float("nan")
+            continue
+        mid = len(xs) // 2
+        out[name] = (
+            xs[mid] if len(xs) % 2 else 0.5 * (xs[mid - 1] + xs[mid])
+        )
+    return out
 
 
 @contextlib.contextmanager

@@ -20,10 +20,74 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+# jax_num_cpu_devices only exists in newer JAX (>= 0.4.34 it appeared,
+# but 0.4.37 as installed here still lacks it); the XLA_FLAGS fallback
+# above already forces 8 host devices on versions without the option.
+if hasattr(jax.config, "jax_num_cpu_devices"):
+    jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_threefry_partitionable", True)
 
 import pytest  # noqa: E402
+
+
+def _install_pallas_interpret_compat() -> None:
+    """Version-gate ``pltpu.force_tpu_interpret_mode`` for old JAX.
+
+    The kernel structure tests run the Mosaic kernels on CPU via
+    ``pltpu.force_tpu_interpret_mode``, which the installed JAX 0.4.37
+    predates. The shim reproduces the two properties those tests rely
+    on: every ``pl.pallas_call`` built inside the context runs with
+    ``interpret=True``, and the Mosaic-only PRNG primitives execute on
+    CPU with the SAME semantics the real interpret mode documents —
+    ``prng_random_bits`` yields all-ZERO bits (the structure tests'
+    determinism anchor, see tests/test_pallas.py docstring) and
+    ``prng_seed`` is a no-op. ``bitcast`` already carries a generic
+    lowering rule. On newer JAX the real context manager is used
+    untouched.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    if hasattr(pltpu, "force_tpu_interpret_mode"):
+        return
+    import contextlib
+
+    import jax.numpy as jnp
+    from jax.interpreters import mlir
+    from jax._src.pallas.mosaic import primitives as _mp
+    from jax.experimental import pallas as pl
+
+    mlir.register_lowering(
+        _mp.prng_seed_p,
+        mlir.lower_fun(lambda *seeds: [], multiple_results=True),
+        "cpu",
+    )
+    mlir.register_lowering(
+        _mp.prng_random_bits_p,
+        mlir.lower_fun(
+            lambda *, shape: jnp.zeros(shape, jnp.int32),
+            multiple_results=False,
+        ),
+        "cpu",
+    )
+
+    _real_call = pl.pallas_call
+
+    @contextlib.contextmanager
+    def force_tpu_interpret_mode():
+        def interpret_call(*args, **kwargs):
+            kwargs["interpret"] = True
+            return _real_call(*args, **kwargs)
+
+        pl.pallas_call = interpret_call
+        try:
+            yield
+        finally:
+            pl.pallas_call = _real_call
+
+    pltpu.force_tpu_interpret_mode = force_tpu_interpret_mode
+
+
+_install_pallas_interpret_compat()
 
 
 @pytest.fixture

@@ -14,9 +14,26 @@ import subprocess
 import sys
 from pathlib import Path
 
+import jax
+import pytest
+
 TOOL = Path(__file__).resolve().parent.parent / "tools" / "multihost_smoke.py"
 
+# Multi-process CPU collectives only exist in newer jaxlib: 0.4.x raises
+# "Multiprocess computations aren't implemented on the CPU backend" at
+# the first sharded computation, so on those versions the smokes cannot
+# run AT ALL on this platform (they still run on real multi-host TPU).
+# Proxy capability gate: jax.shard_map moved to the top level in the
+# same era the CPU backend gained cross-process computations.
+_MULTIPROC_CPU = hasattr(jax, "shard_map")
+needs_multiproc_cpu = pytest.mark.skipif(
+    not _MULTIPROC_CPU,
+    reason="installed jaxlib has no multi-process CPU collectives",
+)
 
+
+@pytest.mark.slow
+@needs_multiproc_cpu
 def test_multihost_smoke_with_checkpointing():
     proc = subprocess.run(
         [sys.executable, str(TOOL)],
@@ -35,6 +52,8 @@ def test_multihost_smoke_with_checkpointing():
 RESIZE_TOOL = Path(__file__).resolve().parent.parent / "tools" / "resize_smoke.py"
 
 
+@pytest.mark.slow
+@needs_multiproc_cpu
 def test_job_resize_checkpoint_matrix():
     """The multi-process matrix (tools/resize_smoke.py), widened to an
     8-PROCESS fleet in round 5 (verdict item 9): a 4-process fleet runs

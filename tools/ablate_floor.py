@@ -1,0 +1,413 @@
+"""Floor-attribution ablation harness for the fused generation step.
+
+Usage: python tools/ablate_floor.py [f32|bf16] [--k 512] [--d 8]
+           [--pop 1048576] [--len 100] [--rounds 5] [--dsweep] [--tsweep]
+           [--json PATH]
+
+The round-5 verdict left 58% of the f32 generation (4.33 of 7.445
+ms/gen at K=512) in an unattributed "compute-removed floor":
+``tools/ablate_kernel.py`` can subtract the breeding *stages* (matmul,
+eval, selection, mutation) but everything the stages sit on — HBM
+traffic, per-grid-step Mosaic machinery, the riffle layout's strided
+writes, the score stores, the host rank sort — was one opaque number.
+This tool partitions that number into NAMED components, each with a
+measurement method, so BASELINE.md can carry an attribution table and
+future rounds know which lever is real:
+
+  floor            all breeding compute ablated (the round-5 variant:
+                   sel_const + no_matmul + no_cross + no_mut, no fused
+                   eval) — the quantity being partitioned
+  copy_riffle      PURE-COPY kernel at the identical grid/BlockSpec
+                   layout (``copy_only`` ablation): HBM read+write +
+                   grid machinery + riffle writes, nothing else
+  copy_contig      the same copy with contiguous deme-major output
+                   (``no_riffle``) — the riffle stride cost by delta
+  copy_alias       contiguous copy writing IN PLACE over the input
+                   buffer (``alias_io`` + ``input_output_aliases``) —
+                   the output-allocation headroom by delta
+  copy_riffle_score  copy + the batched (1, D, K) score stores — the
+                   score-write cost by delta (part of the FULL step,
+                   not of the fused=False floor)
+  rank_sort        ``compute_ranks`` (two-key sort + argsort) isolated
+  full / full_serial / full_nodonate   the production step, and A/Bs
+                   for the parallel grid dimension_semantics and jit
+                   buffer donation
+  --dsweep         copy_riffle at every admissible D (fixed K): fits
+                   t(D) = a + b·(G/D), attributing per-grid-step
+                   dispatch overhead from the slope
+  --tsweep         the multi-generation kernel at T in {1,2,4,8}:
+                   per-launch dispatch amortization
+
+All variants are measured INTERLEAVED over ``--rounds`` rounds with a
+fixed per-round ordering (the round-4/5 lesson: on the tunneled chip
+only interleaved A/Bs are decision-grade), each sample a two-length
+subtraction of per-length minima; medians are reported. The partition
+itself (``partition_floor``) is pure arithmetic over the measured
+medians and is unit-tested on CPU (tests/test_ablate_floor.py); the
+kernel variants also run under interpret mode there, pinning the
+copy kernel's identity property.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+# The floor variant of ablate_kernel.py / BASELINE.md round 5: every
+# removable breeding stage off, fused evaluation off.
+FLOOR_ABLATE = ("sel_const", "no_matmul", "no_cross", "no_mut")
+# Copy variants skip the host rank sort too — the kernel ignores the
+# ranks input, so sorting it would time the sort into the copy.
+COPY = ("copy_only", "no_rank_sort")
+
+
+def build_variant(
+    name, dt, K, D, pop, L, ablate=(), fused=True, donate=True,
+    interpret_ok=False,
+):
+    """Build ``(loop, gp, sp)`` for one ablation variant: a jitted
+    fori_loop driving ``breed.padded`` n times, plus the padded inputs.
+    Mirrors tools/ablate_kernel.py's loop so numbers stay comparable."""
+    from libpga_tpu.objectives import onemax
+    from libpga_tpu.ops.pallas_step import make_pallas_breed
+
+    breed = make_pallas_breed(
+        pop, L, deme_size=K,
+        fused_obj=onemax.kernel_rowwise if fused else None,
+        gene_dtype=dt, _demes_per_step=D, _ablate=tuple(ablate),
+    )
+    if breed is None:
+        return None
+    if not interpret_ok:
+        assert breed.K == K and breed.D == D, (name, breed.K, breed.D)
+
+    def body(_, carry):
+        g, s, key = carry
+        key, sub = jax.random.split(key)
+        out = breed.padded(g, s, sub)
+        g, s = out if breed.fused else (out, s)
+        return g, s, key
+
+    def loop(gp, sp, n):
+        g, s, _ = jax.lax.fori_loop(0, n, body, (gp, sp, jax.random.key(0)))
+        return g, s
+
+    gp = jax.random.uniform(
+        jax.random.key(1), (breed.Pp, breed.Lp)
+    ).astype(dt)
+    sp = jnp.sum(gp[:, :L].astype(jnp.float32), axis=1)
+    jitted = jax.jit(loop, donate_argnums=(0,) if donate else ())
+
+    def run(n):
+        # Donation consumes gp on the first call; feed a fresh copy so
+        # every sample runs the identical program.
+        jax.block_until_ready(jitted(gp + 0, sp, n))
+
+    run.breed = breed
+    return run
+
+
+def build_rank_sort(dt, K, D, pop, L):
+    """Isolated ``compute_ranks`` timing: the host-side two-key sort the
+    one-generation path runs per generation, looped n times with the
+    rank output folded back into the scores so the loop cannot be
+    collapsed."""
+    from libpga_tpu.ops.pallas_step import make_pallas_breed
+    from libpga_tpu.objectives import onemax
+
+    breed = make_pallas_breed(
+        pop, L, deme_size=K, fused_obj=onemax.kernel_rowwise,
+        gene_dtype=dt, _demes_per_step=D,
+    )
+    if breed is None:
+        return None
+    Pp = breed.Pp
+
+    def body(_, carry):
+        s, key = carry
+        key, k_tie = jax.random.split(key)
+        ranks = breed.compute_ranks(s, k_tie)
+        return s + 1e-6 * ranks.reshape(Pp), key
+
+    def loop(sp, n):
+        s, _ = jax.lax.fori_loop(0, n, body, (sp, jax.random.key(0)))
+        return s
+
+    jitted = jax.jit(loop)
+    sp = jax.random.uniform(jax.random.key(2), (Pp,), jnp.float32)
+
+    def run(n):
+        jax.block_until_ready(jitted(sp, n))
+
+    return run
+
+
+def build_tsweep_variant(dt, K, pop, L, T):
+    """Multi-generation kernel at launch depth T: per-launch dispatch
+    amortizes /T, so t(T) against 1/T yields the per-launch overhead."""
+    from libpga_tpu.objectives import onemax
+    from libpga_tpu.ops.pallas_step import make_pallas_multigen
+
+    bm = make_pallas_multigen(
+        pop, L, deme_size=K, fused_obj=onemax.kernel_rowwise,
+        gene_dtype=dt,
+    )
+    if bm is None:
+        return None
+
+    def body(_, carry):
+        g, s, key = carry
+        key, sub = jax.random.split(key)
+        g, s = bm.padded(g, s, sub, jnp.int32(T))
+        return g, s, key
+
+    def loop(gp, sp, n):
+        g, s, _ = jax.lax.fori_loop(0, n, body, (gp, sp, jax.random.key(0)))
+        return g, s
+
+    jitted = jax.jit(loop)
+    gp = jax.random.uniform(jax.random.key(1), (bm.Pp, bm.Lp)).astype(dt)
+    sp = jnp.sum(gp[:, :L].astype(jnp.float32), axis=1)
+
+    def run(n):
+        # n LAUNCHES of T sub-generations each: per-generation figures
+        # divide by T (handled by the caller via gens_per_call).
+        jax.block_until_ready(jitted(gp, sp, n))
+
+    run.gens_per_call = T
+    return run
+
+
+def measure_interleaved(runners: dict, rounds: int, lo=30, hi=90) -> dict:
+    """{name: median ms/gen} over ``rounds`` interleaved rounds with a
+    fixed per-round ordering — the measurement protocol now lives in
+    ``utils/profiling`` (the only decision-grade protocol on the
+    tunneled chip; BASELINE.md round 4)."""
+    from libpga_tpu.utils.profiling import (
+        best_ms_per_unit,
+        interleaved_medians,
+    )
+
+    return interleaved_medians(
+        runners,
+        rounds,
+        sample=lambda run: best_ms_per_unit(
+            run, lo, hi, units_per_call=getattr(run, "gens_per_call", 1)
+        ),
+    )
+
+
+def fit_dispatch_slope(dsweep_ms: dict, G: int):
+    """Least-squares fit t(D) = a + b·(G/D) over the copy-kernel D sweep.
+    Returns (a_ms, b_ms_per_step): ``b`` is the marginal cost of one
+    grid step at fixed total HBM traffic — per-step dispatch/sync
+    machinery, the component the VMEM model caps from below (K·D rows
+    per step bound the minimum step count)."""
+    pts = [(G / d, ms) for d, ms in sorted(dsweep_ms.items()) if ms == ms]
+    if len(pts) < 2:
+        return None, None
+    n = len(pts)
+    sx = sum(x for x, _ in pts); sy = sum(y for _, y in pts)
+    sxx = sum(x * x for x, _ in pts); sxy = sum(x * y for x, y in pts)
+    denom = n * sxx - sx * sx
+    if denom == 0:
+        return None, None
+    b = (n * sxy - sx * sy) / denom
+    a = (sy - b * sx) / n
+    return a, b
+
+
+def partition_floor(ms: dict, *, steps_bench=None, dispatch_per_step=None):
+    """Partition the measured floor into named components (pure
+    arithmetic — unit-tested on CPU). ``ms`` carries the medians for
+    ``floor``, ``copy_riffle``, ``copy_contig``, ``copy_alias`` and
+    ``rank_sort`` (missing keys degrade gracefully: the affected deltas
+    fold into their parent component). Returns ``(components,
+    coverage)``: an ordered list of ``(name, ms, method)`` that sums to
+    ``floor`` EXACTLY by construction, and the fraction of the floor
+    attributed by direct measurement (everything except the
+    by-subtraction scaffold residual)."""
+    floor = ms["floor"]
+    copy_riffle = ms.get("copy_riffle")
+    copy_contig = ms.get("copy_contig", copy_riffle)
+    copy_alias = ms.get("copy_alias", copy_contig)
+    rank_sort = ms.get("rank_sort", 0.0)
+
+    comps = []
+    base = copy_alias
+    if steps_bench and dispatch_per_step and dispatch_per_step > 0:
+        grid = min(dispatch_per_step * steps_bench, base)
+        comps.append((
+            "grid_steps", grid,
+            f"D-sweep slope: {dispatch_per_step*1000:.2f} us/step x "
+            f"{steps_bench} steps",
+        ))
+        base = base - grid
+    comps.append((
+        "hbm_copy", base,
+        "aliased contiguous pure-copy kernel at the identical grid"
+        + (" (minus grid_steps)" if len(comps) else ""),
+    ))
+    if copy_contig is not None and copy_alias is not None:
+        comps.append((
+            "alias_headroom", copy_contig - copy_alias,
+            "contiguous copy minus in-place (input_output_aliases) copy",
+        ))
+    if copy_riffle is not None and copy_contig is not None:
+        comps.append((
+            "riffle_stride", copy_riffle - copy_contig,
+            "riffle-layout copy minus contiguous copy",
+        ))
+    comps.append((
+        "rank_sort", rank_sort, "compute_ranks looped in isolation",
+    ))
+    attributed = sum(c[1] for c in comps)
+    comps.append((
+        "kernel_scaffold", floor - attributed,
+        "subtraction: floor minus all directly measured components "
+        "(PRNG seeding, sel_const scaffolding, casts, unmodeled "
+        "per-step overhead)",
+    ))
+    coverage = attributed / floor if floor else float("nan")
+    return comps, coverage
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("dtype", nargs="?", default="f32", choices=["f32", "bf16"])
+    ap.add_argument("--k", type=int, default=512)
+    ap.add_argument("--d", type=int, default=None)
+    ap.add_argument("--pop", type=int, default=1 << 20)
+    ap.add_argument("--len", type=int, default=100, dest="length")
+    ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--dsweep", action="store_true")
+    ap.add_argument("--tsweep", action="store_true")
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+
+    assert jax.default_backend() == "tpu", (
+        "the floor is a hardware quantity — run this on TPU "
+        "(the CPU-side partition arithmetic is covered by "
+        "tests/test_ablate_floor.py)"
+    )
+    dt = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+    K, pop, L = args.k, args.pop, args.length
+    D = args.d
+    if D is None:
+        D = 4 if dt == jnp.bfloat16 else 8
+
+    mk = lambda name, **kw: build_variant(name, dt, K, D, pop, L, **kw)
+    runners = {
+        "full": mk("full"),
+        "full_serial": mk("full_serial", ablate=("serial_grid",)),
+        "full_nodonate": mk("full_nodonate", donate=False),
+        "floor": mk("floor", ablate=FLOOR_ABLATE, fused=False),
+        "copy_riffle_score": mk("copy_riffle_score", ablate=COPY),
+        "copy_riffle": mk("copy_riffle", ablate=COPY, fused=False),
+        "copy_contig": mk(
+            "copy_contig", ablate=COPY + ("no_riffle",), fused=False
+        ),
+        "copy_alias": mk(
+            "copy_alias", ablate=COPY + ("no_riffle", "alias_io"),
+            fused=False,
+        ),
+        "rank_sort": build_rank_sort(dt, K, D, pop, L),
+    }
+    runners = {n: r for n, r in runners.items() if r is not None}
+    for r in runners.values():
+        r(3)  # compile before the interleave
+    med = measure_interleaved(runners, args.rounds)
+
+    G = -(-pop // K)
+    dsweep_ms, a_ms, b_ms = {}, None, None
+    if args.dsweep:
+        dr = {}
+        for d in (1, 2, 4, 8, 16, 32):
+            # interpret_ok skips the exact-(K, D) assert: an
+            # inadmissible D rounds down in the factory and the sweep
+            # just drops that point instead of crashing.
+            v = build_variant(
+                f"copy_riffle_d{d}", dt, K, d, pop, L, ablate=COPY,
+                fused=False, interpret_ok=True,
+            )
+            if v is not None and v.breed.K == K and v.breed.D == d:
+                v(3)
+                dr[d] = v
+        sw = measure_interleaved(
+            {f"d{d}": r for d, r in dr.items()}, args.rounds
+        )
+        dsweep_ms = {d: sw[f"d{d}"] for d in dr}
+        a_ms, b_ms = fit_dispatch_slope(dsweep_ms, G)
+
+    tsweep_ms = {}
+    if args.tsweep:
+        tr = {}
+        for t in (1, 2, 4, 8):
+            v = build_tsweep_variant(dt, K, pop, L, t)
+            if v is not None:
+                v(3)
+                tr[t] = v
+        sw = measure_interleaved(
+            {f"t{t}": r for t, r in tr.items()}, args.rounds, lo=10, hi=30
+        )
+        tsweep_ms = {t: sw[f"t{t}"] for t in tr}
+
+    comps, coverage = partition_floor(
+        med, steps_bench=G // D, dispatch_per_step=b_ms,
+    )
+
+    name = args.dtype
+    print(f"# floor attribution — {name} K={K} D={D} pop={pop} L={L} "
+          f"({args.rounds} interleaved rounds, median ms/gen)")
+    for label in runners:
+        print(f"{name} {label:18s} {med[label]:8.3f} ms/gen")
+    if "copy_riffle_score" in med and "copy_riffle" in med:
+        print(f"{name} {'score_store':18s} "
+              f"{med['copy_riffle_score'] - med['copy_riffle']:8.3f} ms "
+              f"(copy_riffle_score - copy_riffle; part of full, not floor)")
+    print(f"\n# partition of floor = {med['floor']:.3f} ms "
+          f"(coverage {coverage:.1%} directly measured)")
+    for comp, v, method in comps:
+        print(f"  {comp:16s} {v:8.3f} ms  [{method}]")
+    if dsweep_ms:
+        print(f"\n# D sweep (copy_riffle, K={K}): "
+              + ", ".join(f"D={d}: {v:.3f}" for d, v in dsweep_ms.items()))
+        if b_ms is not None:
+            print(f"  fit t = {a_ms:.3f} + {b_ms*1000:.2f} us * (G/D)")
+    if tsweep_ms:
+        print("\n# T sweep (multigen): "
+              + ", ".join(f"T={t}: {v:.3f} ms/gen"
+                          for t, v in tsweep_ms.items()))
+
+    out = {
+        "dtype": name, "K": K, "D": D, "pop": pop, "genome_len": L,
+        "rounds": args.rounds,
+        "medians_ms_per_gen": {k: round(v, 4) for k, v in med.items()},
+        "floor_partition": [
+            {"component": c, "ms": round(v, 4), "method": m}
+            for c, v, m in comps
+        ],
+        "coverage": round(coverage, 4),
+        "dsweep_ms": {str(d): round(v, 4) for d, v in dsweep_ms.items()},
+        "dispatch_us_per_step": (
+            round(b_ms * 1000, 3) if b_ms is not None else None
+        ),
+        "tsweep_ms": {str(t): round(v, 4) for t, v in tsweep_ms.items()},
+    }
+    line = json.dumps(out)
+    print("\n" + line)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -25,7 +25,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+from libpga_tpu.utils.compat import force_cpu_device_count  # noqa: E402
+
+force_cpu_device_count(8)
 
 import jax.numpy as jnp
 import numpy as np

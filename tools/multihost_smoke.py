@@ -27,7 +27,9 @@ def worker(process_id: int) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", DEVICES_PER_PROCESS)
+    from libpga_tpu.utils.compat import force_cpu_device_count
+
+    force_cpu_device_count(DEVICES_PER_PROCESS)
 
     from libpga_tpu.parallel import distributed
 

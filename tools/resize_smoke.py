@@ -62,7 +62,9 @@ def worker(stage: int, process_id: int) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", GLOBAL_DEVICES // num_procs)
+    from libpga_tpu.utils.compat import force_cpu_device_count
+
+    force_cpu_device_count(GLOBAL_DEVICES // num_procs)
 
     from libpga_tpu.parallel import distributed
 

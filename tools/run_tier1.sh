@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Tier-1 verification entry point — the exact ROADMAP.md command, make-free.
+#
+#   tools/run_tier1.sh            # run tier-1 (CPU, not-slow, 870 s budget)
+#
+# Prints DOTS_PASSED=<count> at the end (the driver's pass metric) and
+# exits with pytest's status. Log lands in /tmp/_t1.log.
+set -o pipefail
+cd "$(dirname "$0")/.."
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc
