@@ -154,11 +154,17 @@ def _median_iqr(xs) -> tuple:
     return med, iqr
 
 
-def setup_single(gene_dtype):
-    """One-population 1M×100 OneMax runner at the given gene dtype."""
-    from libpga_tpu import PGA, PGAConfig
+def setup_single(gene_dtype, telemetry_gens: int = 0):
+    """One-population 1M×100 OneMax runner at the given gene dtype.
+    ``telemetry_gens`` > 0 enables the on-device history carry
+    (``utils/telemetry``) — the telemetry-overhead A/B arm."""
+    from libpga_tpu import PGA, PGAConfig, TelemetryConfig
 
-    pga = PGA(seed=42, config=PGAConfig(use_pallas=True, gene_dtype=gene_dtype))
+    tel = TelemetryConfig(history_gens=telemetry_gens) if telemetry_gens else None
+    pga = PGA(
+        seed=42,
+        config=PGAConfig(use_pallas=True, gene_dtype=gene_dtype, telemetry=tel),
+    )
     pga.create_population(POP, GENOME_LEN)
     pga.set_objective("onemax")
     if not pga._pallas_gate():
@@ -269,6 +275,12 @@ def main() -> None:
     # islands/single ratio comes from adjacent measurements.
     runners = [
         ("f32", setup_single(jnp.float32), 50, 150),
+        # Telemetry-overhead A/B arm: identical config + the on-device
+        # history carry, sampled ADJACENT to f32 every round so the
+        # tracked overhead comes from back-to-back measurements
+        # (acceptance bar: < 2% at this shape).
+        ("f32_telemetry", setup_single(jnp.float32, telemetry_gens=160),
+         50, 150),
         ("islands", setup_islands(), 50, 150),
         ("bf16", setup_single(jnp.bfloat16), 50, 150),
         # Longer windows for the fast configs: at ~3,500 gens/sec the
@@ -280,13 +292,20 @@ def main() -> None:
     ]
     samples: dict = {name: [] for name, *_ in runners}
     ratios = []
+    tel_overheads = []
     for _ in range(ROUNDS):
         for name, run, lo, hi in runners:
             samples[name].append(_sample_gps(run, lo, hi))
         ratios.append(samples["islands"][-1] / samples["f32"][-1])
+        # per-round overhead from the ADJACENT f32/f32_telemetry pair:
+        # (1/gps_on) / (1/gps_off) - 1, in percent.
+        tel_overheads.append(
+            (samples["f32"][-1] / samples["f32_telemetry"][-1] - 1.0) * 100.0
+        )
 
     med = {name: _median_iqr(xs) for name, xs in samples.items()}
     ratio_med, ratio_iqr = _median_iqr(ratios)
+    tel_med, tel_iqr = _median_iqr(tel_overheads)
 
     baseline_gps = 1.0 / reference_floor_seconds_per_gen()
     f32_gps = med["f32"][0]
@@ -312,6 +331,11 @@ def main() -> None:
         "tsp1k_gens_per_sec": round(med["tsp1k"][0], 1),
         "tsp1k_gens_per_sec_median": round(med["tsp1k"][0], 1),
         "tsp1k_gens_per_sec_iqr": round(med["tsp1k"][1], 1),
+        # Telemetry-overhead A/B (utils/telemetry history carry at the
+        # headline shape; per-round from adjacent pairs, ISSUE 2 bar <2%).
+        "telemetry_gens_per_sec_median": round(med["f32_telemetry"][0], 2),
+        "telemetry_overhead_pct_median": round(tel_med, 2),
+        "telemetry_overhead_pct_iqr": round(tel_iqr, 2),
     }
     d32 = single_derived(jnp.float32, f32_gps)
     out.update(d32)

@@ -259,4 +259,30 @@ int pga_run_islands(pga_t *p, unsigned n, unsigned m, float pct) {
                                       n, m, static_cast<double>(pct)));
 }
 
+int pga_set_telemetry(pga_t *p, unsigned max_gens) {
+    if (!p) return -1;
+    return static_cast<int>(
+        call_long("set_telemetry", "(lI)", solver_of(p), max_gens));
+}
+
+float *pga_get_history(pga_t *p, population_t *pop, unsigned *rows,
+                       unsigned *cols) {
+    if (!p || !pop) return nullptr;
+    long c = call_long("history_cols", "()");
+    if (c <= 0) return nullptr;
+    size_t nbytes = 0;
+    float *vals = bytes_to_floats(
+        call("get_history", "(ll)", solver_of(p), pop_index_of(pop)),
+        &nbytes);
+    if (!vals || nbytes == 0) {
+        std::free(vals); /* empty history: no rows recorded */
+        if (rows) *rows = 0;
+        if (cols) *cols = static_cast<unsigned>(c);
+        return nullptr;
+    }
+    if (rows) *rows = static_cast<unsigned>(nbytes / (c * sizeof(float)));
+    if (cols) *cols = static_cast<unsigned>(c);
+    return vals;
+}
+
 }  // extern "C"

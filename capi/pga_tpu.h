@@ -256,6 +256,26 @@ int pga_run(pga_t *p, unsigned n, float target);
 int pga_run_n(pga_t *p, unsigned n);
 int pga_run_islands(pga_t *p, unsigned n, unsigned m, float pct);
 
+/* In-run telemetry (no reference analog — its observability is one
+ * printf of the best score, pga.cu:230). pga_set_telemetry enables a
+ * per-generation history recorded ON DEVICE inside the fused run loop
+ * (no host round trip per generation): up to `max_gens` rows of
+ * PGA_HISTORY_COLS float32 statistics — best, mean, std fitness, a
+ * genome-diversity proxy, and a stall counter (generations since the
+ * best improved). Runs longer than `max_gens` keep the LAST row
+ * current; `max_gens` 0 disables. Returns 0, or -1 on error.
+ *
+ * pga_get_history returns the rows recorded by the population's most
+ * recent pga_run / pga_run_islands (islands record one shared global
+ * history) as a malloc'd row-major rows x cols float array (caller
+ * frees); the rows and cols out-params (either may be NULL) receive the
+ * shape. NULL when nothing is recorded (telemetry off / no run yet) or
+ * on error. */
+#define PGA_HISTORY_COLS 5
+int pga_set_telemetry(pga_t *p, unsigned max_gens);
+float *pga_get_history(pga_t *p, population_t *pop, unsigned *rows,
+                       unsigned *cols);
+
 #ifdef __cplusplus
 }
 #endif

@@ -1,0 +1,75 @@
+/* Smoke driver: in-run telemetry through the C ABI. Enables the
+ * on-device per-generation history, runs a short OneMax GA, and checks
+ * the returned history — shape, NaN-free rows, a non-decreasing
+ * RUNNING best (row best is the population best, which generational
+ * replacement may lower; the cumulative max may not), and a sane stall
+ * column. Also checks the disabled/edge surfaces: no history before any
+ * run, NULL after disabling, and errors on bad handles. */
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "pga_tpu.h"
+
+#define POP 4096
+#define LEN 64
+#define GENS 25
+
+int main(void) {
+    pga_t *p = pga_init(7);
+    if (!p) return fprintf(stderr, "pga_init failed\n"), 1;
+    population_t *pop = pga_create_population(p, POP, LEN, RANDOM_POPULATION);
+    if (!pop) return fprintf(stderr, "pga_create_population failed\n"), 1;
+    if (pga_set_objective_name(p, "onemax") != 0)
+        return fprintf(stderr, "pga_set_objective_name failed\n"), 1;
+
+    /* No telemetry configured yet: no history. */
+    unsigned rows = 99, cols = 0;
+    float *hist = pga_get_history(p, pop, &rows, &cols);
+    if (hist != NULL || rows != 0)
+        return fprintf(stderr, "history before telemetry not empty\n"), 1;
+
+    if (pga_set_telemetry(p, 64) != 0)
+        return fprintf(stderr, "pga_set_telemetry failed\n"), 1;
+    if (pga_run_n(p, GENS) != GENS)
+        return fprintf(stderr, "pga_run failed\n"), 1;
+
+    hist = pga_get_history(p, pop, &rows, &cols);
+    if (!hist) return fprintf(stderr, "pga_get_history failed\n"), 1;
+    if (rows != GENS || cols != PGA_HISTORY_COLS)
+        return fprintf(stderr, "bad history shape %ux%u\n", rows, cols), 1;
+
+    float run_best = -1e30f;
+    for (unsigned r = 0; r < rows; r++) {
+        for (unsigned c = 0; c < cols; c++)
+            if (isnan(hist[r * cols + c]))
+                return fprintf(stderr, "NaN at row %u col %u\n", r, c), 1;
+        float best = hist[r * cols + 0];
+        float mean = hist[r * cols + 1];
+        float stall = hist[r * cols + 4];
+        if (best < run_best - 1e-4f && stall == 0.0f)
+            return fprintf(stderr, "best dropped without stall\n"), 1;
+        if (best > run_best) run_best = best;
+        if (mean > best + 1e-4f)
+            return fprintf(stderr, "mean above best at row %u\n", r), 1;
+    }
+    printf("telemetry history: %u gens, final best %.2f (first %.2f)\n",
+           rows, hist[(rows - 1) * cols], hist[0]);
+    if (run_best <= hist[0] + 1.0f)
+        return fprintf(stderr, "FAIL: no convergence recorded\n"), 1;
+    free(hist);
+
+    /* Disable: later history reads revert to empty-after-next-run, and
+     * the existing buffer is NOT retroactively dropped. */
+    if (pga_set_telemetry(p, 0) != 0)
+        return fprintf(stderr, "pga_set_telemetry(0) failed\n"), 1;
+
+    if (pga_get_history(NULL, pop, &rows, &cols) != NULL)
+        return fprintf(stderr, "NULL solver not rejected\n"), 1;
+    if (pga_set_telemetry(NULL, 8) != -1)
+        return fprintf(stderr, "NULL solver not rejected (set)\n"), 1;
+
+    pga_deinit(p);
+    printf("PASS\n");
+    return 0;
+}

@@ -38,6 +38,33 @@ def main():
     gens = lp.pga_run(pga2, 10_000, target=99.0)
     print(f"with target=99.0: stopped after {gens} generations")
 
+    # Convergence curve via in-run telemetry: the fused loop records
+    # best/mean/std fitness, a diversity proxy, and a stall counter per
+    # generation ON DEVICE (no host round trip mid-run) — the reference
+    # could only printf the final best (pga.cu:230).
+    pga3 = lp.PGA(
+        seed=7,
+        config=lp.PGAConfig(
+            telemetry=lp.TelemetryConfig(history_gens=256)
+        ),
+    )
+    pop3 = pga3.create_population(40_000, 100)
+    pga3.set_objective("onemax")
+    pga3.run(100)
+    hist = pga3.history(pop3)
+    print(f"telemetry: {hist}")
+    for g in range(0, len(hist), 20):
+        bar = "#" * int((hist.best[g] - 50) * 1.5)
+        print(
+            f"  gen {g + 1:3d}: best {hist.best[g]:6.2f} "
+            f"mean {hist.mean[g]:6.2f} diversity {hist.diversity[g]:.4f} "
+            f"{bar}"
+        )
+    print(
+        f"  gen {len(hist):3d}: best {hist.best[-1]:6.2f} "
+        f"(stalled for {int(hist.stall[-1])} gens)"
+    )
+
 
 if __name__ == "__main__":
     main()

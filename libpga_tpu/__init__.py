@@ -26,6 +26,7 @@ Design stance (TPU-first, not a port):
 from libpga_tpu.config import PGAConfig
 from libpga_tpu.population import Population
 from libpga_tpu.engine import PGA
+from libpga_tpu.utils.telemetry import TelemetryConfig
 from libpga_tpu import ops
 from libpga_tpu import objectives
 from libpga_tpu import parallel

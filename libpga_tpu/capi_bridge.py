@@ -766,3 +766,56 @@ def get_best_top_all(handle: int, k: int) -> bytes:
 def genome_len(handle: int, pop: int) -> int:
     pga, h = _handle_pop(handle, pop)
     return pga.population(h).genome_len
+
+
+# ------------------------------------------------------------- telemetry
+
+
+def set_telemetry(handle: int, max_gens: int) -> None:
+    """``pga_set_telemetry``: enable the in-run per-generation history
+    with a ``max_gens``-row on-device buffer (0 disables). Subsequent
+    ``pga_run``/``pga_run_islands`` calls record best/mean/std fitness, a
+    diversity proxy, and a stall counter per generation, readable via
+    ``pga_get_history`` — the C-side view of ``PGA.history``."""
+    import dataclasses
+
+    from libpga_tpu.utils.telemetry import TelemetryConfig
+
+    pga = _solver(handle)
+    tel = (
+        None if max_gens <= 0
+        else TelemetryConfig(history_gens=int(max_gens))
+    )
+    if pga.config.telemetry != tel:
+        pga.config = dataclasses.replace(pga.config, telemetry=tel)
+
+
+def history_cols() -> int:
+    from libpga_tpu.utils.telemetry import NUM_STATS
+
+    return NUM_STATS
+
+
+def history_rows(handle: int, pop: int) -> int:
+    """Recorded generation rows for the population's last telemetry run
+    (0 when telemetry was off or no run has happened)."""
+    pga, h = _handle_pop(handle, pop)
+    hist = pga.history(h)
+    return 0 if hist is None else len(hist)
+
+
+def get_history(handle: int, pop: int) -> bytes:
+    """History rows as raw float32 little-endian bytes, row-major
+    ``rows x history_cols()`` in HISTORY_COLUMNS order (best, mean, std,
+    diversity, stall). Empty bytes when no history is recorded."""
+    pga, h = _handle_pop(handle, pop)
+    hist = pga.history(h)
+    if hist is None:
+        return b""
+    import numpy as _np
+
+    rows = _np.stack(
+        [hist.as_dict()[c].astype(_np.float32) for c in hist.columns],
+        axis=1,
+    ) if len(hist) else _np.zeros((0, history_cols()), dtype=_np.float32)
+    return _np.ascontiguousarray(rows, dtype=_np.float32).tobytes()

@@ -15,6 +15,8 @@ from typing import Optional
 
 import jax.numpy as jnp
 
+from libpga_tpu.utils.telemetry import TelemetryConfig
+
 
 @dataclasses.dataclass(frozen=True)
 class PGAConfig:
@@ -87,6 +89,13 @@ class PGAConfig:
         XLA evaluation oracle, raising ``ValidationError`` with the
         operation and population named. Adds a host copy + one XLA
         evaluation per checked op; off by default.
+      telemetry: in-run telemetry settings
+        (``utils/telemetry.TelemetryConfig``): per-generation on-device
+        history carried through the fused run loops (best/mean/std
+        fitness, diversity proxy, stall counter — read back with
+        ``PGA.history``), optional JSONL event log, stall alerts.
+        ``None`` (default) disables telemetry entirely — the run loops
+        then trace to the exact pre-telemetry jaxpr (zero cost off).
       seed: base PRNG seed. The reference seeds cuRAND with ``time(NULL)``
         (``pga.cu:154``); here an explicit seed gives reproducibility, and
         ``None`` picks an OS-entropy seed.
@@ -105,6 +114,7 @@ class PGAConfig:
     pallas_generations_per_launch: Optional[int] = None
     donate_buffers: bool = True
     validate: bool = False
+    telemetry: Optional[TelemetryConfig] = None
     seed: Optional[int] = None
 
     def pallas_enabled(self) -> bool:

@@ -46,6 +46,7 @@ from libpga_tpu.ops.mutate import make_point_mutate
 from libpga_tpu.ops.step import make_breed
 from libpga_tpu.ops.topk import top_k_genomes
 from libpga_tpu.utils.metrics import Metrics
+from libpga_tpu.utils import telemetry as _tl
 
 
 # Cache marker: the Pallas factory declined this (shape, kind) — skip
@@ -106,6 +107,11 @@ class PGA:
         self._mutate: Callable = make_point_mutate(self.config.mutation_rate)
         self._compiled: Dict[tuple, Callable] = {}
         self.metrics = Metrics()
+        # Per-population History of the most recent telemetry-enabled
+        # run (run_islands stores the shared global history in every
+        # participating slot); None when telemetry is off.
+        self._history: List[Optional[_tl.History]] = []
+        self._events: Optional[_tl.EventLog] = None
 
     # ------------------------------------------------------------------ RNG
 
@@ -126,6 +132,7 @@ class PGA:
         )
         self._populations.append(pop)
         self._staged.append(None)
+        self._history.append(None)
         return PopulationHandle(len(self._populations) - 1)
 
     def population(self, handle: PopulationHandle) -> Population:
@@ -141,6 +148,55 @@ class PGA:
 
     def _handles(self) -> List[PopulationHandle]:
         return [PopulationHandle(i) for i in range(len(self._populations))]
+
+    # -------------------------------------------------------------- telemetry
+
+    def _history_gens(self) -> Optional[int]:
+        """History-buffer capacity, or None when the history carry is off
+        (no telemetry config, or history_gens == 0)."""
+        t = self.config.telemetry
+        return t.history_gens if t is not None and t.history_gens > 0 else None
+
+    def history(self, handle: PopulationHandle) -> Optional[_tl.History]:
+        """Per-generation history of the population's most recent
+        telemetry-enabled ``run``/``run_islands`` (columns
+        ``telemetry.HISTORY_COLUMNS``: best/mean/std fitness, diversity
+        proxy, stall counter), or None. Recorded ON DEVICE inside the
+        fused loop — no host round trip per generation; granularity is
+        per generation on the default paths, per launch on an explicit
+        multi-generation kernel, per migration epoch on the island
+        runners."""
+        return self._history[handle.index]
+
+    def _event_log(self) -> Optional[_tl.EventLog]:
+        t = self.config.telemetry
+        if t is None or not t.events_path:
+            return None
+        if self._events is None or self._events.path != t.events_path:
+            if self._events is not None:
+                self._events.close()
+            self._events = _tl.EventLog(t.events_path)
+            # run_record events ride the existing Metrics listener
+            # registry — the same channel loggers/checkpointers use.
+            self._events.attach(self.metrics)
+        return self._events
+
+    def _emit(self, event: str, **fields) -> None:
+        log = self._event_log()
+        if log is not None:
+            log.emit(event, **fields)
+
+    def _check_stall_alert(self, hist: Optional[_tl.History]) -> None:
+        t = self.config.telemetry
+        if (
+            t is not None and t.stall_alert_gens > 0 and hist is not None
+            and len(hist) > 0 and int(hist.stall[-1]) >= t.stall_alert_gens
+        ):
+            self._emit(
+                "stall_alert",
+                stalled_gens=int(hist.stall[-1]),
+                best=float(hist.best[-1]),
+            )
 
     # ------------------------------------------------------------- callbacks
 
@@ -192,7 +248,19 @@ class PGA:
         nothing and would double the op's cost)."""
         if not self.config.validate:
             return
-        from libpga_tpu.utils.validate import check_population
+        from libpga_tpu.utils.validate import check_population as _check
+
+        def check_population(*args, **kw):
+            # Event-log hook: a validation failure is exactly the kind of
+            # in-run anomaly the structured log exists to capture.
+            try:
+                _check(*args, **kw)
+            except Exception as e:
+                self._emit(
+                    "validation_failure", where=where,
+                    index=kw.get("index"), error=str(e),
+                )
+                raise
 
         if indices is None:
             indices = range(len(self._populations))
@@ -258,8 +326,16 @@ class PGA:
         annealing schedules share one compilation — the cache key holds
         the mutation KIND, not the operator instance); the XLA path bakes
         the operator in and ignores it.
+
+        Telemetry (``config.telemetry`` with history_gens > 0): the loop
+        additionally carries the (history_gens, NUM_STATS) stats buffer +
+        running best/stall scalars and the fn returns a trailing history
+        array. The DISABLED path is the exact code below, untouched — it
+        traces to the same jaxpr as before telemetry existed
+        (structurally asserted in tests/test_telemetry.py).
         """
         obj = self._require_objective()
+        hist_gens = self._history_gens()
         pallas_kind = self._mutate_kind() if self._pallas_gate() else None
         if pallas_kind is None:
             self._warn_xla_fallback()
@@ -274,11 +350,16 @@ class PGA:
                 self.config.tournament_size, self.config.selection,
                 self.config.selection_param,
                 self.config.pallas_generations_per_launch,
+                hist_gens,
             )
             cached = self._compiled.get(pkey)
             if cached is None:
                 from libpga_tpu.ops.pallas_step import make_pallas_run
 
+                self._emit(
+                    "compile", what="run_pallas", population_size=size,
+                    genome_len=genome_len,
+                )
                 factory = make_pallas_run(
                     obj,
                     tournament_size=self.config.tournament_size,
@@ -297,6 +378,7 @@ class PGA:
                     generations_per_launch=(
                         self.config.pallas_generations_per_launch
                     ),
+                    history_gens=hist_gens,
                 )
                 pallas_fn = factory(size, genome_len) if factory else None
                 cached = (
@@ -310,31 +392,70 @@ class PGA:
             "run", size, genome_len, obj, self._crossover, self._mutate,
             self.config.tournament_size, self.config.elitism,
             self.config.selection, self.config.selection_param,
+            hist_gens,
         )
         fn = self._compiled.get(cache_key)
         if fn is not None:
             return fn
+        self._emit(
+            "compile", what="run_xla", population_size=size,
+            genome_len=genome_len,
+        )
 
         breed = self._breed_fn()
 
-        def run_loop(genomes, key, n, target, mparams):
-            del mparams  # operator parameters are baked into breed
-            scores0 = _evaluate(obj, genomes)
+        if hist_gens is None:
 
-            def cond(carry):
-                g, s, k, gen = carry
-                return jnp.logical_and(gen < n, jnp.max(s) < target)
+            def run_loop(genomes, key, n, target, mparams):
+                del mparams  # operator parameters are baked into breed
+                scores0 = _evaluate(obj, genomes)
 
-            def body(carry):
-                g, s, k, gen = carry
-                k, sub = jax.random.split(k)
-                g2 = breed(g, s, sub)
-                s2 = _evaluate(obj, g2)
-                return (g2, s2, k, gen + 1)
+                def cond(carry):
+                    g, s, k, gen = carry
+                    return jnp.logical_and(gen < n, jnp.max(s) < target)
 
-            init = (genomes, scores0, key, jnp.int32(0))
-            g, s, k, gens_done = jax.lax.while_loop(cond, body, init)
-            return g, s, gens_done
+                def body(carry):
+                    g, s, k, gen = carry
+                    k, sub = jax.random.split(k)
+                    g2 = breed(g, s, sub)
+                    s2 = _evaluate(obj, g2)
+                    return (g2, s2, k, gen + 1)
+
+                init = (genomes, scores0, key, jnp.int32(0))
+                g, s, k, gens_done = jax.lax.while_loop(cond, body, init)
+                return g, s, gens_done
+
+        else:
+
+            def run_loop(genomes, key, n, target, mparams):
+                del mparams
+                scores0 = _evaluate(obj, genomes)
+
+                def cond(carry):
+                    g, s, k, gen, best, stall, buf = carry
+                    return jnp.logical_and(gen < n, jnp.max(s) < target)
+
+                def body(carry):
+                    g, s, k, gen, best, stall, buf = carry
+                    k, sub = jax.random.split(k)
+                    with jax.named_scope("pga/select_breed"):
+                        g2 = breed(g, s, sub)
+                    with jax.named_scope("pga/evaluate"):
+                        s2 = _evaluate(obj, g2)
+                    with jax.named_scope("pga/telemetry"):
+                        row, best, stall = _tl.stats_row(g2, s2, best, stall)
+                        buf = _tl.write_row(buf, gen, row)
+                    return (g2, s2, k, gen + 1, best, stall, buf)
+
+                init = (
+                    genomes, scores0, key, jnp.int32(0),
+                    jnp.max(scores0), jnp.int32(0),
+                    _tl.history_init(hist_gens),
+                )
+                g, s, k, gens_done, _, _, buf = jax.lax.while_loop(
+                    cond, body, init
+                )
+                return g, s, gens_done, buf
 
         donate = (0,) if self.config.donate_buffers else ()
         fn = jax.jit(run_loop, donate_argnums=donate)
@@ -653,19 +774,39 @@ class PGA:
         pop = self._populations[handle.index]
         fn = self._compiled_run(pop.size, pop.genome_len)
         tgt = jnp.float32(jnp.inf if target is None else target)
-        t0 = time.perf_counter()
-        genomes, scores, gens_done = fn(
-            pop.genomes, self.next_key(), jnp.int32(n), tgt,
-            self._mutate_params(),
+        self._emit(
+            "run_start", population_size=pop.size,
+            genome_len=pop.genome_len, n=int(n),
+            target=None if target is None else float(target),
         )
+        t0 = time.perf_counter()
+        with _tl.span("run"):
+            out = fn(
+                pop.genomes, self.next_key(), jnp.int32(n), tgt,
+                self._mutate_params(),
+            )
+        genomes, scores, gens_done = out[:3]
         gens = int(gens_done)
         # Install the new population BEFORE notifying metrics listeners:
         # the old genome buffer was donated to the jit and is dead, and
         # listeners (e.g. AutoCheckpointer) read solver state.
         self._populations[handle.index] = Population(genomes=genomes, scores=scores)
         self._staged[handle.index] = None
+        hist = None
+        if len(out) > 3:  # telemetry history rode the loop carry
+            hist = _tl.History(out[3], gens)
+        # history() always describes the population's MOST RECENT run: a
+        # telemetry-off run clears any stale buffer from an earlier one.
+        self._history[handle.index] = hist
         self._validate("run", [handle.index])
-        self.metrics.record_run(gens, pop.size, time.perf_counter() - t0)
+        seconds = time.perf_counter() - t0
+        self.metrics.record_run(gens, pop.size, seconds)
+        if self._event_log() is not None:
+            self._emit(
+                "run_end", generations=gens, seconds=seconds,
+                best=float(jnp.max(scores)),
+            )
+        self._check_stall_alert(hist)
         return gens
 
     # ------------------------------------------------- step-by-step operators
@@ -673,7 +814,8 @@ class PGA:
     def evaluate(self, handle: PopulationHandle) -> None:
         """Score the current generation (reference ``pga_evaluate``)."""
         pop = self._populations[handle.index]
-        scores = self._jitted_evaluate()(pop.genomes)
+        with _tl.span("evaluate"):
+            scores = self._jitted_evaluate()(pop.genomes)
         self._populations[handle.index] = dataclasses.replace(pop, scores=scores)
         self._validate("evaluate", [handle.index], oracle=False)
 
@@ -712,7 +854,10 @@ class PGA:
             )
         pop = self._populations[handle.index]
         fn = self._compiled_op("crossover")
-        self._staged[handle.index] = fn(pop.genomes, pop.scores, self.next_key())
+        with _tl.span("select_breed"):
+            self._staged[handle.index] = fn(
+                pop.genomes, pop.scores, self.next_key()
+            )
         self._validate("crossover", [handle.index], staged=True)
 
     def crossover_all(self, selection: str = "tournament") -> None:
@@ -788,9 +933,10 @@ class PGA:
         staged = self._staged[handle.index]
         if staged is None:
             raise RuntimeError("no staged generation — call crossover() first")
-        self._staged[handle.index] = self._compiled_op("mutate")(
-            staged, self.next_key()
-        )
+        with _tl.span("mutate"):
+            self._staged[handle.index] = self._compiled_op("mutate")(
+                staged, self.next_key()
+            )
         self._validate("mutate", [handle.index], staged=True)
 
     def mutate_all(self) -> None:
@@ -811,11 +957,12 @@ class PGA:
         if staged is None:
             raise RuntimeError("no staged generation — call crossover() first")
         pop = self._populations[handle.index]
-        self._populations[handle.index] = Population(
-            genomes=staged,
-            scores=jnp.full((pop.size,), -jnp.inf, dtype=jnp.float32),
-        )
-        self._staged[handle.index] = None
+        with _tl.span("swap"):
+            self._populations[handle.index] = Population(
+                genomes=staged,
+                scores=jnp.full((pop.size,), -jnp.inf, dtype=jnp.float32),
+            )
+            self._staged[handle.index] = None
 
     def fill_random_values(self, handle: PopulationHandle) -> None:
         """Advance the PRNG stream (reference ``pga_fill_random_values``
@@ -896,16 +1043,20 @@ class PGA:
         n = len(self._populations)
         if n < 2:
             return
-        emigrants = {}
-        for i, pop in enumerate(self._populations):
-            count = int(pop.size * pct)
-            if count > 0:
-                emigrants[i] = top_k_genomes(pop.genomes, pop.scores, count)
-        order = np.asarray(jax.random.permutation(self.next_key(), jnp.arange(n)))
-        for i in range(n):
-            src, dst = int(order[i]), int(order[(i + 1) % n])
-            if src in emigrants:
-                self._immigrate_into(dst, *emigrants[src])
+        self._emit("migration", pct=float(pct), populations=n)
+        with _tl.span("migrate"):
+            emigrants = {}
+            for i, pop in enumerate(self._populations):
+                count = int(pop.size * pct)
+                if count > 0:
+                    emigrants[i] = top_k_genomes(pop.genomes, pop.scores, count)
+            order = np.asarray(
+                jax.random.permutation(self.next_key(), jnp.arange(n))
+            )
+            for i in range(n):
+                src, dst = int(order[i]), int(order[(i + 1) % n])
+                if src in emigrants:
+                    self._immigrate_into(dst, *emigrants[src])
 
     def migrate_between(
         self, src: PopulationHandle, dst: PopulationHandle, pct: float
@@ -971,34 +1122,59 @@ class PGA:
             and not getattr(breed, "fused", False)
             else 0
         )
-        t0 = time.perf_counter()
-        genomes, scores, gens = run_islands_stacked(
-            breed,
-            self._require_objective(),
-            stacked,
-            self.next_key(),
-            n=n,
-            m=m,
-            pct=pct,
-            target=target,
-            topology=self.config.migration_topology,
-            mesh=mesh,
-            runner_cache=self._compiled,
-            mparams=self._mutate_params(),
-            elitism=epoch_elitism,
+        hist_gens = self._history_gens()
+        self._emit(
+            "islands_start", islands=len(self._populations), n=int(n),
+            m=int(m), pct=float(pct),
         )
+        t0 = time.perf_counter()
+        with _tl.span("run_islands"):
+            out = run_islands_stacked(
+                breed,
+                self._require_objective(),
+                stacked,
+                self.next_key(),
+                n=n,
+                m=m,
+                pct=pct,
+                target=target,
+                topology=self.config.migration_topology,
+                mesh=mesh,
+                runner_cache=self._compiled,
+                mparams=self._mutate_params(),
+                elitism=epoch_elitism,
+                history_gens=hist_gens,
+            )
+        genomes, scores, gens = out[:3]
         for i in range(len(self._populations)):
             # genomes[i] on a jax.Array stays on device (no host round trip).
             self._populations[i] = Population(
                 genomes=genomes[i], scores=scores[i]
             )
             self._staged[i] = None
+        hist = None
+        if hist_gens is not None:
+            # One GLOBAL history (stats across all islands) shared by
+            # every participating population's slot.
+            hist = _tl.History(out[3], gens)
+        # Most-recent-run semantics, as in run(): telemetry-off islands
+        # clear any stale per-population buffers.
+        for i in range(len(self._populations)):
+            self._history[i] = hist
         self._validate("run_islands")
         # Metrics listeners run after the state swap (see run()).
+        seconds = time.perf_counter() - t0
         self.metrics.record_run(
-            gens, sum(p.size for p in self._populations),
-            time.perf_counter() - t0,
+            gens, sum(p.size for p in self._populations), seconds
         )
+        if self._event_log() is not None:
+            from libpga_tpu.parallel.mesh import global_max
+
+            self._emit(
+                "islands_end", generations=gens, seconds=seconds,
+                best=float(global_max(scores, mesh)),
+            )
+        self._check_stall_alert(hist)
         return gens
 
     def _run_islands_hetero(

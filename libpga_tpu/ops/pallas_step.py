@@ -1873,15 +1873,25 @@ def make_pallas_multigen(
     return breed
 
 
-def _multigen_run_loop(obj, bm, pop_size, genome_len, T, donate):
+def _multigen_run_loop(obj, bm, pop_size, genome_len, T, donate,
+                       history_gens=None):
     """Jitted run loop over the multi-generation breed ``bm``: launches
     chunks of ``min(T, n - gen)`` sub-generations until ``n`` or the
     target is reached. Same contract as the one-generation loop; the
     generation count still lands exactly on ``n`` (the runtime ``steps``
     input serves the remainder), and a target hit reports at launch
     granularity (its achiever is preserved by the kernel's group
-    freeze)."""
+    freeze).
+
+    ``history_gens`` set = telemetry: the loop carries the stats buffer
+    and the fn returns it as a trailing output. Rows land at LAUNCH
+    granularity — each launch's ``steps`` generation rows are filled
+    with the launch-end stats (the kernel keeps demes VMEM-resident
+    between sub-generations, so per-sub-generation stats don't exist
+    outside the kernel) and the stall counter advances by the whole
+    launch width. Disabled path untouched."""
     from libpga_tpu.ops.evaluate import evaluate as _evaluate
+    from libpga_tpu.utils import telemetry as _tl
 
     P, L, Pp, Lp = pop_size, genome_len, bm.Pp, bm.Lp
 
@@ -1890,28 +1900,64 @@ def _multigen_run_loop(obj, bm, pop_size, genome_len, T, donate):
             return s
         return jnp.where(jnp.arange(Pp, dtype=jnp.int32) < P, s, -jnp.inf)
 
-    def run_loop(genomes, key, n, target, mparams):
-        gp = genomes.astype(bm.gene_dtype)
-        if Lp != L or Pp != P:
-            gp = jnp.pad(gp, ((0, Pp - P), (0, Lp - L)))
-        scores0 = masked_tail(
-            jnp.pad(_evaluate(obj, gp[:P, :L]), (0, Pp - P))
-        )
+    if history_gens is None:
 
-        def cond(carry):
-            g, s, k, gen = carry
-            return jnp.logical_and(gen < n, jnp.max(s) < target)
+        def run_loop(genomes, key, n, target, mparams):
+            gp = genomes.astype(bm.gene_dtype)
+            if Lp != L or Pp != P:
+                gp = jnp.pad(gp, ((0, Pp - P), (0, Lp - L)))
+            scores0 = masked_tail(
+                jnp.pad(_evaluate(obj, gp[:P, :L]), (0, Pp - P))
+            )
 
-        def body(carry):
-            g, s, k, gen = carry
-            k, sub = jax.random.split(k)
-            steps = jnp.minimum(jnp.int32(T), n - gen)
-            g2, s2 = bm.padded(g, s, sub, steps, mparams, target)
-            return (g2, s2, k, gen + steps)
+            def cond(carry):
+                g, s, k, gen = carry
+                return jnp.logical_and(gen < n, jnp.max(s) < target)
 
-        init = (gp, scores0, key, jnp.int32(0))
-        g, s, k, gens = jax.lax.while_loop(cond, body, init)
-        return g[:P, :L], s[:P], gens
+            def body(carry):
+                g, s, k, gen = carry
+                k, sub = jax.random.split(k)
+                steps = jnp.minimum(jnp.int32(T), n - gen)
+                g2, s2 = bm.padded(g, s, sub, steps, mparams, target)
+                return (g2, s2, k, gen + steps)
+
+            init = (gp, scores0, key, jnp.int32(0))
+            g, s, k, gens = jax.lax.while_loop(cond, body, init)
+            return g[:P, :L], s[:P], gens
+
+    else:
+
+        def run_loop(genomes, key, n, target, mparams):
+            gp = genomes.astype(bm.gene_dtype)
+            if Lp != L or Pp != P:
+                gp = jnp.pad(gp, ((0, Pp - P), (0, Lp - L)))
+            scores0 = masked_tail(
+                jnp.pad(_evaluate(obj, gp[:P, :L]), (0, Pp - P))
+            )
+
+            def cond(carry):
+                g, s, k, gen, best, stall, buf = carry
+                return jnp.logical_and(gen < n, jnp.max(s) < target)
+
+            def body(carry):
+                g, s, k, gen, best, stall, buf = carry
+                k, sub = jax.random.split(k)
+                steps = jnp.minimum(jnp.int32(T), n - gen)
+                g2, s2 = bm.padded(g, s, sub, steps, mparams, target)
+                # Stats on the live [:P] rows only (the pad tail carries
+                # -inf scores / zero genes).
+                row, best, stall = _tl.stats_row(
+                    g2[:P, :L], s2[:P], best, stall, step=steps
+                )
+                buf = _tl.fill_rows(buf, gen, gen + steps, row)
+                return (g2, s2, k, gen + steps, best, stall, buf)
+
+            init = (
+                gp, scores0, key, jnp.int32(0), jnp.max(scores0),
+                jnp.int32(0), _tl.history_init(history_gens),
+            )
+            g, s, k, gens, _, _, buf = jax.lax.while_loop(cond, body, init)
+            return g[:P, :L], s[:P], gens, buf
 
     return jax.jit(run_loop, donate_argnums=(0,) if donate else ())
 
@@ -1931,6 +1977,7 @@ def make_pallas_run(
     donate: bool = True,
     gene_dtype=jnp.float32,
     generations_per_launch: Optional[int] = None,
+    history_gens: Optional[int] = None,
 ) -> Optional[Callable]:
     """Build a per-shape factory for the fused run loop used by ``PGA.run``:
     ``build(pop_size, genome_len)`` returns a jitted
@@ -1940,6 +1987,12 @@ def make_pallas_run(
     None when unsupported (non-TPU backend, tournament size out of the
     kernel's 1..16 range, or per-shape inside the factory) — the engine
     then falls back to the XLA path.
+
+    ``history_gens`` set = telemetry: the host-level while_loop around
+    the kernel launches carries a ``(history_gens, NUM_STATS)`` stats
+    buffer (written from the kernel-returned scores — the kernel itself
+    is untouched) and the built fn returns it as a trailing output. The
+    disabled loops below are byte-identical to the pre-telemetry code.
 
     ``generations_per_launch`` (T): generations bred per kernel launch.
     None = auto (``multigen_default_t`` when the objective fuses, else
@@ -2002,7 +2055,8 @@ def make_pallas_run(
             )
             if bm is not None:
                 return _multigen_run_loop(
-                    obj, bm, pop_size, genome_len, T, donate
+                    obj, bm, pop_size, genome_len, T, donate,
+                    history_gens=history_gens,
                 )
             if generations_per_launch is not None:
                 # An EXPLICIT T > 1 expresses intent (e.g. a T-sweep
@@ -2036,39 +2090,85 @@ def make_pallas_run(
                 return s
             return jnp.where(jnp.arange(Pp, dtype=jnp.int32) < P, s, -jnp.inf)
 
-        def run_loop(genomes, key, n, target, mparams):
-            # Pad once; the loop carries the deme-aligned (Pp, Lp) matrix.
-            # Evaluation reads the [:P, :L] view (the slice fuses into the
-            # objective's reduction — nothing materializes).
-            gp = genomes.astype(gene_dtype)
-            if Lp != L or Pp != P:
-                gp = jnp.pad(gp, ((0, Pp - P), (0, Lp - L)))
-            scores0 = masked_tail(
-                jnp.pad(_evaluate(obj, gp[:P, :L]), (0, Pp - P))
-            )
+        if history_gens is None:
 
-            def cond(carry):
-                g, s, k, gen = carry
-                return jnp.logical_and(gen < n, jnp.max(s) < target)
+            def run_loop(genomes, key, n, target, mparams):
+                # Pad once; the loop carries the deme-aligned (Pp, Lp)
+                # matrix. Evaluation reads the [:P, :L] view (the slice
+                # fuses into the objective's reduction — nothing
+                # materializes).
+                gp = genomes.astype(gene_dtype)
+                if Lp != L or Pp != P:
+                    gp = jnp.pad(gp, ((0, Pp - P), (0, Lp - L)))
+                scores0 = masked_tail(
+                    jnp.pad(_evaluate(obj, gp[:P, :L]), (0, Pp - P))
+                )
 
-            def body(carry):
-                g, s, k, gen = carry
-                k, sub = jax.random.split(k)
-                if breed.fused:
-                    # tail already -inf; elitism applied inside breed
-                    g2, s2 = breed.padded(g, s, sub, mparams)
-                else:
-                    g2 = breed.padded(g, s, sub, mparams)
-                    s2 = masked_tail(jnp.pad(
-                        _evaluate(obj, g2[:P, :L]), (0, Pp - P)
-                    ))
-                    if elitism > 0:
-                        g2, s2 = _carry_elites(g, s, g2, s2, elitism)
-                return (g2, s2, k, gen + 1)
+                def cond(carry):
+                    g, s, k, gen = carry
+                    return jnp.logical_and(gen < n, jnp.max(s) < target)
 
-            init = (gp, scores0, key, jnp.int32(0))
-            g, s, k, gens = jax.lax.while_loop(cond, body, init)
-            return g[:P, :L], s[:P], gens
+                def body(carry):
+                    g, s, k, gen = carry
+                    k, sub = jax.random.split(k)
+                    if breed.fused:
+                        # tail already -inf; elitism applied inside breed
+                        g2, s2 = breed.padded(g, s, sub, mparams)
+                    else:
+                        g2 = breed.padded(g, s, sub, mparams)
+                        s2 = masked_tail(jnp.pad(
+                            _evaluate(obj, g2[:P, :L]), (0, Pp - P)
+                        ))
+                        if elitism > 0:
+                            g2, s2 = _carry_elites(g, s, g2, s2, elitism)
+                    return (g2, s2, k, gen + 1)
+
+                init = (gp, scores0, key, jnp.int32(0))
+                g, s, k, gens = jax.lax.while_loop(cond, body, init)
+                return g[:P, :L], s[:P], gens
+
+        else:
+            from libpga_tpu.utils import telemetry as _tl
+
+            def run_loop(genomes, key, n, target, mparams):
+                gp = genomes.astype(gene_dtype)
+                if Lp != L or Pp != P:
+                    gp = jnp.pad(gp, ((0, Pp - P), (0, Lp - L)))
+                scores0 = masked_tail(
+                    jnp.pad(_evaluate(obj, gp[:P, :L]), (0, Pp - P))
+                )
+
+                def cond(carry):
+                    g, s, k, gen, best, stall, buf = carry
+                    return jnp.logical_and(gen < n, jnp.max(s) < target)
+
+                def body(carry):
+                    g, s, k, gen, best, stall, buf = carry
+                    k, sub = jax.random.split(k)
+                    if breed.fused:
+                        g2, s2 = breed.padded(g, s, sub, mparams)
+                    else:
+                        g2 = breed.padded(g, s, sub, mparams)
+                        s2 = masked_tail(jnp.pad(
+                            _evaluate(obj, g2[:P, :L]), (0, Pp - P)
+                        ))
+                        if elitism > 0:
+                            g2, s2 = _carry_elites(g, s, g2, s2, elitism)
+                    # Stats on the live [:P] rows (pad tail is -inf/0).
+                    row, best, stall = _tl.stats_row(
+                        g2[:P, :L], s2[:P], best, stall
+                    )
+                    buf = _tl.write_row(buf, gen, row)
+                    return (g2, s2, k, gen + 1, best, stall, buf)
+
+                init = (
+                    gp, scores0, key, jnp.int32(0), jnp.max(scores0),
+                    jnp.int32(0), _tl.history_init(history_gens),
+                )
+                g, s, k, gens, _, _, buf = jax.lax.while_loop(
+                    cond, body, init
+                )
+                return g[:P, :L], s[:P], gens, buf
 
         return jax.jit(run_loop, donate_argnums=(0,) if donate else ())
 

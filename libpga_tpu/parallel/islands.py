@@ -35,6 +35,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from libpga_tpu.ops.evaluate import evaluate as _evaluate
 from libpga_tpu.ops.pallas_step import _carry_elites
+from libpga_tpu.utils import telemetry as _tl
 
 
 def make_island_epoch(
@@ -324,7 +325,7 @@ def _shard_host_array(arr: jax.Array, sharding: NamedSharding) -> jax.Array:
 
 def build_local_runner(
     breed: Callable, obj: Callable, *, m: int, count: int, topology: str,
-    elitism: int = 0,
+    elitism: int = 0, history_gens: Optional[int] = None,
 ) -> Callable:
     """Single-device (vmapped-islands) epoch loop.
 
@@ -335,31 +336,80 @@ def build_local_runner(
     ``takes_params`` marker. ``elitism`` is the epoch-level elite carry
     for breeds that don't handle it themselves (see
     :func:`make_island_epoch`).
+
+    ``history_gens`` set = telemetry mode: the loop ADDITIONALLY takes
+    ``(gen0, best0, stall0, hist)`` after ``target`` and returns
+    ``(genomes, scores, epochs_done, best, stall, hist)``. One GLOBAL
+    stats row per migration epoch (interval-end values fill that epoch's
+    ``m`` generation rows of the ``(history_gens, NUM_STATS)`` buffer,
+    offset by ``gen0``) — written on device inside the loop carry; the
+    explicit best/stall threading lets the remainder-generations call
+    continue the same buffer and stall counter. The default path below
+    is untouched (telemetry off traces to the exact pre-telemetry
+    jaxpr).
     """
     takes_params = getattr(breed, "takes_params", False)
     vepoch = _make_vepoch(breed, obj, m, elitism)
 
-    def loop(genomes, island_keys, mig_key, num_epochs, target, mparams=None):
-        scores = jax.vmap(lambda gi: _evaluate(obj, gi))(genomes)
+    if history_gens is None:
 
-        def cond(c):
-            g, s, keys, mk, e = c
-            return jnp.logical_and(e < num_epochs, jnp.max(s) < target)
+        def loop(genomes, island_keys, mig_key, num_epochs, target,
+                 mparams=None):
+            scores = jax.vmap(lambda gi: _evaluate(obj, gi))(genomes)
 
-        def body(c):
-            g, s, keys, mk, e = c
-            if takes_params:
-                g, s, keys = vepoch(g, s, keys, mparams)
-            else:
-                g, s, keys = vepoch(g, s, keys)
-            if count > 0:
-                mk, sub = jax.random.split(mk)
-                g, s = _migrate_local(g, s, sub, count, topology)
-            return (g, s, keys, mk, e + 1)
+            def cond(c):
+                g, s, keys, mk, e = c
+                return jnp.logical_and(e < num_epochs, jnp.max(s) < target)
 
-        init = (genomes, scores, island_keys, mig_key, jnp.int32(0))
-        g, s, keys, mk, e = jax.lax.while_loop(cond, body, init)
-        return g, s, e
+            def body(c):
+                g, s, keys, mk, e = c
+                if takes_params:
+                    g, s, keys = vepoch(g, s, keys, mparams)
+                else:
+                    g, s, keys = vepoch(g, s, keys)
+                if count > 0:
+                    mk, sub = jax.random.split(mk)
+                    g, s = _migrate_local(g, s, sub, count, topology)
+                return (g, s, keys, mk, e + 1)
+
+            init = (genomes, scores, island_keys, mig_key, jnp.int32(0))
+            g, s, keys, mk, e = jax.lax.while_loop(cond, body, init)
+            return g, s, e
+
+    else:
+
+        def loop(genomes, island_keys, mig_key, num_epochs, target,
+                 gen0, best0, stall0, hist, mparams=None):
+            scores = jax.vmap(lambda gi: _evaluate(obj, gi))(genomes)
+
+            def cond(c):
+                g, s, keys, mk, e, best, stall, buf = c
+                return jnp.logical_and(e < num_epochs, jnp.max(s) < target)
+
+            def body(c):
+                g, s, keys, mk, e, best, stall, buf = c
+                if takes_params:
+                    g, s, keys = vepoch(g, s, keys, mparams)
+                else:
+                    g, s, keys = vepoch(g, s, keys)
+                if count > 0:
+                    mk, sub = jax.random.split(mk)
+                    g, s = _migrate_local(g, s, sub, count, topology)
+                row, best, stall = _tl.island_stats_row(
+                    g, s, best, stall, step=m
+                )
+                start = gen0 + e * m
+                buf = _tl.fill_rows(buf, start, start + m, row)
+                return (g, s, keys, mk, e + 1, best, stall, buf)
+
+            init = (
+                genomes, scores, island_keys, mig_key, jnp.int32(0),
+                best0, stall0, hist,
+            )
+            g, s, keys, mk, e, best, stall, buf = jax.lax.while_loop(
+                cond, body, init
+            )
+            return g, s, e, best, stall, buf
 
     jitted = jax.jit(loop)
 
@@ -423,28 +473,37 @@ def build_sharded_runner(
     mesh: Mesh,
     axis_name: str = "islands",
     elitism: int = 0,
+    history_gens: Optional[int] = None,
 ) -> Callable:
     """shard_map'd epoch loop: islands split over the mesh axis, migration
     over ICI. Same signature as :func:`build_local_runner`'s return
     (including the trailing ``mparams`` for a ``takes_params`` breed —
-    replicated across the mesh)."""
+    replicated across the mesh, and the telemetry extras when
+    ``history_gens`` is set: every shard computes the identical global
+    stats row via pmax/pmean collectives, so the history buffer stays
+    replicated — one all-reduce of five scalars per epoch, not per
+    generation)."""
     takes_params = getattr(breed, "takes_params", False)
     # Same flattened-rank-sort hoist as the local runner, applied to
     # each shard's local islands.
     vepoch = _make_vepoch(breed, obj, m, elitism)
+    telemetry = history_gens is not None
 
     def shard_body(genomes, island_keys, mig_key, num_epochs, target,
-                   mparams=None):
+                   *rest):
+        if telemetry:
+            gen0, best_t0, stall0, hist = rest[:4]
+            rest = rest[4:]
+        mparams = rest[0] if rest else None
         # genomes: (I_loc, S, L); island_keys: (I_loc,); mig_key replicated.
         scores = jax.vmap(lambda gi: _evaluate(obj, gi))(genomes)
         best0 = jax.lax.pmax(jnp.max(scores), axis_name)
 
         def cond(c):
-            g, s, keys, mk, e, best = c
-            return jnp.logical_and(e < num_epochs, best < target)
+            return jnp.logical_and(c[4] < num_epochs, c[5] < target)
 
         def body(c):
-            g, s, keys, mk, e, best = c
+            g, s, keys, mk, e, best = c[:6]
             if takes_params:
                 g, s, keys = vepoch(g, s, keys, mparams)
             else:
@@ -459,20 +518,37 @@ def build_sharded_runner(
             # Computed AFTER migration, which only replaces worst-E, so the
             # carried best is still present in some island.
             best = jax.lax.pmax(jnp.max(s), axis_name)
-            return (g, s, keys, mk, e + 1, best)
+            if not telemetry:
+                return (g, s, keys, mk, e + 1, best)
+            best_t, stall, buf = c[6:]
+            row, best_t, stall = _tl.island_stats_row(
+                g, s, best_t, stall, step=m, axis_name=axis_name
+            )
+            start = gen0 + e * m
+            buf = _tl.fill_rows(buf, start, start + m, row)
+            return (g, s, keys, mk, e + 1, best, best_t, stall, buf)
 
         init = (genomes, scores, island_keys, mig_key, jnp.int32(0), best0)
-        g, s, keys, mk, e, best = jax.lax.while_loop(cond, body, init)
-        return g, s, e
+        if telemetry:
+            init = init + (best_t0, stall0, hist)
+        out = jax.lax.while_loop(cond, body, init)
+        if not telemetry:
+            return out[0], out[1], out[4]
+        return out[0], out[1], out[4], out[6], out[7], out[8]
 
     from libpga_tpu.utils.compat import shard_map as _shard_map
 
     base_specs = (P(axis_name, None, None), P(axis_name), P(), P(), P())
+    if telemetry:
+        base_specs = base_specs + (P(), P(), P(), P())
+    out_specs = (P(axis_name, None, None), P(axis_name, None), P())
+    if telemetry:
+        out_specs = out_specs + (P(), P(), P())
     mapped = _shard_map(
         shard_body,
         mesh=mesh,
         in_specs=base_specs + ((P(),) if takes_params else ()),
-        out_specs=(P(axis_name, None, None), P(axis_name, None), P()),
+        out_specs=out_specs,
     )
     jitted = jax.jit(mapped)
 
@@ -493,14 +569,16 @@ def build_runner(
     mesh: Optional[Mesh] = None,
     axis_name: str = "islands",
     elitism: int = 0,
+    history_gens: Optional[int] = None,
 ) -> Callable:
     if mesh is None:
         return build_local_runner(
-            breed, obj, m=m, count=count, topology=topology, elitism=elitism
+            breed, obj, m=m, count=count, topology=topology, elitism=elitism,
+            history_gens=history_gens,
         )
     return build_sharded_runner(
         breed, obj, m=m, count=count, topology=topology, mesh=mesh,
-        axis_name=axis_name, elitism=elitism,
+        axis_name=axis_name, elitism=elitism, history_gens=history_gens,
     )
 
 
@@ -523,6 +601,7 @@ def run_islands_stacked(
     runner_cache: Optional[dict] = None,
     mparams: Optional[jax.Array] = None,
     elitism: int = 0,
+    history_gens: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array, int]:
     """Run the island GA on a stacked ``(I, S, L)`` population array.
 
@@ -537,7 +616,11 @@ def run_islands_stacked(
     :func:`make_island_epoch`) — leave 0 for XLA breeds built with
     ``make_breed(..., elitism=...)`` and fused Pallas breeds.
 
-    Returns ``(genomes (I,S,L), scores (I,S), generations_executed)``.
+    Returns ``(genomes (I,S,L), scores (I,S), generations_executed)``;
+    with ``history_gens`` set, a trailing on-device history buffer
+    (``(history_gens, telemetry.NUM_STATS)``, epoch-granularity rows —
+    the remainder-generations call continues the same buffer and stall
+    counter) making it a 4-tuple.
     """
     I, S, L = stacked.shape
     if m < 1:
@@ -558,19 +641,22 @@ def run_islands_stacked(
             f"({mesh.devices.size})"
         )
 
-    def cached(tag, mm, build):
+    def cached(tag, mm, cc, build):
         if runner_cache is None:
             return build()
-        ck = (tag, mm, count, topology, mesh, axis_name, breed, obj, elitism)
+        ck = (
+            tag, mm, cc, topology, mesh, axis_name, breed, obj, elitism,
+            history_gens,
+        )
         if ck not in runner_cache:
             runner_cache[ck] = build()
         return runner_cache[ck]
 
     runner = cached(
-        "main", m,
+        "main", m, count,
         lambda: build_runner(
             breed, obj, m=m, count=count, topology=topology, mesh=mesh,
-            axis_name=axis_name, elitism=elitism,
+            axis_name=axis_name, elitism=elitism, history_gens=history_gens,
         ),
     )
     if mesh is not None:
@@ -591,9 +677,22 @@ def run_islands_stacked(
         extra = (mparams,)
     else:
         extra = ()
-    genomes, scores, epochs_done = runner(
-        stacked, island_keys, mig_key, jnp.int32(epochs), tgt, *extra
-    )
+    if history_gens is not None:
+        # best0 = -inf so the first epoch registers as an improvement
+        # (stall 0) — the telemetry carry, threaded through both calls.
+        tstate = (
+            jnp.int32(0), jnp.float32(-jnp.inf), jnp.int32(0),
+            _tl.history_init(history_gens),
+        )
+        genomes, scores, epochs_done, best_t, stall_t, hist = runner(
+            stacked, island_keys, mig_key, jnp.int32(epochs), tgt,
+            *tstate, *extra,
+        )
+    else:
+        hist = None
+        genomes, scores, epochs_done = runner(
+            stacked, island_keys, mig_key, jnp.int32(epochs), tgt, *extra
+        )
     gens = int(epochs_done) * m
 
     # Remainder generations (< m) run without a following migration. Only
@@ -602,10 +701,11 @@ def run_islands_stacked(
 
     if rem > 0 and (target is None or global_max(scores, mesh) < float(tgt)):
         rem_runner = cached(
-            "rem", rem,
+            "rem", rem, 0,
             lambda: build_runner(
                 breed, obj, m=rem, count=0, topology=topology, mesh=mesh,
                 axis_name=axis_name, elitism=elitism,
+                history_gens=history_gens,
             ),
         )
         rem_keys = jax.random.split(jax.random.fold_in(mig_key, 7), I)
@@ -613,9 +713,17 @@ def run_islands_stacked(
             rem_keys = _shard_host_array(
                 rem_keys, NamedSharding(mesh, P(axis_name))
             )
-        genomes, scores, _ = rem_runner(
+        rem_args = (
             genomes, rem_keys, jax.random.fold_in(mig_key, 11),
-            jnp.int32(1), jnp.float32(jnp.inf), *extra
+            jnp.int32(1), jnp.float32(jnp.inf),
         )
+        if history_gens is not None:
+            genomes, scores, _, best_t, stall_t, hist = rem_runner(
+                *rem_args, jnp.int32(gens), best_t, stall_t, hist, *extra
+            )
+        else:
+            genomes, scores, _ = rem_runner(*rem_args, *extra)
         gens += rem
+    if history_gens is not None:
+        return genomes, scores, gens, hist
     return genomes, scores, gens

@@ -1,5 +1,10 @@
 from libpga_tpu.utils.metrics import Metrics
 from libpga_tpu.utils import checkpoint
 from libpga_tpu.utils import profiling
+from libpga_tpu.utils import telemetry
+from libpga_tpu.utils.telemetry import TelemetryConfig, History
 
-__all__ = ["Metrics", "checkpoint", "profiling"]
+__all__ = [
+    "Metrics", "checkpoint", "profiling", "telemetry", "TelemetryConfig",
+    "History",
+]

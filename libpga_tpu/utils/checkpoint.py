@@ -148,6 +148,16 @@ def save(pga: "PGA", path: str) -> None:
     ``<path>.proc<k>.npz`` with its addressable shards; no process ever
     touches a non-addressable buffer.
     """
+    from libpga_tpu.utils import telemetry as _tl
+
+    with _tl.span("checkpoint"):
+        _save(pga, path)
+    emit = getattr(pga, "_emit", None)
+    if emit is not None:
+        emit("checkpoint_save", path=path, seq=getattr(pga, "_ckpt_seq", 0))
+
+
+def _save(pga: "PGA", path: str) -> None:
     # Monotonic per-solver save sequence: every process runs the same
     # engine calls, so the counter is identical across the fleet — at
     # restore it catches a checkpoint torn by preemption mid-save (one
@@ -290,6 +300,7 @@ def restore(pga: "PGA", path: str) -> None:
             for i in range(n)
         ]
         pga._staged = [None] * n
+        pga._history = [None] * n
     finally:
         for d in datas:
             d.close()
@@ -319,3 +330,4 @@ def _restore_single(pga: "PGA", path: str) -> None:
             for i in range(n)
         ]
         pga._staged = [None] * n
+        pga._history = [None] * n

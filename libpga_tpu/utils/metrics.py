@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
+import warnings
 from typing import Callable, List, Optional
 
 
@@ -22,7 +23,10 @@ class RunRecord:
 
     @property
     def generations_per_sec(self) -> float:
-        return self.generations / self.seconds if self.seconds > 0 else float("inf")
+        """Generations per wall second; 0.0 when no time elapsed (a
+        sub-resolution timer must read as "no rate", not inf — inf
+        poisons any aggregate a consumer computes over records)."""
+        return self.generations / self.seconds if self.seconds > 0 else 0.0
 
 
 class Metrics:
@@ -59,10 +63,23 @@ class Metrics:
             timestamp=time.time(),
         )
         self.runs.append(rec)
-        for fn in list(self._listeners):
-            fn(rec)
-        if self.on_run is not None:
-            self.on_run(rec)
+        # Listener isolation: observers must never abort the run they
+        # observe (a raising logger used to propagate out of PGA.run
+        # AFTER the run completed, losing the result). Each consumer is
+        # isolated; failures surface as warnings and the listener stays
+        # registered (a transient failure shouldn't silently end a
+        # checkpointer's subscription).
+        for fn in list(self._listeners) + (
+            [self.on_run] if self.on_run is not None else []
+        ):
+            try:
+                fn(rec)
+            except Exception as e:
+                warnings.warn(
+                    f"metrics listener {fn!r} raised {e!r} — ignored "
+                    "(listeners must not abort the run)",
+                    stacklevel=2,
+                )
         return rec
 
     @property
@@ -76,4 +93,4 @@ class Metrics:
     @property
     def generations_per_sec(self) -> float:
         s = self.total_seconds
-        return self.total_generations / s if s > 0 else float("inf")
+        return self.total_generations / s if s > 0 else 0.0

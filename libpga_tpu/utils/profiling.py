@@ -65,7 +65,9 @@ def best_ms_per_unit(
     select the try where noise shrank the difference).
     ``units_per_call`` scales a call that performs several units (e.g. a
     multi-generation launch breeding T generations). NaN when the
-    subtraction is degenerate.
+    subtraction is degenerate — the drop marker
+    :func:`interleaved_medians` COUNTS AND REPORTS (``.dropped``), so a
+    published median always states the n it actually rests on.
     """
     t_lo, t_hi = [], []
     for _ in range(tries):
@@ -80,11 +82,23 @@ def best_ms_per_unit(
     return 1000.0 * delta / units if delta > 0 else float("nan")
 
 
+class InterleavedMedians(dict):
+    """``{runner: median}`` plus the sample accounting a decision-grade
+    median must state: ``.n[runner]`` = samples the median rests on,
+    ``.dropped[runner]`` = degenerate (NaN) samples excluded. Plain-dict
+    compatible, so existing callers are unaffected."""
+
+    def __init__(self):
+        super().__init__()
+        self.n: Dict[str, int] = {}
+        self.dropped: Dict[str, int] = {}
+
+
 def interleaved_medians(
     runners: Dict[str, Callable[[int], None]],
     rounds: int = 5,
     sample: Optional[Callable[[Callable], float]] = None,
-) -> Dict[str, float]:
+) -> "InterleavedMedians":
     """Per-runner MEDIAN of ``sample`` over ``rounds`` interleaved
     rounds with a fixed per-round ordering.
 
@@ -93,23 +107,38 @@ def interleaved_medians(
     than the effects under comparison — only interleaved A/Bs are
     decision-grade. This is that protocol as a reusable primitive;
     ``sample`` defaults to :func:`best_ms_per_unit`. NaN samples
-    (degenerate subtractions) are dropped from the median.
+    (degenerate subtractions) are excluded from the median — and
+    COUNTED: the result's ``.n``/``.dropped`` attributes state each
+    runner's surviving/excluded sample counts, and any drop emits a
+    warning (a median over 2 of 5 rounds is a much weaker claim than
+    the number alone suggests; silently shrinking n hid that).
     """
+    import warnings
+
     if sample is None:
         sample = best_ms_per_unit
     samples: Dict[str, list] = {name: [] for name in runners}
     for _ in range(rounds):
         for name, run in runners.items():
             samples[name].append(sample(run))
-    out = {}
+    out = InterleavedMedians()
     for name, xs in samples.items():
-        xs = sorted(x for x in xs if x == x)
-        if not xs:
+        kept = sorted(x for x in xs if x == x)
+        out.n[name] = len(kept)
+        out.dropped[name] = len(xs) - len(kept)
+        if out.dropped[name]:
+            warnings.warn(
+                f"interleaved_medians: runner {name!r} median rests on "
+                f"n={len(kept)} of {len(xs)} rounds "
+                f"({out.dropped[name]} degenerate sample(s) dropped)",
+                stacklevel=2,
+            )
+        if not kept:
             out[name] = float("nan")
             continue
-        mid = len(xs) // 2
+        mid = len(kept) // 2
         out[name] = (
-            xs[mid] if len(xs) % 2 else 0.5 * (xs[mid - 1] + xs[mid])
+            kept[mid] if len(kept) % 2 else 0.5 * (kept[mid - 1] + kept[mid])
         )
     return out
 

@@ -99,6 +99,15 @@ def test_capi_compat_full_abi(built_shim):
     assert "compat best sum" in out
 
 
+def test_capi_telemetry_history(built_shim):
+    """pga_set_telemetry + pga_get_history: the on-device per-generation
+    history is reachable from C — shape, NaN-free rows, convergence
+    recorded, and the disabled/NULL surfaces behave (ISSUE 2: history
+    reachable from both Python and the C ABI)."""
+    out = _run(built_shim, "test_telemetry")
+    assert "telemetry history:" in out
+
+
 def test_capi_selection_strategies(built_shim):
     """pga_set_selection: TRUNCATION and LINEAR_RANK converge from C;
     out-of-range params and unknown enum values return -1."""

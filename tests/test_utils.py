@@ -37,6 +37,87 @@ def test_trace_writes_profile(tmp_path):
     assert any(tmp_path.rglob("*")), "no trace output written"
 
 
+def test_listener_add_remove_and_isolation():
+    """Listener registry: add/remove round-trips, and a raising listener
+    cannot abort the run that notifies it — it warns, later listeners
+    still fire, and the record is kept (satellite fix: one bad logger
+    used to propagate out of PGA.run AFTER the run completed)."""
+    import warnings
+
+    from libpga_tpu.utils.metrics import Metrics
+
+    m = Metrics()
+    seen = []
+
+    def bad(rec):
+        raise RuntimeError("boom")
+
+    def good(rec):
+        seen.append(rec)
+
+    m.add_listener(bad)
+    m.add_listener(good)
+    m.on_run = bad
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        rec = m.record_run(3, 10, 0.5)
+    assert len(seen) == 1 and seen[0] is rec
+    assert len(m.runs) == 1
+    assert sum("boom" in str(x.message) for x in w) == 2  # listener + on_run
+    # removal: no further notifications; removing twice is a no-op
+    m.remove_listener(bad)
+    m.remove_listener(good)
+    m.remove_listener(good)
+    m.on_run = None
+    m.record_run(1, 10, 0.5)
+    assert len(seen) == 1
+
+
+def test_raising_listener_does_not_abort_engine_run():
+    pga, _ = _solver()
+    pga.metrics.add_listener(
+        lambda rec: (_ for _ in ()).throw(RuntimeError("observer bug"))
+    )
+    import warnings
+
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter("always")
+        assert pga.run(2) == 2  # the run survives its observer
+
+
+def test_generations_per_sec_zero_seconds_is_zero():
+    """A sub-resolution timer must read 0.0 gens/sec, not inf (satellite
+    fix: inf poisoned aggregates over records)."""
+    from libpga_tpu.utils.metrics import Metrics, RunRecord
+
+    rec = RunRecord(generations=5, population_size=10, seconds=0.0,
+                    timestamp=0.0)
+    assert rec.generations_per_sec == 0.0
+    assert Metrics().generations_per_sec == 0.0
+
+
+def test_interleaved_medians_counts_dropped_samples():
+    """Degenerate (NaN) samples are excluded AND accounted: the result
+    carries per-runner n/dropped and a warning names the shrunken n
+    (satellite fix: silently dropping samples hid how weak a median
+    was)."""
+    import warnings
+
+    # sample() pulls from each runner's scripted sequence; runner "a"
+    # hits one degenerate round.
+    vals = {"a": iter([1.0, float("nan"), 3.0]), "b": iter([2.0, 2.0, 2.0])}
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        med = profiling.interleaved_medians(
+            {"a": "a", "b": "b"}, rounds=3,
+            sample=lambda name: next(vals[name]),
+        )
+    assert med["a"] == 2.0 and med["b"] == 2.0
+    assert med.n == {"a": 2, "b": 3}
+    assert med.dropped == {"a": 1, "b": 0}
+    assert any("n=2 of 3" in str(x.message) for x in w)
+
+
 def test_auto_checkpointer_saves_and_resumes(tmp_path):
     path = str(tmp_path / "state.npz")
     pga, handle = _solver(seed=7)

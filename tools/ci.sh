@@ -1,0 +1,57 @@
+#!/usr/bin/env bash
+# CI entry point: tier-1 tests + the observability gates.
+#
+#   tools/ci.sh          # CPU: tier-1, trace-span smoke, event-log schema
+#
+# Three stages, all CPU-runnable (no chip needed):
+#   1. tools/run_tier1.sh       — the exact ROADMAP.md tier-1 command;
+#   2. tools/trace_smoke.py     — capture a profiler trace, assert every
+#                                 pga/<stage> span exists;
+#   3. event-log schema check   — run a short telemetry-enabled solve
+#                                 emitting a JSONL event log, then
+#                                 validate every record against
+#                                 utils/telemetry's versioned schema.
+# Exits nonzero on the first failing stage.
+set -e
+cd "$(dirname "$0")/.."
+
+echo "== ci: tier-1 =="
+bash tools/run_tier1.sh
+
+echo "== ci: trace-span smoke =="
+JAX_PLATFORMS=cpu python tools/trace_smoke.py
+
+echo "== ci: event-log schema =="
+JAX_PLATFORMS=cpu python - <<'PY'
+import sys
+import tempfile
+
+from libpga_tpu import PGA, PGAConfig, TelemetryConfig
+from libpga_tpu.utils import telemetry
+
+path = tempfile.mktemp(suffix=".jsonl", prefix="pga-ci-events-")
+pga = PGA(
+    seed=0,
+    config=PGAConfig(
+        telemetry=TelemetryConfig(
+            history_gens=32, events_path=path, stall_alert_gens=1000
+        )
+    ),
+)
+pga.create_population(256, 16)
+pga.create_population(256, 16)
+pga.set_objective("onemax")
+pga.run(5)
+pga.migrate(0.1)
+pga.run_islands(4, 2, 0.1)
+
+records = telemetry.validate_log(path)
+kinds = {r["event"] for r in records}
+need = {"compile", "run_start", "run_record", "run_end", "migration",
+        "islands_start", "islands_end"}
+missing = need - kinds
+if missing:
+    sys.exit(f"event log missing kinds: {sorted(missing)} (got {sorted(kinds)})")
+print(f"event-log schema OK: {len(records)} records, kinds {sorted(kinds)}")
+PY
+echo "== ci: all stages passed =="

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Telemetry-overhead A/B: interleaved medians, telemetry off vs on.
+
+Measures the cost of the on-device history carry with the same
+decision-grade protocol as the bench (``utils/profiling.
+interleaved_medians`` — round-4/5 lesson: only interleaved A/Bs beat
+chip/process drift). Two identical solvers, one with
+``TelemetryConfig(history_gens=...)``, sampled alternately; prints one
+JSON line with both medians, the overhead percentage, and the n each
+median rests on.
+
+On a TPU run the default shape is the 1M×100 bench headline; on CPU
+(no chip this round) pass a feasible shape, e.g.::
+
+    JAX_PLATFORMS=cpu python tools/telemetry_overhead.py \
+        --pop 16384 --len 64 --lo 10 --hi 30 --rounds 5
+
+The acceptance bar (ISSUE 2): overhead < 2% at the bench shape.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os as _os
+import sys
+
+sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+
+def make_runner(pop: int, genome_len: int, telemetry_gens: int, seed: int):
+    import jax
+
+    from libpga_tpu import PGA, PGAConfig, TelemetryConfig
+
+    tel = (
+        TelemetryConfig(history_gens=telemetry_gens)
+        if telemetry_gens else None
+    )
+    pga = PGA(seed=seed, config=PGAConfig(telemetry=tel))
+    pga.create_population(pop, genome_len)
+    pga.set_objective("onemax")
+    pga.run(3)  # compile + warm
+    jax.block_until_ready(pga.populations[0].genomes)
+    return lambda n: pga.run(n)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--pop", type=int, default=1 << 20)
+    ap.add_argument("--len", type=int, default=100, dest="genome_len")
+    ap.add_argument("--lo", type=int, default=50)
+    ap.add_argument("--hi", type=int, default=150)
+    ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument(
+        "--history-gens", type=int, default=0,
+        help="history capacity for the ON solver (default: hi + 8)",
+    )
+    args = ap.parse_args()
+    hist_gens = args.history_gens or args.hi + 8
+
+    import functools
+
+    import jax
+
+    from libpga_tpu.utils.profiling import best_ms_per_unit, interleaved_medians
+
+    runners = {
+        "telemetry_off": make_runner(args.pop, args.genome_len, 0, seed=42),
+        "telemetry_on": make_runner(
+            args.pop, args.genome_len, hist_gens, seed=42
+        ),
+    }
+    sample = functools.partial(best_ms_per_unit, lo=args.lo, hi=args.hi)
+    med = interleaved_medians(runners, rounds=args.rounds, sample=sample)
+    off, on = med["telemetry_off"], med["telemetry_on"]
+    overhead = (on - off) / off * 100.0 if off == off and off > 0 else None
+    out = {
+        "metric": "telemetry_overhead_pct",
+        "value": None if overhead is None else round(overhead, 2),
+        "backend": jax.default_backend(),
+        "pop": args.pop,
+        "genome_len": args.genome_len,
+        "history_gens": hist_gens,
+        "interleaved_rounds": args.rounds,
+        "ms_per_gen_off_median": None if off != off else round(off, 4),
+        "ms_per_gen_on_median": None if on != on else round(on, 4),
+        "n": med.n,
+        "dropped": med.dropped,
+        "protocol": (
+            f"interleaved_medians over {args.rounds} rounds of "
+            f"best_ms_per_unit(lo={args.lo}, hi={args.hi})"
+        ),
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
