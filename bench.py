@@ -71,6 +71,33 @@ GENOME_LEN = 100
 V5E_BF16_PEAK = 197e12  # TPU v5e: 197 TFLOP/s bf16 per chip
 V5E_HBM_PEAK = 819e9  # TPU v5e: 819 GB/s HBM bandwidth per chip
 
+# Version of the emitted JSON artifact's schema. Bump when keys are
+# added/renamed; tools/ci.sh gates on the current artifact carrying it.
+# 1 = rounds <= 7 implicit schema + the provenance block below.
+SCHEMA_VERSION = 1
+
+
+def provenance() -> dict:
+    """Measurement-context stamp for the JSON artifact (ISSUE 3
+    satellite): WHAT ran WHERE, plus the cross-process caveat
+    BASELINE.md documents — carried on the artifact itself so a number
+    read in isolation cannot be mistaken for a cross-process-comparable
+    one."""
+    import jax
+
+    dev = jax.devices()[0]
+    return {
+        "schema_version": SCHEMA_VERSION,
+        "backend": jax.default_backend(),
+        "device_kind": getattr(dev, "device_kind", str(dev)),
+        "process_state_note": (
+            "BASELINE.md documents +/-15% drift across processes on the "
+            "tunneled chip; only medians measured INTERLEAVED within one "
+            "process are decision-grade — do not compare this artifact's "
+            "absolute numbers against another process's run"
+        ),
+    }
+
 
 def hbm_bytes_per_gen(pop, genome_lanes, gene_bytes, T: int) -> int:
     """Population HBM traffic per generation under the fused run loop:
@@ -310,6 +337,7 @@ def main() -> None:
     baseline_gps = 1.0 / reference_floor_seconds_per_gen()
     f32_gps = med["f32"][0]
     out = {
+        **provenance(),
         "metric": "onemax_1M_generations_per_sec",
         "value": round(f32_gps, 2),
         "unit": "generations/sec",

@@ -79,6 +79,24 @@ class PGAConfig:
         see BASELINE.md), target checks gain launch granularity, and
         islands run one multigen launch per migration interval; 1
         forces the one-generation kernel everywhere.
+      pallas_layout: output layout of the fused kernel. ``None``
+        (default) = auto: the alias-compatible PING-PONG layout — each
+        grid step writes its children IN PLACE over the rows it read
+        (``input_output_aliases``), with generations alternating
+        between two row groupings so deme cohorts still reshuffle —
+        ships on the fused paths whenever its mixing gate admits
+        (``ops/pallas_step.pingpong_admissible``), and the staged
+        riffle-shuffle layout serves everything else. ``"riffle"`` /
+        ``"pingpong"`` force a layout (forcing ping-pong raises where
+        its gate fails rather than degrading silently).
+      pallas_subblock: sub-blocks per grid step of the one-generation
+        ping-pong kernel. > 1 streams that many deme groups through a
+        manually double-buffered VMEM scratch pair per grid step —
+        the grid (and its per-step dispatch floor) shrinks by the same
+        factor at unchanged scoped-VMEM budget. ``None``/1 = off (the
+        default until the hardware A/B in tools/ablate_floor.py rules);
+        ignored by the multi-generation kernel, which keeps its deme
+        group VMEM-resident instead.
       donate_buffers: donate the genome buffer to jit so XLA updates it in
         place (the TPU-native replacement for the reference's
         current/next-generation pointer swap, ``pga.h:124-129``).
@@ -112,6 +130,8 @@ class PGAConfig:
     use_pallas: Optional[bool] = None
     pallas_deme_size: Optional[int] = None
     pallas_generations_per_launch: Optional[int] = None
+    pallas_layout: Optional[str] = None
+    pallas_subblock: Optional[int] = None
     donate_buffers: bool = True
     validate: bool = False
     telemetry: Optional[TelemetryConfig] = None
@@ -142,3 +162,9 @@ class PGAConfig:
             and self.pallas_generations_per_launch < 1
         ):
             raise ValueError("pallas_generations_per_launch must be >= 1")
+        if self.pallas_layout not in (None, "riffle", "pingpong"):
+            raise ValueError(
+                "pallas_layout must be None, 'riffle' or 'pingpong'"
+            )
+        if self.pallas_subblock is not None and self.pallas_subblock < 1:
+            raise ValueError("pallas_subblock must be >= 1")

@@ -350,6 +350,7 @@ class PGA:
                 self.config.tournament_size, self.config.selection,
                 self.config.selection_param,
                 self.config.pallas_generations_per_launch,
+                self.config.pallas_layout, self.config.pallas_subblock,
                 hist_gens,
             )
             cached = self._compiled.get(pkey)
@@ -379,6 +380,8 @@ class PGA:
                         self.config.pallas_generations_per_launch
                     ),
                     history_gens=hist_gens,
+                    layout=self.config.pallas_layout,
+                    subblock=self.config.pallas_subblock,
                 )
                 pallas_fn = factory(size, genome_len) if factory else None
                 cached = (
@@ -658,6 +661,7 @@ class PGA:
             self.config.elitism, self.config.tournament_size,
             self.config.selection, self.config.selection_param,
             self.config.pallas_generations_per_launch,
+            self.config.pallas_layout, self.config.pallas_subblock,
         )
         if cache_key in self._compiled:
             return self._compiled[cache_key]
@@ -704,6 +708,7 @@ class PGA:
                     getattr(obj, "kernel_rowwise_consts", ())
                 ),
                 gene_dtype=self.config.gene_dtype,
+                _layout=self.config.pallas_layout,
             )
             if bm is not None:
                 # An explicit config value bounds the island epoch's
@@ -743,6 +748,8 @@ class PGA:
             fused_obj=fused,
             fused_consts=tuple(getattr(obj, "kernel_rowwise_consts", ())),
             gene_dtype=self.config.gene_dtype,
+            _layout=self.config.pallas_layout,
+            _subblock=self.config.pallas_subblock,
         )
         self._compiled[cache_key] = pb
         return pb

@@ -38,11 +38,17 @@ This kernel removes all HBM random access:
   indices, crossover masks, and mutation draws in registers, so no
   ``(P, L)`` random pool ever touches HBM (the reference materializes
   exactly such a pool per generation, ``pga.cu:99-105``).
-- **Free global mixing**: each deme's children are written through the
-  output ``BlockSpec`` index map into a ``(K, G, L)`` layout; a free
-  row-major reshape back to ``(P, L)`` interleaves all demes (a riffle
-  shuffle), so deme membership changes every generation and selection is
-  panmictic over a few-generation horizon.
+- **Free global mixing, in place**: deme membership changes every
+  generation. On the fused default this is the ALIAS-COMPATIBLE
+  PING-PONG layout (see the layout-algebra block below): children are
+  written in place over the rows their grid step read
+  (``input_output_aliases`` — no staged output buffer, no strided
+  riffle writes) and the reshuffle comes from alternating two row
+  groupings by generation parity. Elsewhere the riffle layout remains:
+  children written through the output ``BlockSpec`` index map into a
+  ``(K, G, L)`` layout whose free row-major reshape back to ``(P, L)``
+  interleaves all demes. Either way selection is panmictic over a
+  few-generation horizon.
 
 Semantics note: selection is a tournament *within the current deme* (a
 random cohort of ``K`` that reshuffles every generation), not i.i.d. over
@@ -66,6 +72,173 @@ import jax
 import jax.numpy as jnp
 
 LANE = 128
+
+# Every ablation flag the kernel factories understand, each consumed by
+# tools/ablate_kernel.py or tools/ablate_floor.py. A typo'd flag used to
+# be silently ignored — the variant would measure the FULL kernel and the
+# attribution table would carry a wrong number — so unknown names now
+# raise at build time (see _validate_ablate).
+_VALID_ABLATE = frozenset({
+    "copy_only",       # pure-copy kernel (floor harness)
+    "no_riffle",       # contiguous deme-major output layout
+    "alias_io",        # in-place output over the input buffer
+    "serial_grid",     # "arbitrary" grid dimension semantics
+    "no_rank_sort",    # skip the host-side rank sort (copy variants)
+    "no_score_t",      # skip the score transpose in padded_ranks
+    "scatter_scores",  # pre-round-5 per-deme score stores
+    "sel_const",       # identity selection (no sampling/one-hot build)
+    "no_matmul",       # skip the parent-gather matmul
+    "no_cross",        # skip crossover
+    "no_mut",          # skip mutation
+    "no_freeze",       # multigen: disable the target-freeze predicate
+    "no_rank_cube",    # multigen: identity in-kernel ranks
+})
+
+# Flags that change the OUTPUT LAYOUT itself; the ping-pong layout has
+# its own addressing, so these only combine with the riffle layout.
+_LAYOUT_ABLATE = frozenset({
+    "copy_only", "no_riffle", "alias_io", "no_score_t", "scatter_scores",
+})
+
+
+def _validate_ablate(ablate) -> tuple:
+    """Reject unknown ablation-flag names at build time. A silently
+    ignored typo (e.g. ``"no_rifle"``) makes the harness measure the
+    full kernel where a component was meant to be removed."""
+    ablate = tuple(ablate)
+    unknown = sorted(set(ablate) - _VALID_ABLATE)
+    if unknown:
+        raise ValueError(
+            f"unknown ablation flag(s) {unknown}; valid flags are "
+            f"{sorted(_VALID_ABLATE)}"
+        )
+    return ablate
+
+
+# ---------------------------------------------------------------------
+# Ping-pong layout algebra (the alias-compatible replacement for the
+# riffle shuffle — ISSUE 3 tentpole).
+#
+# The riffle layout scatters each grid step's children across every
+# other step's read rows, which is exactly why in-place output aliasing
+# was gated to the non-shippable ``no_riffle`` ablation. The ping-pong
+# scheme instead uses a GENERATION-PARITY PAIR of row groupings in which
+# every grid step writes only the rows it reads — so
+# ``input_output_aliases`` is sound by construction — while deme-cohort
+# membership still reshuffles across generations:
+#
+# - parity 0 ("even" generations): grid step i owns the CONSECUTIVE row
+#   slab [i*W, (i+1)*W) (W = demes_per_step * K rows);
+# - parity 1 ("odd" generations): the population is viewed as
+#   (A, S, q, Lp) with A = W/q chunks of q rows and S grid steps, and
+#   step i owns the STRIDED comb {a*S*q + i*q + o : a < A, o < q} —
+#   A chunks of q consecutive rows at stride S*q.
+#
+# q is the dtype's native sublane tile (8 rows f32, 16 bf16), the
+# finest granularity a BlockSpec can address. Both groupings partition
+# the Pp rows into S groups of W rows; within a group the kernel breeds
+# D READ demes of K consecutive group-local rows each.
+#
+# CRUCIALLY, a generation READS layout A but WRITES layout B (within
+# the same rows — the aliasing license is row-SET equality per step,
+# not per row): deme d's children are written INTERLEAVED across the
+# whole group — child chunk u of deme d lands at group chunk
+# ``u*D + d`` (a single middle-axis store on a (T, D, q, Lp)-factored
+# block, the same proven pattern as the riffle kernel's
+# ``out_ref[:, 0, d, :]``). Without this cross-deme write scatter the
+# scheme provably fragments: read==write per DEME makes each deme's
+# rows a closed set under one parity, and the two parities' closures
+# leave disconnected super-block islands (a cohort-dynamics simulation
+# shows takeover never completing — see tools/selection_equivalence.py
+# --simulate, which guards this exact property). With the interleave,
+# one parity-0 + parity-1 pair spreads any lineage across the full row
+# range (the parity-1 comb's interleaved writes span all of [0, Pp)),
+# and the cohort graph mixes in a handful of generations — the
+# deme-cohort reshuffle property the riffle provided, now at in-place
+# write cost.
+#
+# ``pingpong_admissible`` still hard-gates on A >= S (W**2 >= Pp*q):
+# below it the parity-1 comb of one group covers too few distinct
+# even-group residues and middle index "bits" are never regrouped
+# (provably disconnected for power-of-two shapes even with the write
+# interleave at D = 1).
+# ---------------------------------------------------------------------
+
+
+def pingpong_quantum(gene_dtype) -> int:
+    """Chunk granularity of the parity-1 comb: the dtype's native
+    sublane tile (the finest row block a BlockSpec may address)."""
+    return 16 if gene_dtype == jnp.bfloat16 else 8
+
+
+def pingpong_admissible(W: int, Pp: int, q: int) -> bool:
+    """True when the parity pair fully mixes: ``A >= S`` (with A = W/q
+    chunks per group and S = Pp/W groups), i.e. every even group spans
+    every odd group and vice versa. Below that threshold the two static
+    partitions provably leave disconnected row components (for
+    power-of-two shapes the middle index bits are never regrouped), so
+    the layout must not ship."""
+    if W <= 0 or W % q or Pp % W:
+        return False
+    return (W // q) >= (Pp // W)
+
+
+def pingpong_group_rows(parity: int, i: int, *, W: int, S: int, q: int):
+    """Physical rows grid step ``i`` both READS and WRITES under the
+    given parity — the single source of truth for the layout algebra,
+    mirrored by the BlockSpec index maps and pinned against the kernels
+    by the structural tests (tests/test_pingpong.py)."""
+    import numpy as np
+
+    if parity == 0:
+        return np.arange(i * W, (i + 1) * W, dtype=np.int64)
+    A = W // q
+    a = np.arange(A, dtype=np.int64)[:, None]
+    o = np.arange(q, dtype=np.int64)[None, :]
+    return (a * (S * q) + i * q + o).reshape(-1)
+
+
+def pingpong_perm(parity: int, Pp: int, W: int, q: int):
+    """READ-cohort-order -> physical-row permutation: entry ``g*W + x``
+    is the physical row of group ``g``'s local row ``x`` (local rows in
+    group-chunk order; read deme d = local rows [d*K, (d+1)*K)). Parity
+    0 is the identity; parity 1 is the strided comb."""
+    import numpy as np
+
+    S = Pp // W
+    return np.concatenate([
+        pingpong_group_rows(parity, i, W=W, S=S, q=q) for i in range(S)
+    ])
+
+
+def pingpong_child_rows(
+    parity: int, Pp: int, K: int, q: int, D: int, B: int = 1
+):
+    """WRITE placement: entry ``g*W + dd*K + k`` is the physical row
+    where group ``g``'s deme ``dd``'s child ``k`` lands. Within each
+    sub-block of D demes, child chunk ``u`` of deme ``d`` is written to
+    sub-block chunk ``u*D + d`` — the cross-deme interleave that makes
+    the parity pair mix (see the layout-algebra block above). The row
+    SET per group equals ``pingpong_group_rows`` (the aliasing
+    license); only the within-group placement differs from read
+    order."""
+    import numpy as np
+
+    W = B * D * K
+    S = Pp // W
+    T = K // q
+    rows = np.empty(Pp, np.int64)
+    for g in range(S):
+        grp = pingpong_group_rows(parity, g, W=W, S=S, q=q)
+        for b in range(B):
+            for d in range(D):
+                dd = b * D + d
+                u = np.arange(T)[:, None]
+                o = np.arange(q)[None, :]
+                m = b * D * T + u * D + d      # sub-block interleave
+                local = (m * q + o).reshape(-1)
+                rows[g * W + dd * K : g * W + (dd + 1) * K] = grp[local]
+    return rows
 
 
 def _valid_deme(k: int) -> bool:
@@ -980,7 +1153,232 @@ def _breed_kernel(
         )
 
 
-def _kernel_ranks(s, tie_bits, v_i32, K, padded=True):
+def _pp_breed_kernel(
+    seed_ref,
+    mparams_ref,
+    scores_ref,
+    genomes_ref,
+    *rest,
+    parity,
+    K,
+    D,
+    B,
+    S,
+    q,
+    L,
+    Lp,
+    tk=2,
+    sel="tournament",
+    sel_param=None,
+    crossover="uniform",
+    mutate="point",
+    obj=None,
+    obj_pad_ok=False,
+    n_consts=0,
+    n_cross=0,
+    n_mut=0,
+    bf16_genes=False,
+    padded=False,
+    ablate=(),
+):
+    """One grid step of the PING-PONG layout: breed ``B * D`` demes and
+    write every child IN PLACE over the group's own rows (the in/out
+    BlockSpecs are identical, licensing ``input_output_aliases``). The
+    genome arrays arrive as the parity's chunk view with an explicit
+    deme-interleave axis — parity 0 ``(S, T, D, q, Lp)`` blocks
+    ``(1, T, D, q, Lp)``, parity 1 ``(T, D, S, q, Lp)`` blocks
+    ``(T, D, 1, q, Lp)`` — whose group-local flat row order is
+    IDENTICAL (group-chunk-major), so the breeding core is
+    parity-independent; only the ref indexing differs.
+
+    READ layout A, WRITE layout B (the mixing crux — see the module's
+    layout-algebra block): deme d READS the contiguous group-local rows
+    [d*K, (d+1)*K) (a flat slice of the loaded block) but its children
+    are WRITTEN interleaved across the whole sub-block via the middle
+    D axis (``out[.., :, d, :, :] = child``) — child chunk u lands at
+    group chunk ``u*D + d``. Same row set per step (aliasing stays
+    sound); the cross-deme scatter is what lets the parity pair mix
+    lineages across the whole population instead of fragmenting into
+    closed super-blocks.
+
+    ``B`` > 1 is the SUB-BLOCK PIPELINE: the genome arrays stay in HBM
+    (``memory_space=ANY``) and the kernel streams ``B`` sub-blocks of
+    ``D`` demes through a manually double-buffered VMEM scratch pair
+    (async copy in / breed / async copy out), so one grid step serves
+    ``B`` times the demes at the same scoped-VMEM footprint — the grid
+    shrinks ``B``x, directly attacking the per-grid-step dispatch floor
+    the round-6 D-sweep isolated. Ranks and scores stay on ordinary
+    pipelined BlockSpecs (they are K-lane rows, ~KB per step).
+
+    ``rest`` holds, in order: the alive-mask input ref when ``padded``
+    (see below), ``n_consts`` objective-constant refs, expression
+    crossover/mutation constant refs, the genome output ref, the score
+    output ref when fused, and for ``B`` > 1 the four scratch refs
+    (in-buffer, out-buffer, in-sems, out-sems).
+
+    Padded populations: under parity 1 the pad rows (physical row >= P)
+    scatter through the comb instead of pooling at each deme's tail, so
+    the positional ``V = P - deme*K`` count of the riffle kernel is
+    wrong here. The caller instead passes a static per-parity ALIVE
+    mask (S, B*D, K) f32 (1 = real row); the deme's valid count is its
+    lane sum, and the host-side rank sort already places pad rows at
+    ranks >= V (their tie keys are pinned maximal), so sampling
+    ``rank < V`` can never select a pad row in either parity.
+    """
+    import jax.lax as lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    fused = obj is not None
+    idx = 1 if padded else 0
+    alive_ref = rest[0] if padded else None
+    const_refs = rest[idx : idx + n_consts]
+    cross_consts = tuple(
+        r[:] for r in rest[idx + n_consts : idx + n_consts + n_cross]
+    )
+    mut_consts = tuple(
+        r[:]
+        for r in rest[
+            idx + n_consts + n_cross : idx + n_consts + n_cross + n_mut
+        ]
+    )
+    base = idx + n_consts + n_cross + n_mut
+    g_out = rest[base]
+    s_out = rest[base + 1] if fused else None
+
+    i = pl.program_id(0)
+    pltpu.prng_seed(seed_ref[0, 0] ^ (i * jnp.int32(-1640531527)))
+
+    def uniform(shape):
+        bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+        return pltpu.bitcast(bits >> 8, jnp.int32).astype(
+            jnp.float32
+        ) * jnp.float32(2**-24)
+
+    rate = mparams_ref[0, 0]
+    sigma = mparams_ref[0, 1]
+    ranks_all = scores_ref[:]  # (1, B*D, K) f32 in-deme ranks
+    alive_all = alive_ref[:] if padded else None  # (1, B*D, K) f32
+
+    lane_ok = None
+    if mutate == "gaussian" and Lp > L:
+        lane_ok = lax.broadcasted_iota(jnp.int32, (K, Lp), 1) < L
+
+    out_dtype = jnp.bfloat16 if bf16_genes else jnp.float32
+    T = K // q           # chunks per deme
+
+    if B > 1:
+        gin_buf, gout_buf, sem_in, sem_out = rest[-4:]
+
+        # Arrays are the 6-D sub-block views — parity 0
+        # (S, B, T, D, q, Lp), parity 1 (B, T, D, S, q, Lp) — so one
+        # integer-indexed slab per (step, sub-block) matches the
+        # (T, D, q, Lp) scratch shape exactly.
+        def in_copy(b, slot):
+            if parity == 0:
+                src = genomes_ref.at[i, b]
+            else:
+                src = genomes_ref.at[b, :, :, i]
+            return pltpu.make_async_copy(
+                src, gin_buf.at[slot], sem_in.at[slot]
+            )
+
+        def out_copy(b, slot):
+            if parity == 0:
+                dst = g_out.at[i, b]
+            else:
+                dst = g_out.at[b, :, :, i]
+            return pltpu.make_async_copy(
+                gout_buf.at[slot], dst, sem_out.at[slot]
+            )
+
+        in_copy(0, 0).start()
+
+    score_rows = []
+    for b in range(B):
+        slot = b % 2
+        if B > 1:
+            # Double buffer: start sub-block b+1's inbound DMA before
+            # waiting on b's, and reclaim the outbound buffer written
+            # two iterations ago before overwriting it.
+            if b + 1 < B:
+                in_copy(b + 1, (b + 1) % 2).start()
+            in_copy(b, slot).wait()
+            if b >= 2:
+                out_copy(b - 2, slot).wait()
+            g_sub = gin_buf[slot].reshape(D * K, Lp)
+        else:
+            g_sub = genomes_ref[:].reshape(D * K, Lp)
+
+        mask_words = None
+        if crossover == "uniform" and "no_cross" not in ablate:
+            # One (K, Lp) PRNG tile per sub-block serves its D demes
+            # via distinct word bits (same trick as _breed_kernel).
+            mask_words = pltpu.bitcast(
+                pltpu.prng_random_bits((K, Lp)), jnp.uint32
+            )
+
+        for d in range(D):
+            dd = b * D + d  # deme slot within the whole grid step
+            g = g_sub[d * K : (d + 1) * K, :]
+            R = ranks_all[0, dd : dd + 1, :]  # (1, K)
+            if padded:
+                av = alive_all[0, dd : dd + 1, :]  # (1, K)
+                # A parity-1 cohort can in principle be all pads; the
+                # max() keeps the sampling denominator sane (its
+                # children are pad rows the caller masks to -inf).
+                Vf = jnp.maximum(
+                    jnp.sum(av, axis=1, keepdims=True), 1.0
+                )  # (1, 1)
+            else:
+                Vf = jnp.float32(K)
+
+            child = _deme_child(
+                g, R, Vf, uniform, mask_words, d,
+                K=K, L=L, Lp=Lp, tk=tk, sel=sel, sel_param=sel_param,
+                crossover=crossover, mutate=mutate, rate=rate, sigma=sigma,
+                lane_ok=lane_ok, bf16_genes=bf16_genes,
+                cross_consts=cross_consts, mut_consts=mut_consts,
+                ablate=ablate,
+            )
+            child = child.astype(out_dtype)
+            # The cross-deme write interleave: child chunk u of deme d
+            # lands at sub-block chunk u*D + d — one middle-axis store
+            # (the riffle kernel's proven out_ref[:, 0, d, :] pattern).
+            blk = child.reshape(T, q, Lp)
+            if B > 1:
+                gout_buf[slot, :, d, :, :] = blk
+            elif parity == 0:
+                g_out[0, :, d, :, :] = blk
+            else:
+                g_out[:, d, 0, :, :] = blk
+            if fused:
+                if bf16_genes:
+                    child = child.astype(jnp.float32)
+                child_scores = obj(
+                    child if obj_pad_ok else child[:, :L],
+                    *[r[:] for r in const_refs],
+                ).astype(jnp.float32)
+                score_rows.append(child_scores.reshape(1, 1, K))
+        if B > 1:
+            out_copy(b, slot).start()
+
+    if B > 1:
+        # Drain the last two outbound DMAs (earlier ones were waited in
+        # the loop when their buffer slot was reclaimed).
+        for b in range(max(B - 2, 0), B):
+            out_copy(b, b % 2).wait()
+
+    if score_rows:
+        # ONE (1, B*D, K) score store per grid step (the round-5
+        # batched-store lesson carries over from the riffle kernel).
+        s_out[:] = (
+            jnp.concatenate(score_rows, axis=1)
+            if len(score_rows) > 1 else score_rows[0]
+        )
+
+
+def _kernel_ranks(s, tie_bits, v_i32, K, padded=True, alive=None):
     """In-deme ranks (1, K) f32 computed INSIDE the kernel from raw
     scores — the multi-generation kernel's replacement for the caller's
     ``compute_ranks`` sort (sub-generations 2..T have no HBM round trip
@@ -998,6 +1396,10 @@ def _kernel_ranks(s, tie_bits, v_i32, K, padded=True):
     Cost: one (K, K) compare cube + sublane reduce per deme per
     sub-generation — all VPU, no MXU — versus the host sort's ~0.9 ms
     per 1M×100 generation plus its HBM score round trip.
+
+    ``alive`` (ping-pong layouts): a (1, K) f32 mask of real rows
+    replacing the positional ``v_i32`` tail — under the parity-1 comb,
+    pad rows scatter through a cohort instead of pooling at its end.
     """
     import jax.lax as lax
 
@@ -1012,14 +1414,18 @@ def _kernel_ranks(s, tie_bits, v_i32, K, padded=True):
     # divisor population, V == K statically) skips both dead-slot
     # passes.
     dead = jnp.isnan(s)
-    if padded:
+    if alive is not None:
+        dead = dead | (alive == 0.0)
+    elif padded:
         dead = dead | (lane >= v_i32)
     s = jnp.where(dead, -jnp.inf, s)  # (1, K) f32
     t = pltpu.bitcast(
         lax.shift_right_logical(tie_bits, jnp.uint32(2)), jnp.int32
     )
     t = (t & jnp.int32(-1024)) | lane
-    if padded:
+    if alive is not None:
+        t = jnp.where(alive > 0.0, t, jnp.int32(0x7FFFFC00) | lane)
+    elif padded:
         t = jnp.where(lane < v_i32, t, jnp.int32(0x7FFFFC00) | lane)
     # better[i, j]: row i strictly precedes row j in the sort order.
     # (A select-on-bool where-form won't lower in Mosaic.) The column
@@ -1060,6 +1466,9 @@ def _multigen_kernel(
     P=None,
     elitism=0,
     ablate=(),
+    layout="riffle",
+    parity=0,
+    q=8,
 ):
     """Breed ``steps_ref`` consecutive generations with the deme group
     resident in VMEM scratch — one HBM read + one HBM write of the
@@ -1087,17 +1496,34 @@ def _multigen_kernel(
       happens at launch boundaries), so the panmictic mixing horizon
       grows from 1 to ``steps`` generations — measured equivalence in
       BASELINE.md covers the shipped default.
+
+    ``layout`` "pingpong": the genome in/out refs are the parity's 4-D
+    chunk view of the SAME aliased flat buffer (see _pp_breed_kernel —
+    group-local row order is identical for both parities) and the
+    launch writes the whole group back IN PLACE; the inter-group
+    reshuffle comes from the run loop alternating launch parity. On a
+    padded population the positional tail masks are replaced by the
+    static per-parity alive-mask input (``rest[0]``).
     """
     import jax.lax as lax
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    const_refs = rest[:n_consts]
-    cross_consts = tuple(r[:] for r in rest[n_consts : n_consts + n_cross])
-    mut_consts = tuple(
-        r[:] for r in rest[n_consts + n_cross : n_consts + n_cross + n_mut]
+    pp = layout == "pingpong"
+    pp_padded = pp and P is not None and P % K != 0
+    idx = 1 if pp_padded else 0
+    alive_ref = rest[0] if pp_padded else None
+    const_refs = rest[idx : idx + n_consts]
+    cross_consts = tuple(
+        r[:] for r in rest[idx + n_consts : idx + n_consts + n_cross]
     )
-    base = n_consts + n_cross + n_mut
+    mut_consts = tuple(
+        r[:]
+        for r in rest[
+            idx + n_consts + n_cross : idx + n_consts + n_cross + n_mut
+        ]
+    )
+    base = idx + n_consts + n_cross + n_mut
     g_out = rest[base]
     s_out = rest[base + 1]
     g_scr = rest[base + 2]
@@ -1117,14 +1543,26 @@ def _multigen_kernel(
     sigma = mparams_ref[0, 1]
     tgt = target_ref[0, 0]
 
-    g_scr[:] = genomes_ref[:]
+    if pp:
+        g_scr[:] = genomes_ref[:].reshape(D * K, Lp)
+    else:
+        g_scr[:] = genomes_ref[:]
     s_scr[:] = scores_ref[:]
 
     lane_ok = None
     if mutate == "gaussian" and Lp > L:
         lane_ok = lax.broadcasted_iota(jnp.int32, (K, Lp), 1) < L
 
+    alive_all = alive_ref[:] if pp_padded else None  # (1, D, K) f32
+
     def valid_rows(d):
+        if pp_padded:
+            # Pad rows scatter through the parity-1 comb; the static
+            # mask's lane sum is the deme's real-row count.
+            return jnp.maximum(
+                jnp.sum(alive_all[0, d : d + 1, :], axis=1, keepdims=True),
+                1.0,
+            )
         if P is None or P % K == 0:
             return jnp.int32(K)
         deme = i * D + d
@@ -1139,7 +1577,9 @@ def _multigen_kernel(
     # one-generation path); in-kernel, dead tail-deme slots are excluded
     # positionally inside _kernel_ranks and via this mask for the
     # target-freeze check.
-    if P is not None and P % K != 0:
+    if pp_padded:
+        alive = alive_all > 0.0
+    elif P is not None and P % K != 0:
         lane3 = lax.broadcasted_iota(jnp.int32, (1, D, K), 2)
         deme3 = lax.broadcasted_iota(jnp.int32, (1, D, K), 1) + i * D
         v3 = jnp.clip(jnp.int32(P) - deme3 * K, 1, jnp.int32(K))
@@ -1189,9 +1629,13 @@ def _multigen_kernel(
                 R = _kernel_ranks(
                     s_scr[0:1, d, :], tie_bits[d : d + 1, :], v, K,
                     padded=P is not None and P % K != 0,
+                    alive=(
+                        alive_all[0, d : d + 1, :] if pp_padded else None
+                    ),
                 )
+            vf = v if pp_padded else v.astype(jnp.float32)
             child = _deme_child(
-                g_store, R, v.astype(jnp.float32), uniform, mask_words, d,
+                g_store, R, vf, uniform, mask_words, d,
                 K=K, L=L, Lp=Lp, tk=tk, sel=sel, sel_param=sel_param,
                 crossover=crossover, mutate=mutate, rate=rate,
                 sigma=sigma, lane_ok=lane_ok, bf16_genes=bf16_genes,
@@ -1219,8 +1663,21 @@ def _multigen_kernel(
 
     lax.fori_loop(0, steps_ref[0, 0], sub_gen, jnp.int32(0))
 
-    for d in range(D):
-        g_out[:, 0, d, :] = g_scr[d * K : (d + 1) * K, :]
+    if pp:
+        # In-place group writeback through the parity's interleave
+        # view (same rows the step read — the aliasing license): deme
+        # d's rows land at group chunks {u*D + d}, the launch-boundary
+        # reshuffle of the ping-pong scheme.
+        T = K // q
+        for d in range(D):
+            blk = g_scr[d * K : (d + 1) * K, :].reshape(T, q, Lp)
+            if parity == 0:
+                g_out[0, :, d, :, :] = blk
+            else:
+                g_out[:, d, 0, :, :] = blk
+    else:
+        for d in range(D):
+            g_out[:, 0, d, :] = g_scr[d * K : (d + 1) * K, :]
     s_out[:] = s_scr[:]
 
 
@@ -1244,7 +1701,10 @@ def _kernel_shape(
     """Admission gates + shape resolution shared by the one-generation
     and multi-generation kernel factories — ONE copy so the two paths
     can never accept different configurations. Returns
-    ``(K, G, D, Pp, Lp, resolved_selection_param)`` or None to decline:
+    ``(K, G, D, Pp, Lp, resolved_selection_param, d_candidates)`` —
+    ``d_candidates`` being every VMEM-admissible demes-per-step value
+    (descending; the ping-pong layout resolver may bump D within it) —
+    or None to decline:
 
     - supported gene dtype (f32/bf16), crossover/mutate kind;
     - order crossover: f32 genes only (bf16 resolution ~0.004 near 1.0
@@ -1321,7 +1781,7 @@ def _kernel_shape(
         D = next((d for d in d_candidates if d <= demes_per_step), 1)
     else:
         D = next((d for d in d_candidates if d <= d_default), 1)
-    return K, G, D, G * K, Lp, selection_param
+    return K, G, D, G * K, Lp, selection_param, tuple(d_candidates)
 
 
 def _breeding_kind(kind, L: int, Lp: int):
@@ -1354,6 +1814,96 @@ def _breeding_kind(kind, L: int, Lp: int):
     return rows, tuple(consts)
 
 
+def _resolve_layout(
+    layout,
+    *,
+    K,
+    G,
+    D,
+    Pp,
+    q,
+    d_candidates,
+    subblock,
+    fused,
+    crossover_kind,
+    ablate,
+    multigen=False,
+    padded_elitism=False,
+    d_pinned=False,
+):
+    """Resolve the output-layout request to ``("riffle", D, 1)`` or
+    ``("pingpong", D', B)``.
+
+    ``layout`` None is AUTO: the ping-pong in-place layout is the
+    SHIPPED DEFAULT for the fused f32/bf16 paths (ISSUE 3) whenever its
+    mixing gate admits — bumping demes-per-step to the smallest
+    VMEM-admissible candidate that satisfies ``pingpong_admissible``
+    (in-place writes have no riffle-stride downside, so a larger D only
+    cuts grid steps) — and falls back to the riffle otherwise. An
+    EXPLICIT ``"pingpong"`` raises when inadmissible instead of
+    degrading silently (a benchmark variant must not quietly measure
+    the other layout). Riffle-only conditions: order crossover (D
+    pinned to 1 never passes the mixing gate at scale, and the TSP
+    scorer shares its scratch), any layout-affecting ablation flag
+    (the floor instruments are riffle-calibrated), and per-deme
+    elitism on a padded multigen population (a pad row can occupy a
+    parity-1 cohort's elite slot).
+    """
+    B = int(subblock or 1)
+    if B < 1:
+        raise ValueError(f"subblock depth must be >= 1, got {subblock}")
+    if layout not in (None, "riffle", "pingpong"):
+        raise ValueError(
+            f"unknown layout {layout!r}: expected 'riffle' or 'pingpong'"
+        )
+    explicit = layout == "pingpong"
+    blockers = []
+    if crossover_kind == "order":
+        blockers.append("order crossover is riffle-only")
+    if set(ablate) & _LAYOUT_ABLATE:
+        blockers.append(
+            f"layout ablation flags {sorted(set(ablate) & _LAYOUT_ABLATE)}"
+            " are riffle instruments"
+        )
+    if multigen and B > 1:
+        blockers.append(
+            "sub-block pipelining streams demes through VMEM, which the"
+            " multi-generation kernel's resident scratch precludes"
+        )
+    if padded_elitism:
+        blockers.append(
+            "per-deme elitism on a padded population would write elites"
+            " into pad rows under parity 1"
+        )
+    want = explicit or (layout is None and fused)
+    if layout == "riffle" or not want:
+        return "riffle", D, 1
+    if blockers:
+        if explicit:
+            raise ValueError(
+                "layout='pingpong' is not available here: "
+                + "; ".join(blockers)
+            )
+        return "riffle", D, 1
+    # Smallest admissible D' >= the measured default (candidates are
+    # descending). An EXPLICITLY pinned demes-per-step is never bumped
+    # — a sweep point must measure the D it asked for — so it either
+    # passes the gate itself or the ping-pong layout is off the table.
+    pool = [D] if d_pinned else sorted(d for d in d_candidates if d >= D)
+    for d2 in pool:
+        if G % (B * d2) == 0 and pingpong_admissible(B * d2 * K, Pp, q):
+            return "pingpong", d2, B
+    if explicit:
+        raise ValueError(
+            "layout='pingpong' requested but no VMEM-admissible"
+            f" demes-per-step satisfies the mixing gate (K={K}, G={G},"
+            f" subblock={B}, candidates={pool}):"
+            " the parity pair would leave disconnected row components"
+            " (pingpong_admissible)"
+        )
+    return "riffle", D, 1
+
+
 def make_pallas_breed(
     pop_size: int,
     genome_len: int,
@@ -1373,12 +1923,25 @@ def make_pallas_breed(
     gene_dtype=jnp.float32,
     _demes_per_step: Optional[int] = None,
     _ablate: tuple = (),
+    _layout: Optional[str] = None,
+    _subblock: Optional[int] = None,
 ) -> Optional[Callable]:
     """Build the fused breed: ``(genomes (P,L), scores (P,), key[, mparams])
     -> next_genomes (P, L)`` — or, with ``fused_obj``, ``-> (next_genomes,
     next_scores)`` with evaluation done inside the kernel. ``gene_dtype``
     bfloat16 selects parents with a single exact bf16 matmul (half the
     FLOPs/traffic of the f32 hi/lo path) at bf16 gene resolution.
+
+    ``_layout`` None (auto) ships the alias-compatible PING-PONG layout
+    on the fused paths whenever its mixing gate admits (see
+    ``_resolve_layout``): children are written IN PLACE over the input
+    buffer (``input_output_aliases``), generations alternate between
+    two row groupings (the returned breed's ``padded``/``padded_ranks``
+    take a ``parity`` argument the run loops toggle), and the riffle's
+    staged output buffer plus its strided writes disappear. "riffle"
+    and "pingpong" force either layout; ``_subblock`` B > 1 adds the
+    manually double-buffered sub-block pipeline (ping-pong only),
+    shrinking the grid B-fold at the same scoped-VMEM budget.
 
     ``fused_tsp`` (an objective's ``kernel_gene_major`` dict) selects
     the gene-major fused TSP scorer instead of a rowwise ``fused_obj``;
@@ -1411,6 +1974,7 @@ def make_pallas_breed(
     # fused TSP at short genomes too (100-city, 4-round interleave:
     # 3316 vs 2817 gens/sec; long genomes fall to K<=256 via the order
     # scratch VMEM gate regardless).
+    _ablate = _validate_ablate(_ablate)
     const_obj = fused_obj is not None and bool(fused_consts)
     shape = _kernel_shape(
         pop_size, genome_len, deme_size, tournament_size,
@@ -1447,7 +2011,25 @@ def make_pallas_breed(
         return None
     bf16_genes = gene_dtype == jnp.bfloat16
     P, L = pop_size, genome_len
-    K, G, D, Pp, Lp, selection_param = shape
+    K, G, D, Pp, Lp, selection_param, d_cands = shape
+
+    layout, D, subblock = _resolve_layout(
+        _layout,
+        K=K, G=G, D=D, Pp=Pp, q=pingpong_quantum(gene_dtype),
+        d_candidates=d_cands, subblock=_subblock, fused=fused,
+        crossover_kind=crossover_kind, ablate=_ablate,
+        d_pinned=_demes_per_step is not None,
+    )
+    if layout == "pingpong":
+        return _make_pingpong_breed(
+            P, L, K, G, D, subblock, Pp, Lp,
+            tournament_size=tournament_size,
+            selection_kind=selection_kind, selection_param=selection_param,
+            mutation_rate=mutation_rate, mutation_sigma=mutation_sigma,
+            crossover_kind=crossover_kind, mutate_kind=mutate_kind,
+            elitism=elitism, fused_obj=fused_obj, fused_consts=fused_consts,
+            gene_dtype=gene_dtype, ablate=_ablate,
+        )
 
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -1545,7 +2127,7 @@ def make_pallas_breed(
         [[mutation_rate, mutation_sigma]], dtype=jnp.float32
     )
 
-    def compute_ranks(scores, k_tie):
+    def compute_ranks(scores, k_tie, parity=0):
         """In-deme ranks (0 = best) for ``scores (..., Pp)`` →
         ``(..., G//D, D, K)`` f32, via ONE two-key sort flattened over
         every leading dim (an island runner passes (I, Pp) so the sort
@@ -1564,7 +2146,11 @@ def make_pallas_breed(
            (real rows' keys are shifted into [0, 2^31)), so they still
            sort strictly after every real row and sampling rank < V can
            never select one.
+
+        ``parity`` is accepted for signature parity with the ping-pong
+        breed (the riffle's cohorts are parity-independent).
         """
+        del parity
         lead = scores.shape[:-1]
         N = math.prod(lead) if lead else 1
         if "no_rank_sort" in _ablate:
@@ -1592,7 +2178,7 @@ def make_pallas_breed(
         ranks = jnp.argsort(order, axis=1, stable=True).astype(jnp.float32)
         return ranks.reshape(*lead, G // D, D, K)
 
-    def padded_ranks(gp, scores, ranks, key, mparams=None):
+    def padded_ranks(gp, scores, ranks, key, mparams=None, parity=0):
         """``breed_padded`` with the deme ranks precomputed (see
         ``compute_ranks``): island runners hoist the rank sort above
         their per-island vmap and call this per island. With ranks from
@@ -1600,6 +2186,7 @@ def make_pallas_breed(
         split(key)``, this returns exactly what ``breed_padded(gp,
         scores, key)`` would. ``scores`` are still needed for the
         elitism epilogue (elites carry from the PREVIOUS generation)."""
+        del parity  # riffle cohorts are parity-independent
         if mparams is None:
             mparams = default_params
         k_seed, _ = jax.random.split(key)
@@ -1628,16 +2215,18 @@ def make_pallas_breed(
             return g2, s2
         return out.reshape(Pp, Lp)
 
-    def breed_padded(gp, scores, key, mparams=None):
+    def breed_padded(gp, scores, key, mparams=None, parity=0):
         """(Pp, Lp)-padded variant for loops that keep the pad resident.
         Takes/returns genomes (Pp, Lp) and scores (Pp,); when fused, tail
         child scores (rows >= P) come back masked to -inf so loop
         reductions and target checks never see a discarded child."""
+        del parity
         _, k_tie = jax.random.split(key)
         ranks = compute_ranks(scores, k_tie)
         return padded_ranks(gp, scores, ranks, key, mparams)
 
-    def breed(genomes, scores, key, mparams=None):
+    def breed(genomes, scores, key, mparams=None, parity=0):
+        del parity
         gp = genomes.astype(gene_dtype)
         if Lp != L or Pp != P:
             gp = jnp.pad(gp, ((0, Pp - P), (0, Lp - L)))
@@ -1662,6 +2251,280 @@ def make_pallas_breed(
     breed.default_params = default_params
     breed.elitism = elitism
     breed.crossover_kind = crossover_kind
+    breed.layout = "riffle"
+    breed.subblock = 1
+    breed.parities = 1
+    return breed
+
+
+def _make_pingpong_breed(
+    P, L, K, G, D, B, Pp, Lp,
+    *,
+    tournament_size,
+    selection_kind,
+    selection_param,
+    mutation_rate,
+    mutation_sigma,
+    crossover_kind,
+    mutate_kind,
+    elitism,
+    fused_obj,
+    fused_consts,
+    gene_dtype,
+    ablate,
+):
+    """Assemble the ping-pong breed: one ``pl.pallas_call`` per parity
+    over the parity's 4-D chunk view of the SAME flat (Pp, Lp) buffer,
+    genome input aliased onto the genome output (children land in
+    place). ``D`` here is demes per SUB-block; a grid step serves
+    ``B * D`` demes (``B`` > 1 streams them through the manual
+    double-buffer pipeline of ``_pp_breed_kernel``). See the layout
+    algebra block at the top of this module for the mixing argument.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    import numpy as np
+
+    fused = fused_obj is not None
+    bf16_genes = gene_dtype == jnp.bfloat16
+    q = pingpong_quantum(gene_dtype)
+    Dstep = B * D           # demes per grid step
+    W = Dstep * K           # rows per grid step
+    S = Pp // W             # grid steps
+    Ablk = W // q           # chunks per grid step
+    padded = Pp != P
+
+    consts = tuple(jnp.atleast_2d(jnp.asarray(c)) for c in fused_consts)
+    if fused_obj is None:
+        consts = ()
+    cross_kind, cross_consts = _breeding_kind(crossover_kind, L, Lp)
+    mut_kind, mut_consts = _breeding_kind(mutate_kind, L, Lp)
+
+    # Static per-parity alive masks (padded populations only): 1.0 where
+    # the cohort slot holds a real row. Under parity 1 pad rows scatter
+    # through the comb, so aliveness is a per-slot property, not a
+    # per-deme tail count.
+    alive = []
+    if padded:
+        for parity in (0, 1):
+            rows = pingpong_perm(parity, Pp, W, q)  # cohort -> physical
+            alive.append(
+                jnp.asarray(
+                    (rows < P).astype(np.float32).reshape(S, Dstep, K)
+                )
+            )
+
+    T = K // q  # chunks per deme
+    if B > 1:
+        # 6-D sub-block views: one integer-indexed (T, D, q, Lp) slab
+        # per (step, sub-block) for the manual DMA pipeline.
+        view = [(S, B, T, D, q, Lp), (B, T, D, S, q, Lp)]
+    else:
+        view = [(S, T, D, q, Lp), (T, D, S, q, Lp)]
+    gspec = [
+        pl.BlockSpec((1, T, D, q, Lp), lambda i: (i, 0, 0, 0, 0)),
+        pl.BlockSpec((T, D, 1, q, Lp), lambda i: (0, 0, i, 0, 0)),
+    ]
+
+    def _const_spec(c):
+        return pl.BlockSpec(c.shape, lambda i: (0,) * c.ndim)
+
+    calls = []
+    for parity in (0, 1):
+        kernel = partial(
+            _pp_breed_kernel,
+            parity=parity, K=K, D=D, B=B, S=S, q=q, L=L, Lp=Lp,
+            tk=tournament_size, sel=selection_kind, sel_param=selection_param,
+            crossover=cross_kind, mutate=mut_kind,
+            obj=fused_obj,
+            obj_pad_ok=bool(getattr(fused_obj, "pad_ok", False)),
+            n_consts=len(consts), n_cross=len(cross_consts),
+            n_mut=len(mut_consts), bf16_genes=bf16_genes, padded=padded,
+            ablate=tuple(ablate),
+        )
+        if B > 1:
+            # Sub-block pipeline: genomes stay in HBM; the kernel
+            # streams (D*K/q, q, Lp) slabs through the scratch pair.
+            genome_in = pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY)
+            genome_out = pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY)
+            scratch = [
+                pltpu.VMEM((2, T, D, q, Lp), gene_dtype),
+                pltpu.VMEM((2, T, D, q, Lp), gene_dtype),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+            ]
+        else:
+            genome_in = gspec[parity]
+            genome_out = gspec[parity]
+            scratch = []
+        in_specs = [
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 2), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, Dstep, K), lambda i: (i, 0, 0)),
+            genome_in,
+        ]
+        if padded:
+            in_specs.append(pl.BlockSpec((1, Dstep, K), lambda i: (i, 0, 0)))
+        in_specs += [_const_spec(c) for c in consts + cross_consts + mut_consts]
+        out_specs = [genome_out]
+        out_shape = [jax.ShapeDtypeStruct(view[parity], gene_dtype)]
+        if fused:
+            out_specs.append(pl.BlockSpec((1, Dstep, K), lambda i: (i, 0, 0)))
+            out_shape.append(jax.ShapeDtypeStruct((S, Dstep, K), jnp.float32))
+        calls.append(
+            pl.pallas_call(
+                kernel,
+                grid=(S,),
+                in_specs=in_specs,
+                out_specs=out_specs if fused else out_specs[0],
+                out_shape=out_shape if fused else out_shape[0],
+                scratch_shapes=scratch,
+                input_output_aliases={3: 0},
+                compiler_params=_grid_compiler_params(ablate),
+            )
+        )
+
+    default_params = jnp.asarray(
+        [[mutation_rate, mutation_sigma]], dtype=jnp.float32
+    )
+
+    # Static pad mask in COHORT order per parity (parity 0 is physical
+    # order, so the plain arange test suffices there).
+    pad_cohort = [None, None]
+    if padded:
+        pad_cohort[0] = jnp.arange(Pp, dtype=jnp.int32) >= P
+        pad_cohort[1] = jnp.asarray(pingpong_perm(1, Pp, W, q) >= P)
+
+    def _to_cohort(scores, parity):
+        """Physical-order (..., Pp) scores -> the parity's cohort order
+        (group-major, demes of K consecutive slots). Parity 0 is the
+        identity; parity 1 swaps the chunk/group axes of the comb view
+        — a (Pp,)-sized transpose, ~4 MB at 1M, vs the ~0.5 GB genome
+        traffic the in-place layout saves."""
+        if parity == 0:
+            return scores
+        lead = scores.shape[:-1]
+        sc = scores.reshape(*lead, Ablk, S, q)
+        return jnp.swapaxes(sc, -3, -2).reshape(*lead, -1)
+
+    def _to_physical(scores, parity):
+        """Inverse of ``_to_cohort`` (same transpose, axes swapped
+        back)."""
+        if parity == 0:
+            return scores
+        lead = scores.shape[:-1]
+        sc = scores.reshape(*lead, S, Ablk, q)
+        return jnp.swapaxes(sc, -3, -2).reshape(*lead, -1)
+
+    def _child_to_physical(cs, parity):
+        """Kernel child scores (S, B*D, K) — written per READ deme — to
+        physical row order of the INTERLEAVED child placement (child
+        chunk u of deme d lands at sub-block chunk u*D + d: the
+        (D, T) -> (T, D) axis swap, then the parity's comb)."""
+        local = cs.reshape(S, B, D, T, q).swapaxes(2, 3).reshape(-1)
+        return _to_physical(local, parity)
+
+    def compute_ranks(scores, k_tie, parity=0):
+        """In-deme ranks for the PARITY'S cohorts, shaped
+        ``(..., S, Dstep, K)`` for the kernel's rank input. Same total
+        order as the riffle path's ``compute_ranks`` (descending score,
+        NaN last among real rows, random tie order, pads strictly
+        last); the only difference is which rows form a deme."""
+        lead = scores.shape[:-1]
+        N = math.prod(lead) if lead else 1
+        s_real = jnp.where(jnp.isnan(scores), -jnp.inf, scores)
+        s_c = _to_cohort(s_real, parity)
+        neg = -s_c.reshape(N * S * Dstep, K).astype(jnp.float32)
+        tb = jax.lax.shift_right_logical(
+            jax.random.bits(k_tie, (N, Pp)), jnp.uint32(1)
+        )
+        if padded:
+            tb = jnp.where(
+                pad_cohort[parity][None, :], jnp.uint32(0xFFFFFFFF), tb
+            )
+        row_iota = jnp.broadcast_to(
+            jnp.arange(K, dtype=jnp.int32)[None, :], (N * S * Dstep, K)
+        )
+        _, _, order = jax.lax.sort(
+            (neg, tb.reshape(N * S * Dstep, K), row_iota),
+            dimension=1, num_keys=2,
+        )
+        ranks = jnp.argsort(order, axis=1, stable=True).astype(jnp.float32)
+        return ranks.reshape(*lead, S, Dstep, K)
+
+    def padded_ranks(gp, scores, ranks, key, mparams=None, parity=0):
+        """One in-place generation at the given parity. ``ranks`` must
+        come from ``compute_ranks(scores, k_tie, parity)`` with
+        ``(_, k_tie) = split(key)``; genomes and scores are physical
+        row order in and out (the cohort permutations are internal)."""
+        if mparams is None:
+            mparams = default_params
+        if elitism > 0:
+            # Elites are gathered BEFORE the kernel call: reading the
+            # pre-breed buffer afterwards would force XLA to keep a
+            # copy alive and defeat the in-place aliasing.
+            top_s, top_i = jax.lax.top_k(scores, elitism)
+            elite_g = jnp.take(gp, top_i, axis=0)
+        k_seed, _ = jax.random.split(key)
+        seed = jax.random.randint(
+            k_seed, (1, 1), jnp.iinfo(jnp.int32).min, jnp.iinfo(jnp.int32).max,
+            dtype=jnp.int32,
+        )
+        args = [seed, mparams, ranks, gp.reshape(view[parity])]
+        if padded:
+            args.append(alive[parity])
+        out = calls[parity](*args, *consts, *cross_consts, *mut_consts)
+        if fused:
+            genomes, child_scores = out
+            s2 = _child_to_physical(child_scores, parity)
+            if padded:
+                s2 = jnp.where(
+                    jnp.arange(Pp, dtype=jnp.int32) < P, s2, -jnp.inf
+                )
+            g2 = genomes.reshape(Pp, Lp)
+            if elitism > 0:
+                g2 = jax.lax.dynamic_update_slice(
+                    g2, elite_g.astype(g2.dtype), (0, 0)
+                )
+                s2 = jax.lax.dynamic_update_slice(s2, top_s, (0,))
+            return g2, s2
+        return out.reshape(Pp, Lp)
+
+    def breed_padded(gp, scores, key, mparams=None, parity=0):
+        _, k_tie = jax.random.split(key)
+        ranks = compute_ranks(scores, k_tie, parity)
+        return padded_ranks(gp, scores, ranks, key, mparams, parity)
+
+    def breed(genomes, scores, key, mparams=None, parity=0):
+        gp = genomes.astype(gene_dtype)
+        if Lp != L or Pp != P:
+            gp = jnp.pad(gp, ((0, Pp - P), (0, Lp - L)))
+        if Pp != P:
+            scores = jnp.pad(scores, (0, Pp - P), constant_values=-jnp.inf)
+        out = breed_padded(gp, scores, key, mparams, parity)
+        if fused:
+            g2, s2 = out
+            return g2[:P, :L], s2[:P]
+        return out[:P, :L]
+
+    breed.padded = breed_padded
+    breed.padded_ranks = padded_ranks
+    breed.compute_ranks = compute_ranks
+    breed.Lp = Lp
+    breed.Pp = Pp
+    breed.K = K
+    breed.D = Dstep  # total demes per grid step (dispatch-relevant)
+    breed.fused = fused
+    breed.gene_dtype = gene_dtype
+    breed.takes_params = True
+    breed.default_params = default_params
+    breed.elitism = elitism
+    breed.crossover_kind = crossover_kind
+    breed.layout = "pingpong"
+    breed.subblock = B
+    breed.parities = 2
+    breed.grid_steps = S
     return breed
 
 
@@ -1726,6 +2589,8 @@ def make_pallas_multigen(
     gene_dtype=jnp.float32,
     _demes_per_step: Optional[int] = None,
     _ablate: tuple = (),
+    _layout: Optional[str] = None,
+    _subblock: Optional[int] = None,
 ) -> Optional[Callable]:
     """Build the multi-generation fused breed:
     ``(genomes (P, L), scores (P,), key, steps[, mparams, target])
@@ -1739,9 +2604,17 @@ def make_pallas_multigen(
     returns None otherwise or wherever ``make_pallas_breed`` would
     decline. The same deme-size policy applies; D defaults smaller than
     the one-generation kernel's because scratch shares the VMEM budget.
+
+    ``_layout`` follows ``make_pallas_breed``: the auto default is the
+    alias-compatible ping-pong layout (launches write their deme group
+    back IN PLACE; the run loop alternates launch parity —
+    ``breed.padded(..., parity=p)``). Sub-block pipelining is
+    one-generation-only (the multigen kernel's whole point is keeping
+    the group VMEM-resident), so ``_subblock`` is ignored here.
     """
     if fused_obj is None:
         return None
+    _ablate = _validate_ablate(_ablate)
     shape = _kernel_shape(
         pop_size, genome_len, deme_size, tournament_size,
         selection_kind, selection_param, crossover_kind, mutate_kind,
@@ -1763,72 +2636,144 @@ def make_pallas_multigen(
         return None
     bf16_genes = gene_dtype == jnp.bfloat16
     P, L = pop_size, genome_len
-    K, G, D, Pp, Lp, selection_param = shape
+    K, G, D, Pp, Lp, selection_param, d_cands = shape
     if elitism >= K // 4:
         # Per-deme elitism at this scale would freeze most of each deme.
         return None
 
+    # _subblock is IGNORED here (not an error): the multigen kernel's
+    # whole point is a VMEM-resident deme group, which the sub-block
+    # streaming pipeline contradicts; the one-generation kernel is the
+    # sub-block carrier.
+    layout, D, _ = _resolve_layout(
+        _layout,
+        K=K, G=G, D=D, Pp=Pp, q=pingpong_quantum(gene_dtype),
+        d_candidates=d_cands, subblock=None, fused=True,
+        crossover_kind=crossover_kind, ablate=_ablate,
+        multigen=True,
+        padded_elitism=(Pp != P and elitism > 0),
+        d_pinned=_demes_per_step is not None,
+    )
+    pp = layout == "pingpong"
+    q = pingpong_quantum(gene_dtype)
+    S = G // D
+    Ablk = D * K // q
+
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    import numpy as np
 
     consts = tuple(jnp.atleast_2d(jnp.asarray(c)) for c in fused_consts)
     cross_kind, cross_consts = _breeding_kind(crossover_kind, L, Lp)
     mut_kind, mut_consts = _breeding_kind(mutate_kind, L, Lp)
 
-    kernel = partial(
-        _multigen_kernel,
-        K=K, D=D, L=L, Lp=Lp,
-        tk=tournament_size, sel=selection_kind, sel_param=selection_param,
-        crossover=cross_kind, mutate=mut_kind,
-        obj=fused_obj,
-        obj_pad_ok=bool(getattr(fused_obj, "pad_ok", False)),
-        n_consts=len(consts), n_cross=len(cross_consts),
-        n_mut=len(mut_consts), bf16_genes=bf16_genes, P=P,
-        elitism=elitism, ablate=tuple(_ablate),
-    )
-
     def _const_spec(c):
         return pl.BlockSpec(c.shape, lambda i: (0,) * c.ndim)
 
     smem = pltpu.SMEM
-    call = pl.pallas_call(
-        kernel,
-        grid=(G // D,),
-        in_specs=[
+    pp_padded = pp and Pp != P
+    alive = []
+    if pp_padded:
+        for par in (0, 1):
+            rows = pingpong_perm(par, Pp, D * K, q)
+            alive.append(
+                jnp.asarray(
+                    (rows < P).astype(np.float32).reshape(S, D, K)
+                )
+            )
+
+    T = K // q
+    view = [(S, T, D, q, Lp), (T, D, S, q, Lp)]
+    gspec = [
+        pl.BlockSpec((1, T, D, q, Lp), lambda i: (i, 0, 0, 0, 0)),
+        pl.BlockSpec((T, D, 1, q, Lp), lambda i: (0, 0, i, 0, 0)),
+    ]
+
+    def build_call(par):
+        kernel = partial(
+            _multigen_kernel,
+            K=K, D=D, L=L, Lp=Lp,
+            tk=tournament_size, sel=selection_kind,
+            sel_param=selection_param,
+            crossover=cross_kind, mutate=mut_kind,
+            obj=fused_obj,
+            obj_pad_ok=bool(getattr(fused_obj, "pad_ok", False)),
+            n_consts=len(consts), n_cross=len(cross_consts),
+            n_mut=len(mut_consts), bf16_genes=bf16_genes, P=P,
+            elitism=elitism, ablate=tuple(_ablate),
+            layout=layout, parity=par, q=q,
+        )
+        in_specs = [
             pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=smem),
             pl.BlockSpec((1, 2), lambda i: (0, 0), memory_space=smem),
             pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=smem),
             pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=smem),
             pl.BlockSpec((1, D, K), lambda i: (i, 0, 0)),
-            pl.BlockSpec((D * K, Lp), lambda i: (i, 0)),
-        ] + [_const_spec(c) for c in consts + cross_consts + mut_consts],
-        out_specs=[
-            pl.BlockSpec((K, 1, D, Lp), lambda i: (0, i, 0, 0)),
-            pl.BlockSpec((1, D, K), lambda i: (i, 0, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((K, G // D, D, Lp), gene_dtype),
-            jax.ShapeDtypeStruct((G // D, D, K), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((D * K, Lp), gene_dtype),
-            pltpu.VMEM((1, D, K), jnp.float32),
-        ] + (
-            _order_scratch_shapes(K, L, Lp)
-            if crossover_kind == "order" else []
-        ),
-        compiler_params=_grid_compiler_params(_ablate),
-    )
+            gspec[par] if pp else pl.BlockSpec((D * K, Lp), lambda i: (i, 0)),
+        ]
+        if pp_padded:
+            in_specs.append(pl.BlockSpec((1, D, K), lambda i: (i, 0, 0)))
+        in_specs += [
+            _const_spec(c) for c in consts + cross_consts + mut_consts
+        ]
+        return pl.pallas_call(
+            kernel,
+            grid=(S,),
+            in_specs=in_specs,
+            out_specs=[
+                gspec[par] if pp
+                else pl.BlockSpec((K, 1, D, Lp), lambda i: (0, i, 0, 0)),
+                pl.BlockSpec((1, D, K), lambda i: (i, 0, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct(
+                    view[par] if pp else (K, S, D, Lp), gene_dtype
+                ),
+                jax.ShapeDtypeStruct((S, D, K), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((D * K, Lp), gene_dtype),
+                pltpu.VMEM((1, D, K), jnp.float32),
+            ] + (
+                _order_scratch_shapes(K, L, Lp)
+                if crossover_kind == "order" else []
+            ),
+            input_output_aliases={5: 0} if pp else {},
+            compiler_params=_grid_compiler_params(_ablate),
+        )
+
+    calls = [build_call(0), build_call(1)] if pp else [build_call(0)]
 
     default_params = jnp.asarray(
         [[mutation_rate, mutation_sigma]], dtype=jnp.float32
     )
 
-    def breed_padded(gp, scores, key, steps, mparams=None, target=None):
+    def _to_cohort(s, par):
+        if not pp or par == 0:
+            return s
+        return jnp.swapaxes(s.reshape(Ablk, S, q), 0, 1).reshape(-1)
+
+    def _to_physical(s, par):
+        if not pp or par == 0:
+            return s
+        return jnp.swapaxes(s.reshape(S, Ablk, q), 0, 1).reshape(-1)
+
+    def _child_to_physical(cs, par):
+        """Launch-end scores (S, D, K), per resident deme, -> physical
+        rows of the interleaved writeback (chunk u of deme d at group
+        chunk u*D + d)."""
+        local = cs.reshape(S, D, T, q).swapaxes(1, 2).reshape(-1)
+        return _to_physical(local, par)
+
+    def breed_padded(gp, scores, key, steps, mparams=None, target=None,
+                     parity=0):
         """(Pp, Lp)-padded multi-generation breed. ``steps`` is a
         runtime i32 (0 = identity); pad rows must carry -inf scores on
         entry and do on exit. ``target`` freezes a deme group once its
-        best reaches it (None/+inf = never)."""
+        best reaches it (None/+inf = never). ``parity`` (ping-pong
+        layout only) selects the launch's row grouping — the run loop
+        alternates it so demes regroup between launches."""
         if mparams is None:
             mparams = default_params
         if target is None:
@@ -1839,23 +2784,37 @@ def make_pallas_multigen(
         )
         steps_a = jnp.asarray(steps, dtype=jnp.int32).reshape(1, 1)
         tgt_a = jnp.asarray(target, dtype=jnp.float32).reshape(1, 1)
-        s_in = scores.astype(jnp.float32).reshape(G // D, D, K)
-        genomes, cs = call(
-            seed, mparams, steps_a, tgt_a, s_in, gp,
-            *consts, *cross_consts, *mut_consts,
-        )
-        s2 = cs.reshape(G, K).T.reshape(Pp)
+        s_in = _to_cohort(
+            scores.astype(jnp.float32), parity
+        ).reshape(S, D, K)
+        if pp:
+            args = [seed, mparams, steps_a, tgt_a, s_in,
+                    gp.reshape(view[parity])]
+            if pp_padded:
+                args.append(alive[parity])
+            genomes, cs = calls[parity](
+                *args, *consts, *cross_consts, *mut_consts
+            )
+            s2 = _child_to_physical(cs, parity)
+        else:
+            genomes, cs = calls[0](
+                seed, mparams, steps_a, tgt_a, s_in, gp,
+                *consts, *cross_consts, *mut_consts,
+            )
+            s2 = cs.reshape(G, K).T.reshape(Pp)
         if Pp != P:
             s2 = jnp.where(jnp.arange(Pp, dtype=jnp.int32) < P, s2, -jnp.inf)
         return genomes.reshape(Pp, Lp), s2
 
-    def breed(genomes, scores, key, steps, mparams=None, target=None):
+    def breed(genomes, scores, key, steps, mparams=None, target=None,
+              parity=0):
         gp = genomes.astype(gene_dtype)
         if Lp != L or Pp != P:
             gp = jnp.pad(gp, ((0, Pp - P), (0, Lp - L)))
         if Pp != P:
             scores = jnp.pad(scores, (0, Pp - P), constant_values=-jnp.inf)
-        g2, s2 = breed_padded(gp, scores, key, steps, mparams, target)
+        g2, s2 = breed_padded(gp, scores, key, steps, mparams, target,
+                              parity)
         return g2[:P, :L], s2[:P]
 
     breed.padded = breed_padded
@@ -1870,6 +2829,10 @@ def make_pallas_multigen(
     breed.elitism = elitism
     breed.crossover_kind = crossover_kind
     breed.multigen = True
+    breed.layout = layout
+    breed.subblock = 1
+    breed.parities = 2 if pp else 1
+    breed.grid_steps = S
     return breed
 
 
@@ -1889,11 +2852,28 @@ def _multigen_run_loop(obj, bm, pop_size, genome_len, T, donate,
     with the launch-end stats (the kernel keeps demes VMEM-resident
     between sub-generations, so per-sub-generation stats don't exist
     outside the kernel) and the stall counter advances by the whole
-    launch width. Disabled path untouched."""
+    launch width. Disabled path untouched.
+
+    Ping-pong breeds: the carry additionally holds the LAUNCH counter,
+    whose parity selects the kernel's row grouping (lax.cond between
+    the two aliased pallas calls) — the double-buffer "carry parity" of
+    the in-place layout. Riffle breeds carry it too (dead weight of one
+    i32) so the two loop shapes stay identical."""
     from libpga_tpu.ops.evaluate import evaluate as _evaluate
     from libpga_tpu.utils import telemetry as _tl
 
     P, L, Pp, Lp = pop_size, genome_len, bm.Pp, bm.Lp
+    pingpong = getattr(bm, "layout", "riffle") == "pingpong"
+
+    def launch(g, s, sub, steps, mparams, target, lc):
+        if not pingpong:
+            return bm.padded(g, s, sub, steps, mparams, target)
+        return jax.lax.cond(
+            jnp.equal(lc & 1, 0),
+            lambda a: bm.padded(*a, parity=0),
+            lambda a: bm.padded(*a, parity=1),
+            (g, s, sub, steps, mparams, target),
+        )
 
     def masked_tail(s):
         if Pp == P:
@@ -1911,18 +2891,18 @@ def _multigen_run_loop(obj, bm, pop_size, genome_len, T, donate,
             )
 
             def cond(carry):
-                g, s, k, gen = carry
+                g, s, k, gen, lc = carry
                 return jnp.logical_and(gen < n, jnp.max(s) < target)
 
             def body(carry):
-                g, s, k, gen = carry
+                g, s, k, gen, lc = carry
                 k, sub = jax.random.split(k)
                 steps = jnp.minimum(jnp.int32(T), n - gen)
-                g2, s2 = bm.padded(g, s, sub, steps, mparams, target)
-                return (g2, s2, k, gen + steps)
+                g2, s2 = launch(g, s, sub, steps, mparams, target, lc)
+                return (g2, s2, k, gen + steps, lc + 1)
 
-            init = (gp, scores0, key, jnp.int32(0))
-            g, s, k, gens = jax.lax.while_loop(cond, body, init)
+            init = (gp, scores0, key, jnp.int32(0), jnp.int32(0))
+            g, s, k, gens, _ = jax.lax.while_loop(cond, body, init)
             return g[:P, :L], s[:P], gens
 
     else:
@@ -1936,27 +2916,30 @@ def _multigen_run_loop(obj, bm, pop_size, genome_len, T, donate,
             )
 
             def cond(carry):
-                g, s, k, gen, best, stall, buf = carry
+                g, s, k, gen, lc, best, stall, buf = carry
                 return jnp.logical_and(gen < n, jnp.max(s) < target)
 
             def body(carry):
-                g, s, k, gen, best, stall, buf = carry
+                g, s, k, gen, lc, best, stall, buf = carry
                 k, sub = jax.random.split(k)
                 steps = jnp.minimum(jnp.int32(T), n - gen)
-                g2, s2 = bm.padded(g, s, sub, steps, mparams, target)
+                g2, s2 = launch(g, s, sub, steps, mparams, target, lc)
                 # Stats on the live [:P] rows only (the pad tail carries
                 # -inf scores / zero genes).
                 row, best, stall = _tl.stats_row(
                     g2[:P, :L], s2[:P], best, stall, step=steps
                 )
                 buf = _tl.fill_rows(buf, gen, gen + steps, row)
-                return (g2, s2, k, gen + steps, best, stall, buf)
+                return (g2, s2, k, gen + steps, lc + 1, best, stall, buf)
 
             init = (
-                gp, scores0, key, jnp.int32(0), jnp.max(scores0),
-                jnp.int32(0), _tl.history_init(history_gens),
+                gp, scores0, key, jnp.int32(0), jnp.int32(0),
+                jnp.max(scores0), jnp.int32(0),
+                _tl.history_init(history_gens),
             )
-            g, s, k, gens, _, _, buf = jax.lax.while_loop(cond, body, init)
+            g, s, k, gens, _, _, _, buf = jax.lax.while_loop(
+                cond, body, init
+            )
             return g[:P, :L], s[:P], gens, buf
 
     return jax.jit(run_loop, donate_argnums=(0,) if donate else ())
@@ -1978,6 +2961,8 @@ def make_pallas_run(
     gene_dtype=jnp.float32,
     generations_per_launch: Optional[int] = None,
     history_gens: Optional[int] = None,
+    layout: Optional[str] = None,
+    subblock: Optional[int] = None,
 ) -> Optional[Callable]:
     """Build a per-shape factory for the fused run loop used by ``PGA.run``:
     ``build(pop_size, genome_len)`` returns a jitted
@@ -2048,6 +3033,7 @@ def make_pallas_run(
             crossover_kind=crossover_kind, mutate_kind=mutate_kind,
             fused_obj=fused_obj, fused_consts=fused_consts,
             gene_dtype=gene_dtype,
+            _layout=layout, _subblock=subblock,
         )
         if T > 1:
             bm = make_pallas_multigen(
@@ -2082,6 +3068,22 @@ def make_pallas_run(
             return None
 
         P, L, Pp, Lp = pop_size, genome_len, breed.Pp, breed.Lp
+        pingpong = getattr(breed, "layout", "riffle") == "pingpong"
+
+        def one_gen(g, s, sub, mparams, gen):
+            """One breed at the generation's parity. Ping-pong layouts
+            alternate the two aliased kernels via lax.cond (the cond
+            predicate is the loop-carried generation counter — the
+            'double-buffer carry parity'); riffle breeds dispatch
+            directly. Returns (g2, s2) for fused breeds, g2 otherwise."""
+            if not pingpong:
+                return breed.padded(g, s, sub, mparams)
+            return jax.lax.cond(
+                jnp.equal(gen & 1, 0),
+                lambda a: breed.padded(*a, parity=0),
+                lambda a: breed.padded(*a, parity=1),
+                (g, s, sub, mparams),
+            )
 
         def masked_tail(s):
             """Scores for pad rows pinned to -inf: they must never win the
@@ -2113,9 +3115,9 @@ def make_pallas_run(
                     k, sub = jax.random.split(k)
                     if breed.fused:
                         # tail already -inf; elitism applied inside breed
-                        g2, s2 = breed.padded(g, s, sub, mparams)
+                        g2, s2 = one_gen(g, s, sub, mparams, gen)
                     else:
-                        g2 = breed.padded(g, s, sub, mparams)
+                        g2 = one_gen(g, s, sub, mparams, gen)
                         s2 = masked_tail(jnp.pad(
                             _evaluate(obj, g2[:P, :L]), (0, Pp - P)
                         ))
@@ -2146,9 +3148,9 @@ def make_pallas_run(
                     g, s, k, gen, best, stall, buf = carry
                     k, sub = jax.random.split(k)
                     if breed.fused:
-                        g2, s2 = breed.padded(g, s, sub, mparams)
+                        g2, s2 = one_gen(g, s, sub, mparams, gen)
                     else:
-                        g2 = breed.padded(g, s, sub, mparams)
+                        g2 = one_gen(g, s, sub, mparams, gen)
                         s2 = masked_tail(jnp.pad(
                             _evaluate(obj, g2[:P, :L]), (0, Pp - P)
                         ))

@@ -119,10 +119,19 @@ def make_stacked_pallas_epoch(breed: Callable, m: int) -> Callable:
     it was the island path's largest overhead (see BASELINE.md round 3).
     Serves fused breeds only (they score children in-kernel and apply
     their own elitism epilogue); everything else goes through
-    :func:`make_island_epoch` under ``jax.vmap``."""
+    :func:`make_island_epoch` under ``jax.vmap``.
+
+    Ping-pong breeds alternate their two row groupings per generation.
+    The parity is STATIC per kernel build, so instead of a traced cond
+    the epoch scans generation PAIRS (parity 0 then parity 1) with an
+    odd-``m`` tail at parity 0 — gens 0,1,2,... run at parities
+    0,1,0,..., exactly like the single-population run loop. Each epoch
+    restarts at parity 0; the migration step between epochs mixes
+    globally anyway, so the restart costs nothing."""
     Lp, Pp = breed.Lp, breed.Pp
     gdtype = breed.gene_dtype
     takes_params = breed.takes_params
+    parities = getattr(breed, "parities", 1)
 
     def epoch(genomes, scores, keys, mparams=None):
         I, S, L = genomes.shape
@@ -135,7 +144,7 @@ def make_stacked_pallas_epoch(breed: Callable, m: int) -> Callable:
                 scores, ((0, 0), (0, Pp - S)), constant_values=-jnp.inf
             )
 
-        def body(carry, _):
+        def gen_step(carry, parity):
             g, s, ks = carry
             split2 = jax.vmap(jax.random.split)(ks)
             ks2, subs = split2[:, 0], split2[:, 1]
@@ -143,18 +152,35 @@ def make_stacked_pallas_epoch(breed: Callable, m: int) -> Callable:
             # disjoint from every island's kernel-seed stream (fold_in
             # is a PRF; padded_ranks only consumes split(key)[0]).
             tie_key = jax.random.fold_in(subs[0], 0x72616E6B)
-            ranks = breed.compute_ranks(s, tie_key)
+            ranks = breed.compute_ranks(s, tie_key, parity=parity)
             if takes_params and mparams is not None:
                 g2, s2 = jax.vmap(
                     lambda gi, si, ri, ki: breed.padded_ranks(
-                        gi, si, ri, ki, mparams
+                        gi, si, ri, ki, mparams, parity=parity
                     )
                 )(g, s, ranks, subs)
             else:
-                g2, s2 = jax.vmap(breed.padded_ranks)(g, s, ranks, subs)
-            return (g2, s2, ks2), None
+                g2, s2 = jax.vmap(
+                    lambda gi, si, ri, ki: breed.padded_ranks(
+                        gi, si, ri, ki, parity=parity
+                    )
+                )(g, s, ranks, subs)
+            return (g2, s2, ks2)
 
-        (g, s, ks), _ = jax.lax.scan(body, (g0, s0, keys), None, length=m)
+        carry = (g0, s0, keys)
+        if parities > 1:
+            def pair(carry, _):
+                return gen_step(gen_step(carry, 0), 1), None
+
+            carry, _ = jax.lax.scan(pair, carry, None, length=m // 2)
+            if m % 2:
+                carry = gen_step(carry, 0)
+        else:
+            def body(carry, _):
+                return gen_step(carry, 0), None
+
+            carry, _ = jax.lax.scan(body, carry, None, length=m)
+        g, s, ks = carry
         if pad:
             g = g[:, :S, :L]
             s = s[:, :S]
@@ -200,16 +226,22 @@ def make_multigen_stacked_epoch(bm: Callable, m: int) -> Callable:
             s = jnp.pad(s, ((0, 0), (0, Pp - S)), constant_values=-jnp.inf)
         ks = keys
         done = 0
+        launch = 0
         while done < m:  # static chunking: m and T are Python ints
             t = min(T, m - done)
+            # Ping-pong multigen: launch parity alternates the row
+            # grouping (static per launch — the loop is a Python
+            # unroll, so no traced cond is needed).
+            parity = launch % 2 if getattr(bm, "parities", 1) > 1 else 0
             split2 = jax.vmap(jax.random.split)(ks)
             ks, subs = split2[:, 0], split2[:, 1]
             g, s = jax.vmap(
                 lambda gi, si, ki: bm.padded(
-                    gi, si, ki, jnp.int32(t), mparams
+                    gi, si, ki, jnp.int32(t), mparams, None, parity
                 )
             )(g, s, subs)
             done += t
+            launch += 1
         if pad:
             g = g[:, :S, :L]
             s = s[:, :S]

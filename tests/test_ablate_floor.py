@@ -75,6 +75,36 @@ class TestVariantsRunAtToyShape:
             assert run is not None
             run(2)
 
+    def test_pingpong_alias_variant_runs_and_alternates(self):
+        """The shipped-lever variant (ISSUE 3): builds the ping-pong
+        breed and its loop body alternates parity via lax.cond — two
+        iterations exercise both aliased kernels."""
+        with _interpret():
+            run = _build("pingpong_alias", layout="pingpong")
+            assert run is not None
+            assert run.breed.layout == "pingpong"
+            assert run.breed.parities == 2
+            run(2)
+
+    def test_subblock_variant_runs_with_reduced_grid(self):
+        with _interpret():
+            base = _build("pingpong_alias", layout="pingpong")
+            run = _build("subblock", layout="pingpong", subblock=2)
+            assert run is not None
+            assert run.breed.subblock == 2
+            assert run.breed.grid_steps * 2 == base.breed.grid_steps
+            run(2)
+
+    def test_unknown_ablate_flag_raises_naming_valid_set(self):
+        """Satellite (ISSUE 3): a typo'd flag must raise instead of
+        silently measuring the full kernel."""
+        import pytest
+
+        with pytest.raises(ValueError) as ei:
+            _build("typo", ablate=("no_rifle",), fused=False)
+        assert "no_rifle" in str(ei.value)
+        assert "no_riffle" in str(ei.value)  # the valid set is named
+
 
 class TestCopyKernelIdentity:
     """The copy variants' correctness property: output == input up to

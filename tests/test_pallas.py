@@ -437,7 +437,9 @@ def test_fused_evaluation_scores_match_genome_order():
     must be reordered to match the riffle-shuffled genome rows: with zero
     PRNG bits child r is a copy of row 0 of deme r % G, so its fused score
     must equal obj(that row) — this pins the (G,K) transpose in
-    breed_padded against the genome output's k*G+i interleave."""
+    breed_padded against the genome output's k*G+i interleave.
+    (_layout="riffle": the fused default is now the ping-pong layout,
+    whose score ordering is pinned by tests/test_pingpong.py.)"""
     from libpga_tpu.objectives import onemax
 
     P, L, K = 1024, 20, 128
@@ -445,7 +447,7 @@ def test_fused_evaluation_scores_match_genome_order():
     with _interpret():
         breed = make_pallas_breed(
             P, L, deme_size=K, mutation_rate=0.0,
-            fused_obj=onemax.kernel_rowwise,
+            fused_obj=onemax.kernel_rowwise, _layout="riffle",
         )
         genomes = (
             jnp.broadcast_to(jnp.arange(P, dtype=jnp.float32)[:, None], (P, L))
@@ -630,9 +632,11 @@ def test_fused_elitism_preserves_top_rows():
     # scores unrelated to genome content: rows 131 and 7 are the elite
     scores = jnp.zeros((P,), jnp.float32).at[131].set(9.0).at[7].set(5.0)
     with _interpret():
+        # riffle layout pinned: the ping-pong elitism epilogue has its
+        # own structural test in tests/test_pingpong.py
         breed = make_pallas_breed(
             P, L, deme_size=K, mutation_rate=0.0, elitism=2,
-            fused_obj=onemax.kernel_rowwise,
+            fused_obj=onemax.kernel_rowwise, _layout="riffle",
         )
         assert breed is not None and breed.elitism == 2
         g2, s2 = breed(genomes, scores, jax.random.key(0))
@@ -860,7 +864,9 @@ def test_multigen_structure_matches_single_gen():
     """Zero PRNG bits + rank-0 scores: after any number of sub-gens the
     whole deme collapses onto copies of its original row 0 (every child
     descends from rank 0 and the fused score follows) — the same
-    structural expectation the one-generation kernel satisfies."""
+    structural expectation the one-generation kernel satisfies.
+    (_layout="riffle": the ping-pong multigen structure is pinned in
+    tests/test_pingpong.py.)"""
     from libpga_tpu.ops.pallas_step import make_pallas_multigen
 
     P, L, K = 512, 12, 128
@@ -868,7 +874,7 @@ def test_multigen_structure_matches_single_gen():
         fused, consts = _sum_obj()
         bm = make_pallas_multigen(
             P, L, deme_size=K, mutation_rate=0.0,
-            fused_obj=fused, fused_consts=consts,
+            fused_obj=fused, fused_consts=consts, _layout="riffle",
         )
         genomes = (
             jnp.broadcast_to(jnp.arange(P, dtype=jnp.float32)[:, None], (P, L))

@@ -1,0 +1,643 @@
+"""CPU structural tests for the alias-compatible ping-pong layout
+(ISSUE 3 tentpole — ops/pallas_step.py).
+
+The layout's correctness splits into pure ALGEBRA (the two parities'
+row groupings partition the population, every grid step writes exactly
+the rows it reads — the property that licenses ``input_output_aliases``
+— and the alternation connects every row to every group) and KERNEL
+structure (under zero interpret-mode PRNG bits every child copies its
+cohort's rank-0 row, so the output is exactly predictable from the
+algebra). Both are pinned here against ``pingpong_group_rows`` /
+``pingpong_perm``, the single source of truth the BlockSpec index maps
+mirror. Hardware-only properties (actual in-place buffer reuse, DMA
+overlap, throughput) are round-8-pending on the next attached chip via
+tools/ablate_floor.py's ``pingpong_alias`` / ``subblock`` variants.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from libpga_tpu.objectives import onemax
+from libpga_tpu.ops.pallas_step import (
+    _BLOCK_BYTES_LIMIT,
+    _SCOPED_VMEM_LIMIT,
+    _blocks_fit,
+    _scoped_vmem_bytes,
+    make_pallas_breed,
+    make_pallas_multigen,
+    pingpong_admissible,
+    pingpong_child_rows,
+    pingpong_group_rows,
+    pingpong_perm,
+    pingpong_quantum,
+)
+
+
+def _interpret():
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.force_tpu_interpret_mode()
+
+
+def _expected_rank0_copy(parity, Pp, W, q, K, P, genome_col, D=None, B=1):
+    """Zero-PRNG-bits expectation: every READ deme's children copy its
+    best alive row (scores strictly decreasing in physical row index →
+    rank 0 = the deme's minimal alive physical row), and land at the
+    WRITE-interleaved child rows (``pingpong_child_rows``) — the
+    read-layout-A / write-layout-B crux of the scheme."""
+    if D is None:
+        D = W // K
+    perm = pingpong_perm(parity, Pp, W, q)
+    child = pingpong_child_rows(parity, Pp, K, q, D, B)
+    out = np.zeros(Pp, np.float32)
+    for c in range(Pp // K):
+        rows = perm[c * K : (c + 1) * K]          # read cohort c
+        dest = child[c * K : (c + 1) * K]         # its children's rows
+        alive = rows[rows < P]
+        best = alive.min() if alive.size else rows.min()
+        # physical PAD rows may receive real children under the
+        # interleave — harmless, the caller masks their scores and
+        # slices [:P]; comparisons here only read [:P] too.
+        out[dest] = genome_col[min(best, len(genome_col) - 1)]
+    return out
+
+
+class TestLayoutAlgebra:
+    """Pure-numpy pins of the layout itself."""
+
+    @pytest.mark.parametrize("parity", [0, 1])
+    @pytest.mark.parametrize(
+        "Pp,W,q", [(4096, 512, 8), (2048, 256, 8), (8192, 1024, 16)]
+    )
+    def test_groups_partition_population(self, parity, Pp, W, q):
+        S = Pp // W
+        seen = np.zeros(Pp, bool)
+        for i in range(S):
+            rows = pingpong_group_rows(parity, i, W=W, S=S, q=q)
+            assert rows.shape == (W,)
+            assert not seen[rows].any(), "groups must be disjoint"
+            seen[rows] = True
+        assert seen.all(), "groups must cover every row"
+
+    @pytest.mark.parametrize(
+        "Pp,W,q", [(4096, 512, 8), (8192, 1024, 16)]
+    )
+    def test_alias_safety_write_set_equals_read_set(self, Pp, W, q):
+        """THE aliasing license: for each parity, the in and out
+        BlockSpecs are the same index map, i.e. step i's write rows ==
+        its read rows. At algebra level both are pingpong_group_rows;
+        equality across parities of the UNION (each a partition) plus
+        the kernel-structure tests below (which verify the kernel's
+        actual writes land on the algebra's rows) pin it."""
+        S = Pp // W
+        K, D = 128, W // 128
+        for parity in (0, 1):
+            perm = pingpong_perm(parity, Pp, W, q)
+            child = pingpong_child_rows(parity, Pp, K, q, D)
+            for i in range(S):
+                rows = pingpong_group_rows(parity, i, W=W, S=S, q=q)
+                # read map: group i's slot range is exactly these rows
+                np.testing.assert_array_equal(
+                    perm[i * W : (i + 1) * W], rows
+                )
+                # write map: the interleaved child placement PERMUTES
+                # the same row set — writes never leave the step's rows
+                assert set(child[i * W : (i + 1) * W]) == set(rows), (
+                    f"parity {parity} group {i}: children escaped"
+                )
+
+    def test_parity1_is_a_strided_comb(self):
+        Pp, W, q = 4096, 512, 8
+        S = Pp // W
+        rows = pingpong_group_rows(1, 3, W=W, S=S, q=q)
+        # A chunks of q consecutive rows at stride S*q, offset i*q
+        A = W // q
+        for a in range(A):
+            chunk = rows[a * q : (a + 1) * q]
+            np.testing.assert_array_equal(
+                chunk, np.arange(a * S * q + 3 * q, a * S * q + 4 * q)
+            )
+
+    def test_admissibility_gate(self):
+        # A >= S <=> W^2 >= Pp*q — the full-coverage mixing condition
+        assert pingpong_admissible(4096, 1 << 20, 8)       # f32 1M D=8 K=512
+        assert not pingpong_admissible(2048, 1 << 20, 16)  # bf16 1M D=4 K=512
+        assert pingpong_admissible(4096, 1 << 20, 16)      # bf16 1M D=8 K=512
+        assert not pingpong_admissible(512, 1 << 20, 8)    # D=1 at 1M
+        assert not pingpong_admissible(0, 1024, 8)
+        assert not pingpong_admissible(513, 1024, 8)       # q-misaligned
+        assert not pingpong_admissible(384, 1024, 8)       # W does not divide
+
+    def test_quantum_is_the_dtype_sublane_tile(self):
+        assert pingpong_quantum(jnp.float32) == 8
+        assert pingpong_quantum(jnp.bfloat16) == 16
+
+    def test_lineage_reaches_every_cohort_in_few_generations(self):
+        """THE mixing pin, at the granularity that matters: selection
+        COHORTS (K rows), through the real read maps (pingpong_perm)
+        and write maps (pingpong_child_rows). A lineage starting in any
+        single cohort must reach EVERY cohort of both parities within a
+        few alternating generations — the property whose absence (the
+        read==write-per-deme variant) fragments the population into
+        closed super-blocks and stalls takeover (see
+        tools/selection_equivalence.py --simulate)."""
+        Pp, K, D, q = 4096, 128, 4, 8  # W=512, S=8, A=64 >= 8
+        W = D * K
+        C = Pp // K  # cohorts per parity
+        maps = {}
+        for parity in (0, 1):
+            perm = pingpong_perm(parity, Pp, W, q).reshape(C, K)
+            child = pingpong_child_rows(parity, Pp, K, q, D).reshape(C, K)
+            row_cohort = np.empty(Pp, np.int64)
+            for c in range(C):
+                row_cohort[perm[c]] = c
+            maps[parity] = (perm, child, row_cohort)
+        # breadth-first lineage spread from cohort 0, alternating parity
+        rows = set(maps[0][0][0])  # rows of parity-0 cohort 0
+        for gen in range(6):
+            parity = gen % 2
+            perm, child, row_cohort = maps[parity]
+            cohorts = {row_cohort[r] for r in rows}
+            rows = set()
+            for c in cohorts:
+                rows.update(child[c])  # children land here
+        final = {maps[0][2][r] for r in rows}
+        assert final == set(range(C)), (
+            f"lineage reached only {len(final)}/{C} cohorts in 6 gens"
+        )
+
+    def test_inadmissible_shape_really_disconnects(self):
+        """The gate's reason for existing: at A < S the two partitions
+        leave row components that NEVER exchange individuals (the
+        middle index bits are never regrouped), so the layout must not
+        ship there."""
+        Pp, W, q = 4096, 128, 8  # A=16 < S=32 — inadmissible
+        S = Pp // W
+        assert not pingpong_admissible(W, Pp, q)
+        # union-find over the two partitions' groups
+        parent = list(range(Pp))
+
+        def find(x):
+            while parent[x] != x:
+                parent[x] = parent[parent[x]]
+                x = parent[x]
+            return x
+
+        def union(a, b):
+            parent[find(a)] = find(b)
+
+        for parity in (0, 1):
+            for i in range(S):
+                rows = pingpong_group_rows(parity, i, W=W, S=S, q=q)
+                for r in rows[1:]:
+                    union(int(rows[0]), int(r))
+        comps = {find(r) for r in range(Pp)}
+        assert len(comps) > 1, "expected disconnected components below gate"
+
+
+class TestOneGenKernel:
+    """Interpret-mode structure: the kernel's writes land exactly where
+    the algebra says, for BOTH parities, and in place."""
+
+    @pytest.mark.parametrize("parity", [0, 1])
+    def test_rank0_structure_matches_algebra(self, parity):
+        P, L, K, D = 512, 16, 128, 2
+        with _interpret():
+            breed = make_pallas_breed(
+                P, L, deme_size=K, mutation_rate=0.0,
+                fused_obj=onemax.kernel_rowwise,
+                _demes_per_step=D, _layout="pingpong",
+            )
+            assert breed is not None and breed.layout == "pingpong"
+            assert breed.parities == 2
+            g = jnp.broadcast_to(
+                jnp.arange(P, dtype=jnp.float32)[:, None], (P, L)
+            ) / P
+            s = -jnp.arange(P, dtype=jnp.float32)  # rank0 = min physical row
+            g2, s2 = breed(g, s, jax.random.key(0), parity=parity)
+        W = breed.D * breed.K
+        q = pingpong_quantum(jnp.float32)
+        expect = _expected_rank0_copy(
+            parity, breed.Pp, W, q, breed.K, P, np.arange(P) / P
+        )
+        np.testing.assert_allclose(
+            np.asarray(g2)[:, 0], expect[:P], atol=2e-5, rtol=0
+        )
+        # fused scores travel with their genome rows (physical order)
+        np.testing.assert_allclose(
+            np.asarray(s2), np.asarray(g2).sum(axis=1), atol=1e-4, rtol=0
+        )
+
+    def test_children_never_leave_their_group(self):
+        """Alias safety, kernel-level: encode group membership in the
+        genes and check every output row's value originated in its own
+        group — a step writing another step's rows would break this."""
+        P, L, K, D = 1024, 8, 128, 2
+        with _interpret():
+            breed = make_pallas_breed(
+                P, L, deme_size=K, mutation_rate=0.0,
+                fused_obj=onemax.kernel_rowwise,
+                _demes_per_step=D, _layout="pingpong",
+            )
+            W = breed.D * breed.K
+            S = breed.Pp // W
+            q = pingpong_quantum(jnp.float32)
+            for parity in (0, 1):
+                member = np.zeros(P, np.float32)
+                for i in range(S):
+                    rows = pingpong_group_rows(parity, i, W=W, S=S, q=q)
+                    member[rows[rows < P]] = (i + 1) / (S + 1)
+                g = jnp.broadcast_to(
+                    jnp.asarray(member)[:, None], (P, L)
+                ).astype(jnp.float32)
+                s = jax.random.normal(jax.random.key(parity), (P,))
+                g2, _ = breed(g, s, jax.random.key(1), parity=parity)
+                np.testing.assert_allclose(
+                    np.asarray(g2)[:, 0], member, atol=2e-5, rtol=0,
+                    err_msg=f"parity {parity}: children crossed groups",
+                )
+
+    def test_in_place_aliasing_is_declared(self):
+        """The shipped default must carry input_output_aliases — pinned
+        by jaxpr inspection (interpret mode executes it functionally;
+        hardware reuses the buffer)."""
+        P, L, K = 512, 16, 128
+        with _interpret():
+            breed = make_pallas_breed(
+                P, L, deme_size=K, fused_obj=onemax.kernel_rowwise,
+            )
+            assert breed.layout == "pingpong", "pingpong must be the default"
+            gp = jax.random.uniform(jax.random.key(0), (breed.Pp, breed.Lp))
+            sp = jnp.sum(gp[:, :L], axis=1)
+            jaxpr = jax.make_jaxpr(
+                lambda g, s: breed.padded(g, s, jax.random.key(1))
+            )(gp, sp)
+        txt = str(jaxpr)
+        assert "input_output_aliases" in txt and "(3, 0)" in txt, (
+            "genome input must alias the genome output"
+        )
+
+    def test_fused_default_is_pingpong_nonfused_is_riffle(self):
+        with _interpret():
+            fused = make_pallas_breed(
+                512, 16, deme_size=128, fused_obj=onemax.kernel_rowwise
+            )
+            plain = make_pallas_breed(512, 16, deme_size=128)
+        assert fused.layout == "pingpong"
+        assert plain.layout == "riffle"
+
+    def test_explicit_pingpong_raises_when_gate_fails(self):
+        # D=1 at a shape where W=K fails A >= S
+        with pytest.raises(ValueError, match="pingpong"):
+            make_pallas_breed(
+                1 << 15, 16, deme_size=128, _demes_per_step=1,
+                fused_obj=onemax.kernel_rowwise, _layout="pingpong",
+            )
+
+    def test_layout_ablations_are_riffle_only(self):
+        with pytest.raises(ValueError, match="riffle"):
+            make_pallas_breed(
+                512, 16, deme_size=128, fused_obj=onemax.kernel_rowwise,
+                _layout="pingpong", _ablate=("no_riffle",),
+            )
+
+
+class TestPaddedPopulation:
+    """Satellite: the round-2 'pad rows are inert' guarantees extended
+    to BOTH parities of the new layout — pad rows excluded from
+    tournaments, pad lanes zero."""
+
+    @pytest.mark.parametrize("parity", [0, 1])
+    def test_pads_never_selected_and_pad_lanes_zero(self, parity):
+        # P=300 at K=128 pads to 384; D=1 would fail the gate, so pick
+        # P=1000 -> Pp=1024, G=8, D=2: W=256, S=4, A=32 >= 4.
+        P, L, K, D = 1000, 12, 128, 2
+        with _interpret():
+            breed = make_pallas_breed(
+                P, L, deme_size=K, mutation_rate=0.0,
+                fused_obj=onemax.kernel_rowwise,
+                _demes_per_step=D, _layout="pingpong",
+            )
+            assert breed.Pp == 1024
+            g = jnp.broadcast_to(
+                jnp.arange(P, dtype=jnp.float32)[:, None], (P, L)
+            ) / P
+            # NaN scores on real rows still must not select pads
+            s = -jnp.arange(P, dtype=jnp.float32)
+            g2, s2 = breed(g, s, jax.random.key(0), parity=parity)
+            # padded variant: the pad tail itself
+            gp = jnp.pad(g, ((0, breed.Pp - P), (0, breed.Lp - L)))
+            sp = jnp.pad(s, (0, breed.Pp - P), constant_values=-jnp.inf)
+            gp2, sp2 = breed.padded(gp, sp, jax.random.key(0), parity=parity)
+        g2 = np.asarray(g2)
+        # zero-bits children copy their deme's best ALIVE row — never a
+        # pad (pads carry zero genes; real genomes here are >= 1/P only
+        # for rows >= 1, so check value membership in real rows)
+        W = breed.D * breed.K
+        q = pingpong_quantum(jnp.float32)
+        expect = _expected_rank0_copy(
+            parity, breed.Pp, W, q, breed.K, P, np.arange(P) / P
+        )
+        np.testing.assert_allclose(g2[:, 0], expect[:P], atol=2e-5, rtol=0)
+        # pad-row scores masked, pad LANES zero in the padded output
+        sp2, gp2 = np.asarray(sp2), np.asarray(gp2)
+        assert np.all(np.isneginf(sp2[P:]))
+        assert np.all(gp2[:, L:] == 0.0), "pad lanes must stay zero"
+
+    @pytest.mark.parametrize("parity", [0, 1])
+    def test_padded_gaussian_keeps_pad_lanes_zero(self, parity):
+        P, L, K, D = 1000, 12, 128, 2
+        with _interpret():
+            breed = make_pallas_breed(
+                P, L, deme_size=K, mutation_rate=1.0,
+                mutation_sigma=0.5, mutate_kind="gaussian",
+                fused_obj=onemax.kernel_rowwise,
+                _demes_per_step=D, _layout="pingpong",
+            )
+            gp = jnp.pad(
+                jax.random.uniform(jax.random.key(2), (P, L)),
+                ((0, breed.Pp - P), (0, breed.Lp - L)),
+            )
+            sp = jnp.pad(
+                jnp.sum(gp[:P, :L], axis=1), (0, breed.Pp - P),
+                constant_values=-jnp.inf,
+            )
+            gp2, _ = breed.padded(gp, sp, jax.random.key(0), parity=parity)
+        assert np.all(np.asarray(gp2)[:, L:] == 0.0)
+
+
+class TestElitismAndMultigen:
+    def test_elitism_epilogue_both_parities(self):
+        """Fused elitism with the in-place layout: elites are gathered
+        BEFORE the kernel (no post-call read of the pre-breed buffer)
+        and land in physical rows 0..e-1 with their scores."""
+        P, L, K = 256, 8, 128
+        genomes = (
+            jnp.broadcast_to(
+                jnp.arange(P, dtype=jnp.float32)[:, None], (P, L)
+            ) / P
+        )
+        scores = jnp.zeros((P,), jnp.float32).at[131].set(9.0).at[7].set(5.0)
+        with _interpret():
+            breed = make_pallas_breed(
+                P, L, deme_size=K, mutation_rate=0.0, elitism=2,
+                fused_obj=onemax.kernel_rowwise, _layout="pingpong",
+            )
+            for parity in (0, 1):
+                g2, s2 = breed(genomes, scores, jax.random.key(0),
+                               parity=parity)
+                g2, s2 = np.asarray(g2), np.asarray(s2)
+                gn = np.asarray(genomes)
+                np.testing.assert_array_equal(g2[0], gn[131])
+                np.testing.assert_array_equal(g2[1], gn[7])
+                assert s2[0] == 9.0 and s2[1] == 5.0
+
+    def test_multigen_zero_steps_is_the_interleave_permutation(self):
+        """steps=0 passes the population through the launch-boundary
+        write interleave ONLY: output row ``child_rows[x]`` must be
+        input row ``perm[x]`` exactly, scores aligned — pinning the
+        writeback map against the algebra."""
+        P, L = 512, 20
+        with _interpret():
+            bm = make_pallas_multigen(
+                P, L, deme_size=128, fused_obj=onemax.kernel_rowwise,
+                fused_consts=tuple(
+                    getattr(onemax, "kernel_rowwise_consts", ())
+                ),
+                _layout="pingpong",
+            )
+            assert bm is not None and bm.layout == "pingpong"
+            g = jax.random.uniform(jax.random.key(1), (P, L))
+            s = jnp.sum(g, axis=1)
+            q = pingpong_quantum(jnp.float32)
+            W = bm.D * bm.K
+            for parity in (0, 1):
+                g0, s0 = bm(g, s, jax.random.key(0), 0, None, None, parity)
+                g0, s0 = np.asarray(g0), np.asarray(s0)
+                perm = pingpong_perm(parity, bm.Pp, W, q)
+                child = pingpong_child_rows(parity, bm.Pp, bm.K, q, bm.D)
+                gn = np.asarray(g)
+                np.testing.assert_array_equal(g0[child], gn[perm])
+                np.testing.assert_allclose(
+                    np.asarray(s0)[child], np.asarray(s)[perm], rtol=1e-5
+                )
+
+    @pytest.mark.parametrize("parity", [0, 1])
+    def test_multigen_rank0_structure(self, parity):
+        P, L, K, D = 1024, 12, 128, 2
+        with _interpret():
+            bm = make_pallas_multigen(
+                P, L, deme_size=K, mutation_rate=0.0,
+                fused_obj=onemax.kernel_rowwise,
+                _demes_per_step=D, _layout="pingpong",
+            )
+            assert bm.layout == "pingpong" and bm.D == D
+            g = jnp.broadcast_to(
+                jnp.arange(P, dtype=jnp.float32)[:, None], (P, L)
+            ) / P
+            s = -jnp.arange(P, dtype=jnp.float32)
+            g2, s2 = bm(g, s, jax.random.key(0), 1, None, None, parity)
+        W = bm.D * bm.K
+        q = pingpong_quantum(jnp.float32)
+        expect = _expected_rank0_copy(
+            parity, bm.Pp, W, q, bm.K, P, np.arange(P) / P
+        )
+        np.testing.assert_allclose(
+            np.asarray(g2)[:, 0], expect[:P], atol=2e-5, rtol=0
+        )
+        np.testing.assert_allclose(
+            np.asarray(s2), np.asarray(g2).sum(axis=1), atol=1e-4, rtol=0
+        )
+
+    def test_multigen_padded_alive_mask(self):
+        """Padded multigen under ping-pong: the static alive mask
+        replaces the positional tail; children stay real-rooted and
+        scores consistent for both parities."""
+        P, L, K, D = 1000, 12, 128, 2
+        with _interpret():
+            bm = make_pallas_multigen(
+                P, L, deme_size=K, fused_obj=onemax.kernel_rowwise,
+                _demes_per_step=D, _layout="pingpong",
+            )
+            assert bm.Pp == 1024 and bm.layout == "pingpong"
+            g = jax.random.uniform(jax.random.key(2), (P, L))
+            s = jnp.sum(g, axis=1)
+            for parity in (0, 1):
+                g2, s2 = bm(g, s, jax.random.key(0), 3, None, None, parity)
+                assert np.all(np.isfinite(np.asarray(s2)))
+                np.testing.assert_allclose(
+                    np.asarray(s2), np.asarray(g2).sum(axis=1), rtol=1e-4
+                )
+
+    def test_multigen_padded_elitism_falls_back_to_riffle(self):
+        """A pad row can occupy a parity-1 cohort's elite slot, so the
+        auto resolver must keep padded+elitism multigen on the riffle."""
+        with _interpret():
+            bm = make_pallas_multigen(
+                1000, 12, deme_size=128, elitism=2,
+                fused_obj=onemax.kernel_rowwise, _demes_per_step=2,
+            )
+        assert bm.layout == "riffle"
+
+
+class TestSubblockPipeline:
+    """The second tentpole lever: B sub-blocks per grid step via the
+    manual double-buffered DMA pipeline."""
+
+    def test_grid_shrinks_2x_at_bench_shape_constant_vmem(self):
+        """Acceptance pin, pure arithmetic: at the 1M x 100 f32 bench
+        shape the riffle kernel needs G/D = 256 grid steps (VMEM caps
+        D at 8); sub-block B=2 halves that AND B=4 quarters it, at the
+        SAME per-sub-block scoped-VMEM model (the streamed scratch pair
+        equals Mosaic's double-buffered block allowance)."""
+        K, Lp, P = 512, 128, 1 << 20
+        G = P // K  # 2048
+        # VMEM model: D=8 fits, D=16 does not — the dispatch floor
+        assert _blocks_fit(K, 8, Lp, 4) and not _blocks_fit(K, 16, Lp, 4)
+        riffle_steps = G // 8
+        assert riffle_steps == 256
+        # sub-blocking at D=8 per sub-block keeps the same scoped model
+        assert _scoped_vmem_bytes(K, 8, Lp, 4) <= _SCOPED_VMEM_LIMIT
+        assert 4 * 8 * K * Lp * 4 <= _BLOCK_BYTES_LIMIT
+        for B in (2, 4):
+            assert G % (B * 8) == 0
+            assert riffle_steps // B * B == riffle_steps
+            assert riffle_steps / (G // (B * 8)) == B
+        assert G // (2 * 8) == 128 <= riffle_steps // 2
+
+    def test_subblock_factory_reports_grid_reduction(self):
+        P, L, K, D = 1024, 16, 128, 2
+        with _interpret():
+            b1 = make_pallas_breed(
+                P, L, deme_size=K, fused_obj=onemax.kernel_rowwise,
+                _demes_per_step=D, _layout="pingpong",
+            )
+            b2 = make_pallas_breed(
+                P, L, deme_size=K, fused_obj=onemax.kernel_rowwise,
+                _demes_per_step=D, _layout="pingpong", _subblock=2,
+            )
+        assert b1.grid_steps == 4 and b2.grid_steps == 2
+        assert b2.subblock == 2 and b2.D == 2 * D
+
+    @pytest.mark.parametrize("parity", [0, 1])
+    def test_subblock_children_match_algebra(self, parity):
+        """The streamed pipeline must produce the same structural
+        children as the algebra predicts for its (wider) groups."""
+        P, L, K, D, B = 1024, 12, 128, 2, 2
+        with _interpret():
+            breed = make_pallas_breed(
+                P, L, deme_size=K, mutation_rate=0.0,
+                fused_obj=onemax.kernel_rowwise,
+                _demes_per_step=D, _layout="pingpong", _subblock=B,
+            )
+            assert breed.subblock == B and breed.D == B * D
+            g = jnp.broadcast_to(
+                jnp.arange(P, dtype=jnp.float32)[:, None], (P, L)
+            ) / P
+            s = -jnp.arange(P, dtype=jnp.float32)
+            g2, s2 = breed(g, s, jax.random.key(0), parity=parity)
+        W = breed.D * breed.K
+        q = pingpong_quantum(jnp.float32)
+        expect = _expected_rank0_copy(
+            parity, breed.Pp, W, q, breed.K, P, np.arange(P) / P,
+            D=breed.D // breed.subblock, B=breed.subblock,
+        )
+        np.testing.assert_allclose(
+            np.asarray(g2)[:, 0], expect[:P], atol=2e-5, rtol=0
+        )
+        np.testing.assert_allclose(
+            np.asarray(s2), np.asarray(g2).sum(axis=1), atol=1e-4, rtol=0
+        )
+
+    def test_multigen_ignores_subblock(self):
+        with _interpret():
+            bm = make_pallas_multigen(
+                512, 16, deme_size=128, fused_obj=onemax.kernel_rowwise,
+                _subblock=4,
+            )
+        assert bm is not None and bm.subblock == 1
+
+
+class TestRunLoopParity:
+    def test_multigen_run_loop_alternates_and_lands_exactly(self):
+        """The chunked run loop still lands exactly on n with the
+        parity-alternating lax.cond dispatch in the carry."""
+        from libpga_tpu.objectives import get as get_obj
+        from libpga_tpu.ops.pallas_step import (
+            _multigen_run_loop, make_pallas_multigen,
+        )
+
+        obj = get_obj("onemax")
+        P, L = 512, 20
+        with _interpret():
+            bm = make_pallas_multigen(
+                P, L, deme_size=128, fused_obj=obj.kernel_rowwise,
+                fused_consts=tuple(
+                    getattr(obj, "kernel_rowwise_consts", ())
+                ),
+                _layout="pingpong",
+            )
+            assert bm.layout == "pingpong"
+            run = _multigen_run_loop(obj, bm, P, L, 3, donate=False)
+            g = jax.random.uniform(jax.random.key(1), (P, L))
+            g2, s2, gens = run(
+                g, jax.random.key(0), jnp.int32(10), jnp.float32(jnp.inf),
+                bm.default_params,
+            )
+        assert int(gens) == 10
+        np.testing.assert_allclose(
+            np.asarray(s2), np.asarray(jnp.sum(g2, axis=1)), rtol=1e-4
+        )
+
+    def test_island_stacked_epoch_parity_pairs(self):
+        """run_islands_stacked over a ping-pong breed: the epoch's
+        pair-scan (+ odd tail) keeps carried scores consistent with
+        the carried genomes."""
+        from libpga_tpu.parallel.islands import run_islands_stacked
+
+        I, S, L, K = 2, 512, 20, 128
+        with _interpret():
+            breed = make_pallas_breed(
+                S, L, deme_size=K, mutation_rate=0.0,
+                fused_obj=onemax.kernel_rowwise, _layout="pingpong",
+            )
+            assert breed.fused and breed.parities == 2
+            stacked = jax.random.uniform(jax.random.key(0), (I, S, L))
+            genomes, scores, gens = run_islands_stacked(
+                breed, onemax, stacked, jax.random.key(1), n=3, m=3,
+                pct=0.05,
+            )
+        assert gens == 3
+        np.testing.assert_allclose(
+            np.asarray(scores), np.asarray(genomes).sum(axis=2),
+            atol=2e-4, rtol=0,
+        )
+
+
+class TestAblateFlagValidation:
+    """Satellite: unknown ablation flags must raise, naming the valid
+    set, instead of silently measuring the full kernel."""
+
+    def test_unknown_flag_raises_with_valid_set(self):
+        with pytest.raises(ValueError) as ei:
+            make_pallas_breed(512, 16, deme_size=128, _ablate=("no_rifle",))
+        msg = str(ei.value)
+        assert "no_rifle" in msg and "no_riffle" in msg
+        assert "copy_only" in msg  # names the valid set
+
+    def test_unknown_flag_raises_on_multigen(self):
+        with pytest.raises(ValueError, match="unknown ablation flag"):
+            make_pallas_multigen(
+                512, 16, deme_size=128, fused_obj=onemax.kernel_rowwise,
+                _ablate=("serail_grid",),
+            )
+
+    def test_known_flags_still_accepted(self):
+        with _interpret():
+            b = make_pallas_breed(
+                512, 16, deme_size=128,
+                _ablate=("copy_only", "no_rank_sort"),
+            )
+        assert b is not None

@@ -32,6 +32,15 @@ future rounds know which lever is real:
   full / full_serial / full_nodonate   the production step, and A/Bs
                    for the parallel grid dimension_semantics and jit
                    buffer donation
+  full_riffle      the production step pinned to the pre-ISSUE-3
+                   riffle layout (comparable to rounds <= 7 numbers)
+  pingpong_alias   the production step on the shipped alias-compatible
+                   ping-pong layout: in-place children via
+                   input_output_aliases, parity-alternating kernels —
+                   the riffle_stride + alias_headroom levers SHIPPED
+  subblock         ping-pong + the manually double-buffered sub-block
+                   pipeline (--subblock-b groups per grid step): the
+                   grid_steps lever shipped — G/(B*D) dispatches
   --dsweep         copy_riffle at every admissible D (fixed K): fits
                    t(D) = a + b·(G/D), attributing per-grid-step
                    dispatch overhead from the slope
@@ -71,11 +80,17 @@ COPY = ("copy_only", "no_rank_sort")
 
 def build_variant(
     name, dt, K, D, pop, L, ablate=(), fused=True, donate=True,
-    interpret_ok=False,
+    interpret_ok=False, layout=None, subblock=None,
 ):
     """Build ``(loop, gp, sp)`` for one ablation variant: a jitted
     fori_loop driving ``breed.padded`` n times, plus the padded inputs.
-    Mirrors tools/ablate_kernel.py's loop so numbers stay comparable."""
+    Mirrors tools/ablate_kernel.py's loop so numbers stay comparable.
+
+    ``layout``/``subblock`` select the output layout (ISSUE 3 levers):
+    a ping-pong breed's loop body alternates the generation parity via
+    lax.cond exactly like the shipped run loop, so its timing includes
+    the real dispatch pattern (two alternating aliased kernels), not a
+    single-parity approximation."""
     from libpga_tpu.objectives import onemax
     from libpga_tpu.ops.pallas_step import make_pallas_breed
 
@@ -83,16 +98,29 @@ def build_variant(
         pop, L, deme_size=K,
         fused_obj=onemax.kernel_rowwise if fused else None,
         gene_dtype=dt, _demes_per_step=D, _ablate=tuple(ablate),
+        _layout=layout, _subblock=subblock,
     )
     if breed is None:
         return None
     if not interpret_ok:
-        assert breed.K == K and breed.D == D, (name, breed.K, breed.D)
+        assert breed.K == K, (name, breed.K)
+        if layout is None:
+            assert breed.D == D, (name, breed.D)
 
-    def body(_, carry):
+    pingpong = getattr(breed, "layout", "riffle") == "pingpong"
+
+    def body(i, carry):
         g, s, key = carry
         key, sub = jax.random.split(key)
-        out = breed.padded(g, s, sub)
+        if pingpong:
+            out = jax.lax.cond(
+                jnp.equal(i & 1, 0),
+                lambda a: breed.padded(*a, parity=0),
+                lambda a: breed.padded(*a, parity=1),
+                (g, s, sub),
+            )
+        else:
+            out = breed.padded(g, s, sub)
         g, s = out if breed.fused else (out, s)
         return g, s, key
 
@@ -290,6 +318,12 @@ def main() -> None:
     ap.add_argument("--rounds", type=int, default=5)
     ap.add_argument("--dsweep", action="store_true")
     ap.add_argument("--tsweep", action="store_true")
+    ap.add_argument(
+        "--subblock-b", type=int, default=2, dest="subblock_b",
+        help="sub-blocks per grid step for the 'subblock' variant "
+        "(grid shrinks this many x; 2 and 4 are the shapes the model "
+        "tests pin)",
+    )
     ap.add_argument("--json", default=None)
     args = ap.parse_args()
 
@@ -305,8 +339,29 @@ def main() -> None:
         D = 4 if dt == jnp.bfloat16 else 8
 
     mk = lambda name, **kw: build_variant(name, dt, K, D, pop, L, **kw)
+
+    def mk_pp(name, **kw):
+        # The ping-pong levers can be inadmissible at swept shapes
+        # (mixing gate / divisibility): drop the variant rather than
+        # abort the whole attribution run.
+        try:
+            return build_variant(name, dt, K, D, pop, L, **kw)
+        except ValueError as exc:
+            print(f"# {name}: skipped ({exc})", flush=True)
+            return None
+
     runners = {
         "full": mk("full"),
+        # The shipped-default A/B pair (ISSUE 3): the riffle layout the
+        # rounds <= 7 numbers measured, vs the alias-compatible
+        # ping-pong layout (in-place children, parity-alternating
+        # kernels), vs ping-pong + the sub-block pipeline collapsing
+        # the grid a further --subblock-b x.
+        "full_riffle": mk("full_riffle", layout="riffle"),
+        "pingpong_alias": mk_pp("pingpong_alias", layout="pingpong"),
+        "subblock": mk_pp(
+            "subblock", layout="pingpong", subblock=args.subblock_b
+        ),
         "full_serial": mk("full_serial", ablate=("serial_grid",)),
         "full_nodonate": mk("full_nodonate", donate=False),
         "floor": mk("floor", ablate=FLOOR_ABLATE, fused=False),
@@ -390,6 +445,21 @@ def main() -> None:
     out = {
         "dtype": name, "K": K, "D": D, "pop": pop, "genome_len": L,
         "rounds": args.rounds,
+        "subblock_b": args.subblock_b,
+        # dispatch-count bookkeeping for the layout variants: the
+        # quantity the grid_steps lever moves
+        "layout_variants": {
+            n: {
+                "layout": r.breed.layout,
+                "demes_per_step": r.breed.D,
+                "grid_steps": getattr(
+                    r.breed, "grid_steps", G // r.breed.D
+                ),
+            }
+            for n, r in runners.items()
+            if hasattr(r, "breed")
+            and n in ("full", "full_riffle", "pingpong_alias", "subblock")
+        },
         "medians_ms_per_gen": {k: round(v, 4) for k, v in med.items()},
         "floor_partition": [
             {"component": c, "ms": round(v, 4), "method": m}

@@ -61,6 +61,11 @@ def measure(dt, K, D, ablate, fused=True):
         POP, L, deme_size=K,
         fused_obj=onemax.kernel_rowwise if fused else None,
         gene_dtype=dt, _demes_per_step=D, _ablate=ablate,
+        # Riffle pinned: stage deltas must all share ONE output layout
+        # (some ablation flags are riffle-only, and the fused default
+        # is now the ping-pong layout — its A/B lives in
+        # tools/ablate_floor.py, not in this stage harness).
+        _layout="riffle",
     )
     assert breed is not None and breed.K == K and breed.D == D, (K, D)
     gp = jax.random.uniform(jax.random.key(1), (breed.Pp, breed.Lp)).astype(dt)

@@ -10,7 +10,13 @@
 #   3. event-log schema check   — run a short telemetry-enabled solve
 #                                 emitting a JSONL event log, then
 #                                 validate every record against
-#                                 utils/telemetry's versioned schema.
+#                                 utils/telemetry's versioned schema;
+#   4. bench provenance gate    — bench.provenance() carries the
+#                                 versioned schema fields and the
+#                                 newest BENCH_r*.json artifact is
+#                                 stamped with them (schema_version,
+#                                 backend, device_kind,
+#                                 process_state_note — ISSUE 3).
 # Exits nonzero on the first failing stage.
 set -e
 cd "$(dirname "$0")/.."
@@ -53,5 +59,38 @@ missing = need - kinds
 if missing:
     sys.exit(f"event log missing kinds: {sorted(missing)} (got {sorted(kinds)})")
 print(f"event-log schema OK: {len(records)} records, kinds {sorted(kinds)}")
+PY
+
+echo "== ci: bench provenance schema =="
+JAX_PLATFORMS=cpu python - <<'PY'
+import glob
+import json
+import re
+import sys
+
+import bench
+
+need = {"schema_version", "backend", "device_kind", "process_state_note"}
+prov = bench.provenance()
+missing = need - set(prov)
+if missing:
+    sys.exit(f"bench.provenance() missing keys: {sorted(missing)}")
+
+arts = glob.glob("BENCH_r*.json")
+latest = max(arts, key=lambda f: int(re.search(r"r(\d+)", f).group(1)))
+with open(latest) as f:
+    art = json.load(f)
+missing = need - set(art)
+if missing:
+    sys.exit(
+        f"{latest} missing provenance keys: {sorted(missing)} — every "
+        "artifact from schema_version 1 on must be stamped (ISSUE 3)"
+    )
+if art["schema_version"] != bench.SCHEMA_VERSION:
+    sys.exit(
+        f"{latest} schema_version {art['schema_version']} != "
+        f"bench.SCHEMA_VERSION {bench.SCHEMA_VERSION}"
+    )
+print(f"bench provenance OK: {latest} schema_version={art['schema_version']}")
 PY
 echo "== ci: all stages passed =="

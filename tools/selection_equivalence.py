@@ -21,7 +21,20 @@ quantifies how much that matters, on real hardware, with three measures:
    optimum with the standard operator stack on both paths.
 
 Run on TPU: ``python tools/selection_equivalence.py``. Prints a markdown
-table for BASELINE.md.
+table for BASELINE.md. The kernel columns cover BOTH output layouts:
+the riffle shuffle and the ISSUE-3 alias-compatible ping-pong layout
+(parity alternated per generation, exactly as the shipped run loop
+does).
+
+CPU fallback: ``--simulate`` runs the same three measures on a pure
+numpy cohort-dynamics model driven by the EXACT layout algebra
+(``ops/pallas_step.pingpong_perm`` — the same function the kernels'
+BlockSpecs mirror and the structural tests pin), with rank-space
+tournament sampling and binomial score blending for uniform crossover
+of constant-gene rows. It cannot see Mosaic lowering, but it measures
+precisely what the layout changes: WHICH rows compete, and where
+children land. Bands: intensity within 1% of theory, takeover within
+2% of panmictic.
 
 Method note: scores are N(0.5, 0.05²) encoded as constant-gene rows with
 a mean-gene objective, so a child's score is a convex mix of its two
@@ -54,14 +67,27 @@ def xla_breed(tournament_size=2):
     ))
 
 
-def pallas_breed(K, tournament_size=2):
+def pallas_breed(K, tournament_size=2, layout=None, demes_per_step=None):
     from libpga_tpu.ops.pallas_step import make_pallas_breed
 
     b = make_pallas_breed(
         P, L, deme_size=K, mutation_rate=0.0,
         tournament_size=tournament_size,
+        _layout=layout, _demes_per_step=demes_per_step,
     )
     assert b is not None and b.K == K
+    if getattr(b, "parities", 1) > 1:
+        # Alternate the generation parity exactly like the shipped run
+        # loop (the measurement loops here are Python-side, so the
+        # parity is static per call).
+        state = {"gen": 0}
+
+        def breed(g, s, key):
+            parity = state["gen"] & 1
+            state["gen"] += 1
+            return b(g, s, key, parity=parity)
+
+        return breed
     return b
 
 
@@ -165,8 +191,151 @@ def multigen_onemax_mean(T, seed, gens=64):
     return float(jnp.mean(pga.population(h).scores))
 
 
+# ---------------------------------------------------------------------
+# CPU cohort-dynamics simulation (--simulate): the layout algebra's
+# selection consequences without a chip. One "generation" draws, for
+# every cohort slot, two tournament-2 winners in RANK SPACE (the same
+# inverse CDF the kernel samples), crosses the two parent GENE ROWS
+# with a per-gene coin flip (the genes must be carried, not just
+# scores: uniform crossover of once-constant rows yields mixed rows
+# whose gene-level diversity is what makes real takeover take tens of
+# generations — a scalar-score blend model collapses geometrically and
+# badly understates takeover), and writes children where the layout
+# writes them: in place for the ping-pong parities, through the riffle
+# permutation for the riffle layout, nowhere (whole-population cohort)
+# for panmictic.
+# ---------------------------------------------------------------------
+
+
+def _sim_generation(g, rng, cohorts, out_rows, tk=2):
+    """One selection + uniform-crossover generation on the gene matrix
+    ``g (P, L)``. ``cohorts``: (C, Kc) physical rows forming each
+    selection cohort; ``out_rows``: (C, Kc) physical rows the children
+    land in (same array = in place)."""
+    s = g.mean(axis=1)
+    C, Kc = cohorts.shape
+    s_c = s[cohorts]                                    # (C, Kc)
+    order = np.argsort(-s_c, axis=1, kind="stable")     # rank -> slot
+    u = rng.random((2, C, Kc))
+    t = 1.0 - u
+    for _ in range(tk.bit_length() - 1):
+        t = np.sqrt(t)
+    wr = np.clip(np.floor((1.0 - t) * Kc), 0, Kc - 1).astype(np.int64)
+    p1_rows = np.take_along_axis(
+        cohorts, np.take_along_axis(order, wr[0], axis=1), axis=1
+    ).reshape(-1)
+    p2_rows = np.take_along_axis(
+        cohorts, np.take_along_axis(order, wr[1], axis=1), axis=1
+    ).reshape(-1)
+    mask = rng.random((C * Kc, g.shape[1])) < 0.5
+    child = np.where(mask, g[p1_rows], g[p2_rows])
+    g2 = np.empty_like(g)
+    g2[out_rows.reshape(-1)] = child
+    return g2
+
+
+def _sim_layout(layout, K, D=8, q=8, B=1):
+    """(cohorts, out_rows) per generation parity for a layout name:
+    ``cohorts[c]`` = physical rows of selection cohort c (READ side),
+    ``out_rows[c]`` = physical rows cohort c's children land in (WRITE
+    side — the ping-pong write interleave makes these differ)."""
+    from libpga_tpu.ops.pallas_step import (
+        pingpong_child_rows,
+        pingpong_perm,
+    )
+
+    ident = np.arange(P).reshape(-1, K)
+    if layout == "panmictic":
+        return [(np.arange(P).reshape(1, P), np.arange(P).reshape(1, P))]
+    if layout == "riffle":
+        G = P // K
+        riffle = np.empty(P, np.int64)  # child g*K+r lands at row r*G+g
+        for g in range(G):
+            riffle[g * K : (g + 1) * K] = np.arange(K) * G + g
+        return [(ident, riffle.reshape(-1, K))]
+    if layout == "pingpong":
+        W = B * D * K
+        return [
+            (
+                pingpong_perm(parity, P, W, q).reshape(-1, K),
+                pingpong_child_rows(parity, P, K, q, D, B).reshape(-1, K),
+            )
+            for parity in (0, 1)
+        ]
+    raise ValueError(layout)
+
+
+def _sim_pop(rng):
+    """Constant-gene founder population, the study's method-note trick:
+    row r carries score c_r in every gene."""
+    c = np.clip(0.5 + 0.05 * rng.standard_normal(P), 0.0, 1.0 - 1e-6)
+    return np.broadcast_to(
+        c[:, None].astype(np.float32), (P, L)
+    ).copy()
+
+
+def _sim_intensity(layout, seed, K=512):
+    rng = np.random.default_rng(seed)
+    g = _sim_pop(rng)
+    s = g.mean(axis=1)
+    m, sd = s.mean(), s.std()
+    cohorts, out_rows = _sim_layout(layout, K)[0]
+    g2 = _sim_generation(g, rng, cohorts, out_rows)
+    return (g2.mean() - m) / sd
+
+
+def _sim_takeover(layout, seed, K=512, cap=400):
+    rng = np.random.default_rng(seed)
+    g = _sim_pop(rng)
+    sd0 = g.mean(axis=1).std()
+    phases = _sim_layout(layout, K)
+    for gen in range(1, cap + 1):
+        cohorts, out_rows = phases[(gen - 1) % len(phases)]
+        g = _sim_generation(g, rng, cohorts, out_rows)
+        if g.mean(axis=1).std() < 0.05 * sd0:
+            return gen
+    return cap
+
+
+def simulate(seeds=SEEDS, K=512):
+    """The CPU equivalence study. Returns the results dict and prints
+    the BASELINE.md table + band verdicts."""
+    theory = 1 / np.sqrt(np.pi)
+    res = {}
+    for layout in ("panmictic", "riffle", "pingpong"):
+        i_m = np.mean([_sim_intensity(layout, 10 + s) for s in range(seeds)])
+        t_m = np.mean([_sim_takeover(layout, 20 + s) for s in range(seeds)])
+        res[layout] = {"intensity": float(i_m), "takeover": float(t_m)}
+    print("\n| measure (CPU simulation, layout algebra) | theory "
+          "| panmictic | riffle | pingpong |")
+    print("|---|---|---|---|---|")
+    print(f"| tournament-2 intensity | {theory:.4f} | "
+          + " | ".join(f"{res[m]['intensity']:.4f}"
+                       for m in ("panmictic", "riffle", "pingpong"))
+          + " |")
+    print("| takeover (gens to 5% std) | - | "
+          + " | ".join(f"{res[m]['takeover']:.1f}"
+                       for m in ("panmictic", "riffle", "pingpong"))
+          + " |")
+    i_dev = abs(res["pingpong"]["intensity"] / theory - 1.0)
+    t_dev = abs(
+        res["pingpong"]["takeover"] / res["panmictic"]["takeover"] - 1.0
+    )
+    print(f"\npingpong intensity vs theory: {i_dev:.2%} (band 1%)")
+    print(f"pingpong takeover vs panmictic: {t_dev:.2%} (band 2%)")
+    res["bands_ok"] = bool(i_dev <= 0.01 and t_dev <= 0.02)
+    print("bands:", "OK" if res["bands_ok"] else "EXCEEDED")
+    return res
+
+
 def main():
-    assert jax.default_backend() == "tpu", "study needs real kernel entropy"
+    if "--simulate" in sys.argv:
+        simulate()
+        return
+    assert jax.default_backend() == "tpu", (
+        "study needs real kernel entropy — or use --simulate for the "
+        "CPU layout-algebra model"
+    )
     rows = []
     for k, theory in ((2, 1 / np.sqrt(np.pi)), (4, 1.0294)):
         xb = xla_breed(k)
@@ -176,6 +345,12 @@ def main():
             pb = pallas_breed(K, k)
             i_p = np.mean([intensity(pb, s) for s in range(SEEDS)])
             row.append(f"{i_p:.4f}")
+        # the shipped ping-pong layout at the default deme shape
+        i_pp = np.mean([
+            intensity(pallas_breed(512, k, layout="pingpong"), s)
+            for s in range(SEEDS)
+        ])
+        row.append(f"{i_pp:.4f}")
         rows.append(row)
         print("intensity", row, flush=True)
 
@@ -186,6 +361,12 @@ def main():
         pb = pallas_breed(K, 2)
         t_p = np.mean([takeover(pb, s) for s in range(SEEDS)])
         trow.append(f"{t_p:.1f}")
+    # ping-pong: a FRESH breed per seed so every run starts at parity 0
+    t_pp = np.mean([
+        takeover(pallas_breed(512, 2, layout="pingpong"), s)
+        for s in range(SEEDS)
+    ])
+    trow.append(f"{t_pp:.1f}")
     rows.append(trow)
     print("takeover", trow, flush=True)
 
@@ -193,8 +374,9 @@ def main():
     g_p = np.mean([onemax_gens(True, s) for s in range(3)])
     print(f"onemax 99% gens: xla={g_x:.1f} pallas={g_p:.1f}", flush=True)
 
-    print("\n| measure | theory | panmictic (XLA) | K=128 | K=256 | K=512 | K=1024 |")
-    print("|---|---|---|---|---|---|---|")
+    print("\n| measure | theory | panmictic (XLA) | K=128 | K=256 "
+          "| K=512 | K=1024 | K=512 pingpong |")
+    print("|---|---|---|---|---|---|---|---|")
     for r in rows:
         print("| " + " | ".join(r) + " |")
     print(f"\nOneMax 131k×100 generations to 99% optimum: "

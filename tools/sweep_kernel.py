@@ -61,6 +61,10 @@ def main():
         breed = make_pallas_breed(
             POP, L, deme_size=K, fused_obj=onemax.kernel_rowwise,
             gene_dtype=dt, _demes_per_step=D,
+            # Riffle pinned: the ping-pong mixing gate admits only some
+            # (K, D) points, which would silently mix layouts across
+            # the sweep; the layout A/B lives in tools/ablate_floor.py.
+            _layout="riffle",
         )
         if breed is None or breed.K != K or breed.D != D:
             continue  # combination rounded away; skip duplicates
