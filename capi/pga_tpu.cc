@@ -259,6 +259,43 @@ int pga_run_islands(pga_t *p, unsigned n, unsigned m, float pct) {
                                       n, m, static_cast<double>(pct)));
 }
 
+pga_ticket_t *pga_submit(pga_t *p, unsigned n, float target) {
+    if (!p) return nullptr;
+    long tid = call_long("submit", "(lIif)", solver_of(p), n, 1,
+                         static_cast<double>(target));
+    return tid <= 0 ? nullptr
+                    : reinterpret_cast<pga_ticket_t *>(
+                          static_cast<intptr_t>(tid));
+}
+
+pga_ticket_t *pga_submit_n(pga_t *p, unsigned n) {
+    if (!p) return nullptr;
+    long tid = call_long("submit", "(lIif)", solver_of(p), n, 0, 0.0);
+    return tid <= 0 ? nullptr
+                    : reinterpret_cast<pga_ticket_t *>(
+                          static_cast<intptr_t>(tid));
+}
+
+int pga_poll(pga_ticket_t *t) {
+    if (!t) return -1;
+    return static_cast<int>(call_long(
+        "poll", "(l)",
+        static_cast<long>(reinterpret_cast<intptr_t>(t))));
+}
+
+int pga_await(pga_ticket_t *t) {
+    if (!t) return -1;
+    return static_cast<int>(call_long(
+        "await_ticket", "(l)",
+        static_cast<long>(reinterpret_cast<intptr_t>(t))));
+}
+
+int pga_serving_config(unsigned max_batch, float max_wait_ms) {
+    return static_cast<int>(
+        call_long("serving_config", "(If)", max_batch,
+                  static_cast<double>(max_wait_ms)));
+}
+
 int pga_set_telemetry(pga_t *p, unsigned max_gens) {
     if (!p) return -1;
     return static_cast<int>(

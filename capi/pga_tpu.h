@@ -276,6 +276,47 @@ int pga_set_telemetry(pga_t *p, unsigned max_gens);
 float *pga_get_history(pga_t *p, population_t *pop, unsigned *rows,
                        unsigned *cols);
 
+/* ---- Async batched serving (no reference analog) ----------------------
+ *
+ * pga_submit admits an asynchronous run of the solver's first
+ * population — the population pga_run operates on — and returns
+ * immediately with an opaque ticket. Submitted runs accumulate in a
+ * process-global queue, bucketed by exact shape signature (population
+ * size, genome length, gene dtype, objective, operator kinds, solver
+ * config); a bucket launches as ONE batched device program when it
+ * fills (`max_batch` requests) or when its oldest request has waited
+ * `max_wait_ms`. Runs in one bucket share a single cached compilation,
+ * so N same-shaped solvers submitting concurrently pay one compile,
+ * not N — and each run's result is bit-identical to what pga_run would
+ * have produced on that solver at that moment. Solvers whose shapes or
+ * configs differ can never share a program (they land in different
+ * buckets).
+ *
+ * pga_poll returns 1 once the ticket's batch has launched and its
+ * result is assigned (device buffers may still be in flight), 0 while
+ * pending, -1 on an invalid ticket.
+ *
+ * pga_await blocks until the run finishes, installs the final
+ * population into the solver exactly as pga_run does (scores current,
+ * staged generation cleared, telemetry history updated when enabled),
+ * RELEASES the ticket, and returns the generations executed (negative
+ * on error). Awaiting is what completes the submit→result round trip;
+ * a ticket must be awaited exactly once. Between submit and await the
+ * solver's populations must not be mutated (run, crossover, swap, ...)
+ * — the submitted run captured the population at submit time and
+ * await overwrites whatever is installed.
+ *
+ * pga_serving_config adjusts the process-global queue (applies to
+ * subsequent submissions): max_batch requests per bucket launch,
+ * max_wait_ms accumulation window (0 = launch only when a bucket
+ * fills or an await forces the flush). Returns 0, -1 on error. */
+typedef struct pga_ticket pga_ticket_t;
+pga_ticket_t *pga_submit(pga_t *p, unsigned n, float target);
+pga_ticket_t *pga_submit_n(pga_t *p, unsigned n);
+int pga_poll(pga_ticket_t *t);
+int pga_await(pga_ticket_t *t);
+int pga_serving_config(unsigned max_batch, float max_wait_ms);
+
 #ifdef __cplusplus
 }
 #endif

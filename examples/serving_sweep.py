@@ -1,0 +1,77 @@
+"""Hyperparameter sweep through the serving queue (ISSUE 4).
+
+The "before" version of this script is the loop every tuning workflow
+writes: for each candidate mutation rate, build a solver, run it, read
+the result — N requests, N compile pipelines, N synchronous dispatches.
+The serving subsystem turns the same sweep into submit() calls: every
+configuration here shares one shape signature (the rate is a runtime
+input), so the whole sweep executes as ONE batched device program with
+one cached compilation, and results stream back through tickets.
+
+    JAX_PLATFORMS=cpu python examples/serving_sweep.py
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from libpga_tpu import PGAConfig, ServingConfig, TelemetryConfig
+from libpga_tpu.serving import BatchedRuns, RunQueue, RunRequest
+
+POP, LEN, GENS = 8192, 64, 30
+RATES = [0.005, 0.01, 0.02, 0.05, 0.1, 0.2, 0.35, 0.5]
+
+
+def main() -> None:
+    executor = BatchedRuns(
+        "onemax",
+        config=PGAConfig(
+            use_pallas=False,
+            telemetry=TelemetryConfig(history_gens=GENS),
+        ),
+    )
+    queue = RunQueue(
+        executor,
+        serving=ServingConfig(max_batch=len(RATES), max_wait_ms=10.0),
+    )
+
+    # The sweep: one submit per candidate — no loop-carried engine, no
+    # per-candidate compile. The final submit fills the bucket and
+    # launches the mega-run.
+    tickets = {
+        rate: queue.submit(
+            RunRequest(
+                size=POP, genome_len=LEN, n=GENS,
+                seed=42,  # identical seed isolates the rate's effect
+                mutation_rate=rate,
+            )
+        )
+        for rate in RATES
+    }
+
+    print(f"rate      best     mean(last)  stall  (pop {POP}x{LEN}, "
+          f"{GENS} gens, shared seed)")
+    best_rate, best_score = None, -float("inf")
+    for rate, ticket in tickets.items():
+        result = ticket.result(timeout=600)
+        hist = result.history
+        print(
+            f"{rate:<8}  {result.best_score:7.3f}  {hist.mean[-1]:9.3f}"
+            f"  {int(hist.stall[-1]):5d}"
+        )
+        if result.best_score > best_score:
+            best_rate, best_score = rate, result.best_score
+    queue.close()
+    print(f"\nwinner: rate={best_rate} (best {best_score:.3f})")
+    from libpga_tpu.serving import COUNTERS
+
+    counters = COUNTERS.snapshot()
+    print(
+        f"compiled programs built: {counters.get('builds', 0)} "
+        f"(the whole sweep shares one bucket)"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -191,6 +191,7 @@ def deinit(handle: int) -> None:
     _retained.pop(handle, None)
     _host_ops.pop(handle, None)
     _expr_consts.pop(handle, None)
+    _serving_execs.pop(handle, None)
 
 
 def create_population(handle: int, size: int, genome_len: int, ptype: int) -> int:
@@ -766,6 +767,143 @@ def get_best_top_all(handle: int, k: int) -> bytes:
 def genome_len(handle: int, pop: int) -> int:
     pga, h = _handle_pop(handle, pop)
     return pga.population(h).genome_len
+
+
+# --------------------------------------------------------------- serving
+#
+# Async run submission (pga_submit / pga_poll / pga_await): requests
+# from every solver in the process flow through ONE module-global
+# RunQueue, bucketed by exact shape signature, so same-shaped solvers
+# share compiled mega-runs (serving/). A ticket is an integer handle;
+# pga_await installs the finished run into the solver's population —
+# the same state transition pga_run performs — and releases the ticket.
+
+_serving_queue = None
+_serving_execs: Dict[int, object] = {}
+_tickets: Dict[int, tuple] = {}  # id -> (handle, pop_index, ticket, pga)
+_next_ticket = 1
+
+
+def _get_serving_queue():
+    global _serving_queue
+    if _serving_queue is None:
+        from libpga_tpu.config import ServingConfig
+        from libpga_tpu.serving.queue import RunQueue
+
+        _serving_queue = RunQueue(serving=ServingConfig())
+    return _serving_queue
+
+
+def serving_config(max_batch: int, max_wait_ms: float) -> None:
+    """Reconfigure the process-global submission queue
+    (``pga_serving_config``). Flushes pending work first so in-flight
+    tickets complete under the settings they were admitted with."""
+    global _serving_queue
+    from libpga_tpu.config import ServingConfig
+
+    cfg = ServingConfig(
+        max_batch=int(max_batch), max_wait_ms=float(max_wait_ms)
+    )
+    if _serving_queue is not None:
+        _serving_queue.close()
+    from libpga_tpu.serving.queue import RunQueue
+
+    _serving_queue = RunQueue(serving=cfg)
+
+
+def _serving_executor(handle: int):
+    """A BatchedRuns matching the solver's current objective/operators.
+
+    Rebuilt whenever the identity-relevant pieces change; executors for
+    equal configurations produce equal signatures, so distinct solvers
+    still share buckets and compiled programs."""
+    from libpga_tpu.serving.batch import BatchedRuns
+
+    pga = _solver(handle)
+    if _host_ops.get(handle):
+        raise ValueError(
+            "pga_submit: host-pointer callbacks cannot be batch-served "
+            "(they pin the solver to per-host-call execution) — use a "
+            "named/expression operator, or pga_run"
+        )
+    obj = pga._require_objective()
+    kind = pga._mutate_kind()
+    if kind not in ("point", "gaussian", "swap"):
+        raise ValueError(
+            "pga_submit requires a builtin mutation kind "
+            "(point/gaussian/swap); expression mutations run via pga_run"
+        )
+    ident = (obj, pga._crossover, kind, pga.config)
+    cached = _serving_execs.get(handle)
+    if cached is not None and cached[0] == ident:
+        return cached[1]
+    ex = BatchedRuns(
+        obj, config=pga.config, crossover=pga._crossover, mutate_kind=kind
+    )
+    _serving_execs[handle] = (ident, ex)
+    return ex
+
+
+def submit(handle: int, n: int, has_target: int, target: float) -> int:
+    """``pga_submit``: admit an async run of the solver's FIRST
+    population (the population pga_run operates on) and return a
+    ticket id (> 0)."""
+    global _next_ticket
+    from libpga_tpu.serving.batch import RunRequest
+
+    pga = _solver(handle)
+    if pga.num_populations == 0:
+        raise ValueError("no populations")
+    from libpga_tpu.engine import PopulationHandle
+
+    ex = _serving_executor(handle)
+    pop = pga.population(PopulationHandle(0))
+    mp = np.asarray(pga._mutate_params())
+    req = RunRequest(
+        size=pop.size,
+        genome_len=pop.genome_len,
+        n=int(n),
+        key=pga.next_key(),
+        genomes=pop.genomes,
+        target=float(target) if has_target else None,
+        mutation_rate=float(mp[0, 0]),
+        mutation_sigma=float(mp[0, 1]),
+    )
+    ticket = _get_serving_queue().submit(req, executor=ex)
+    tid = _next_ticket
+    _next_ticket += 1
+    _tickets[tid] = (handle, 0, ticket, pga)
+    return tid
+
+
+def poll(ticket_id: int) -> int:
+    """``pga_poll``: 1 once the ticket's mega-run has launched and
+    assigned its result, else 0."""
+    entry = _tickets.get(ticket_id)
+    if entry is None:
+        raise ValueError(f"invalid ticket {ticket_id}")
+    return 1 if entry[2].poll() else 0
+
+
+def await_ticket(ticket_id: int) -> int:
+    """``pga_await``: block for the run, install its final population
+    into the solver (the pga_run state transition), release the ticket,
+    and return the generations executed."""
+    from libpga_tpu.population import Population
+
+    entry = _tickets.pop(ticket_id, None)
+    if entry is None:
+        raise ValueError(f"invalid ticket {ticket_id}")
+    handle, pop_index, ticket, pga = entry
+    result = ticket.result(timeout=600.0)
+    gens = result.generations
+    if _solvers.get(handle) is pga:  # solver may have been deinit'd
+        pga._populations[pop_index] = Population(
+            genomes=result.genomes, scores=result.scores
+        )
+        pga._staged[pop_index] = None
+        pga._history[pop_index] = result.history
+    return gens
 
 
 # ------------------------------------------------------------- telemetry

@@ -137,6 +137,22 @@ class PGAConfig:
     telemetry: Optional[TelemetryConfig] = None
     seed: Optional[int] = None
 
+    def serving_signature_fields(self) -> tuple:
+        """The config fields that shape a compiled run program — the
+        config part of a serving bucket signature (``serving/batch.py``).
+        Everything here is baked into the traced program; everything
+        else (seed, n, target, mutation rate/sigma) is a runtime input
+        and therefore free to vary across the runs of one bucket."""
+        import numpy as _np
+
+        return (
+            _np.dtype(self.gene_dtype).name,
+            self.tournament_size, self.selection, self.selection_param,
+            self.elitism, self.pallas_generations_per_launch,
+            self.pallas_layout, self.pallas_subblock,
+            None if self.telemetry is None else self.telemetry.history_gens,
+        )
+
     def pallas_enabled(self) -> bool:
         """Resolve the use_pallas auto setting against the live backend."""
         if self.use_pallas is not None:
@@ -168,3 +184,63 @@ class PGAConfig:
             )
         if self.pallas_subblock is not None and self.pallas_subblock < 1:
             raise ValueError("pallas_subblock must be >= 1")
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Settings for the multi-tenant batched run engine (``serving/``).
+
+    Attributes:
+      max_batch: a bucket launches as soon as this many same-signature
+        requests are pending (the mega-run's leading run axis width).
+      max_wait_ms: a non-empty bucket launches at most this many
+        milliseconds after its OLDEST pending request was admitted, even
+        if under ``max_batch`` — the latency bound of the accumulation
+        window (the Orca/vLLM-style admission tradeoff; see PAPERS.md).
+      cache_capacity: LRU capacity of the module-level compiled-program
+        cache (``serving/cache.py``), counted in compiled mega-run
+        programs. ``None`` = unbounded.
+      layout: how the mega-run lays out the run axis — "run_major"
+        (``lax.scan`` over runs: each run's working set stays
+        cache-resident and finished runs cost nothing; the measured
+        winner on CPU hosts), "lockstep" (``vmap`` over runs: one wide
+        program advancing every run per step, with the branchless
+        per-run freeze; the accelerator layout), or "auto" (default:
+        run_major on CPU backends, lockstep elsewhere).
+      donate_buffers: donate the stacked population buffer to the
+        mega-run so XLA updates it in place (same stance as
+        ``PGAConfig.donate_buffers``).
+      aot_warmup: compile the mega-run ahead of time at bucket-build
+        time via ``jit(...).lower(...).compile()`` — the first launch
+        then only executes. Disable to defer compilation to first use.
+    """
+
+    max_batch: int = 32
+    max_wait_ms: float = 20.0
+    cache_capacity: Optional[int] = 32
+    layout: str = "auto"
+    donate_buffers: bool = True
+    aot_warmup: bool = True
+
+    def __post_init__(self):
+        if self.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        if self.max_wait_ms < 0:
+            raise ValueError("max_wait_ms must be >= 0")
+        if self.cache_capacity is not None and self.cache_capacity < 1:
+            raise ValueError("cache_capacity must be >= 1 or None")
+        if self.layout not in ("auto", "run_major", "lockstep"):
+            raise ValueError(
+                "layout must be 'auto', 'run_major' or 'lockstep'"
+            )
+
+    def resolve_layout(self) -> str:
+        if self.layout != "auto":
+            return self.layout
+        import jax
+
+        try:
+            backend = jax.default_backend()
+        except RuntimeError:
+            backend = "cpu"
+        return "run_major" if backend == "cpu" else "lockstep"

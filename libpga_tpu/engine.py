@@ -30,7 +30,6 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from functools import partial
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -64,6 +63,82 @@ def _kind_key(kind):
     rate/sigma reuses the compiled kernel (the parameters are runtime
     inputs of the compiled fn, passed per call)."""
     return getattr(kind, "kernel_cache_key", kind)
+
+
+def make_run_loop(obj, breed, history_gens: Optional[int] = None):
+    """Build the fused single-run loop — the one implementation shared by
+    the engine's XLA path and the serving mega-run executor
+    (``serving/batch.py``), so their semantics cannot drift.
+
+    ``breed`` takes ``(genomes, scores, key, mparams)``; operators with
+    baked-in parameters simply ignore ``mparams`` (the engine wraps its
+    3-arg breed), while the serving executor passes a runtime-parameter
+    breed (``ops/step.make_param_breed``) so distinct mutation rates can
+    share one compilation.
+
+    Returns ``run_loop(genomes, key, n, target, mparams) ->
+    (genomes, scores, gens_done[, history])``. The loop carries
+    ``(genomes, scores)`` together and checks the target against the
+    carried scores BEFORE breeding again, so the generation that reaches
+    the target is the one returned — its offspring never overwrite it.
+    With ``history_gens`` set the loop additionally carries the
+    ``(history_gens, NUM_STATS)`` stats buffer + running best/stall
+    scalars and returns a trailing history array; the disabled path
+    traces to the exact pre-telemetry jaxpr (structurally asserted in
+    tests/test_telemetry.py).
+    """
+    if history_gens is None:
+
+        def run_loop(genomes, key, n, target, mparams):
+            scores0 = _evaluate(obj, genomes)
+
+            def cond(carry):
+                g, s, k, gen = carry
+                return jnp.logical_and(gen < n, jnp.max(s) < target)
+
+            def body(carry):
+                g, s, k, gen = carry
+                k, sub = jax.random.split(k)
+                g2 = breed(g, s, sub, mparams)
+                s2 = _evaluate(obj, g2)
+                return (g2, s2, k, gen + 1)
+
+            init = (genomes, scores0, key, jnp.int32(0))
+            g, s, k, gens_done = jax.lax.while_loop(cond, body, init)
+            return g, s, gens_done
+
+    else:
+
+        def run_loop(genomes, key, n, target, mparams):
+            scores0 = _evaluate(obj, genomes)
+
+            def cond(carry):
+                g, s, k, gen, best, stall, buf = carry
+                return jnp.logical_and(gen < n, jnp.max(s) < target)
+
+            def body(carry):
+                g, s, k, gen, best, stall, buf = carry
+                k, sub = jax.random.split(k)
+                with jax.named_scope("pga/select_breed"):
+                    g2 = breed(g, s, sub, mparams)
+                with jax.named_scope("pga/evaluate"):
+                    s2 = _evaluate(obj, g2)
+                with jax.named_scope("pga/telemetry"):
+                    row, best, stall = _tl.stats_row(g2, s2, best, stall)
+                    buf = _tl.write_row(buf, gen, row)
+                return (g2, s2, k, gen + 1, best, stall, buf)
+
+            init = (
+                genomes, scores0, key, jnp.int32(0),
+                jnp.max(scores0), jnp.int32(0),
+                _tl.history_init(history_gens),
+            )
+            g, s, k, gens_done, _, _, buf = jax.lax.while_loop(
+                cond, body, init
+            )
+            return g, s, gens_done, buf
+
+    return run_loop
 
 
 @dataclasses.dataclass(frozen=True)
@@ -296,7 +371,7 @@ class PGA:
     def _breed_fn(self) -> Callable:
         """Cached breed (select+crossover+mutate) for the current callbacks."""
         cache_key = (
-            "breed", self._crossover, self._mutate,
+            "engine/breed", self._crossover, self._mutate,
             self.config.tournament_size, self.config.elitism,
             self.config.selection, self.config.selection_param,
         )
@@ -345,7 +420,8 @@ class PGA:
             # sentinel — NOT the XLA fn itself, which bakes the operator
             # instance in and must stay keyed by it below.
             pkey = (
-                "runP", size, genome_len, obj, _kind_key(pallas_kind),
+                "engine/run-pallas", size, genome_len, obj,
+                _kind_key(pallas_kind),
                 _kind_key(self._crossover_kind()), self.config.elitism,
                 self.config.tournament_size, self.config.selection,
                 self.config.selection_param,
@@ -392,7 +468,8 @@ class PGA:
                 return cached
 
         cache_key = (
-            "run", size, genome_len, obj, self._crossover, self._mutate,
+            "engine/run-xla", size, genome_len, obj, self._crossover,
+            self._mutate,
             self.config.tournament_size, self.config.elitism,
             self.config.selection, self.config.selection_param,
             hist_gens,
@@ -405,61 +482,15 @@ class PGA:
             genome_len=genome_len,
         )
 
-        breed = self._breed_fn()
+        breed3 = self._breed_fn()
 
-        if hist_gens is None:
+        def breed(g, s, k, mparams):
+            # Operator parameters are baked into the engine's breed; the
+            # runtime mparams input exists for the Pallas and serving
+            # paths and is simply unused here.
+            return breed3(g, s, k)
 
-            def run_loop(genomes, key, n, target, mparams):
-                del mparams  # operator parameters are baked into breed
-                scores0 = _evaluate(obj, genomes)
-
-                def cond(carry):
-                    g, s, k, gen = carry
-                    return jnp.logical_and(gen < n, jnp.max(s) < target)
-
-                def body(carry):
-                    g, s, k, gen = carry
-                    k, sub = jax.random.split(k)
-                    g2 = breed(g, s, sub)
-                    s2 = _evaluate(obj, g2)
-                    return (g2, s2, k, gen + 1)
-
-                init = (genomes, scores0, key, jnp.int32(0))
-                g, s, k, gens_done = jax.lax.while_loop(cond, body, init)
-                return g, s, gens_done
-
-        else:
-
-            def run_loop(genomes, key, n, target, mparams):
-                del mparams
-                scores0 = _evaluate(obj, genomes)
-
-                def cond(carry):
-                    g, s, k, gen, best, stall, buf = carry
-                    return jnp.logical_and(gen < n, jnp.max(s) < target)
-
-                def body(carry):
-                    g, s, k, gen, best, stall, buf = carry
-                    k, sub = jax.random.split(k)
-                    with jax.named_scope("pga/select_breed"):
-                        g2 = breed(g, s, sub)
-                    with jax.named_scope("pga/evaluate"):
-                        s2 = _evaluate(obj, g2)
-                    with jax.named_scope("pga/telemetry"):
-                        row, best, stall = _tl.stats_row(g2, s2, best, stall)
-                        buf = _tl.write_row(buf, gen, row)
-                    return (g2, s2, k, gen + 1, best, stall, buf)
-
-                init = (
-                    genomes, scores0, key, jnp.int32(0),
-                    jnp.max(scores0), jnp.int32(0),
-                    _tl.history_init(hist_gens),
-                )
-                g, s, k, gens_done, _, _, buf = jax.lax.while_loop(
-                    cond, body, init
-                )
-                return g, s, gens_done, buf
-
+        run_loop = make_run_loop(obj, breed, hist_gens)
         donate = (0,) if self.config.donate_buffers else ()
         fn = jax.jit(run_loop, donate_argnums=donate)
         self._compiled[cache_key] = fn
@@ -503,7 +534,7 @@ class PGA:
     }
 
     def _crossover_expr_equivalent(self, name: str):
-        cache_key = ("crossover-expr-builtin", name)
+        cache_key = ("engine/crossover-expr-builtin", name)
         op = self._compiled.get(cache_key)
         if op is None:
             from libpga_tpu.ops.breed_expr import crossover_from_expression
@@ -655,7 +686,7 @@ class PGA:
         # Cached: runner caching downstream keys on the breed's identity,
         # so rebuilding it per call would defeat compilation reuse.
         cache_key = (
-            "island_breed", island_size, genome_len, obj, fused,
+            "engine/island-breed", island_size, genome_len, obj, fused,
             _kind_key(self._crossover_kind()),
             _kind_key(self._mutate_kind()),
             self.config.elitism, self.config.tournament_size,
@@ -831,7 +862,7 @@ class PGA:
             self.evaluate(h)
 
     def _jitted_evaluate(self):
-        cache_key = ("eval", self._objective)
+        cache_key = ("engine/eval", self._objective)
         fn = self._compiled.get(cache_key)
         if fn is None:
             obj = self._require_objective()
@@ -873,7 +904,7 @@ class PGA:
 
     def _compiled_op(self, which: str):
         cache_key = (
-            which, self._crossover, self._mutate,
+            "engine/op", which, self._crossover, self._mutate,
             self.config.tournament_size, self.config.selection,
             self.config.selection_param,
         )

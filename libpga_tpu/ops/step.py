@@ -105,6 +105,87 @@ def make_breed(
     return breed
 
 
+def make_param_breed(
+    crossover_fn: Callable,
+    mutate_kind: str,
+    *,
+    tournament_size: int = 2,
+    selection_kind: str = "tournament",
+    selection_param: Optional[float] = None,
+    elitism: int = 0,
+) -> Callable:
+    """:func:`make_breed` with the mutation rate/sigma as RUNTIME inputs.
+
+    The serving mega-run packs requests with distinct mutation rates
+    into one compiled program, so the operator parameters cannot be
+    baked in the way :func:`make_breed` bakes them. ``mutate_kind``
+    names a builtin kind ("point" / "gaussian" / "swap"); the returned
+    ``breed(genomes, scores, key, mparams)`` reads ``rate = mparams[0,
+    0]`` and ``sigma = mparams[0, 1]`` — the engine's ``(1, 2)`` f32
+    mparams layout, the same runtime input the fused Pallas kernel
+    takes. For equal parameter values the traced computation is
+    identical to :func:`make_breed`'s (same ops, same PRNG consumption),
+    so results are bit-identical to a baked-parameter breed — the
+    property the serving bit-exactness tests assert.
+
+    The returned callable carries ``takes_params = True`` (the marker
+    the island epochs already dispatch on) and ``default_params``.
+    """
+    from libpga_tpu.ops import mutate as _m
+
+    batched_kinds = {
+        "point": (_m.point_mutate_batched, 3),
+        "gaussian": (_m.gaussian_mutate, None),
+        "swap": (_m.swap_mutate_batched, 3),
+    }
+    if mutate_kind not in batched_kinds:
+        raise ValueError(
+            f"unknown mutate kind {mutate_kind!r}; "
+            f"available: {sorted(batched_kinds)}"
+        )
+    mut_batched, mut_cols = batched_kinds[mutate_kind]
+    cross_batched = getattr(crossover_fn, "batched", None)
+    cross_cols = getattr(crossover_fn, "rand_cols", None)
+
+    def breed(genomes, scores, key, mparams):
+        P, L = genomes.shape
+        rate = mparams[0, 0]
+        k_sel, k_cross, k_mut = jax.random.split(key, 3)
+        p1_idx, p2_idx = select_parent_pairs(
+            k_sel, scores, P, k=tournament_size,
+            kind=selection_kind, param=selection_param,
+        )
+        p1 = jnp.take(genomes, p1_idx, axis=0)
+        p2 = jnp.take(genomes, p2_idx, axis=0)
+
+        rand_c = jax.random.uniform(
+            k_cross, (P, cross_cols or L), dtype=jnp.float32
+        )
+        if cross_batched is not None:
+            children = cross_batched(p1, p2, rand_c)
+        else:
+            children = jax.vmap(crossover_fn)(p1, p2, rand_c)
+
+        rand_m = jax.random.uniform(
+            k_mut, (P, mut_cols or L), dtype=jnp.float32
+        )
+        if mutate_kind == "gaussian":
+            nxt = mut_batched(children, rand_m, rate, mparams[0, 1])
+        else:
+            nxt = mut_batched(children, rand_m, rate)
+
+        if elitism > 0:
+            _, elite_idx = jax.lax.top_k(scores, elitism)
+            nxt = nxt.at[:elitism].set(jnp.take(genomes, elite_idx, axis=0))
+
+        return nxt.astype(genomes.dtype)
+
+    breed.takes_params = True
+    breed.default_params = jnp.asarray([[0.01, 0.0]], dtype=jnp.float32)
+    breed.mutate_kind = mutate_kind
+    return breed
+
+
 def make_step(
     obj: Callable,
     crossover_fn: Callable,

@@ -449,7 +449,60 @@ def build_local_runner(
         return jitted(*args)
 
     runner.takes_params = takes_params
+    # The untraced loop body, exposed so the serving executor can batch
+    # N independent island runs through ONE program (scan/vmap over a
+    # leading run axis — see make_batched_island_loop).
+    runner.raw = loop
+    runner.history_gens = history_gens
     return runner
+
+
+def make_batched_island_loop(
+    breed: Callable, obj: Callable, *, m: int, count: int, topology: str,
+    elitism: int = 0, history_gens: Optional[int] = None,
+    layout: str = "run_major",
+):
+    """N independent island runs as ONE program over a leading run axis —
+    the island-model face of the serving mega-run (``serving/batch.py``).
+
+    Reuses :func:`build_local_runner`'s exact loop per run, so each
+    run's result is bit-identical to a standalone
+    :func:`run_islands_stacked` epoch loop with the same keys.
+    ``layout``: "run_major" scans runs sequentially (each run's working
+    set stays cache-resident — the fast layout on CPU hosts);
+    "lockstep" vmaps the loop over the run axis (every run advances one
+    epoch per step — the wide layout for accelerators).
+
+    Returns ``mega(genomes (N,I,S,L), island_keys (N,I), mig_keys (N,),
+    num_epochs (N,), target (N,)[, telemetry extras][, mparams (N,...)])
+    -> stacked per-run results`` (untraced; callers jit with their own
+    donation policy).
+    """
+    runner = build_local_runner(
+        breed, obj, m=m, count=count, topology=topology, elitism=elitism,
+        history_gens=history_gens,
+    )
+    loop = runner.raw
+    takes_params = runner.takes_params
+
+    if layout == "lockstep":
+        mega = jax.vmap(loop)
+    elif layout == "run_major":
+
+        def mega(*args):
+            def one(carry, xs):
+                return carry, loop(*xs)
+
+            _, out = jax.lax.scan(one, 0, args)
+            return out
+
+    else:
+        raise ValueError(
+            f"unknown layout {layout!r}; use 'run_major' or 'lockstep'"
+        )
+    mega.takes_params = takes_params
+    mega.history_gens = history_gens
+    return mega
 
 
 # ------------------------------------------------------------- sharded path
@@ -676,9 +729,13 @@ def run_islands_stacked(
     def cached(tag, mm, cc, build):
         if runner_cache is None:
             return build()
+        # Role-prefixed namespace: the runner cache is the engine's
+        # shared ``_compiled`` dict, so island keys must be structurally
+        # disjoint from every other role's keys (see the collision test
+        # in tests/test_serving.py).
         ck = (
-            tag, mm, cc, topology, mesh, axis_name, breed, obj, elitism,
-            history_gens,
+            "islands/" + tag, mm, cc, topology, mesh, axis_name, breed,
+            obj, elitism, history_gens,
         )
         if ck not in runner_cache:
             runner_cache[ck] = build()

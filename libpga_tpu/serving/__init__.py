@@ -1,0 +1,30 @@
+"""Multi-tenant batched run engine.
+
+Three layers (see ISSUE 4 / README "Serving"):
+
+- :mod:`libpga_tpu.serving.batch` — :class:`BatchedRuns`, the executor
+  packing N same-signature runs into ONE compiled mega-run over a
+  leading run axis, bit-identical per run to standalone ``PGA.run``;
+- :mod:`libpga_tpu.serving.cache` — the module-level shape-bucket
+  program cache with AOT warm-up and hit/miss/evict counters;
+- :mod:`libpga_tpu.serving.queue` — the async front door:
+  ``submit() -> RunTicket``, accumulation per bucket, launch at
+  ``max_batch`` or ``max_wait_ms``.
+"""
+
+from libpga_tpu.config import ServingConfig
+from libpga_tpu.serving.batch import BatchedRuns, RunRequest, RunResult
+from libpga_tpu.serving.cache import COUNTERS, PROGRAM_CACHE, ProgramCache
+from libpga_tpu.serving.queue import RunQueue, RunTicket
+
+__all__ = [
+    "BatchedRuns",
+    "RunRequest",
+    "RunResult",
+    "RunQueue",
+    "RunTicket",
+    "ServingConfig",
+    "ProgramCache",
+    "PROGRAM_CACHE",
+    "COUNTERS",
+]

@@ -1,0 +1,245 @@
+"""Async submission queue: accumulate → bucket → launch mega-runs.
+
+The serving front door. ``submit()`` returns immediately with a
+:class:`RunTicket`; requests accumulate per shape bucket and a bucket
+launches when it reaches ``ServingConfig.max_batch`` or when its oldest
+request has waited ``max_wait_ms`` (the continuous-batching admission
+window — the same request-packing tradeoff as LLM serving schedulers;
+see PAPERS.md). Mismatched shapes can never share a program: the bucket
+key IS the executor's exact signature tuple.
+
+Pipelining: a launch only DISPATCHES the mega-run — results come back
+as unmaterialized device arrays (``serving/batch.RunResult``), and
+host-side readback happens in ``ticket.result()``. With JAX's async
+dispatch this overlaps the readback of batch k with the device
+execution of batch k+1; nothing in the queue ever calls
+``jax.block_until_ready`` on behalf of a caller that hasn't asked.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from libpga_tpu.config import ServingConfig
+from libpga_tpu.serving.batch import BatchedRuns, RunRequest, RunResult
+
+
+def _bucket_id(sig: tuple) -> str:
+    """Short stable-within-process label for a signature (event logs
+    need a JSON-friendly name, not a tuple full of function objects)."""
+    return f"b{abs(hash(sig)) & 0xFFFFFFFF:08x}"
+
+
+class RunTicket:
+    """Handle for one submitted run.
+
+    ``poll()`` is non-blocking; ``result()`` blocks until the run's
+    bucket has launched and the mega-run finished, force-flushing the
+    bucket first so a lone ticket never waits out ``max_wait_ms``.
+    """
+
+    def __init__(self, queue: "RunQueue", bucket: str):
+        self.bucket = bucket
+        self._queue = queue
+        self._event = threading.Event()
+        self._result: Optional[RunResult] = None
+        self._error: Optional[BaseException] = None
+
+    def _complete(self, result: Optional[RunResult], error=None) -> None:
+        self._result = result
+        self._error = error
+        self._event.set()
+
+    def poll(self) -> bool:
+        """True once the run's mega-run has been launched and assigned
+        (the result may still be device-lazy — ``result()`` reads it
+        back)."""
+        return self._event.is_set()
+
+    done = poll
+
+    def result(self, timeout: Optional[float] = None) -> RunResult:
+        if not self._event.is_set():
+            self._queue.flush(bucket=self.bucket)
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"run in bucket {self.bucket} not completed "
+                f"within {timeout}s"
+            )
+        if self._error is not None:
+            raise self._error
+        return self._result.block()
+
+
+class _Bucket:
+    __slots__ = ("executor", "items", "oldest")
+
+    def __init__(self, executor: BatchedRuns):
+        self.executor = executor
+        self.items: List[tuple] = []  # (RunRequest, RunTicket)
+        self.oldest: float = time.monotonic()
+
+
+class RunQueue:
+    """Accumulating async front end over :class:`BatchedRuns` executors.
+
+    One queue can serve many tenants: pass a default ``executor`` at
+    construction and/or a per-call executor to :meth:`submit`. Requests
+    land in the bucket named by ``executor.signature(request)``, so two
+    tenants with identical configuration share buckets (and compiled
+    programs) automatically, while any difference in shape, objective,
+    operators, or config splits them.
+    """
+
+    def __init__(
+        self,
+        executor: Optional[BatchedRuns] = None,
+        serving: Optional[ServingConfig] = None,
+        events=None,
+    ):
+        self.executor = executor
+        self.serving = serving or (
+            executor.serving if executor is not None else ServingConfig()
+        )
+        self.events = events if events is not None else (
+            executor.events if executor is not None else None
+        )
+        self._buckets: Dict[tuple, _Bucket] = {}
+        self._bucket_names: Dict[str, tuple] = {}
+        self._lock = threading.RLock()
+        self._closed = False
+        self._flusher: Optional[threading.Thread] = None
+        self.launches = 0
+        self.submitted = 0
+
+    # --------------------------------------------------------------- events
+
+    def _emit(self, event: str, **fields) -> None:
+        if self.events is not None:
+            self.events.emit(event, **fields)
+
+    # ---------------------------------------------------------------- admit
+
+    def submit(
+        self, request: RunRequest, executor: Optional[BatchedRuns] = None
+    ) -> RunTicket:
+        """Admit a run; returns its ticket. Launches the request's
+        bucket inline when it reaches ``max_batch``."""
+        if self._closed:
+            raise RuntimeError("queue is closed")
+        ex = executor or self.executor
+        if ex is None:
+            raise ValueError("no executor: pass one here or at init")
+        sig = ex.signature(request)
+        name = _bucket_id(sig)
+        launch = None
+        with self._lock:
+            bucket = self._buckets.get(sig)
+            if bucket is None:
+                bucket = self._buckets[sig] = _Bucket(ex)
+                self._bucket_names[name] = sig
+            if not bucket.items:
+                bucket.oldest = time.monotonic()
+            ticket = RunTicket(self, name)
+            bucket.items.append((request, ticket))
+            self.submitted += 1
+            self._emit(
+                "batch_admit", bucket=name, pending=len(bucket.items),
+                population_size=request.size,
+                genome_len=request.genome_len,
+            )
+            if len(bucket.items) >= self.serving.max_batch:
+                launch = self._take(sig)
+            self._ensure_flusher()
+        if launch is not None:
+            self._launch(sig, *launch)
+        return ticket
+
+    # --------------------------------------------------------------- launch
+
+    def _take(self, sig: tuple):
+        """Detach a bucket's pending items (lock held by caller)."""
+        bucket = self._buckets.get(sig)
+        if bucket is None or not bucket.items:
+            return None
+        items, bucket.items = bucket.items, []
+        return bucket.executor, items
+
+    def _launch(self, sig: tuple, executor: BatchedRuns, items) -> None:
+        name = _bucket_id(sig)
+        self._emit("batch_launch", bucket=name, batch_size=len(items))
+        self.launches += 1
+        try:
+            results = executor.run([req for req, _ in items])
+        except BaseException as e:  # propagate to every waiter
+            for _, ticket in items:
+                ticket._complete(None, error=e)
+            return
+        for (_, ticket), result in zip(items, results):
+            ticket._complete(result)
+
+    def flush(self, bucket: Optional[str] = None) -> int:
+        """Launch pending buckets now (all of them, or just the named
+        one). Returns the number of mega-runs launched."""
+        with self._lock:
+            if bucket is not None:
+                sig = self._bucket_names.get(bucket)
+                sigs = [] if sig is None else [sig]
+            else:
+                sigs = list(self._buckets)
+            taken = [(s, self._take(s)) for s in sigs]
+        count = 0
+        for sig, launch in taken:
+            if launch is not None:
+                self._launch(sig, *launch)
+                count += 1
+        return count
+
+    def drain(self) -> int:
+        """Flush everything pending; returns launches performed. After
+        drain() every previously returned ticket is completed (its
+        result may still be device-lazy until read)."""
+        return self.flush()
+
+    # -------------------------------------------------------- timed flusher
+
+    def _ensure_flusher(self) -> None:
+        if (
+            self._flusher is not None and self._flusher.is_alive()
+        ) or self.serving.max_wait_ms <= 0 or self._closed:
+            # max_wait_ms == 0 → flush on ticket.result()/drain() only
+            # (pure size-triggered batching, fully deterministic: no
+            # background thread races the test's own flushes).
+            return
+        self._flusher = threading.Thread(
+            target=self._flush_loop, name="pga-serving-flusher", daemon=True
+        )
+        self._flusher.start()
+
+    def _flush_loop(self) -> None:
+        interval = min(max(self.serving.max_wait_ms / 4000.0, 0.001), 0.05)
+        while not self._closed:
+            time.sleep(interval)
+            deadline = time.monotonic() - self.serving.max_wait_ms / 1000.0
+            with self._lock:
+                expired = [
+                    (sig, self._take(sig))
+                    for sig, b in self._buckets.items()
+                    if b.items and b.oldest <= deadline
+                ]
+            for sig, launch in expired:
+                if launch is not None:
+                    self._launch(sig, *launch)
+
+    def close(self) -> None:
+        """Flush pending work and stop the background flusher."""
+        self._closed = True
+        self.flush()
+
+    def __enter__(self) -> "RunQueue":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

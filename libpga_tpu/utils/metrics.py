@@ -29,6 +29,53 @@ class RunRecord:
         return self.generations / self.seconds if self.seconds > 0 else 0.0
 
 
+class Counters:
+    """Named monotonically-increasing counters with listener fan-out —
+    the metrics primitive behind the serving compile-cache's
+    hit/miss/evict accounting (``serving/cache.py``). Deliberately
+    minimal: ``bump`` increments, ``snapshot`` returns a plain dict (so
+    a consumer can diff two snapshots without holding a reference into
+    live state), and listeners registered with :meth:`add_listener` see
+    ``(name, value)`` per bump under the same isolation contract as
+    :class:`Metrics` run listeners."""
+
+    def __init__(self):
+        self._counts: dict = {}
+        self._listeners: List[Callable[[str, int], None]] = []
+
+    def add_listener(self, fn: Callable[[str, int], None]) -> Callable:
+        self._listeners.append(fn)
+        return fn
+
+    def remove_listener(self, fn: Callable[[str, int], None]) -> None:
+        try:
+            self._listeners.remove(fn)
+        except ValueError:
+            pass
+
+    def bump(self, name: str, by: int = 1) -> int:
+        value = self._counts.get(name, 0) + by
+        self._counts[name] = value
+        for fn in list(self._listeners):
+            try:
+                fn(name, value)
+            except Exception as e:
+                warnings.warn(
+                    f"counter listener {fn!r} raised {e!r} — ignored",
+                    stacklevel=2,
+                )
+        return value
+
+    def get(self, name: str) -> int:
+        return self._counts.get(name, 0)
+
+    def snapshot(self) -> dict:
+        return dict(self._counts)
+
+    def reset(self) -> None:
+        self._counts.clear()
+
+
 class Metrics:
     """Accumulates per-run statistics for a PGA instance.
 

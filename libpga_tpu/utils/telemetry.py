@@ -77,6 +77,8 @@ EVENT_FIELDS: Dict[str, tuple] = {
     "islands_end": ("generations", "seconds", "best"),
     "run_record": ("generations", "population_size", "seconds"),
     "compile": ("what",),
+    "batch_admit": ("bucket",),
+    "batch_launch": ("bucket", "batch_size"),
     "migration": ("pct",),
     "checkpoint_save": ("path",),
     "validation_failure": ("where", "error"),

@@ -108,6 +108,14 @@ def test_capi_telemetry_history(built_shim):
     assert "telemetry history:" in out
 
 
+def test_capi_serving_submit_poll_await(built_shim):
+    """pga_submit/pga_poll/pga_await: async batched serving round trip
+    from C — tickets pending below max_batch, done once the bucket
+    fills, awaited results bit-identical to a same-seed synchronous
+    pga_run, and the NULL/stale-ticket error surfaces (ISSUE 4)."""
+    _run(built_shim, "test_serving")
+
+
 def test_capi_selection_strategies(built_shim):
     """pga_set_selection: TRUNCATION and LINEAR_RANK converge from C;
     out-of-range params and unknown enum values return -1."""

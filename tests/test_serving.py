@@ -1,0 +1,492 @@
+"""Multi-tenant batched run engine (serving/): ISSUE 4 acceptance.
+
+The contracts under test:
+
+- **bit-exactness**: every run of a mega-run — in BOTH run-axis
+  layouts — produces the identical final population, scores, and
+  telemetry history slice as a standalone same-seed ``PGA.run``,
+  including runs with distinct per-run mutation rates sharing one
+  program;
+- **per-run early termination**: runs with different targets/budgets in
+  one batch each stop at exactly the generation their sequential
+  counterpart stops at, and finished runs' results are frozen;
+- **bucket routing**: mismatched shape signatures never share a
+  program — the queue splits them into separate launches, and a direct
+  mixed ``run()`` call refuses;
+- **compile-once**: a second same-bucket submission triggers 0 new
+  builds (asserted via the cache hit/miss counters), and the LRU cache
+  evicts at capacity;
+- **queue mechanics**: ``max_batch`` launches inline, ``max_wait_ms``
+  launches from the background flusher, ``drain()`` completes
+  everything, and the batch_admit/batch_launch/compile event stream
+  validates against the telemetry schema;
+- **cache-key hygiene** (ISSUE 4 satellite): every engine/islands
+  compile-cache key is namespaced with a ``<role>/`` prefix, so no
+  engine-level key can ever collide with an operator
+  ``kernel_cache_key``.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from libpga_tpu import PGA, PGAConfig, ServingConfig, TelemetryConfig
+from libpga_tpu.ops.mutate import make_point_mutate
+from libpga_tpu.serving import (
+    COUNTERS,
+    BatchedRuns,
+    ProgramCache,
+    RunQueue,
+    RunRequest,
+)
+
+POP, LEN = 256, 16
+
+
+def _executor(tel_gens=0, **cfg):
+    tel = TelemetryConfig(history_gens=tel_gens) if tel_gens else None
+    return BatchedRuns(
+        "onemax",
+        config=PGAConfig(use_pallas=False, telemetry=tel, **cfg),
+        serving=ServingConfig(aot_warmup=True),
+    )
+
+
+def _engine_run(seed, n, target=None, rate=None, tel_gens=0, pop=POP,
+                length=LEN):
+    tel = TelemetryConfig(history_gens=tel_gens) if tel_gens else None
+    pga = PGA(seed=seed, config=PGAConfig(use_pallas=False, telemetry=tel))
+    h = pga.create_population(pop, length)
+    pga.set_objective("onemax")
+    if rate is not None:
+        pga.set_mutate(make_point_mutate(rate))
+    gens = pga.run(n, target=target)
+    return pga, h, gens
+
+
+# ------------------------------------------------------------ bit-exactness
+
+
+@pytest.mark.parametrize("layout", ["run_major", "lockstep"])
+def test_batched_bit_identical_to_sequential_runs(layout):
+    """Same seeds → identical final populations, scores, and history
+    slices, for runs with DISTINCT mutation rates sharing one program."""
+    ex = _executor(tel_gens=16)
+    rates = [0.01, 0.05, 0.02, 0.08]
+    reqs = [
+        RunRequest(size=POP, genome_len=LEN, n=5, seed=30 + i,
+                   mutation_rate=r)
+        for i, r in enumerate(rates)
+    ]
+    results = ex.run(reqs, layout=layout)
+    for i, (r, rate) in enumerate(zip(results, rates)):
+        pga, h, gens = _engine_run(30 + i, 5, rate=rate, tel_gens=16)
+        assert r.generations == gens == 5
+        np.testing.assert_array_equal(
+            np.asarray(r.genomes), np.asarray(pga.population(h).genomes)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(r.scores), np.asarray(pga.population(h).scores)
+        )
+        hist = pga.history(h)
+        assert len(r.history) == len(hist)
+        np.testing.assert_array_equal(r.history.best, hist.best)
+        np.testing.assert_array_equal(r.history.stall, hist.stall)
+
+
+def test_layouts_agree():
+    ex = _executor()
+    reqs = [
+        RunRequest(size=POP, genome_len=LEN, n=4, seed=60 + i)
+        for i in range(3)
+    ]
+    a = ex.run(reqs, layout="run_major")
+    b = ex.run(reqs, layout="lockstep")
+    for ra, rb in zip(a, b):
+        np.testing.assert_array_equal(
+            np.asarray(ra.genomes), np.asarray(rb.genomes)
+        )
+        assert ra.generations == rb.generations
+
+
+@pytest.mark.parametrize("layout", ["run_major", "lockstep"])
+def test_per_run_early_termination_freeze(layout):
+    """Distinct per-run targets and budgets: each run stops exactly
+    where its sequential counterpart stops, and the generation that
+    reached the target is the one returned (not its offspring)."""
+    ex = _executor()
+    # Targets straddling reachability: request 0 terminates early,
+    # request 1 never reaches (runs its full budget), request 2 has a
+    # smaller budget than the others.
+    specs = [
+        (90, 40, float(LEN) * 0.56), (91, 12, float(LEN)), (92, 3, None),
+    ]
+    reqs = [
+        RunRequest(size=POP, genome_len=LEN, n=n, seed=s, target=t)
+        for s, n, t in specs
+    ]
+    results = ex.run(reqs, layout=layout)
+    gens_seen = []
+    for r, (seed, n, target) in zip(results, specs):
+        pga, h, gens = _engine_run(seed, n, target=target)
+        assert r.generations == gens
+        gens_seen.append(gens)
+        np.testing.assert_array_equal(
+            np.asarray(r.genomes), np.asarray(pga.population(h).genomes)
+        )
+        if target is not None and gens < n:
+            assert r.best_score >= target
+    # The early-stop spec must actually have stopped early, or this
+    # test exercises nothing.
+    assert gens_seen[0] < 40
+    assert gens_seen[1] == 12
+    assert gens_seen[2] == 3
+
+
+def test_explicit_genomes_and_key_match_engine_state_run():
+    """A request built from a live engine's population + next key is
+    served bit-identically to calling run() on that engine — the C
+    ABI's pga_submit contract."""
+    pga = PGA(seed=7, config=PGAConfig(use_pallas=False))
+    h = pga.create_population(POP, LEN)
+    pga.set_objective("onemax")
+    ex = _executor()
+    req = RunRequest(
+        size=POP, genome_len=LEN, n=4,
+        genomes=pga.population(h).genomes, key=pga.next_key(),
+    )
+    # Replay the same state transition on a clone engine.
+    pga2 = PGA(seed=7, config=PGAConfig(use_pallas=False))
+    h2 = pga2.create_population(POP, LEN)
+    pga2.set_objective("onemax")
+    (result,) = ex.run([req])
+    assert pga2.run(4) == 4
+    np.testing.assert_array_equal(
+        np.asarray(result.genomes), np.asarray(pga2.population(h2).genomes)
+    )
+
+
+def test_ragged_batch_padding_preserves_results():
+    """A non-power-of-two batch pads to the next compiled width; pad
+    runs must not perturb real runs."""
+    ex = _executor()
+    reqs = [
+        RunRequest(size=POP, genome_len=LEN, n=4, seed=70 + i)
+        for i in range(3)
+    ]
+    results = ex.run(reqs)
+    assert len(results) == 3
+    for i, r in enumerate(results):
+        pga, h, _ = _engine_run(70 + i, 4)
+        np.testing.assert_array_equal(
+            np.asarray(r.genomes), np.asarray(pga.population(h).genomes)
+        )
+
+
+# ----------------------------------------------------------- compile cache
+
+
+def test_second_same_bucket_submission_compiles_nothing():
+    """The acceptance gate: one build for the first mega-run of a
+    bucket; the second identical submission is a pure cache hit."""
+    ex = _executor()
+    reqs = [
+        RunRequest(size=POP, genome_len=LEN, n=3, seed=80 + i)
+        for i in range(2)
+    ]
+    ex.run(reqs)  # may build or hit depending on suite order
+    before = COUNTERS.snapshot()
+    ex.run([
+        RunRequest(size=POP, genome_len=LEN, n=9, seed=99,
+                   mutation_rate=0.07, target=12.3),
+        RunRequest(size=POP, genome_len=LEN, n=2, seed=98),
+    ])
+    after = COUNTERS.snapshot()
+    assert after.get("builds", 0) - before.get("builds", 0) == 0
+    assert after.get("hits", 0) - before.get("hits", 0) == 1
+
+
+def test_distinct_shapes_distinct_programs():
+    ex = _executor()
+    a = RunRequest(size=POP, genome_len=LEN, n=2, seed=1)
+    b = RunRequest(size=POP * 2, genome_len=LEN, n=2, seed=1)
+    c = RunRequest(size=POP, genome_len=LEN * 2, n=2, seed=1)
+    sigs = {ex.signature(a), ex.signature(b), ex.signature(c)}
+    assert len(sigs) == 3
+    with pytest.raises(ValueError, match="mixed bucket"):
+        ex.run([a, b])
+
+
+def test_program_cache_lru_eviction():
+    cache = ProgramCache(capacity=2, counters=None)
+    # Private counters so suite-order noise can't leak in.
+    cache.counters = type(COUNTERS)()
+    cache.get_or_build(("a",), lambda: "A")
+    cache.get_or_build(("b",), lambda: "B")
+    assert cache.get_or_build(("a",), lambda: "A2") == "A"  # refreshes a
+    cache.get_or_build(("c",), lambda: "C")  # evicts b (LRU)
+    assert cache.counters.get("evictions") == 1
+    assert ("b",) not in cache
+    assert ("a",) in cache and ("c",) in cache
+    snap = cache.stats()
+    assert snap["builds"] == 3
+    assert snap["entries"] == 2
+
+
+# ------------------------------------------------------------------- queue
+
+
+def test_queue_max_batch_inline_launch():
+    ex = _executor()
+    q = RunQueue(ex, serving=ServingConfig(max_batch=3, max_wait_ms=0))
+    tickets = [
+        q.submit(RunRequest(size=POP, genome_len=LEN, n=3, seed=i))
+        for i in range(2)
+    ]
+    assert not any(t.poll() for t in tickets)
+    tickets.append(
+        q.submit(RunRequest(size=POP, genome_len=LEN, n=3, seed=2))
+    )
+    assert all(t.poll() for t in tickets)  # the filling submit launched
+    assert q.launches == 1
+    assert tickets[0].result(timeout=60).generations == 3
+    q.close()
+
+
+def test_queue_result_forces_flush():
+    ex = _executor()
+    q = RunQueue(ex, serving=ServingConfig(max_batch=32, max_wait_ms=0))
+    t = q.submit(RunRequest(size=POP, genome_len=LEN, n=3, seed=5))
+    assert not t.poll()
+    assert t.result(timeout=60).generations == 3  # flushes its bucket
+    q.close()
+
+
+def test_queue_max_wait_ms_background_flush():
+    """A bucket below max_batch launches from the background flusher
+    once its oldest request has waited max_wait_ms — no caller action."""
+    ex = _executor()
+    q = RunQueue(ex, serving=ServingConfig(max_batch=32, max_wait_ms=40.0))
+    tickets = [
+        q.submit(RunRequest(size=POP, genome_len=LEN, n=3, seed=10 + i))
+        for i in range(2)
+    ]
+    deadline = time.monotonic() + 30.0
+    while not all(t.poll() for t in tickets):
+        if time.monotonic() > deadline:
+            pytest.fail("max_wait_ms flush never fired")
+        time.sleep(0.01)
+    assert q.launches == 1
+    q.close()
+
+
+def test_queue_routes_mismatched_shapes_to_separate_launches():
+    ex = _executor()
+    q = RunQueue(ex, serving=ServingConfig(max_batch=2, max_wait_ms=0))
+    t1 = q.submit(RunRequest(size=POP, genome_len=LEN, n=2, seed=1))
+    t2 = q.submit(RunRequest(size=POP * 2, genome_len=LEN, n=2, seed=2))
+    # Neither bucket filled: shapes never share a bucket.
+    assert not t1.poll() and not t2.poll()
+    assert q.drain() == 2  # one launch per shape bucket
+    assert t1.result(timeout=60).generations == 2
+    assert t2.result(timeout=60).generations == 2
+    assert t1.bucket != t2.bucket
+    q.close()
+
+
+def test_queue_events_validate_and_one_compile_per_bucket(tmp_path):
+    """batch_admit / batch_launch / compile flow through the telemetry
+    event log, validate against the schema, and a bucket compiles ONCE
+    across repeated same-bucket submissions."""
+    from libpga_tpu.utils import telemetry
+
+    path = str(tmp_path / "serving-events.jsonl")
+    with telemetry.EventLog(path) as log:
+        ex = BatchedRuns(
+            "onemax", config=PGAConfig(use_pallas=False), events=log
+        )
+        q = RunQueue(
+            ex, serving=ServingConfig(max_batch=2, max_wait_ms=0),
+            events=log,
+        )
+        for round_ in range(2):
+            ts = [
+                q.submit(
+                    RunRequest(size=POP, genome_len=LEN, n=2,
+                               seed=round_ * 10 + i)
+                )
+                for i in range(2)
+            ]
+            for t in ts:
+                t.result(timeout=60)
+        q.close()
+    records = telemetry.validate_log(path)
+    kinds = [r["event"] for r in records]
+    assert kinds.count("batch_admit") == 4
+    assert kinds.count("batch_launch") == 2
+    launches = [r for r in records if r["event"] == "batch_launch"]
+    assert all(r["batch_size"] == 2 for r in launches)
+    # One bucket, therefore AT MOST one actual program build; a warm
+    # program cache (suite order) legally yields zero.
+    compiles = [
+        r for r in records
+        if r["event"] == "compile" and r["what"] == "serving_mega_run"
+    ]
+    assert len(compiles) <= 1
+    admits = {r["bucket"] for r in records if r["event"] == "batch_admit"}
+    assert len(admits) == 1
+
+
+def test_queue_error_propagates_to_tickets():
+    ex = _executor()
+    q = RunQueue(ex, serving=ServingConfig(max_batch=1, max_wait_ms=0))
+    bad = RunRequest(
+        size=POP, genome_len=LEN, n=2, seed=1,
+        genomes=np.zeros((POP, LEN + 1), np.float32),
+    )
+    t = q.submit(bad)
+    with pytest.raises(ValueError, match="genomes"):
+        t.result(timeout=60)
+    q.close()
+
+
+# ---------------------------------------------------------------- islands
+
+
+def test_batched_island_runs_match_stacked_runner():
+    """N island runs through the batched loop are bit-identical to N
+    separate run_islands_stacked calls with the same keys (the island
+    face of the mega-run; reuses build_local_runner's exact loop)."""
+    from libpga_tpu import objectives
+    from libpga_tpu.ops.crossover import uniform_crossover
+    from libpga_tpu.ops.step import make_breed
+    from libpga_tpu.parallel.islands import (
+        make_batched_island_loop,
+        run_islands_stacked,
+    )
+
+    obj = objectives.get("onemax")
+    breed = make_breed(uniform_crossover, make_point_mutate(0.01))
+    N, I, S, L, m, epochs = 3, 2, 64, 16, 2, 3
+    mega = jax.jit(
+        make_batched_island_loop(breed, obj, m=m, count=3, topology="ring")
+    )
+    runs = []
+    for r in range(N):
+        key = jax.random.key(50 + r)
+        stacked = jax.random.uniform(jax.random.fold_in(key, 9), (I, S, L))
+        runs.append((stacked, key))
+    refs = [
+        run_islands_stacked(
+            breed, obj, g, k, n=epochs * m, m=m, pct=3 / S, topology="ring"
+        )
+        for g, k in runs
+    ]
+    island_keys, mig_keys = [], []
+    for _, k in runs:
+        ks = jax.random.split(k, I + 1)
+        mig_keys.append(ks[0])
+        island_keys.append(ks[1:])
+    g_b, s_b, e_b = mega(
+        jnp.stack([g for g, _ in runs]),
+        jnp.stack(island_keys),
+        jnp.stack(mig_keys),
+        jnp.full((N,), epochs, jnp.int32),
+        jnp.full((N,), jnp.inf, jnp.float32),
+    )
+    for r in range(N):
+        np.testing.assert_array_equal(
+            np.asarray(g_b[r]), np.asarray(refs[r][0])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(s_b[r]), np.asarray(refs[r][1])
+        )
+        assert int(e_b[r]) * m == refs[r][2]
+
+
+# ------------------------------------------------------------- validation
+
+
+def test_request_and_config_validation():
+    with pytest.raises(ValueError, match="seed or an explicit key"):
+        RunRequest(size=8, genome_len=8, n=1)
+    with pytest.raises(ValueError, match="n must be"):
+        RunRequest(size=8, genome_len=8, n=-1, seed=0)
+    with pytest.raises(ValueError, match="max_batch"):
+        ServingConfig(max_batch=0)
+    with pytest.raises(ValueError, match="layout"):
+        ServingConfig(layout="sideways")
+    with pytest.raises(ValueError, match="cache_capacity"):
+        ServingConfig(cache_capacity=0)
+    with pytest.raises(ValueError, match="mutate kind"):
+        from libpga_tpu.ops.step import make_param_breed
+        from libpga_tpu.ops.crossover import uniform_crossover
+
+        make_param_breed(uniform_crossover, "bitflip")
+
+
+# --------------------------------------------------------- cache-key hygiene
+
+
+def test_compile_cache_keys_are_role_namespaced():
+    """Satellite: every engine/islands compile-cache key is a tuple
+    whose first element is a '<ns>/<role>' string — structurally
+    disjoint from operator kernel_cache_keys (whose role tags carry no
+    '/'), so the historical collision class (engine key == operator
+    key) is impossible by construction."""
+    from libpga_tpu.ops.breed_expr import (
+        crossover_from_expression,
+        mutate_from_expression,
+    )
+    from libpga_tpu.ops.crossover import one_point_crossover
+
+    pga = PGA(seed=0, config=PGAConfig(use_pallas=False))
+    pga.create_population(64, 8)
+    pga.create_population(64, 8)
+    pga.set_objective("onemax")
+    pga.run(2)
+    pga.evaluate_all()
+    pga.crossover_all()
+    pga.mutate_all()
+    pga.run_islands(2, 1, 0.1)
+    keys = list(pga._compiled)
+    pga._crossover_expr_equivalent("one_point")
+    assert pga._crossover_kind() is not None  # populates nothing extra
+    pga.set_crossover(one_point_crossover)  # clears the cache...
+    pga.run(1)
+    keys += list(pga._compiled)  # ...so union both generations of keys
+    assert keys, "no compiled entries exercised"
+    namespaces = set()
+    for key in keys:
+        assert isinstance(key, tuple), f"bare key {key!r}"
+        assert isinstance(key[0], str) and "/" in key[0], (
+            f"un-namespaced cache key {key!r}"
+        )
+        namespaces.add(key[0].split("/", 1)[0])
+    assert namespaces <= {"engine", "islands", "serving"}
+    assert "engine" in namespaces and "islands" in namespaces
+
+    # Operator kernel_cache_keys can never equal an engine-level key.
+    cross_op = crossover_from_expression("where(r < 0.5, p1, p2)")
+    mut_op = mutate_from_expression("where(r < rate, r2, g)")
+    for op_key in (cross_op.kernel_cache_key, mut_op.kernel_cache_key):
+        assert op_key not in pga._compiled
+        assert "/" not in op_key[0]
+
+
+def test_serving_signature_separates_config_changes():
+    """Config fields that shape the program split buckets; runtime
+    inputs don't."""
+    base = _executor()
+    elitist = _executor(elitism=2)
+    req = RunRequest(size=POP, genome_len=LEN, n=2, seed=0)
+    assert base.signature(req) != elitist.signature(req)
+    r2 = RunRequest(
+        size=POP, genome_len=LEN, n=99, seed=123, target=5.0,
+        mutation_rate=0.3,
+    )
+    assert base.signature(req) == base.signature(r2)

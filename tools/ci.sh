@@ -16,10 +16,24 @@
 #                                 newest BENCH_r*.json artifact is
 #                                 stamped with them (schema_version,
 #                                 backend, device_kind,
-#                                 process_state_note — ISSUE 3).
+#                                 process_state_note — ISSUE 3);
+#   5. serving smoke            — 8 mixed-config runs through the async
+#                                 submission queue: asserts exactly one
+#                                 compile per shape bucket (cache
+#                                 counters), bit-parity with pga.run,
+#                                 and schema-valid batch_admit /
+#                                 batch_launch telemetry (ISSUE 4).
 # Exits nonzero on the first failing stage.
 set -e
 cd "$(dirname "$0")/.."
+
+# Persistent XLA compilation cache on every stage (ISSUE 4 satellite:
+# utils/profiling.enable_compilation_cache existed since round 2 but
+# nothing wired it into the hot paths) — reruns reload fused-kernel
+# compiles from disk instead of repeating them.
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$HOME/.cache/libpga_tpu_xla}"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="${JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS:-1}"
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 
 echo "== ci: tier-1 =="
 bash tools/run_tier1.sh
@@ -92,5 +106,74 @@ if art["schema_version"] != bench.SCHEMA_VERSION:
         f"bench.SCHEMA_VERSION {bench.SCHEMA_VERSION}"
     )
 print(f"bench provenance OK: {latest} schema_version={art['schema_version']}")
+PY
+
+echo "== ci: serving smoke =="
+JAX_PLATFORMS=cpu python - <<'PY'
+import sys
+import tempfile
+
+import numpy as np
+
+from libpga_tpu import PGA, PGAConfig, ServingConfig
+from libpga_tpu.serving import COUNTERS, BatchedRuns, RunQueue, RunRequest
+from libpga_tpu.utils import telemetry
+
+path = tempfile.mktemp(suffix=".jsonl", prefix="pga-ci-serving-")
+log = telemetry.EventLog(path)
+cfg = PGAConfig(use_pallas=False)
+small = BatchedRuns("onemax", config=cfg, events=log)
+wide = BatchedRuns("sphere", config=cfg, events=log)
+q = RunQueue(
+    small, serving=ServingConfig(max_batch=4, max_wait_ms=0), events=log
+)
+
+before = COUNTERS.snapshot()
+# 8 mixed-config runs: two shape buckets x two objectives-with-shapes,
+# distinct seeds/rates/targets inside each bucket.
+tickets = []
+for i in range(4):
+    tickets.append(q.submit(RunRequest(
+        size=256, genome_len=16, n=4, seed=i, mutation_rate=0.01 * (i + 1),
+    )))
+for i in range(4):
+    tickets.append(q.submit(RunRequest(
+        size=512, genome_len=8, n=4, seed=i,
+    ), executor=wide))
+q.drain()
+results = [t.result(timeout=120) for t in tickets]
+q.close()
+log.close()
+
+after = COUNTERS.snapshot()
+builds = after.get("builds", 0) - before.get("builds", 0)
+if builds != 2:
+    sys.exit(f"expected exactly 1 compile per bucket (2 total), got {builds}")
+
+# Bit-parity of one batched run against the engine path.
+pga = PGA(seed=2, config=cfg)
+h = pga.create_population(256, 16)
+pga.set_objective("onemax")
+from libpga_tpu.ops.mutate import make_point_mutate
+pga.set_mutate(make_point_mutate(0.03))
+pga.run(4)
+if not np.array_equal(
+    np.asarray(results[2].genomes), np.asarray(pga.population(h).genomes)
+):
+    sys.exit("batched run diverged from sequential PGA.run")
+
+records = telemetry.validate_log(path)
+kinds = [r["event"] for r in records]
+if kinds.count("batch_admit") != 8:
+    sys.exit(f"expected 8 batch_admit events, got {kinds.count('batch_admit')}")
+if kinds.count("batch_launch") != 2:
+    sys.exit(f"expected 2 batch_launch events, got {kinds.count('batch_launch')}")
+buckets = {r["bucket"] for r in records if r["event"] == "batch_launch"}
+if len(buckets) != 2:
+    sys.exit(f"expected 2 distinct buckets, got {buckets}")
+print(
+    f"serving smoke OK: 8 runs, 2 buckets, {builds} compiles, "
+    f"{len(records)} schema-valid events"
+)
 PY
 echo "== ci: all stages passed =="

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Standalone serving throughput A/B (ISSUE 4 satellite).
+
+Measures end-to-end request service rate for N concurrent OneMax runs
+three ways, interleaved per round (the decision-grade protocol from
+``utils/profiling.interleaved_medians``'s docstring):
+
+  batched     — one mega-run through serving.BatchedRuns (warm bucket;
+                rates are runtime inputs, so the sweep shares one
+                compiled program);
+  seq_fresh   — a fresh PGA instance per request (per-engine compile
+                caches: the pipeline ISSUE 4 exists to kill);
+  seq_warm    — one persistent engine re-running ONE fixed config warm
+                (the no-sweep charitable baseline: zero recompiles).
+
+The request stream is a mutation-rate sweep: each request carries a
+distinct (seed, rate). The engine bakes the rate into its compiled
+program, so the sequential arms recompile per request — exactly the
+cost the shared runtime-input program eliminates.
+
+Prints one JSON line. Run on any backend:
+
+    JAX_PLATFORMS=cpu python tools/serving_throughput.py
+    python tools/serving_throughput.py --pop 16384 --len 100 --gens 10 \
+        --batch 32 --rounds 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--pop", type=int, default=16384)
+    ap.add_argument("--len", dest="genome_len", type=int, default=100)
+    ap.add_argument("--gens", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument(
+        "--seq-count", type=int, default=3,
+        help="fresh-engine requests timed per round",
+    )
+    ap.add_argument(
+        "--layout", default=None, choices=[None, "run_major", "lockstep"],
+        help="mega-run layout (default: ServingConfig auto)",
+    )
+    args = ap.parse_args()
+
+    import jax
+
+    from libpga_tpu import PGA, PGAConfig
+    from libpga_tpu.serving import COUNTERS, BatchedRuns, RunRequest
+
+    from libpga_tpu.ops.mutate import make_point_mutate
+
+    ex = BatchedRuns("onemax", config=PGAConfig(use_pallas=False))
+
+    def sweep(n_reqs, base):
+        return [
+            (base + i, 0.005 + 2e-5 * (base % 7919) + 0.002 * i)
+            for i in range(n_reqs)
+        ]
+
+    def serve_batched(base_seed):
+        results = ex.run(
+            [
+                RunRequest(
+                    size=args.pop, genome_len=args.genome_len,
+                    n=args.gens, seed=seed, mutation_rate=rate,
+                )
+                for seed, rate in sweep(args.batch, base_seed)
+            ],
+            layout=args.layout,
+        )
+        for r in results:
+            r.block()
+
+    def serve_fresh(base_seed):
+        for seed, rate in sweep(args.seq_count, base_seed):
+            pga = PGA(seed=seed, config=PGAConfig(use_pallas=False))
+            pga.create_population(args.pop, args.genome_len)
+            pga.set_objective("onemax")
+            pga.set_mutate(make_point_mutate(rate))
+            pga.run(args.gens)
+
+    warm = PGA(seed=1, config=PGAConfig(use_pallas=False))
+    warm.create_population(args.pop, args.genome_len)
+    warm.set_objective("onemax")
+
+    serve_batched(10_000)  # compile the bucket (amortized warm-up)
+    warm.run(args.gens)
+
+    samples = {"batched": [], "seq_fresh": [], "seq_warm": []}
+    speedups = []
+    for rnd in range(args.rounds):
+        base = 20_000 + 1_000 * rnd
+        t0 = time.perf_counter()
+        serve_batched(base)
+        samples["batched"].append(
+            args.batch / (time.perf_counter() - t0)
+        )
+        t0 = time.perf_counter()
+        serve_fresh(base)
+        samples["seq_fresh"].append(
+            args.seq_count / (time.perf_counter() - t0)
+        )
+        t0 = time.perf_counter()
+        warm.run(args.gens)
+        samples["seq_warm"].append(1 / (time.perf_counter() - t0))
+        speedups.append(samples["batched"][-1] / samples["seq_fresh"][-1])
+
+    med = {k: statistics.median(v) for k, v in samples.items()}
+    print(
+        json.dumps(
+            {
+                "backend": jax.default_backend(),
+                "pop": args.pop,
+                "genome_len": args.genome_len,
+                "gens_per_request": args.gens,
+                "batch": args.batch,
+                "rounds": args.rounds,
+                "batched_runs_per_sec": round(med["batched"], 3),
+                "seq_fresh_runs_per_sec": round(med["seq_fresh"], 3),
+                "seq_warm_runs_per_sec": round(med["seq_warm"], 3),
+                "speedup_vs_fresh_median": round(
+                    statistics.median(speedups), 2
+                ),
+                "speedup_vs_warm": round(
+                    med["batched"] / med["seq_warm"], 2
+                ),
+                "cache_counters": {
+                    k: v
+                    for k, v in COUNTERS.snapshot().items()
+                    if k in ("hits", "misses", "builds", "evictions")
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -289,7 +289,7 @@ def main() -> int:
             solver.set_objective(from_expression(e, **consts))
             solver.run(2)
             entry = [
-                v for k, v in solver._compiled.items() if k[0] == "runP"
+                v for k, v in solver._compiled.items() if k[0] == "engine/run-pallas"
             ]
             fused = bool(entry) and entry[0] is not _XLA_FALLBACK
             if not fused:
@@ -320,7 +320,7 @@ def main() -> int:
             "where(r < rate, r2, g)", rate=0.02
         ))
         solver.run(30)
-        entry = [v for k, v in solver._compiled.items() if k[0] == "runP"]
+        entry = [v for k, v in solver._compiled.items() if k[0] == "engine/run-pallas"]
         if not (entry and entry[0] is not _XLA_FALLBACK):
             print("  expr breeding NOT FUSED")
             breed_ok = False
@@ -367,7 +367,7 @@ def main() -> int:
         solver.set_mutate(make_swap_mutate(0.5))
         solver.run(60)  # validate=True cross-checks fused scores per run
         # ...and that the engine took the kernel path, not _XLA_FALLBACK
-        entry = [v for k, v in solver._compiled.items() if k[0] == "runP"]
+        entry = [v for k, v in solver._compiled.items() if k[0] == "engine/run-pallas"]
         if not (entry and entry[0] is not _XLA_FALLBACK):
             print("  TSP run fell back to the XLA path")
             tsp_ok = False
