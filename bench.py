@@ -88,12 +88,24 @@ V5E_HBM_PEAK = 819e9  # TPU v5e: 819 GB/s HBM bandwidth per chip
 SCHEMA_VERSION = 1
 
 
-def enable_persistent_cache() -> str:
+def enable_persistent_cache():
     """Wire utils/profiling.enable_compilation_cache into the bench hot
     path (ISSUE 4 satellite — it existed since round 2 but nothing
     called it here): island/fused kernels then reload in milliseconds
     on rerun instead of recompiling. Returns the cache dir for the
-    provenance stamp."""
+    provenance stamp.
+
+    TPU sessions only: on the jaxlib-0.4.37 CPU backend, executing
+    persistent-cache-deserialized executables with donated buffers
+    corrupts the runtime heap (found by the ISSUE 5 chaos matrix —
+    donation-heavy checkpoint/restore loops segfault or silently
+    corrupt; see tools/ci.sh). CPU compiles are cheap; the cache's
+    motivation is tens-of-seconds Mosaic compiles. Returns None on
+    non-TPU backends (provenance then omits the cache fields)."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return None
     from libpga_tpu.utils.profiling import enable_compilation_cache
 
     path = os.environ.get(
@@ -446,6 +458,107 @@ def serving_arm(rounds: int = ROUNDS) -> dict:
     return out
 
 
+def supervised_arm(rounds: int = ROUNDS) -> dict:
+    """The permanent supervisor-overhead A/B (ISSUE 5): ms/run of a
+    SERVING_POP x GENOME_LEN OneMax run of SERVING_GENS generations —
+    bare ``PGA.run`` vs ``robustness.supervised_run`` at auto-checkpoint
+    cadence K=0 (pure supervisor wrapper: pre-chunk snapshot +
+    bookkeeping, no durability) vs K=SERVING_GENS/2 (one mid-run atomic
+    checkpoint + progress sidecar per run).
+
+    Protocol: per-round samples via ``utils/profiling.best_ms_per_unit``
+    (the shared two-length-subtraction estimator), the three arms
+    measured ADJACENT within each round, per-round overhead ratios from
+    adjacent pairs, medians + IQR across rounds — the interleaved
+    protocol every bench arm uses. The acceptance bar is direction-only
+    on this host (BASELINE.md documents a ±4% CPU drift floor): K=0
+    overhead must be within measurement noise; the artifact reports
+    median + IQR and gates nothing finer than a gross regression.
+    """
+    import tempfile
+
+    from libpga_tpu import PGA, PGAConfig
+    from libpga_tpu.robustness.supervisor import supervised_run
+    from libpga_tpu.utils.profiling import best_ms_per_unit
+
+    def engine():
+        pga = PGA(seed=17, config=PGAConfig(use_pallas=False))
+        pga.create_population(SERVING_POP, GENOME_LEN)
+        pga.set_objective("onemax")
+        pga.run(SERVING_GENS)  # compile + warm
+        return pga
+
+    bare_pga = engine()
+    k0_pga = engine()
+    km_pga = engine()
+    ckpt_dir = tempfile.mkdtemp(prefix="pga-bench-supervised-")
+    ckpt = os.path.join(ckpt_dir, "state.npz")
+    K = max(SERVING_GENS // 2, 1)
+
+    def run_bare(calls):
+        for _ in range(calls):
+            bare_pga.run(SERVING_GENS)
+
+    def run_supervised_k0(calls):
+        for _ in range(calls):
+            supervised_run(k0_pga, SERVING_GENS)
+
+    def run_supervised_ckpt(calls):
+        for _ in range(calls):
+            supervised_run(
+                km_pga, SERVING_GENS, checkpoint_path=ckpt,
+                checkpoint_every=K,
+            )
+
+    samples = {"bare": [], "supervised_k0": [], "supervised_ckpt": []}
+    k0_overheads, ckpt_overheads = [], []
+    for _ in range(rounds):
+        samples["bare"].append(best_ms_per_unit(run_bare, 2, 6))
+        samples["supervised_k0"].append(
+            best_ms_per_unit(run_supervised_k0, 2, 6)
+        )
+        samples["supervised_ckpt"].append(
+            best_ms_per_unit(run_supervised_ckpt, 2, 6)
+        )
+        k0_overheads.append(
+            (samples["supervised_k0"][-1] / samples["bare"][-1] - 1.0)
+            * 100.0
+        )
+        ckpt_overheads.append(
+            (samples["supervised_ckpt"][-1] / samples["bare"][-1] - 1.0)
+            * 100.0
+        )
+    med = {name: _median_iqr(xs) for name, xs in samples.items()}
+    k0_med, k0_iqr = _median_iqr(k0_overheads)
+    ck_med, ck_iqr = _median_iqr(ckpt_overheads)
+    return {
+        "supervised_pop": SERVING_POP,
+        "supervised_gens": SERVING_GENS,
+        "supervised_ckpt_every": K,
+        "supervised_rounds": rounds,
+        "supervised_bare_ms_per_run_median": round(med["bare"][0], 2),
+        "supervised_bare_ms_per_run_iqr": round(med["bare"][1], 2),
+        "supervised_k0_ms_per_run_median": round(
+            med["supervised_k0"][0], 2
+        ),
+        "supervised_overhead_pct_median": round(k0_med, 2),
+        "supervised_overhead_pct_iqr": round(k0_iqr, 2),
+        "supervised_ckpt_ms_per_run_median": round(
+            med["supervised_ckpt"][0], 2
+        ),
+        "supervised_ckpt_overhead_pct_median": round(ck_med, 2),
+        "supervised_ckpt_overhead_pct_iqr": round(ck_iqr, 2),
+        "supervised_note": (
+            "ms per SERVING_GENS-generation run, adjacent per round: "
+            "bare PGA.run vs supervised_run at K=0 (snapshot+bookkeeping "
+            "only — the within-noise bar) vs auto-checkpoint every "
+            f"{K} gens (one atomic save + sidecar per run). CPU drift "
+            "floor is +/-4% (BASELINE.md): gate only on gross "
+            "regressions of the medians"
+        ),
+    }
+
+
 def single_derived(gene_dtype, gps) -> dict:
     """Roofline-relative figures for the single-population result."""
     import jax.numpy as jnp
@@ -571,9 +684,10 @@ def main() -> None:
         "evaluation are real kernel work the model excludes; gens/sec is "
         "the headline metric"
     )
-    # Permanent serving arm (ISSUE 4) — backend-agnostic, so it rides
-    # every bench run, chip or CPU.
+    # Permanent serving + supervised arms (ISSUE 4 / ISSUE 5) —
+    # backend-agnostic, so they ride every bench run, chip or CPU.
     out.update(serving_arm())
+    out.update(supervised_arm())
     print(json.dumps(out))
 
 
@@ -590,10 +704,24 @@ def serving_main() -> None:
     print(json.dumps(out))
 
 
+def supervised_main() -> None:
+    """``python bench.py --supervised``: the supervisor-overhead arm
+    alone — CPU-decision-grade like the serving arm."""
+    cache_dir = enable_persistent_cache()
+    out = {
+        **provenance(cache_dir),
+        "metric": "supervised_overhead_pct_16kx100",
+        **supervised_arm(),
+    }
+    print(json.dumps(out))
+
+
 if __name__ == "__main__":
     import sys
 
     if "--serving" in sys.argv[1:]:
         serving_main()
+    elif "--supervised" in sys.argv[1:]:
+        supervised_main()
     else:
         main()

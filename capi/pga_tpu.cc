@@ -259,6 +259,20 @@ int pga_run_islands(pga_t *p, unsigned n, unsigned m, float pct) {
                                       n, m, static_cast<double>(pct)));
 }
 
+int pga_supervised_run(pga_t *p, unsigned n, unsigned checkpoint_every,
+                       unsigned max_retries, const char *checkpoint_path,
+                       int resume) {
+    if (!p) return -1;
+    return static_cast<int>(call_long(
+        "supervised_run", "(lIIIsi)", solver_of(p), n, checkpoint_every,
+        max_retries, checkpoint_path ? checkpoint_path : "", resume));
+}
+
+int pga_set_fault_plan(const char *json_spec) {
+    return static_cast<int>(call_long(
+        "set_fault_plan", "(s)", json_spec ? json_spec : ""));
+}
+
 pga_ticket_t *pga_submit(pga_t *p, unsigned n, float target) {
     if (!p) return nullptr;
     long tid = call_long("submit", "(lIif)", solver_of(p), n, 1,

@@ -256,6 +256,32 @@ int pga_run(pga_t *p, unsigned n, float target);
 int pga_run_n(pga_t *p, unsigned n);
 int pga_run_islands(pga_t *p, unsigned n, unsigned m, float pct);
 
+/* ---- Fault-tolerant execution (no reference analog: its correctness
+ * net is CUDA_CALL exit-on-error, pga.cu:24-31) --------------------------
+ *
+ * pga_supervised_run wraps pga_run in the supervisor
+ * (robustness/supervisor): a failing chunk is retried up to
+ * `max_retries` times with exponential backoff after rolling back to
+ * the pre-chunk snapshot (PRNG key + populations), so a retried run is
+ * bit-identical to one that never failed; with `checkpoint_path`
+ * non-empty the run auto-checkpoints every `checkpoint_every`
+ * generations (0 = only a final save) through the atomic checkpoint
+ * writer, and `resume` != 0 restores the checkpoint + progress sidecar
+ * first — the crash-recovery entry point. Returns generations
+ * completed toward `n` (including resumed progress), or -1.
+ *
+ * pga_set_fault_plan installs (or clears) the process-global
+ * fault-injection plan for chaos testing — see robustness/faults for
+ * sites and kinds. `json_spec` is a JSON object/array of plans, e.g.
+ *   {"site": "objective.eval", "kind": "raise", "at_call_n": 2}
+ * or "" / "off" to clear. Faults are OFF unless a plan is installed;
+ * the disabled path costs one attribute read per site. Returns 0 or
+ * -1 (bad spec). */
+int pga_supervised_run(pga_t *p, unsigned n, unsigned checkpoint_every,
+                       unsigned max_retries, const char *checkpoint_path,
+                       int resume);
+int pga_set_fault_plan(const char *json_spec);
+
 /* In-run telemetry (no reference analog — its observability is one
  * printf of the best score, pga.cu:230). pga_set_telemetry enables a
  * per-generation history recorded ON DEVICE inside the fused run loop

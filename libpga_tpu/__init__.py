@@ -30,6 +30,7 @@ from libpga_tpu.utils.telemetry import TelemetryConfig
 from libpga_tpu import ops
 from libpga_tpu import objectives
 from libpga_tpu import parallel
+from libpga_tpu import robustness
 from libpga_tpu.api import (
     pga_init,
     pga_deinit,
@@ -67,6 +68,7 @@ __all__ = [
     "ops",
     "objectives",
     "parallel",
+    "robustness",
     # C-shaped parity API
     "pga_init",
     "pga_deinit",

@@ -906,6 +906,67 @@ def await_ticket(ticket_id: int) -> int:
     return gens
 
 
+# ------------------------------------------------------------ robustness
+
+
+def set_fault_plan(spec: str) -> None:
+    """``pga_set_fault_plan``: install (or clear) the process-global
+    fault-injection plan from a JSON spec — the chaos driver's entry
+    point (``robustness/faults``).
+
+    Spec forms:
+      - ``""`` / ``"[]"`` / ``"null"`` / ``"off"``: clear the plan;
+      - a JSON object: one plan — ``{"site": ..., "kind": "raise"|"nan",
+        "at_call_n": N | "probability": p, "times": M|null}``;
+      - a JSON array of such objects;
+      - ``{"seed": S, "plans": [...]}`` to set the registry's PRNG seed
+        for probability-triggered plans.
+    """
+    import json
+
+    from libpga_tpu.robustness import faults
+
+    if not spec or spec.strip() in ("[]", "{}", "null", "off"):
+        faults.clear()
+        return
+    data = json.loads(spec)
+    seed = 0
+    if isinstance(data, dict) and "plans" in data:
+        seed = int(data.get("seed", 0))
+        data = data["plans"]
+    if isinstance(data, dict):
+        data = [data]
+    plans = [faults.FaultPlan(**d) for d in data]
+    faults.install(*plans, seed=seed)
+
+
+def supervised_run(
+    handle: int, n: int, checkpoint_every: int, max_retries: int,
+    checkpoint_path: str, resume: int,
+) -> int:
+    """``pga_supervised_run``: run the solver under the supervisor
+    (``robustness/supervisor``) — retry with exponential backoff,
+    auto-checkpoint every ``checkpoint_every`` generations to
+    ``checkpoint_path`` (empty string = no durability), and
+    ``resume`` != 0 restores the checkpoint + progress sidecar before
+    running. Returns generations completed toward ``n`` (including
+    resumed progress); -1 through the ABI on error."""
+    from libpga_tpu.robustness.supervisor import RetryPolicy
+    from libpga_tpu.robustness.supervisor import supervised_run as _sr
+
+    pga = _solver(handle)
+    with _exec_ctx(handle):
+        report = _sr(
+            pga,
+            int(n),
+            checkpoint_path=checkpoint_path or None,
+            checkpoint_every=int(checkpoint_every),
+            retry=RetryPolicy(max_retries=int(max_retries)),
+            resume=bool(resume),
+        )
+    return report.generations
+
+
 # ------------------------------------------------------------- telemetry
 
 

@@ -107,6 +107,15 @@ class PGAConfig:
         XLA evaluation oracle, raising ``ValidationError`` with the
         operation and population named. Adds a host copy + one XLA
         evaluation per checked op; off by default.
+      fallback: what a kernel-BUILD or first-dispatch failure on the
+        fused Pallas path does. "xla" (default): the run degrades
+        per-config to the XLA ``step`` path — bit-equal semantics to a
+        run whose shape the kernel had declined — with a one-time
+        warning and a ``degraded`` telemetry event, so an unvalidated
+        Mosaic lowering can never take down a serving process.
+        "raise": propagate the build/dispatch error (the fail-fast
+        stance for development and for the StableHLO purity gates).
+        Host-side policy only — it never changes a traced program.
       telemetry: in-run telemetry settings
         (``utils/telemetry.TelemetryConfig``): per-generation on-device
         history carried through the fused run loops (best/mean/std
@@ -134,6 +143,7 @@ class PGAConfig:
     pallas_subblock: Optional[int] = None
     donate_buffers: bool = True
     validate: bool = False
+    fallback: str = "xla"
     telemetry: Optional[TelemetryConfig] = None
     seed: Optional[int] = None
 
@@ -184,6 +194,8 @@ class PGAConfig:
             )
         if self.pallas_subblock is not None and self.pallas_subblock < 1:
             raise ValueError("pallas_subblock must be >= 1")
+        if self.fallback not in ("xla", "raise"):
+            raise ValueError("fallback must be 'xla' or 'raise'")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -213,6 +225,17 @@ class ServingConfig:
       aot_warmup: compile the mega-run ahead of time at bucket-build
         time via ``jit(...).lower(...).compile()`` — the first launch
         then only executes. Disable to defer compilation to first use.
+      max_pending: bounded-queue backpressure — the maximum number of
+        admitted-but-incomplete tickets. ``None`` (default) = unbounded
+        (the pre-robustness behavior). With a bound, an unserviceable
+        burst degrades predictably instead of accumulating memory
+        without limit; what ``submit`` does at the bound is the
+        ``overflow`` policy.
+      overflow: "block" (default) — ``submit`` waits until a pending
+        ticket completes (requires a flusher or a concurrent
+        ``result()`` reader to make progress); "raise" — ``submit``
+        raises :class:`libpga_tpu.serving.QueueFull` immediately, the
+        load-shedding policy.
     """
 
     max_batch: int = 32
@@ -221,6 +244,8 @@ class ServingConfig:
     layout: str = "auto"
     donate_buffers: bool = True
     aot_warmup: bool = True
+    max_pending: Optional[int] = None
+    overflow: str = "block"
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -233,6 +258,10 @@ class ServingConfig:
             raise ValueError(
                 "layout must be 'auto', 'run_major' or 'lockstep'"
             )
+        if self.max_pending is not None and self.max_pending < 1:
+            raise ValueError("max_pending must be >= 1 or None")
+        if self.overflow not in ("block", "raise"):
+            raise ValueError("overflow must be 'block' or 'raise'")
 
     def resolve_layout(self) -> str:
         if self.layout != "auto":

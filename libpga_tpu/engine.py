@@ -46,6 +46,7 @@ from libpga_tpu.ops.step import make_breed
 from libpga_tpu.ops.topk import top_k_genomes
 from libpga_tpu.utils.metrics import Metrics
 from libpga_tpu.utils import telemetry as _tl
+from libpga_tpu.robustness import faults as _faults
 
 
 # Cache marker: the Pallas factory declined this (shape, kind) — skip
@@ -187,6 +188,9 @@ class PGA:
         # participating slot); None when telemetry is off.
         self._history: List[Optional[_tl.History]] = []
         self._events: Optional[_tl.EventLog] = None
+        # One degradation warning per distinct cause (graceful kernel
+        # fallback, config.fallback == "xla").
+        self._degraded_warned: set = set()
 
     # ------------------------------------------------------------------ RNG
 
@@ -409,6 +413,33 @@ class PGA:
         traces to the same jaxpr as before telemetry existed
         (structurally asserted in tests/test_telemetry.py).
         """
+        return self._compiled_run_meta(size, genome_len)[0]
+
+    def _degrade(self, what: str, error: BaseException, **fields) -> None:
+        """Record a graceful kernel degradation (policy "xla"): one-time
+        warning per cause + a ``degraded`` telemetry event. The caller
+        has already decided to fall back."""
+        self._emit("degraded", what=what, error=str(error), **fields)
+        cause = (what, type(error).__name__)
+        if cause in self._degraded_warned:
+            return
+        self._degraded_warned.add(cause)
+        import warnings
+
+        warnings.warn(
+            f"fused Pallas {what} failed ({type(error).__name__}: {error})"
+            " — degrading this config to the XLA step path"
+            " (PGAConfig(fallback='raise') to fail fast instead)",
+            stacklevel=4,
+        )
+
+    def _compiled_run_meta(
+        self, size: int, genome_len: int
+    ) -> Tuple[Callable, Optional[tuple]]:
+        """(compiled run fn, pallas cache key or None). The key is
+        non-None exactly when the returned fn is the fused Pallas path —
+        ``run()`` uses it to retire the entry and re-dispatch on the XLA
+        path when a first dispatch fails under ``fallback="xla"``."""
         obj = self._require_objective()
         hist_gens = self._history_gens()
         pallas_kind = self._mutate_kind() if self._pallas_gate() else None
@@ -437,35 +468,26 @@ class PGA:
                     "compile", what="run_pallas", population_size=size,
                     genome_len=genome_len,
                 )
-                factory = make_pallas_run(
-                    obj,
-                    tournament_size=self.config.tournament_size,
-                    selection_kind=self.config.selection,
-                    selection_param=self.config.selection_param,
-                    # Defaults for callers that pass no runtime params;
-                    # the engine always passes self._mutate_params().
-                    mutation_rate=self._mutation_rate(),
-                    mutation_sigma=self._operator_param("sigma", 0.0),
-                    crossover_kind=self._crossover_kind(),
-                    mutate_kind=pallas_kind,
-                    elitism=self.config.elitism,
-                    deme_size=self.config.pallas_deme_size,
-                    donate=self.config.donate_buffers,
-                    gene_dtype=self.config.gene_dtype,
-                    generations_per_launch=(
-                        self.config.pallas_generations_per_launch
-                    ),
-                    history_gens=hist_gens,
-                    layout=self.config.pallas_layout,
-                    subblock=self.config.pallas_subblock,
-                )
-                pallas_fn = factory(size, genome_len) if factory else None
-                cached = (
-                    pallas_fn if pallas_fn is not None else _XLA_FALLBACK
-                )
+                try:
+                    cached = self._build_pallas_run(
+                        make_pallas_run, obj, pallas_kind, size,
+                        genome_len, hist_gens,
+                    )
+                except Exception as e:
+                    # Graceful degradation: an unvalidated Mosaic
+                    # lowering (or an injected kernel.build fault) must
+                    # never take down the process under the default
+                    # policy — the config drops to the XLA step path.
+                    if self.config.fallback == "raise":
+                        raise
+                    self._degrade(
+                        "kernel build", e, population_size=size,
+                        genome_len=genome_len,
+                    )
+                    cached = _XLA_FALLBACK
                 self._compiled[pkey] = cached
             if cached is not _XLA_FALLBACK:
-                return cached
+                return cached, pkey
 
         cache_key = (
             "engine/run-xla", size, genome_len, obj, self._crossover,
@@ -476,7 +498,7 @@ class PGA:
         )
         fn = self._compiled.get(cache_key)
         if fn is not None:
-            return fn
+            return fn, None
         self._emit(
             "compile", what="run_xla", population_size=size,
             genome_len=genome_len,
@@ -494,7 +516,39 @@ class PGA:
         donate = (0,) if self.config.donate_buffers else ()
         fn = jax.jit(run_loop, donate_argnums=donate)
         self._compiled[cache_key] = fn
-        return fn
+        return fn, None
+
+    def _build_pallas_run(
+        self, make_pallas_run, obj, pallas_kind, size, genome_len,
+        hist_gens,
+    ):
+        """Build the fused run fn for one shape, or ``_XLA_FALLBACK``
+        when the factory declines. Raises when the build itself fails —
+        the caller applies the ``config.fallback`` policy."""
+        factory = make_pallas_run(
+            obj,
+            tournament_size=self.config.tournament_size,
+            selection_kind=self.config.selection,
+            selection_param=self.config.selection_param,
+            # Defaults for callers that pass no runtime params;
+            # the engine always passes self._mutate_params().
+            mutation_rate=self._mutation_rate(),
+            mutation_sigma=self._operator_param("sigma", 0.0),
+            crossover_kind=self._crossover_kind(),
+            mutate_kind=pallas_kind,
+            elitism=self.config.elitism,
+            deme_size=self.config.pallas_deme_size,
+            donate=self.config.donate_buffers,
+            gene_dtype=self.config.gene_dtype,
+            generations_per_launch=(
+                self.config.pallas_generations_per_launch
+            ),
+            history_gens=hist_gens,
+            layout=self.config.pallas_layout,
+            subblock=self.config.pallas_subblock,
+        )
+        pallas_fn = factory(size, genome_len) if factory else None
+        return pallas_fn if pallas_fn is not None else _XLA_FALLBACK
 
     def _mutate_kind(self):
         """Kernel-implementable mutation kind of the active operator, or
@@ -722,25 +776,34 @@ class PGA:
                 stacklevel=3,
             )
         if use_island_multigen and fused is not None:
-            bm = make_pallas_multigen(
-                island_size,
-                genome_len,
-                deme_size=self.config.pallas_deme_size,
-                tournament_size=self.config.tournament_size,
-                selection_kind=self.config.selection,
-                selection_param=self.config.selection_param,
-                mutation_rate=self._mutation_rate(),
-                mutation_sigma=self._operator_param("sigma", 0.0),
-                crossover_kind=self._crossover_kind(),
-                mutate_kind=self._mutate_kind(),
-                elitism=self.config.elitism,
-                fused_obj=fused,
-                fused_consts=tuple(
-                    getattr(obj, "kernel_rowwise_consts", ())
-                ),
-                gene_dtype=self.config.gene_dtype,
-                _layout=self.config.pallas_layout,
-            )
+            try:
+                bm = make_pallas_multigen(
+                    island_size,
+                    genome_len,
+                    deme_size=self.config.pallas_deme_size,
+                    tournament_size=self.config.tournament_size,
+                    selection_kind=self.config.selection,
+                    selection_param=self.config.selection_param,
+                    mutation_rate=self._mutation_rate(),
+                    mutation_sigma=self._operator_param("sigma", 0.0),
+                    crossover_kind=self._crossover_kind(),
+                    mutate_kind=self._mutate_kind(),
+                    elitism=self.config.elitism,
+                    fused_obj=fused,
+                    fused_consts=tuple(
+                        getattr(obj, "kernel_rowwise_consts", ())
+                    ),
+                    gene_dtype=self.config.gene_dtype,
+                    _layout=self.config.pallas_layout,
+                )
+            except Exception as e:
+                if self.config.fallback == "raise":
+                    raise
+                self._degrade(
+                    "island multigen kernel build", e,
+                    island_size=island_size, genome_len=genome_len,
+                )
+                bm = None
             if bm is not None:
                 # An explicit config value bounds the island epoch's
                 # per-launch generation count too (None → the island
@@ -761,27 +824,38 @@ class PGA:
                     " falling back to the one-generation island path",
                     stacklevel=3,
                 )
-        pb = make_pallas_breed(
-            island_size,
-            genome_len,
-            deme_size=self.config.pallas_deme_size,
-            tournament_size=self.config.tournament_size,
-            selection_kind=self.config.selection,
-            selection_param=self.config.selection_param,
-            mutation_rate=self._mutation_rate(),
-            mutation_sigma=self._operator_param("sigma", 0.0),
-            crossover_kind=self._crossover_kind(),
-            mutate_kind=self._mutate_kind(),
-            # Without fused scores the kernel can't carry elites itself;
-            # the island epoch applies them after its separate evaluation
-            # (run_islands passes the epoch-level elitism).
-            elitism=self.config.elitism if fused is not None else 0,
-            fused_obj=fused,
-            fused_consts=tuple(getattr(obj, "kernel_rowwise_consts", ())),
-            gene_dtype=self.config.gene_dtype,
-            _layout=self.config.pallas_layout,
-            _subblock=self.config.pallas_subblock,
-        )
+        try:
+            pb = make_pallas_breed(
+                island_size,
+                genome_len,
+                deme_size=self.config.pallas_deme_size,
+                tournament_size=self.config.tournament_size,
+                selection_kind=self.config.selection,
+                selection_param=self.config.selection_param,
+                mutation_rate=self._mutation_rate(),
+                mutation_sigma=self._operator_param("sigma", 0.0),
+                crossover_kind=self._crossover_kind(),
+                mutate_kind=self._mutate_kind(),
+                # Without fused scores the kernel can't carry elites itself;
+                # the island epoch applies them after its separate evaluation
+                # (run_islands passes the epoch-level elitism).
+                elitism=self.config.elitism if fused is not None else 0,
+                fused_obj=fused,
+                fused_consts=tuple(getattr(obj, "kernel_rowwise_consts", ())),
+                gene_dtype=self.config.gene_dtype,
+                _layout=self.config.pallas_layout,
+                _subblock=self.config.pallas_subblock,
+            )
+        except Exception as e:
+            # Degrade THIS config to the XLA island breed (caller falls
+            # back on the cached None) instead of killing the run.
+            if self.config.fallback == "raise":
+                raise
+            self._degrade(
+                "island kernel build", e, island_size=island_size,
+                genome_len=genome_len,
+            )
+            pb = None
         self._compiled[cache_key] = pb
         return pb
 
@@ -810,20 +884,51 @@ class PGA:
         """
         handle = population or PopulationHandle(0)
         pop = self._populations[handle.index]
-        fn = self._compiled_run(pop.size, pop.genome_len)
+        fn, pallas_key = self._compiled_run_meta(pop.size, pop.genome_len)
         tgt = jnp.float32(jnp.inf if target is None else target)
         self._emit(
             "run_start", population_size=pop.size,
             genome_len=pop.genome_len, n=int(n),
             target=None if target is None else float(target),
         )
+        # Fault-injection site "objective.eval" (robustness/faults):
+        # kind "raise" propagates from here — BEFORE the key is consumed
+        # or any buffer donated, so a supervised retry replays the exact
+        # state; kind "nan" flags a NaN storm applied to the produced
+        # scores below. Disabled path: one attribute read.
+        nan_storm = (
+            _faults.PLAN is not None and _faults.PLAN.fire("objective.eval")
+        )
         t0 = time.perf_counter()
+        args = (
+            pop.genomes, self.next_key(), jnp.int32(n), tgt,
+            self._mutate_params(),
+        )
         with _tl.span("run"):
-            out = fn(
-                pop.genomes, self.next_key(), jnp.int32(n), tgt,
-                self._mutate_params(),
-            )
+            try:
+                out = fn(*args)
+            except Exception as e:
+                # Graceful degradation on FIRST DISPATCH of a fused
+                # Pallas program (an unvalidated Mosaic lowering can
+                # fail at execute, not only at build): retire the cache
+                # entry and re-dispatch the same inputs on the XLA path.
+                if pallas_key is None or self.config.fallback == "raise":
+                    raise
+                if (
+                    isinstance(pop.genomes, jax.Array)
+                    and pop.genomes.is_deleted()
+                ):
+                    raise  # the failed dispatch consumed the donation
+                self._degrade(
+                    "kernel dispatch", e, population_size=pop.size,
+                    genome_len=pop.genome_len,
+                )
+                self._compiled[pallas_key] = _XLA_FALLBACK
+                fn, _ = self._compiled_run_meta(pop.size, pop.genome_len)
+                out = fn(*args)
         genomes, scores, gens_done = out[:3]
+        if nan_storm:
+            scores = jnp.full_like(scores, jnp.nan)
         gens = int(gens_done)
         # Install the new population BEFORE notifying metrics listeners:
         # the old genome buffer was donated to the jit and is dead, and
@@ -1165,6 +1270,12 @@ class PGA:
             "islands_start", islands=len(self._populations), n=int(n),
             m=int(m), pct=float(pct),
         )
+        # Same "objective.eval" fault site as run() (see there): raise
+        # fires before any key consumption; nan poisons the installed
+        # scores below.
+        nan_storm = (
+            _faults.PLAN is not None and _faults.PLAN.fire("objective.eval")
+        )
         t0 = time.perf_counter()
         with _tl.span("run_islands"):
             out = run_islands_stacked(
@@ -1184,6 +1295,8 @@ class PGA:
                 history_gens=hist_gens,
             )
         genomes, scores, gens = out[:3]
+        if nan_storm:
+            scores = jnp.full_like(scores, jnp.nan)
         for i in range(len(self._populations)):
             # genomes[i] on a jax.Array stays on device (no host round trip).
             self._populations[i] = Population(

@@ -71,6 +71,8 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
+from libpga_tpu.robustness import faults as _faults
+
 LANE = 128
 
 # Every ablation flag the kernel factories understand, each consumed by
@@ -1968,6 +1970,12 @@ def make_pallas_breed(
     scores, so the padded rows are inert — the caller still sees exactly
     ``(P, L)``. Returns None when unsupported (population under one deme
     tile, an unsupported dtype, or elitism without fused scores)."""
+    # Fault-injection site (robustness/faults): a raised fault here
+    # travels the exact path a real Mosaic build failure would — the
+    # engine's fallback policy decides whether the config degrades to
+    # XLA or fails fast. No-op attribute read when no plan is installed.
+    if _faults.PLAN is not None:
+        _faults.PLAN.fire("kernel.build")
     # const_carrying deliberately EXCLUDES fused_tsp: its coordinate
     # table is a bilinear-matmul operand, not an NK-class
     # masked-accumulation table, and K=512 measured FASTER for the
@@ -2612,6 +2620,8 @@ def make_pallas_multigen(
     one-generation-only (the multigen kernel's whole point is keeping
     the group VMEM-resident), so ``_subblock`` is ignored here.
     """
+    if _faults.PLAN is not None:  # same site as make_pallas_breed
+        _faults.PLAN.fire("kernel.build")
     if fused_obj is None:
         return None
     _ablate = _validate_ablate(_ablate)
@@ -2987,6 +2997,11 @@ def make_pallas_run(
     chunks; a mid-launch target hit freezes its deme group so the
     achieving individual survives to the returned population), and
     elitism is applied per deme."""
+    # Fault-injection site (robustness/faults): fires BEFORE the backend
+    # gate so a chaos run on any host exercises the engine's
+    # build-failure fallback policy through this real entry point.
+    if _faults.PLAN is not None:
+        _faults.PLAN.fire("kernel.build")
     if not _supported():
         return None
     # The Mosaic kernel only lowers on TPU; an explicit use_pallas=True on

@@ -1,0 +1,214 @@
+"""Process-global, seed-deterministic fault injection.
+
+Chaos testing needs failures that are (a) injected through the REAL code
+paths — a fault raised by the registry travels the exact except/retry/
+fallback machinery a hardware or runtime fault would — and (b)
+reproducible, so a failing chaos run can be replayed. Both properties
+live here:
+
+- a :class:`FaultPlan` names a SITE (one of :data:`SITES`, each a real
+  call point in the library), a KIND (``"raise"`` — an
+  :class:`InjectedFault` propagates from the site — or ``"nan"`` — the
+  site's caller poisons the produced scores with NaN, the numeric-storm
+  mode), and a trigger: ``at_call_n`` (fire on exactly the Nth call to
+  the site) or ``probability`` (an independent per-call draw from the
+  registry's seeded PRNG — deterministic for a given seed and call
+  sequence);
+- :func:`install` activates a :class:`FaultRegistry` in the module
+  global :data:`PLAN`. Every injection site is guarded by
+  ``if faults.PLAN is not None`` — with no plan installed the site is a
+  single attribute read and the surrounding code is exactly the
+  pre-robustness path (the disabled-path purity the acceptance gate
+  asserts);
+- every fired fault is recorded in ``registry.injected`` and emitted as
+  a ``fault_injected`` telemetry event when the registry carries an
+  event log.
+
+This is OFF by default, forever: nothing in the library installs a plan;
+only tests, ``tools/chaos_smoke.py``, and the C ABI's
+``pga_set_fault_plan`` do.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import random
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+#: Injection sites threaded through the real code paths. The registry
+#: accepts unknown site names (forward compatibility for drivers probing
+#: a newer library), but these are the ones the library actually fires.
+SITES = (
+    # fused-kernel build/compile: ops/pallas_step.make_pallas_run (and
+    # its per-shape factory), make_pallas_breed, make_pallas_multigen
+    "kernel.build",
+    # serving program build: serving/cache.ProgramCache.get_or_build
+    "serving.compile",
+    # objective evaluation around the fused run dispatch
+    # (engine.PGA.run / run_islands) — supports kind="nan" (NaN storm)
+    "objective.eval",
+    # one mega-run launch: serving/batch.BatchedRuns.run
+    "serving.launch",
+    # checkpoint I/O: utils/checkpoint save (fires between the temp
+    # write and the atomic rename — the kill-mid-checkpoint point) and
+    # restore
+    "checkpoint.save",
+    "checkpoint.restore",
+    # the serving queue's background flusher thread loop
+    "serving.flusher",
+)
+
+_KINDS = ("raise", "nan")
+
+
+class InjectedFault(RuntimeError):
+    """The exception a ``kind="raise"`` plan throws from its site."""
+
+    def __init__(self, site: str, call: int = 0, message: str = ""):
+        self.site = site
+        self.call = call
+        super().__init__(
+            message or f"injected fault at {site!r} (call {call})"
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """One fault to inject.
+
+    Attributes:
+      site: injection-site name (see :data:`SITES`).
+      kind: ``"raise"`` (an :class:`InjectedFault` propagates from the
+        site) or ``"nan"`` (the site's caller NaN-poisons the scores it
+        produces — the numeric-storm mode; only honored at sites that
+        produce scores).
+      at_call_n: fire on exactly the Nth call to the site (1-based).
+      probability: when ``at_call_n`` is None, fire each call with this
+        probability (drawn from the registry's seeded PRNG — the SAME
+        seed and call sequence always fires the same calls).
+      times: maximum number of fires for this plan; None = unlimited.
+        The default of 1 models a transient fault (fails once, then the
+        retried operation succeeds).
+    """
+
+    site: str
+    kind: str = "raise"
+    at_call_n: Optional[int] = None
+    probability: float = 0.0
+    times: Optional[int] = 1
+
+    def __post_init__(self):
+        if not self.site:
+            raise ValueError("FaultPlan needs a site name")
+        if self.kind not in _KINDS:
+            raise ValueError(f"kind must be one of {_KINDS}, got {self.kind!r}")
+        if self.at_call_n is not None and self.at_call_n < 1:
+            raise ValueError("at_call_n is 1-based (must be >= 1)")
+        if not (0.0 <= self.probability <= 1.0):
+            raise ValueError("probability must be in [0, 1]")
+        if self.at_call_n is None and self.probability == 0.0:
+            raise ValueError(
+                "FaultPlan needs a trigger: at_call_n or probability > 0"
+            )
+        if self.times is not None and self.times < 1:
+            raise ValueError("times must be >= 1 or None (unlimited)")
+
+
+class FaultRegistry:
+    """An installed set of :class:`FaultPlan` s with per-site call
+    accounting. Thread-safe: sites fire from the serving flusher and
+    submitter threads concurrently."""
+
+    def __init__(
+        self,
+        plans: Tuple[FaultPlan, ...],
+        seed: int = 0,
+        events=None,
+    ):
+        self.plans = tuple(plans)
+        self.seed = seed
+        self.events = events
+        self.calls: Dict[str, int] = {}
+        self.injected: List[dict] = []
+        self._fired: Dict[int, int] = {}
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    def fire(self, site: str) -> bool:
+        """Count a call at ``site``; raise :class:`InjectedFault` when a
+        matching ``"raise"`` plan triggers, return True when a matching
+        value-transform plan (``"nan"``) triggers, else False."""
+        with self._lock:
+            n = self.calls.get(site, 0) + 1
+            self.calls[site] = n
+            for i, plan in enumerate(self.plans):
+                if plan.site != site:
+                    continue
+                if (
+                    plan.times is not None
+                    and self._fired.get(i, 0) >= plan.times
+                ):
+                    continue
+                if plan.at_call_n is not None:
+                    hit = plan.at_call_n == n
+                else:
+                    hit = self._rng.random() < plan.probability
+                if not hit:
+                    continue
+                self._fired[i] = self._fired.get(i, 0) + 1
+                self.injected.append(
+                    {"site": site, "kind": plan.kind, "call": n}
+                )
+                if self.events is not None:
+                    try:
+                        self.events.emit(
+                            "fault_injected", site=site, kind=plan.kind,
+                            call=n,
+                        )
+                    except Exception:
+                        pass  # an injected fault must not also break logging
+                if plan.kind == "raise":
+                    raise InjectedFault(site, n)
+                return True
+        return False
+
+
+#: The active registry, or None (the default, and the production state).
+#: Injection sites read this ONCE per call: ``if faults.PLAN is not
+#: None: faults.PLAN.fire("<site>")``.
+PLAN: Optional[FaultRegistry] = None
+
+
+def install(*plans: FaultPlan, seed: int = 0, events=None) -> FaultRegistry:
+    """Activate a fault plan process-wide; returns the registry (whose
+    ``calls``/``injected`` the chaos driver asserts on)."""
+    global PLAN
+    PLAN = FaultRegistry(tuple(plans), seed=seed, events=events)
+    return PLAN
+
+
+def clear() -> None:
+    """Deactivate fault injection (the default state)."""
+    global PLAN
+    PLAN = None
+
+
+@contextlib.contextmanager
+def active(*plans: FaultPlan, seed: int = 0, events=None):
+    """Scoped installation::
+
+        with faults.active(FaultPlan("objective.eval", at_call_n=2)) as reg:
+            ...
+        # cleared on exit, even on error
+    """
+    global PLAN
+    prev = PLAN
+    registry = FaultRegistry(tuple(plans), seed=seed, events=events)
+    PLAN = registry
+    try:
+        yield registry
+    finally:
+        PLAN = prev

@@ -1,0 +1,330 @@
+"""Supervised execution: retry, backoff, auto-checkpoint, stall watchdog.
+
+``PGA.run`` is fail-fast: any exception propagates and the run's progress
+since the last *manual* checkpoint is gone — the Python analog of the
+reference's ``CUDA_CALL`` exit-on-error (``src/pga.cu:24-31``).
+:func:`supervised_run` is the layer you leave running:
+
+- the run executes in CHUNKS of ``checkpoint_every`` generations, each
+  followed by an atomic :func:`libpga_tpu.utils.checkpoint.save` plus a
+  tiny JSON progress sidecar (``<path>.meta.json``);
+- a failing chunk is retried with exponential backoff + deterministic
+  jitter after ROLLING BACK to the pre-chunk snapshot (PRNG key +
+  populations), so the retry replays the exact key chain — a supervised
+  run that failed and retried is bit-identical to one that never failed;
+- a process death between chunks is recovered by calling
+  :func:`supervised_run` again with ``resume=True``: the engine restores
+  the last durable checkpoint (populations + PRNG key) and continues
+  from the recorded generation count — again bit-identical to an
+  uninterrupted same-seed supervised run with the same cadence (the
+  contract ``tools/chaos_smoke.py`` proves with injected faults);
+- NaN-storm detection: a chunk that completes with NaN scores is treated
+  as a failure (rolled back + retried) — deterministic NaN sources
+  exhaust the retries and raise :class:`NaNStorm` instead of silently
+  burning the remaining budget on a poisoned population;
+- a STALL WATCHDOG fed by the telemetry stall counter
+  (``TelemetryConfig(history_gens=...)``) aborts-and-reports once the
+  best score has not improved for ``stall_abort_gens`` generations,
+  instead of burning the rest of the budget (the engine's existing
+  ``stall_alert`` event fires on the same counter).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import time
+from typing import Callable, List, Optional, TYPE_CHECKING, Tuple
+
+if TYPE_CHECKING:
+    from libpga_tpu.engine import PGA
+
+
+class NaNStorm(RuntimeError):
+    """Raised (after retries are exhausted) when a chunk completes with
+    NaN scores — the numeric-blowup failure mode."""
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Retry/backoff settings for :func:`supervised_run`.
+
+    ``max_retries`` bounds attempts PER CHUNK. Backoff for attempt k is
+    ``min(base * factor**(k-1), max)``, scaled by a deterministic jitter
+    factor in ``[1 - jitter, 1]`` drawn from a PRNG seeded with
+    ``jitter_seed`` — two supervised runs with the same policy and
+    failure sequence sleep the same amounts (reproducible chaos runs).
+    """
+
+    max_retries: int = 3
+    backoff_base_s: float = 0.05
+    backoff_factor: float = 2.0
+    backoff_max_s: float = 5.0
+    jitter: float = 0.5
+    jitter_seed: int = 0
+
+    def __post_init__(self):
+        if self.max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
+        if self.backoff_base_s < 0 or self.backoff_max_s < 0:
+            raise ValueError("backoff times must be >= 0")
+        if self.backoff_factor < 1.0:
+            raise ValueError("backoff_factor must be >= 1")
+        if not (0.0 <= self.jitter <= 1.0):
+            raise ValueError("jitter must be in [0, 1]")
+
+    def delay(self, attempt: int, rng: random.Random) -> float:
+        """Backoff before retry ``attempt`` (1-based)."""
+        base = min(
+            self.backoff_base_s * self.backoff_factor ** (attempt - 1),
+            self.backoff_max_s,
+        )
+        return base * (1.0 - self.jitter * rng.random())
+
+
+@dataclasses.dataclass
+class SupervisedReport:
+    """What :func:`supervised_run` did — returned, never printed."""
+
+    generations: int = 0  # total toward n, including resumed progress
+    retries: int = 0
+    checkpoints: int = 0
+    restored: bool = False  # this call resumed from a checkpoint
+    aborted_on_stall: bool = False
+    target_reached: bool = False
+    best_score: float = float("-inf")
+    errors: List[str] = dataclasses.field(default_factory=list)
+
+
+def _meta_path(path: str) -> str:
+    return f"{path}.meta.json"
+
+
+def _write_meta(path: str, meta: dict) -> None:
+    """Atomic sidecar write — same durability stance as the checkpoint
+    itself (a torn sidecar must not shadow a good one)."""
+    tmp = f"{_meta_path(path)}.{os.getpid()}.tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(meta, fh)
+    os.replace(tmp, _meta_path(path))
+
+
+def read_meta(path: str) -> Optional[dict]:
+    """The progress sidecar of a supervised checkpoint, or None."""
+    try:
+        with open(_meta_path(path), "r", encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _snapshot(pga: "PGA"):
+    """Pre-chunk rollback state: the PRNG key plus HOST copies of every
+    population's buffers.
+
+    Copies, not references: with buffer donation on, a retried chunk
+    donates the installed genome buffer — the snapshot must survive a
+    second rollback. Host (numpy) copies specifically: ``np.array``
+    blocks until the buffer is ready and materializes off-device, so
+    the snapshot can never alias — or hold an in-flight async
+    device-to-device copy of — a buffer the very next dispatch donates.
+    (Unrelated but found by the chaos matrix: the PERSISTENT
+    compilation cache on jaxlib 0.4.37/CPU corrupts the heap under
+    donation-heavy checkpoint/restore loops — see tools/ci.sh; the
+    cache, not this snapshot, was the culprit.)"""
+    import numpy as np
+
+    return (
+        pga._key,
+        [
+            (np.array(p.genomes), np.array(p.scores))
+            for p in pga._populations
+        ],
+    )
+
+
+def _rollback(pga: "PGA", snap) -> None:
+    """Reinstate a snapshot. Uploads fresh device buffers from the host
+    copies, so the snapshot stays pristine for further rollbacks (see
+    :func:`_snapshot`)."""
+    import jax.numpy as jnp
+
+    from libpga_tpu.population import Population
+
+    key, pops = snap
+    pga._key = key
+    pga._populations = [
+        Population(genomes=jnp.asarray(g), scores=jnp.asarray(s))
+        for g, s in pops
+    ]
+    pga._staged = [None] * len(pops)
+    pga._history = [None] * len(pops)
+
+
+def _has_nan_scores(pga: "PGA") -> bool:
+    import jax.numpy as jnp
+
+    return any(
+        bool(jnp.isnan(p.scores).any()) for p in pga._populations
+    )
+
+
+def _best(pga: "PGA") -> float:
+    best = float("-inf")
+    for p in pga._populations:
+        import jax.numpy as jnp
+
+        v = float(jnp.max(p.scores))
+        if v > best:
+            best = v
+    return best
+
+
+def _stalled_gens(pga: "PGA") -> int:
+    """Final stall-counter value across the populations' most recent
+    histories (0 when telemetry is off)."""
+    worst = 0
+    for hist in pga._history:
+        if hist is not None and len(hist) > 0:
+            worst = max(worst, int(hist.stall[-1]))
+    return worst
+
+
+def supervised_run(
+    pga: "PGA",
+    n: int,
+    *,
+    target: Optional[float] = None,
+    islands: Optional[Tuple[int, float]] = None,
+    checkpoint_path: Optional[str] = None,
+    checkpoint_every: int = 0,
+    retry: Optional[RetryPolicy] = None,
+    stall_abort_gens: int = 0,
+    detect_nan: bool = True,
+    resume: bool = False,
+    sleep: Callable[[float], None] = time.sleep,
+) -> SupervisedReport:
+    """Run ``pga`` for up to ``n`` generations under supervision.
+
+    Args:
+      pga: the solver (objective + populations already set up).
+      n: total generation budget (including any resumed progress).
+      target: early-stop objective value (as in ``PGA.run``).
+      islands: ``(m, pct)`` to supervise ``run_islands`` (migration
+        every ``m`` generations of the top ``pct``) instead of ``run``.
+      checkpoint_path: where auto-checkpoints go; None disables
+        durability (retry/rollback still works in memory).
+      checkpoint_every: auto-checkpoint cadence in generations (the
+        chunk size). 0 = one chunk of ``n`` generations — the
+        supervisor then adds only the snapshot + bookkeeping (the
+        bench ``supervised`` arm's K=0 overhead case) and, when
+        ``checkpoint_path`` is set, a single final save.
+      retry: :class:`RetryPolicy`; default ``RetryPolicy()``.
+      stall_abort_gens: abort once the telemetry stall counter reaches
+        this (0 = no watchdog; requires
+        ``PGAConfig(telemetry=TelemetryConfig(history_gens>0))``).
+      detect_nan: treat NaN scores after a chunk as a failure.
+      resume: restore ``checkpoint_path`` (+ its progress sidecar)
+        before running — the crash-recovery entry point.
+      sleep: backoff sleeper (injectable for tests).
+
+    Returns a :class:`SupervisedReport`. Raises the last chunk error
+    once ``retry.max_retries`` is exhausted.
+    """
+    from libpga_tpu.utils import checkpoint as _ckpt
+
+    if n < 0:
+        raise ValueError("n must be >= 0")
+    if checkpoint_every < 0:
+        raise ValueError("checkpoint_every must be >= 0")
+    if islands is not None and len(islands) != 2:
+        raise ValueError("islands must be (m, pct)")
+    retry = retry or RetryPolicy()
+    rng = random.Random(retry.jitter_seed)
+    report = SupervisedReport()
+
+    done = 0
+    if resume:
+        if not checkpoint_path:
+            raise ValueError("resume=True needs a checkpoint_path")
+        meta = read_meta(checkpoint_path)
+        _ckpt.restore(pga, checkpoint_path)
+        report.restored = True
+        if meta is not None:
+            done = int(meta.get("generations", 0))
+            report.target_reached = bool(meta.get("target_reached", False))
+
+    chunk = checkpoint_every if checkpoint_every > 0 else max(n - done, 0)
+
+    def save_progress(generations: int) -> None:
+        if not checkpoint_path:
+            return
+        _ckpt.save(pga, checkpoint_path)
+        _write_meta(
+            checkpoint_path,
+            {
+                "schema": 1,
+                "generations": generations,
+                "n": n,
+                "target_reached": report.target_reached,
+            },
+        )
+        report.checkpoints += 1
+
+    while done < n and not report.target_reached:
+        step = min(chunk, n - done)
+        snap = _snapshot(pga)
+        attempt = 0
+        while True:
+            try:
+                if islands is None:
+                    gens = pga.run(step, target=target)
+                else:
+                    m, pct = islands
+                    gens = pga.run_islands(step, m, pct, target=target)
+                if detect_nan and _has_nan_scores(pga):
+                    raise NaNStorm(
+                        "NaN scores after chunk — numeric storm"
+                    )
+                # Checkpoint INSIDE the attempt scope: a save that dies
+                # (preemption mid-write, injected checkpoint.save fault)
+                # rolls back and replays the chunk deterministically —
+                # the atomic writer guarantees the previous checkpoint
+                # survived the failed save.
+                if checkpoint_every > 0 and checkpoint_path:
+                    save_progress(done + gens)
+                break
+            except KeyboardInterrupt:
+                raise
+            except BaseException as e:
+                attempt += 1
+                report.errors.append(f"{type(e).__name__}: {e}")
+                if attempt > retry.max_retries:
+                    raise
+                _rollback(pga, snap)
+                delay = retry.delay(attempt, rng)
+                report.retries += 1
+                pga._emit(
+                    "retry", attempt=attempt, error=str(e),
+                    backoff_s=round(delay, 4), where="supervised_run",
+                )
+                sleep(delay)
+        done += gens
+        if target is not None and gens < step:
+            report.target_reached = True
+        if (
+            stall_abort_gens > 0
+            and _stalled_gens(pga) >= stall_abort_gens
+        ):
+            report.aborted_on_stall = True
+            break
+
+    report.generations = done
+    report.best_score = _best(pga)
+    # Final durable state (covers checkpoint_every == 0, early stop,
+    # and stall aborts) so a later resume=True sees completion.
+    if checkpoint_path:
+        save_progress(done)
+    return report

@@ -10,12 +10,41 @@ Three layers (see ISSUE 4 / README "Serving"):
 - :mod:`libpga_tpu.serving.queue` — the async front door:
   ``submit() -> RunTicket``, accumulation per bucket, launch at
   ``max_batch`` or ``max_wait_ms``.
+
+Failure semantics (ISSUE 5 — the contracts a serving operator leans on):
+
+- **Per-ticket failure isolation.** A failing run inside a mega-batch
+  fails ONLY its own ticket. When a launch raises, the queue
+  pre-validates every co-batched request (``BatchedRuns.validate``) —
+  statically invalid ones dead-letter immediately with their diagnosis —
+  and requeues the survivors ONCE as solo launches; a request that then
+  fails alone is itself the poison. Poisoned requests land on
+  ``RunQueue.dead_letters`` (a :class:`~libpga_tpu.serving.queue.DeadLetter`
+  each: request + bucket + error) and emit a ``dead_letter`` telemetry
+  event; every innocent ticket completes normally.
+- **Bounded-queue backpressure.** ``ServingConfig(max_pending=N)``
+  bounds admitted-but-incomplete tickets; at the bound ``submit``
+  follows ``overflow``: ``"block"`` (wait for a completion) or
+  ``"raise"`` (:class:`~libpga_tpu.serving.queue.QueueFull` — load
+  shedding). Default is unbounded, the pre-robustness behavior.
+- **Deterministic teardown.** ``RunQueue.close()`` wakes and JOINS the
+  background flusher before the final flush — no flusher iteration can
+  race a post-close launch, and post-close ``submit`` always raises.
+  A flusher thread that dies mid-run (crash, injected
+  ``serving.flusher`` fault) is replaced on the next submit.
+- ``ticket.result(timeout=...)`` raising ``TimeoutError`` leaves the
+  ticket re-awaitable — call ``result()`` again to keep waiting.
 """
 
 from libpga_tpu.config import ServingConfig
 from libpga_tpu.serving.batch import BatchedRuns, RunRequest, RunResult
 from libpga_tpu.serving.cache import COUNTERS, PROGRAM_CACHE, ProgramCache
-from libpga_tpu.serving.queue import RunQueue, RunTicket
+from libpga_tpu.serving.queue import (
+    DeadLetter,
+    QueueFull,
+    RunQueue,
+    RunTicket,
+)
 
 __all__ = [
     "BatchedRuns",
@@ -23,6 +52,8 @@ __all__ = [
     "RunResult",
     "RunQueue",
     "RunTicket",
+    "DeadLetter",
+    "QueueFull",
     "ServingConfig",
     "ProgramCache",
     "PROGRAM_CACHE",

@@ -50,6 +50,7 @@ from libpga_tpu.engine import make_run_loop
 from libpga_tpu.ops.crossover import uniform_crossover
 from libpga_tpu.ops.step import make_param_breed
 from libpga_tpu.population import create_population
+from libpga_tpu.robustness import faults as _faults
 from libpga_tpu.serving import cache as _cache
 from libpga_tpu.utils import telemetry as _tl
 
@@ -233,6 +234,41 @@ class BatchedRuns:
         if self.events is not None:
             self.events.emit(event, **fields)
 
+    # ---------------------------------------------------------- validation
+
+    def validate(self, req: RunRequest) -> Optional[Exception]:
+        """Pre-validate one request's static parameters; returns the
+        diagnosis (an exception instance) or None when the request looks
+        launchable. The queue's failure isolation (``serving/queue.py``)
+        uses this to split a failed mega-run into poisoned requests
+        (dead-lettered with their error) and innocent survivors
+        (requeued) — cheap, host-only checks, no device work."""
+        try:
+            if req.size < 1 or req.genome_len < 1:
+                raise ValueError(
+                    f"invalid shape ({req.size}, {req.genome_len})"
+                )
+            if req.genomes is not None:
+                shape = tuple(np.shape(req.genomes))
+                if shape != (req.size, req.genome_len):
+                    raise ValueError(
+                        f"request genomes {shape} != "
+                        f"({req.size}, {req.genome_len})"
+                    )
+            if req.mutation_rate is not None and not (
+                0.0 <= req.mutation_rate <= 1.0
+            ):
+                raise ValueError(
+                    f"mutation_rate {req.mutation_rate} not in [0, 1]"
+                )
+            if req.mutation_sigma is not None and req.mutation_sigma < 0:
+                raise ValueError(
+                    f"mutation_sigma {req.mutation_sigma} < 0"
+                )
+        except Exception as e:
+            return e
+        return None
+
     # ------------------------------------------------------- program build
 
     def _history_gens(self) -> Optional[int]:
@@ -328,6 +364,10 @@ class BatchedRuns:
         """
         if not requests:
             return []
+        # Fault-injection site (robustness/faults): a raise here is a
+        # mega-run launch failure the queue's isolation must contain.
+        if _faults.PLAN is not None:
+            _faults.PLAN.fire("serving.launch")
         sigs = {self.signature(r) for r in requests}
         if len(sigs) != 1:
             raise ValueError(

@@ -29,6 +29,7 @@ import threading
 from collections import OrderedDict
 from typing import Callable, Optional
 
+from libpga_tpu.robustness import faults as _faults
 from libpga_tpu.utils.metrics import Counters
 
 #: Module-level counter set: hits / misses / builds / evictions.
@@ -106,6 +107,11 @@ class ProgramCache:
         self.counters.bump("builds")
         if on_compile is not None:
             on_compile()
+        # Fault-injection site (robustness/faults): a raise here is a
+        # mega-run compile failure on the real build path — the queue's
+        # launch isolation (serving/queue.py) decides who it poisons.
+        if _faults.PLAN is not None:
+            _faults.PLAN.fire("serving.compile")
         program = build()
         self.put(key, program)
         return program

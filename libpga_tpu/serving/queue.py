@@ -14,16 +14,45 @@ host-side readback happens in ``ticket.result()``. With JAX's async
 dispatch this overlaps the readback of batch k with the device
 execution of batch k+1; nothing in the queue ever calls
 ``jax.block_until_ready`` on behalf of a caller that hasn't asked.
+
+Failure isolation (ISSUE 5): a failing run inside a mega-batch fails
+only its own ticket. A launch that raises is split by
+``BatchedRuns.validate`` — statically invalid requests dead-letter
+immediately with their diagnosis — and the surviving requests are
+requeued ONCE as solo launches; a request that fails alone is itself
+poisoned and joins :attr:`RunQueue.dead_letters` with its error, while
+every innocent co-batched ticket completes normally. Bounded-queue
+backpressure (``ServingConfig.max_pending`` + ``overflow``) makes an
+unserviceable burst degrade predictably: ``submit`` blocks, or raises
+:class:`QueueFull`, instead of accumulating without limit.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
 from typing import Dict, List, Optional
 
 from libpga_tpu.config import ServingConfig
+from libpga_tpu.robustness import faults as _faults
 from libpga_tpu.serving.batch import BatchedRuns, RunRequest, RunResult
+
+
+class QueueFull(RuntimeError):
+    """``submit`` under ``overflow="raise"`` with ``max_pending``
+    admitted-but-incomplete tickets already in flight."""
+
+
+@dataclasses.dataclass(frozen=True)
+class DeadLetter:
+    """One poisoned request: what was submitted, where it was bucketed,
+    and why it failed. Kept on :attr:`RunQueue.dead_letters` so an
+    operator can inspect/replay instead of losing the diagnosis."""
+
+    request: RunRequest
+    bucket: str
+    error: BaseException
 
 
 def _bucket_id(sig: tuple) -> str:
@@ -37,7 +66,9 @@ class RunTicket:
 
     ``poll()`` is non-blocking; ``result()`` blocks until the run's
     bucket has launched and the mega-run finished, force-flushing the
-    bucket first so a lone ticket never waits out ``max_wait_ms``.
+    bucket first so a lone ticket never waits out ``max_wait_ms``. A
+    ``result(timeout=...)`` that raises ``TimeoutError`` leaves the
+    ticket intact — call ``result()`` again to keep waiting.
     """
 
     def __init__(self, queue: "RunQueue", bucket: str):
@@ -51,6 +82,7 @@ class RunTicket:
         self._result = result
         self._error = error
         self._event.set()
+        self._queue._ticket_done()
 
     def poll(self) -> bool:
         """True once the run's mega-run has been launched and assigned
@@ -111,8 +143,14 @@ class RunQueue:
         self._lock = threading.RLock()
         self._closed = False
         self._flusher: Optional[threading.Thread] = None
+        self._wake = threading.Event()  # close() interrupts the flusher nap
+        # Backpressure accounting: tickets admitted but not completed.
+        self._pending = 0
+        self._pending_cv = threading.Condition()
         self.launches = 0
         self.submitted = 0
+        self.requeues = 0
+        self.dead_letters: List[DeadLetter] = []
 
     # --------------------------------------------------------------- events
 
@@ -120,39 +158,82 @@ class RunQueue:
         if self.events is not None:
             self.events.emit(event, **fields)
 
+    # --------------------------------------------------------- backpressure
+
+    def _ticket_done(self) -> None:
+        with self._pending_cv:
+            self._pending -= 1
+            self._pending_cv.notify_all()
+
+    @property
+    def pending(self) -> int:
+        """Admitted-but-incomplete tickets (the backpressure quantity)."""
+        with self._pending_cv:
+            return self._pending
+
+    def _admit_slot(self) -> None:
+        """Reserve a pending slot, blocking or raising per the overflow
+        policy at the ``max_pending`` bound. Called OUTSIDE the bucket
+        lock (a blocked submit must not stall completions)."""
+        limit = self.serving.max_pending
+        with self._pending_cv:
+            while limit is not None and self._pending >= limit:
+                if self._closed:
+                    raise RuntimeError("queue is closed")
+                if self.serving.overflow == "raise":
+                    raise QueueFull(
+                        f"{self._pending} pending tickets >= "
+                        f"max_pending={limit}"
+                    )
+                self._pending_cv.wait(timeout=0.05)
+            self._pending += 1
+
+    def _unadmit(self) -> None:
+        """Roll back a slot reserved by :meth:`_admit_slot` when the
+        admission itself fails (closed race, executor error)."""
+        self._ticket_done()
+
     # ---------------------------------------------------------------- admit
 
     def submit(
         self, request: RunRequest, executor: Optional[BatchedRuns] = None
     ) -> RunTicket:
         """Admit a run; returns its ticket. Launches the request's
-        bucket inline when it reaches ``max_batch``."""
+        bucket inline when it reaches ``max_batch``. With
+        ``max_pending`` set, applies the overflow policy first."""
         if self._closed:
             raise RuntimeError("queue is closed")
         ex = executor or self.executor
         if ex is None:
             raise ValueError("no executor: pass one here or at init")
-        sig = ex.signature(request)
-        name = _bucket_id(sig)
-        launch = None
-        with self._lock:
-            bucket = self._buckets.get(sig)
-            if bucket is None:
-                bucket = self._buckets[sig] = _Bucket(ex)
-                self._bucket_names[name] = sig
-            if not bucket.items:
-                bucket.oldest = time.monotonic()
-            ticket = RunTicket(self, name)
-            bucket.items.append((request, ticket))
-            self.submitted += 1
-            self._emit(
-                "batch_admit", bucket=name, pending=len(bucket.items),
-                population_size=request.size,
-                genome_len=request.genome_len,
-            )
-            if len(bucket.items) >= self.serving.max_batch:
-                launch = self._take(sig)
-            self._ensure_flusher()
+        self._admit_slot()
+        try:
+            sig = ex.signature(request)
+            name = _bucket_id(sig)
+            launch = None
+            with self._lock:
+                if self._closed:
+                    raise RuntimeError("queue is closed")
+                bucket = self._buckets.get(sig)
+                if bucket is None:
+                    bucket = self._buckets[sig] = _Bucket(ex)
+                    self._bucket_names[name] = sig
+                if not bucket.items:
+                    bucket.oldest = time.monotonic()
+                ticket = RunTicket(self, name)
+                bucket.items.append((request, ticket))
+                self.submitted += 1
+                self._emit(
+                    "batch_admit", bucket=name, pending=len(bucket.items),
+                    population_size=request.size,
+                    genome_len=request.genome_len,
+                )
+                if len(bucket.items) >= self.serving.max_batch:
+                    launch = self._take(sig)
+                self._ensure_flusher()
+        except BaseException:
+            self._unadmit()
+            raise
         if launch is not None:
             self._launch(sig, *launch)
         return ticket
@@ -173,12 +254,58 @@ class RunQueue:
         self.launches += 1
         try:
             results = executor.run([req for req, _ in items])
-        except BaseException as e:  # propagate to every waiter
-            for _, ticket in items:
-                ticket._complete(None, error=e)
+        except BaseException as e:
+            self._isolate(name, executor, items, e)
             return
         for (_, ticket), result in zip(items, results):
             ticket._complete(result)
+
+    def _isolate(self, name: str, executor: BatchedRuns, items, error) -> None:
+        """A failed mega-run fails only the tickets that are actually
+        poisoned. Statically invalid requests (per
+        ``executor.validate``) dead-letter immediately with their
+        diagnosis; the survivors are requeued ONCE as solo launches — a
+        request that then fails alone is itself the poison and
+        dead-letters with its error, everything else completes. Bounded:
+        one extra pass, no recursion."""
+        survivors = []
+        for req, ticket in items:
+            diag = executor.validate(req)
+            if diag is not None:
+                self._dead_letter(name, req, ticket, diag)
+            else:
+                survivors.append((req, ticket))
+        if not survivors:
+            return
+        if len(items) == 1:
+            # The failed launch WAS a solo run of a statically valid
+            # request: the failure is its own (objective raise,
+            # poisoned params) — dead-letter rather than loop.
+            req, ticket = survivors[0]
+            self._dead_letter(name, req, ticket, error)
+            return
+        self.requeues += 1
+        self._emit(
+            "retry", attempt=1, bucket=name, batch_size=len(survivors),
+            error=str(error), where="serving_launch",
+        )
+        for req, ticket in survivors:
+            try:
+                (result,) = executor.run([req])
+            except BaseException as e:
+                self._dead_letter(name, req, ticket, e)
+            else:
+                ticket._complete(result)
+
+    def _dead_letter(self, name: str, req, ticket, error) -> None:
+        self.dead_letters.append(
+            DeadLetter(request=req, bucket=name, error=error)
+        )
+        self._emit(
+            "dead_letter", bucket=name, error=str(error),
+            population_size=req.size, genome_len=req.genome_len,
+        )
+        ticket._complete(None, error=error)
 
     def flush(self, bucket: Optional[str] = None) -> int:
         """Launch pending buckets now (all of them, or just the named
@@ -213,6 +340,10 @@ class RunQueue:
             # (pure size-triggered batching, fully deterministic: no
             # background thread races the test's own flushes).
             return
+        # A dead flusher (crashed iteration — e.g. an injected
+        # serving.flusher fault) is replaced here on the next submit:
+        # thread death degrades the max_wait_ms latency bound until the
+        # next admission, never the queue's correctness.
         self._flusher = threading.Thread(
             target=self._flush_loop, name="pga-serving-flusher", daemon=True
         )
@@ -221,7 +352,15 @@ class RunQueue:
     def _flush_loop(self) -> None:
         interval = min(max(self.serving.max_wait_ms / 4000.0, 0.001), 0.05)
         while not self._closed:
-            time.sleep(interval)
+            self._wake.wait(interval)  # close() sets _wake to end the nap
+            if self._closed:
+                return
+            # Fault-injection site (robustness/faults): a raise here
+            # kills THIS thread — the failure mode of any unexpected
+            # flusher crash — and _ensure_flusher resurrects it on the
+            # next submit.
+            if _faults.PLAN is not None:
+                _faults.PLAN.fire("serving.flusher")
             deadline = time.monotonic() - self.serving.max_wait_ms / 1000.0
             with self._lock:
                 expired = [
@@ -233,10 +372,24 @@ class RunQueue:
                 if launch is not None:
                     self._launch(sig, *launch)
 
-    def close(self) -> None:
-        """Flush pending work and stop the background flusher."""
-        self._closed = True
+    def close(self, timeout: float = 5.0) -> None:
+        """Flush pending work and stop the background flusher.
+
+        Deterministic teardown: the flusher thread is woken and JOINED
+        (up to ``timeout`` seconds) BEFORE the final flush, so no
+        ``_flush_loop`` iteration can race a post-close launch, and a
+        ``submit`` after ``close()`` returns always raises. Blocked
+        ``submit`` callers (overflow="block") are released with the
+        closed error."""
+        with self._lock:
+            self._closed = True
+            flusher, self._flusher = self._flusher, None
+        self._wake.set()
+        if flusher is not None and flusher.is_alive():
+            flusher.join(timeout)
         self.flush()
+        with self._pending_cv:
+            self._pending_cv.notify_all()
 
     def __enter__(self) -> "RunQueue":
         return self

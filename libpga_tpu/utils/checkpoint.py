@@ -19,11 +19,14 @@ from __future__ import annotations
 import glob
 import os
 import re
-from typing import TYPE_CHECKING, Dict, List, Tuple
+import zlib
+from typing import TYPE_CHECKING, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from libpga_tpu.robustness import faults as _faults
 
 if TYPE_CHECKING:
     from libpga_tpu.engine import PGA
@@ -31,6 +34,62 @@ if TYPE_CHECKING:
 FORMAT_VERSION = 2  # single-file format
 SHARD_FORMAT_VERSION = 3  # per-process shard format
 _PROC_RE = re.compile(r"\.proc(\d+)\.npz$")  # shard-file suffix, save+restore
+
+
+class CheckpointError(ValueError):
+    """A checkpoint could not be restored (or written): version
+    mismatch, missing/extra shard files, a truncated or corrupted file,
+    or a per-array CRC mismatch. Carries the offending ``path`` so an
+    operator knows WHICH file to repair — a ``ValueError`` subclass, so
+    callers matching the historical error surface keep working."""
+
+    def __init__(self, message: str, path: Optional[str] = None):
+        self.path = path
+        super().__init__(
+            message if path is None else f"{message} [checkpoint: {path}]"
+        )
+
+
+def _crc32(arr: np.ndarray) -> np.uint32:
+    """Per-array integrity word stored alongside each data array: CRC32
+    of the raw little-endian bytes. Cheap relative to the npz deflate,
+    and catches the silent-corruption class (bit flips, short writes
+    inside an otherwise readable zip) that the container CRC alone
+    cannot attribute to an array."""
+    return np.uint32(zlib.crc32(np.ascontiguousarray(arr).tobytes()))
+
+
+def _verify_crc(data, key: str, path: Optional[str]) -> np.ndarray:
+    """Return ``data[key]``, verifying its recorded CRC when present
+    (checkpoints written before the integrity manifest lack the crc
+    keys and restore unverified, as before)."""
+    try:
+        arr = data[key]
+    except KeyError:
+        raise CheckpointError(f"checkpoint is missing array {key!r}", path)
+    crc_key = f"{key}_crc32"
+    if crc_key in data:
+        stored = int(data[crc_key])
+        actual = int(_crc32(arr))
+        if stored != actual:
+            raise CheckpointError(
+                f"checkpoint array {key!r} is corrupted: stored crc32 "
+                f"{stored:#010x} != computed {actual:#010x}",
+                path,
+            )
+    return arr
+
+
+def _np_load(path: str):
+    """np.load that maps container-level corruption (truncated file,
+    bad zip, unreadable header) to :class:`CheckpointError` naming the
+    file, instead of a raw zipfile/OS error mid-restore."""
+    try:
+        return np.load(path)
+    except Exception as e:
+        raise CheckpointError(
+            f"checkpoint file is unreadable ({type(e).__name__}: {e})", path
+        )
 
 
 def _encode(arr: np.ndarray):
@@ -87,6 +146,12 @@ def _atomic_savez(path: str, arrays: Dict[str, np.ndarray]) -> str:
     tmp = f"{final}.{os.getpid()}.tmp.npz"  # .npz suffix: stop savez renaming
     try:
         np.savez(tmp, **arrays)
+        # Fault-injection site (robustness/faults): firing BETWEEN the
+        # temp write and the atomic rename is the kill-mid-checkpoint
+        # point — the previous good checkpoint must survive (the finally
+        # sweeps the temp), which tools/chaos_smoke.py proves.
+        if _faults.PLAN is not None:
+            _faults.PLAN.fire("checkpoint.save")
         os.replace(tmp, final)
     finally:
         if os.path.exists(tmp):
@@ -101,29 +166,37 @@ def _pack_array(arrays: Dict[str, np.ndarray], name: str, arr) -> None:
     for j, (starts, data) in enumerate(_addressable_shards(arr)):
         enc, dtype_name = _encode(data)
         arrays[f"{name}_shard{j}"] = enc
+        arrays[f"{name}_shard{j}_crc32"] = _crc32(enc)
         arrays[f"{name}_shard{j}_dtype"] = np.asarray(dtype_name)
         arrays[f"{name}_shard{j}_start"] = np.asarray(starts, dtype=np.int64)
 
 
-def _merge_array(files: List, name: str):
-    """Reassemble a full host array for ``name`` from all process files."""
+def _merge_array(files: List, name: str, paths: Optional[List[str]] = None):
+    """Reassemble a full host array for ``name`` from all process files.
+    ``paths`` (aligned with ``files``) names the offending file in
+    integrity errors; each shard's recorded CRC is verified on read."""
     shape = dtype = None
     pieces = []
-    for data in files:
+    for idx_f, data in enumerate(files):
+        path = paths[idx_f] if paths else None
         if f"{name}_shape" not in data:
             continue
         shape = tuple(int(x) for x in data[f"{name}_shape"])
         j = 0
         while f"{name}_shard{j}" in data:
             piece = _decode(
-                data[f"{name}_shard{j}"], str(data[f"{name}_shard{j}_dtype"])
+                _verify_crc(data, f"{name}_shard{j}", path),
+                str(data[f"{name}_shard{j}_dtype"]),
             )
             starts = tuple(int(x) for x in data[f"{name}_shard{j}_start"])
             pieces.append((starts, piece))
             dtype = piece.dtype
             j += 1
     if shape is None:
-        raise ValueError(f"checkpoint is missing array {name!r}")
+        raise CheckpointError(
+            f"checkpoint is missing array {name!r}",
+            paths[0] if paths else None,
+        )
     full = np.zeros(shape, dtype=dtype)
     covered = np.zeros(shape, dtype=bool) if pieces else None
     for starts, piece in pieces:
@@ -133,9 +206,10 @@ def _merge_array(files: List, name: str):
         full[idx] = piece
         covered[idx] = True
     if covered is None or not covered.all():
-        raise ValueError(
+        raise CheckpointError(
             f"checkpoint shards for {name!r} do not cover the full array "
-            "(missing a process file?)"
+            "(missing a process file?)",
+            paths[0] if paths else None,
         )
     return full
 
@@ -195,9 +269,12 @@ def _save(pga: "PGA", path: str) -> None:
     }
     for i, pop in enumerate(pga.populations):
         genomes, dtype_name = _encode(np.asarray(pop.genomes))
+        scores = np.asarray(pop.scores)
         arrays[f"genomes_{i}"] = genomes
+        arrays[f"genomes_{i}_crc32"] = _crc32(genomes)
         arrays[f"genomes_dtype_{i}"] = np.asarray(dtype_name)
-        arrays[f"scores_{i}"] = np.asarray(pop.scores)
+        arrays[f"scores_{i}"] = scores
+        arrays[f"scores_{i}_crc32"] = _crc32(scores)
     _atomic_savez(path, arrays)
     # Only now is it safe to drop a previous run's shard set (see shadow
     # note above): restore() prefers the single file, and deleting the
@@ -257,6 +334,11 @@ def restore(pga: "PGA", path: str) -> None:
     """
     from libpga_tpu.population import Population
 
+    # Fault-injection site (robustness/faults): a raise here is a
+    # restore-time I/O failure on the real path.
+    if _faults.PLAN is not None:
+        _faults.PLAN.fire("checkpoint.restore")
+
     if os.path.exists(path):
         _restore_single(pga, path)
         return
@@ -268,34 +350,43 @@ def restore(pga: "PGA", path: str) -> None:
             by_idx[int(m.group(1))] = f
     if 0 not in by_idx:
         raise FileNotFoundError(f"no checkpoint at {path} (or {path}.proc*.npz)")
-    with np.load(by_idx[0]) as head:
+    with _np_load(by_idx[0]) as head:
         version = int(head["__version__"])
         if version != SHARD_FORMAT_VERSION:
-            raise ValueError(f"unsupported shard-checkpoint version {version}")
+            raise CheckpointError(
+                f"unsupported shard-checkpoint version {version}", by_idx[0]
+            )
         expect = int(head["__num_processes__"])
     # Read exactly the file set the checkpoint declares: stale .proc<k>
     # leftovers with k >= expect (older, wider run) are ignored rather
     # than failing the count/seq consistency checks.
     missing = [k for k in range(expect) if k not in by_idx]
     if missing:
-        raise ValueError(
+        raise CheckpointError(
             f"checkpoint written by {expect} processes is missing process "
-            f"files {missing}"
+            f"files {missing}",
+            f"{path}.proc{missing[0]}.npz",
         )
-    datas = [np.load(by_idx[k]) for k in range(expect)]
+    proc_paths = [by_idx[k] for k in range(expect)]
+    datas = [_np_load(p) for p in proc_paths]
     try:
         n = int(datas[0]["__num_populations__"])
         seqs = {int(d["__save_seq__"]) for d in datas}
         if len(seqs) != 1:
-            raise ValueError(
+            raise CheckpointError(
                 f"inconsistent checkpoint: process files carry save "
-                f"sequences {sorted(seqs)} (torn by preemption mid-save?)"
+                f"sequences {sorted(seqs)} (torn by preemption mid-save?)",
+                path,
             )
         pga._key = jax.random.wrap_key_data(jnp.asarray(datas[0]["__key__"]))
         pga._populations = [
             Population(
-                genomes=jnp.asarray(_merge_array(datas, f"genomes_{i}")),
-                scores=jnp.asarray(_merge_array(datas, f"scores_{i}")),
+                genomes=jnp.asarray(
+                    _merge_array(datas, f"genomes_{i}", proc_paths)
+                ),
+                scores=jnp.asarray(
+                    _merge_array(datas, f"scores_{i}", proc_paths)
+                ),
             )
             for i in range(n)
         ]
@@ -309,15 +400,17 @@ def restore(pga: "PGA", path: str) -> None:
 def _restore_single(pga: "PGA", path: str) -> None:
     from libpga_tpu.population import Population
 
-    with np.load(path) as data:
+    with _np_load(path) as data:
         version = int(data["__version__"])
         if version not in (1, FORMAT_VERSION):
-            raise ValueError(f"unsupported checkpoint version {version}")
+            raise CheckpointError(
+                f"unsupported checkpoint version {version}", path
+            )
         n = int(data["__num_populations__"])
         pga._key = jax.random.wrap_key_data(jnp.asarray(data["__key__"]))
 
         def genomes(i):
-            g = data[f"genomes_{i}"]
+            g = _verify_crc(data, f"genomes_{i}", path)
             if version >= 2:
                 g = _decode(g, str(data[f"genomes_dtype_{i}"]))
             return jnp.asarray(g)
@@ -325,7 +418,7 @@ def _restore_single(pga: "PGA", path: str) -> None:
         pga._populations = [
             Population(
                 genomes=genomes(i),
-                scores=jnp.asarray(data[f"scores_{i}"]),
+                scores=jnp.asarray(_verify_crc(data, f"scores_{i}", path)),
             )
             for i in range(n)
         ]

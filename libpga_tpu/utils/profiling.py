@@ -28,6 +28,14 @@ def enable_compilation_cache(path: str = "~/.cache/libpga_tpu_xla") -> None:
     TPU; with this cache enabled a restarted job (or a benchmark rerun)
     loads them in milliseconds instead. Safe to call repeatedly; call it
     before the first compilation to benefit that compilation.
+
+    TPU sessions only. Do NOT enable on the CPU backend of this jaxlib
+    (0.4.37): executing a cache-DESERIALIZED executable with donated
+    buffers corrupts the runtime heap — donation-heavy
+    checkpoint/restore loops (the robustness supervisor's workload)
+    segfault or silently corrupt results (found by
+    ``tools/chaos_smoke.py``; see the gate in ``tools/ci.sh``). CPU
+    compiles are cheap enough that the cache buys nothing there anyway.
     """
     path = os.path.expanduser(path)
     os.makedirs(path, exist_ok=True)

@@ -83,6 +83,12 @@ EVENT_FIELDS: Dict[str, tuple] = {
     "checkpoint_save": ("path",),
     "validation_failure": ("where", "error"),
     "stall_alert": ("stalled_gens",),
+    # Robustness layer (ISSUE 5): injected faults, graceful kernel
+    # degradation, supervised/serving retries, poisoned-request routing.
+    "fault_injected": ("site", "kind"),
+    "degraded": ("what", "error"),
+    "retry": ("attempt", "error"),
+    "dead_letter": ("bucket", "error"),
 }
 
 

@@ -1,10 +1,20 @@
-"""Checkpoint/resume tests — a capability the reference lacks entirely."""
+"""Checkpoint/resume tests — a capability the reference lacks entirely.
+
+Integrity (ISSUE 5 satellite): every data array carries a CRC32 in the
+npz manifest, verified on restore; version mismatches, missing shards,
+truncated and bit-flipped files raise a named :class:`CheckpointError`
+carrying the offending path instead of a KeyError/zipfile error
+mid-merge."""
+
+import os
 
 import numpy as np
+import pytest
 
 from libpga_tpu import PGA
 from libpga_tpu.engine import PopulationHandle
 from libpga_tpu.utils import checkpoint
+from libpga_tpu.utils.checkpoint import CheckpointError
 
 
 def test_save_restore_roundtrip(tmp_path):
@@ -198,6 +208,137 @@ def test_multiprocess_save_leaves_wider_shards_intact(tmp_path, monkeypatch):
     assert names == [
         "ckpt.npz.proc0.npz", "ckpt.npz.proc2.npz", "ckpt.npz.proc3.npz"
     ]
+
+
+def _saved_solver(tmp_path, name="c.npz"):
+    path = str(tmp_path / name)
+    pga = PGA(seed=0)
+    pga.create_population(64, 8)
+    pga.set_objective("onemax")
+    pga.run(3)
+    checkpoint.save(pga, path)
+    return pga, path
+
+
+def test_bit_flipped_array_raises_checkpoint_error(tmp_path):
+    """A flipped bit inside an otherwise readable npz must fail the
+    per-array CRC32 check with the file named — not restore silently
+    corrupted genomes."""
+    _, path = _saved_solver(tmp_path)
+    data = dict(np.load(path))
+    flipped = data["genomes_0"].copy()
+    flipped.view(np.uint8)[7] ^= 0x10
+    data["genomes_0"] = flipped  # keep the stored crc32: now stale
+    np.savez(path, **data)
+    with pytest.raises(CheckpointError, match="genomes_0.*corrupted") as ei:
+        checkpoint.restore(PGA(seed=1), path)
+    assert ei.value.path == path
+
+
+def test_truncated_file_raises_checkpoint_error(tmp_path):
+    _, path = _saved_solver(tmp_path)
+    with open(path, "r+b") as fh:
+        fh.truncate(os.path.getsize(path) // 2)
+    with pytest.raises(CheckpointError, match="unreadable") as ei:
+        checkpoint.restore(PGA(seed=1), path)
+    assert ei.value.path == path
+
+
+def test_truncated_shard_file_raises_checkpoint_error(tmp_path):
+    """The shard format: one truncated .proc<k> file names ITSELF, so a
+    pod operator knows which host's shard to recover."""
+    import jax
+
+    path = str(tmp_path / "ckpt.npz")
+    genomes = np.arange(8 * 4, dtype=np.float32).reshape(8, 4)
+    scores = np.arange(8, dtype=np.float32)
+    keydata = np.asarray(jax.random.key_data(jax.random.key(5)))
+    _write_shard_file(path, 0, 2, slice(0, 4), genomes, scores, keydata)
+    _write_shard_file(path, 1, 2, slice(4, 8), genomes, scores, keydata)
+    shard1 = f"{path}.proc1.npz"
+    with open(shard1, "r+b") as fh:
+        fh.truncate(os.path.getsize(shard1) // 3)
+    with pytest.raises(CheckpointError, match="unreadable") as ei:
+        checkpoint.restore(PGA(seed=1), path)
+    assert ei.value.path == shard1
+
+
+def test_bit_flipped_shard_raises_checkpoint_error(tmp_path):
+    # a 1-process shard set with a corrupted shard payload under a
+    # stale (correct-for-the-original) crc
+    import jax
+
+    spath = str(tmp_path / "shards.npz")
+    genomes = np.arange(8 * 4, dtype=np.float32).reshape(8, 4)
+    scores = np.arange(8, dtype=np.float32)
+    keydata = np.asarray(jax.random.key_data(jax.random.key(5)))
+    _write_shard_file(spath, 0, 1, slice(0, 8), genomes, scores, keydata)
+    f0 = f"{spath}.proc0.npz"
+    data = dict(np.load(f0))
+    good = data["genomes_0_shard0"].copy()
+    data["genomes_0_shard0_crc32"] = np.uint32(
+        __import__("zlib").crc32(np.ascontiguousarray(good).tobytes())
+    )
+    bad = good.copy()
+    bad.view(np.uint8)[3] ^= 0x01
+    data["genomes_0_shard0"] = bad
+    np.savez(f0, **data)
+    with pytest.raises(CheckpointError, match="corrupted") as ei:
+        checkpoint.restore(PGA(seed=1), spath)
+    assert ei.value.path == f0
+
+
+def test_version_mismatch_raises_checkpoint_error(tmp_path):
+    _, path = _saved_solver(tmp_path)
+    data = dict(np.load(path))
+    data["__version__"] = np.asarray(999)
+    np.savez(path, **data)
+    with pytest.raises(CheckpointError, match="version 999") as ei:
+        checkpoint.restore(PGA(seed=1), path)
+    assert ei.value.path == path
+
+
+def test_missing_array_raises_checkpoint_error_not_keyerror(tmp_path):
+    """The historical failure shape was a bare KeyError mid-merge; a
+    checkpoint declaring 2 populations but carrying 1 must raise the
+    named error with the path instead."""
+    _, path = _saved_solver(tmp_path)
+    data = dict(np.load(path))
+    data["__num_populations__"] = np.asarray(2)  # lies: only pop 0 exists
+    np.savez(path, **data)
+    with pytest.raises(CheckpointError, match="genomes_1") as ei:
+        checkpoint.restore(PGA(seed=1), path)
+    assert ei.value.path == path
+
+
+def test_checkpoint_error_is_a_valueerror(tmp_path):
+    """Compatibility: callers matching the historical ValueError surface
+    keep working."""
+    assert issubclass(CheckpointError, ValueError)
+
+
+def test_crc_recorded_for_every_data_array(tmp_path):
+    _, path = _saved_solver(tmp_path)
+    with np.load(path) as data:
+        keys = set(data.files)
+    assert "genomes_0_crc32" in keys and "scores_0_crc32" in keys
+
+
+def test_pre_crc_checkpoints_still_restore(tmp_path):
+    """Forward compatibility: a checkpoint written before the integrity
+    manifest (no crc keys) restores unverified, as before."""
+    pga, path = _saved_solver(tmp_path)
+    data = {
+        k: v for k, v in dict(np.load(path)).items()
+        if not k.endswith("_crc32")
+    }
+    np.savez(path, **data)
+    fresh = PGA(seed=1)
+    checkpoint.restore(fresh, path)
+    np.testing.assert_array_equal(
+        np.asarray(fresh.population(PopulationHandle(0)).genomes),
+        np.asarray(pga.population(PopulationHandle(0)).genomes),
+    )
 
 
 def test_resume_continues_deterministically(tmp_path):

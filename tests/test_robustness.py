@@ -1,0 +1,529 @@
+"""Fault-tolerant execution layer (robustness/): ISSUE 5 acceptance.
+
+The contracts under test:
+
+- **deterministic injection**: a :class:`FaultPlan` fires on exactly the
+  configured call (``at_call_n``), probability plans replay identically
+  under the same seed, ``times`` bounds fires, and the registry records
+  every injection (+ emits schema-valid ``fault_injected`` events);
+- **disabled-path purity**: with no plan installed and under every
+  ``fallback`` setting the engine's compiled run loop lowers to
+  byte-identical StableHLO (the host-side robustness machinery can
+  never perturb a traced program) — the same structural pattern as the
+  telemetry zero-cost-off gate;
+- **graceful degradation**: a kernel-build failure under
+  ``fallback="xla"`` degrades the config to the XLA path (bit-identical
+  to a plain XLA run, one warning, a ``degraded`` event);
+  ``fallback="raise"`` propagates;
+- **supervision**: retry-with-rollback replays the engine key chain
+  (a supervised run that failed and retried — or died and resumed — is
+  bit-identical to an uninterrupted same-seed run with the same
+  cadence), backoff grows exponentially with deterministic jitter,
+  NaN storms roll back, the stall watchdog aborts, and the C-ABI
+  bridge surface (``set_fault_plan``/``supervised_run``) round-trips.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from libpga_tpu import PGA, PGAConfig, TelemetryConfig
+from libpga_tpu.robustness import faults
+from libpga_tpu.robustness.faults import FaultPlan, InjectedFault
+from libpga_tpu.robustness.supervisor import (
+    NaNStorm,
+    RetryPolicy,
+    SupervisedReport,
+    read_meta,
+    supervised_run,
+)
+
+POP, LEN = 64, 8
+
+
+def _engine(seed=5, tel=None, **cfg):
+    pga = PGA(seed=seed, config=PGAConfig(use_pallas=False, telemetry=tel,
+                                          **cfg))
+    pga.create_population(POP, LEN)
+    pga.set_objective("onemax")
+    return pga
+
+
+def _genomes(pga):
+    # explicit host copy: comparisons must never read a zero-copy view
+    # of a device buffer a later donated dispatch could reuse
+    return np.array(pga._populations[0].genomes, copy=True)
+
+
+NOSLEEP = staticmethod(lambda s: None)
+
+
+# ------------------------------------------------------------ fault registry
+
+
+def test_plan_validation():
+    with pytest.raises(ValueError, match="site"):
+        FaultPlan("")
+    with pytest.raises(ValueError, match="kind"):
+        FaultPlan("objective.eval", kind="explode", at_call_n=1)
+    with pytest.raises(ValueError, match="trigger"):
+        FaultPlan("objective.eval")
+    with pytest.raises(ValueError, match="1-based"):
+        FaultPlan("objective.eval", at_call_n=0)
+    with pytest.raises(ValueError, match="probability"):
+        FaultPlan("objective.eval", probability=1.5)
+    with pytest.raises(ValueError, match="times"):
+        FaultPlan("objective.eval", at_call_n=1, times=0)
+
+
+def test_at_call_n_fires_exactly_once():
+    reg = faults.FaultRegistry((FaultPlan("s", at_call_n=3),))
+    assert reg.fire("s") is False
+    assert reg.fire("other") is False  # other sites don't advance "s"
+    assert reg.fire("s") is False
+    with pytest.raises(InjectedFault) as ei:
+        reg.fire("s")
+    assert ei.value.site == "s" and ei.value.call == 3
+    assert reg.fire("s") is False  # times=1 default: exhausted
+    assert reg.calls == {"s": 4, "other": 1}
+    assert reg.injected == [{"site": "s", "kind": "raise", "call": 3}]
+
+
+def test_probability_plans_replay_deterministically():
+    def pattern(seed):
+        reg = faults.FaultRegistry(
+            (FaultPlan("s", probability=0.4, times=None),), seed=seed
+        )
+        fired = []
+        for i in range(50):
+            try:
+                reg.fire("s")
+                fired.append(False)
+            except InjectedFault:
+                fired.append(True)
+        return fired
+
+    assert pattern(7) == pattern(7)
+    assert any(pattern(7)) and not all(pattern(7))
+    assert pattern(7) != pattern(8)
+
+
+def test_times_bounds_unlimited_and_nan_kind():
+    reg = faults.FaultRegistry(
+        (FaultPlan("s", kind="nan", probability=1.0, times=2),)
+    )
+    assert reg.fire("s") is True
+    assert reg.fire("s") is True
+    assert reg.fire("s") is False  # exhausted
+    reg2 = faults.FaultRegistry(
+        (FaultPlan("s", kind="nan", probability=1.0, times=None),)
+    )
+    assert all(reg2.fire("s") for _ in range(10))
+
+
+def test_active_context_restores_previous_plan():
+    assert faults.PLAN is None
+    outer = faults.install(FaultPlan("a", at_call_n=1))
+    try:
+        with faults.active(FaultPlan("b", at_call_n=1)) as inner:
+            assert faults.PLAN is inner
+        assert faults.PLAN is outer
+    finally:
+        faults.clear()
+    assert faults.PLAN is None
+
+
+def test_fault_injected_events_validate(tmp_path):
+    from libpga_tpu.utils import telemetry
+
+    path = str(tmp_path / "faults.jsonl")
+    with telemetry.EventLog(path) as log:
+        with faults.active(
+            FaultPlan("objective.eval", at_call_n=1), events=log
+        ):
+            pga = _engine()
+            with pytest.raises(InjectedFault):
+                pga.run(3)
+    records = telemetry.validate_log(path)
+    kinds = [r["event"] for r in records]
+    assert "fault_injected" in kinds
+    rec = next(r for r in records if r["event"] == "fault_injected")
+    assert rec["site"] == "objective.eval" and rec["kind"] == "raise"
+
+
+# ------------------------------------------------------ disabled-path purity
+
+
+def test_disabled_path_lowering_is_byte_identical():
+    """No fault plan + any fallback setting: the compiled run loop's
+    StableHLO is byte-identical across configurations (and to the
+    telemetry purity gate's replica, transitively) — the robustness
+    layer is host-side only."""
+    import jax
+
+    texts = []
+    for fallback in ("xla", "raise"):
+        pga = _engine(fallback=fallback)
+        pop = pga._populations[0]
+        args = (
+            pop.genomes, jax.random.key(0), jnp.int32(3),
+            jnp.float32(jnp.inf), pga._mutate_params(),
+        )
+        texts.append(
+            pga._compiled_run(pop.size, pop.genome_len)
+            .lower(*args).as_text()
+        )
+    assert texts[0] == texts[1]
+
+
+def test_run_results_unchanged_with_inert_plan_installed():
+    """An installed plan that never fires must not perturb results —
+    the registry is consulted, nothing else changes."""
+    a = _engine()
+    a.run(4)
+    b = _engine()
+    with faults.active(FaultPlan("objective.eval", at_call_n=999)):
+        b.run(4)
+    np.testing.assert_array_equal(_genomes(a), _genomes(b))
+
+
+# --------------------------------------------------------------- degradation
+
+
+def _tpu_faked_engine(seed=5, **cfg):
+    pga = PGA(seed=seed, config=PGAConfig(use_pallas=True, **cfg))
+    pga._pallas_backend_ok = lambda: True  # reach the kernel build on CPU
+    pga.create_population(POP, LEN)
+    pga.set_objective("onemax")
+    return pga
+
+
+def test_kernel_build_fault_degrades_to_xla_bit_identically(tmp_path):
+    from libpga_tpu.utils import telemetry
+
+    ref = _engine()
+    ref.run(4)
+    path = str(tmp_path / "degraded.jsonl")
+    pga = _tpu_faked_engine(
+        telemetry=TelemetryConfig(history_gens=0, events_path=path)
+    )
+    with faults.active(FaultPlan("kernel.build", probability=1.0,
+                                 times=None)):
+        with pytest.warns(UserWarning, match="degrading this config"):
+            pga.run(4)
+    np.testing.assert_array_equal(_genomes(pga), _genomes(ref))
+    records = telemetry.validate_log(path)
+    degraded = [r for r in records if r["event"] == "degraded"]
+    assert len(degraded) == 1
+    assert "kernel build" in degraded[0]["what"]
+    # the degraded config is cached: a second run neither warns nor
+    # re-emits (one XLA-path run, no new degradation)
+    import warnings as _w
+
+    with faults.active(FaultPlan("kernel.build", probability=1.0,
+                                 times=None)):
+        with _w.catch_warnings():
+            _w.simplefilter("error")
+            pga.run(2)
+    assert len(
+        [r for r in telemetry.validate_log(path) if r["event"] == "degraded"]
+    ) == 1
+
+
+def test_kernel_build_fault_raises_under_raise_policy():
+    pga = _tpu_faked_engine(fallback="raise")
+    with faults.active(FaultPlan("kernel.build", probability=1.0,
+                                 times=None)):
+        with pytest.raises(InjectedFault):
+            pga.run(2)
+
+
+def test_fallback_config_validation():
+    with pytest.raises(ValueError, match="fallback"):
+        PGAConfig(fallback="sideways")
+
+
+# ---------------------------------------------------------------- supervisor
+
+
+def test_supervised_plain_run_matches_bare_run():
+    bare = _engine()
+    bare.run(6)
+    sup = _engine()
+    report = supervised_run(sup, 6, sleep=lambda s: None)
+    assert isinstance(report, SupervisedReport)
+    assert report.generations == 6 and report.retries == 0
+    np.testing.assert_array_equal(_genomes(bare), _genomes(sup))
+
+
+def test_supervised_retry_is_bit_identical_and_backoff_grows():
+    ref = _engine()
+    ref_report = supervised_run(
+        ref, 8, checkpoint_every=2, sleep=lambda s: None
+    )
+    sleeps = []
+    pga = _engine()
+    with faults.active(
+        FaultPlan("objective.eval", at_call_n=2, times=3),
+        FaultPlan("objective.eval", at_call_n=3, times=3),
+    ):
+        report = supervised_run(
+            pga, 8, checkpoint_every=2,
+            retry=RetryPolicy(max_retries=3, backoff_base_s=0.1,
+                              backoff_factor=2.0, jitter=0.5,
+                              jitter_seed=0),
+            sleep=sleeps.append,
+        )
+    assert report.retries == 2
+    assert len(report.errors) == 2
+    np.testing.assert_array_equal(_genomes(ref), _genomes(pga))
+    assert report.best_score == ref_report.best_score
+    # exponential growth under bounded jitter: attempt k sleeps in
+    # [base*2^(k-1)*(1-jitter), base*2^(k-1)]
+    assert 0.05 <= sleeps[0] <= 0.1
+    assert 0.1 <= sleeps[1] <= 0.2
+    # deterministic jitter: same policy seed → same sleeps
+    sleeps2 = []
+    pga2 = _engine()
+    with faults.active(
+        FaultPlan("objective.eval", at_call_n=2, times=3),
+        FaultPlan("objective.eval", at_call_n=3, times=3),
+    ):
+        supervised_run(
+            pga2, 8, checkpoint_every=2,
+            retry=RetryPolicy(max_retries=3, backoff_base_s=0.1,
+                              jitter_seed=0),
+            sleep=sleeps2.append,
+        )
+    assert sleeps == sleeps2
+
+
+def test_supervised_exhausted_retries_reraise():
+    pga = _engine()
+    with faults.active(
+        FaultPlan("objective.eval", probability=1.0, times=None)
+    ):
+        with pytest.raises(InjectedFault):
+            supervised_run(
+                pga, 4, retry=RetryPolicy(max_retries=2),
+                sleep=lambda s: None,
+            )
+
+
+def test_supervised_nan_storm_rolls_back_and_deterministic_nan_raises():
+    ref = _engine()
+    supervised_run(ref, 6, checkpoint_every=2, sleep=lambda s: None)
+    pga = _engine()
+    with faults.active(FaultPlan("objective.eval", kind="nan", at_call_n=2)):
+        report = supervised_run(
+            pga, 6, checkpoint_every=2, retry=RetryPolicy(max_retries=2),
+            sleep=lambda s: None,
+        )
+    assert report.retries == 1
+    assert any("NaNStorm" in e for e in report.errors)
+    np.testing.assert_array_equal(_genomes(ref), _genomes(pga))
+    # a DETERMINISTIC NaN source exhausts retries and raises NaNStorm
+    # instead of silently burning budget on a poisoned population
+    pga2 = _engine()
+    with faults.active(
+        FaultPlan("objective.eval", kind="nan", probability=1.0, times=None)
+    ):
+        with pytest.raises(NaNStorm):
+            supervised_run(
+                pga2, 4, retry=RetryPolicy(max_retries=1),
+                sleep=lambda s: None,
+            )
+
+
+def test_supervised_auto_checkpoint_cadence_and_meta(tmp_path):
+    path = str(tmp_path / "auto.npz")
+    pga = _engine()
+    report = supervised_run(
+        pga, 9, checkpoint_path=path, checkpoint_every=3,
+        sleep=lambda s: None,
+    )
+    # 3 cadence saves + the final save
+    assert report.checkpoints == 4
+    assert os.path.exists(path)
+    meta = read_meta(path)
+    assert meta["generations"] == 9 and meta["n"] == 9
+
+
+def test_supervised_death_and_resume_bit_identical(tmp_path):
+    ref = _engine()
+    ref_report = supervised_run(
+        ref, 8, checkpoint_path=str(tmp_path / "ref.npz"),
+        checkpoint_every=2, sleep=lambda s: None,
+    )
+    path = str(tmp_path / "died.npz")
+    dying = _engine()
+    with faults.active(FaultPlan("objective.eval", at_call_n=3)):
+        with pytest.raises(InjectedFault):
+            supervised_run(
+                dying, 8, checkpoint_path=path, checkpoint_every=2,
+                retry=RetryPolicy(max_retries=0), sleep=lambda s: None,
+            )
+    assert read_meta(path)["generations"] == 4  # two chunks survived
+    # fresh process: seed is irrelevant, state comes from the checkpoint
+    resumed = PGA(seed=424242, config=PGAConfig(use_pallas=False))
+    resumed.set_objective("onemax")
+    report = supervised_run(
+        resumed, 8, checkpoint_path=path, checkpoint_every=2, resume=True,
+        sleep=lambda s: None,
+    )
+    assert report.restored and report.generations == 8
+    np.testing.assert_array_equal(_genomes(ref), _genomes(resumed))
+    assert report.best_score == ref_report.best_score
+
+
+def test_supervised_resume_of_completed_run_is_noop(tmp_path):
+    path = str(tmp_path / "done.npz")
+    pga = _engine()
+    supervised_run(pga, 4, checkpoint_path=path, checkpoint_every=2,
+                   sleep=lambda s: None)
+    before = _genomes(pga)
+    again = PGA(seed=1, config=PGAConfig(use_pallas=False))
+    again.set_objective("onemax")
+    report = supervised_run(
+        again, 4, checkpoint_path=path, checkpoint_every=2, resume=True,
+        sleep=lambda s: None,
+    )
+    assert report.generations == 4
+    np.testing.assert_array_equal(before, _genomes(again))
+
+
+def test_supervised_checkpoint_save_fault_retries_chunk(tmp_path):
+    ref = _engine()
+    supervised_run(
+        ref, 6, checkpoint_path=str(tmp_path / "r.npz"),
+        checkpoint_every=2, sleep=lambda s: None,
+    )
+    pga = _engine()
+    with faults.active(FaultPlan("checkpoint.save", at_call_n=2)):
+        report = supervised_run(
+            pga, 6, checkpoint_path=str(tmp_path / "f.npz"),
+            checkpoint_every=2, retry=RetryPolicy(max_retries=2),
+            sleep=lambda s: None,
+        )
+    assert report.retries == 1
+    np.testing.assert_array_equal(_genomes(ref), _genomes(pga))
+
+
+def test_supervised_stall_watchdog_aborts():
+    # A constant objective can never improve: the stall counter grows
+    # every generation and the watchdog must abort instead of burning
+    # the remaining budget.
+    pga = PGA(
+        seed=5,
+        config=PGAConfig(
+            use_pallas=False, telemetry=TelemetryConfig(history_gens=64)
+        ),
+    )
+    pga.create_population(POP, LEN)
+    pga.set_objective(lambda g: jnp.float32(0.0) * jnp.sum(g))
+    report = supervised_run(
+        pga, 64, checkpoint_every=8, stall_abort_gens=8,
+        sleep=lambda s: None,
+    )
+    assert report.aborted_on_stall
+    assert report.generations <= 16  # aborted after the first chunk check
+
+
+def test_supervised_target_early_stop():
+    pga = _engine()
+    report = supervised_run(
+        pga, 200, target=float(LEN) * 0.6, checkpoint_every=10,
+        sleep=lambda s: None,
+    )
+    assert report.target_reached
+    assert report.generations < 200
+    assert report.best_score >= LEN * 0.6
+
+
+def test_supervised_islands():
+    ref = PGA(seed=5, config=PGAConfig(use_pallas=False))
+    for _ in range(2):
+        ref.create_population(POP, LEN)
+    ref.set_objective("onemax")
+    ref.run_islands(4, 2, 0.1)
+    ref.run_islands(4, 2, 0.1)
+    pga = PGA(seed=5, config=PGAConfig(use_pallas=False))
+    for _ in range(2):
+        pga.create_population(POP, LEN)
+    pga.set_objective("onemax")
+    report = supervised_run(
+        pga, 8, islands=(2, 0.1), checkpoint_every=4, sleep=lambda s: None
+    )
+    assert report.generations == 8
+    for i in range(2):
+        np.testing.assert_array_equal(
+            np.asarray(ref._populations[i].genomes),
+            np.asarray(pga._populations[i].genomes),
+        )
+
+
+def test_retry_policy_validation():
+    with pytest.raises(ValueError, match="max_retries"):
+        RetryPolicy(max_retries=-1)
+    with pytest.raises(ValueError, match="jitter"):
+        RetryPolicy(jitter=2.0)
+    with pytest.raises(ValueError, match="backoff_factor"):
+        RetryPolicy(backoff_factor=0.5)
+
+
+def test_supervised_retry_event_validates(tmp_path):
+    from libpga_tpu.utils import telemetry
+
+    path = str(tmp_path / "retry.jsonl")
+    pga = _engine(
+        tel=TelemetryConfig(history_gens=0, events_path=path)
+    )
+    with faults.active(FaultPlan("objective.eval", at_call_n=1)):
+        supervised_run(
+            pga, 4, retry=RetryPolicy(max_retries=1), sleep=lambda s: None
+        )
+    records = telemetry.validate_log(path)
+    retries = [r for r in records if r["event"] == "retry"]
+    assert len(retries) == 1
+    assert retries[0]["attempt"] == 1 and "error" in retries[0]
+
+
+# -------------------------------------------------------------- capi bridge
+
+
+def test_capi_bridge_fault_plan_and_supervised_run(tmp_path):
+    from libpga_tpu import capi_bridge as cb
+
+    cb.set_fault_plan(
+        '{"seed": 3, "plans": [{"site": "objective.eval", '
+        '"at_call_n": 2}]}'
+    )
+    try:
+        assert faults.PLAN is not None
+        assert faults.PLAN.seed == 3
+        assert faults.PLAN.plans[0].site == "objective.eval"
+    finally:
+        cb.set_fault_plan("off")
+    assert faults.PLAN is None
+    with pytest.raises(ValueError):
+        cb.set_fault_plan('[{"site": "x", "kind": "bogus", "at_call_n": 1}]')
+
+    h = cb.init(31)
+    try:
+        cb.create_population(h, POP, LEN, 0)
+        cb.set_objective_name(h, "onemax")
+        path = str(tmp_path / "cabi.npz")
+        gens = cb.supervised_run(h, 6, 2, 1, path, 0)
+        assert gens == 6
+        assert os.path.exists(path)
+        assert read_meta(path)["generations"] == 6
+        # resume of the finished run is a no-op returning completion
+        h2 = cb.init(99)
+        cb.set_objective_name(h2, "onemax")
+        assert cb.supervised_run(h2, 6, 2, 1, path, 1) == 6
+        cb.deinit(h2)
+    finally:
+        cb.deinit(h)

@@ -23,9 +23,17 @@ The contracts under test:
 - **cache-key hygiene** (ISSUE 4 satellite): every engine/islands
   compile-cache key is namespaced with a ``<role>/`` prefix, so no
   engine-level key can ever collide with an operator
-  ``kernel_cache_key``.
+  ``kernel_cache_key``;
+- **failure isolation** (ISSUE 5): a failing run inside a mega-batch
+  fails only its own ticket — poisoned requests dead-letter with their
+  diagnosis, co-batched tickets complete bit-identically, a transient
+  launch failure is requeued once; plus bounded-queue backpressure
+  (``max_pending`` + block/raise overflow), deterministic ``close()``
+  (flusher joined; post-close submit raises even under concurrent
+  submitters), and re-awaitable ticket timeouts.
 """
 
+import threading
 import time
 
 import numpy as np
@@ -36,10 +44,12 @@ import jax.numpy as jnp
 
 from libpga_tpu import PGA, PGAConfig, ServingConfig, TelemetryConfig
 from libpga_tpu.ops.mutate import make_point_mutate
+from libpga_tpu.robustness import faults
 from libpga_tpu.serving import (
     COUNTERS,
     BatchedRuns,
     ProgramCache,
+    QueueFull,
     RunQueue,
     RunRequest,
 )
@@ -352,6 +362,240 @@ def test_queue_error_propagates_to_tickets():
     with pytest.raises(ValueError, match="genomes"):
         t.result(timeout=60)
     q.close()
+
+
+# -------------------------------------------------- failure isolation (I5)
+
+
+def test_poisoned_request_fails_only_its_ticket():
+    """ISSUE 5 tentpole fix of the pinned pre-robustness semantics: one
+    raising request in a mixed bucket used to error EVERY co-batched
+    ticket; now it dead-letters alone and the co-batched tickets
+    complete bit-identically to a fault-free batch."""
+    ex = _executor()
+    q = RunQueue(ex, serving=ServingConfig(max_batch=3, max_wait_ms=0))
+    good = [RunRequest(size=POP, genome_len=LEN, n=3, seed=40 + i)
+            for i in range(2)]
+    poisoned = RunRequest(
+        size=POP, genome_len=LEN, n=3, seed=49,
+        genomes=np.zeros((POP, LEN + 1), np.float32),
+    )
+    t0 = q.submit(good[0])
+    t_bad = q.submit(poisoned)
+    t1 = q.submit(good[1])  # fills the bucket → inline launch
+    with pytest.raises(ValueError, match="genomes"):
+        t_bad.result(timeout=60)
+    r0, r1 = t0.result(timeout=60), t1.result(timeout=60)
+    assert len(q.dead_letters) == 1
+    assert q.dead_letters[0].request is poisoned
+    assert isinstance(q.dead_letters[0].error, ValueError)
+    ref = _executor().run(good)
+    np.testing.assert_array_equal(
+        np.asarray(r0.genomes), np.asarray(ref[0].genomes)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(r1.genomes), np.asarray(ref[1].genomes)
+    )
+    q.close()
+
+
+def test_transient_launch_fault_requeues_once_and_recovers(tmp_path):
+    from libpga_tpu.utils import telemetry
+
+    path = str(tmp_path / "iso.jsonl")
+    with telemetry.EventLog(path) as log:
+        ex = BatchedRuns(
+            "onemax", config=PGAConfig(use_pallas=False), events=log
+        )
+        q = RunQueue(
+            ex, serving=ServingConfig(max_batch=2, max_wait_ms=0),
+            events=log,
+        )
+        reqs = [RunRequest(size=POP, genome_len=LEN, n=3, seed=50 + i)
+                for i in range(2)]
+        with faults.active(faults.FaultPlan("serving.launch", at_call_n=1)):
+            tickets = [q.submit(r) for r in reqs]
+            results = [t.result(timeout=60) for t in tickets]
+        q.close()
+    assert q.requeues == 1 and not q.dead_letters
+    ref = _executor().run(reqs)
+    for r, rr in zip(results, ref):
+        np.testing.assert_array_equal(
+            np.asarray(r.genomes), np.asarray(rr.genomes)
+        )
+    records = telemetry.validate_log(path)
+    retries = [r for r in records if r["event"] == "retry"]
+    assert len(retries) == 1 and retries[0]["attempt"] == 1
+
+
+def test_dead_letter_event_validates(tmp_path):
+    from libpga_tpu.utils import telemetry
+
+    path = str(tmp_path / "dl.jsonl")
+    with telemetry.EventLog(path) as log:
+        ex = BatchedRuns(
+            "onemax", config=PGAConfig(use_pallas=False), events=log
+        )
+        q = RunQueue(
+            ex, serving=ServingConfig(max_batch=1, max_wait_ms=0),
+            events=log,
+        )
+        t = q.submit(RunRequest(
+            size=POP, genome_len=LEN, n=2, seed=1,
+            genomes=np.zeros((POP, LEN + 1), np.float32),
+        ))
+        with pytest.raises(ValueError):
+            t.result(timeout=60)
+        q.close()
+    records = telemetry.validate_log(path)
+    dead = [r for r in records if r["event"] == "dead_letter"]
+    assert len(dead) == 1
+    assert "genomes" in dead[0]["error"]
+
+
+def test_executor_validate_diagnoses():
+    ex = _executor()
+    ok = RunRequest(size=POP, genome_len=LEN, n=2, seed=0)
+    assert ex.validate(ok) is None
+    bad_shape = RunRequest(
+        size=POP, genome_len=LEN, n=2, seed=0,
+        genomes=np.zeros((POP + 1, LEN), np.float32),
+    )
+    assert isinstance(ex.validate(bad_shape), ValueError)
+    bad_rate = RunRequest(
+        size=POP, genome_len=LEN, n=2, seed=0, mutation_rate=1.5
+    )
+    assert isinstance(ex.validate(bad_rate), ValueError)
+
+
+# --------------------------------------------------- backpressure (I5)
+
+
+def test_backpressure_raise_policy():
+    ex = _executor()
+    q = RunQueue(ex, serving=ServingConfig(
+        max_batch=8, max_wait_ms=0, max_pending=2, overflow="raise",
+    ))
+    q.submit(RunRequest(size=POP, genome_len=LEN, n=1, seed=0))
+    q.submit(RunRequest(size=POP, genome_len=LEN, n=1, seed=1))
+    assert q.pending == 2
+    with pytest.raises(QueueFull):
+        q.submit(RunRequest(size=POP, genome_len=LEN, n=1, seed=2))
+    q.drain()
+    assert q.pending == 0
+    # completions free slots: the next submit is admitted again
+    t = q.submit(RunRequest(size=POP, genome_len=LEN, n=1, seed=3))
+    q.drain()
+    assert t.result(timeout=60).generations == 1
+    q.close()
+
+
+def test_backpressure_block_policy_unblocks_on_completion():
+    ex = _executor()
+    q = RunQueue(ex, serving=ServingConfig(
+        max_batch=8, max_wait_ms=0, max_pending=1, overflow="block",
+    ))
+    q.submit(RunRequest(size=POP, genome_len=LEN, n=1, seed=0))
+    admitted = threading.Event()
+
+    def blocked_submit():
+        q.submit(RunRequest(size=POP, genome_len=LEN, n=1, seed=1))
+        admitted.set()
+
+    worker = threading.Thread(target=blocked_submit, daemon=True)
+    worker.start()
+    time.sleep(0.1)
+    assert not admitted.is_set()  # blocked at the bound
+    q.drain()  # completes the first ticket → frees the slot
+    assert admitted.wait(10)
+    q.drain()
+    q.close()
+    worker.join(5)
+
+
+def test_serving_config_backpressure_validation():
+    with pytest.raises(ValueError, match="max_pending"):
+        ServingConfig(max_pending=0)
+    with pytest.raises(ValueError, match="overflow"):
+        ServingConfig(overflow="drop")
+
+
+# ------------------------------------------------ ticket + close semantics
+
+
+def test_ticket_timeout_leaves_ticket_reawaitable():
+    """Satellite pin: result(timeout=) raising TimeoutError must leave
+    the ticket intact — a later result() still completes it."""
+    ex = _executor()
+    q = RunQueue(ex, serving=ServingConfig(max_batch=32, max_wait_ms=0))
+    t = q.submit(RunRequest(size=POP, genome_len=LEN, n=2, seed=5))
+    # Detach the bucket items as a launch-in-flight elsewhere would, so
+    # result()'s force-flush finds nothing and the wait genuinely times
+    # out.
+    with q._lock:
+        sig = q._bucket_names[t.bucket]
+        launch = q._take(sig)
+    with pytest.raises(TimeoutError):
+        t.result(timeout=0.05)
+    assert not t.poll()
+    q._launch(sig, *launch)  # the in-flight launch lands
+    assert t.result(timeout=60).generations == 2  # re-awaitable
+    q.close()
+
+
+def test_close_joins_flusher_and_post_close_submit_raises():
+    ex = _executor()
+    q = RunQueue(ex, serving=ServingConfig(max_batch=32, max_wait_ms=10.0))
+    q.submit(RunRequest(size=POP, genome_len=LEN, n=1, seed=0))
+    flusher = q._flusher
+    assert flusher is not None and flusher.is_alive()
+    q.close()
+    assert not flusher.is_alive()  # joined, not just flagged
+    with pytest.raises(RuntimeError, match="closed"):
+        q.submit(RunRequest(size=POP, genome_len=LEN, n=1, seed=1))
+
+
+def test_close_under_concurrent_submits_is_deterministic():
+    """Satellite: close() must leave no racing flusher iteration and
+    every admitted ticket either completes or the submit raised the
+    closed error — nothing hangs, nothing launches after close."""
+    ex = _executor()
+    q = RunQueue(ex, serving=ServingConfig(max_batch=4, max_wait_ms=5.0))
+    tickets, closed_errors = [], []
+    stop = threading.Event()
+
+    def submitter(base):
+        i = 0
+        while not stop.is_set():
+            try:
+                tickets.append(q.submit(RunRequest(
+                    size=POP, genome_len=LEN, n=1, seed=base + i,
+                )))
+            except RuntimeError:
+                closed_errors.append(1)
+                return
+            i += 1
+
+    workers = [
+        threading.Thread(target=submitter, args=(1000 * w,), daemon=True)
+        for w in range(3)
+    ]
+    for w in workers:
+        w.start()
+    time.sleep(0.15)
+    q.close()
+    stop.set()
+    for w in workers:
+        w.join(10)
+        assert not w.is_alive()
+    launches_at_close = q.launches
+    # every admitted ticket is completed by close()'s final flush
+    for t in list(tickets):
+        assert t.result(timeout=60).generations == 1
+    # and nothing launched after close() returned
+    assert q.launches == launches_at_close
+    with pytest.raises(RuntimeError, match="closed"):
+        q.submit(RunRequest(size=POP, genome_len=LEN, n=1, seed=9))
 
 
 # ---------------------------------------------------------------- islands

@@ -1,0 +1,304 @@
+#!/usr/bin/env python
+"""Chaos smoke: the ISSUE 5 fault matrix, CPU-runnable, CI stage 6.
+
+Each scenario installs a deterministic fault plan (``robustness/faults``),
+exercises a real entry point, and asserts BOTH halves of the robustness
+contract:
+
+1. **recovery** — the run completes (retry, resume, degrade, or isolate
+   per the scenario) instead of dying;
+2. **bit-identity** — the recovered run's final best genome/score is
+   bit-identical to the fault-free same-seed run with the same cadence
+   (rollback replays the engine key chain), or, for the poisoned-request
+   scenario, every innocent co-batched ticket matches its fault-free
+   result while the poisoned one dead-letters.
+
+Matrix:
+  compile-fault     injected kernel.build failure → engine degrades the
+                    config to the XLA path (fallback="xla"), results
+                    equal the plain XLA run; serving.compile failure →
+                    queue isolation requeues and every ticket completes
+  objective-raise   supervised_run retries after an injected objective
+                    exception; final state bit-identical to fault-free
+  nan-storm         supervised_run detects NaN scores, rolls back,
+                    retries; bit-identical to fault-free
+  checkpoint-kill   an injected failure between the checkpoint temp
+                    write and the atomic rename: the previous checkpoint
+                    survives, the supervised run retries the chunk+save
+                    and still ends bit-identical; a run killed outright
+                    resumes from the last durable checkpoint
+  flusher-death     the serving queue's background flusher thread dies;
+                    the next submit resurrects it and all tickets land
+  dead-letter       one statically poisoned request inside a mega-batch
+                    dead-letters with its diagnosis; all co-batched
+                    tickets complete bit-identically
+
+Exit 0 with a one-line summary per scenario; nonzero on first failure.
+"""
+
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from libpga_tpu import PGA, PGAConfig, ServingConfig  # noqa: E402
+from libpga_tpu.robustness import faults  # noqa: E402
+from libpga_tpu.robustness.supervisor import (  # noqa: E402
+    RetryPolicy,
+    supervised_run,
+)
+from libpga_tpu.serving import (  # noqa: E402
+    BatchedRuns,
+    RunQueue,
+    RunRequest,
+)
+
+SEED = 11
+POP, LEN, GENS, EVERY = 128, 16, 8, 2
+_NOSLEEP = lambda s: None  # noqa: E731 — backoff sleeps add nothing here
+
+
+def fresh_engine(seed=SEED):
+    pga = PGA(seed=seed, config=PGAConfig(use_pallas=False))
+    pga.create_population(POP, LEN)
+    pga.set_objective("onemax")
+    return pga
+
+
+def genomes_of(pga):
+    # explicit host copy — never a zero-copy view of a donatable buffer
+    return np.array(pga._populations[0].genomes, copy=True)
+
+
+def faultfree_supervised(tmp):
+    """The reference trajectory every recovery must match bit-exactly."""
+    pga = fresh_engine()
+    report = supervised_run(
+        pga, GENS, checkpoint_path=os.path.join(tmp, "ref.npz"),
+        checkpoint_every=EVERY, sleep=_NOSLEEP,
+    )
+    return genomes_of(pga), report.best_score
+
+
+def check(name, ok, detail=""):
+    status = "ok" if ok else "FAIL"
+    print(f"chaos {name}: {status}{' — ' + detail if detail else ''}")
+    if not ok:
+        sys.exit(f"chaos matrix failed at {name}")
+
+
+def scenario_compile_fault(tmp, ref_g, ref_best):
+    # Engine half: a kernel-build failure degrades the config to the
+    # XLA path instead of killing the run (fallback="xla" default).
+    xla = fresh_engine()
+    xla.run(GENS)
+    pga = PGA(seed=SEED, config=PGAConfig(use_pallas=True))
+    pga._pallas_backend_ok = lambda: True  # reach the build on CPU
+    pga.create_population(POP, LEN)
+    pga.set_objective("onemax")
+    with faults.active(
+        faults.FaultPlan("kernel.build", times=None, probability=1.0)
+    ) as reg:
+        pga.run(GENS)
+        assert reg.injected, "kernel.build site never fired"
+    engine_ok = np.array_equal(genomes_of(pga), genomes_of(xla))
+
+    # Serving half: a mega-run compile failure is isolated — the queue
+    # requeues the co-batched requests and every ticket completes.
+    ex = BatchedRuns("onemax", config=PGAConfig(use_pallas=False))
+    q = RunQueue(ex, serving=ServingConfig(max_batch=2, max_wait_ms=0))
+    with faults.active(faults.FaultPlan("serving.compile", at_call_n=1)):
+        tickets = [
+            q.submit(RunRequest(size=POP, genome_len=LEN, n=3, seed=s))
+            for s in (1, 2)
+        ]
+        results = [t.result(timeout=120) for t in tickets]
+    q.close()
+    ref = BatchedRuns("onemax", config=PGAConfig(use_pallas=False)).run(
+        [RunRequest(size=POP, genome_len=LEN, n=3, seed=s) for s in (1, 2)]
+    )
+    serving_ok = all(
+        np.array_equal(np.asarray(a.genomes), np.asarray(b.genomes))
+        for a, b in zip(results, ref)
+    ) and q.requeues == 1 and not q.dead_letters
+    check(
+        "compile-fault", engine_ok and serving_ok,
+        f"engine degraded bit-identical={engine_ok}, "
+        f"serving requeued+bit-identical={serving_ok}",
+    )
+
+
+def scenario_objective_raise(tmp, ref_g, ref_best):
+    pga = fresh_engine()
+    with faults.active(faults.FaultPlan("objective.eval", at_call_n=2)):
+        report = supervised_run(
+            pga, GENS, checkpoint_path=os.path.join(tmp, "oraise.npz"),
+            checkpoint_every=EVERY, retry=RetryPolicy(max_retries=2),
+            sleep=_NOSLEEP,
+        )
+    ok = (
+        report.retries == 1
+        and np.array_equal(genomes_of(pga), ref_g)
+        and report.best_score == ref_best
+    )
+    check("objective-raise", ok, f"retries={report.retries}, bit-identical")
+
+
+def scenario_nan_storm(tmp, ref_g, ref_best):
+    pga = fresh_engine()
+    with faults.active(
+        faults.FaultPlan("objective.eval", kind="nan", at_call_n=2)
+    ):
+        report = supervised_run(
+            pga, GENS, checkpoint_path=os.path.join(tmp, "nan.npz"),
+            checkpoint_every=EVERY, retry=RetryPolicy(max_retries=2),
+            sleep=_NOSLEEP,
+        )
+    ok = (
+        report.retries == 1
+        and "NaNStorm" in "".join(report.errors)
+        and np.array_equal(genomes_of(pga), ref_g)
+        and report.best_score == ref_best
+    )
+    check("nan-storm", ok, f"retries={report.retries}, bit-identical")
+
+
+def scenario_checkpoint_kill(tmp, ref_g, ref_best):
+    # Half 1: a save that dies mid-write is retried (chunk replays
+    # deterministically) and the final state is still bit-identical.
+    path = os.path.join(tmp, "ckill.npz")
+    pga = fresh_engine()
+    with faults.active(faults.FaultPlan("checkpoint.save", at_call_n=2)):
+        report = supervised_run(
+            pga, GENS, checkpoint_path=path, checkpoint_every=EVERY,
+            retry=RetryPolicy(max_retries=2), sleep=_NOSLEEP,
+        )
+    retried_ok = report.retries == 1 and np.array_equal(
+        genomes_of(pga), ref_g
+    )
+
+    # Half 2: a run killed outright mid-way resumes from the last
+    # durable checkpoint in a fresh engine, bit-identical at the end.
+    path2 = os.path.join(tmp, "ckill2.npz")
+    died = fresh_engine()
+    try:
+        with faults.active(faults.FaultPlan("objective.eval", at_call_n=3)):
+            supervised_run(
+                died, GENS, checkpoint_path=path2, checkpoint_every=EVERY,
+                retry=RetryPolicy(max_retries=0), sleep=_NOSLEEP,
+            )
+        raise AssertionError("worker was supposed to die")
+    except faults.InjectedFault:
+        pass
+    resumed = PGA(seed=999, config=PGAConfig(use_pallas=False))
+    resumed.set_objective("onemax")  # state comes from the checkpoint
+    report2 = supervised_run(
+        resumed, GENS, checkpoint_path=path2, checkpoint_every=EVERY,
+        resume=True, sleep=_NOSLEEP,
+    )
+    resume_ok = (
+        report2.restored
+        and report2.generations == GENS
+        and np.array_equal(genomes_of(resumed), ref_g)
+        and report2.best_score == ref_best
+    )
+    check(
+        "checkpoint-kill", retried_ok and resume_ok,
+        f"save-retry bit-identical={retried_ok}, "
+        f"resume bit-identical={resume_ok}",
+    )
+
+
+def scenario_flusher_death(tmp, ref_g, ref_best):
+    ex = BatchedRuns("onemax", config=PGAConfig(use_pallas=False))
+    q = RunQueue(ex, serving=ServingConfig(max_batch=32, max_wait_ms=15.0))
+    with faults.active(faults.FaultPlan("serving.flusher", at_call_n=1)):
+        t1 = q.submit(RunRequest(size=POP, genome_len=LEN, n=3, seed=1))
+        deadline = time.monotonic() + 10
+        while q._flusher.is_alive() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        died = not q._flusher.is_alive()
+        # the next submit resurrects the flusher, which then launches
+        # both tickets off the max_wait_ms clock
+        t2 = q.submit(RunRequest(size=POP, genome_len=LEN, n=3, seed=2))
+        deadline = time.monotonic() + 30
+        while not (t1.poll() and t2.poll()):
+            if time.monotonic() > deadline:
+                check("flusher-death", False, "tickets never completed")
+            time.sleep(0.01)
+        r1, r2 = t1.result(timeout=60), t2.result(timeout=60)
+    q.close()
+    ref = BatchedRuns("onemax", config=PGAConfig(use_pallas=False)).run(
+        [RunRequest(size=POP, genome_len=LEN, n=3, seed=s) for s in (1, 2)]
+    )
+    ok = died and all(
+        np.array_equal(np.asarray(a.genomes), np.asarray(b.genomes))
+        for a, b in zip((r1, r2), ref)
+    )
+    check("flusher-death", ok, f"died={died}, resurrected, bit-identical")
+
+
+def scenario_dead_letter(tmp, ref_g, ref_best):
+    ex = BatchedRuns("onemax", config=PGAConfig(use_pallas=False))
+    q = RunQueue(ex, serving=ServingConfig(max_batch=4, max_wait_ms=0))
+    good = [RunRequest(size=POP, genome_len=LEN, n=3, seed=s) for s in (1, 2, 3)]
+    poisoned = RunRequest(
+        size=POP, genome_len=LEN, n=3, seed=9,
+        genomes=np.zeros((POP, LEN + 1), np.float32),  # wrong shape
+    )
+    tickets = [q.submit(good[0]), q.submit(poisoned), q.submit(good[1]),
+               q.submit(good[2])]
+    poisoned_raised = False
+    try:
+        tickets[1].result(timeout=60)
+    except ValueError:
+        poisoned_raised = True
+    survivors = [tickets[0].result(timeout=60), tickets[2].result(timeout=60),
+                 tickets[3].result(timeout=60)]
+    q.close()
+    ref = BatchedRuns("onemax", config=PGAConfig(use_pallas=False)).run(good)
+    ok = (
+        poisoned_raised
+        and len(q.dead_letters) == 1
+        and q.dead_letters[0].request is poisoned
+        and all(
+            np.array_equal(np.asarray(a.genomes), np.asarray(b.genomes))
+            for a, b in zip(survivors, ref)
+        )
+    )
+    check(
+        "dead-letter", ok,
+        "poisoned ticket dead-lettered, 3 co-batched tickets bit-identical",
+    )
+
+
+def main():
+    # The flusher-death scenario kills a thread by design; keep its
+    # traceback out of the smoke's output.
+    threading.excepthook = lambda args: None
+    with tempfile.TemporaryDirectory(prefix="pga-chaos-") as tmp:
+        ref_g, ref_best = faultfree_supervised(tmp)
+        for scenario in (
+            scenario_compile_fault,
+            scenario_objective_raise,
+            scenario_nan_storm,
+            scenario_checkpoint_kill,
+            scenario_flusher_death,
+            scenario_dead_letter,
+        ):
+            scenario(tmp, ref_g, ref_best)
+    assert faults.PLAN is None, "a scenario leaked an installed fault plan"
+    print("chaos matrix: all scenarios recovered, bit-identical")
+
+
+if __name__ == "__main__":
+    main()
