@@ -421,6 +421,43 @@ def serving_arm(rounds: int = ROUNDS) -> dict:
     wsp_med, _ = _median_iqr(warm_speedups)
     from libpga_tpu.serving import COUNTERS
 
+    # Per-ticket latency (ISSUE 6, ROADMAP item 5): one full-width batch
+    # through the async queue per round, tickets carrying the complete
+    # submit -> launch -> complete -> readback breakdown. A PRIVATE
+    # registry so the percentiles describe exactly these rounds; the
+    # bucket is warm (compiles were amortized above), so this measures
+    # serving latency, not compilation.
+    from libpga_tpu import ServingConfig
+    from libpga_tpu.serving import RunQueue
+    from libpga_tpu.utils import metrics as _metrics
+
+    lat_width = max(SERVING_WIDTHS)
+    lat_registry = _metrics.MetricsRegistry()
+    lat_queue = RunQueue(
+        ex,
+        serving=ServingConfig(max_batch=lat_width, max_wait_ms=0),
+        registry=lat_registry,
+    )
+    for rnd in range(rounds):
+        tickets = [
+            lat_queue.submit(RunRequest(
+                size=SERVING_POP, genome_len=GENOME_LEN, n=SERVING_GENS,
+                seed=seed, mutation_rate=rate,
+            ))
+            for seed, rate in sweep(lat_width, 60_000 + 1_000 * rnd)
+        ]
+        lat_queue.drain()
+        for t in tickets:
+            t.result(timeout=600)
+    e2e = lat_registry.histogram("serving.ticket.e2e_ms").snapshot()
+    qwait = lat_registry.histogram(
+        "serving.ticket.queue_wait_ms"
+    ).snapshot()
+    fill = lat_registry.histogram(
+        "serving.batch.fill_ratio"
+    ).snapshot()
+    lat_queue.close()
+
     out = {
         "serving_pop": SERVING_POP,
         "serving_genome_len": GENOME_LEN,
@@ -433,6 +470,15 @@ def serving_arm(rounds: int = ROUNDS) -> dict:
         "serving_speedup_median": round(sp_med, 2),
         "serving_speedup_iqr": round(sp_iqr, 2),
         "serving_speedup_vs_warm_median": round(wsp_med, 2),
+        # Per-ticket serving latency over rounds x max-width warm
+        # batches (submit -> readback, ms) + the admission window's
+        # occupancy — the SLO quantities (ISSUE 6 / ROADMAP item 5).
+        "serving_latency_p50_ms": round(e2e.p50, 3),
+        "serving_latency_p99_ms": round(e2e.p99, 3),
+        "serving_queue_wait_p50_ms": round(qwait.p50, 3),
+        "serving_queue_wait_p99_ms": round(qwait.p99, 3),
+        "serving_latency_samples": e2e.count,
+        "serving_batch_fill_ratio_median": round(fill.p50, 4),
         "serving_cache": {
             k: v
             for k, v in COUNTERS.snapshot().items()

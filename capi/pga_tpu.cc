@@ -304,6 +304,47 @@ int pga_await(pga_ticket_t *t) {
         static_cast<long>(reinterpret_cast<intptr_t>(t))));
 }
 
+int pga_await_ex(pga_ticket_t *t, float latency_ms[4]) {
+    if (!t) return -1;
+    size_t nbytes = 0;
+    /* float32[5]: generations, then queue_wait/execute/readback/e2e ms
+     * (NaN where the lifecycle never reached the transition). */
+    float *vals = bytes_to_floats(
+        call("await_ticket_ex", "(l)",
+             static_cast<long>(reinterpret_cast<intptr_t>(t))),
+        &nbytes);
+    if (!vals || nbytes < 5 * sizeof(float)) {
+        std::free(vals);
+        return -1;
+    }
+    if (latency_ms)
+        for (int i = 0; i < 4; i++) latency_ms[i] = vals[1 + i];
+    int gens = static_cast<int>(vals[0]);
+    std::free(vals);
+    return gens;
+}
+
+long pga_metrics_snapshot(char *buf, unsigned long cap) {
+    PyObject *out = call("metrics_snapshot_json", "()");
+    if (!out) return -1;
+    char *data = nullptr;
+    Py_ssize_t len = 0;
+    if (PyBytes_AsStringAndSize(out, &data, &len) != 0) {
+        PyErr_Print();
+        Py_DECREF(out);
+        return -1;
+    }
+    if (buf && cap > 0) {
+        size_t n = static_cast<size_t>(len) < cap - 1
+                       ? static_cast<size_t>(len)
+                       : cap - 1;
+        std::memcpy(buf, data, n);
+        buf[n] = '\0';
+    }
+    Py_DECREF(out);
+    return static_cast<long>(len);
+}
+
 int pga_serving_config(unsigned max_batch, float max_wait_ms) {
     return static_cast<int>(
         call_long("serving_config", "(If)", max_batch,

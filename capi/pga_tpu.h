@@ -343,6 +343,27 @@ int pga_poll(pga_ticket_t *t);
 int pga_await(pga_ticket_t *t);
 int pga_serving_config(unsigned max_batch, float max_wait_ms);
 
+/* ---- Serving observability (ISSUE 6) ----------------------------------
+ *
+ * pga_await_ex behaves exactly like pga_await and additionally reports
+ * the awaited ticket's latency breakdown into latency_ms[4]:
+ * [0] queue wait (submit -> mega-run launch), [1] execute (launch ->
+ * run complete), [2] readback (complete -> host materialization),
+ * [3] end-to-end (submit -> readback) — all in milliseconds, NaN for
+ * spans the ticket's lifecycle never reached (e.g. a dead-lettered
+ * run). latency_ms may be NULL (then it is pga_await). Returns the
+ * generations executed, negative on error.
+ *
+ * pga_metrics_snapshot writes the process-global metrics registry —
+ * per-ticket latency histograms with p50/p95/p99, queue/cache gauges,
+ * serving counters — as a UTF-8 JSON document into buf (NUL-terminated,
+ * truncated at cap). Returns the full JSON length in bytes (excluding
+ * the NUL) so a caller receiving ret >= cap can retry with a larger
+ * buffer; negative on error. buf may be NULL with cap 0 to query the
+ * size. */
+int pga_await_ex(pga_ticket_t *t, float latency_ms[4]);
+long pga_metrics_snapshot(char *buf, unsigned long cap);
+
 #ifdef __cplusplus
 }
 #endif

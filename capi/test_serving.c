@@ -9,6 +9,7 @@
 #include <math.h>
 #include <stdio.h>
 #include <stdlib.h>
+#include <string.h>
 
 #include "pga_tpu.h"
 
@@ -71,7 +72,19 @@ int main(void) {
         if (pga_poll(tickets[i]) != 1)
             return fprintf(stderr, "ticket %d not done post-launch\n", i), 1;
 
-    for (int i = 0; i < NSOLVERS; i++) {
+    /* Ticket 0 through pga_await_ex: same install semantics, plus the
+     * latency breakdown (every span reached => finite and ordered). */
+    float lat[4] = {-1.0f, -1.0f, -1.0f, -1.0f};
+    int gens0 = pga_await_ex(tickets[0], lat);
+    if (gens0 != GENS)
+        return fprintf(stderr, "pga_await_ex returned %d\n", gens0), 1;
+    for (int i = 0; i < 4; i++)
+        if (!(lat[i] == lat[i]) || lat[i] < 0.0f)
+            return fprintf(stderr, "latency[%d] = %g invalid\n", i, lat[i]),
+                   1;
+    if (lat[3] + 1e-3f < lat[1]) /* e2e >= execute (readback-inclusive) */
+        return fprintf(stderr, "e2e %g < execute %g\n", lat[3], lat[1]), 1;
+    for (int i = 1; i < NSOLVERS; i++) {
         int gens = pga_await(tickets[i]);
         if (gens != GENS)
             return fprintf(stderr, "pga_await %d returned %d\n", i, gens), 1;
@@ -99,6 +112,27 @@ int main(void) {
     int gens = pga_await(t); /* await forces the flush */
     if (gens < 0 || gens > 200)
         return fprintf(stderr, "target await returned %d\n", gens), 1;
+
+    /* Metrics snapshot: size query, then a real read — the JSON must
+     * mention the per-ticket latency histograms the awaits fed. */
+    long need = pga_metrics_snapshot(NULL, 0);
+    if (need <= 0)
+        return fprintf(stderr, "metrics size query returned %ld\n", need), 1;
+    {
+        /* The snapshot is live (its timestamp alone changes length
+         * between calls) — allocate slack, as the header prescribes. */
+        unsigned long cap = (unsigned long)need + 4096;
+        char *json = (char *)malloc(cap);
+        if (!json) return fprintf(stderr, "malloc failed\n"), 1;
+        long got = pga_metrics_snapshot(json, cap);
+        if (got <= 0 || (unsigned long)got >= cap)
+            return fprintf(stderr, "metrics read %ld (cap %lu)\n", got, cap),
+                   1;
+        if (!strstr(json, "serving.ticket.e2e_ms"))
+            return fprintf(stderr, "snapshot missing latency histogram\n"),
+                   1;
+        free(json);
+    }
 
     /* Error surfaces. */
     if (pga_poll(NULL) != -1)

@@ -72,6 +72,25 @@ def main() -> None:
         f"(the whole sweep shares one bucket)"
     )
 
+    # Per-ticket latency summary (ISSUE 6): every ticket was stamped
+    # submit -> admit -> launch -> complete -> readback on its way
+    # through the queue; the breakdown survives result().
+    print("\nlatency   queue_wait  execute   readback  e2e  (ms)")
+    for rate, ticket in tickets.items():
+        lat = ticket.latency()
+        print(
+            f"{rate:<8}  {lat['queue_wait_ms']:9.2f}  "
+            f"{lat['execute_ms']:8.2f}  {lat['readback_ms']:8.2f}  "
+            f"{lat['e2e_ms']:8.2f}"
+        )
+    from libpga_tpu.utils.metrics import REGISTRY
+
+    e2e = REGISTRY.histogram("serving.ticket.e2e_ms").snapshot()
+    print(
+        f"\np50 {e2e.p50:.1f} ms / p99 {e2e.p99:.1f} ms end-to-end "
+        f"over {e2e.count} tickets"
+    )
+
 
 if __name__ == "__main__":
     main()

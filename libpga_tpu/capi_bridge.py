@@ -885,10 +885,11 @@ def poll(ticket_id: int) -> int:
     return 1 if entry[2].poll() else 0
 
 
-def await_ticket(ticket_id: int) -> int:
-    """``pga_await``: block for the run, install its final population
-    into the solver (the pga_run state transition), release the ticket,
-    and return the generations executed."""
+def _await_install(ticket_id: int):
+    """Shared body of ``pga_await`` / ``pga_await_ex``: block for the
+    run, install its final population into the solver (the pga_run
+    state transition), release the ticket. Returns ``(gens, ticket)``
+    — the ticket keeps its latency breakdown after release."""
     from libpga_tpu.population import Population
 
     entry = _tickets.pop(ticket_id, None)
@@ -903,7 +904,39 @@ def await_ticket(ticket_id: int) -> int:
         )
         pga._staged[pop_index] = None
         pga._history[pop_index] = result.history
-    return gens
+    return gens, ticket
+
+
+def await_ticket(ticket_id: int) -> int:
+    """``pga_await``: block for the run, install its final population
+    into the solver (the pga_run state transition), release the ticket,
+    and return the generations executed."""
+    return _await_install(ticket_id)[0]
+
+
+def await_ticket_ex(ticket_id: int) -> bytes:
+    """``pga_await_ex``: like ``pga_await``, additionally reporting the
+    ticket's latency breakdown. Returns five float32s: generations,
+    then queue_wait / execute / readback / end-to-end milliseconds
+    (NaN for spans the lifecycle never reached)."""
+    gens, ticket = _await_install(ticket_id)
+    lat = ticket.latency()
+    vals = [float(gens)] + [
+        float("nan") if lat[k] is None else float(lat[k])
+        for k in ("queue_wait_ms", "execute_ms", "readback_ms", "e2e_ms")
+    ]
+    return np.asarray(vals, dtype=np.float32).tobytes()
+
+
+def metrics_snapshot_json() -> bytes:
+    """``pga_metrics_snapshot``: the process-global metrics registry
+    snapshot (counters, gauges, histograms with p50/p95/p99) as UTF-8
+    JSON — the C-side export of the ISSUE 6 observability layer."""
+    import json
+
+    from libpga_tpu.utils import metrics as _metrics
+
+    return json.dumps(_metrics.REGISTRY.snapshot()).encode("utf-8")
 
 
 # ------------------------------------------------------------ robustness

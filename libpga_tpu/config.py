@@ -273,3 +273,40 @@ class ServingConfig:
         except RuntimeError:
             backend = "cpu"
         return "run_major" if backend == "cpu" else "lockstep"
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOConfig:
+    """Latency service-level objectives for the serving queue (ISSUE 6).
+
+    Pass to :class:`~libpga_tpu.serving.queue.RunQueue` (``slo=...``).
+    Two kinds of check, both host-side and advisory — a breach emits an
+    ``slo_violation`` telemetry event and bumps the
+    ``serving.slo_violations`` counter, it never fails a request:
+
+    - **per-ticket**: a completed ticket whose queue wait exceeded
+      ``max_queue_wait_ms`` violates immediately (checked as each
+      result is read back);
+    - **aggregate**: ``RunQueue.check_slo()`` compares the p99 of the
+      end-to-end ticket latency histogram against ``p99_latency_ms``
+      (meaningful once ``min_samples`` tickets completed — a p99 over
+      three tickets is noise, not an objective).
+
+    ``tools/serving_throughput.py --slo`` turns violations into a
+    nonzero exit — the CI/SLO gate; ``None`` fields are unchecked.
+    """
+
+    p99_latency_ms: Optional[float] = None
+    max_queue_wait_ms: Optional[float] = None
+    min_samples: int = 20
+
+    def __post_init__(self):
+        if self.p99_latency_ms is not None and self.p99_latency_ms <= 0:
+            raise ValueError("p99_latency_ms must be > 0 or None")
+        if (
+            self.max_queue_wait_ms is not None
+            and self.max_queue_wait_ms < 0
+        ):
+            raise ValueError("max_queue_wait_ms must be >= 0 or None")
+        if self.min_samples < 1:
+            raise ValueError("min_samples must be >= 1")

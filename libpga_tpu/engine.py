@@ -261,6 +261,7 @@ class PGA:
         return self._events
 
     def _emit(self, event: str, **fields) -> None:
+        _tl.flight_note(event, fields)  # post-mortem ring, always on
         log = self._event_log()
         if log is not None:
             log.emit(event, **fields)
@@ -417,9 +418,13 @@ class PGA:
 
     def _degrade(self, what: str, error: BaseException, **fields) -> None:
         """Record a graceful kernel degradation (policy "xla"): one-time
-        warning per cause + a ``degraded`` telemetry event. The caller
-        has already decided to fall back."""
+        warning per cause + a ``degraded`` telemetry event + an
+        automatic flight-recorder dump (the degradation's recent
+        context — launches, faults, retries — is exactly what the
+        post-mortem needs). The caller has already decided to fall
+        back."""
         self._emit("degraded", what=what, error=str(error), **fields)
+        _tl.flight_dump("degraded")
         cause = (what, type(error).__name__)
         if cause in self._degraded_warned:
             return

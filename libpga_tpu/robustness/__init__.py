@@ -62,8 +62,15 @@ _SUPERVISOR_NAMES = (
 
 def __getattr__(name):
     if name in _SUPERVISOR_NAMES:
-        from libpga_tpu.robustness import supervisor
+        # importlib, not ``from ... import supervisor``: the from-form
+        # probes the package attribute first (PEP 562), which re-enters
+        # this __getattr__ before the submodule import ever starts —
+        # infinite recursion on the first lazy access.
+        import importlib
 
+        supervisor = importlib.import_module(
+            "libpga_tpu.robustness.supervisor"
+        )
         if name == "supervisor":
             return supervisor
         return getattr(supervisor, name)

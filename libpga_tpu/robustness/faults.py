@@ -162,6 +162,17 @@ class FaultRegistry:
                 self.injected.append(
                     {"site": site, "kind": plan.kind, "call": n}
                 )
+                # Flight-recorder tee (lazy import: faults must stay
+                # importable before utils wiring in stripped builds).
+                try:
+                    from libpga_tpu.utils import telemetry as _tl
+
+                    _tl.flight_note(
+                        "fault_injected",
+                        {"site": site, "kind": plan.kind, "call": n},
+                    )
+                except Exception:
+                    pass
                 if self.events is not None:
                     try:
                         self.events.emit(

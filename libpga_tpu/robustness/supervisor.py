@@ -38,6 +38,9 @@ import random
 import time
 from typing import Callable, List, Optional, TYPE_CHECKING, Tuple
 
+from libpga_tpu.utils import metrics as _metrics
+from libpga_tpu.utils import telemetry as _tl
+
 if TYPE_CHECKING:
     from libpga_tpu.engine import PGA
 
@@ -261,6 +264,7 @@ def supervised_run(
     def save_progress(generations: int) -> None:
         if not checkpoint_path:
             return
+        t0 = time.perf_counter()
         _ckpt.save(pga, checkpoint_path)
         _write_meta(
             checkpoint_path,
@@ -271,6 +275,11 @@ def supervised_run(
                 "target_reached": report.target_reached,
             },
         )
+        # Durability cost per auto-checkpoint (atomic save + sidecar):
+        # the number an operator tunes checkpoint_every against.
+        _metrics.REGISTRY.histogram(
+            "supervisor.checkpoint_write_seconds"
+        ).observe(time.perf_counter() - t0)
         report.checkpoints += 1
 
     while done < n and not report.target_reached:
@@ -302,10 +311,16 @@ def supervised_run(
                 attempt += 1
                 report.errors.append(f"{type(e).__name__}: {e}")
                 if attempt > retry.max_retries:
+                    # Retries exhausted: the supervised run is about to
+                    # abort — capture the recent fault/retry context +
+                    # live metrics before the raise unwinds it.
+                    _tl.flight_dump("supervisor_abort")
                     raise
                 _rollback(pga, snap)
+                _metrics.REGISTRY.counter("supervisor.rollbacks").bump()
                 delay = retry.delay(attempt, rng)
                 report.retries += 1
+                _metrics.REGISTRY.counter("supervisor.retries").bump()
                 pga._emit(
                     "retry", attempt=attempt, error=str(e),
                     backoff_s=round(delay, 4), where="supervised_run",
@@ -319,6 +334,8 @@ def supervised_run(
             and _stalled_gens(pga) >= stall_abort_gens
         ):
             report.aborted_on_stall = True
+            _metrics.REGISTRY.counter("supervisor.stall_aborts").bump()
+            _tl.flight_dump("stall_abort")
             break
 
     report.generations = done

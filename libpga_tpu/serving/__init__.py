@@ -36,7 +36,7 @@ Failure semantics (ISSUE 5 — the contracts a serving operator leans on):
   ticket re-awaitable — call ``result()`` again to keep waiting.
 """
 
-from libpga_tpu.config import ServingConfig
+from libpga_tpu.config import ServingConfig, SLOConfig
 from libpga_tpu.serving.batch import BatchedRuns, RunRequest, RunResult
 from libpga_tpu.serving.cache import COUNTERS, PROGRAM_CACHE, ProgramCache
 from libpga_tpu.serving.queue import (
@@ -44,6 +44,7 @@ from libpga_tpu.serving.queue import (
     QueueFull,
     RunQueue,
     RunTicket,
+    TicketTiming,
 )
 
 __all__ = [
@@ -52,9 +53,11 @@ __all__ = [
     "RunResult",
     "RunQueue",
     "RunTicket",
+    "TicketTiming",
     "DeadLetter",
     "QueueFull",
     "ServingConfig",
+    "SLOConfig",
     "ProgramCache",
     "PROGRAM_CACHE",
     "COUNTERS",

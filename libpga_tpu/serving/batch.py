@@ -39,6 +39,7 @@ Two run-axis layouts (``ServingConfig.layout``):
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Callable, List, Optional, Sequence
 
 import jax
@@ -52,6 +53,7 @@ from libpga_tpu.ops.step import make_param_breed
 from libpga_tpu.population import create_population
 from libpga_tpu.robustness import faults as _faults
 from libpga_tpu.serving import cache as _cache
+from libpga_tpu.utils import metrics as _metrics
 from libpga_tpu.utils import telemetry as _tl
 
 
@@ -231,6 +233,7 @@ class BatchedRuns:
         )
 
     def _emit(self, event: str, **fields) -> None:
+        _tl.flight_note(event, fields)  # post-mortem ring, always on
         if self.events is not None:
             self.events.emit(event, **fields)
 
@@ -406,10 +409,17 @@ class BatchedRuns:
             )
 
         fn = self._program(sig, width, layout)
+        t0 = time.perf_counter()
         out = fn(
             genomes, key_data, jnp.asarray(n), jnp.asarray(target),
             jnp.asarray(mparams),
         )
+        # Host-side dispatch span only (JAX async dispatch returns
+        # before the device finishes) — the device-complete span is the
+        # ticket's execute_ms, stamped by the queue at _complete.
+        _metrics.REGISTRY.histogram(
+            "serving.megarun.dispatch_seconds"
+        ).observe(time.perf_counter() - t0)
         g, s, gens = out[:3]
         hist_gens = self._history_gens()
         buf = out[3] if len(out) > 3 else None

@@ -26,14 +26,22 @@ Three properties the serving acceptance gates assert:
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 from typing import Callable, Optional
 
 from libpga_tpu.robustness import faults as _faults
+from libpga_tpu.utils import metrics as _metrics
 from libpga_tpu.utils.metrics import Counters
 
 #: Module-level counter set: hits / misses / builds / evictions.
 COUNTERS = Counters()
+
+
+def _entries_gauge(n: int) -> None:
+    """Mirror the live entry count into the metrics registry (the
+    operator-facing 'how many compiled mega-runs are resident' gauge)."""
+    _metrics.REGISTRY.gauge("serving.cache.entries").set(n)
 
 
 class ProgramCache:
@@ -74,8 +82,10 @@ class ProgramCache:
             if key in self._entries:
                 self._entries.move_to_end(key)
                 self.counters.bump("hits")
+                _metrics.REGISTRY.counter("serving.cache.hits").bump()
                 return self._entries[key]
         self.counters.bump("misses")
+        _metrics.REGISTRY.counter("serving.cache.misses").bump()
         return None
 
     def put(self, key: tuple, program) -> None:
@@ -88,6 +98,8 @@ class ProgramCache:
                 and len(self._entries) > self.capacity
             ):
                 evicted.append(self._entries.popitem(last=False))
+            n = len(self._entries)
+        _entries_gauge(n)
         for _ in evicted:
             self.counters.bump("evictions")
 
@@ -112,7 +124,13 @@ class ProgramCache:
         # launch isolation (serving/queue.py) decides who it poisons.
         if _faults.PLAN is not None:
             _faults.PLAN.fire("serving.compile")
+        t0 = time.perf_counter()
         program = build()
+        # Wall seconds per actual compile: the quantity an autotuner or
+        # warm-up planner reads to decide what to pre-build (ROADMAP 4).
+        _metrics.REGISTRY.histogram(
+            "serving.cache.build_seconds"
+        ).observe(time.perf_counter() - t0)
         self.put(key, program)
         return program
 
@@ -125,6 +143,7 @@ class ProgramCache:
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
+        _entries_gauge(0)
 
 
 #: The process-wide program cache every serving executor shares. Tests
@@ -141,3 +160,4 @@ def configure(capacity: Optional[int]) -> None:
             while len(PROGRAM_CACHE._entries) > capacity:
                 PROGRAM_CACHE._entries.popitem(last=False)
                 PROGRAM_CACHE.counters.bump("evictions")
+    _entries_gauge(len(PROGRAM_CACHE))

@@ -34,14 +34,67 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from libpga_tpu.config import ServingConfig
+from libpga_tpu.config import ServingConfig, SLOConfig
 from libpga_tpu.robustness import faults as _faults
 from libpga_tpu.serving.batch import BatchedRuns, RunRequest, RunResult
+from libpga_tpu.utils import metrics as _metrics
+from libpga_tpu.utils import telemetry as _tl
 
 
 class QueueFull(RuntimeError):
     """``submit`` under ``overflow="raise"`` with ``max_pending``
     admitted-but-incomplete tickets already in flight."""
+
+
+@dataclasses.dataclass
+class TicketTiming:
+    """Monotonic lifecycle stamps for one ticket (ISSUE 6).
+
+    Stamped by the queue at each transition: ``submitted`` (submit()
+    entered, before any backpressure wait), ``admitted`` (appended to
+    its shape bucket), ``launched`` (mega-run dispatch began; restamped
+    if the ticket is relaunched solo after a failed batch), ``completed``
+    (result or error assigned), ``readback`` (host readback finished in
+    ``result()``). A dead-lettered ticket keeps every stamp up to the
+    failure point — its post-mortem is exactly these timestamps.
+    Derived spans are in milliseconds and ``None`` while the
+    corresponding transition hasn't happened.
+    """
+
+    submitted: Optional[float] = None
+    admitted: Optional[float] = None
+    launched: Optional[float] = None
+    completed: Optional[float] = None
+    readback: Optional[float] = None
+
+    @staticmethod
+    def _ms(a: Optional[float], b: Optional[float]) -> Optional[float]:
+        return None if a is None or b is None else max((b - a) * 1e3, 0.0)
+
+    @property
+    def queue_wait_ms(self) -> Optional[float]:
+        return self._ms(self.submitted, self.launched)
+
+    @property
+    def execute_ms(self) -> Optional[float]:
+        return self._ms(self.launched, self.completed)
+
+    @property
+    def readback_ms(self) -> Optional[float]:
+        return self._ms(self.completed, self.readback)
+
+    @property
+    def e2e_ms(self) -> Optional[float]:
+        end = self.readback if self.readback is not None else self.completed
+        return self._ms(self.submitted, end)
+
+    def as_dict(self) -> dict:
+        return {
+            "queue_wait_ms": self.queue_wait_ms,
+            "execute_ms": self.execute_ms,
+            "readback_ms": self.readback_ms,
+            "e2e_ms": self.e2e_ms,
+        }
 
 
 @dataclasses.dataclass(frozen=True)
@@ -73,16 +126,27 @@ class RunTicket:
 
     def __init__(self, queue: "RunQueue", bucket: str):
         self.bucket = bucket
+        self.timing = TicketTiming()
         self._queue = queue
         self._event = threading.Event()
         self._result: Optional[RunResult] = None
         self._error: Optional[BaseException] = None
+        self._observed = False
 
     def _complete(self, result: Optional[RunResult], error=None) -> None:
+        self.timing.completed = time.monotonic()
         self._result = result
         self._error = error
         self._event.set()
         self._queue._ticket_done()
+
+    def latency(self) -> dict:
+        """The latency breakdown recorded so far (ms; ``None`` for
+        spans whose transitions haven't happened yet). Complete after
+        ``result()``; a dead-lettered ticket reports every span up to
+        its failure. ``drain()`` preserves tickets and their timing —
+        draining completes the runs, it never discards the breakdown."""
+        return self.timing.as_dict()
 
     def poll(self) -> bool:
         """True once the run's mega-run has been launched and assigned
@@ -102,7 +166,12 @@ class RunTicket:
             )
         if self._error is not None:
             raise self._error
-        return self._result.block()
+        out = self._result.block()
+        if not self._observed:
+            self._observed = True
+            self.timing.readback = time.monotonic()
+            self._queue._observe_ticket(self)
+        return out
 
 
 class _Bucket:
@@ -130,6 +199,8 @@ class RunQueue:
         executor: Optional[BatchedRuns] = None,
         serving: Optional[ServingConfig] = None,
         events=None,
+        slo: Optional[SLOConfig] = None,
+        registry: Optional[_metrics.MetricsRegistry] = None,
     ):
         self.executor = executor
         self.serving = serving or (
@@ -138,6 +209,8 @@ class RunQueue:
         self.events = events if events is not None else (
             executor.events if executor is not None else None
         )
+        self.slo = slo
+        self.registry = registry if registry is not None else _metrics.REGISTRY
         self._buckets: Dict[tuple, _Bucket] = {}
         self._bucket_names: Dict[str, tuple] = {}
         self._lock = threading.RLock()
@@ -155,15 +228,79 @@ class RunQueue:
     # --------------------------------------------------------------- events
 
     def _emit(self, event: str, **fields) -> None:
+        _tl.flight_note(event, fields)  # post-mortem ring, always on
         if self.events is not None:
             self.events.emit(event, **fields)
+
+    # -------------------------------------------------------------- metrics
+
+    def _observe_ticket(self, ticket: RunTicket) -> None:
+        """Fold one successfully read-back ticket into the latency
+        histograms, emit its ``ticket_done`` event, and apply the
+        per-ticket SLO check. Called exactly once per ticket, from
+        ``RunTicket.result()`` after readback."""
+        t = ticket.timing
+        for name, value in (
+            ("serving.ticket.queue_wait_ms", t.queue_wait_ms),
+            ("serving.ticket.execute_ms", t.execute_ms),
+            ("serving.ticket.readback_ms", t.readback_ms),
+            ("serving.ticket.e2e_ms", t.e2e_ms),
+        ):
+            if value is not None:
+                self.registry.histogram(name).observe(value)
+        self.registry.counter("serving.tickets_done").bump()
+        self._emit("ticket_done", bucket=ticket.bucket, **t.as_dict())
+        slo = self.slo
+        if (
+            slo is not None
+            and slo.max_queue_wait_ms is not None
+            and t.queue_wait_ms is not None
+            and t.queue_wait_ms > slo.max_queue_wait_ms
+        ):
+            self.registry.counter("serving.slo_violations").bump()
+            self._emit(
+                "slo_violation", what="queue_wait",
+                value_ms=round(t.queue_wait_ms, 3),
+                limit_ms=slo.max_queue_wait_ms, bucket=ticket.bucket,
+            )
+
+    def check_slo(self, slo: Optional[SLOConfig] = None) -> List[dict]:
+        """Aggregate SLO check: compare the end-to-end latency
+        histogram's p99 against ``slo.p99_latency_ms`` (skipped until
+        ``min_samples`` tickets completed). Returns violation dicts
+        (empty = within objective) and emits one ``slo_violation``
+        event per breach. ``tools/serving_throughput.py --slo`` exits
+        nonzero on a non-empty return."""
+        slo = slo or self.slo
+        if slo is None:
+            return []
+        violations: List[dict] = []
+        if slo.p99_latency_ms is not None:
+            snap = self.registry.histogram(
+                "serving.ticket.e2e_ms"
+            ).snapshot()
+            if snap.count >= slo.min_samples:
+                p99 = snap.percentile(99.0)
+                if p99 > slo.p99_latency_ms:
+                    violations.append({
+                        "what": "p99_latency",
+                        "value_ms": round(p99, 3),
+                        "limit_ms": slo.p99_latency_ms,
+                        "samples": snap.count,
+                    })
+        for v in violations:
+            self.registry.counter("serving.slo_violations").bump()
+            self._emit("slo_violation", **v)
+        return violations
 
     # --------------------------------------------------------- backpressure
 
     def _ticket_done(self) -> None:
         with self._pending_cv:
             self._pending -= 1
+            depth = self._pending
             self._pending_cv.notify_all()
+        self.registry.gauge("serving.queue.depth").set(depth)
 
     @property
     def pending(self) -> int:
@@ -187,6 +324,8 @@ class RunQueue:
                     )
                 self._pending_cv.wait(timeout=0.05)
             self._pending += 1
+            depth = self._pending
+        self.registry.gauge("serving.queue.depth").set(depth)
 
     def _unadmit(self) -> None:
         """Roll back a slot reserved by :meth:`_admit_slot` when the
@@ -206,6 +345,7 @@ class RunQueue:
         ex = executor or self.executor
         if ex is None:
             raise ValueError("no executor: pass one here or at init")
+        t_submit = time.monotonic()  # before any backpressure wait
         self._admit_slot()
         try:
             sig = ex.signature(request)
@@ -221,16 +361,22 @@ class RunQueue:
                 if not bucket.items:
                     bucket.oldest = time.monotonic()
                 ticket = RunTicket(self, name)
+                ticket.timing.submitted = t_submit
+                ticket.timing.admitted = time.monotonic()
                 bucket.items.append((request, ticket))
+                n_pending = len(bucket.items)
                 self.submitted += 1
                 self._emit(
-                    "batch_admit", bucket=name, pending=len(bucket.items),
+                    "batch_admit", bucket=name, pending=n_pending,
                     population_size=request.size,
                     genome_len=request.genome_len,
                 )
-                if len(bucket.items) >= self.serving.max_batch:
+                if n_pending >= self.serving.max_batch:
                     launch = self._take(sig)
                 self._ensure_flusher()
+            self.registry.gauge(
+                "serving.bucket.pending", bucket=name
+            ).set(0 if launch is not None else n_pending)
         except BaseException:
             self._unadmit()
             raise
@@ -246,12 +392,32 @@ class RunQueue:
         if bucket is None or not bucket.items:
             return None
         items, bucket.items = bucket.items, []
+        self.registry.gauge(
+            "serving.bucket.pending", bucket=_bucket_id(sig)
+        ).set(0)
         return bucket.executor, items
 
     def _launch(self, sig: tuple, executor: BatchedRuns, items) -> None:
         name = _bucket_id(sig)
-        self._emit("batch_launch", bucket=name, batch_size=len(items))
+        # Batch occupancy: requests actually packed into this mega-run,
+        # and how full the admission window ran vs max_batch — the
+        # latency-vs-throughput knob's direct reading (ROADMAP item 5).
+        fill = len(items) / self.serving.max_batch
+        self.registry.histogram("serving.batch.occupancy").observe(
+            len(items)
+        )
+        self.registry.histogram(
+            "serving.batch.fill_ratio",
+            bounds=tuple(i / 16 for i in range(1, 17)),
+        ).observe(fill)
+        self._emit(
+            "batch_launch", bucket=name, batch_size=len(items),
+            fill_ratio=round(fill, 4),
+        )
         self.launches += 1
+        t_launch = time.monotonic()
+        for _, ticket in items:
+            ticket.timing.launched = t_launch
         try:
             results = executor.run([req for req, _ in items])
         except BaseException as e:
@@ -291,6 +457,11 @@ class RunQueue:
         )
         for req, ticket in survivors:
             try:
+                # Restamp: the solo relaunch is this ticket's real
+                # dispatch — queue_wait then includes the failed batch
+                # attempt (which IS time spent waiting to execute), and
+                # the submit <= admit <= launch <= done ordering holds.
+                ticket.timing.launched = time.monotonic()
                 (result,) = executor.run([req])
             except BaseException as e:
                 self._dead_letter(name, req, ticket, e)
@@ -305,7 +476,14 @@ class RunQueue:
             "dead_letter", bucket=name, error=str(error),
             population_size=req.size, genome_len=req.genome_len,
         )
+        self.registry.counter("serving.dead_letters").bump()
+        self.registry.gauge("serving.dead_letters.pending").set(
+            len(self.dead_letters)
+        )
         ticket._complete(None, error=error)
+        # Post-mortem: the poisoned request's recent context (launches,
+        # faults, retries, this dead_letter) + live metrics, on disk.
+        _tl.flight_dump("dead_letter")
 
     def flush(self, bucket: Optional[str] = None) -> int:
         """Launch pending buckets now (all of them, or just the named
@@ -327,7 +505,11 @@ class RunQueue:
     def drain(self) -> int:
         """Flush everything pending; returns launches performed. After
         drain() every previously returned ticket is completed (its
-        result may still be device-lazy until read)."""
+        result may still be device-lazy until read). Draining preserves
+        each ticket's latency breakdown: the tickets are launched and
+        completed normally, so ``ticket.latency()`` afterwards reports
+        the full submit -> admit -> launch -> complete history (readback
+        is stamped when ``result()`` reads the ticket back)."""
         return self.flush()
 
     # -------------------------------------------------------- timed flusher

@@ -9,9 +9,11 @@ framework's headline metric — plus an optional callback hook for loggers.
 from __future__ import annotations
 
 import dataclasses
+import math
+import threading
 import time
 import warnings
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 
 @dataclasses.dataclass
@@ -42,6 +44,8 @@ class Counters:
     def __init__(self):
         self._counts: dict = {}
         self._listeners: List[Callable[[str, int], None]] = []
+        self._lock = threading.Lock()
+        self._warned_listeners: set = set()
 
     def add_listener(self, fn: Callable[[str, int], None]) -> Callable:
         self._listeners.append(fn)
@@ -52,28 +56,46 @@ class Counters:
             self._listeners.remove(fn)
         except ValueError:
             pass
+        self._warned_listeners.discard(id(fn))
 
     def bump(self, name: str, by: int = 1) -> int:
-        value = self._counts.get(name, 0) + by
-        self._counts[name] = value
+        # Read-modify-write under a lock: the serving flusher thread and
+        # submitter threads bump the same cache counters concurrently —
+        # an unlocked += loses increments exactly when the accounting is
+        # most interesting (bursts).
+        with self._lock:
+            value = self._counts.get(name, 0) + by
+            self._counts[name] = value
+        # Listener isolation (same contract as Metrics.record_run): one
+        # bad listener must never break cache/queue accounting. Called
+        # OUTSIDE the lock — a listener that bumps back would deadlock.
+        # One warning PER FAILING LISTENER, not per bump: counters fire
+        # on hot serving paths, and a broken dashboard hook repeating
+        # its warning thousands of times buries every other diagnostic.
         for fn in list(self._listeners):
             try:
                 fn(name, value)
             except Exception as e:
-                warnings.warn(
-                    f"counter listener {fn!r} raised {e!r} — ignored",
-                    stacklevel=2,
-                )
+                if id(fn) not in self._warned_listeners:
+                    self._warned_listeners.add(id(fn))
+                    warnings.warn(
+                        f"counter listener {fn!r} raised {e!r} — ignored "
+                        "(further failures of this listener are silent)",
+                        stacklevel=2,
+                    )
         return value
 
     def get(self, name: str) -> int:
-        return self._counts.get(name, 0)
+        with self._lock:
+            return self._counts.get(name, 0)
 
     def snapshot(self) -> dict:
-        return dict(self._counts)
+        with self._lock:
+            return dict(self._counts)
 
     def reset(self) -> None:
-        self._counts.clear()
+        with self._lock:
+            self._counts.clear()
 
 
 class Metrics:
@@ -141,3 +163,563 @@ class Metrics:
     def generations_per_sec(self) -> float:
         s = self.total_seconds
         return self.total_generations / s if s > 0 else 0.0
+
+
+# ======================================================================
+# Serving-grade metrics registry (ISSUE 6)
+#
+# Host-side only, by construction: nothing below ever appears inside a
+# traced program — instrumented code paths observe wall-clock spans and
+# queue states around device dispatches, so the metrics-disabled /
+# metrics-enabled distinction cannot perturb a jaxpr (the StableHLO
+# byte-identity gates never see this layer).
+# ======================================================================
+
+
+def log_bounds(
+    lo: float = 0.01, hi: float = 1e6, per_decade: int = 5
+) -> Tuple[float, ...]:
+    """Fixed log-spaced histogram bucket upper bounds covering
+    [lo, hi]. ``per_decade`` buckets per factor of 10 bounds the
+    worst-case percentile interpolation error at a factor of
+    ``10**(1/per_decade)`` (~58% at the default 5) while keeping the
+    bucket count small enough to snapshot/merge cheaply. The default
+    span (0.01..1e6, read as milliseconds: 10µs .. ~17min) covers every
+    latency this library serves."""
+    if lo <= 0 or hi <= lo:
+        raise ValueError("need 0 < lo < hi")
+    if per_decade < 1:
+        raise ValueError("per_decade must be >= 1")
+    step = 10.0 ** (1.0 / per_decade)
+    out = [lo]
+    while out[-1] < hi:
+        out.append(out[-1] * step)
+    # Exact decade boundaries drift under repeated float multiply;
+    # round to a stable short decimal so identical parameters always
+    # produce identical (mergeable) bounds.
+    return tuple(float(f"{b:.6g}") for b in out)
+
+
+#: The registry's default bucket layout — one shared shape so every
+#: histogram snapshot in a process (and across processes of one fleet)
+#: merges with every other.
+DEFAULT_BOUNDS = log_bounds()
+
+
+@dataclasses.dataclass(frozen=True)
+class HistogramSnapshot:
+    """An immutable, mergeable view of a histogram's state.
+
+    ``counts`` has ``len(bounds) + 1`` entries — the last is the
+    overflow bucket (> bounds[-1]). Merging requires identical bounds;
+    merge is associative and commutative (counts add, min/max fold), so
+    per-worker snapshots can be combined in any tree order — the
+    property a fleet-level aggregator needs.
+    """
+
+    bounds: Tuple[float, ...]
+    counts: Tuple[int, ...]
+    sum: float = 0.0
+    min: float = math.inf
+    max: float = -math.inf
+
+    @property
+    def count(self) -> int:
+        return sum(self.counts)
+
+    def merge(self, other: "HistogramSnapshot") -> "HistogramSnapshot":
+        if self.bounds != other.bounds:
+            raise ValueError(
+                "cannot merge histograms with different bounds "
+                f"({len(self.bounds)} vs {len(other.bounds)} buckets)"
+            )
+        return HistogramSnapshot(
+            bounds=self.bounds,
+            counts=tuple(
+                a + b for a, b in zip(self.counts, other.counts)
+            ),
+            sum=self.sum + other.sum,
+            min=min(self.min, other.min),
+            max=max(self.max, other.max),
+        )
+
+    def percentile(self, q: float) -> float:
+        """Estimate the q-th percentile (0..100) by linear interpolation
+        inside the containing bucket. Accuracy is bounded by the bucket
+        width; exact at the recorded min/max. NaN when empty."""
+        if not (0.0 <= q <= 100.0):
+            raise ValueError("q must be in [0, 100]")
+        total = self.count
+        if total == 0:
+            return math.nan
+        rank = (q / 100.0) * total
+        cum = 0
+        for i, c in enumerate(self.counts):
+            if c == 0:
+                continue
+            if cum + c >= rank:
+                lo = self.bounds[i - 1] if i > 0 else min(
+                    self.min, self.bounds[0]
+                )
+                hi = (
+                    self.bounds[i] if i < len(self.bounds) else self.max
+                )
+                lo = max(lo, self.min)
+                hi = min(hi, self.max) if hi >= lo else lo
+                frac = (rank - cum) / c
+                return min(max(lo + frac * (hi - lo), self.min), self.max)
+            cum += c
+        return self.max  # numeric slack: rank fell off the end
+
+    @property
+    def p50(self) -> float:
+        return self.percentile(50.0)
+
+    @property
+    def p95(self) -> float:
+        return self.percentile(95.0)
+
+    @property
+    def p99(self) -> float:
+        return self.percentile(99.0)
+
+    def as_dict(self) -> dict:
+        """JSON-able form (the snapshot-exporter record)."""
+        empty = self.count == 0
+        return {
+            "bounds": list(self.bounds),
+            "counts": list(self.counts),
+            "count": self.count,
+            "sum": self.sum,
+            "min": None if empty else self.min,
+            "max": None if empty else self.max,
+            "p50": None if empty else self.p50,
+            "p95": None if empty else self.p95,
+            "p99": None if empty else self.p99,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "HistogramSnapshot":
+        empty = sum(d["counts"]) == 0
+        return HistogramSnapshot(
+            bounds=tuple(d["bounds"]),
+            counts=tuple(d["counts"]),
+            sum=float(d["sum"]),
+            min=math.inf if empty else float(d["min"]),
+            max=-math.inf if empty else float(d["max"]),
+        )
+
+
+class Histogram:
+    """Thread-safe fixed-bound histogram (log-spaced by default).
+
+    ``observe`` is O(log buckets); reads go through :meth:`snapshot`
+    (an immutable, mergeable value — see :class:`HistogramSnapshot`).
+    Convenience percentile properties read a fresh snapshot.
+    """
+
+    def __init__(self, bounds: Optional[Sequence[float]] = None):
+        bounds = tuple(bounds) if bounds is not None else DEFAULT_BOUNDS
+        if len(bounds) < 1 or any(
+            b2 <= b1 for b1, b2 in zip(bounds, bounds[1:])
+        ):
+            raise ValueError("bounds must be strictly increasing")
+        self.bounds = bounds
+        self._counts = [0] * (len(bounds) + 1)
+        self._sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        if math.isnan(value):
+            return  # a NaN sample would poison sum/percentiles
+        import bisect
+
+        idx = bisect.bisect_left(self.bounds, value)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += value
+            if value < self._min:
+                self._min = value
+            if value > self._max:
+                self._max = value
+
+    def snapshot(self) -> HistogramSnapshot:
+        with self._lock:
+            return HistogramSnapshot(
+                bounds=self.bounds,
+                counts=tuple(self._counts),
+                sum=self._sum,
+                min=self._min,
+                max=self._max,
+            )
+
+    @property
+    def count(self) -> int:
+        return self.snapshot().count
+
+    @property
+    def sum(self) -> float:
+        return self.snapshot().sum
+
+    def percentile(self, q: float) -> float:
+        return self.snapshot().percentile(q)
+
+    @property
+    def p50(self) -> float:
+        return self.percentile(50.0)
+
+    @property
+    def p95(self) -> float:
+        return self.percentile(95.0)
+
+    @property
+    def p99(self) -> float:
+        return self.percentile(99.0)
+
+
+class Gauge:
+    """Thread-safe point-in-time value (queue depth, cache entries)."""
+
+    def __init__(self, value: float = 0.0):
+        self._value = float(value)
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> float:
+        with self._lock:
+            self._value = float(value)
+            return self._value
+
+    def add(self, delta: float) -> float:
+        with self._lock:
+            self._value += float(delta)
+            return self._value
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Counter:
+    """Thread-safe monotonically-increasing scalar (the registry's
+    per-series counter; :class:`Counters` remains the multi-name set
+    used by the compile cache)."""
+
+    def __init__(self):
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def bump(self, by: int = 1) -> int:
+        if by < 0:
+            raise ValueError("counters only increase; use a Gauge")
+        with self._lock:
+            self._value += by
+            return self._value
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+
+def _labels_key(labels: dict) -> tuple:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _series_name(name: str, labels: tuple) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return f"{name}{{{inner}}}"
+
+
+class MetricsRegistry:
+    """Process-global home for counters, gauges, and histograms.
+
+    ``counter(name, **labels)`` / ``gauge(...)`` / ``histogram(...)``
+    return the live (shared, thread-safe) instrument for that series,
+    creating it on first use — instrumentation sites never need setup
+    order. Snapshots are plain JSON-able dicts; ``to_prometheus()``
+    renders the text exposition format. A name maps to exactly one
+    instrument kind (a ``gauge("x")`` after ``counter("x")`` raises —
+    silent kind confusion corrupts dashboards).
+    """
+
+    SNAPSHOT_SCHEMA = 1
+
+    def __init__(self):
+        self._series: Dict[tuple, object] = {}
+        self._kinds: Dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, kind: str, name: str, labels: dict, make):
+        key = (name, _labels_key(labels))
+        with self._lock:
+            prev = self._kinds.get(name)
+            if prev is not None and prev != kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as {prev}, "
+                    f"cannot re-register as {kind}"
+                )
+            got = self._series.get(key)
+            if got is None:
+                self._kinds[name] = kind
+                got = self._series[key] = make()
+            return got
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get("counter", name, labels, Counter)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get("gauge", name, labels, Gauge)
+
+    def histogram(
+        self, name: str, bounds: Optional[Sequence[float]] = None,
+        **labels,
+    ) -> Histogram:
+        return self._get(
+            "histogram", name, labels, lambda: Histogram(bounds)
+        )
+
+    def reset(self) -> None:
+        """Drop every series (tests; a fresh server start)."""
+        with self._lock:
+            self._series.clear()
+            self._kinds.clear()
+
+    # ------------------------------------------------------------ export
+
+    def snapshot(self) -> dict:
+        """JSON-able registry state: one record per series, grouped by
+        instrument kind. The histogram records embed the full mergeable
+        state (bounds + counts) plus derived p50/p95/p99."""
+        with self._lock:
+            items = list(self._series.items())
+        out = {
+            "schema": self.SNAPSHOT_SCHEMA,
+            "ts": time.time(),
+            "counters": [],
+            "gauges": [],
+            "histograms": [],
+        }
+        for (name, labels), series in sorted(
+            items, key=lambda kv: kv[0]
+        ):
+            rec = {"name": name, "labels": dict(labels)}
+            if isinstance(series, Counter):
+                rec["value"] = series.value
+                out["counters"].append(rec)
+            elif isinstance(series, Gauge):
+                rec["value"] = series.value
+                out["gauges"].append(rec)
+            else:
+                rec.update(series.snapshot().as_dict())
+                out["histograms"].append(rec)
+        return out
+
+    def to_prometheus(self, prefix: str = "pga_") -> str:
+        return prometheus_text(self.snapshot(), prefix=prefix)
+
+
+#: The process-wide registry every instrumented subsystem shares.
+#: Tests that assert exact series contents should construct their own
+#: MetricsRegistry (RunQueue and friends accept one) or reset this.
+REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    return REGISTRY
+
+
+# ------------------------------------------------- Prometheus exposition
+
+
+def _prom_name(name: str, prefix: str) -> str:
+    out = "".join(
+        c if c.isalnum() or c == "_" else "_" for c in prefix + name
+    )
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _prom_escape(v) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"')
+
+
+def _prom_labels(labels: dict, extra: str = "") -> str:
+    parts = [
+        f'{k}="{_prom_escape(v)}"' for k, v in sorted(labels.items())
+    ]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _prom_float(v: float) -> str:
+    if v != v:
+        return "NaN"
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    return repr(float(v))
+
+
+def prometheus_text(snapshot: dict, prefix: str = "pga_") -> str:
+    """Render a :meth:`MetricsRegistry.snapshot` dict in the Prometheus
+    text exposition format (the ``tools/metrics_dump.py`` writer).
+    Works from a snapshot — not the live registry — so a collector can
+    re-render persisted or merged snapshots from other processes."""
+    lines: List[str] = []
+    typed: set = set()
+
+    def header(name: str, kind: str):
+        if name not in typed:
+            typed.add(name)
+            lines.append(f"# TYPE {name} {kind}")
+
+    for rec in snapshot.get("counters", ()):
+        name = _prom_name(rec["name"], prefix)
+        header(name, "counter")
+        lines.append(
+            f"{name}{_prom_labels(rec['labels'])} {int(rec['value'])}"
+        )
+    for rec in snapshot.get("gauges", ()):
+        name = _prom_name(rec["name"], prefix)
+        header(name, "gauge")
+        lines.append(
+            f"{name}{_prom_labels(rec['labels'])} "
+            f"{_prom_float(rec['value'])}"
+        )
+    for rec in snapshot.get("histograms", ()):
+        name = _prom_name(rec["name"], prefix)
+        header(name, "histogram")
+        cum = 0
+        for bound, cnt in zip(rec["bounds"], rec["counts"]):
+            cum += cnt
+            le = _prom_labels(rec["labels"], f'le="{_prom_float(bound)}"')
+            lines.append(f"{name}_bucket{le} {cum}")
+        cum += rec["counts"][len(rec["bounds"])]
+        le = _prom_labels(rec["labels"], 'le="+Inf"')
+        lines.append(f"{name}_bucket{le} {cum}")
+        labels = _prom_labels(rec["labels"])
+        lines.append(f"{name}_sum{labels} {_prom_float(rec['sum'])}")
+        lines.append(f"{name}_count{labels} {rec['count']}")
+    return "\n".join(lines) + "\n"
+
+
+def lint_prometheus(text: str) -> List[str]:
+    """Line-format lint of a Prometheus text exposition (the
+    ``tools/metrics_dump.py --check`` gate). Returns a list of problem
+    strings (empty = clean). Checks per-line syntax, histogram bucket
+    cumulativity, the ``+Inf`` bucket, and ``_count`` consistency."""
+    import re
+
+    errors: List[str] = []
+    name_re = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
+    label_re = re.compile(
+        r'[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    )
+    sample_re = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^{}]*\})?\s+(\S+)(\s+\d+)?$"
+    )
+    buckets: Dict[tuple, List[Tuple[float, float]]] = {}
+    counts: Dict[tuple, float] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) < 3 or parts[1] not in ("TYPE", "HELP"):
+                errors.append(f"line {lineno}: malformed comment: {line!r}")
+            elif not name_re.fullmatch(parts[2]):
+                errors.append(
+                    f"line {lineno}: bad metric name {parts[2]!r}"
+                )
+            elif parts[1] == "TYPE" and (
+                len(parts) < 4
+                or parts[3]
+                not in ("counter", "gauge", "histogram", "summary",
+                        "untyped")
+            ):
+                errors.append(f"line {lineno}: bad TYPE: {line!r}")
+            continue
+        m = sample_re.match(line)
+        if m is None:
+            errors.append(f"line {lineno}: not a sample line: {line!r}")
+            continue
+        name, labelstr, value = m.group(1), m.group(2), m.group(3)
+        labels = {}
+        if labelstr:
+            body = labelstr[1:-1].strip()
+            if body:
+                pos = 0
+                ok = True
+                while pos < len(body):
+                    lm = label_re.match(body, pos)
+                    if lm is None:
+                        ok = False
+                        break
+                    k, v = lm.group(0).split("=", 1)
+                    labels[k] = v[1:-1]
+                    pos = lm.end()
+                    if pos < len(body):
+                        if body[pos] != ",":
+                            ok = False
+                            break
+                        pos += 1
+                if not ok:
+                    errors.append(
+                        f"line {lineno}: bad label syntax: {labelstr!r}"
+                    )
+                    continue
+        try:
+            fval = float(value)
+        except ValueError:
+            if value not in ("NaN", "+Inf", "-Inf"):
+                errors.append(
+                    f"line {lineno}: bad sample value {value!r}"
+                )
+                continue
+            fval = float(value.replace("Inf", "inf"))
+        if name.endswith("_bucket") and "le" in labels:
+            base = name[: -len("_bucket")]
+            rest = tuple(
+                sorted((k, v) for k, v in labels.items() if k != "le")
+            )
+            le = (
+                math.inf if labels["le"] == "+Inf"
+                else float(labels["le"])
+            )
+            buckets.setdefault((base, rest), []).append((le, fval))
+        elif name.endswith("_count"):
+            base = name[: -len("_count")]
+            counts[(base, _labels_key(labels))] = fval
+    for (base, rest), series in buckets.items():
+        series.sort(key=lambda p: p[0])
+        if series[-1][0] != math.inf:
+            errors.append(f"histogram {base}: missing le=\"+Inf\" bucket")
+        prev = -math.inf
+        for le, v in series:
+            if v < prev:
+                errors.append(
+                    f"histogram {base}: bucket counts not cumulative "
+                    f"at le={le}"
+                )
+                break
+            prev = v
+        total = counts.get((base, tuple(rest)))
+        if (
+            total is not None
+            and series[-1][0] == math.inf
+            and series[-1][1] != total
+        ):
+            errors.append(
+                f"histogram {base}: +Inf bucket {series[-1][1]} != "
+                f"_count {total}"
+            )
+    return errors

@@ -89,6 +89,12 @@ EVENT_FIELDS: Dict[str, tuple] = {
     "degraded": ("what", "error"),
     "retry": ("attempt", "error"),
     "dead_letter": ("bucket", "error"),
+    # Serving observability (ISSUE 6): per-ticket latency accounting,
+    # SLO breaches, exported metric snapshots, flight-recorder dumps.
+    "ticket_done": ("bucket", "queue_wait_ms", "execute_ms", "e2e_ms"),
+    "slo_violation": ("what", "value_ms", "limit_ms"),
+    "metrics_snapshot": ("metrics",),
+    "flight_dump": ("reason", "records"),
 }
 
 
@@ -374,6 +380,155 @@ class EventLog:
 
     def __exit__(self, *exc):
         self.close()
+
+
+# -------------------------------------------------------- flight recorder
+
+
+class FlightRecorder:
+    """Bounded ring buffer of recent observability events — the
+    post-mortem "what were the last N things this process did" record
+    (ISSUE 6). Every ``_emit`` site in the serving queue, executor,
+    engine, and supervisor also notes its event here (independent of
+    whether a JSONL event log is configured), so when something
+    dead-letters, degrades, or a supervised run aborts, the trigger
+    site calls :meth:`dump` and the recent launch/fault/retry context
+    lands on disk as a schema-valid JSONL file, terminated by a
+    ``metrics_snapshot`` record carrying the live
+    :data:`~libpga_tpu.utils.metrics.REGISTRY` state and a
+    ``flight_dump`` trailer naming the dump reason.
+
+    Thread-safe; ``capacity`` bounds memory (each record is one small
+    dict). Dumps go to ``dump_dir`` (default: ``$PGA_FLIGHT_DIR`` or
+    the system temp dir) as ``pga-flight-<pid>-<seq>-<reason>.jsonl``.
+    """
+
+    def __init__(
+        self,
+        capacity: int = 256,
+        dump_dir: Optional[str] = None,
+        *,
+        clock=time.time,
+    ):
+        import collections
+        import threading
+
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = capacity
+        self.dump_dir = dump_dir
+        self._clock = clock
+        self._ring = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self.dumps: List[str] = []
+
+    def note(self, event: str, fields: Optional[dict] = None, **kw) -> dict:
+        rec = {
+            "schema": EVENT_SCHEMA_VERSION,
+            "ts": float(self._clock()),
+            "event": str(event),
+        }
+        if fields:
+            rec.update(fields)
+        if kw:
+            rec.update(kw)
+        with self._lock:
+            self._ring.append(rec)
+        return rec
+
+    def records(self) -> List[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    def _default_path(self, reason: str) -> str:
+        import os
+        import tempfile
+
+        base = self.dump_dir or os.environ.get(
+            "PGA_FLIGHT_DIR"
+        ) or tempfile.gettempdir()
+        os.makedirs(base, exist_ok=True)
+        safe = "".join(c if c.isalnum() or c in "-_" else "-" for c in reason)
+        return os.path.join(
+            base, f"pga-flight-{os.getpid()}-{self._seq}-{safe}.jsonl"
+        )
+
+    def dump(
+        self, path: Optional[str] = None, reason: str = "manual"
+    ) -> Optional[str]:
+        """Write the ring (oldest first) + a ``metrics_snapshot`` + a
+        ``flight_dump`` trailer as schema-valid JSONL; returns the path
+        (None when the write failed). Never raises out of a trigger
+        site — the flight recorder is the diagnostic of last resort,
+        and a failing dump must not mask the failure being recorded
+        (it warns instead)."""
+        import warnings
+
+        with self._lock:
+            recs = list(self._ring)
+            self._seq += 1
+        try:
+            if path is None:
+                path = self._default_path(reason)
+            from libpga_tpu.utils import metrics as _metrics
+
+            snap_rec = {
+                "schema": EVENT_SCHEMA_VERSION,
+                "ts": float(self._clock()),
+                "event": "metrics_snapshot",
+                "metrics": _metrics.REGISTRY.snapshot(),
+            }
+            trailer = {
+                "schema": EVENT_SCHEMA_VERSION,
+                "ts": float(self._clock()),
+                "event": "flight_dump",
+                "reason": str(reason),
+                "records": len(recs),
+            }
+            with open(path, "w", encoding="utf-8") as fh:
+                for rec in recs + [snap_rec, trailer]:
+                    fh.write(json.dumps(rec, default=str) + "\n")
+        except Exception as e:
+            warnings.warn(
+                f"flight-recorder dump to {path!r} failed: {e!r}",
+                stacklevel=2,
+            )
+            return None
+        self.dumps.append(path)
+        del self.dumps[:-32]  # keep the tail; paths, not contents
+        return path
+
+
+#: The process-wide flight recorder every instrumented subsystem feeds.
+FLIGHT = FlightRecorder()
+
+
+def flight() -> FlightRecorder:
+    return FLIGHT
+
+
+def flight_note(event: str, fields: Optional[dict] = None) -> None:
+    """Feed one event into the global flight recorder (the tee every
+    subsystem ``_emit`` helper calls). Never raises — recording is
+    strictly best-effort."""
+    try:
+        FLIGHT.note(event, fields)
+    except Exception:
+        pass
+
+
+def flight_dump(reason: str) -> Optional[str]:
+    """Trigger an automatic post-mortem dump (dead letters, degradation,
+    supervisor aborts). Returns the path, or None if dumping failed."""
+    try:
+        return FLIGHT.dump(reason=reason)
+    except Exception:
+        return None
 
 
 def validate_event(rec: dict) -> None:

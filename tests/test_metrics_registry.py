@@ -1,0 +1,312 @@
+"""Serving-grade observability substrate (ISSUE 6): the metrics
+registry (histograms / gauges / counters + exporters), the flight
+recorder, and the SLO configuration — all host-side (nothing here may
+touch a traced program; the StableHLO byte-identity gates in
+test_telemetry.py prove the run loops can't see this layer)."""
+
+import json
+import math
+import threading
+
+import numpy as np
+import pytest
+
+from libpga_tpu.utils import metrics as M
+from libpga_tpu.utils import telemetry as T
+
+
+# ---------------------------------------------------------------- bounds
+
+
+def test_log_bounds_shape_and_validation():
+    b = M.log_bounds(0.01, 1e6, 5)
+    assert b[0] == 0.01 and b[-1] >= 1e6
+    assert all(x2 > x1 for x1, x2 in zip(b, b[1:]))
+    assert M.DEFAULT_BOUNDS == b  # the registry-wide shared layout
+    with pytest.raises(ValueError):
+        M.log_bounds(0, 10)
+    with pytest.raises(ValueError):
+        M.log_bounds(10, 1)
+    with pytest.raises(ValueError):
+        M.log_bounds(1, 10, 0)
+
+
+# ------------------------------------------------------------- histogram
+
+
+def test_histogram_percentiles_vs_numpy():
+    """Log-spaced buckets bound percentile error by the bucket width:
+    at 5 buckets/decade an estimate can be off by at most a factor of
+    10^(1/5) ~ 1.585 from the true order statistic. Checked against
+    numpy on heavy-tailed samples — the latency-shaped case."""
+    rng = np.random.default_rng(7)
+    for scale in (0.5, 3.0):
+        xs = rng.lognormal(scale, 1.2, 10_000)
+        h = M.Histogram()
+        for x in xs:
+            h.observe(x)
+        for q in (50, 90, 95, 99):
+            est = h.percentile(q)
+            true = float(np.percentile(xs, q))
+            assert true / 1.6 <= est <= true * 1.6, (q, est, true)
+
+
+def test_histogram_percentile_edge_cases():
+    h = M.Histogram(bounds=(1.0, 10.0, 100.0))
+    assert math.isnan(h.percentile(50))  # empty
+    h.observe(5.0)
+    # one sample: every percentile is that sample (clamped to min/max)
+    assert h.percentile(1) == h.percentile(99) == 5.0
+    h.observe(float("nan"))  # ignored, must not poison sum
+    assert h.count == 1 and h.sum == 5.0
+    h.observe(1e9)  # overflow bucket, clamped to recorded max
+    assert h.percentile(100) == 1e9
+    with pytest.raises(ValueError):
+        h.percentile(101)
+    with pytest.raises(ValueError):
+        M.Histogram(bounds=(2.0, 1.0))
+
+
+def test_snapshot_merge_associative_on_random_splits():
+    """Merge must be associative + commutative so per-worker snapshots
+    can combine in any tree order (the fleet-aggregation property)."""
+    rng = np.random.default_rng(3)
+    xs = rng.lognormal(2.0, 1.0, 6_000)
+    parts = [M.Histogram() for _ in range(4)]
+    whole = M.Histogram()
+    assignment = rng.integers(0, 4, xs.shape[0])
+    for x, i in zip(xs, assignment):
+        parts[i].observe(x)
+        whole.observe(x)
+    a, b, c, d = (p.snapshot() for p in parts)
+    m1 = a.merge(b).merge(c).merge(d)
+    m2 = a.merge(b.merge(c.merge(d)))
+    m3 = d.merge(c).merge(b.merge(a))
+    ref = whole.snapshot()
+    assert m1.counts == m2.counts == m3.counts == ref.counts
+    assert m1.min == ref.min and m1.max == ref.max
+    assert math.isclose(m1.sum, ref.sum, rel_tol=1e-9)
+    assert math.isclose(m2.sum, m3.sum, rel_tol=1e-9)
+    # percentiles are a pure function of the merged state
+    assert m1.percentile(99) == m2.percentile(99) == m3.percentile(99)
+    with pytest.raises(ValueError):
+        a.merge(M.Histogram(bounds=(1.0, 2.0)).snapshot())
+
+
+def test_snapshot_dict_round_trip():
+    h = M.Histogram()
+    for v in (0.5, 5.0, 500.0):
+        h.observe(v)
+    snap = h.snapshot()
+    d = snap.as_dict()
+    json.dumps(d)  # JSON-able
+    back = M.HistogramSnapshot.from_dict(d)
+    assert back == snap
+    # empty round trip keeps the empty sentinel semantics
+    e = M.Histogram(bounds=(1.0, 2.0)).snapshot()
+    assert M.HistogramSnapshot.from_dict(e.as_dict()) == e
+
+
+# --------------------------------------------------- gauges and counters
+
+
+def test_gauge_and_counter_under_threads():
+    """The serving flusher thread and submitter threads hit the same
+    gauges/counters; increments must not be lost."""
+    g = M.Gauge()
+    c = M.Counter()
+    h = M.Histogram()
+    N, WORKERS = 2_000, 4
+
+    def work():
+        for _ in range(N):
+            g.add(1)
+            c.bump()
+            h.observe(1.0)
+
+    threads = [threading.Thread(target=work) for _ in range(WORKERS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert g.value == N * WORKERS
+    assert c.value == N * WORKERS
+    assert h.count == N * WORKERS
+    g.set(7.5)
+    assert g.value == 7.5
+    with pytest.raises(ValueError):
+        c.bump(-1)
+
+
+def test_counters_bump_listener_isolation_warns_once():
+    """Satellite (ISSUE 6): a raising Counters listener can't break
+    cache/queue accounting, and warns ONCE per failing listener — not
+    once per bump (hot-path counters would bury diagnostics)."""
+    import warnings
+
+    cs = M.Counters()
+    seen = []
+
+    def bad(name, value):
+        raise RuntimeError("boom")
+
+    cs.add_listener(bad)
+    cs.add_listener(lambda name, value: seen.append((name, value)))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        for _ in range(5):
+            cs.bump("hits")
+    assert cs.get("hits") == 5  # accounting survived
+    assert seen[-1] == ("hits", 5)  # later listeners still fire
+    assert sum("boom" in str(x.message) for x in w) == 1  # once, not 5
+    # re-adding after removal warns again (fresh registration)
+    cs.remove_listener(bad)
+    cs.add_listener(bad)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        cs.bump("hits")
+    assert sum("boom" in str(x.message) for x in w) == 1
+
+
+def test_counters_bump_thread_safe():
+    cs = M.Counters()
+    N, WORKERS = 2_000, 4
+    threads = [
+        threading.Thread(
+            target=lambda: [cs.bump("n") for _ in range(N)]
+        )
+        for _ in range(WORKERS)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert cs.get("n") == N * WORKERS
+
+
+# -------------------------------------------------------------- registry
+
+
+def test_registry_series_identity_labels_and_kinds():
+    r = M.MetricsRegistry()
+    assert r.counter("x") is r.counter("x")
+    assert r.gauge("g", bucket="a") is r.gauge("g", bucket="a")
+    assert r.gauge("g", bucket="a") is not r.gauge("g", bucket="b")
+    assert r.histogram("h") is r.histogram("h")
+    with pytest.raises(ValueError):
+        r.gauge("x")  # kind collision, even for a new label set
+    r.reset()
+    r.gauge("x")  # fine after reset
+
+
+def test_registry_snapshot_and_prometheus_lint():
+    r = M.MetricsRegistry()
+    r.counter("serving.tickets_done").bump(3)
+    r.gauge("serving.queue.depth").set(2)
+    r.gauge("serving.bucket.pending", bucket="b01").set(4)
+    h = r.histogram("serving.ticket.e2e_ms")
+    for v in (1.0, 10.0, 100.0, 1e9):
+        h.observe(v)
+    snap = r.snapshot()
+    json.dumps(snap)
+    assert snap["schema"] == M.MetricsRegistry.SNAPSHOT_SCHEMA
+    [hrec] = snap["histograms"]
+    assert hrec["count"] == 4 and hrec["p50"] is not None
+    text = r.to_prometheus()
+    assert M.lint_prometheus(text) == []
+    # snapshot-driven rendering equals live rendering
+    assert M.prometheus_text(snap) == text
+    # exposition carries the cumulative +Inf bucket = count
+    assert 'le="+Inf"} 4' in text
+
+
+def test_lint_catches_malformed_expositions():
+    good = "# TYPE pga_x counter\npga_x 3\n"
+    assert M.lint_prometheus(good) == []
+    assert M.lint_prometheus("pga x 3\n")  # bad name
+    assert M.lint_prometheus("pga_x three\n")  # bad value
+    assert M.lint_prometheus('pga_x{le=1} 3\n')  # unquoted label
+    # non-cumulative buckets
+    bad_hist = (
+        'pga_h_bucket{le="1.0"} 5\n'
+        'pga_h_bucket{le="2.0"} 3\n'
+        'pga_h_bucket{le="+Inf"} 5\n'
+    )
+    assert any("cumulative" in e for e in M.lint_prometheus(bad_hist))
+    # missing +Inf
+    assert any(
+        "+Inf" in e
+        for e in M.lint_prometheus('pga_h_bucket{le="1.0"} 5\n')
+    )
+    # +Inf bucket disagreeing with _count
+    bad_count = (
+        'pga_h_bucket{le="+Inf"} 5\n'
+        "pga_h_count 6\n"
+    )
+    assert any("_count" in e for e in M.lint_prometheus(bad_count))
+
+
+# -------------------------------------------------------- flight recorder
+
+
+def test_flight_recorder_ring_is_bounded():
+    fr = T.FlightRecorder(capacity=8)
+    for i in range(20):
+        fr.note("compile", {"what": f"w{i}"})
+    recs = fr.records()
+    assert len(recs) == 8
+    assert recs[0]["what"] == "w12" and recs[-1]["what"] == "w19"
+    fr.clear()
+    assert fr.records() == []
+    with pytest.raises(ValueError):
+        T.FlightRecorder(capacity=0)
+
+
+def test_flight_recorder_dump_is_schema_valid(tmp_path):
+    fr = T.FlightRecorder(capacity=16, dump_dir=str(tmp_path))
+    fr.note("compile", {"what": "serving_mega_run"})
+    fr.note("retry", {"attempt": 1, "error": "boom"})
+    path = fr.dump(reason="dead_letter")
+    assert path in fr.dumps
+    recs = T.validate_log(path)  # schema-valid against EVENT_FIELDS
+    kinds = [r["event"] for r in recs]
+    assert kinds == ["compile", "retry", "metrics_snapshot", "flight_dump"]
+    assert recs[-1]["reason"] == "dead_letter"
+    assert recs[-1]["records"] == 2
+    assert isinstance(recs[-2]["metrics"], dict)  # live registry context
+
+
+def test_flight_note_and_dump_never_raise(tmp_path, monkeypatch):
+    """The recorder is the diagnostic of last resort: a broken dump
+    target must warn, not mask the failure being recorded."""
+    import warnings
+
+    fr = T.FlightRecorder(dump_dir=str(tmp_path / "missing" / "deep"))
+    fr.note("compile", {"what": "x"})
+    target = tmp_path / "not-a-dir"
+    target.write_text("file, not dir")
+    fr.dump_dir = str(target)  # makedirs will fail
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter("always")
+        try:
+            fr.dump(reason="r")
+        except Exception as e:  # pragma: no cover
+            pytest.fail(f"dump raised {e!r}")
+    T.flight_note("compile", {"what": "y"})  # module helpers: no raise
+    assert T.flight_dump("manual") is not None
+
+
+# ------------------------------------------------------------ SLO config
+
+
+def test_slo_config_validation():
+    from libpga_tpu import SLOConfig
+
+    SLOConfig()  # all-None = unchecked
+    SLOConfig(p99_latency_ms=10.0, max_queue_wait_ms=0.0, min_samples=1)
+    with pytest.raises(ValueError):
+        SLOConfig(p99_latency_ms=0.0)
+    with pytest.raises(ValueError):
+        SLOConfig(max_queue_wait_ms=-1.0)
+    with pytest.raises(ValueError):
+        SLOConfig(min_samples=0)

@@ -734,3 +734,212 @@ def test_serving_signature_separates_config_changes():
         mutation_rate=0.3,
     )
     assert base.signature(req) == base.signature(r2)
+
+
+# --------------------------------------------- ticket lifecycle (ISSUE 6)
+#
+# Per-ticket latency tracing: every ticket carries monotonic stamps for
+# submit -> bucket-admit -> launch -> run-complete -> readback, the
+# latency() breakdown derives from them, and the queue folds completed
+# tickets into registry histograms + ticket_done events. The dead-letter
+# and solo-requeue paths keep their stamps up to the failure point.
+
+
+def _traced_queue(max_batch=4, slo=None, **serving_kw):
+    from libpga_tpu.utils.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    q = RunQueue(
+        _executor(),
+        serving=ServingConfig(
+            max_batch=max_batch, max_wait_ms=0, **serving_kw
+        ),
+        registry=reg,
+        slo=slo,
+    )
+    return q, reg
+
+
+def test_ticket_lifecycle_monotonic_and_complete():
+    q, reg = _traced_queue(max_batch=3)
+    tickets = [
+        q.submit(RunRequest(size=POP, genome_len=LEN, n=3, seed=i))
+        for i in range(3)
+    ]
+    for t in tickets:
+        t.result(timeout=300)
+        tm = t.timing
+        assert (
+            tm.submitted <= tm.admitted <= tm.launched
+            <= tm.completed <= tm.readback
+        ), tm
+        lat = t.latency()
+        assert set(lat) == {
+            "queue_wait_ms", "execute_ms", "readback_ms", "e2e_ms"
+        }
+        assert all(v is not None and v >= 0.0 for v in lat.values())
+        # e2e covers the component spans (equality up to fp rounding)
+        assert lat["e2e_ms"] >= max(
+            lat["queue_wait_ms"], lat["execute_ms"]
+        ) - 1e-6
+    # histograms saw every ticket; occupancy recorded the full batch
+    assert reg.histogram("serving.ticket.e2e_ms").count == 3
+    assert reg.histogram("serving.batch.occupancy").count == 1
+    assert reg.counter("serving.tickets_done").value == 3
+    q.close()
+
+
+def test_drain_preserves_ticket_timing():
+    """drain() completes the runs without discarding the breakdown:
+    launch/complete are stamped at drain time, readback at result()."""
+    q, _ = _traced_queue(max_batch=64)  # never fills inline
+    t = q.submit(RunRequest(size=POP, genome_len=LEN, n=2, seed=0))
+    assert t.timing.submitted is not None and t.timing.launched is None
+    q.drain()
+    assert t.timing.launched is not None
+    assert t.timing.completed is not None
+    assert t.timing.readback is None  # not read back yet
+    t.result(timeout=300)
+    tm = t.timing
+    assert tm.submitted <= tm.admitted <= tm.launched \
+        <= tm.completed <= tm.readback
+    q.close()
+
+
+def test_dead_letter_ticket_keeps_stamps_to_failure_point():
+    """Satellite: a dead-lettered ticket still carries timestamps up to
+    the failure — submit/admit/launch/complete set, readback never."""
+    q, reg = _traced_queue(max_batch=3)
+    good = [
+        q.submit(RunRequest(size=POP, genome_len=LEN, n=2, seed=i))
+        for i in range(2)
+    ]
+    poisoned = q.submit(RunRequest(
+        size=POP, genome_len=LEN, n=2, seed=9,
+        genomes=np.zeros((4, 4), np.float32),
+    ))
+    q.drain()
+    with pytest.raises(ValueError):
+        poisoned.result(timeout=300)
+    tm = poisoned.timing
+    assert tm.submitted <= tm.admitted <= tm.launched <= tm.completed
+    assert tm.readback is None
+    assert poisoned.latency()["readback_ms"] is None
+    assert poisoned.latency()["e2e_ms"] is not None  # up to completion
+    # the survivors went through the solo-requeue path: restamped
+    # launches still ordered, full breakdown present
+    for t in good:
+        t.result(timeout=300)
+        tm = t.timing
+        assert tm.submitted <= tm.admitted <= tm.launched \
+            <= tm.completed <= tm.readback
+    assert q.requeues == 1 and len(q.dead_letters) == 1
+    assert reg.counter("serving.dead_letters").value == 1
+    assert reg.gauge("serving.dead_letters.pending").value == 1
+    q.close()
+
+
+def test_dead_letter_dumps_flight_recorder(tmp_path, monkeypatch):
+    from libpga_tpu.utils import telemetry as tl
+
+    monkeypatch.setattr(
+        tl, "FLIGHT", tl.FlightRecorder(dump_dir=str(tmp_path))
+    )
+    q, _ = _traced_queue(max_batch=1)
+    t = q.submit(RunRequest(
+        size=POP, genome_len=LEN, n=2, seed=0,
+        genomes=np.zeros((2, 2), np.float32),
+    ))
+    with pytest.raises(ValueError):
+        t.result(timeout=300)
+    assert tl.FLIGHT.dumps, "dead letter did not dump the recorder"
+    recs = tl.validate_log(tl.FLIGHT.dumps[-1])
+    kinds = [r["event"] for r in recs]
+    assert "dead_letter" in kinds
+    assert "metrics_snapshot" in kinds and kinds[-1] == "flight_dump"
+    q.close()
+
+
+def test_ticket_done_and_batch_launch_events_validate(tmp_path):
+    from libpga_tpu.utils import telemetry as tl
+
+    path = str(tmp_path / "events.jsonl")
+    log = tl.EventLog(path)
+    from libpga_tpu.utils.metrics import MetricsRegistry
+
+    q = RunQueue(
+        _executor(), serving=ServingConfig(max_batch=2, max_wait_ms=0),
+        events=log, registry=MetricsRegistry(),
+    )
+    tickets = [
+        q.submit(RunRequest(size=POP, genome_len=LEN, n=2, seed=i))
+        for i in range(2)
+    ]
+    for t in tickets:
+        t.result(timeout=300)
+    q.close()
+    log.close()
+    records = tl.validate_log(path)
+    done = [r for r in records if r["event"] == "ticket_done"]
+    assert len(done) == 2
+    for r in done:
+        assert r["queue_wait_ms"] >= 0 and r["e2e_ms"] >= r["execute_ms"]
+    [launch] = [r for r in records if r["event"] == "batch_launch"]
+    assert launch["fill_ratio"] == 1.0
+
+
+def test_slo_per_ticket_and_aggregate_violations(tmp_path):
+    from libpga_tpu import SLOConfig
+    from libpga_tpu.utils import telemetry as tl
+
+    path = str(tmp_path / "events.jsonl")
+    log = tl.EventLog(path)
+    slo = SLOConfig(
+        p99_latency_ms=1e-4, max_queue_wait_ms=0.0, min_samples=1
+    )
+    from libpga_tpu.utils.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    q = RunQueue(
+        _executor(), serving=ServingConfig(max_batch=2, max_wait_ms=0),
+        events=log, registry=reg, slo=slo,
+    )
+    tickets = [
+        q.submit(RunRequest(size=POP, genome_len=LEN, n=2, seed=i))
+        for i in range(2)
+    ]
+    for t in tickets:
+        t.result(timeout=300)
+    violations = q.check_slo()
+    assert violations and violations[0]["what"] == "p99_latency"
+    # an un-SLO'd queue reports nothing
+    q2 = RunQueue(
+        _executor(), serving=ServingConfig(max_batch=1, max_wait_ms=0),
+        registry=MetricsRegistry(),
+    )
+    assert q2.check_slo() == []
+    q.close()
+    q2.close()
+    log.close()
+    records = tl.validate_log(path)
+    slo_events = [r for r in records if r["event"] == "slo_violation"]
+    whats = {r["what"] for r in slo_events}
+    assert "queue_wait" in whats and "p99_latency" in whats
+    assert reg.counter("serving.slo_violations").value == len(slo_events)
+
+
+def test_queue_depth_and_bucket_gauges_settle_to_zero():
+    q, reg = _traced_queue(max_batch=2)
+    tickets = [
+        q.submit(RunRequest(size=POP, genome_len=LEN, n=2, seed=i))
+        for i in range(2)
+    ]
+    for t in tickets:
+        t.result(timeout=300)
+    assert reg.gauge("serving.queue.depth").value == 0
+    [bucket] = [
+        rec for rec in reg.snapshot()["gauges"]
+        if rec["name"] == "serving.bucket.pending"
+    ]
+    assert bucket["value"] == 0
+    q.close()

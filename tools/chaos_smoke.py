@@ -286,6 +286,11 @@ def main():
     # traceback out of the smoke's output.
     threading.excepthook = lambda args: None
     with tempfile.TemporaryDirectory(prefix="pga-chaos-") as tmp:
+        # Route flight-recorder dumps into the matrix's own tempdir so
+        # the post-mortem gate below inspects THIS run's dumps.
+        from libpga_tpu.utils import telemetry as _tl
+
+        _tl.FLIGHT = _tl.FlightRecorder(dump_dir=tmp)
         ref_g, ref_best = faultfree_supervised(tmp)
         for scenario in (
             scenario_compile_fault,
@@ -296,8 +301,20 @@ def main():
             scenario_dead_letter,
         ):
             scenario(tmp, ref_g, ref_best)
+        # ISSUE 6 acceptance: a chaos run must leave a flight-recorder
+        # dump (the dead-letter scenario triggers one) whose every
+        # record validates against the versioned event schema, with the
+        # metric context + trailer present.
+        assert _tl.FLIGHT.dumps, "chaos matrix produced no flight dump"
+        records = _tl.validate_log(_tl.FLIGHT.dumps[-1])
+        kinds = [r["event"] for r in records]
+        assert "dead_letter" in kinds, kinds
+        assert "metrics_snapshot" in kinds and kinds[-1] == "flight_dump"
     assert faults.PLAN is None, "a scenario leaked an installed fault plan"
-    print("chaos matrix: all scenarios recovered, bit-identical")
+    print(
+        "chaos matrix: all scenarios recovered, bit-identical; "
+        f"flight dump schema-valid ({len(records)} records)"
+    )
 
 
 if __name__ == "__main__":

@@ -31,7 +31,15 @@
 #                                 fault recovers automatically and the
 #                                 recovered run's final best is
 #                                 bit-identical to the fault-free
-#                                 same-seed run.
+#                                 same-seed run;
+#   7. serving observability    — serving bench under --slo, the new
+#                                 ISSUE 6 event kinds (ticket_done /
+#                                 slo_violation / metrics_snapshot /
+#                                 flight_dump) validated against
+#                                 EVENT_FIELDS, a forced dead letter's
+#                                 flight-recorder dump schema-checked,
+#                                 and the Prometheus exposition linted
+#                                 (tools/metrics_dump.py --check).
 # Exits nonzero on the first failing stage.
 set -e
 cd "$(dirname "$0")/.."
@@ -198,5 +206,78 @@ PY
 
 echo "== ci: chaos smoke =="
 JAX_PLATFORMS=cpu python tools/chaos_smoke.py
+
+echo "== ci: serving observability =="
+# ISSUE 6, three gates: (a) the serving bench runs under --slo with
+# generous objectives (the gate's machinery, not this host's speed, is
+# under test); (b) the new event kinds (ticket_done, slo_violation,
+# metrics_snapshot, flight_dump) validate against EVENT_FIELDS and a
+# forced dead letter produces a schema-valid flight-recorder dump; (c)
+# the Prometheus exposition of the live registry passes the
+# line-format lint.
+JAX_PLATFORMS=cpu python tools/serving_throughput.py --pop 512 --len 32 \
+    --gens 4 --batch 8 --rounds 2 --seq-count 1 --slo \
+    --slo-p99-ms 120000 --slo-queue-wait-ms 120000 > /dev/null
+JAX_PLATFORMS=cpu python - <<'PY'
+import sys
+import tempfile
+
+import numpy as np
+
+from libpga_tpu import PGAConfig, ServingConfig, SLOConfig
+from libpga_tpu.serving import BatchedRuns, RunQueue, RunRequest
+from libpga_tpu.utils import telemetry
+
+path = tempfile.mktemp(suffix=".jsonl", prefix="pga-ci-obs-")
+log = telemetry.EventLog(path)
+ex = BatchedRuns("onemax", config=PGAConfig(use_pallas=False), events=log)
+q = RunQueue(
+    ex, serving=ServingConfig(max_batch=3, max_wait_ms=0), events=log,
+    slo=SLOConfig(p99_latency_ms=0.001, max_queue_wait_ms=0.0,
+                  min_samples=1),
+)
+tickets = [
+    q.submit(RunRequest(size=256, genome_len=16, n=3, seed=i))
+    for i in range(2)
+]
+poisoned = q.submit(RunRequest(
+    size=256, genome_len=16, n=3, seed=9,
+    genomes=np.zeros((4, 4), np.float32),
+))
+q.drain()
+for t in tickets:
+    t.result(timeout=300)
+    tm = t.timing
+    if not (tm.submitted <= tm.admitted <= tm.launched
+            <= tm.completed <= tm.readback):
+        sys.exit(f"non-monotonic ticket lifecycle: {t.latency()}")
+try:
+    poisoned.result(timeout=300)
+    sys.exit("poisoned request did not dead-letter")
+except ValueError:
+    pass
+q.check_slo()
+q.close()
+log.close()
+
+records = telemetry.validate_log(path)
+kinds = {r["event"] for r in records}
+need = {"ticket_done", "slo_violation", "dead_letter"}
+missing = need - kinds
+if missing:
+    sys.exit(f"event log missing kinds: {sorted(missing)}")
+if not telemetry.FLIGHT.dumps:
+    sys.exit("dead letter produced no flight-recorder dump")
+dump = telemetry.validate_log(telemetry.FLIGHT.dumps[-1])
+dump_kinds = [r["event"] for r in dump]
+if "metrics_snapshot" not in dump_kinds or dump_kinds[-1] != "flight_dump":
+    sys.exit(f"flight dump malformed: kinds {dump_kinds}")
+print(
+    f"serving observability OK: {len(records)} events "
+    f"({sorted(kinds)}), flight dump {len(dump)} records"
+)
+PY
+JAX_PLATFORMS=cpu python tools/metrics_dump.py --demo --check > /dev/null
+echo "prometheus exposition lint OK"
 
 echo "== ci: all stages passed =="

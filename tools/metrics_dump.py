@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Metrics exporter / linter CLI (ISSUE 6).
+
+Three modes:
+
+  --demo            run a small serving workload, then export the live
+                    process registry (default mode when no snapshot is
+                    given): ``--format prom`` (default) writes the
+                    Prometheus text exposition, ``--format json`` the
+                    JSON snapshot.
+  --snapshot F      re-render a previously saved JSON snapshot (from
+                    ``--format json``, ``pga_metrics_snapshot``, or a
+                    flight-recorder ``metrics_snapshot`` record) as
+                    Prometheus text — the offline-collector path.
+  --check [F]       line-format lint a Prometheus exposition (from a
+                    file or stdin with ``-``; with no argument, lints
+                    what the current mode would have printed). Exits
+                    nonzero listing the problems — the ``tools/ci.sh``
+                    gate that keeps ``to_prometheus`` scrape-able.
+
+Examples:
+
+    JAX_PLATFORMS=cpu python tools/metrics_dump.py --demo
+    python tools/metrics_dump.py --demo --format json > snap.json
+    python tools/metrics_dump.py --snapshot snap.json
+    python tools/metrics_dump.py --demo | python tools/metrics_dump.py --check -
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def run_demo() -> None:
+    """A tiny serving workload so the registry has every series kind:
+    ticket latency histograms, occupancy, cache gauges, counters."""
+    from libpga_tpu import PGAConfig, ServingConfig
+    from libpga_tpu.serving import BatchedRuns, RunQueue, RunRequest
+
+    ex = BatchedRuns("onemax", config=PGAConfig(use_pallas=False))
+    with RunQueue(
+        ex, serving=ServingConfig(max_batch=4, max_wait_ms=0)
+    ) as q:
+        tickets = [
+            q.submit(
+                RunRequest(size=256, genome_len=16, n=3, seed=i)
+            )
+            for i in range(6)
+        ]
+        q.drain()
+        for t in tickets:
+            t.result(timeout=300)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--demo", action="store_true",
+                    help="run a small serving workload first")
+    ap.add_argument("--snapshot", metavar="F",
+                    help="render a saved JSON snapshot instead of the "
+                         "live registry")
+    ap.add_argument("--format", choices=("prom", "json"), default="prom")
+    ap.add_argument("--check", nargs="?", const="", metavar="F",
+                    help="lint a Prometheus exposition (file, '-' for "
+                         "stdin, or the current output when omitted)")
+    args = ap.parse_args()
+
+    from libpga_tpu.utils import metrics as M
+
+    if args.check not in (None, ""):
+        text = (
+            sys.stdin.read() if args.check == "-"
+            else Path(args.check).read_text()
+        )
+        errors = M.lint_prometheus(text)
+        for e in errors:
+            print(f"metrics_dump: {e}", file=sys.stderr)
+        print(
+            f"metrics_dump: {'FAIL' if errors else 'OK'} "
+            f"({len(text.splitlines())} lines, {len(errors)} problems)"
+        )
+        return 1 if errors else 0
+
+    if args.snapshot:
+        snap = json.loads(Path(args.snapshot).read_text())
+    else:
+        if args.demo:
+            run_demo()
+        snap = M.REGISTRY.snapshot()
+
+    if args.format == "json":
+        out = json.dumps(snap, indent=2, sort_keys=True)
+    else:
+        out = M.prometheus_text(snap)
+
+    if args.check is not None:  # bare --check: lint our own output
+        errors = M.lint_prometheus(
+            out if args.format == "prom" else M.prometheus_text(snap)
+        )
+        for e in errors:
+            print(f"metrics_dump: {e}", file=sys.stderr)
+        if errors:
+            return 1
+
+    print(out, end="" if out.endswith("\n") else "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,12 +52,29 @@ def main() -> None:
         "--layout", default=None, choices=[None, "run_major", "lockstep"],
         help="mega-run layout (default: ServingConfig auto)",
     )
+    ap.add_argument(
+        "--slo", action="store_true",
+        help="gate the run on the latency SLO: exit 1 when the "
+             "per-ticket p99 or queue-wait objective is breached "
+             "(ISSUE 6 — the CI/SLO entry point)",
+    )
+    ap.add_argument(
+        "--slo-p99-ms", type=float, default=5000.0,
+        help="aggregate objective: p99 end-to-end ticket latency (ms)",
+    )
+    ap.add_argument(
+        "--slo-queue-wait-ms", type=float, default=1000.0,
+        help="per-ticket objective: max queue wait (ms)",
+    )
     args = ap.parse_args()
 
     import jax
 
-    from libpga_tpu import PGA, PGAConfig
-    from libpga_tpu.serving import COUNTERS, BatchedRuns, RunRequest
+    from libpga_tpu import PGA, PGAConfig, ServingConfig, SLOConfig
+    from libpga_tpu.serving import (
+        COUNTERS, BatchedRuns, RunQueue, RunRequest,
+    )
+    from libpga_tpu.utils import metrics as _metrics
 
     from libpga_tpu.ops.mutate import make_point_mutate
 
@@ -117,6 +134,40 @@ def main() -> None:
         samples["seq_warm"].append(1 / (time.perf_counter() - t0))
         speedups.append(samples["batched"][-1] / samples["seq_fresh"][-1])
 
+    # ------------------------------------------------- latency round
+    # One batch through the async queue: tickets carry the full
+    # submit -> admit -> launch -> complete -> readback breakdown; a
+    # PRIVATE registry so the percentiles describe exactly this round.
+    reg = _metrics.MetricsRegistry()
+    slo = SLOConfig(
+        p99_latency_ms=args.slo_p99_ms,
+        max_queue_wait_ms=args.slo_queue_wait_ms,
+        min_samples=min(args.batch, 20),
+    )
+    queue = RunQueue(
+        ex,
+        serving=ServingConfig(max_batch=args.batch, max_wait_ms=0),
+        slo=slo,
+        registry=reg,
+    )
+    tickets = [
+        queue.submit(RunRequest(
+            size=args.pop, genome_len=args.genome_len, n=args.gens,
+            seed=seed, mutation_rate=rate,
+        ))
+        for seed, rate in sweep(args.batch, 90_000)
+    ]
+    queue.drain()
+    for t in tickets:
+        t.result(timeout=600)
+    e2e = reg.histogram("serving.ticket.e2e_ms").snapshot()
+    qwait = reg.histogram("serving.ticket.queue_wait_ms").snapshot()
+    violations = queue.check_slo()
+    per_ticket_violations = int(
+        reg.counter("serving.slo_violations").value
+    ) - len(violations)
+    queue.close()
+
     med = {k: statistics.median(v) for k, v in samples.items()}
     print(
         json.dumps(
@@ -136,6 +187,15 @@ def main() -> None:
                 "speedup_vs_warm": round(
                     med["batched"] / med["seq_warm"], 2
                 ),
+                "latency_p50_ms": round(e2e.p50, 3),
+                "latency_p99_ms": round(e2e.p99, 3),
+                "queue_wait_p50_ms": round(qwait.p50, 3),
+                "queue_wait_p99_ms": round(qwait.p99, 3),
+                "slo_checked": bool(args.slo),
+                "slo_p99_limit_ms": args.slo_p99_ms,
+                "slo_queue_wait_limit_ms": args.slo_queue_wait_ms,
+                "slo_violations": violations,
+                "slo_per_ticket_violations": per_ticket_violations,
                 "cache_counters": {
                     k: v
                     for k, v in COUNTERS.snapshot().items()
@@ -144,6 +204,16 @@ def main() -> None:
             }
         )
     )
+    if args.slo and (violations or per_ticket_violations):
+        print(
+            f"SLO BREACHED: {len(violations)} aggregate + "
+            f"{per_ticket_violations} per-ticket violations "
+            f"(p99 {e2e.p99:.1f}ms vs {args.slo_p99_ms}ms, "
+            f"queue-wait p99 {qwait.p99:.1f}ms vs "
+            f"{args.slo_queue_wait_ms}ms)",
+            file=sys.stderr,
+        )
+        sys.exit(1)
 
 
 if __name__ == "__main__":
