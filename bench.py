@@ -79,6 +79,16 @@ GENOME_LEN = 100
 SERVING_POP = 1 << 14  # 16,384
 SERVING_GENS = 10
 SERVING_WIDTHS = (1, 8, 32)
+
+# Population-sharding arm (ISSUE 7): one SHARDED_POP x SHARDED_LEN
+# OneMax population split SHARDED_SHARDS ways (parallel/shard_pop.py),
+# A/B'd against the collective-ablated loop (the same program minus the
+# per-generation all_gather) and the unsharded engine path — so the
+# one-collective-per-generation cost model is tracked from day one.
+SHARDED_POP = 1 << 16  # 65,536
+SHARDED_LEN = 64
+SHARDED_SHARDS = 4
+SHARDED_GENS_PER_CALL = 10
 V5E_BF16_PEAK = 197e12  # TPU v5e: 197 TFLOP/s bf16 per chip
 V5E_HBM_PEAK = 819e9  # TPU v5e: 819 GB/s HBM bandwidth per chip
 
@@ -504,6 +514,128 @@ def serving_arm(rounds: int = ROUNDS) -> dict:
     return out
 
 
+def sharded_arm(rounds: int = ROUNDS, shards: int = SHARDED_SHARDS) -> dict:
+    """The permanent population-sharding A/B (ISSUE 7): gens/sec of a
+    SHARDED_POP x SHARDED_LEN OneMax run with the population axis split
+    ``shards`` ways, measured three ways ADJACENT per round (the
+    interleaved protocol every arm uses):
+
+    - ``sharded_gens_per_sec`` — the full sharded loop (one ppermute +
+      one all_gather per generation);
+    - ``shard_allreduce_pct`` — per-round overhead of the
+      per-generation all-gather, from the ablate=("sync",) loop (the
+      identical program minus the rank-threshold collective — the
+      component isolation tools/ablate_floor.py applies to kernels);
+    - ``sharded_vs_single_ratio`` — against the unsharded engine path
+      at the same shape (NOTE: on a single-socket CPU host all shards
+      timeshare one core, so this ratio measures sharding OVERHEAD,
+      not speedup; cross-device scaling is a chip-round measurement).
+
+    Needs ``shards`` visible devices; returns a skip note otherwise
+    (the TPU bench on a single chip skips, the CPU harness forces a
+    multi-device platform in ``sharded_main``)."""
+    import jax
+    import jax.numpy as jnp
+
+    if len(jax.devices()) < shards:
+        return {
+            "sharded_note": (
+                f"sharded arm skipped: {len(jax.devices())} device(s) "
+                f"< pop_shards={shards}"
+            )
+        }
+    from libpga_tpu import PGA, PGAConfig
+    from libpga_tpu.parallel import shard_pop as _sp
+    from libpga_tpu.parallel.islands import _shard_host_array
+    from libpga_tpu.parallel.mesh import pop_sharding
+    from libpga_tpu.objectives import get as get_obj
+    from libpga_tpu.ops.crossover import uniform_crossover
+    from libpga_tpu.ops.mutate import make_point_mutate
+    from libpga_tpu.ops.step import make_breed
+    from libpga_tpu.utils.profiling import best_ms_per_unit
+
+    obj = get_obj("onemax")
+    breed = make_breed(
+        uniform_crossover, make_point_mutate(0.01), tournament_size=2
+    )
+
+    def local_step(g, s, sub, mparams, gen):
+        del mparams, gen
+        return breed(g, s, sub), None
+
+    def build(ablate):
+        return _sp.make_sharded_run(
+            obj, local_step, SHARDED_POP, SHARDED_LEN, shards,
+            donate=False, ablate=ablate,
+        )
+
+    full = build(())
+    nosync = build(("sync",))
+    genomes0 = jax.random.uniform(
+        jax.random.key(11), (SHARDED_POP, SHARDED_LEN), dtype=jnp.float32
+    )
+    placed = _shard_host_array(genomes0, pop_sharding(full.mesh))
+    mparams = jnp.asarray([[0.01, 0.0]], dtype=jnp.float32)
+    T = SHARDED_GENS_PER_CALL
+
+    def runner(fn):
+        def run(calls):
+            out = None
+            for _ in range(calls):
+                out = fn(
+                    placed, jax.random.key(3), jnp.int32(T),
+                    jnp.float32(jnp.inf), mparams,
+                )
+            jax.block_until_ready(out)
+
+        return run
+
+    run_full, run_nosync = runner(full), runner(nosync)
+    single = PGA(seed=11, config=PGAConfig(use_pallas=False,
+                                           donate_buffers=False))
+    single.create_population(SHARDED_POP, SHARDED_LEN)
+    single.set_objective("onemax")
+
+    def run_single(calls):
+        for _ in range(calls):
+            single.run(T)
+
+    # warm-up: compile every arm before any timed round
+    run_full(1), run_nosync(1), run_single(1)
+
+    ms_full, ms_nosync, ratios, pcts = [], [], [], []
+    for _ in range(rounds):
+        f = best_ms_per_unit(run_full, 2, 6, units_per_call=T)
+        ns = best_ms_per_unit(run_nosync, 2, 6, units_per_call=T)
+        sg = best_ms_per_unit(run_single, 2, 6, units_per_call=T)
+        ms_full.append(f)
+        ms_nosync.append(ns)
+        pcts.append((f - ns) / f * 100.0)
+        ratios.append(sg / f)  # >1 = sharded faster than single
+    med_ms, iqr_ms = _median_iqr(ms_full)
+    pct_med, pct_iqr = _median_iqr(pcts)
+    ratio_med, _ = _median_iqr(ratios)
+    return {
+        "sharded_pop_shards": shards,
+        "sharded_shape": f"{SHARDED_POP}x{SHARDED_LEN}",
+        "sharded_gens_per_sec": round(1000.0 / med_ms, 2),
+        "sharded_gens_per_sec_iqr": round(
+            abs(1000.0 / (med_ms + iqr_ms / 2)
+                - 1000.0 / max(med_ms - iqr_ms / 2, 1e-9)), 2
+        ),
+        "shard_allreduce_pct": round(pct_med, 2),
+        "shard_allreduce_pct_iqr": round(pct_iqr, 2),
+        "sharded_vs_single_ratio": round(ratio_med, 3),
+        "sharded_note": (
+            "shard_allreduce_pct is the full-vs-ablated('sync') "
+            "interleaved A/B; on CPU hosts all shards timeshare one "
+            "socket, so a pct within the IQR means the all-gather "
+            "cost is below this host's drift floor — re-measure on a "
+            "chip round for the cross-device number"
+        ),
+    }
+
+
 def supervised_arm(rounds: int = ROUNDS) -> dict:
     """The permanent supervisor-overhead A/B (ISSUE 5): ms/run of a
     SERVING_POP x GENOME_LEN OneMax run of SERVING_GENS generations —
@@ -730,10 +862,12 @@ def main() -> None:
         "evaluation are real kernel work the model excludes; gens/sec is "
         "the headline metric"
     )
-    # Permanent serving + supervised arms (ISSUE 4 / ISSUE 5) —
-    # backend-agnostic, so they ride every bench run, chip or CPU.
+    # Permanent serving + supervised + sharded arms (ISSUE 4 / 5 / 7)
+    # — backend-agnostic, so they ride every bench run, chip or CPU
+    # (the sharded arm skips itself below its device requirement).
     out.update(serving_arm())
     out.update(supervised_arm())
+    out.update(sharded_arm())
     print(json.dumps(out))
 
 
@@ -762,6 +896,32 @@ def supervised_main() -> None:
     print(json.dumps(out))
 
 
+def sharded_main() -> None:
+    """``python bench.py --pop-shards [S]``: the population-sharding
+    arm alone (ISSUE 7). On CPU hosts the multi-device platform is
+    forced BEFORE backend init so the S-way mesh exists; the
+    gens/sec figure is CPU-decision-grade for the OVERHEAD model
+    (collective cost), not for cross-device scaling (all shards
+    timeshare this host's core — see sharded_arm)."""
+    import sys
+
+    shards = SHARDED_SHARDS
+    argv = sys.argv[1:]
+    i = argv.index("--pop-shards")
+    if i + 1 < len(argv) and argv[i + 1].isdigit():
+        shards = int(argv[i + 1])
+    from libpga_tpu.utils.compat import force_cpu_device_count
+
+    force_cpu_device_count(max(shards, 1))
+    cache_dir = enable_persistent_cache()
+    out = {
+        **provenance(cache_dir),
+        "metric": f"sharded_gens_per_sec_{SHARDED_POP}x{SHARDED_LEN}",
+        **sharded_arm(shards=shards),
+    }
+    print(json.dumps(out))
+
+
 if __name__ == "__main__":
     import sys
 
@@ -769,5 +929,7 @@ if __name__ == "__main__":
         serving_main()
     elif "--supervised" in sys.argv[1:]:
         supervised_main()
+    elif "--pop-shards" in sys.argv[1:]:
+        sharded_main()
     else:
         main()

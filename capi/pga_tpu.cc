@@ -357,6 +357,12 @@ int pga_set_telemetry(pga_t *p, unsigned max_gens) {
         call_long("set_telemetry", "(lI)", solver_of(p), max_gens));
 }
 
+int pga_set_pop_shards(pga_t *p, unsigned shards) {
+    if (!p) return -1;
+    return static_cast<int>(
+        call_long("set_pop_shards", "(lI)", solver_of(p), shards));
+}
+
 float *pga_get_history(pga_t *p, population_t *pop, unsigned *rows,
                        unsigned *cols) {
     if (!p || !pop) return nullptr;

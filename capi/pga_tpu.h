@@ -302,6 +302,20 @@ int pga_set_telemetry(pga_t *p, unsigned max_gens);
 float *pga_get_history(pga_t *p, population_t *pop, unsigned *rows,
                        unsigned *cols);
 
+/* Population sharding (no reference analog — the reference caps every
+ * run at one GPU's memory). pga_set_pop_shards splits the POPULATION
+ * AXIS of subsequent pga_run calls across `shards` mesh devices: each
+ * shard breeds its local rows with the normal operator stack, and
+ * exactly one cross-shard collective pair per generation (a comb-slab
+ * ppermute plus an all-gather of shards x max(1, elitism) fitness
+ * scalars) keeps the run panmictic-equivalent — see the library's
+ * "Giant populations" documentation. shards=1 restores the unsharded
+ * path (byte-identical program). The population size must be divisible
+ * by shards^2 and shards must not exceed the visible devices; an
+ * inadmissible value fails at the next pga_run. Returns 0, -1 on
+ * error. */
+int pga_set_pop_shards(pga_t *p, unsigned shards);
+
 /* ---- Async batched serving (no reference analog) ----------------------
  *
  * pga_submit admits an asynchronous run of the solver's first

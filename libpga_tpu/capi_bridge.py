@@ -1022,6 +1022,25 @@ def set_telemetry(handle: int, max_gens: int) -> None:
         pga.config = dataclasses.replace(pga.config, telemetry=tel)
 
 
+def set_pop_shards(handle: int, shards: int) -> None:
+    """``pga_set_pop_shards``: split subsequent ``pga_run`` calls'
+    population axis across ``shards`` mesh devices
+    (``parallel/shard_pop.py``); 1 restores the unsharded
+    byte-identical path. Validation of the population-size
+    admissibility (``shards² | pop``, shards <= devices) happens at
+    the next run, where the shape is known — an out-of-range value
+    here fails fast."""
+    import dataclasses
+
+    if shards < 1:
+        raise ValueError("pop_shards must be >= 1")
+    pga = _solver(handle)
+    if pga.config.pop_shards != int(shards):
+        pga.config = dataclasses.replace(
+            pga.config, pop_shards=int(shards)
+        )
+
+
 def history_cols() -> int:
     from libpga_tpu.utils.telemetry import NUM_STATS
 

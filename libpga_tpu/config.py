@@ -97,6 +97,22 @@ class PGAConfig:
         default until the hardware A/B in tools/ablate_floor.py rules);
         ignored by the multi-generation kernel, which keeps its deme
         group VMEM-resident instead.
+      pop_shards: split the POPULATION AXIS of each ``run`` across this
+        many mesh devices via ``shard_map`` (``parallel/shard_pop.py``
+        — ROADMAP item 2, "giant populations"). Each shard breeds its
+        local rows with the existing machinery; cross-shard comb
+        mixing (one ``ppermute``) plus global rank thresholds (one
+        ``all_gather`` of S·k scalars) keep the run panmictic-
+        equivalent at exactly one cross-shard collective pair per
+        generation. 1 (default) = the unsharded path, byte-identical
+        StableHLO to the pre-sharding code. Requires ``S² | pop`` and
+        S <= devices (``shard_pop.validate_shards`` names the valid
+        counts); sharded elitism is global (rank-threshold based) and
+        selection cohorts are per-shard — measured panmictic-
+        equivalent, see README "Giant populations". Applies to
+        ``run`` only: ``run_islands`` already shards the ISLAND axis
+        via its ``mesh`` argument (composing both axes is ROADMAP
+        work).
       donate_buffers: donate the genome buffer to jit so XLA updates it in
         place (the TPU-native replacement for the reference's
         current/next-generation pointer swap, ``pga.h:124-129``).
@@ -141,6 +157,7 @@ class PGAConfig:
     pallas_generations_per_launch: Optional[int] = None
     pallas_layout: Optional[str] = None
     pallas_subblock: Optional[int] = None
+    pop_shards: int = 1
     donate_buffers: bool = True
     validate: bool = False
     fallback: str = "xla"
@@ -160,6 +177,7 @@ class PGAConfig:
             self.tournament_size, self.selection, self.selection_param,
             self.elitism, self.pallas_generations_per_launch,
             self.pallas_layout, self.pallas_subblock,
+            self.pop_shards,
             None if self.telemetry is None else self.telemetry.history_gens,
         )
 
@@ -194,6 +212,8 @@ class PGAConfig:
             )
         if self.pallas_subblock is not None and self.pallas_subblock < 1:
             raise ValueError("pallas_subblock must be >= 1")
+        if self.pop_shards < 1:
+            raise ValueError("pop_shards must be >= 1")
         if self.fallback not in ("xla", "raise"):
             raise ValueError("fallback must be 'xla' or 'raise'")
 

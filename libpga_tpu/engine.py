@@ -887,6 +887,13 @@ class PGA:
         T-1 high) and a mid-launch achiever is preserved by the
         kernel's group freeze.
         """
+        if self.config.pop_shards > 1:
+            # Giant populations (ROADMAP 2): the population axis splits
+            # across the device mesh. pop_shards=1 (the default) never
+            # reaches the sharded path — the code below is byte-for-byte
+            # the pre-sharding run loop (tests/test_shard_pop.py pins
+            # its StableHLO).
+            return self._run_sharded(n, target, population)
         handle = population or PopulationHandle(0)
         pop = self._populations[handle.index]
         fn, pallas_key = self._compiled_run_meta(pop.size, pop.genome_len)
@@ -953,6 +960,192 @@ class PGA:
             self._emit(
                 "run_end", generations=gens, seconds=seconds,
                 best=float(jnp.max(scores)),
+            )
+        self._check_stall_alert(hist)
+        return gens
+
+    # ------------------------------------------------- sharded population run
+
+    def _sharded_local_step(self, shard_size: int, genome_len: int):
+        """The per-shard breeding step of the sharded run loop:
+        ``(g, s, sub, mparams, gen) -> (g2, s2 | None)``. On TPU a
+        per-shard fused ping-pong breed (parity alternated on the
+        generation counter, exactly like the single-device run loop);
+        everywhere else the XLA breed built WITHOUT elitism — the
+        sharded loop applies GLOBAL elitism through the gathered rank
+        thresholds (``parallel/shard_pop.py``), so the local step must
+        not also carry local elites."""
+        if self._pallas_gate():
+            pallas_kind = self._mutate_kind()
+            obj = self._require_objective()
+            fused = getattr(obj, "kernel_rowwise", None)
+            if fused is not None:
+                from libpga_tpu.ops.pallas_step import make_pallas_breed
+
+                try:
+                    breed = make_pallas_breed(
+                        shard_size, genome_len,
+                        deme_size=self.config.pallas_deme_size,
+                        tournament_size=self.config.tournament_size,
+                        selection_kind=self.config.selection,
+                        selection_param=self.config.selection_param,
+                        mutation_rate=self._mutation_rate(),
+                        mutation_sigma=self._operator_param("sigma", 0.0),
+                        crossover_kind=self._crossover_kind(),
+                        mutate_kind=pallas_kind,
+                        elitism=0,  # global elitism lives in the loop
+                        fused_obj=fused,
+                        fused_consts=tuple(
+                            getattr(obj, "kernel_rowwise_consts", ())
+                        ),
+                        gene_dtype=self.config.gene_dtype,
+                        _layout=self.config.pallas_layout,
+                        _subblock=self.config.pallas_subblock,
+                    )
+                except Exception as e:
+                    if self.config.fallback == "raise":
+                        raise
+                    self._degrade(
+                        "sharded kernel build", e, shard_size=shard_size,
+                        genome_len=genome_len,
+                    )
+                    breed = None
+                # Per-shard padding inside shard_map would re-pad every
+                # generation — only the exact-fit kernel rides the
+                # sharded loop; padded shapes take the XLA local step.
+                if (
+                    breed is not None
+                    and getattr(breed, "fused", False)
+                    and breed.Pp == shard_size and breed.Lp == genome_len
+                ):
+                    parities = getattr(breed, "parities", 1)
+
+                    def local_step(g, s, sub, mparams, gen):
+                        if parities > 1:
+                            return jax.lax.cond(
+                                jnp.equal(gen & 1, 0),
+                                lambda a: breed.padded(*a, parity=0),
+                                lambda a: breed.padded(*a, parity=1),
+                                (g, s, sub, mparams),
+                            )
+                        return breed.padded(g, s, sub, mparams)
+
+                    return local_step
+
+        breed0 = make_breed(
+            self._crossover,
+            self._mutate,
+            tournament_size=self.config.tournament_size,
+            selection_kind=self.config.selection,
+            selection_param=self.config.selection_param,
+            elitism=0,  # global elitism lives in the sharded loop
+        )
+
+        def local_step(g, s, sub, mparams, gen):
+            del mparams, gen  # engine operators bake their parameters
+            return breed0(g, s, sub), None
+
+        return local_step
+
+    def _compiled_sharded_run(self, size: int, genome_len: int):
+        """Cached sharded run loop for one shape (``pop_shards`` > 1):
+        the shard_map program of ``parallel/shard_pop.make_sharded_run``
+        over this engine's operators. Raises ValueError (naming the
+        valid shard counts) for an inadmissible ``pop_shards``."""
+        from libpga_tpu.parallel import shard_pop as _sp
+
+        obj = self._require_objective()
+        S = self.config.pop_shards
+        _sp.validate_shards(size, S)
+        hist_gens = self._history_gens()
+        cache_key = (
+            "engine/run-sharded", S, size, genome_len, obj,
+            self._crossover, self._mutate,
+            self.config.tournament_size, self.config.elitism,
+            self.config.selection, self.config.selection_param,
+            self.config.pallas_layout, self.config.pallas_subblock,
+            hist_gens,
+        )
+        fn = self._compiled.get(cache_key)
+        if fn is None:
+            self._emit(
+                "compile", what="run_sharded", population_size=size,
+                genome_len=genome_len, pop_shards=S,
+            )
+            fn = _sp.make_sharded_run(
+                obj,
+                self._sharded_local_step(size // S, genome_len),
+                size,
+                genome_len,
+                S,
+                elitism=self.config.elitism,
+                history_gens=hist_gens,
+                donate=self.config.donate_buffers,
+            )
+            self._compiled[cache_key] = fn
+        return fn
+
+    def _run_sharded(
+        self, n: int, target: Optional[float],
+        population: Optional[PopulationHandle],
+    ) -> int:
+        """``run()`` with the population axis sharded S ways (see
+        ``parallel/shard_pop.py``). Same contract and side effects as
+        the unsharded path: installs the bred population (as ONE
+        logical global array, rows sharded over the mesh), records
+        telemetry history, fires the same events plus one
+        ``shard_sync`` describing the per-generation collective pair."""
+        handle = population or PopulationHandle(0)
+        pop = self._populations[handle.index]
+        fn = self._compiled_sharded_run(pop.size, pop.genome_len)
+        tgt = jnp.float32(jnp.inf if target is None else target)
+        self._emit(
+            "run_start", population_size=pop.size,
+            genome_len=pop.genome_len, n=int(n),
+            target=None if target is None else float(target),
+            pop_shards=fn.shards,
+        )
+        self._emit(
+            "shard_sync", shards=fn.shards, topk=fn.k_sync,
+            mix_rows=fn.mix,
+        )
+        # Same "objective.eval" fault site as the unsharded run (see
+        # there): raise fires before any key consumption or donation.
+        nan_storm = (
+            _faults.PLAN is not None and _faults.PLAN.fire("objective.eval")
+        )
+        t0 = time.perf_counter()
+        from libpga_tpu.parallel.islands import _shard_host_array
+        from libpga_tpu.parallel.mesh import pop_sharding
+
+        genomes = _shard_host_array(pop.genomes, pop_sharding(fn.mesh))
+        args = (
+            genomes, self.next_key(), jnp.int32(n), tgt,
+            self._mutate_params(),
+        )
+        with _tl.span("run"):
+            out = fn(*args)
+        genomes, scores, gens_done = out[:3]
+        if nan_storm:
+            scores = jnp.full_like(scores, jnp.nan)
+        gens = int(gens_done)
+        self._populations[handle.index] = Population(
+            genomes=genomes, scores=scores
+        )
+        self._staged[handle.index] = None
+        hist = None
+        if len(out) > 3:
+            hist = _tl.History(out[3], gens)
+        self._history[handle.index] = hist
+        self._validate("run", [handle.index])
+        seconds = time.perf_counter() - t0
+        self.metrics.record_run(gens, pop.size, seconds)
+        if self._event_log() is not None:
+            from libpga_tpu.parallel.mesh import global_max
+
+            self._emit(
+                "run_end", generations=gens, seconds=seconds,
+                best=float(global_max(scores, fn.mesh)),
             )
         self._check_stall_alert(hist)
         return gens

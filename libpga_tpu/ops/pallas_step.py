@@ -164,6 +164,21 @@ def _validate_ablate(ablate) -> tuple:
 # even-group residues and middle index "bits" are never regrouped
 # (provably disconnected for power-of-two shapes even with the write
 # interleave at D = 1).
+#
+# ONE LEVEL UP (ISSUE 7): the same algebra extends over POPULATION
+# SHARDS — ``parallel/shard_pop.py`` runs this kernel unchanged on
+# each shard's local (P/S, L) block (every function below is already
+# parameterized by the per-shard population, and a shard only ever
+# writes its own rows, so the aliasing license is untouched), and the
+# odd-parity comb STRIDE becomes a cross-shard ``ppermute``: the
+# stride-S row comb of fresh children hops the shard ring each
+# generation with the same u·D+d cross-chunk interleave. The comb
+# property is load-bearing at that level too: a CONTIGUOUS migrating
+# slab starves the parity-0 groups that don't intersect it (simulated
+# deme-path takeover ~3× slower — the shard-level rerun of exactly
+# the closed-super-block failure described above), while the stride-S
+# comb touches every group. tools/selection_equivalence.py --simulate
+# --pop-shards S guards the composition.
 # ---------------------------------------------------------------------
 
 

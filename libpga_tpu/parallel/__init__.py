@@ -13,13 +13,20 @@ ring migration is a ``lax.ppermute`` neighbor exchange that rides ICI
 ``all_gather`` of the (small) emigrant sets plus a shared permutation.
 """
 
-from libpga_tpu.parallel.mesh import default_mesh, island_sharding
+from libpga_tpu.parallel.mesh import (
+    default_mesh,
+    island_sharding,
+    pop_mesh,
+    pop_sharding,
+)
 from libpga_tpu.parallel.islands import run_islands_stacked, make_island_epoch
 from libpga_tpu.parallel import distributed
 
 __all__ = [
     "default_mesh",
     "island_sharding",
+    "pop_mesh",
+    "pop_sharding",
     "run_islands_stacked",
     "make_island_epoch",
     "distributed",

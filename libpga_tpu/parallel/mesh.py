@@ -8,6 +8,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 ISLAND_AXIS = "islands"
+POP_AXIS = "pop"
 
 
 def default_mesh(
@@ -27,6 +28,32 @@ def island_sharding(mesh: Mesh, axis_name: str = ISLAND_AXIS) -> NamedSharding:
     """Sharding for a stacked ``(islands, size, genome_len)`` array:
     islands split across the mesh, genomes local to a core."""
     return NamedSharding(mesh, P(axis_name, None, None))
+
+
+def pop_mesh(
+    shards: int,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_name: str = POP_AXIS,
+) -> Mesh:
+    """A 1-D ``shards``-way mesh over the POPULATION axis of one run
+    (``parallel/shard_pop.py``): the first ``shards`` devices, one
+    population shard per device. Distinct axis name from the island
+    mesh so a future 2-D (islands × pop) layout composes."""
+    import numpy as np
+
+    devs = list(devices if devices is not None else jax.devices())
+    if shards > len(devs):
+        raise ValueError(
+            f"pop_shards={shards} exceeds the {len(devs)} available "
+            "devices"
+        )
+    return Mesh(np.asarray(devs[:shards]), axis_names=(axis_name,))
+
+
+def pop_sharding(mesh: Mesh, axis_name: str = POP_AXIS) -> NamedSharding:
+    """Sharding for one ``(pop, genome_len)`` population: rows split
+    across the mesh axis, genes local to a device."""
+    return NamedSharding(mesh, P(axis_name, None))
 
 
 def global_max(arr, mesh: Optional[Mesh] = None) -> float:

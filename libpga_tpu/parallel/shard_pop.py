@@ -1,0 +1,397 @@
+"""Population sharding: ONE panmictic-equivalent population across the
+device mesh (ROADMAP item 2, ISSUE 7).
+
+Everything before this module fits a single device's memory — islands
+were the only multi-device story, so the largest servable tenant was
+capped by single-device HBM. Here the POPULATION AXIS of a single run is
+split S ways via ``shard_map``: each shard runs the existing breed
+machinery (the fused ping-pong deme kernel on TPU, the XLA breed
+elsewhere) over its LOCAL rows, and three cross-shard mechanisms keep
+the run globally panmictic-equivalent at a cost of exactly ONE
+cross-shard collective pair per generation:
+
+1. **Comb mixing (one ``ppermute``)** — the round-8 ping-pong comb
+   algebra extended over shards: the odd-parity comb STRIDE becomes a
+   cross-shard permute. Each generation, every shard ships the
+   ``mix = P/S²`` children sitting at stride-S row positions (rows
+   0, S, 2S, … — a comb across the WHOLE shard, so every deme group
+   of the in-shard layout contributes) one hop around the shard ring,
+   and the received comb lands CROSS-DEME INTERLEAVED (comb slot
+   ``d·C + u`` lands at slot ``u·D + d`` — the same ``u*D+d`` write
+   interleave that makes the in-shard parity pair mix, see
+   ``ops/pallas_step.py``). The hop is the ring specialization of the
+   comb's ``(s+u) mod S`` stride family with a STATIC permutation
+   (``ppermute`` perms cannot be traced); because every comb row is a
+   fresh child of the WHOLE local shard (local selection is panmictic
+   within the shard), one hop per generation spreads any lineage
+   across all S shards in at most S generations, and because the comb
+   is spread across every deme group, the composition with the
+   in-shard ping-pong layout mixes too (a CONTIGUOUS migration slab
+   provably does not: at S=4·K=512 it slowed simulated deme-path
+   takeover ~3×, caught in-session by the cohort model — the same
+   class of bug round 8 caught in the read==write deme layout). The
+   lineage-BFS test in ``tests/test_shard_pop.py`` pins connectivity,
+   and the cohort-dynamics simulation
+   (``tools/selection_equivalence.py --simulate --pop-shards S``)
+   measures takeover within 0-1.6% of panmictic (S=2/4/8, BASELINE.md round 12). The read-local/
+   write-local alias discipline holds per shard: a shard only ever
+   writes its own rows, so ``input_output_aliases`` (and buffer
+   donation) still applies per shard.
+
+2. **Global rank thresholds (one ``all_gather`` of S·k scalars)** —
+   selection pressure stays globally panmictic-equivalent. Per-shard
+   rank-space selection over a mixed shard is selection over an
+   exchangeable cohort of the global score distribution — the exact
+   argument (and measurement) that already justifies the deme kernel's
+   cohort selection one level down (``tools/selection_equivalence.py``,
+   BASELINE.md round 8); the comb mixing is what keeps the cohorts
+   exchangeable. What cannot be local is the GLOBAL part of the
+   algebra: the target/termination check, elitism, and telemetry's
+   best. Each generation every shard publishes its local top-k scores
+   (k = max(1, elitism)); one ``all_gather`` makes the sorted S·k
+   sketch — the global rank thresholds — available everywhere: row 0
+   is the global best (the while-loop's termination predicate and the
+   stall counter's input), row e-1 is the global elitism threshold
+   (each shard re-injects only local parents scoring at or above the
+   global e-th best, so exactly the global top-e survive, modulo
+   score ties).
+
+3. **Replicated control flow** — every shard derives the same
+   ``best``/``gens`` scalars from the same sketch, so all shards take
+   the same branch every generation (the islands pmax pattern).
+
+``pop_shards=1`` never reaches this module: the engine routes the
+default through the exact pre-sharding path, which therefore lowers to
+byte-identical StableHLO (structurally asserted in
+``tests/test_shard_pop.py``).
+
+Admissibility: ``P % S == 0`` (equal shards) and ``(P/S) % S == 0``
+(the mix slab is a whole number of rows ≥ 1 per hop), i.e. ``S² | P``
+— plus, on the TPU deme path, the per-shard population must itself
+pass ``pingpong_admissible``. :func:`validate_shards` raises a
+ValueError naming the valid shard counts (the round-8 ablate-flag
+convention).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from libpga_tpu.ops.evaluate import evaluate as _evaluate
+from libpga_tpu.parallel.mesh import POP_AXIS, pop_mesh
+from libpga_tpu.utils import telemetry as _tl
+
+#: Ablation flags accepted by make_sharded_run (bench component
+#: isolation — tools/ablate_floor.py convention: unknown flags raise).
+ABLATE_FLAGS = ("sync", "mix")
+
+
+# ------------------------------------------------------------ admissibility
+
+
+def admissible_shards(pop_size: int, max_shards: Optional[int] = None):
+    """Every shard count S with ``S² | pop_size`` (each shard gets an
+    equal P/S rows AND the per-generation mix slab P/S² is a whole
+    number of rows), capped at ``max_shards`` (default: the number of
+    visible devices)."""
+    if max_shards is None:
+        max_shards = len(jax.devices())
+    return [
+        s
+        for s in range(1, max_shards + 1)
+        if pop_size % (s * s) == 0
+    ]
+
+
+def validate_shards(
+    pop_size: int, shards: int, max_shards: Optional[int] = None
+) -> None:
+    """Raise ValueError (naming the valid values, the round-8
+    ablate-flag convention) unless ``shards`` is admissible for this
+    population on this host."""
+    if max_shards is None:
+        max_shards = len(jax.devices())
+    valid = admissible_shards(pop_size, max_shards)
+    if shards not in valid:
+        raise ValueError(
+            f"pop_shards={shards} is inadmissible for a population of "
+            f"{pop_size} on {max_shards} devices (need S <= devices and "
+            f"S^2 | pop so every shard holds pop/S rows and the comb "
+            f"mix slab pop/S^2 is whole); valid shard counts: {valid}"
+        )
+
+
+def mix_rows(pop_size: int, shards: int) -> int:
+    """Rows each shard ships per generation: one comb stride's worth,
+    ``P / S²`` (the whole population circulates the ring every S·S
+    generations even without lineage spread; WITH it, one hop per
+    generation suffices — see the module docstring)."""
+    return (pop_size // shards) // shards
+
+
+def comb_chunks(mix: int, cap: int = 8) -> int:
+    """Sub-chunk count D of the migrating slab — the cross-deme write
+    interleave granularity (``u·D + d``). The largest divisor of the
+    slab that is <= ``cap`` (8 = the f32 sublane quantum the in-shard
+    comb uses); 1 when the slab is a single row."""
+    for d in range(min(cap, mix), 0, -1):
+        if mix % d == 0:
+            return d
+    return 1
+
+
+def comb_interleave_rows(mix: int, D: Optional[int] = None):
+    """Where received slab rows land, slab-locally: source row
+    ``d·C + u`` (sub-chunk d of D, offset u of C = mix/D) lands at row
+    ``u·D + d`` — the transposed cross-deme interleave of the round-8
+    comb (``pingpong_child_rows``), one level up. Returns a numpy
+    permutation ``dest[src_row] = dest_row``."""
+    import numpy as np
+
+    if D is None:
+        D = comb_chunks(mix)
+    C = mix // D
+    d = np.arange(D, dtype=np.int64)[:, None]
+    u = np.arange(C, dtype=np.int64)[None, :]
+    dest = np.empty(mix, dtype=np.int64)
+    dest[(d * C + u).reshape(-1)] = (u * D + d).reshape(-1)
+    return dest
+
+
+def shard_mix_perm(pop_size: int, shards: int):
+    """The GLOBAL row permutation one generation's mixing applies —
+    the single source of truth the runtime mirrors, pinned by the
+    structure tests and driven by the ``--simulate`` cohort model.
+    Row ``s·Ps + m·S`` (the stride-S comb) moves to shard
+    ``(s+1) mod S`` at comb slot ``inv_interleave(m)``; off-comb rows
+    stay. The comb (rather than a contiguous slab) is load-bearing:
+    it touches every deme group of the in-shard layout, which is what
+    makes the composition with the ping-pong parities mix (see the
+    module docstring)."""
+    import numpy as np
+
+    S = shards
+    Ps = pop_size // S
+    mix = mix_rows(pop_size, S)
+    ileave = comb_interleave_rows(mix)
+    inv = np.argsort(ileave)  # inv[ileave[k]] = k
+    dest = np.arange(pop_size, dtype=np.int64)
+    m = np.arange(mix)
+    for s in range(S):
+        nxt = (s + 1) % S
+        # runtime: dest comb slot k receives source comb slot
+        # ileave[k]; as src -> dest that is slot m -> inv[m].
+        dest[s * Ps + m * S] = nxt * Ps + inv[m] * S
+    return dest
+
+
+def _validate_ablate(ablate) -> tuple:
+    ablate = tuple(ablate)
+    unknown = [a for a in ablate if a not in ABLATE_FLAGS]
+    if unknown:
+        raise ValueError(
+            f"unknown shard ablation flag(s) {unknown}; "
+            f"valid: {list(ABLATE_FLAGS)}"
+        )
+    return ablate
+
+
+# ---------------------------------------------------------------- run loop
+
+
+def make_sharded_run(
+    obj: Callable,
+    local_step: Callable,
+    pop_size: int,
+    genome_len: int,
+    shards: int,
+    *,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = POP_AXIS,
+    elitism: int = 0,
+    history_gens: Optional[int] = None,
+    donate: bool = True,
+    ablate=(),
+) -> Callable:
+    """Build the sharded fused run loop: ``runner(genomes (P, L), key,
+    n, target, mparams) -> (genomes, scores, gens[, history])`` with
+    the engine run-loop contract, population rows split ``shards`` ways
+    over ``mesh`` (default: :func:`~libpga_tpu.parallel.mesh.pop_mesh`).
+
+    ``local_step(g, s, sub, mparams, gen) -> (g2, s2 | None)`` breeds
+    one shard's local block — the XLA breed returns ``(children,
+    None)`` (the loop evaluates after mixing); a fused Pallas breed
+    returns in-kernel scores and only the migrated slab is re-scored.
+    The step must NOT apply elitism itself: the loop applies GLOBAL
+    elitism through the gathered rank thresholds (see module
+    docstring).
+
+    ``ablate``: bench-only component isolation — ``"sync"`` drops the
+    all_gather (termination/elitism degrade to shard-local; measures
+    the collective's cost), ``"mix"`` drops the ppermute. Unknown
+    flags raise (tools/ablate_floor.py convention).
+    """
+    validate_shards(pop_size, shards)
+    ablate = _validate_ablate(ablate)
+    if mesh is None:
+        mesh = pop_mesh(shards, axis_name=axis_name)
+    S = shards
+    Ps = pop_size // S
+    mix = mix_rows(pop_size, S)
+    if not 0 <= elitism <= Ps:
+        raise ValueError(
+            f"elitism={elitism} must be in [0, per-shard rows {Ps}]"
+        )
+    ileave = jnp.asarray(comb_interleave_rows(mix), dtype=jnp.int32)
+    perm = [(i, (i + 1) % S) for i in range(S)]
+    k_sync = max(1, elitism)
+    telemetry = history_gens is not None
+
+    def sync(scores):
+        """The one small all-reduce: local top-k -> all_gather -> the
+        sorted S·k global rank-threshold sketch (descending; entry 0 is
+        the global best, entry e-1 the global elitism threshold)."""
+        top = jax.lax.top_k(scores, k_sync)[0]
+        if "sync" in ablate:
+            return top  # shard-local sketch (bench isolation only)
+        gathered = jax.lax.all_gather(top, axis_name)  # (S, k_sync)
+        return -jnp.sort(-gathered.reshape(-1))
+
+    def mix_children(g2):
+        """One ppermute: ship the stride-S row comb of fresh children
+        (rows 0, S, 2S, … — every deme group contributes) one hop
+        around the shard ring; the received comb lands cross-deme
+        interleaved (``u·D + d``)."""
+        if mix == 0 or "mix" in ablate:
+            return g2
+        g2r = g2.reshape(mix, S, genome_len)  # row k·S + b -> (k, b)
+        incoming = jax.lax.ppermute(g2r[:, 0, :], axis_name, perm)
+        g2r = g2r.at[:, 0, :].set(incoming[ileave])
+        return g2r.reshape(Ps, genome_len)
+
+    def apply_elitism(g, s, g2, s2, sketch):
+        """Global elitism via the carried rank thresholds: a local
+        parent survives into rows 0..e-1 iff its score reaches the
+        global e-th best — so exactly the global top-e survive
+        (score ties may keep a few extra copies, never fewer)."""
+        if elitism == 0:
+            return g2, s2
+        thr = sketch[elitism - 1]
+        top_s, top_i = jax.lax.top_k(s, elitism)
+        keep = top_s >= thr  # (e,)
+        elites = jnp.take(g, top_i, axis=0).astype(g2.dtype)
+        cur_g = jax.lax.dynamic_slice(
+            g2, (0, 0), (elitism, g2.shape[1])
+        )
+        cur_s = jax.lax.dynamic_slice(s2, (0,), (elitism,))
+        g2 = jax.lax.dynamic_update_slice(
+            g2, jnp.where(keep[:, None], elites, cur_g), (0, 0)
+        )
+        s2 = jax.lax.dynamic_update_slice(
+            s2, jnp.where(keep, top_s, cur_s), (0,)
+        )
+        return g2, s2
+
+    def generation(g, s, sub, mparams, gen, sketch):
+        """One sharded generation: local breed -> comb ppermute ->
+        (re)evaluate -> global elitism -> rank-threshold sync."""
+        g2, s2 = local_step(g, s, sub, mparams, gen)
+        g2 = mix_children(g2)
+        if s2 is None:
+            s2 = _evaluate(obj, g2)
+        elif mix > 0 and "mix" not in ablate:
+            # Fused step scored its own children pre-mix; only the
+            # migrated comb rows need re-scoring.
+            comb = g2.reshape(mix, S, genome_len)[:, 0, :]
+            s2 = (
+                s2.reshape(mix, S)
+                .at[:, 0]
+                .set(_evaluate(obj, comb))
+                .reshape(Ps)
+            )
+        g2, s2 = apply_elitism(g, s, g2, s2, sketch)
+        return g2, s2, sync(s2)
+
+    if not telemetry:
+
+        def shard_body(genomes, keys, n, target, mparams):
+            key = keys[0]
+            scores = _evaluate(obj, genomes)
+            sketch0 = sync(scores)
+
+            def cond(c):
+                g, s, k, gen, sk = c
+                return jnp.logical_and(gen < n, sk[0] < target)
+
+            def body(c):
+                g, s, k, gen, sk = c
+                k, sub = jax.random.split(k)
+                g2, s2, sk2 = generation(g, s, sub, mparams, gen, sk)
+                return (g2, s2, k, gen + 1, sk2)
+
+            init = (genomes, scores, key, jnp.int32(0), sketch0)
+            g, s, k, gens, _ = jax.lax.while_loop(cond, body, init)
+            return g, s, gens
+
+        out_specs = (P(axis_name, None), P(axis_name), P())
+
+    else:
+
+        def shard_body(genomes, keys, n, target, mparams):
+            key = keys[0]
+            scores = _evaluate(obj, genomes)
+            sketch0 = sync(scores)
+
+            def cond(c):
+                g, s, k, gen, sk = c[:5]
+                return jnp.logical_and(gen < n, sk[0] < target)
+
+            def body(c):
+                g, s, k, gen, sk, best, stall, buf = c
+                k, sub = jax.random.split(k)
+                g2, s2, sk2 = generation(g, s, sub, mparams, gen, sk)
+                # Global stats row (pmax/pmean across shards — the
+                # islands reduction pattern): every shard writes the
+                # identical replicated history buffer.
+                row, best, stall = _tl.island_stats_row(
+                    g2[None], s2[None], best, stall,
+                    axis_name=None if "sync" in ablate else axis_name,
+                )
+                buf = _tl.write_row(buf, gen, row)
+                return (g2, s2, k, gen + 1, sk2, best, stall, buf)
+
+            init = (
+                genomes, scores, key, jnp.int32(0), sketch0,
+                sketch0[0], jnp.int32(0), _tl.history_init(history_gens),
+            )
+            out = jax.lax.while_loop(cond, body, init)
+            return out[0], out[1], out[3], out[7]
+
+        out_specs = (P(axis_name, None), P(axis_name), P(), P())
+
+    from libpga_tpu.utils.compat import shard_map as _shard_map
+
+    mapped = _shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(axis_name, None), P(axis_name), P(), P(), P()),
+        out_specs=out_specs,
+    )
+    jitted = jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+    def runner(genomes, key, n, target, mparams):
+        keys = jax.random.split(key, S)
+        return jitted(genomes, keys, n, target, mparams)
+
+    runner.mesh = mesh
+    runner.shards = S
+    runner.mix = mix
+    runner.k_sync = k_sync
+    runner.jitted = jitted
+    runner.history_gens = history_gens
+    return runner

@@ -219,7 +219,13 @@ class BatchedRuns:
         """The exact shape-bucket signature: everything baked into the
         traced program. Two requests share a program iff their
         signatures are equal; seeds, n, targets, and mutation
-        parameters are runtime inputs and deliberately absent."""
+        parameters are runtime inputs and deliberately absent.
+        ``config.serving_signature_fields()`` carries ``pop_shards``
+        (ISSUE 7), so sharded and unsharded tenants never share a
+        compiled program — and since the cache key
+        (:meth:`_program`'s ``prog_key``) extends this signature, the
+        separation holds in ``cache.py`` too (collision test in
+        tests/test_shard_pop.py)."""
         from libpga_tpu.engine import _kind_key
 
         return (

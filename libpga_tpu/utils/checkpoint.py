@@ -12,6 +12,17 @@ array, one ``<path>.proc<k>.npz`` file per process (all processes must
 call it — it is a collective); ``restore`` merges every process file it
 finds (shared filesystem, the norm for pod jobs) back into full host
 arrays. Single-process solvers keep the flat single-file format.
+
+Population sharding (ISSUE 7): a POPULATION-SHARDED solver
+(``PGAConfig(pop_shards=S)``, ``parallel/shard_pop.py``) checkpoints
+through these same paths as ONE LOGICAL ``(pop, genome_len)`` array —
+single-process saves gather the addressable shards transparently, and
+multi-process saves reuse the per-shard offset format above. The shard
+count is a RESTORE-TIME choice, not a checkpoint property: the engine
+re-places the restored array onto whatever mesh its current
+``pop_shards`` demands at the next sharded run, so save@shards=4 →
+restore@shards=2 needs no conversion (``tools/resize_smoke.py``'s
+pop-shard leg proves the round trip).
 """
 
 from __future__ import annotations

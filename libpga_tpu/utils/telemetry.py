@@ -91,6 +91,10 @@ EVENT_FIELDS: Dict[str, tuple] = {
     "dead_letter": ("bucket", "error"),
     # Serving observability (ISSUE 6): per-ticket latency accounting,
     # SLO breaches, exported metric snapshots, flight-recorder dumps.
+    # Population sharding (ISSUE 7): one record per sharded run naming
+    # the per-generation cross-shard collective pair's geometry (S-way
+    # mesh, S·k-scalar rank-threshold gather, comb-slab ppermute rows).
+    "shard_sync": ("shards", "topk", "mix_rows"),
     "ticket_done": ("bucket", "queue_wait_ms", "execute_ms", "e2e_ms"),
     "slo_violation": ("what", "value_ms", "limit_ms"),
     "metrics_snapshot": ("metrics",),

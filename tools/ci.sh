@@ -39,7 +39,17 @@
 #                                 EVENT_FIELDS, a forced dead letter's
 #                                 flight-recorder dump schema-checked,
 #                                 and the Prometheus exposition linted
-#                                 (tools/metrics_dump.py --check).
+#                                 (tools/metrics_dump.py --check);
+#   8. population-shard smoke   — tools/shard_smoke.py on a 4-device
+#                                 CPU platform: a rank-selection config
+#                                 at pop_shards=4 reaches the
+#                                 bit-identical final best as the
+#                                 same-seed pop_shards=1 run, the
+#                                 while body carries exactly one
+#                                 ppermute + one all_gather per
+#                                 generation, and the shard_sync
+#                                 telemetry event is schema-valid
+#                                 (ISSUE 7).
 # Exits nonzero on the first failing stage.
 set -e
 cd "$(dirname "$0")/.."
@@ -279,5 +289,8 @@ print(
 PY
 JAX_PLATFORMS=cpu python tools/metrics_dump.py --demo --check > /dev/null
 echo "prometheus exposition lint OK"
+
+echo "== ci: population-shard smoke =="
+JAX_PLATFORMS=cpu python tools/shard_smoke.py
 
 echo "== ci: all stages passed =="

@@ -21,6 +21,14 @@ on a CPU-simulated multi-host mesh (8 global devices throughout):
    (resize DOWN again — 8 shard files into 4 processes), verify, and
    evolve again.
 
+Stage 5 (**pop-shard leg**, ISSUE 7) resizes the OTHER sharding axis:
+a single process with 8 devices runs a POPULATION-SHARDED solver
+(``PGAConfig(pop_shards=4)``), checkpoints it — the sharded population
+serializes as ONE logical array through the same save path — then
+restores into a ``pop_shards=2`` solver and keeps evolving: shard
+count, like process count, is a restore-time choice, not a property of
+the checkpoint.
+
 Run directly:  python tools/resize_smoke.py
 Exit code 0 and "RESIZE SMOKE: PASS" = every stage agreed.
 """
@@ -125,6 +133,55 @@ def worker(stage: int, process_id: int) -> None:
     )
 
 
+def pop_shard_leg() -> None:
+    """save@pop_shards=4 → restore@pop_shards=2 (single process, 8
+    devices): the population-axis analog of the process-resize stages."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from libpga_tpu.utils.compat import force_cpu_device_count
+
+    force_cpu_device_count(GLOBAL_DEVICES)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from libpga_tpu import PGA, PGAConfig
+    from libpga_tpu.utils import checkpoint
+
+    ckpt_path = os.environ["PGA_RESIZE_CKPT"].replace(
+        ".npz", ".popshard.npz"
+    )
+
+    def solver(shards):
+        pga = PGA(seed=5, config=PGAConfig(
+            pop_shards=shards, use_pallas=False, selection="truncation",
+            mutation_rate=0.05, elitism=1,
+        ))
+        pga.set_objective("onemax_bits")
+        return pga
+
+    pga = solver(4)
+    h = pga.create_population(1024, 32)
+    gens = pga.run(15)
+    assert gens == 15, gens
+    best = float(pga.get_best_with_score(h)[1])
+    assert best > 20.0, f"no convergence at shards=4 ({best})"
+    checkpoint.save(pga, ckpt_path)
+
+    pga2 = solver(2)
+    checkpoint.restore(pga2, ckpt_path)
+    h2 = pga2._handles()[0]
+    restored = float(pga2.get_best_with_score(h2)[1])
+    assert restored == best, f"restore@2 lost the best: {restored} != {best}"
+    pga2.run(10)
+    after = float(pga2.get_best_with_score(h2)[1])
+    assert after >= best, f"evolution at shards=2 regressed: {after} < {best}"
+    print(
+        f"[pop-shard leg] save@shards=4 best {best:.1f} -> "
+        f"restore@shards=2 exact, evolved to {after:.1f}",
+        flush=True,
+    )
+
+
 def _run_stage(stage: int, env) -> int:
     num_procs, _ = STAGES[stage]
     env = dict(env, PGA_RESIZE_PORT=str(_free_port()))
@@ -156,6 +213,9 @@ def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
         worker(int(sys.argv[2]), int(sys.argv[3]))
         return 0
+    if len(sys.argv) > 1 and sys.argv[1] == "--pop-shard-leg":
+        pop_shard_leg()
+        return 0
 
     env = {
         k: v
@@ -179,6 +239,16 @@ def main() -> int:
             f"stage {stage} ok: {n} processes"
             + (" (restored from previous stage)" if restoring else "")
         )
+    # Stage 5: the population-shard resize leg (single process, its own
+    # subprocess so the forced device count binds before backend init).
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--pop-shard-leg"],
+        env=env, timeout=600,
+    )
+    if proc.returncode != 0:
+        print("RESIZE SMOKE: FAIL (pop-shard leg)")
+        return proc.returncode
+    print("stage 4 ok: pop-shard leg (save@shards=4 -> restore@shards=2)")
     print("RESIZE SMOKE: PASS")
     return 0
 

@@ -1,0 +1,146 @@
+"""4-shard CPU smoke (ci.sh stage 8, ISSUE 7).
+
+Forces a 4-device CPU platform (``jax_num_cpu_devices`` /
+``xla_force_host_platform_device_count``, the way the island smokes
+already do), then proves the sharded run loop end to end:
+
+1. **Bit-identical final best** — a rank-selection (truncation) OneMax
+   config run to its optimum at ``pop_shards=4`` reaches the
+   bit-identical final best (f32-exact score AND an optimal phenotype)
+   as the ``pop_shards=1`` same-seed run: sharded mixing and the
+   global rank thresholds must not break convergence.
+2. **Collective cost model** — the compiled 4-shard while body carries
+   exactly ONE ppermute + ONE all_gather per generation.
+3. **shard_sync telemetry** — the sharded run emits a schema-valid
+   ``shard_sync`` event (validated against utils/telemetry's
+   versioned EVENT_FIELDS schema, like every other ci event gate).
+
+Run directly: ``python tools/shard_smoke.py`` (CPU). Exit 0 and
+"SHARD SMOKE: PASS" = all three gates held.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+from libpga_tpu.utils.compat import force_cpu_device_count  # noqa: E402
+
+force_cpu_device_count(4)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+POP, LENGTH, CAP = 256, 32, 400
+
+
+def _solve(shards, events_path=None):
+    from libpga_tpu import PGA, PGAConfig, TelemetryConfig
+
+    tel = (
+        None if events_path is None
+        else TelemetryConfig(history_gens=8, events_path=events_path)
+    )
+    pga = PGA(
+        seed=7,
+        config=PGAConfig(
+            pop_shards=shards, use_pallas=False, selection="truncation",
+            mutation_rate=0.05, elitism=2, telemetry=tel,
+        ),
+    )
+    h = pga.create_population(POP, LENGTH)
+    pga.set_objective("onemax_bits")
+    gens = pga.run(CAP, target=float(LENGTH))
+    genome, score = pga.get_best_with_score(h)
+    return pga, h, gens, genome, np.float32(score)
+
+
+def main() -> int:
+    import tempfile
+
+    assert len(jax.devices()) >= 4, f"only {len(jax.devices())} devices"
+
+    # Gate 1: bit-identical final best, 1 vs 4 shards, same seed.
+    _, _, gens1, g1, s1 = _solve(1)
+    events = tempfile.mktemp(suffix=".jsonl", prefix="pga-shard-smoke-")
+    pga4, h4, gens4, g4, s4 = _solve(4, events_path=events)
+    assert gens1 < CAP and gens4 < CAP, (gens1, gens4)
+    assert s1.tobytes() == s4.tobytes(), f"best diverged: {s1} vs {s4}"
+    assert (g1 >= 0.5).all() and (g4 >= 0.5).all(), "non-optimal best"
+    print(
+        f"bit-identity OK: shards=1 hit {s1} in {gens1} gens, "
+        f"shards=4 hit {s4} in {gens4} gens"
+    )
+
+    # Gate 2: exactly one cross-shard collective pair per generation.
+    from jax.core import ClosedJaxpr, Jaxpr
+
+    fn = pga4._compiled_sharded_run(POP, LENGTH)
+    keys = jax.random.split(jax.random.key(0), 4)
+    args = (
+        pga4.population(h4).genomes, keys, jnp.int32(3),
+        jnp.float32(jnp.inf), pga4._mutate_params(),
+    )
+    jaxpr = jax.make_jaxpr(lambda *a: fn.jitted(*a))(*args)
+
+    def walk(jxp, counts):
+        for eqn in jxp.eqns:
+            counts[eqn.primitive.name] = counts.get(eqn.primitive.name, 0) + 1
+            for v in eqn.params.values():
+                vals = v if isinstance(v, (list, tuple)) else (v,)
+                for vv in vals:
+                    if isinstance(vv, ClosedJaxpr):
+                        walk(vv.jaxpr, counts)
+                    elif isinstance(vv, Jaxpr):
+                        walk(vv, counts)
+        return counts
+
+    def find_while(jxp):
+        for eqn in jxp.eqns:
+            if eqn.primitive.name == "while":
+                return eqn
+            for v in eqn.params.values():
+                vals = v if isinstance(v, (list, tuple)) else (v,)
+                for vv in vals:
+                    sub = (
+                        vv.jaxpr if isinstance(vv, ClosedJaxpr)
+                        else vv if isinstance(vv, Jaxpr) else None
+                    )
+                    if sub is not None:
+                        found = find_while(sub)
+                        if found is not None:
+                            return found
+        return None
+
+    body = find_while(jaxpr.jaxpr).params["body_jaxpr"].jaxpr
+    counts = walk(body, {})
+    pp, ag = counts.get("ppermute", 0), counts.get("all_gather", 0)
+    assert (pp, ag) == (1, 1), f"collective pair broken: {counts}"
+    print(f"collective pair OK: 1 ppermute + 1 all_gather per generation")
+
+    # Gate 3: schema-valid shard_sync telemetry.
+    from libpga_tpu.utils import telemetry
+
+    records = telemetry.validate_log(events)  # raises on violation
+    sync = [r for r in records if r["event"] == "shard_sync"]
+    assert sync, f"no shard_sync event in {[r['event'] for r in records]}"
+    assert sync[0]["shards"] == 4 and sync[0]["mix_rows"] == POP // 16
+    print(
+        f"shard_sync OK: {len(records)} schema-valid events, "
+        f"sync geometry {sync[0]['shards']}x top-{sync[0]['topk']}, "
+        f"{sync[0]['mix_rows']}-row comb slab"
+    )
+
+    print("SHARD SMOKE: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
