@@ -345,6 +345,51 @@ long pga_metrics_snapshot(char *buf, unsigned long cap) {
     return static_cast<long>(len);
 }
 
+int pga_fleet_start(const char *spool_dir, const char *objective,
+                    unsigned n_workers, unsigned max_batch,
+                    float max_wait_ms) {
+    if (!spool_dir || !objective) return -1;
+    return static_cast<int>(call_long(
+        "fleet_start", "(ssIIf)", spool_dir, objective, n_workers,
+        max_batch, static_cast<double>(max_wait_ms)));
+}
+
+pga_fleet_ticket_t *pga_fleet_submit(unsigned size, unsigned genome_len,
+                                     unsigned n, long seed,
+                                     unsigned checkpoint_every) {
+    long tid = call_long("fleet_submit", "(IIIlI)", size, genome_len, n,
+                         seed, checkpoint_every);
+    return tid <= 0 ? nullptr
+                    : reinterpret_cast<pga_fleet_ticket_t *>(
+                          static_cast<intptr_t>(tid));
+}
+
+int pga_fleet_await(pga_fleet_ticket_t *t, float *best, double timeout_s) {
+    if (!t) return -1;
+    size_t nbytes = 0;
+    /* float32[2]: generations, best objective value. */
+    float *vals = bytes_to_floats(
+        call("fleet_await", "(ld)",
+             static_cast<long>(reinterpret_cast<intptr_t>(t)), timeout_s),
+        &nbytes);
+    if (!vals || nbytes < 2 * sizeof(float)) {
+        std::free(vals);
+        return -1;
+    }
+    if (best) *best = vals[1];
+    int gens = static_cast<int>(vals[0]);
+    std::free(vals);
+    return gens;
+}
+
+int pga_fleet_drain(void) {
+    return static_cast<int>(call_long("fleet_drain", "()"));
+}
+
+int pga_fleet_close(void) {
+    return static_cast<int>(call_long("fleet_close", "()"));
+}
+
 int pga_serving_config(unsigned max_batch, float max_wait_ms) {
     return static_cast<int>(
         call_long("serving_config", "(If)", max_batch,

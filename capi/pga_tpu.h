@@ -378,6 +378,48 @@ int pga_serving_config(unsigned max_batch, float max_wait_ms);
 int pga_await_ex(pga_ticket_t *t, float latency_ms[4]);
 long pga_metrics_snapshot(char *buf, unsigned long cap);
 
+/* ---- Cross-process serving fleet (ISSUE 8) ----------------------------
+ *
+ * The process-global FLEET lifts the serving queue across processes: a
+ * coordinator in this process owns ticket intake and `n_workers`
+ * spawned worker processes claim shape-bucket batches under
+ * time-bounded heartbeat leases. A worker killed mid-batch (SIGKILL,
+ * preemption) has its lease expire and its batch re-run bit-identically
+ * on a survivor — seeds and runtime parameters travel with the ticket,
+ * never with the worker. All cross-process state lives in `spool_dir`
+ * as atomic filesystem transitions.
+ *
+ * pga_fleet_start creates (or replaces, closing the old one) the fleet
+ * on `spool_dir` serving the named builtin objective, with `max_batch`/
+ * `max_wait_ms` as the batch-formation admission window. Returns 0/-1.
+ *
+ * pga_fleet_submit admits one run (a fresh size x genome_len population
+ * from `seed`, `n` generations); `checkpoint_every` > 0 makes the
+ * ticket SUPERVISED — executed under the supervisor at that
+ * auto-checkpoint cadence, so drains and worker deaths resume it from
+ * the last durable chunk boundary. Returns a ticket or NULL.
+ *
+ * pga_fleet_await blocks (up to timeout_s; <= 0 = forever) for one
+ * ticket, releases it, writes the best objective value into *best
+ * (may be NULL), and returns the generations executed; -1 on error or
+ * a dead-lettered ticket (a batch that cost too many distinct workers
+ * their lease is quarantined, not retried forever).
+ *
+ * pga_fleet_drain SIGTERMs every worker: each checkpoints in-flight
+ * supervised runs at the next chunk boundary, returns its lease, and
+ * exits. Returns workers drained; pga_fleet_start on the same spool
+ * resumes the work. pga_fleet_close drains and shuts the fleet down. */
+typedef struct pga_fleet_ticket pga_fleet_ticket_t;
+int pga_fleet_start(const char *spool_dir, const char *objective,
+                    unsigned n_workers, unsigned max_batch,
+                    float max_wait_ms);
+pga_fleet_ticket_t *pga_fleet_submit(unsigned size, unsigned genome_len,
+                                     unsigned n, long seed,
+                                     unsigned checkpoint_every);
+int pga_fleet_await(pga_fleet_ticket_t *t, float *best, double timeout_s);
+int pga_fleet_drain(void);
+int pga_fleet_close(void);
+
 #ifdef __cplusplus
 }
 #endif

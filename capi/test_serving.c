@@ -10,6 +10,7 @@
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
+#include <unistd.h>
 
 #include "pga_tpu.h"
 
@@ -143,6 +144,40 @@ int main(void) {
         return fprintf(stderr, "double await not rejected\n"), 1;
     if (pga_submit_n(NULL, 5) != NULL)
         return fprintf(stderr, "NULL solver submit not rejected\n"), 1;
+
+    /* Cross-process serving fleet (ISSUE 8): start a 2-worker fleet on
+     * a private spool, submit a plain and a supervised ticket, await
+     * both, drain, and close. The worker processes are real OS
+     * processes — this is the ABI round trip; bit-identity across
+     * kills/drains is proven by tests/test_fleet.py and
+     * tools/fleet_smoke.py. */
+    {
+        char spool[] = "/tmp/pga-fleet-capi-XXXXXX";
+        if (!mkdtemp(spool))
+            return fprintf(stderr, "mkdtemp failed\n"), 1;
+        if (pga_fleet_start(spool, "onemax", 2, 2, 5.0f) != 0)
+            return fprintf(stderr, "pga_fleet_start failed\n"), 1;
+        pga_fleet_ticket_t *f1 = pga_fleet_submit(POP, LEN, GENS, 42, 0);
+        pga_fleet_ticket_t *f2 = pga_fleet_submit(POP, LEN, 2 * GENS, 43, GENS);
+        if (!f1 || !f2)
+            return fprintf(stderr, "pga_fleet_submit failed\n"), 1;
+        float best1 = -1.0f, best2 = -1.0f;
+        int fg1 = pga_fleet_await(f1, &best1, 300.0);
+        int fg2 = pga_fleet_await(f2, &best2, 300.0);
+        if (fg1 != GENS || fg2 != 2 * GENS)
+            return fprintf(stderr, "fleet await gens %d/%d\n", fg1, fg2), 1;
+        if (!(best1 >= 0.0f && best1 <= (float)LEN) ||
+            !(best2 >= 0.0f && best2 <= (float)LEN))
+            return fprintf(stderr, "fleet best %g/%g out of range\n",
+                           (double)best1, (double)best2),
+                   1;
+        if (pga_fleet_await(f1, NULL, 1.0) >= 0) /* released */
+            return fprintf(stderr, "double fleet await not rejected\n"), 1;
+        if (pga_fleet_drain() < 0)
+            return fprintf(stderr, "pga_fleet_drain failed\n"), 1;
+        if (pga_fleet_close() != 0)
+            return fprintf(stderr, "pga_fleet_close failed\n"), 1;
+    }
 
     for (int i = 0; i < NSOLVERS; i++) pga_deinit(solvers[i]);
     pga_deinit(ref);

@@ -23,7 +23,7 @@ Design stance (TPU-first, not a port):
   — is a ``lax.ppermute`` ring neighbor-exchange over ICI.
 """
 
-from libpga_tpu.config import PGAConfig, ServingConfig, SLOConfig
+from libpga_tpu.config import FleetConfig, PGAConfig, ServingConfig, SLOConfig
 from libpga_tpu.population import Population
 from libpga_tpu.engine import PGA
 from libpga_tpu.utils.telemetry import TelemetryConfig
@@ -65,6 +65,7 @@ __all__ = [
     "PGAConfig",
     "ServingConfig",
     "SLOConfig",
+    "FleetConfig",
     "Population",
     "ops",
     "objectives",

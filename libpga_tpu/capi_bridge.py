@@ -939,6 +939,92 @@ def metrics_snapshot_json() -> bytes:
     return json.dumps(_metrics.REGISTRY.snapshot()).encode("utf-8")
 
 
+# ------------------------------------------------------------------ fleet
+
+_fleet = None
+_fleet_handles: Dict[int, object] = {}
+_next_fleet_ticket = 1
+
+
+def fleet_start(
+    spool_dir: str, objective: str, n_workers: int, max_batch: int,
+    max_wait_ms: float,
+) -> int:
+    """``pga_fleet_start``: create (or replace) the process-global
+    cross-process serving fleet (``serving/fleet.py``) on ``spool_dir``
+    and spawn ``n_workers`` worker processes. Replacing an existing
+    fleet closes it first (drain + monitor stop)."""
+    global _fleet
+    from libpga_tpu.config import FleetConfig
+    from libpga_tpu.serving.fleet import Fleet
+
+    if _fleet is not None:
+        _fleet.close()
+        _fleet = None
+    _fleet = Fleet(
+        spool_dir, objective,
+        fleet=FleetConfig(
+            n_workers=int(n_workers), max_batch=int(max_batch),
+            max_wait_ms=float(max_wait_ms),
+        ),
+    )
+    _fleet.start()
+    return 0
+
+
+def fleet_submit(
+    size: int, genome_len: int, n: int, seed: int, checkpoint_every: int
+) -> int:
+    """``pga_fleet_submit``: admit one ticket to the process-global
+    fleet; returns a ticket id (> 0). ``checkpoint_every`` > 0 makes
+    the ticket supervised (drain-safe at that cadence)."""
+    global _next_fleet_ticket
+    from libpga_tpu.serving.fleet import FleetTicket
+
+    if _fleet is None:
+        raise ValueError("no fleet: call pga_fleet_start first")
+    handle = _fleet.submit(FleetTicket(
+        size=int(size), genome_len=int(genome_len), n=int(n),
+        seed=int(seed), checkpoint_every=int(checkpoint_every),
+    ))
+    tid = _next_fleet_ticket
+    _next_fleet_ticket += 1
+    _fleet_handles[tid] = handle
+    return tid
+
+
+def fleet_await(ticket_id: int, timeout_s: float) -> bytes:
+    """``pga_fleet_await``: block for one fleet ticket and release it.
+    Returns two float32s: generations executed, best score."""
+    handle = _fleet_handles.pop(int(ticket_id), None)
+    if handle is None:
+        raise ValueError(f"invalid fleet ticket {ticket_id}")
+    res = handle.result(timeout=float(timeout_s) if timeout_s > 0 else None)
+    return np.asarray(
+        [float(res.generations), float(res.best_score)], dtype=np.float32
+    ).tobytes()
+
+
+def fleet_drain() -> int:
+    """``pga_fleet_drain``: SIGTERM-drain the fleet's workers
+    (checkpoint + lease return); returns workers drained. The fleet
+    stays open — ``pga_fleet_start`` on the same spool resumes."""
+    if _fleet is None:
+        raise ValueError("no fleet: call pga_fleet_start first")
+    return int(_fleet.drain())
+
+
+def fleet_close() -> int:
+    """``pga_fleet_close``: drain and close the process-global fleet."""
+    global _fleet
+    if _fleet is None:
+        return 0
+    _fleet.close()
+    _fleet = None
+    _fleet_handles.clear()
+    return 0
+
+
 # ------------------------------------------------------------ robustness
 
 
@@ -954,23 +1040,13 @@ def set_fault_plan(spec: str) -> None:
       - a JSON array of such objects;
       - ``{"seed": S, "plans": [...]}`` to set the registry's PRNG seed
         for probability-triggered plans.
-    """
-    import json
 
+    The parsing lives in ``faults.install_spec`` — the same transport
+    the fleet worker's ``PGA_FAULT_SPEC`` environment hook uses.
+    """
     from libpga_tpu.robustness import faults
 
-    if not spec or spec.strip() in ("[]", "{}", "null", "off"):
-        faults.clear()
-        return
-    data = json.loads(spec)
-    seed = 0
-    if isinstance(data, dict) and "plans" in data:
-        seed = int(data.get("seed", 0))
-        data = data["plans"]
-    if isinstance(data, dict):
-        data = [data]
-    plans = [faults.FaultPlan(**d) for d in data]
-    faults.install(*plans, seed=seed)
+    faults.install_spec(spec)
 
 
 def supervised_run(

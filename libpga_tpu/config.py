@@ -296,6 +296,85 @@ class ServingConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Settings for the cross-process serving fleet (``serving/fleet.py``).
+
+    The fleet lifts :class:`ServingConfig`'s single-process semantics to
+    a coordinator + N worker processes: ``max_batch``/``max_wait_ms``
+    keep their admission-window meaning (the coordinator forms
+    shape-bucket batch files instead of in-process mega-runs), and
+    ``max_pending``/``overflow`` keep their backpressure meaning but
+    count tickets outstanding across the WHOLE fleet.
+
+    Attributes:
+      n_workers: worker processes ``Fleet.start`` spawns.
+      max_batch: a shape bucket becomes a claimable batch file as soon
+        as this many same-signature tickets are pending.
+      max_wait_ms: a non-empty bucket is batched at most this many
+        milliseconds after its oldest ticket was admitted.
+      lease_timeout_s: a claimed batch whose lease heartbeat is older
+        than this is requeued onto the pending spool — the recovery
+        path for a worker that is wedged or paused (SIGSTOP) rather
+        than dead. Workers that EXIT while holding a lease are requeued
+        immediately (the coordinator watches the processes it spawned).
+      heartbeat_s: how often a worker touches its lease file. Must be
+        well under ``lease_timeout_s`` (validated: at most half).
+      max_worker_deaths: a batch that has cost this many DISTINCT
+        workers their lease (death or expiry) is quarantined into the
+        spool's ``dead/`` directory with a flight-recorder dump instead
+        of being retried forever — the fleet-level dead-letter policy.
+      max_pending: fleet-wide bound on submitted-but-incomplete
+        tickets; ``None`` = unbounded. At the bound ``submit`` follows
+        ``overflow`` exactly like ``ServingConfig``: ``"block"`` waits
+        for a completion, ``"raise"`` raises
+        :class:`~libpga_tpu.serving.queue.QueueFull`.
+      overflow: see ``max_pending``.
+      poll_s: coordinator monitor cadence (batch formation, lease
+        scan, worker liveness) — also the worker's pending-spool poll
+        cadence.
+      drain_timeout_s: how long ``Fleet.drain``/``close`` waits for a
+        SIGTERM'd worker to checkpoint and exit before escalating to
+        SIGKILL (the worker's in-flight batch is then recovered by the
+        normal lease-expiry path on the next ``start``).
+    """
+
+    n_workers: int = 2
+    max_batch: int = 8
+    max_wait_ms: float = 20.0
+    lease_timeout_s: float = 3.0
+    heartbeat_s: float = 0.5
+    max_worker_deaths: int = 3
+    max_pending: Optional[int] = None
+    overflow: str = "block"
+    poll_s: float = 0.05
+    drain_timeout_s: float = 60.0
+
+    def __post_init__(self):
+        if self.n_workers < 1:
+            raise ValueError("n_workers must be >= 1")
+        if self.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        if self.max_wait_ms < 0:
+            raise ValueError("max_wait_ms must be >= 0")
+        if self.lease_timeout_s <= 0:
+            raise ValueError("lease_timeout_s must be > 0")
+        if not (0 < self.heartbeat_s <= self.lease_timeout_s / 2):
+            raise ValueError(
+                "heartbeat_s must be in (0, lease_timeout_s / 2]"
+            )
+        if self.max_worker_deaths < 1:
+            raise ValueError("max_worker_deaths must be >= 1")
+        if self.max_pending is not None and self.max_pending < 1:
+            raise ValueError("max_pending must be >= 1 or None")
+        if self.overflow not in ("block", "raise"):
+            raise ValueError("overflow must be 'block' or 'raise'")
+        if self.poll_s <= 0:
+            raise ValueError("poll_s must be > 0")
+        if self.drain_timeout_s <= 0:
+            raise ValueError("drain_timeout_s must be > 0")
+
+
+@dataclasses.dataclass(frozen=True)
 class SLOConfig:
     """Latency service-level objectives for the serving queue (ISSUE 6).
 

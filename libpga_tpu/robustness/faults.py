@@ -59,6 +59,18 @@ SITES = (
     "checkpoint.restore",
     # the serving queue's background flusher thread loop
     "serving.flusher",
+    # fleet worker (serving/worker.py): fires at the start of each
+    # claimed-batch execution — a "raise" plan propagates out of the
+    # worker main loop and kills the WORKER PROCESS mid-batch (the
+    # injected analog of a crash; the coordinator's liveness watch must
+    # requeue the batch)
+    "worker.execute",
+    # fleet worker heartbeat thread: fires per heartbeat tick — a
+    # "raise" plan kills only the heartbeat thread, so the worker keeps
+    # computing while its lease goes stale (the injected lease-expiry
+    # scenario; the coordinator must requeue and the worker must notice
+    # the lost lease before publishing)
+    "worker.heartbeat",
 )
 
 _KINDS = ("raise", "nan")
@@ -205,6 +217,40 @@ def clear() -> None:
     """Deactivate fault injection (the default state)."""
     global PLAN
     PLAN = None
+
+
+def install_spec(spec: str, events=None) -> Optional[FaultRegistry]:
+    """Install (or clear) the process-global plan from a JSON spec — the
+    transport format shared by the C ABI (``pga_set_fault_plan``) and
+    the fleet worker's ``PGA_FAULT_SPEC`` environment hook
+    (``serving/worker.py``), so a chaos driver can inject faults into a
+    process it cannot call into.
+
+    Spec forms:
+      - ``""`` / ``"[]"`` / ``"{}"`` / ``"null"`` / ``"off"``: clear;
+      - a JSON object: one plan — ``{"site": ..., "kind":
+        "raise"|"nan", "at_call_n": N | "probability": p,
+        "times": M|null}``;
+      - a JSON array of such objects;
+      - ``{"seed": S, "plans": [...]}`` to also seed the registry's
+        PRNG for probability-triggered plans.
+
+    Returns the installed registry, or None when the spec cleared it.
+    """
+    import json
+
+    if not spec or spec.strip() in ("[]", "{}", "null", "off"):
+        clear()
+        return None
+    data = json.loads(spec)
+    seed = 0
+    if isinstance(data, dict) and "plans" in data:
+        seed = int(data.get("seed", 0))
+        data = data["plans"]
+    if isinstance(data, dict):
+        data = [data]
+    plans = [FaultPlan(**d) for d in data]
+    return install(*plans, seed=seed, events=events)
 
 
 @contextlib.contextmanager

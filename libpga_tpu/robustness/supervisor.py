@@ -96,6 +96,7 @@ class SupervisedReport:
     checkpoints: int = 0
     restored: bool = False  # this call resumed from a checkpoint
     aborted_on_stall: bool = False
+    stopped: bool = False  # the stop callback ended the run early
     target_reached: bool = False
     best_score: float = float("-inf")
     errors: List[str] = dataclasses.field(default_factory=list)
@@ -103,6 +104,29 @@ class SupervisedReport:
 
 def _meta_path(path: str) -> str:
     return f"{path}.meta.json"
+
+
+def _ckpt_file(path: str) -> str:
+    """The filename ``checkpoint.save`` actually writes for a
+    single-process save (np.savez appends .npz when missing)."""
+    return path if path.endswith(".npz") else f"{path}.npz"
+
+
+def _ckpt_sig(path: str) -> Optional[List[int]]:
+    """Identity of the current checkpoint FILE VERSION (mtime_ns +
+    size). Recorded in the sidecar after each save and checked at
+    resume: on a shared spool two processes can race on the same
+    checkpoint (a lease-expired-but-alive fleet worker finishing its
+    last chunk while a survivor resumes — serving/fleet.py), and a
+    resume that read sidecar@g but checkpoint@g+K would overrun the
+    generation budget. None when the file is not statable (e.g. the
+    multi-process per-shard format) — then the check is skipped, as
+    before."""
+    try:
+        st = os.stat(_ckpt_file(path))
+    except OSError:
+        return None
+    return [st.st_mtime_ns, st.st_size]
 
 
 def _write_meta(path: str, meta: dict) -> None:
@@ -207,6 +231,7 @@ def supervised_run(
     stall_abort_gens: int = 0,
     detect_nan: bool = True,
     resume: bool = False,
+    stop: Optional[Callable[[], bool]] = None,
     sleep: Callable[[float], None] = time.sleep,
 ) -> SupervisedReport:
     """Run ``pga`` for up to ``n`` generations under supervision.
@@ -231,6 +256,15 @@ def supervised_run(
       detect_nan: treat NaN scores after a chunk as a failure.
       resume: restore ``checkpoint_path`` (+ its progress sidecar)
         before running — the crash-recovery entry point.
+      stop: polled AFTER each completed (and checkpointed) chunk; a
+        True return ends the run at that chunk boundary with
+        ``report.stopped`` set. This is the preemption-safe drain hook
+        (``serving/worker.py``): because the check sits on a chunk
+        boundary, the durable checkpoint + sidecar written for that
+        chunk is exactly the state a later ``resume=True`` continues
+        from, and the resumed run replays the SAME cadence — so a
+        stopped-and-resumed run stays bit-identical to an uninterrupted
+        one.
       sleep: backoff sleeper (injectable for tests).
 
     Returns a :class:`SupervisedReport`. Raises the last chunk error
@@ -252,8 +286,18 @@ def supervised_run(
     if resume:
         if not checkpoint_path:
             raise ValueError("resume=True needs a checkpoint_path")
-        meta = read_meta(checkpoint_path)
-        _ckpt.restore(pga, checkpoint_path)
+        # Consistent (sidecar, checkpoint) pair: when the sidecar
+        # carries a checkpoint signature, re-read until the checkpoint
+        # file matches it AFTER the restore — otherwise a concurrent
+        # writer's save landing mid-resume could pair sidecar@g with
+        # checkpoint@g+K and the resumed run would overrun ``n``.
+        for _ in range(40):
+            meta = read_meta(checkpoint_path)
+            _ckpt.restore(pga, checkpoint_path)
+            want = None if meta is None else meta.get("ckpt_sig")
+            if want is None or _ckpt_sig(checkpoint_path) == list(want):
+                break
+            sleep(0.05)
         report.restored = True
         if meta is not None:
             done = int(meta.get("generations", 0))
@@ -273,6 +317,7 @@ def supervised_run(
                 "generations": generations,
                 "n": n,
                 "target_reached": report.target_reached,
+                "ckpt_sig": _ckpt_sig(checkpoint_path),
             },
         )
         # Durability cost per auto-checkpoint (atomic save + sidecar):
@@ -337,6 +382,10 @@ def supervised_run(
             _metrics.REGISTRY.counter("supervisor.stall_aborts").bump()
             _tl.flight_dump("stall_abort")
             break
+        if stop is not None and done < n and not report.target_reached:
+            if stop():
+                report.stopped = True
+                break
 
     report.generations = done
     report.best_score = _best(pga)

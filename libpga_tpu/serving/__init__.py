@@ -34,11 +34,31 @@ Failure semantics (ISSUE 5 — the contracts a serving operator leans on):
   ``serving.flusher`` fault) is replaced on the next submit.
 - ``ticket.result(timeout=...)`` raising ``TimeoutError`` leaves the
   ticket re-awaitable — call ``result()`` again to keep waiting.
+- ``RunQueue.close()`` is idempotent under CONCURRENT closers: one
+  caller tears down, every other close() waits for it and no-ops.
+
+Fleet layer (ISSUE 8 — ``serving/fleet.py`` + ``serving/worker.py``):
+:class:`Fleet` lifts all of the above across PROCESSES — a coordinator
+owns ticket intake and N supervised worker processes claim shape-bucket
+batches under time-bounded heartbeat leases, with fleet-level
+dead-lettering (:class:`FleetDeadLetter` after ``max_worker_deaths``),
+fleet-wide ``max_pending`` backpressure, and preemption-safe SIGTERM
+draining through the supervisor's checkpoint machinery. A worker killed
+mid-batch (SIGKILL included) has its lease expire and its batch re-run
+bit-identically on a survivor: seeds and runtime parameters travel with
+the ticket, never with the worker.
 """
 
-from libpga_tpu.config import ServingConfig, SLOConfig
+from libpga_tpu.config import FleetConfig, ServingConfig, SLOConfig
 from libpga_tpu.serving.batch import BatchedRuns, RunRequest, RunResult
 from libpga_tpu.serving.cache import COUNTERS, PROGRAM_CACHE, ProgramCache
+from libpga_tpu.serving.fleet import (
+    Fleet,
+    FleetDeadLetter,
+    FleetHandle,
+    FleetResult,
+    FleetTicket,
+)
 from libpga_tpu.serving.queue import (
     DeadLetter,
     QueueFull,
@@ -58,6 +78,12 @@ __all__ = [
     "QueueFull",
     "ServingConfig",
     "SLOConfig",
+    "FleetConfig",
+    "Fleet",
+    "FleetTicket",
+    "FleetHandle",
+    "FleetResult",
+    "FleetDeadLetter",
     "ProgramCache",
     "PROGRAM_CACHE",
     "COUNTERS",

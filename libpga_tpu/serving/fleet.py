@@ -1,0 +1,843 @@
+"""Cross-process serving fleet: coordinator, spool protocol, leases.
+
+The round-9/10 serving stack is single-process: one ``RunQueue`` in one
+interpreter — a worker crash kills every pending ticket, and there is no
+notion of a fleet surviving preemption. This module is the coordinator
+half of the fleet (ISSUE 8; ROADMAP item 1 — the distributed
+master/worker execution model the Beagle framework treats as
+first-class, and the reference's aspirational "+MPI" made real): ticket
+intake, shape-bucket batch formation, time-bounded leases, fleet-level
+dead-lettering, fleet-wide backpressure, and preemption-safe draining.
+``serving/worker.py`` is the worker half.
+
+**Spool protocol.** All cross-process state lives in one spool
+directory; every transition is an atomic filesystem operation, so a
+process killed at ANY instant (SIGKILL included) leaves the spool in a
+recoverable state — the same durability stance as
+``utils/checkpoint``'s temp-write + rename:
+
+- ``pending/<batch>.json`` — claimable batch files the coordinator
+  writes (temp + ``os.replace``). A batch carries the executor spec,
+  the ticket list, and the ``attempts`` record of workers that lost
+  their lease on it.
+- ``claimed/<batch>.json`` — a worker claims a batch with ONE
+  ``os.rename(pending/x, claimed/x)``: atomic, so exactly one of N
+  racing workers wins.
+- ``leases/<batch>.lease.json`` — written by the claiming worker
+  (owner + pid), then touched every ``FleetConfig.heartbeat_s`` by its
+  heartbeat thread. The lease IS the liveness contract: a heartbeat
+  older than ``lease_timeout_s`` — worker wedged, SIGSTOPped, or its
+  heartbeat thread killed — expires the lease and the coordinator
+  requeues the batch; a worker PROCESS that exits while holding a
+  lease is requeued immediately (the coordinator watches the processes
+  it spawned).
+- ``results/<tid>.npz`` + ``results/<tid>.json`` — per-ticket results,
+  published FIRST-WRITER-WINS (``os.link``, which fails atomically on
+  an existing target). Seeds and runtime parameters travel with the
+  ticket, never with the worker, so a batch re-run after a worker
+  death lands bit-identical — a late duplicate publication from a
+  SIGSTOP-resumed worker is therefore identical bits, and the link
+  race is benign whoever wins.
+- ``ckpt/<tid>.npz`` (+ supervisor sidecar) — drain checkpoints of
+  supervised tickets; a re-claiming worker resumes from the last
+  durable checkpoint at the ticket's recorded cadence.
+- ``dead/`` — quarantined batches: a batch that cost
+  ``max_worker_deaths`` DISTINCT workers their lease is moved here
+  with a flight-recorder dump instead of being retried forever, and
+  its unfinished tickets fail with :class:`FleetDeadLetter`.
+- ``logs/`` — per-worker stdout, JSONL event logs, and a Prometheus
+  snapshot each worker writes on exit.
+
+**Bit-identity.** Plain tickets (``checkpoint_every == 0``) execute as
+shape-bucketed mega-runs through the worker's ``RunQueue``/
+``BatchedRuns`` engine — per-run bit-identical to standalone
+``PGA.run`` (the round-9 contract), so a killed-and-requeued batch
+re-runs to the same bits. Supervised tickets (``checkpoint_every >
+0``) execute under ``robustness.supervised_run`` at the ticket's
+cadence; SIGTERM drains them at a chunk boundary via the supervisor's
+``stop`` hook, and the per-process contract — a resumed run is
+bit-identical to an uninterrupted same-seed run at the same cadence —
+lifts unchanged to the fleet.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import errno
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from libpga_tpu.config import FleetConfig, PGAConfig
+from libpga_tpu.serving.queue import QueueFull
+from libpga_tpu.utils import metrics as _metrics
+from libpga_tpu.utils import telemetry as _tl
+from libpga_tpu.utils.telemetry import TelemetryConfig
+
+
+class FleetDeadLetter(RuntimeError):
+    """Raised by ``FleetHandle.result`` for a ticket whose batch was
+    quarantined after ``max_worker_deaths`` distinct workers lost their
+    lease on it (the fleet-level dead-letter policy)."""
+
+
+# ------------------------------------------------------------------- spool
+
+
+class Spool:
+    """Path layout + atomic-write helpers for one fleet spool directory.
+
+    Shared by the coordinator and the worker so the protocol cannot
+    drift between the two halves. Every mutation is a single atomic
+    filesystem operation (``os.replace`` / ``os.rename`` / ``os.link``).
+    """
+
+    DIRS = ("pending", "claimed", "leases", "results", "dead", "ckpt",
+            "logs")
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        for d in self.DIRS:
+            os.makedirs(os.path.join(self.root, d), exist_ok=True)
+
+    def path(self, *parts: str) -> str:
+        return os.path.join(self.root, *parts)
+
+    # ---------------------------------------------------------- json files
+
+    @staticmethod
+    def read_json(path: str) -> Optional[dict]:
+        """The parsed file, or None when it is gone or torn mid-read
+        (both are normal under concurrent rename — callers retry or
+        skip)."""
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                return json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    @staticmethod
+    def write_json(path: str, obj: dict) -> None:
+        """Atomic write: temp file + ``os.replace``."""
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(obj, fh)
+        os.replace(tmp, path)
+
+    @staticmethod
+    def publish(tmp: str, final: str) -> bool:
+        """First-writer-wins publication: link ``tmp`` to ``final``;
+        True when this process's copy won, False when a result already
+        existed (ours is discarded). ``tmp`` is removed either way."""
+        try:
+            os.link(tmp, final)
+            return True
+        except OSError as e:
+            if e.errno != errno.EEXIST:
+                raise
+            return False
+        finally:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+    # --------------------------------------------------------------- names
+
+    def pending_batches(self) -> List[str]:
+        try:
+            names = os.listdir(self.path("pending"))
+        except OSError:
+            return []
+        return sorted(n for n in names if n.endswith(".json"))
+
+    def claimed_batches(self) -> List[str]:
+        try:
+            names = os.listdir(self.path("claimed"))
+        except OSError:
+            return []
+        return sorted(n for n in names if n.endswith(".json"))
+
+    def lease_path(self, batch_name: str) -> str:
+        return self.path("leases", f"{batch_name}.lease.json")
+
+    def result_paths(self, tid: str) -> Tuple[str, str]:
+        """(npz, meta-json) result paths for one ticket."""
+        return (
+            self.path("results", f"{tid}.npz"),
+            self.path("results", f"{tid}.json"),
+        )
+
+    def ckpt_path(self, tid: str) -> str:
+        return self.path("ckpt", f"{tid}.npz")
+
+
+# ---------------------------------------------------- config serialization
+
+#: PGAConfig fields that cross the process boundary verbatim. gene_dtype
+#: and telemetry need encoding and are handled separately.
+_CONFIG_FIELDS = (
+    "tournament_size", "selection", "selection_param", "mutation_rate",
+    "elitism", "max_populations", "migration_topology", "use_pallas",
+    "pallas_deme_size", "pallas_generations_per_launch", "pallas_layout",
+    "pallas_subblock", "pop_shards", "donate_buffers", "validate",
+    "fallback", "seed",
+)
+
+
+def config_to_json(cfg: PGAConfig) -> dict:
+    """A JSON-safe encoding of the program-shaping config fields — what
+    a worker needs to rebuild a bit-identical executor. Event-log paths
+    are deliberately NOT carried (each worker logs into the spool)."""
+    out = {f: getattr(cfg, f) for f in _CONFIG_FIELDS}
+    out["gene_dtype"] = np.dtype(cfg.gene_dtype).name
+    t = cfg.telemetry
+    out["telemetry_history_gens"] = None if t is None else t.history_gens
+    return out
+
+
+def config_from_json(data: dict) -> PGAConfig:
+    """Inverse of :func:`config_to_json`."""
+    kw = {f: data[f] for f in _CONFIG_FIELDS if f in data}
+    name = data.get("gene_dtype", "float32")
+    if name == "bfloat16":
+        import jax.numpy as jnp
+
+        kw["gene_dtype"] = jnp.bfloat16
+    else:
+        kw["gene_dtype"] = np.dtype(name)
+    hist = data.get("telemetry_history_gens")
+    if hist is not None:
+        kw["telemetry"] = TelemetryConfig(history_gens=int(hist))
+    return PGAConfig(**kw)
+
+
+# ----------------------------------------------------------------- tickets
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetTicket:
+    """One GA run submitted to the fleet.
+
+    Everything a worker needs travels here (never with the worker):
+    shape, budget, seed, runtime parameters, and the supervision
+    cadence. ``checkpoint_every == 0`` is a PLAIN ticket — executed as
+    part of a shape-bucketed mega-run, recovered after a worker death
+    by re-running the batch (bit-identical, the round-9 contract).
+    ``checkpoint_every > 0`` is a SUPERVISED ticket — executed under
+    ``robustness.supervised_run`` at that cadence with its durable
+    checkpoint in the spool, so drains and deaths resume from the last
+    chunk boundary. ``max_retries`` bounds the supervisor's in-worker
+    retries; failures beyond it escalate to a worker death and the
+    fleet's lease-requeue path."""
+
+    size: int
+    genome_len: int
+    n: int
+    seed: int
+    target: Optional[float] = None
+    mutation_rate: Optional[float] = None
+    mutation_sigma: Optional[float] = None
+    checkpoint_every: int = 0
+    max_retries: int = 1
+
+    def __post_init__(self):
+        if self.size < 1 or self.genome_len < 1:
+            raise ValueError(
+                f"invalid shape ({self.size}, {self.genome_len})"
+            )
+        if self.n < 0:
+            raise ValueError("n must be >= 0")
+        if self.checkpoint_every < 0:
+            raise ValueError("checkpoint_every must be >= 0")
+        if self.max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
+
+
+class FleetResult:
+    """One completed ticket, loaded from the spool (host arrays)."""
+
+    def __init__(self, genomes, scores, generations, best_score, worker):
+        self.genomes = genomes
+        self.scores = scores
+        self.generations = int(generations)
+        self.best_score = float(best_score)
+        self.worker = worker  # which worker published it
+
+    def best(self) -> np.ndarray:
+        return np.asarray(self.genomes[int(np.argmax(self.scores))])
+
+
+class FleetHandle:
+    """Handle for one submitted fleet ticket (``Fleet.submit``)."""
+
+    def __init__(self, fleet: "Fleet", tid: str, ticket: FleetTicket):
+        self.tid = tid
+        self.ticket = ticket
+        self._fleet = fleet
+
+    def poll(self) -> bool:
+        """True once a result (or a dead-letter verdict) is durable."""
+        return self._fleet._meta(self.tid) is not None
+
+    def result(self, timeout: Optional[float] = None) -> FleetResult:
+        """Block for the ticket's result. Raises
+        :class:`FleetDeadLetter` when its batch was quarantined, and
+        ``TimeoutError`` (handle stays re-awaitable) on timeout."""
+        return self._fleet._await(self.tid, timeout)
+
+
+def _now() -> float:
+    return time.monotonic()
+
+
+# ------------------------------------------------------------- coordinator
+
+
+class _Bucket:
+    __slots__ = ("tickets", "oldest")
+
+    def __init__(self):
+        self.tickets: List[Tuple[str, FleetTicket]] = []
+        self.oldest: float = _now()
+
+
+class Fleet:
+    """Coordinator of a cross-process serving fleet.
+
+    One ``Fleet`` owns one tenant configuration (objective name +
+    ``PGAConfig``) and one spool directory; shape buckets still form per
+    ticket shape. Usage::
+
+        fleet = Fleet(spool_dir, "onemax", config=PGAConfig(...))
+        fleet.start()                       # spawn N worker processes
+        h = fleet.submit(FleetTicket(size=4096, genome_len=64, n=50,
+                                     seed=7))
+        res = h.result(timeout=120)         # bit-identical to PGA.run
+        fleet.drain()                       # SIGTERM: checkpoint + exit
+        fleet.start()                       # fresh workers resume
+        fleet.close()
+
+    The objective must be a NAMED builtin (``libpga_tpu.objectives``):
+    it crosses a process boundary, so it must be reconstructible by
+    name — the same constraint the C ABI's serving path has.
+    """
+
+    def __init__(
+        self,
+        spool_dir: str,
+        objective: str,
+        config: Optional[PGAConfig] = None,
+        fleet: Optional[FleetConfig] = None,
+        mutate_kind: str = "point",
+        events=None,
+        registry: Optional[_metrics.MetricsRegistry] = None,
+    ):
+        if not isinstance(objective, str):
+            raise ValueError(
+                "Fleet needs a NAMED objective (it crosses process "
+                "boundaries) — pass a libpga_tpu.objectives name"
+            )
+        from libpga_tpu import objectives
+
+        objectives.get(objective)  # fail fast on unknown names
+        self.spool = Spool(spool_dir)
+        self.objective = objective
+        self.config = config or PGAConfig()
+        self.fleet = fleet or FleetConfig()
+        self.mutate_kind = mutate_kind
+        self.events = events
+        self.registry = registry if registry is not None else _metrics.REGISTRY
+        self._lock = threading.RLock()
+        self._buckets: Dict[tuple, _Bucket] = {}
+        self._handles: Dict[str, FleetHandle] = {}
+        self._meta_cache: Dict[str, dict] = {}
+        self._workers: Dict[str, subprocess.Popen] = {}
+        self._worker_gone: set = set()  # exits already accounted
+        self._hb_seen: Dict[str, float] = {}  # batch -> last lease mtime
+        self._tid_seq = 0
+        self._batch_seq = 0
+        # Coordinator instance token: batch names must never collide
+        # with a previous coordinator's leftovers on the same spool
+        # (a restarted fleet resumes pending work, it never overwrites
+        # it).
+        self._token = f"{os.getpid():x}-{os.urandom(3).hex()}"
+        self._closed = False
+        self._monitor: Optional[threading.Thread] = None
+        self._stop_monitor = threading.Event()
+        self._cv = threading.Condition()  # completion/backpressure wakeups
+        self.submitted = 0
+        self.completed = 0
+        self.requeues = 0
+        self.worker_deaths = 0
+        self.quarantined: List[str] = []  # batch names moved to dead/
+
+    # --------------------------------------------------------------- events
+
+    def _emit(self, event: str, **fields) -> None:
+        _tl.flight_note(event, fields)  # post-mortem ring, always on
+        if self.events is not None:
+            self.events.emit(event, **fields)
+
+    # -------------------------------------------------------------- workers
+
+    def start(self, worker_env: Optional[Dict[int, dict]] = None) -> List[str]:
+        """Spawn ``FleetConfig.n_workers`` worker processes against the
+        spool and start the monitor. Safe to call again after
+        :meth:`drain` — fresh workers pick up pending and checkpointed
+        work. ``worker_env`` maps worker INDEX to extra environment
+        variables (the chaos hooks ``PGA_FAULT_SPEC`` /
+        ``PGA_WORKER_CHAOS`` ride here in tests). Returns worker ids."""
+        if self._closed:
+            raise RuntimeError("fleet is closed")
+        spawned = []
+        # PRNG semantics must MATCH across the process boundary or the
+        # fleet's bit-identity contract is void: the coordinator may
+        # have flipped threefry partitionability via jax.config (not
+        # the environment — e.g. the test harness), and a worker left
+        # on the default would derive different random streams from
+        # the very same ticket seed.
+        try:
+            import jax
+
+            threefry = "1" if jax.config.jax_threefry_partitionable else "0"
+        except Exception:
+            threefry = None
+        with self._lock:
+            base = len(self._workers)
+            for i in range(self.fleet.n_workers):
+                wid = f"w{base + i}"
+                out = open(  # worker stdout/stderr, for post-mortems
+                    self.spool.path("logs", f"{wid}.out"), "ab"
+                )
+                env = dict(os.environ)
+                if threefry is not None:
+                    env["JAX_THREEFRY_PARTITIONABLE"] = threefry
+                if worker_env and i in worker_env:
+                    env.update(worker_env[i])
+                proc = subprocess.Popen(
+                    [
+                        sys.executable, "-m", "libpga_tpu.serving.worker",
+                        "--spool", self.spool.root,
+                        "--worker-id", wid,
+                        "--heartbeat-s", str(self.fleet.heartbeat_s),
+                        "--poll-s", str(self.fleet.poll_s),
+                    ],
+                    stdout=out, stderr=subprocess.STDOUT, env=env,
+                )
+                out.close()  # the child holds its own descriptor
+                self._workers[wid] = proc
+                spawned.append(wid)
+                self._emit("worker_spawn", worker=wid, pid=proc.pid)
+                self.registry.gauge("fleet.worker.up", worker=wid).set(1)
+        self._alive_gauge()
+        self._ensure_monitor()
+        return spawned
+
+    def workers_alive(self) -> List[str]:
+        with self._lock:
+            return [
+                wid for wid, p in self._workers.items()
+                if p.poll() is None
+            ]
+
+    def _alive_gauge(self) -> None:
+        self.registry.gauge("fleet.workers.alive").set(
+            len(self.workers_alive())
+        )
+
+    # ---------------------------------------------------------------- admit
+
+    def _outstanding(self) -> int:
+        return self.submitted - self.completed
+
+    def _admit_slot(self) -> None:
+        limit = self.fleet.max_pending
+        if limit is None:
+            return
+        with self._cv:
+            while self._outstanding() >= limit:
+                if self._closed:
+                    raise RuntimeError("fleet is closed")
+                if self.fleet.overflow == "raise":
+                    raise QueueFull(
+                        f"{self._outstanding()} outstanding fleet tickets"
+                        f" >= max_pending={limit}"
+                    )
+                self._cv.wait(timeout=0.05)
+
+    def _bucket_key(self, t: FleetTicket) -> tuple:
+        # Supervised tickets never co-batch with plain ones: the plain
+        # half of a batch is ONE mega-run, the supervised half is
+        # per-ticket engines — mixing them would couple a drainable
+        # ticket's latency to an undrainable dispatch.
+        return (t.size, t.genome_len, t.checkpoint_every > 0)
+
+    def submit(self, ticket: FleetTicket) -> FleetHandle:
+        """Admit one ticket; returns its handle. Applies the fleet-wide
+        backpressure policy first, then buckets the ticket; the bucket
+        becomes a claimable batch file at ``max_batch`` tickets or
+        ``max_wait_ms`` after its oldest admission."""
+        if self._closed:
+            raise RuntimeError("fleet is closed")
+        self._admit_slot()
+        with self._lock:
+            self._tid_seq += 1
+            # Token-qualified: a fresh coordinator on a reused spool
+            # must never see a previous run's results as its own.
+            tid = f"t{self._tid_seq:05d}-{self._token}"
+            handle = FleetHandle(self, tid, ticket)
+            self._handles[tid] = handle
+            key = self._bucket_key(ticket)
+            bucket = self._buckets.get(key)
+            if bucket is None:
+                bucket = self._buckets[key] = _Bucket()
+            if not bucket.tickets:
+                bucket.oldest = _now()
+            bucket.tickets.append((tid, ticket))
+            self.submitted += 1
+            self._emit(
+                "batch_admit", bucket=f"{ticket.size}x{ticket.genome_len}",
+                pending=len(bucket.tickets), population_size=ticket.size,
+                genome_len=ticket.genome_len,
+            )
+            if len(bucket.tickets) >= self.fleet.max_batch:
+                self._form_batch(key)
+        self.registry.gauge("fleet.tickets.outstanding").set(
+            self._outstanding()
+        )
+        self._ensure_monitor()
+        return handle
+
+    def flush(self) -> int:
+        """Write every non-empty bucket out as a pending batch file now
+        (returns batches formed) — the admission-window override."""
+        formed = 0
+        with self._lock:
+            for key in list(self._buckets):
+                if self._buckets[key].tickets:
+                    self._form_batch(key)
+                    formed += 1
+        return formed
+
+    def _form_batch(self, key: tuple) -> None:
+        """Turn one bucket's tickets into a claimable batch file
+        (caller holds the lock)."""
+        bucket = self._buckets[key]
+        tickets, bucket.tickets = bucket.tickets, []
+        self._batch_seq += 1
+        size, genome_len, supervised = key
+        name = (
+            f"b{self._batch_seq:05d}-{self._token}-{size}x{genome_len}"
+            f"{'-sup' if supervised else ''}.json"
+        )
+        batch = {
+            "batch": name,
+            "spec": {
+                "objective": self.objective,
+                "mutate_kind": self.mutate_kind,
+                "config": config_to_json(self.config),
+            },
+            "attempts": [],
+            "tickets": [
+                {"tid": tid, **dataclasses.asdict(t)}
+                for tid, t in tickets
+            ],
+        }
+        self.spool.write_json(self.spool.path("pending", name), batch)
+        self._emit(
+            "batch_launch", bucket=name, batch_size=len(tickets),
+            fill_ratio=round(len(tickets) / self.fleet.max_batch, 4),
+        )
+        self.registry.gauge("fleet.batches.pending").set(
+            len(self.spool.pending_batches())
+        )
+
+    # -------------------------------------------------------------- results
+
+    def _meta(self, tid: str) -> Optional[dict]:
+        meta = self._meta_cache.get(tid)
+        if meta is not None:
+            return meta
+        meta = self.spool.read_json(self.spool.result_paths(tid)[1])
+        if meta is not None:
+            self._meta_cache[tid] = meta
+        return meta
+
+    def _await(self, tid: str, timeout: Optional[float]) -> FleetResult:
+        deadline = None if timeout is None else _now() + timeout
+        self.flush()  # a lone ticket must not wait out max_wait_ms
+        while True:
+            meta = self._meta(tid)
+            if meta is not None:
+                break
+            if deadline is not None and _now() > deadline:
+                raise TimeoutError(
+                    f"fleet ticket {tid} not completed within {timeout}s"
+                )
+            with self._cv:
+                self._cv.wait(timeout=self.fleet.poll_s)
+        if meta.get("error"):
+            raise FleetDeadLetter(
+                f"ticket {tid} dead-lettered: {meta['error']}"
+            )
+        npz_path = self.spool.result_paths(tid)[0]
+        from libpga_tpu.utils.checkpoint import _decode
+
+        with np.load(npz_path) as data:
+            genomes = _decode(
+                data["genomes"], str(data["genomes_dtype"])
+            ).copy()
+            scores = data["scores"].copy()
+            gens = int(data["generations"])
+        return FleetResult(
+            genomes, scores, gens, meta["best_score"], meta.get("worker")
+        )
+
+    # -------------------------------------------------------------- monitor
+
+    def _ensure_monitor(self) -> None:
+        with self._lock:
+            if self._monitor is not None and self._monitor.is_alive():
+                return
+            if self._closed:
+                return
+            self._stop_monitor.clear()
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, name="pga-fleet-monitor",
+                daemon=True,
+            )
+            self._monitor.start()
+
+    def _monitor_loop(self) -> None:
+        while not self._stop_monitor.wait(self.fleet.poll_s):
+            try:
+                self._tick()
+            except Exception:
+                # The monitor is the fleet's recovery engine — one bad
+                # scan (e.g. a file racing a rename) must not stop it.
+                pass
+
+    def _tick(self) -> None:
+        now = _now()
+        # 1. Admission window: flush buckets past max_wait_ms.
+        with self._lock:
+            deadline = now - self.fleet.max_wait_ms / 1000.0
+            for key, b in list(self._buckets.items()):
+                if b.tickets and b.oldest <= deadline:
+                    self._form_batch(key)
+        # 2. Completions: new result metas wake blocked result()/submit().
+        fresh = False
+        for tid in list(self._handles):
+            if tid in self._meta_cache:
+                continue
+            meta = self._meta(tid)
+            if meta is not None:
+                fresh = True
+                self.completed += 1
+                self.registry.counter("fleet.tickets.completed").bump()
+        if fresh:
+            self.registry.gauge("fleet.tickets.outstanding").set(
+                self._outstanding()
+            )
+            with self._cv:
+                self._cv.notify_all()
+        # 3. Worker liveness: a worker that EXITED while holding a lease
+        # is requeued immediately (no need to wait out the lease).
+        lease_owner: Dict[str, str] = {}
+        for name in self.spool.claimed_batches():
+            lease = self.spool.read_json(self.spool.lease_path(name))
+            if lease is not None:
+                lease_owner[name] = lease.get("worker", "?")
+        with self._lock:
+            workers = dict(self._workers)
+        for wid, proc in workers.items():
+            rc = proc.poll()
+            if rc is None or wid in self._worker_gone:
+                continue
+            self._worker_gone.add(wid)
+            self.registry.gauge("fleet.worker.up", worker=wid).set(0)
+            if rc == 0:
+                self._emit("worker_exit", worker=wid, returncode=0)
+            else:
+                self.worker_deaths += 1
+                self.registry.counter(
+                    "fleet.worker.deaths", worker=wid
+                ).bump()
+                self._emit("worker_death", worker=wid, returncode=rc)
+                for name, owner in lease_owner.items():
+                    if owner == wid:
+                        self._requeue(name, wid, "worker_died")
+            self._alive_gauge()
+        # 4. Lease expiry: stale heartbeats (SIGSTOP, wedged worker,
+        # dead heartbeat thread) requeue the batch onto a survivor.
+        for name in self.spool.claimed_batches():
+            lease_path = self.spool.lease_path(name)
+            try:
+                mtime = os.stat(lease_path).st_mtime
+            except OSError:
+                # Claimed but no lease yet: age from the claim itself.
+                try:
+                    mtime = os.stat(
+                        self.spool.path("claimed", name)
+                    ).st_ctime
+                except OSError:
+                    continue  # finished/requeued under us
+            last = self._hb_seen.get(name)
+            if last is not None and mtime > last:
+                self.registry.counter("fleet.lease.heartbeats").bump()
+            self._hb_seen[name] = mtime
+            if time.time() - mtime > self.fleet.lease_timeout_s:
+                self._requeue(
+                    name, lease_owner.get(name, "?"), "lease_expired"
+                )
+
+    # -------------------------------------------------- requeue / quarantine
+
+    def _requeue(self, name: str, worker: str, reason: str) -> None:
+        """Recover one claimed batch whose worker lost its lease:
+        requeue it for a surviving worker, or quarantine it once
+        ``max_worker_deaths`` distinct workers have failed on it."""
+        claimed = self.spool.path("claimed", name)
+        batch = self.spool.read_json(claimed)
+        if batch is None:
+            return  # already finished or requeued
+        # Invalidate the lease FIRST: a SIGSTOP-resumed worker notices
+        # the missing lease (heartbeat utime fails) and abandons the
+        # batch instead of racing the re-run.
+        try:
+            os.remove(self.spool.lease_path(name))
+        except OSError:
+            pass
+        self._hb_seen.pop(name, None)
+        attempts = list(batch.get("attempts", []))
+        attempts.append(worker)
+        batch["attempts"] = attempts
+        distinct = len(set(attempts))
+        unfinished = [
+            t for t in batch["tickets"] if self._meta(t["tid"]) is None
+        ]
+        if not unfinished:
+            # Every ticket's result landed before the worker lost its
+            # lease (death between publish and cleanup) — nothing to
+            # re-run, just retire the batch file.
+            try:
+                os.remove(claimed)
+            except OSError:
+                pass
+            return
+        if distinct >= self.fleet.max_worker_deaths:
+            self._quarantine(name, claimed, batch, unfinished)
+            return
+        self.spool.write_json(claimed, batch)
+        try:
+            os.rename(claimed, self.spool.path("pending", name))
+        except OSError:
+            return  # raced a concurrent transition; next tick re-scans
+        self.requeues += 1
+        self.registry.counter("fleet.lease.requeues").bump()
+        self._emit(
+            "lease_requeue", batch=name, worker=worker, reason=reason,
+            attempts=distinct,
+        )
+
+    def _quarantine(
+        self, name: str, claimed: str, batch: dict, unfinished: List[dict]
+    ) -> None:
+        """Fleet-level dead-letter: the batch has now cost
+        ``max_worker_deaths`` distinct workers their lease — park it in
+        ``dead/`` with a flight-recorder dump and fail its unfinished
+        tickets instead of feeding it more workers."""
+        dead = self.spool.path("dead", name)
+        self.spool.write_json(claimed, batch)
+        try:
+            os.rename(claimed, dead)
+        except OSError:
+            return
+        self.quarantined.append(name)
+        error = (
+            f"batch {name} quarantined: {len(set(batch['attempts']))} "
+            f"distinct workers lost their lease on it "
+            f"(attempts: {batch['attempts']})"
+        )
+        for t in unfinished:
+            self._publish_error(t["tid"], error)
+        self.registry.counter("fleet.dead_letters").bump()
+        self._emit("dead_letter", bucket=name, error=error)
+        _tl.FLIGHT.dump(
+            path=self.spool.path("dead", f"{name}.flight.jsonl"),
+            reason="fleet_dead_letter",
+        )
+        with self._cv:
+            self._cv.notify_all()
+
+    def _publish_error(self, tid: str, error: str) -> None:
+        """Durable per-ticket failure verdict — first-writer-wins, so a
+        ticket whose result landed before quarantine keeps it."""
+        _, meta_path = self.spool.result_paths(tid)
+        tmp = f"{meta_path}.{os.getpid()}.err.tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump({"tid": tid, "error": error}, fh)
+        self.spool.publish(tmp, meta_path)
+
+    # ------------------------------------------------------- drain / close
+
+    def drain(self, timeout: Optional[float] = None) -> int:
+        """Preemption-safe drain: SIGTERM every live worker and wait for
+        it to exit. Workers checkpoint in-flight supervised runs at the
+        next chunk boundary (atomic checkpoint + sidecar), return their
+        leases by writing unfinished work back to ``pending/``, and
+        exit cleanly; a worker that overruns ``drain_timeout_s`` is
+        SIGKILLed (its batch is then recovered by the normal
+        lease-expiry path). Pending work and handles survive —
+        :meth:`start` afterwards resumes the fleet. Returns the number
+        of workers that exited."""
+        timeout = self.fleet.drain_timeout_s if timeout is None else timeout
+        with self._lock:
+            procs = {
+                wid: p for wid, p in self._workers.items()
+                if p.poll() is None
+            }
+        for p in procs.values():
+            try:
+                p.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        deadline = _now() + timeout
+        for wid, p in procs.items():
+            try:
+                p.wait(timeout=max(deadline - _now(), 0.1))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait(timeout=10)
+        self._alive_gauge()
+        return len(procs)
+
+    def close(self) -> None:
+        """Drain the workers, persist unformed buckets to the spool
+        (nothing in memory only), and stop the monitor. Unfinished work
+        stays claimable — a later ``Fleet`` on the same spool directory
+        can pick it up."""
+        if self._closed:
+            return
+        self.flush()
+        self.drain()
+        self._closed = True
+        self._stop_monitor.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5)
+        with self._cv:
+            self._cv.notify_all()
+
+    def __enter__(self) -> "Fleet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

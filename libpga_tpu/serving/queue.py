@@ -217,6 +217,12 @@ class RunQueue:
         self._closed = False
         self._flusher: Optional[threading.Thread] = None
         self._wake = threading.Event()  # close() interrupts the flusher nap
+        # close() idempotence under CONCURRENT closers: the first caller
+        # does the teardown; every other close() waits for it to finish
+        # and returns — a deterministic no-op, never a double teardown.
+        self._close_lock = threading.Lock()
+        self._close_started = False
+        self._close_done = threading.Event()
         # Backpressure accounting: tickets admitted but not completed.
         self._pending = 0
         self._pending_cv = threading.Condition()
@@ -562,16 +568,31 @@ class RunQueue:
         ``_flush_loop`` iteration can race a post-close launch, and a
         ``submit`` after ``close()`` returns always raises. Blocked
         ``submit`` callers (overflow="block") are released with the
-        closed error."""
+        closed error.
+
+        Idempotent under concurrency: exactly ONE caller performs the
+        teardown; any close() racing it (or arriving later) waits for
+        that teardown to finish — up to ``timeout`` — and returns
+        without flushing or joining anything itself, so concurrent
+        closers can never double-launch a bucket or observe a
+        half-closed queue."""
+        with self._close_lock:
+            first, self._close_started = not self._close_started, True
+        if not first:
+            self._close_done.wait(timeout)
+            return
         with self._lock:
             self._closed = True
             flusher, self._flusher = self._flusher, None
         self._wake.set()
         if flusher is not None and flusher.is_alive():
             flusher.join(timeout)
-        self.flush()
-        with self._pending_cv:
-            self._pending_cv.notify_all()
+        try:
+            self.flush()
+            with self._pending_cv:
+                self._pending_cv.notify_all()
+        finally:
+            self._close_done.set()
 
     def __enter__(self) -> "RunQueue":
         return self

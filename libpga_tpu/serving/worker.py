@@ -1,0 +1,524 @@
+"""Fleet worker process: claim → lease → execute → publish.
+
+The worker half of the cross-process serving fleet (ISSUE 8; the
+coordinator and spool protocol live in ``serving/fleet.py``). One
+worker is one OS process running this module's :func:`main` —
+``python -m libpga_tpu.serving.worker --spool DIR --worker-id w0`` —
+wrapping the existing round-9/10 execution engines in a
+``robustness.supervisor``-style harness:
+
+- **claim**: one atomic ``os.rename(pending/x, claimed/x)`` per batch
+  (exactly one of N racing workers wins), followed by the lease file
+  and a heartbeat thread touching it every ``--heartbeat-s``;
+- **plain tickets** (``checkpoint_every == 0``) run as ONE
+  shape-bucketed mega-run through a worker-local
+  ``RunQueue``/``BatchedRuns`` — the round-9 engine unchanged, with its
+  per-ticket failure isolation: a statically poisoned ticket
+  dead-letters locally (its error is published as the ticket's
+  verdict) while every co-batched ticket completes. The worker's
+  AOT program cache (``serving/cache.PROGRAM_CACHE``) is per-process,
+  so repeated same-bucket batches compile once per worker — the
+  fleet's cache warm-up story;
+- **supervised tickets** (``checkpoint_every > 0``) run under
+  ``robustness.supervised_run`` at the ticket's cadence with their
+  durable checkpoint in the spool (``ckpt/<tid>.npz`` + sidecar). A
+  ticket whose checkpoint already exists RESUMES from it — that is the
+  recovery path for both drains and worker deaths, and the
+  per-process bit-identity contract (resumed == uninterrupted at the
+  same cadence) carries the fleet's;
+- **drain** (SIGTERM): the supervisor's ``stop`` hook ends the
+  in-flight supervised run at the next chunk boundary — checkpointed
+  via the existing atomic temp-write + rename + sidecar machinery —
+  unfinished tickets are written back to ``pending/`` and the lease is
+  returned; the worker then exits 0;
+- **publish**: per-ticket results land first-writer-wins (``os.link``)
+  — a worker that lost its lease (SIGSTOP + requeue) may finish late
+  and publish bits identical to the re-run's, so the race is benign;
+  before retiring the batch file it re-checks lease ownership and
+  abandons cleanup if the coordinator reassigned the batch.
+
+Chaos hooks (environment, set per worker by the coordinator's
+``start(worker_env=...)`` in tests and ``tools/chaos_smoke.py`` /
+``tools/fleet_smoke.py``):
+
+- ``PGA_FAULT_SPEC``: a ``robustness.faults.install_spec`` JSON —
+  deterministic in-process faults, including the fleet sites
+  ``worker.execute`` (a raise kills the worker process mid-batch) and
+  ``worker.heartbeat`` (a raise kills only the heartbeat thread, so
+  the lease expires under a still-computing worker);
+- ``PGA_WORKER_CHAOS``: comma-separated ``<signal>@execute:<n>``
+  directives (``sigkill``/``sigstop``) — the worker sends ITSELF the
+  real signal at the start of its n-th batch execution, giving tests a
+  deterministic kill -9 / preemption-pause mid-batch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from libpga_tpu.robustness import faults as _faults
+from libpga_tpu.serving.fleet import Spool, config_from_json
+from libpga_tpu.utils import metrics as _metrics
+from libpga_tpu.utils import telemetry as _tl
+
+
+def _parse_chaos(spec: str) -> List[tuple]:
+    """``"sigkill@execute:2,sigstop@execute:1"`` → [(SIGKILL,
+    "execute", 2), ...]. Unknown entries raise — a chaos driver must
+    never silently test nothing."""
+    out = []
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        try:
+            signame, rest = part.split("@", 1)
+            site, n = rest.split(":", 1)
+            out.append(
+                (getattr(signal, signame.upper()), site, int(n))
+            )
+        except (ValueError, AttributeError):
+            raise ValueError(f"bad PGA_WORKER_CHAOS directive {part!r}")
+    return out
+
+
+class WorkerHarness:
+    """One fleet worker's claim/execute/publish loop."""
+
+    def __init__(
+        self,
+        spool_dir: str,
+        worker_id: str,
+        heartbeat_s: float = 0.5,
+        poll_s: float = 0.05,
+    ):
+        self.spool = Spool(spool_dir)
+        self.wid = worker_id
+        self.heartbeat_s = heartbeat_s
+        self.poll_s = poll_s
+        self.drain_evt = threading.Event()
+        self._lease_lost = threading.Event()
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._engines: Dict[str, tuple] = {}  # spec key -> (ex, queue)
+        self._exec_calls = 0
+        self._chaos = _parse_chaos(os.environ.get("PGA_WORKER_CHAOS", ""))
+        self.batches_done = 0
+        # Flight-recorder attribution (ISSUE 8 satellite): dumps from
+        # this process carry the worker id + pid in their trailer and
+        # land inside the spool for fleet post-mortems.
+        _tl.FLIGHT.worker_id = worker_id
+        _tl.FLIGHT.dump_dir = self.spool.path("logs")
+        self.events = _tl.EventLog(
+            self.spool.path("logs", f"{worker_id}.events.jsonl")
+        )
+
+    # --------------------------------------------------------------- events
+
+    def _emit(self, event: str, **fields) -> None:
+        _tl.flight_note(event, fields)
+        try:
+            self.events.emit(event, **fields)
+        except Exception:
+            pass  # a full disk must not take down the worker
+
+    # ---------------------------------------------------------------- lease
+
+    def _start_heartbeat(self, batch_name: str) -> None:
+        self._hb_stop.clear()
+        self._lease_lost.clear()
+        lease = self.spool.lease_path(batch_name)
+
+        def beat():
+            while not self._hb_stop.wait(self.heartbeat_s):
+                # Fault site (robustness/faults): a raise kills THIS
+                # thread only — the lease then expires under a live,
+                # still-computing worker (the injected lease-expiry
+                # scenario).
+                if _faults.PLAN is not None:
+                    _faults.PLAN.fire("worker.heartbeat")
+                try:
+                    os.utime(lease)
+                    _metrics.REGISTRY.counter("worker.heartbeats").bump()
+                except OSError:
+                    # Lease invalidated (coordinator requeued us):
+                    # signal the main loop to abandon the batch.
+                    self._lease_lost.set()
+                    return
+
+        self._hb_thread = threading.Thread(
+            target=beat, name=f"pga-hb-{self.wid}", daemon=True
+        )
+        self._hb_thread.start()
+
+    def _stop_heartbeat(self) -> None:
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2 * self.heartbeat_s + 1)
+            self._hb_thread = None
+
+    def _owns_lease(self, batch_name: str) -> bool:
+        lease = self.spool.read_json(self.spool.lease_path(batch_name))
+        return lease is not None and lease.get("worker") == self.wid
+
+    # ---------------------------------------------------------------- claim
+
+    def claim(self) -> Optional[str]:
+        """Claim the oldest pending batch via atomic rename; None when
+        nothing is claimable."""
+        for name in self.spool.pending_batches():
+            src = self.spool.path("pending", name)
+            dst = self.spool.path("claimed", name)
+            try:
+                os.rename(src, dst)
+            except OSError:
+                continue  # another worker won this one
+            self.spool.write_json(
+                self.spool.lease_path(name),
+                {"worker": self.wid, "pid": os.getpid(),
+                 "claimed": time.time()},
+            )
+            self._start_heartbeat(name)
+            self._emit("lease_claim", worker=self.wid, batch=name)
+            return name
+        return None
+
+    # -------------------------------------------------------------- engines
+
+    def _engine(self, spec: dict):
+        """Worker-local ``BatchedRuns`` + ``RunQueue`` for one executor
+        spec — cached per process, so every same-spec batch after the
+        first reuses the warm AOT program cache."""
+        import json as _json
+
+        key = _json.dumps(spec, sort_keys=True)
+        cached = self._engines.get(key)
+        if cached is not None:
+            return cached
+        from libpga_tpu.config import ServingConfig
+        from libpga_tpu.serving.batch import BatchedRuns
+        from libpga_tpu.serving.queue import RunQueue
+
+        cfg = config_from_json(spec["config"])
+        ex = BatchedRuns(
+            spec["objective"], config=cfg,
+            mutate_kind=spec.get("mutate_kind", "point"),
+        )
+        # max_wait_ms=0: the worker flushes explicitly per batch — no
+        # background flusher racing the claim loop. max_batch is a
+        # ceiling, never an admission trigger here.
+        queue = RunQueue(
+            ex, serving=ServingConfig(max_batch=4096, max_wait_ms=0)
+        )
+        self._engines[key] = (ex, queue)
+        return ex, queue
+
+    # -------------------------------------------------------------- publish
+
+    def _publish(self, tid: str, genomes, scores, gens) -> None:
+        from libpga_tpu.utils.checkpoint import _encode
+
+        npz_path, meta_path = self.spool.result_paths(tid)
+        g = np.asarray(genomes)
+        s = np.asarray(scores)
+        enc, dtype_name = _encode(g)
+        tmp = f"{npz_path}.{os.getpid()}.tmp.npz"
+        np.savez(
+            tmp, genomes=enc, genomes_dtype=np.asarray(dtype_name),
+            scores=s, generations=np.asarray(int(gens)),
+        )
+        self.spool.publish(tmp, npz_path)
+        import json as _json
+
+        mtmp = f"{meta_path}.{os.getpid()}.tmp"
+        with open(mtmp, "w", encoding="utf-8") as fh:
+            _json.dump(
+                {"tid": tid, "generations": int(gens),
+                 "best_score": float(np.max(s)), "worker": self.wid,
+                 "pid": os.getpid(), "error": None},
+                fh,
+            )
+        self.spool.publish(mtmp, meta_path)
+        _metrics.REGISTRY.counter("worker.tickets.published").bump()
+
+    def _publish_error(self, tid: str, error: BaseException) -> None:
+        import json as _json
+
+        _, meta_path = self.spool.result_paths(tid)
+        mtmp = f"{meta_path}.{os.getpid()}.tmp"
+        with open(mtmp, "w", encoding="utf-8") as fh:
+            _json.dump(
+                {"tid": tid, "worker": self.wid, "pid": os.getpid(),
+                 "error": f"{type(error).__name__}: {error}"},
+                fh,
+            )
+        self.spool.publish(mtmp, meta_path)
+
+    # -------------------------------------------------------------- execute
+
+    def _chaos_check(self) -> None:
+        for sig, site, n in self._chaos:
+            if site == "execute" and n == self._exec_calls:
+                os.kill(os.getpid(), sig)
+
+    def execute(self, name: str) -> None:
+        """Execute one claimed batch. On completion the batch file and
+        lease are retired; on drain the unfinished remainder returns to
+        ``pending/``; on a lost lease the batch is abandoned (results
+        already published stand — they are bit-identical to the
+        re-run's)."""
+        self._exec_calls += 1
+        self._chaos_check()
+        # Fault site (robustness/faults): a raise here propagates out of
+        # main() — the worker PROCESS dies mid-batch, which is exactly
+        # the failure the coordinator's liveness watch must recover.
+        if _faults.PLAN is not None:
+            _faults.PLAN.fire("worker.execute")
+        batch = self.spool.read_json(self.spool.path("claimed", name))
+        if batch is None:  # requeued/quarantined before we could start
+            self._stop_heartbeat()
+            return
+        done: set = set()
+        drained = False
+        plain = [
+            t for t in batch["tickets"]
+            if t["checkpoint_every"] == 0 and not self._has_result(t["tid"])
+        ]
+        supervised = [
+            t for t in batch["tickets"]
+            if t["checkpoint_every"] > 0 and not self._has_result(t["tid"])
+        ]
+        try:
+            if plain and not self._abandoned():
+                done |= self._run_plain(batch["spec"], plain)
+            for t in supervised:
+                if self._abandoned():
+                    break
+                if self.drain_evt.is_set():
+                    drained = True
+                    break
+                if self._run_supervised(name, batch["spec"], t):
+                    done.add(t["tid"])
+                else:
+                    drained = True  # stopped at a chunk boundary
+                    break
+        except BaseException:
+            # The worker is about to die mid-batch (injected fault,
+            # unexpected error): leave the claimed file AND the lease
+            # exactly as they are — the coordinator's death/lease
+            # recovery owns them now, and retiring either here would
+            # orphan the batch's unfinished tickets.
+            self._hb_stop.set()
+            raise
+        else:
+            self._finish_batch(name, batch, done, drained)
+
+    def _abandoned(self) -> bool:
+        return self._lease_lost.is_set()
+
+    def _has_result(self, tid: str) -> bool:
+        return (
+            self.spool.read_json(self.spool.result_paths(tid)[1])
+            is not None
+        )
+
+    def _run_plain(self, spec: dict, tickets: List[dict]) -> set:
+        """All plain tickets of the batch as ONE mega-run through the
+        worker-local RunQueue — per-ticket isolation included: a
+        poisoned ticket's error becomes its published verdict, innocent
+        co-batched tickets complete."""
+        from libpga_tpu.serving.batch import RunRequest
+
+        _, queue = self._engine(spec)
+        handles = []
+        for t in tickets:
+            req = RunRequest(
+                size=t["size"], genome_len=t["genome_len"], n=t["n"],
+                seed=t["seed"], target=t["target"],
+                mutation_rate=t["mutation_rate"],
+                mutation_sigma=t["mutation_sigma"],
+            )
+            handles.append((t["tid"], queue.submit(req)))
+        queue.drain()
+        done = set()
+        for tid, ticket in handles:
+            try:
+                res = ticket.result(timeout=None)
+            except BaseException as e:
+                self._publish_error(tid, e)
+            else:
+                self._publish(
+                    tid, res.genomes, res.scores, res.generations
+                )
+            done.add(tid)
+        return done
+
+    def _run_supervised(self, name: str, spec: dict, t: dict) -> bool:
+        """One supervised ticket at its cadence; True when it finished
+        (result published), False when the drain hook stopped it at a
+        chunk boundary (checkpoint durable, ticket stays unfinished).
+
+        The stop hook also re-checks LEASE OWNERSHIP each chunk: a
+        worker whose lease expired mid-run (stalled heartbeats) stops
+        at the next boundary instead of racing the re-claiming
+        survivor on the shared checkpoint for the rest of the run."""
+        import dataclasses as _dc
+
+        from libpga_tpu.engine import PGA
+        from libpga_tpu.robustness.supervisor import (
+            RetryPolicy,
+            supervised_run,
+        )
+
+        cfg = config_from_json(spec["config"])
+        if t["mutation_rate"] is not None:
+            cfg = _dc.replace(cfg, mutation_rate=t["mutation_rate"])
+        ckpt = self.spool.ckpt_path(t["tid"])
+        resume = os.path.exists(ckpt)
+        pga = PGA(seed=t["seed"], config=cfg)
+        pga.set_objective(spec["objective"])
+        if not resume:
+            pga.create_population(t["size"], t["genome_len"])
+        report = supervised_run(
+            pga, t["n"], target=t["target"], checkpoint_path=ckpt,
+            checkpoint_every=t["checkpoint_every"],
+            retry=RetryPolicy(max_retries=t.get("max_retries", 1)),
+            resume=resume,
+            stop=lambda: (
+                self.drain_evt.is_set()
+                or self._lease_lost.is_set()
+                or not self._owns_lease(name)
+            ),
+        )
+        if report.stopped:
+            return False
+        pop = pga.populations[0]
+        self._publish(
+            t["tid"], pop.genomes, pop.scores, report.generations
+        )
+        return True
+
+    def _finish_batch(
+        self, name: str, batch: dict, done: set, drained: bool
+    ) -> None:
+        """Retire, return, or abandon the claimed batch file."""
+        self._stop_heartbeat()
+        claimed = self.spool.path("claimed", name)
+        if not self._owns_lease(name):
+            # The coordinator invalidated our lease (expiry after a
+            # stalled heartbeat, SIGSTOP pause) — possibly another
+            # worker holds the batch now. Whatever we published is
+            # bit-identical to the re-run's, but the batch file and
+            # lease are no longer ours to touch.
+            self._emit(
+                "lease_requeue", batch=name, worker=self.wid,
+                reason="lost_lease_abandoned",
+            )
+            return
+        remaining = [
+            t for t in batch["tickets"]
+            if t["tid"] not in done and not self._has_result(t["tid"])
+        ]
+        if remaining and drained:
+            batch["tickets"] = remaining
+            self.spool.write_json(claimed, batch)
+            try:
+                os.rename(claimed, self.spool.path("pending", name))
+            except OSError:
+                pass
+        else:
+            try:
+                os.remove(claimed)
+            except OSError:
+                pass
+        try:
+            os.remove(self.spool.lease_path(name))
+        except OSError:
+            pass
+        self.batches_done += 1
+        _metrics.REGISTRY.counter("worker.batches.done").bump()
+
+    # ----------------------------------------------------------------- loop
+
+    def run_forever(self) -> int:
+        """Claim/execute until drained (SIGTERM). Returns the exit
+        code: 0 for a clean drain."""
+        self._emit("worker_spawn", worker=self.wid, pid=os.getpid())
+        clean = False
+        try:
+            while not self.drain_evt.is_set():
+                name = self.claim()
+                if name is None:
+                    if self.drain_evt.wait(self.poll_s):
+                        break
+                    continue
+                self.execute(name)
+            if self.drain_evt.is_set():
+                self._emit(
+                    "worker_drain", worker=self.wid,
+                    batches_done=self.batches_done,
+                )
+            clean = True
+        finally:
+            self._shutdown(clean)
+        return 0
+
+    def _shutdown(self, clean: bool = True) -> None:
+        self._stop_heartbeat()
+        for _, queue in self._engines.values():
+            try:
+                queue.close()
+            except Exception:
+                pass
+        # Per-worker metrics exposition for fleet post-mortems and the
+        # CI Prometheus lint (tools/fleet_smoke.py): this process's
+        # registry, rendered once at exit.
+        try:
+            snap = _metrics.REGISTRY.snapshot()
+            with open(
+                self.spool.path("logs", f"{self.wid}.prom"), "w",
+                encoding="utf-8",
+            ) as fh:
+                fh.write(_metrics.prometheus_text(snap))
+        except Exception:
+            pass
+        if clean:
+            self._emit("worker_exit", worker=self.wid, returncode=0)
+        try:
+            self.events.close()
+        except Exception:
+            pass
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--spool", required=True)
+    ap.add_argument("--worker-id", required=True)
+    ap.add_argument("--heartbeat-s", type=float, default=0.5)
+    ap.add_argument("--poll-s", type=float, default=0.05)
+    args = ap.parse_args(argv)
+
+    spec = os.environ.get("PGA_FAULT_SPEC", "")
+    if spec:
+        _faults.install_spec(spec)
+
+    harness = WorkerHarness(
+        args.spool, args.worker_id,
+        heartbeat_s=args.heartbeat_s, poll_s=args.poll_s,
+    )
+    # SIGTERM = preemption notice: finish/checkpoint the current chunk,
+    # return the lease, exit 0. Installed on the main thread before any
+    # batch work begins.
+    signal.signal(
+        signal.SIGTERM, lambda *_: harness.drain_evt.set()
+    )
+    return harness.run_forever()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

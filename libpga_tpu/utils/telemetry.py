@@ -99,6 +99,18 @@ EVENT_FIELDS: Dict[str, tuple] = {
     "slo_violation": ("what", "value_ms", "limit_ms"),
     "metrics_snapshot": ("metrics",),
     "flight_dump": ("reason", "records"),
+    # Serving fleet (ISSUE 8): coordinator-side worker lifecycle +
+    # lease accounting (worker_spawn/death/exit, lease_requeue) and
+    # worker-side spool protocol records (lease_claim, worker_drain).
+    # Fleet-level dead-lettering reuses the "dead_letter" kind with the
+    # batch id as the bucket. ``worker_death``/``lease_requeue`` are
+    # the records tools/chaos_smoke.py's fleet stage schema-checks.
+    "worker_spawn": ("worker", "pid"),
+    "worker_exit": ("worker",),
+    "worker_death": ("worker",),
+    "worker_drain": ("worker",),
+    "lease_claim": ("worker", "batch"),
+    "lease_requeue": ("batch", "worker"),
 }
 
 
@@ -421,6 +433,12 @@ class FlightRecorder:
             raise ValueError("capacity must be >= 1")
         self.capacity = capacity
         self.dump_dir = dump_dir
+        #: Optional fleet-worker attribution (ISSUE 8): when a process
+        #: is a fleet worker, ``serving/worker.py`` sets this so every
+        #: dump trailer names the worker that wrote it. The ``pid`` is
+        #: stamped regardless — a fleet post-mortem over a shared dump
+        #: directory needs to attribute dumps to processes either way.
+        self.worker_id: Optional[str] = None
         self._clock = clock
         self._ring = collections.deque(maxlen=capacity)
         self._lock = threading.Lock()
@@ -487,13 +505,22 @@ class FlightRecorder:
                 "event": "metrics_snapshot",
                 "metrics": _metrics.REGISTRY.snapshot(),
             }
+            import os as _os
+
             trailer = {
                 "schema": EVENT_SCHEMA_VERSION,
                 "ts": float(self._clock()),
                 "event": "flight_dump",
                 "reason": str(reason),
                 "records": len(recs),
+                # Attribution for fleet post-mortems (ISSUE 8): which
+                # process (and, when set, which fleet worker) wrote
+                # this dump. Optional fields — validate_log stays green
+                # on pre-fleet dumps, which simply lack them.
+                "pid": _os.getpid(),
             }
+            if self.worker_id is not None:
+                trailer["worker"] = str(self.worker_id)
             with open(path, "w", encoding="utf-8") as fh:
                 for rec in recs + [snap_rec, trailer]:
                     fh.write(json.dumps(rec, default=str) + "\n")

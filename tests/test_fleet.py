@@ -1,0 +1,358 @@
+"""Cross-process serving fleet (ISSUE 8): spool protocol, leases,
+kill/drain recovery, quarantine, backpressure.
+
+The two acceptance properties are bit-identity under violence:
+
+- a worker killed with SIGKILL mid-batch has its lease recovered and
+  its batch re-run on a survivor, landing bit-identical to an
+  uninterrupted same-seed single-process run (seeds travel with the
+  ticket, never the worker);
+- a SIGTERM drain checkpoints in-flight supervised runs at a chunk
+  boundary and a restarted fleet resumes them, finishing bit-identical
+  to an uninterrupted same-seed supervised run at the same cadence.
+
+Process-spawning tests keep shapes tiny (the whole file must fit the
+tier-1 budget); the 8-process matrix lives in ``tools/fleet_smoke.py``
+(CI stage 9).
+"""
+
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from libpga_tpu import PGA, PGAConfig
+from libpga_tpu.config import FleetConfig
+from libpga_tpu.robustness.supervisor import supervised_run
+from libpga_tpu.serving import QueueFull
+from libpga_tpu.serving.fleet import (
+    Fleet,
+    FleetDeadLetter,
+    FleetTicket,
+    Spool,
+    config_from_json,
+    config_to_json,
+)
+from libpga_tpu.utils import telemetry
+
+POP, LEN = 128, 16
+CFG = PGAConfig(use_pallas=False)
+
+
+def engine_run(seed, n, pop=POP, length=LEN):
+    pga = PGA(seed=seed, config=CFG)
+    pga.create_population(pop, length)
+    pga.set_objective("onemax")
+    pga.run(n)
+    return np.array(pga._populations[0].genomes, copy=True)
+
+
+def wait_for(cond, timeout=60, interval=0.02, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ------------------------------------------------------------ no-process
+
+
+def test_fleet_config_validation():
+    with pytest.raises(ValueError):
+        FleetConfig(n_workers=0)
+    with pytest.raises(ValueError):
+        FleetConfig(heartbeat_s=2.0, lease_timeout_s=3.0)  # > half
+    with pytest.raises(ValueError):
+        FleetConfig(max_worker_deaths=0)
+    with pytest.raises(ValueError):
+        FleetConfig(overflow="shed")
+    with pytest.raises(ValueError):
+        FleetConfig(max_pending=0)
+
+
+def test_ticket_validation():
+    with pytest.raises(ValueError):
+        FleetTicket(size=0, genome_len=8, n=1, seed=0)
+    with pytest.raises(ValueError):
+        FleetTicket(size=8, genome_len=8, n=-1, seed=0)
+    with pytest.raises(ValueError):
+        FleetTicket(size=8, genome_len=8, n=1, seed=0, checkpoint_every=-1)
+
+
+def test_config_json_roundtrip():
+    import jax.numpy as jnp
+
+    from libpga_tpu.utils.telemetry import TelemetryConfig
+
+    cfg = PGAConfig(
+        use_pallas=False, elitism=2, selection="truncation",
+        selection_param=0.25, mutation_rate=0.05,
+        gene_dtype=jnp.bfloat16,
+        telemetry=TelemetryConfig(history_gens=64),
+    )
+    back = config_from_json(json.loads(json.dumps(config_to_json(cfg))))
+    assert back.elitism == 2
+    assert back.selection == "truncation"
+    assert back.selection_param == 0.25
+    assert np.dtype(back.gene_dtype).name == "bfloat16"
+    assert back.telemetry.history_gens == 64
+    # Signature-relevant fields survive exactly: the worker's executor
+    # must land in the same bucket the coordinator described.
+    assert (
+        back.serving_signature_fields()
+        == cfg.serving_signature_fields()
+    )
+
+
+def test_fleet_requires_named_objective(tmp_path):
+    with pytest.raises(ValueError, match="NAMED objective"):
+        Fleet(str(tmp_path), lambda g: g.sum())
+    with pytest.raises(KeyError):
+        Fleet(str(tmp_path), "no_such_objective")
+
+
+def test_batch_formation_and_spool_format(tmp_path):
+    fleet = Fleet(
+        str(tmp_path), "onemax", config=CFG,
+        fleet=FleetConfig(n_workers=1, max_batch=2, max_wait_ms=10_000),
+    )
+    h1 = fleet.submit(FleetTicket(size=POP, genome_len=LEN, n=3, seed=1))
+    assert fleet.spool.pending_batches() == []  # below max_batch
+    h2 = fleet.submit(FleetTicket(size=POP, genome_len=LEN, n=3, seed=2))
+    names = fleet.spool.pending_batches()
+    assert len(names) == 1  # max_batch reached -> formed inline
+    batch = Spool.read_json(fleet.spool.path("pending", names[0]))
+    assert batch["spec"]["objective"] == "onemax"
+    assert batch["attempts"] == []
+    assert [t["tid"] for t in batch["tickets"]] == [h1.tid, h2.tid]
+    assert batch["tickets"][0]["seed"] == 1
+    # distinct shapes bucket separately
+    fleet.submit(FleetTicket(size=POP, genome_len=2 * LEN, n=3, seed=3))
+    assert fleet.flush() == 1
+    assert len(fleet.spool.pending_batches()) == 2
+    # supervised tickets never co-batch with plain ones
+    fleet.submit(FleetTicket(size=POP, genome_len=LEN, n=3, seed=4))
+    fleet.submit(
+        FleetTicket(size=POP, genome_len=LEN, n=3, seed=5,
+                    checkpoint_every=1)
+    )
+    assert fleet.flush() == 2
+    fleet.close()
+
+
+def test_backpressure_raise_and_block(tmp_path):
+    fleet = Fleet(
+        str(tmp_path), "onemax", config=CFG,
+        fleet=FleetConfig(
+            n_workers=1, max_pending=2, overflow="raise",
+            max_wait_ms=10_000,
+        ),
+    )
+    fleet.submit(FleetTicket(size=POP, genome_len=LEN, n=1, seed=1))
+    fleet.submit(FleetTicket(size=POP, genome_len=LEN, n=1, seed=2))
+    with pytest.raises(QueueFull):
+        fleet.submit(FleetTicket(size=POP, genome_len=LEN, n=1, seed=3))
+    fleet.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        fleet.submit(FleetTicket(size=POP, genome_len=LEN, n=1, seed=4))
+
+
+def test_publish_first_writer_wins(tmp_path):
+    spool = Spool(str(tmp_path))
+    a = spool.path("results", "a.tmp")
+    b = spool.path("results", "b.tmp")
+    final = spool.path("results", "t1.json")
+    for p, content in ((a, "first"), (b, "second")):
+        with open(p, "w") as fh:
+            fh.write(content)
+    assert spool.publish(a, final) is True
+    assert spool.publish(b, final) is False  # loser discarded
+    assert open(final).read() == "first"
+    assert not os.path.exists(a) and not os.path.exists(b)
+
+
+# -------------------------------------------------------- with processes
+
+
+def test_fleet_kill9_midbatch_bit_identity(tmp_path):
+    """ACCEPTANCE: SIGKILL of a worker mid-batch — the lease is
+    recovered, the batch re-runs on the survivor, and every result is
+    bit-identical to an uninterrupted same-seed single-process run."""
+    events_path = str(tmp_path / "events.jsonl")
+    log = telemetry.EventLog(events_path)
+    fleet = Fleet(
+        str(tmp_path / "spool"), "onemax", config=CFG,
+        fleet=FleetConfig(
+            n_workers=2, max_batch=2, max_wait_ms=5,
+            lease_timeout_s=4.0, heartbeat_s=0.2, poll_s=0.05,
+        ),
+        events=log,
+    )
+    try:
+        # Worker 0 SIGKILLs ITSELF at the start of its first batch
+        # execution — a real kill -9 mid-batch, deterministically.
+        fleet.start(
+            worker_env={0: {"PGA_WORKER_CHAOS": "sigkill@execute:1"}}
+        )
+        seeds = (1, 2, 3, 4)
+        handles = [
+            fleet.submit(
+                FleetTicket(size=POP, genome_len=LEN, n=4, seed=s)
+            )
+            for s in seeds
+        ]
+        results = [h.result(timeout=180) for h in handles]
+        for seed, res in zip(seeds, results):
+            assert res.generations == 4
+            assert np.array_equal(res.genomes, engine_run(seed, 4)), (
+                f"seed {seed} diverged after worker kill"
+            )
+        assert fleet.worker_deaths == 1
+        assert fleet.requeues >= 1
+    finally:
+        fleet.close()
+        log.close()
+    records = telemetry.validate_log(events_path)  # schema-valid
+    kinds = [r["event"] for r in records]
+    assert "worker_spawn" in kinds
+    assert "worker_death" in kinds
+    assert "lease_requeue" in kinds
+
+
+def test_fleet_drain_resume_bit_identity(tmp_path):
+    """ACCEPTANCE: SIGTERM drain mid-supervised-run checkpoints at a
+    chunk boundary; a restarted fleet resumes and finishes bit-identical
+    to an uninterrupted same-seed supervised run at the same cadence."""
+    N, K = 12, 2
+    fleet = Fleet(
+        str(tmp_path / "spool"), "onemax", config=CFG,
+        fleet=FleetConfig(
+            n_workers=1, max_batch=1, max_wait_ms=0,
+            lease_timeout_s=5.0, heartbeat_s=0.2, poll_s=0.05,
+        ),
+    )
+    try:
+        fleet.start()
+        h = fleet.submit(FleetTicket(
+            size=POP, genome_len=LEN, n=N, seed=9, checkpoint_every=K,
+        ))
+        fleet.flush()
+        sidecar = fleet.spool.ckpt_path(h.tid) + ".meta.json"
+
+        def mid_run():
+            try:
+                with open(sidecar) as fh:
+                    return 0 < json.load(fh)["generations"] < N
+            except (OSError, json.JSONDecodeError, KeyError):
+                return False
+
+        wait_for(mid_run, timeout=120, what="first durable checkpoint")
+        assert fleet.drain() == 1
+        # the unfinished ticket went back to the pending spool
+        assert len(fleet.spool.pending_batches()) == 1
+        assert fleet.workers_alive() == []
+        fleet.start()  # fresh worker resumes from the checkpoint
+        res = h.result(timeout=180)
+    finally:
+        fleet.close()
+    ref = PGA(seed=9, config=CFG)
+    ref.create_population(POP, LEN)
+    ref.set_objective("onemax")
+    report = supervised_run(
+        ref, N, checkpoint_path=str(tmp_path / "ref.npz"),
+        checkpoint_every=K,
+    )
+    assert res.generations == N
+    assert np.array_equal(
+        res.genomes, np.array(ref._populations[0].genomes)
+    )
+    assert res.best_score == report.best_score
+
+
+def test_fleet_quarantine_after_k_worker_deaths(tmp_path):
+    """A batch that kills max_worker_deaths DISTINCT workers is
+    quarantined into dead/ with a flight-recorder dump (worker id + pid
+    in the trailer), and its ticket fails with FleetDeadLetter instead
+    of being retried forever."""
+    fleet = Fleet(
+        str(tmp_path / "spool"), "onemax", config=CFG,
+        fleet=FleetConfig(
+            n_workers=2, max_batch=1, max_wait_ms=0,
+            lease_timeout_s=4.0, heartbeat_s=0.2, poll_s=0.05,
+            max_worker_deaths=2,
+        ),
+    )
+    try:
+        # BOTH workers die on their first execution: two distinct
+        # workers lose their lease on the same batch -> quarantine.
+        chaos = {"PGA_WORKER_CHAOS": "sigkill@execute:1"}
+        fleet.start(worker_env={0: chaos, 1: chaos})
+        h = fleet.submit(
+            FleetTicket(size=POP, genome_len=LEN, n=4, seed=7)
+        )
+        fleet.flush()
+        with pytest.raises(FleetDeadLetter, match="2 distinct workers"):
+            h.result(timeout=180)
+        assert len(fleet.quarantined) == 1
+        dead = fleet.spool.path("dead", fleet.quarantined[0])
+        assert os.path.exists(dead)
+        batch = Spool.read_json(dead)
+        assert len(set(batch["attempts"])) == 2
+        dump_path = dead + ".flight.jsonl"
+        records = telemetry.validate_log(dump_path)  # schema-valid
+        trailer = records[-1]
+        assert trailer["event"] == "flight_dump"
+        assert trailer["reason"] == "fleet_dead_letter"
+        assert trailer["pid"] == os.getpid()  # coordinator attribution
+    finally:
+        fleet.close()
+
+
+def test_worker_heartbeat_fault_expires_lease(tmp_path):
+    """Injected worker.heartbeat fault: the heartbeat thread dies while
+    the worker keeps computing — the lease expires, the batch re-runs
+    on a fresh worker, results stay bit-identical (first-writer-wins
+    publication makes the late duplicate benign)."""
+    fleet = Fleet(
+        str(tmp_path / "spool"), "onemax", config=CFG,
+        fleet=FleetConfig(
+            n_workers=1, max_batch=1, max_wait_ms=0,
+            lease_timeout_s=1.0, heartbeat_s=0.1, poll_s=0.05,
+        ),
+    )
+    try:
+        fleet.start(worker_env={0: {
+            # Kill the heartbeat thread on its first tick, and slow the
+            # worker's batch down via a supervised cadence so the lease
+            # demonstrably expires under a live worker.
+            "PGA_FAULT_SPEC":
+                '{"site": "worker.heartbeat", "at_call_n": 1}',
+        }})
+        h = fleet.submit(FleetTicket(
+            size=POP, genome_len=LEN, n=10, seed=3, checkpoint_every=1,
+        ))
+        fleet.flush()
+        wait_for(
+            lambda: fleet.requeues >= 1, timeout=120,
+            what="lease expiry under a live worker",
+        )
+        fleet.start()  # survivor picks the requeued batch up
+        res = h.result(timeout=180)
+        assert res.generations == 10
+    finally:
+        fleet.close()
+    ref = PGA(seed=3, config=CFG)
+    ref.create_population(POP, LEN)
+    ref.set_objective("onemax")
+    supervised_run(
+        ref, 10, checkpoint_path=str(tmp_path / "ref.npz"),
+        checkpoint_every=1,
+    )
+    assert np.array_equal(
+        res.genomes, np.array(ref._populations[0].genomes)
+    )

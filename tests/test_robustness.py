@@ -379,6 +379,75 @@ def test_supervised_death_and_resume_bit_identical(tmp_path):
     assert report.best_score == ref_report.best_score
 
 
+def test_supervised_stop_hook_drains_at_chunk_boundary(tmp_path):
+    """ISSUE 8: the ``stop`` callback ends the run at a chunk boundary
+    with the checkpoint + sidecar durable, and a later ``resume=True``
+    finishes bit-identical to an uninterrupted same-cadence run — the
+    fleet worker's SIGTERM-drain contract."""
+    ref = _engine()
+    ref_report = supervised_run(
+        ref, 8, checkpoint_path=str(tmp_path / "ref.npz"),
+        checkpoint_every=2, sleep=lambda s: None,
+    )
+    path = str(tmp_path / "stopped.npz")
+    draining = _engine()
+    stop_calls = []
+
+    def stop():  # drain lands during the second chunk
+        stop_calls.append(1)
+        return len(stop_calls) >= 2
+
+    report = supervised_run(
+        draining, 8, checkpoint_path=path, checkpoint_every=2,
+        stop=stop, sleep=lambda s: None,
+    )
+    assert report.stopped and not report.target_reached
+    assert report.generations == 4  # stopped after the second chunk
+    meta = read_meta(path)
+    assert meta["generations"] == 4
+    assert meta["ckpt_sig"] is not None  # resume-consistency signature
+    resumed = PGA(seed=424242, config=PGAConfig(use_pallas=False))
+    resumed.set_objective("onemax")
+    report2 = supervised_run(
+        resumed, 8, checkpoint_path=path, checkpoint_every=2, resume=True,
+        sleep=lambda s: None,
+    )
+    assert report2.restored and report2.generations == 8
+    assert not report2.stopped
+    np.testing.assert_array_equal(_genomes(ref), _genomes(resumed))
+    assert report2.best_score == ref_report.best_score
+
+
+def test_supervised_resume_rejects_torn_sidecar_pair(tmp_path):
+    """A sidecar whose recorded checkpoint signature does not match the
+    checkpoint file (a concurrent writer landed a save mid-resume) is
+    re-read instead of trusted blindly; with a persistent mismatch the
+    resume proceeds best-effort on the LAST consistent read."""
+    import json
+
+    path = str(tmp_path / "pair.npz")
+    pga = _engine()
+    supervised_run(pga, 4, checkpoint_path=path, checkpoint_every=2,
+                   sleep=lambda s: None)
+    # Corrupt the signature: pretend the sidecar belongs to a different
+    # checkpoint version.
+    meta_path = f"{path}.meta.json"
+    with open(meta_path) as fh:
+        meta = json.load(fh)
+    meta["ckpt_sig"] = [0, 0]
+    with open(meta_path, "w") as fh:
+        json.dump(meta, fh)
+    sleeps = []
+    resumed = PGA(seed=7, config=PGAConfig(use_pallas=False))
+    resumed.set_objective("onemax")
+    report = supervised_run(
+        resumed, 4, checkpoint_path=path, checkpoint_every=2, resume=True,
+        sleep=sleeps.append,
+    )
+    assert sleeps, "mismatched pair was not re-read"
+    assert report.generations == 4  # best-effort completion
+
+
 def test_supervised_resume_of_completed_run_is_noop(tmp_path):
     path = str(tmp_path / "done.npz")
     pga = _engine()

@@ -598,6 +598,47 @@ def test_close_under_concurrent_submits_is_deterministic():
         q.submit(RunRequest(size=POP, genome_len=LEN, n=1, seed=9))
 
 
+def test_close_concurrent_closers_idempotent():
+    """Satellite (ISSUE 8): a second close() racing the first is a
+    deterministic no-op — exactly one teardown happens, every closer
+    returns with the queue fully closed, and nothing launches after
+    any of them returned."""
+    ex = _executor()
+    q = RunQueue(ex, serving=ServingConfig(max_batch=8, max_wait_ms=5.0))
+    tickets = [
+        q.submit(RunRequest(size=POP, genome_len=LEN, n=1, seed=s))
+        for s in range(3)
+    ]
+    barrier = threading.Barrier(4)
+    errors = []
+
+    def closer():
+        try:
+            barrier.wait(10)
+            q.close()
+        except BaseException as e:  # pragma: no cover - diagnostic
+            errors.append(e)
+
+    closers = [threading.Thread(target=closer, daemon=True)
+               for _ in range(4)]
+    for t in closers:
+        t.start()
+    for t in closers:
+        t.join(30)
+        assert not t.is_alive(), "a concurrent close() hung"
+    assert errors == []
+    launches_at_close = q.launches
+    for t in tickets:  # the single teardown's flush completed them all
+        assert t.result(timeout=60).generations == 1
+    assert q.launches == launches_at_close
+    assert q._flusher is None
+    with pytest.raises(RuntimeError, match="closed"):
+        q.submit(RunRequest(size=POP, genome_len=LEN, n=1, seed=9))
+    # a LATER close() is the same deterministic no-op
+    q.close()
+    assert q.launches == launches_at_close
+
+
 # ---------------------------------------------------------------- islands
 
 

@@ -32,11 +32,25 @@ Matrix:
   dead-letter       one statically poisoned request inside a mega-batch
                     dead-letters with its diagnosis; all co-batched
                     tickets complete bit-identically
+  fleet             ISSUE 8, three sub-scenarios against a real
+                    cross-process fleet (serving/fleet.py):
+                    (a) SIGKILL a worker mid-batch — lease recovered,
+                    batch re-run bit-identical on the survivor;
+                    (b) SIGSTOP a worker (preemption pause) — its lease
+                    EXPIRES under a live process, the batch requeues,
+                    results bit-identical;
+                    (c) kill a worker mid-drain-checkpoint (injected
+                    checkpoint.save fault with no retries) — the
+                    previous durable checkpoint survives the torn save
+                    and a fresh worker resumes to bit-identical bits;
+                    all three leave schema-valid worker_death /
+                    lease_requeue events in the coordinator log.
 
 Exit 0 with a one-line summary per scenario; nonzero on first failure.
 """
 
 import os
+import signal
 import sys
 import tempfile
 import threading
@@ -281,6 +295,136 @@ def scenario_dead_letter(tmp, ref_g, ref_best):
     )
 
 
+def scenario_fleet(tmp, ref_g, ref_best):
+    """ISSUE 8: the single-process fault matrix lifted to a real
+    cross-process fleet — SIGKILL mid-batch, SIGSTOP lease expiry, and
+    a worker killed mid-checkpoint-write (injected checkpoint.save
+    fault, no retries) recovering via resume-from-durable-checkpoint.
+    Every recovery must land bit-identical and the coordinator log must
+    carry schema-valid worker_death / lease_requeue events."""
+    from libpga_tpu.config import FleetConfig
+    from libpga_tpu.serving.fleet import Fleet, FleetTicket
+    from libpga_tpu.utils import telemetry as _tl
+
+    events_path = os.path.join(tmp, "fleet-events.jsonl")
+    log = _tl.EventLog(events_path)
+    fcfg = FleetConfig(
+        n_workers=2, max_batch=2, max_wait_ms=5, lease_timeout_s=2.0,
+        heartbeat_s=0.2, poll_s=0.05,
+    )
+    cfg = PGAConfig(use_pallas=False)
+
+    # (a) SIGKILL mid-batch: worker 0 kills ITSELF (real kill -9) at
+    # the start of its first batch; the survivor re-runs the batch.
+    f = Fleet(os.path.join(tmp, "fleet-kill"), "onemax", config=cfg,
+              fleet=fcfg, events=log)
+    f.start(worker_env={0: {"PGA_WORKER_CHAOS": "sigkill@execute:1"}})
+    handles = [
+        f.submit(FleetTicket(size=POP, genome_len=LEN, n=GENS, seed=s))
+        for s in (21, 22)
+    ]
+    results = [h.result(timeout=300) for h in handles]
+    refs = []
+    for s in (21, 22):
+        pga = fresh_engine(seed=s)
+        pga.run(GENS)
+        refs.append(genomes_of(pga))
+    kill_ok = (
+        f.worker_deaths == 1 and f.requeues >= 1
+        and all(np.array_equal(np.asarray(r.genomes), g)
+                for r, g in zip(results, refs))
+    )
+    f.close()
+    check("fleet-sigkill", kill_ok,
+          f"worker killed -9 mid-batch, requeued, bit-identical")
+
+    # (b) SIGSTOP (simulated preemption pause): the lone worker claims,
+    # freezes, its lease expires under a LIVE process; a late-spawned
+    # survivor re-runs the batch.
+    f = Fleet(os.path.join(tmp, "fleet-stop"), "onemax", config=cfg,
+              fleet=FleetConfig(
+                  n_workers=1, max_batch=1, max_wait_ms=0,
+                  lease_timeout_s=1.0, heartbeat_s=0.2, poll_s=0.05,
+              ), events=log)
+    f.start(worker_env={0: {"PGA_WORKER_CHAOS": "sigstop@execute:1"}})
+    h = f.submit(FleetTicket(size=POP, genome_len=LEN, n=GENS, seed=23))
+    f.flush()
+    deadline = time.monotonic() + 60
+    while not os.listdir(f.spool.path("leases")):
+        if time.monotonic() > deadline:
+            check("fleet-sigstop", False, "worker never claimed")
+        time.sleep(0.02)
+    f.start()  # the survivor
+    r = h.result(timeout=300)
+    pga = fresh_engine(seed=23)
+    pga.run(GENS)
+    stop_ok = f.requeues >= 1 and np.array_equal(
+        np.asarray(r.genomes), genomes_of(pga)
+    )
+    for p in f._workers.values():  # wake the paused worker for teardown
+        if p.poll() is None:
+            os.kill(p.pid, signal.SIGCONT)
+    f.close()
+    check("fleet-sigstop", stop_ok,
+          "lease expired under paused worker, requeued, bit-identical")
+
+    # (c) worker killed MID-CHECKPOINT-WRITE: the injected
+    # checkpoint.save fault fires between the temp write and the atomic
+    # rename of the chunk-2 save, with max_retries=0 — the worker dies,
+    # the chunk-1 checkpoint survives the torn save, and a fresh worker
+    # RESUMES from it, bit-identical to the fault-free supervised run.
+    f = Fleet(os.path.join(tmp, "fleet-ckpt"), "onemax", config=cfg,
+              fleet=FleetConfig(
+                  n_workers=1, max_batch=1, max_wait_ms=0,
+                  lease_timeout_s=5.0, heartbeat_s=0.2, poll_s=0.05,
+              ), events=log)
+    f.start(worker_env={0: {
+        "PGA_FAULT_SPEC":
+            '{"site": "checkpoint.save", "at_call_n": 2}',
+    }})
+    h = f.submit(FleetTicket(
+        size=POP, genome_len=LEN, n=GENS, seed=SEED,
+        checkpoint_every=EVERY, max_retries=0,
+    ))
+    f.flush()
+    deadline = time.monotonic() + 120
+    while f.worker_deaths == 0:
+        if time.monotonic() > deadline:
+            check("fleet-ckpt-kill", False, "worker never died mid-save")
+        time.sleep(0.02)
+    meta = None
+    try:
+        with open(f.spool.ckpt_path(h.tid) + ".meta.json") as fh:
+            import json as _json
+
+            meta = _json.load(fh)
+    except OSError:
+        pass
+    f.start()  # fault-free worker resumes from the durable checkpoint
+    r = h.result(timeout=300)
+    ckpt_ok = (
+        meta is not None and meta["generations"] == EVERY  # chunk 1 held
+        and np.array_equal(np.asarray(r.genomes), ref_g)
+        and r.best_score == ref_best
+    )
+    f.close()
+    check("fleet-ckpt-kill", ckpt_ok,
+          "died mid-checkpoint-write, resumed from durable chunk, "
+          "bit-identical")
+
+    log.close()
+    records = _tl.validate_log(events_path)  # schema gate
+    kinds = [rec["event"] for rec in records]
+    fleet_ok = (
+        kinds.count("worker_death") >= 2  # (a) + (c)
+        and "lease_requeue" in kinds and "worker_spawn" in kinds
+    )
+    check("fleet-events", fleet_ok,
+          f"{len(records)} schema-valid records, "
+          f"{kinds.count('worker_death')} worker_death, "
+          f"{kinds.count('lease_requeue')} lease_requeue")
+
+
 def main():
     # The flusher-death scenario kills a thread by design; keep its
     # traceback out of the smoke's output.
@@ -299,6 +443,7 @@ def main():
             scenario_checkpoint_kill,
             scenario_flusher_death,
             scenario_dead_letter,
+            scenario_fleet,
         ):
             scenario(tmp, ref_g, ref_best)
         # ISSUE 6 acceptance: a chaos run must leave a flight-recorder

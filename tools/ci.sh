@@ -49,7 +49,19 @@
 #                                 ppermute + one all_gather per
 #                                 generation, and the shard_sync
 #                                 telemetry event is schema-valid
-#                                 (ISSUE 7).
+#                                 (ISSUE 7);
+#   9. serving-fleet smoke      — tools/fleet_smoke.py: the ISSUE 8
+#                                 acceptance matrix on 8 real worker
+#                                 processes — kill -9 of a worker
+#                                 mid-batch and a SIGTERM drain/resume
+#                                 cycle both finish bit-identical to
+#                                 uninterrupted same-seed
+#                                 single-process runs, a batch that
+#                                 kills K distinct workers is
+#                                 quarantined with a schema-valid
+#                                 flight dump, and the per-worker
+#                                 Prometheus expositions pass
+#                                 tools/metrics_dump.py --check.
 # Exits nonzero on the first failing stage.
 set -e
 cd "$(dirname "$0")/.."
@@ -292,5 +304,8 @@ echo "prometheus exposition lint OK"
 
 echo "== ci: population-shard smoke =="
 JAX_PLATFORMS=cpu python tools/shard_smoke.py
+
+echo "== ci: serving-fleet smoke =="
+JAX_PLATFORMS=cpu python tools/fleet_smoke.py
 
 echo "== ci: all stages passed =="

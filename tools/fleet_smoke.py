@@ -1,0 +1,254 @@
+#!/usr/bin/env python
+"""Fleet smoke: the ISSUE 8 acceptance matrix on 8 worker processes.
+
+CI stage 9 (``tools/ci.sh``). Four gates against a REAL cross-process
+fleet (``serving/fleet.py`` coordinator + ``serving/worker.py``
+processes) on the CPU backend:
+
+1. **kill-one-worker bit-identity** — an 8-worker fleet serves a spread
+   of plain tickets while one worker SIGKILLs itself mid-batch; the
+   dead worker's lease is recovered, its batch re-runs on a survivor,
+   and EVERY ticket's result is bit-identical to an uninterrupted
+   same-seed single-process ``PGA.run``;
+2. **drain/resume bit-identity** — a supervised ticket is SIGTERM-
+   drained mid-run (checkpoint at a chunk boundary through the atomic
+   checkpoint + sidecar machinery), the fleet restarts, and the
+   resumed run finishes bit-identical to an uninterrupted same-seed
+   supervised run at the same cadence;
+3. **dead-letter quarantine** — a batch that costs
+   ``max_worker_deaths`` DISTINCT workers their lease is quarantined
+   into ``dead/`` with a schema-valid flight-recorder dump (worker/pid
+   attribution in the trailer) and its ticket fails with
+   ``FleetDeadLetter`` instead of being retried forever;
+4. **per-worker metrics lint** — the coordinator's per-worker gauges
+   and lease counters, plus one worker's exit-time exposition from the
+   spool, pass ``tools/metrics_dump.py --check`` (Prometheus
+   line-format lint).
+
+Exit 0 with one line per gate; nonzero on the first failure.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from libpga_tpu import PGA, PGAConfig  # noqa: E402
+from libpga_tpu.config import FleetConfig  # noqa: E402
+from libpga_tpu.robustness.supervisor import supervised_run  # noqa: E402
+from libpga_tpu.serving.fleet import (  # noqa: E402
+    Fleet,
+    FleetDeadLetter,
+    FleetTicket,
+)
+from libpga_tpu.utils import metrics as _metrics  # noqa: E402
+from libpga_tpu.utils import telemetry as _tl  # noqa: E402
+
+POP, LEN, GENS = 256, 32, 6
+WORKERS = 8
+CFG = PGAConfig(use_pallas=False)
+TOOLS = os.path.dirname(os.path.abspath(__file__))
+
+
+def check(name, ok, detail=""):
+    status = "ok" if ok else "FAIL"
+    print(f"fleet {name}: {status}{' — ' + detail if detail else ''}")
+    if not ok:
+        sys.exit(f"fleet smoke failed at {name}")
+
+
+def engine_ref(seed, n):
+    pga = PGA(seed=seed, config=CFG)
+    pga.create_population(POP, LEN)
+    pga.set_objective("onemax")
+    pga.run(n)
+    return np.array(pga._populations[0].genomes, copy=True)
+
+
+def stage_kill_one_worker(tmp):
+    fleet = Fleet(
+        os.path.join(tmp, "kill"), "onemax", config=CFG,
+        fleet=FleetConfig(
+            n_workers=WORKERS, max_batch=2, max_wait_ms=5,
+            lease_timeout_s=6.0, heartbeat_s=0.3, poll_s=0.05,
+        ),
+    )
+    # Worker 0 SIGKILLs itself at the start of its first batch — a real
+    # kill -9 mid-batch on the 8-process matrix.
+    fleet.start(worker_env={0: {"PGA_WORKER_CHAOS": "sigkill@execute:1"}})
+    seeds = list(range(100, 100 + 2 * WORKERS))
+    handles = [
+        fleet.submit(FleetTicket(size=POP, genome_len=LEN, n=GENS, seed=s))
+        for s in seeds
+    ]
+    results = [h.result(timeout=600) for h in handles]
+    workers_used = sorted({r.worker for r in results})
+    mismatches = [
+        s for s, r in zip(seeds, results)
+        if not np.array_equal(r.genomes, engine_ref(s, GENS))
+    ]
+    fleet.close()
+    check(
+        "kill-one-worker", not mismatches and fleet.worker_deaths == 1,
+        f"{len(seeds)} tickets on {WORKERS} workers "
+        f"({len(workers_used)} served), 1 killed, "
+        f"{fleet.requeues} requeue(s), all bit-identical",
+    )
+    return fleet
+
+
+def stage_drain_resume(tmp):
+    N, K = 24, 4
+    fleet = Fleet(
+        os.path.join(tmp, "drain"), "onemax", config=CFG,
+        fleet=FleetConfig(
+            n_workers=2, max_batch=1, max_wait_ms=0,
+            lease_timeout_s=6.0, heartbeat_s=0.3, poll_s=0.05,
+        ),
+    )
+    fleet.start()
+    h = fleet.submit(FleetTicket(
+        size=POP, genome_len=LEN, n=N, seed=77, checkpoint_every=K,
+    ))
+    fleet.flush()
+    sidecar = fleet.spool.ckpt_path(h.tid) + ".meta.json"
+    deadline = time.monotonic() + 300
+    while True:
+        try:
+            with open(sidecar) as fh:
+                if 0 < json.load(fh)["generations"] < N:
+                    break
+        except (OSError, json.JSONDecodeError, KeyError):
+            pass
+        if time.monotonic() > deadline:
+            check("drain-resume", False, "no mid-run checkpoint appeared")
+        time.sleep(0.02)
+    drained = fleet.drain()
+    fleet.start()  # fresh workers resume from the durable checkpoint
+    res = h.result(timeout=600)
+    fleet.close()
+    ref = PGA(seed=77, config=CFG)
+    ref.create_population(POP, LEN)
+    ref.set_objective("onemax")
+    report = supervised_run(
+        ref, N, checkpoint_path=os.path.join(tmp, "drain-ref.npz"),
+        checkpoint_every=K,
+    )
+    ok = (
+        res.generations == N
+        and np.array_equal(
+            res.genomes, np.array(ref._populations[0].genomes)
+        )
+        and res.best_score == report.best_score
+    )
+    check(
+        "drain-resume", ok,
+        f"drained {drained} worker(s) mid-run, resumed, bit-identical "
+        f"at cadence {K}",
+    )
+
+
+def stage_quarantine(tmp):
+    K = 2
+    fleet = Fleet(
+        os.path.join(tmp, "dl"), "onemax", config=CFG,
+        fleet=FleetConfig(
+            n_workers=2, max_batch=1, max_wait_ms=0,
+            lease_timeout_s=6.0, heartbeat_s=0.3, poll_s=0.05,
+            max_worker_deaths=K,
+        ),
+    )
+    chaos = {"PGA_WORKER_CHAOS": "sigkill@execute:1"}
+    fleet.start(worker_env={0: chaos, 1: chaos})
+    h = fleet.submit(FleetTicket(size=POP, genome_len=LEN, n=GENS, seed=5))
+    fleet.flush()
+    dead_lettered = False
+    try:
+        h.result(timeout=600)
+    except FleetDeadLetter:
+        dead_lettered = True
+    dump_ok = False
+    if fleet.quarantined:
+        dump = fleet.spool.path(
+            "dead", f"{fleet.quarantined[0]}.flight.jsonl"
+        )
+        records = _tl.validate_log(dump)  # schema gate
+        trailer = records[-1]
+        dump_ok = (
+            trailer["event"] == "flight_dump"
+            and trailer["reason"] == "fleet_dead_letter"
+            and trailer.get("pid") == os.getpid()
+        )
+    fleet.close()
+    check(
+        "dead-letter-quarantine",
+        dead_lettered and len(fleet.quarantined) == 1 and dump_ok,
+        f"quarantined after {K} distinct worker deaths, flight dump "
+        "schema-valid with pid attribution",
+    )
+
+
+def stage_metrics_lint(tmp):
+    # Coordinator-side: the per-worker gauges/counters the stages above
+    # populated, exported from the live registry.
+    coord = os.path.join(tmp, "coordinator.prom")
+    with open(coord, "w", encoding="utf-8") as fh:
+        fh.write(_metrics.prometheus_text(_metrics.REGISTRY.snapshot()))
+    text = open(coord).read()
+    for needle in ("pga_fleet_worker_up", "pga_fleet_lease_requeues",
+                   "pga_fleet_worker_deaths"):
+        if needle not in text:
+            check("metrics-lint", False, f"missing series {needle}")
+    # Worker-side: every worker wrote its own exposition on exit.
+    worker_proms = []
+    for sub in ("kill", "drain", "dl"):
+        logs = os.path.join(tmp, sub, "logs")
+        worker_proms += [
+            os.path.join(logs, f) for f in sorted(os.listdir(logs))
+            if f.endswith(".prom")
+        ]
+    if not worker_proms:
+        check("metrics-lint", False, "no worker .prom files in the spool")
+    for path in [coord, worker_proms[0]]:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(TOOLS, "metrics_dump.py"),
+             "--check", path],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        if proc.returncode != 0:
+            check(
+                "metrics-lint", False,
+                f"{path}: {proc.stdout.strip()} {proc.stderr.strip()}",
+            )
+    check(
+        "metrics-lint", True,
+        f"coordinator + {len(worker_proms)} worker expositions, "
+        "prometheus lint clean",
+    )
+
+
+def main():
+    with tempfile.TemporaryDirectory(prefix="pga-fleet-smoke-") as tmp:
+        stage_kill_one_worker(tmp)
+        stage_drain_resume(tmp)
+        stage_quarantine(tmp)
+        stage_metrics_lint(tmp)
+    print(
+        f"fleet smoke: {WORKERS}-process matrix — kill/drain/quarantine "
+        "recovered bit-identical, metrics lint clean"
+    )
+
+
+if __name__ == "__main__":
+    main()
