@@ -658,17 +658,27 @@ def fleet_arm(rounds: int = ROUNDS) -> dict:
     speedup — the scaling number awaits a chip round. Protocol: whole
     service times per round (end-to-end rate, like the serving arm),
     medians + IQR across rounds.
+
+    ISSUE 9 additions: the widest fleet's cross-process latency
+    percentiles (fleet_latency_p50/p99_ms, fleet_spool_wait_p99_ms,
+    from the coordinator's fleet.ticket.* histograms fed by the span
+    breakdowns) and the TRACE OVERHEAD A/B — two same-shape 2-worker
+    fleets, tracing on vs off, served interleaved within every round;
+    acceptance bar: the median overhead is within this host's CPU
+    drift floor (direction-only, stamped in the note).
     """
     import shutil
     import tempfile
 
     from libpga_tpu.config import FleetConfig, PGAConfig
     from libpga_tpu.serving.fleet import Fleet, FleetTicket
+    from libpga_tpu.utils import metrics as _metrics
 
     cfg = PGAConfig(use_pallas=False)
     root = tempfile.mkdtemp(prefix="pga-bench-fleet-")
-    fleets = {}
+    fleets, registries = {}, {}
     for w in FLEET_WIDTHS:
+        registries[w] = _metrics.MetricsRegistry()
         fleets[w] = Fleet(
             os.path.join(root, f"w{w}"), "onemax", config=cfg,
             fleet=FleetConfig(
@@ -676,6 +686,7 @@ def fleet_arm(rounds: int = ROUNDS) -> dict:
                 max_wait_ms=2, lease_timeout_s=30.0, heartbeat_s=0.5,
                 poll_s=0.02,
             ),
+            registry=registries[w],
         )
         fleets[w].start()
 
@@ -695,6 +706,10 @@ def fleet_arm(rounds: int = ROUNDS) -> dict:
     # (the per-worker AOT cache story) before any timed round.
     for w in FLEET_WIDTHS:
         serve(fleets[w], max(2 * w, FLEET_REQS), 50_000 + w)
+        # Drop the warm-up observations: the latency percentiles below
+        # must read steady-state service, not first-compile spool waits
+        # (20+ s of AOT build per worker would dominate every p99).
+        registries[w].reset()
 
     samples = {w: [] for w in FLEET_WIDTHS}
     for rnd in range(rounds):
@@ -703,8 +718,42 @@ def fleet_arm(rounds: int = ROUNDS) -> dict:
             t0 = time.perf_counter()
             serve(fleets[w], FLEET_REQS, base + w)
             samples[w].append(FLEET_REQS / (time.perf_counter() - t0))
+    # Cross-process latency percentiles from the widest fleet's
+    # coordinator histograms (fed by every awaited ticket's span
+    # breakdown over warm-up + all timed rounds).
+    widest = registries[max(FLEET_WIDTHS)]
+    e2e = widest.histogram("fleet.ticket.e2e_ms").snapshot()
+    spool_wait = widest.histogram("fleet.ticket.spool_wait_ms").snapshot()
     for w in FLEET_WIDTHS:
         fleets[w].close()
+
+    # Trace-overhead A/B (ISSUE 9): identical 2-worker fleets, tracing
+    # on vs off, warmed separately, served ADJACENT within each round.
+    ab = {}
+    for mode, trace in (("on", True), ("off", False)):
+        ab[mode] = Fleet(
+            os.path.join(root, f"tr_{mode}"), "onemax", config=cfg,
+            fleet=FleetConfig(
+                n_workers=2, max_batch=max(FLEET_REQS // 2, 1),
+                max_wait_ms=2, lease_timeout_s=30.0, heartbeat_s=0.5,
+                poll_s=0.02, trace=trace,
+            ),
+            registry=_metrics.MetricsRegistry(),
+        )
+        ab[mode].start()
+        serve(ab[mode], FLEET_REQS, 90_000 if trace else 91_000)  # warm
+    trace_overheads = []
+    for rnd in range(rounds):
+        base = 92_000 + 1_000 * rnd
+        secs = {}
+        for mode in ("on", "off"):
+            t0 = time.perf_counter()
+            serve(ab[mode], FLEET_REQS, base + (0 if mode == "on" else 500))
+            secs[mode] = time.perf_counter() - t0
+        trace_overheads.append((secs["on"] / secs["off"] - 1.0) * 100.0)
+    for mode in ("on", "off"):
+        ab[mode].close()
+    trace_med, trace_iqr = _median_iqr(trace_overheads)
 
     # Requeue accounting: a 2-worker fleet where one worker SIGKILLs
     # itself mid-batch — the recovery path's cost in requeues (the
@@ -765,6 +814,21 @@ def fleet_arm(rounds: int = ROUNDS) -> dict:
         "fleet_rounds": rounds,
         "fleet_requeue_count": requeues,
         "fleet_drain_resume_seconds": round(drain_resume_s, 3),
+        # ISSUE 9: cross-process latency percentiles (widest fleet,
+        # coordinator-side fleet.ticket.* histograms) and the tracing
+        # on/off A/B.
+        "fleet_latency_p50_ms": (
+            None if e2e.count == 0 else round(e2e.p50, 2)
+        ),
+        "fleet_latency_p99_ms": (
+            None if e2e.count == 0 else round(e2e.p99, 2)
+        ),
+        "fleet_latency_samples": e2e.count,
+        "fleet_spool_wait_p99_ms": (
+            None if spool_wait.count == 0 else round(spool_wait.p99, 2)
+        ),
+        "fleet_trace_overhead_pct_median": round(trace_med, 2),
+        "fleet_trace_overhead_pct_iqr": round(trace_iqr, 2),
         "fleet_note": (
             "runs/sec of whole fleet round trips (submit -> spool "
             "batch -> worker mega-run -> published result) at 1/4/8 "
@@ -775,7 +839,14 @@ def fleet_arm(rounds: int = ROUNDS) -> dict:
             "restart + checkpoint-resume cycle of a supervised ticket "
             "mid-run; fleet_requeue_count is the lease requeues of a "
             "deliberate worker SIGKILL recovery (bit-identity gated in "
-            "tools/fleet_smoke.py)"
+            "tools/fleet_smoke.py). fleet_latency_* percentiles are "
+            "cross-process span breakdowns (coordinator submit -> "
+            "readback) of the widest fleet's TIMED rounds, warm-up "
+            "compiles excluded; "
+            "fleet_trace_overhead_pct_median is the interleaved "
+            "tracing-on vs tracing-off A/B on identical 2-worker "
+            "fleets — acceptance bar: within this host's CPU drift "
+            "floor (~4%, BASELINE.md), direction-only below that"
         ),
     }
     for w in FLEET_WIDTHS:

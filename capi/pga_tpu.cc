@@ -382,6 +382,50 @@ int pga_fleet_await(pga_fleet_ticket_t *t, float *best, double timeout_s) {
     return gens;
 }
 
+int pga_fleet_await_ex(pga_fleet_ticket_t *t, float *best,
+                       float latency_ms[6], double timeout_s) {
+    if (!t) return -1;
+    size_t nbytes = 0;
+    /* float32[8]: generations, best, then the six tiling spans
+     * intake/spool_wait/execute/publish/readback/e2e in ms (NaN where
+     * tracing was off or the span never happened). */
+    float *vals = bytes_to_floats(
+        call("fleet_await_ex", "(ld)",
+             static_cast<long>(reinterpret_cast<intptr_t>(t)), timeout_s),
+        &nbytes);
+    if (!vals || nbytes < 8 * sizeof(float)) {
+        std::free(vals);
+        return -1;
+    }
+    if (best) *best = vals[1];
+    if (latency_ms)
+        for (int i = 0; i < 6; i++) latency_ms[i] = vals[2 + i];
+    int gens = static_cast<int>(vals[0]);
+    std::free(vals);
+    return gens;
+}
+
+long pga_fleet_metrics_snapshot(char *buf, unsigned long cap) {
+    PyObject *out = call("fleet_metrics_snapshot_json", "()");
+    if (!out) return -1;
+    char *data = nullptr;
+    Py_ssize_t len = 0;
+    if (PyBytes_AsStringAndSize(out, &data, &len) != 0) {
+        PyErr_Print();
+        Py_DECREF(out);
+        return -1;
+    }
+    if (buf && cap > 0) {
+        size_t n = static_cast<size_t>(len) < cap - 1
+                       ? static_cast<size_t>(len)
+                       : cap - 1;
+        std::memcpy(buf, data, n);
+        buf[n] = '\0';
+    }
+    Py_DECREF(out);
+    return static_cast<long>(len);
+}
+
 int pga_fleet_drain(void) {
     return static_cast<int>(call_long("fleet_drain", "()"));
 }

@@ -408,7 +408,30 @@ long pga_metrics_snapshot(char *buf, unsigned long cap);
  * pga_fleet_drain SIGTERMs every worker: each checkpoints in-flight
  * supervised runs at the next chunk boundary, returns its lease, and
  * exits. Returns workers drained; pga_fleet_start on the same spool
- * resumes the work. pga_fleet_close drains and shuts the fleet down. */
+ * resumes the work. pga_fleet_close drains and shuts the fleet down.
+ *
+ * Fleet observability (ISSUE 9):
+ *
+ * pga_fleet_await_ex behaves exactly like pga_fleet_await and
+ * additionally reports the ticket's CROSS-PROCESS latency breakdown
+ * into latency_ms[6] — six spans that tile the ticket's life, so they
+ * sum to the end-to-end time: [0] intake (submit -> batch file
+ * durable, coordinator), [1] spool wait (batch durable -> winning
+ * worker's claim), [2] execute (claim -> run complete, worker),
+ * [3] publish (complete -> result durable, worker), [4] readback
+ * (result durable -> coordinator loaded it), [5] end-to-end. All in
+ * milliseconds; NaN where tracing was off or the lifecycle never
+ * reached the span. latency_ms may be NULL (then it is
+ * pga_fleet_await). Returns generations executed, negative on error.
+ *
+ * pga_fleet_metrics_snapshot writes the MERGED fleet metrics snapshot
+ * — every worker process's latest spool flush plus the coordinator's
+ * live registry, each series labeled with its origin process and
+ * histograms additionally merged into fleet-wide aggregates — as a
+ * UTF-8 JSON document into buf (NUL-terminated, truncated at cap).
+ * Same size-query contract as pga_metrics_snapshot: returns the full
+ * length (excluding the NUL; the snapshot is live, allocate slack),
+ * negative on error or when no fleet is running. */
 typedef struct pga_fleet_ticket pga_fleet_ticket_t;
 int pga_fleet_start(const char *spool_dir, const char *objective,
                     unsigned n_workers, unsigned max_batch,
@@ -417,6 +440,9 @@ pga_fleet_ticket_t *pga_fleet_submit(unsigned size, unsigned genome_len,
                                      unsigned n, long seed,
                                      unsigned checkpoint_every);
 int pga_fleet_await(pga_fleet_ticket_t *t, float *best, double timeout_s);
+int pga_fleet_await_ex(pga_fleet_ticket_t *t, float *best,
+                       float latency_ms[6], double timeout_s);
+long pga_fleet_metrics_snapshot(char *buf, unsigned long cap);
 int pga_fleet_drain(void);
 int pga_fleet_close(void);
 

@@ -161,11 +161,31 @@ int main(void) {
         pga_fleet_ticket_t *f2 = pga_fleet_submit(POP, LEN, 2 * GENS, 43, GENS);
         if (!f1 || !f2)
             return fprintf(stderr, "pga_fleet_submit failed\n"), 1;
-        float best1 = -1.0f, best2 = -1.0f;
-        int fg1 = pga_fleet_await(f1, &best1, 300.0);
+        /* Ticket 1 through the observability-extended await (ISSUE 9):
+         * same release semantics, plus the six-span cross-process
+         * breakdown — every span finite with tracing on (the default),
+         * and the spans TILE, so their sum covers >=95% of e2e. */
+        float best1 = -1.0f, best2 = -1.0f, flat[6];
+        for (int i = 0; i < 6; i++) flat[i] = -1.0f;
+        int fg1 = pga_fleet_await_ex(f1, &best1, flat, 300.0);
         int fg2 = pga_fleet_await(f2, &best2, 300.0);
         if (fg1 != GENS || fg2 != 2 * GENS)
             return fprintf(stderr, "fleet await gens %d/%d\n", fg1, fg2), 1;
+        {
+            float sum = 0.0f;
+            for (int i = 0; i < 6; i++) {
+                if (!(flat[i] == flat[i]) || flat[i] < 0.0f)
+                    return fprintf(stderr, "fleet latency[%d] = %g invalid\n",
+                                   i, (double)flat[i]),
+                           1;
+                if (i < 5) sum += flat[i];
+            }
+            if (sum < 0.95f * flat[5])
+                return fprintf(stderr,
+                               "fleet spans %g cover < 95%% of e2e %g\n",
+                               (double)sum, (double)flat[5]),
+                       1;
+        }
         if (!(best1 >= 0.0f && best1 <= (float)LEN) ||
             !(best2 >= 0.0f && best2 <= (float)LEN))
             return fprintf(stderr, "fleet best %g/%g out of range\n",
@@ -173,6 +193,28 @@ int main(void) {
                    1;
         if (pga_fleet_await(f1, NULL, 1.0) >= 0) /* released */
             return fprintf(stderr, "double fleet await not rejected\n"), 1;
+        /* Merged fleet snapshot: size query, then a real read — the
+         * JSON must carry the coordinator's fleet-level series. */
+        long fneed = pga_fleet_metrics_snapshot(NULL, 0);
+        if (fneed <= 0)
+            return fprintf(stderr, "fleet metrics size query %ld\n", fneed),
+                   1;
+        {
+            unsigned long fcap = (unsigned long)fneed + 8192;
+            char *fjson = (char *)malloc(fcap);
+            if (!fjson) return fprintf(stderr, "malloc failed\n"), 1;
+            long fgot = pga_fleet_metrics_snapshot(fjson, fcap);
+            if (fgot <= 0 || (unsigned long)fgot >= fcap)
+                return fprintf(stderr, "fleet metrics read %ld (cap %lu)\n",
+                               fgot, fcap),
+                       1;
+            if (!strstr(fjson, "fleet.tickets.completed") ||
+                !strstr(fjson, "coordinator"))
+                return fprintf(stderr,
+                               "fleet snapshot missing merged series\n"),
+                       1;
+            free(fjson);
+        }
         if (pga_fleet_drain() < 0)
             return fprintf(stderr, "pga_fleet_drain failed\n"), 1;
         if (pga_fleet_close() != 0)

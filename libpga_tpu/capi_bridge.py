@@ -1005,6 +1005,38 @@ def fleet_await(ticket_id: int, timeout_s: float) -> bytes:
     ).tobytes()
 
 
+def fleet_await_ex(ticket_id: int, timeout_s: float) -> bytes:
+    """``pga_fleet_await_ex``: like ``fleet_await``, additionally
+    reporting the ticket's CROSS-PROCESS latency breakdown (ISSUE 9).
+    Returns eight float32s: generations, best score, then the six
+    breakdown values intake / spool_wait / execute / publish /
+    readback / e2e in milliseconds (NaN for spans tracing-off or an
+    incomplete lifecycle suppressed)."""
+    handle = _fleet_handles.pop(int(ticket_id), None)
+    if handle is None:
+        raise ValueError(f"invalid fleet ticket {ticket_id}")
+    res = handle.result(timeout=float(timeout_s) if timeout_s > 0 else None)
+    lat = handle.latency()
+    vals = [float(res.generations), float(res.best_score)] + [
+        float("nan") if lat[k] is None else float(lat[k])
+        for k in ("intake_ms", "spool_wait_ms", "execute_ms",
+                  "publish_ms", "readback_ms", "e2e_ms")
+    ]
+    return np.asarray(vals, dtype=np.float32).tobytes()
+
+
+def fleet_metrics_snapshot_json() -> bytes:
+    """``pga_fleet_metrics_snapshot``: the MERGED fleet metrics
+    snapshot — every worker's latest spool flush + the coordinator's
+    live registry, per-process labels, aggregate histograms — as UTF-8
+    JSON (size-query contract handled by the C shim)."""
+    import json
+
+    if _fleet is None:
+        raise ValueError("no fleet: call pga_fleet_start first")
+    return json.dumps(_fleet.merged_snapshot(), default=str).encode("utf-8")
+
+
 def fleet_drain() -> int:
     """``pga_fleet_drain``: SIGTERM-drain the fleet's workers
     (checkpoint + lease return); returns workers drained. The fleet

@@ -336,6 +336,28 @@ class FleetConfig:
         SIGTERM'd worker to checkpoint and exit before escalating to
         SIGKILL (the worker's in-flight batch is then recovered by the
         normal lease-expiry path on the next ``start``).
+      trace: cross-process trace propagation (ISSUE 9). On (default),
+        every ticket carries a ``trace_id`` and a span log through the
+        spool — coordinator intake, spool wait, worker claim / lease
+        held / execute, publish, coordinator readback — and
+        ``FleetHandle.latency()`` returns the true cross-process
+        end-to-end breakdown. Off disables span recording fleet-wide
+        (the batch files carry the flag to the workers); the overhead
+        A/B lives in ``bench.py --fleet``.
+      metrics_flush_s: cadence at which each worker (and the
+        coordinator's monitor) flushes its ``MetricsRegistry`` snapshot
+        to the spool's ``metrics/`` directory via atomic rename — the
+        feed of the merged fleet exposition, ``Fleet.status()``, and
+        ``tools/fleet_top.py``.
+      straggler_factor: a worker whose execute-latency p95 exceeds the
+        fleet median of worker p95s by this factor (with at least
+        ``straggler_min_samples`` observations) is flagged: one
+        ``straggler_alert`` event, a ``fleet.straggler_alerts`` bump,
+        and its ``fleet.worker.health`` gauge drops to 0 until it
+        recovers.
+      straggler_min_samples: minimum execute-latency observations a
+        worker needs before the straggler check considers it (a p95
+        over three tickets is noise, not a verdict).
     """
 
     n_workers: int = 2
@@ -348,6 +370,10 @@ class FleetConfig:
     overflow: str = "block"
     poll_s: float = 0.05
     drain_timeout_s: float = 60.0
+    trace: bool = True
+    metrics_flush_s: float = 1.0
+    straggler_factor: float = 3.0
+    straggler_min_samples: int = 8
 
     def __post_init__(self):
         if self.n_workers < 1:
@@ -372,6 +398,15 @@ class FleetConfig:
             raise ValueError("poll_s must be > 0")
         if self.drain_timeout_s <= 0:
             raise ValueError("drain_timeout_s must be > 0")
+        if self.metrics_flush_s <= 0:
+            raise ValueError("metrics_flush_s must be > 0")
+        if self.straggler_factor <= 1.0:
+            raise ValueError(
+                "straggler_factor must be > 1 (a worker at the fleet "
+                "median is not a straggler)"
+            )
+        if self.straggler_min_samples < 1:
+            raise ValueError("straggler_min_samples must be >= 1")
 
 
 @dataclasses.dataclass(frozen=True)

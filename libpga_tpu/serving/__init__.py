@@ -53,11 +53,14 @@ from libpga_tpu.config import FleetConfig, ServingConfig, SLOConfig
 from libpga_tpu.serving.batch import BatchedRuns, RunRequest, RunResult
 from libpga_tpu.serving.cache import COUNTERS, PROGRAM_CACHE, ProgramCache
 from libpga_tpu.serving.fleet import (
+    FLEET_SPANS,
     Fleet,
     FleetDeadLetter,
     FleetHandle,
     FleetResult,
     FleetTicket,
+    fleet_status,
+    merge_spool_metrics,
 )
 from libpga_tpu.serving.queue import (
     DeadLetter,
@@ -84,6 +87,9 @@ __all__ = [
     "FleetHandle",
     "FleetResult",
     "FleetDeadLetter",
+    "FLEET_SPANS",
+    "fleet_status",
+    "merge_spool_metrics",
     "ProgramCache",
     "PROGRAM_CACHE",
     "COUNTERS",

@@ -47,6 +47,21 @@ recoverable state — the same durability stance as
   its unfinished tickets fail with :class:`FleetDeadLetter`.
 - ``logs/`` — per-worker stdout, JSONL event logs, and a Prometheus
   snapshot each worker writes on exit.
+- ``traces/<batch>.trace.jsonl`` — the batch's cross-process span log
+  (ISSUE 9): coordinator intake spans per ticket, worker claim /
+  lease-held markers, requeue records. Appended whole-line (O_APPEND)
+  by whichever process observes the transition; per-ticket execute/
+  publish spans travel in the result meta instead, so a ticket's
+  assembled trace (``FleetHandle.trace()``) shows EVERY attempt —
+  including the claim of a worker that then died.
+- ``metrics/<proc>.json`` — periodic ``MetricsRegistry`` snapshot
+  flushes (atomic rename, ``FleetConfig.metrics_flush_s`` cadence)
+  from every worker plus the coordinator. :func:`merge_spool_metrics`
+  folds them — through the associative ``HistogramSnapshot.merge`` —
+  into ONE fleet snapshot with per-process labels; the feed of
+  ``Fleet.merged_prometheus()``, ``Fleet.status()``, straggler
+  detection, and ``tools/fleet_top.py`` (which works from the spool
+  alone, live fleet or post-mortem).
 
 **Bit-identity.** Plain tickets (``checkpoint_every == 0``) execute as
 shape-bucketed mega-runs through the worker's ``RunQueue``/
@@ -100,7 +115,7 @@ class Spool:
     """
 
     DIRS = ("pending", "claimed", "leases", "results", "dead", "ckpt",
-            "logs")
+            "logs", "traces", "metrics")
 
     def __init__(self, root: str):
         self.root = os.path.abspath(root)
@@ -177,6 +192,262 @@ class Spool:
 
     def ckpt_path(self, tid: str) -> str:
         return self.path("ckpt", f"{tid}.npz")
+
+    def trace_path(self, batch_name: str) -> str:
+        """The batch's span-log file (``telemetry.append_trace`` /
+        ``read_trace`` format)."""
+        return self.path("traces", f"{batch_name}.trace.jsonl")
+
+    def metrics_files(self) -> List[str]:
+        """Per-process metric-snapshot files, sorted by process name."""
+        try:
+            names = os.listdir(self.path("metrics"))
+        except OSError:
+            return []
+        return [
+            self.path("metrics", n) for n in sorted(names)
+            if n.endswith(".json")
+        ]
+
+    def metrics_path(self, proc: str) -> str:
+        return self.path("metrics", f"{proc}.json")
+
+
+# --------------------------------------------------- fleet metric merging
+
+#: Version of the on-disk per-process metric snapshot files
+#: (``metrics/<proc>.json``). Bump on any breaking layout change;
+#: :func:`load_spool_metrics` REFUSES other versions so a mixed-version
+#: fleet fails loudly instead of silently mis-merging (the same stance
+#: as ``HistogramSnapshot.merge``'s bounds refusal).
+METRICS_FILE_SCHEMA = 1
+
+
+def write_metrics_file(
+    spool: Spool, proc: str, snapshot: dict, **extra
+) -> None:
+    """Flush one process's registry snapshot to the spool — atomic
+    temp-write + rename (the batch-file crash-safety discipline), so a
+    process SIGKILLed mid-flush leaves the previous valid file, never a
+    torn one."""
+    payload = {
+        "schema_version": METRICS_FILE_SCHEMA,
+        "proc": str(proc),
+        "pid": os.getpid(),
+        "ts": _tl.anchored_wall(),
+        "snapshot": snapshot,
+    }
+    payload.update(extra)
+    spool.write_json(spool.metrics_path(proc), payload)
+
+
+def load_spool_metrics(spool: Spool) -> Tuple[List[dict], List[str]]:
+    """Read every per-process snapshot in the spool. Returns
+    ``(payloads, skipped)``: unreadable/torn files land in ``skipped``
+    (a crash can leave garbage; the atomic-rename flushes themselves
+    never tear) — but a PARSEABLE file from another
+    :data:`METRICS_FILE_SCHEMA` version raises ValueError, the
+    mixed-version refusal path."""
+    payloads: List[dict] = []
+    skipped: List[str] = []
+    for path in spool.metrics_files():
+        payload = Spool.read_json(path)
+        if payload is None:
+            skipped.append(os.path.basename(path))
+            continue
+        ver = payload.get("schema_version")
+        if ver != METRICS_FILE_SCHEMA:
+            raise ValueError(
+                f"{path}: metrics snapshot schema_version {ver!r} != "
+                f"supported {METRICS_FILE_SCHEMA} — refusing to merge "
+                "across fleet versions"
+            )
+        if not isinstance(payload.get("snapshot"), dict) or not isinstance(
+            payload.get("proc"), str
+        ):
+            skipped.append(os.path.basename(path))
+            continue
+        payloads.append(payload)
+    return payloads, skipped
+
+
+def merge_spool_metrics(
+    spool: Spool, live: Optional[Dict[str, dict]] = None
+) -> dict:
+    """One fleet-wide snapshot from the spool's per-process flushes,
+    merged via ``metrics.merge_snapshots`` (per-``proc`` labels +
+    associatively merged aggregate histograms). ``live`` maps process
+    names to in-memory snapshots that OVERRIDE the on-disk file of the
+    same name (the coordinator passes its own registry so its view is
+    current, not flush-cadence stale)."""
+    payloads, skipped = load_spool_metrics(spool)
+    live = dict(live or {})
+    parts: List[Tuple[str, dict]] = [
+        (p["proc"], p["snapshot"]) for p in payloads
+        if p.get("proc") not in live
+    ]
+    parts += sorted(live.items())
+    merged = _metrics.merge_snapshots(parts)
+    if skipped:
+        merged["skipped_files"] = skipped
+    return merged
+
+
+def _merged_hist(merged: dict, name: str) -> Optional[dict]:
+    """The AGGREGATE (proc-label-free) histogram record for one series
+    name in a merged snapshot, or None."""
+    for rec in merged.get("histograms", ()):
+        if rec["name"] == name and "proc" not in rec.get("labels", {}):
+            return rec
+    return None
+
+
+def _counter_total(merged: dict, name: str) -> int:
+    return sum(
+        int(rec["value"]) for rec in merged.get("counters", ())
+        if rec["name"] == name
+    )
+
+
+def _pid_alive(pid) -> Optional[bool]:
+    try:
+        os.kill(int(pid), 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except (OSError, TypeError, ValueError):
+        return None  # unknowable (permissions, bad pid)
+
+
+def fleet_status(
+    spool_dir: str, live: Optional[Dict[str, dict]] = None
+) -> dict:
+    """Introspect one fleet spool — live fleet or post-mortem of a dead
+    one (ISSUE 9): queue depths, batch states, per-worker lease age /
+    health / throughput, and the merged latency percentiles, computed
+    from the SPOOL ALONE. ``Fleet.status()`` wraps this with the
+    coordinator's in-memory view; ``tools/fleet_top.py`` renders it."""
+    spool = Spool(spool_dir)
+    now_wall = _tl.anchored_wall()
+    pending = []
+    for name in spool.pending_batches():
+        batch = Spool.read_json(spool.path("pending", name))
+        formed = None if batch is None else batch.get("formed_at")
+        pending.append({
+            "batch": name,
+            "tickets": 0 if batch is None else len(batch.get("tickets", ())),
+            "attempts": 0 if batch is None else len(
+                set(batch.get("attempts", ()))
+            ),
+            "age_s": None if formed is None else max(
+                now_wall - float(formed), 0.0
+            ),
+        })
+    claimed = []
+    for name in spool.claimed_batches():
+        lease = Spool.read_json(spool.lease_path(name))
+        try:
+            age = max(time.time() - os.stat(spool.lease_path(name)).st_mtime,
+                      0.0)
+        except OSError:
+            age = None
+        claimed.append({
+            "batch": name,
+            "worker": None if lease is None else lease.get("worker"),
+            "lease_age_s": age,
+        })
+    try:
+        dead = sorted(
+            n for n in os.listdir(spool.path("dead")) if n.endswith(".json")
+        )
+    except OSError:
+        dead = []
+    try:
+        results = sum(
+            1 for n in os.listdir(spool.path("results"))
+            if n.endswith(".json")
+        )
+    except OSError:
+        results = 0
+
+    payloads, skipped = load_spool_metrics(spool)
+    merged = merge_spool_metrics(spool, live=live)
+    lease_by_worker = {
+        c["worker"]: c for c in claimed if c["worker"] is not None
+    }
+    workers = []
+    for p in payloads:
+        proc = p["proc"]
+        if proc == "coordinator":
+            continue
+        snap = p["snapshot"]
+        exec_rec = None
+        published = 0
+        for rec in snap.get("histograms", ()):
+            if rec["name"] == "serving.ticket.execute_ms" and not rec.get(
+                "labels"
+            ):
+                exec_rec = rec
+        for rec in snap.get("counters", ()):
+            if rec["name"] == "worker.tickets.published":
+                published += int(rec["value"])
+        health = None
+        for name in ("fleet.worker.health",):
+            for rec in merged.get("gauges", ()):
+                if rec["name"] == name and rec["labels"].get("worker") == proc:
+                    health = float(rec["value"])
+        lease = lease_by_worker.get(proc)
+        workers.append({
+            "worker": proc,
+            "pid": p.get("pid"),
+            "alive": _pid_alive(p.get("pid")),
+            "flush_age_s": max(now_wall - float(p.get("ts", 0.0)), 0.0),
+            "batches_done": p.get("batches_done"),
+            "tickets_published": published,
+            "lease": None if lease is None else lease["batch"],
+            "lease_age_s": None if lease is None else lease["lease_age_s"],
+            "health": health,
+            "execute_p50_ms": None if exec_rec is None else exec_rec["p50"],
+            "execute_p95_ms": None if exec_rec is None else exec_rec["p95"],
+            "execute_count": 0 if exec_rec is None else exec_rec["count"],
+        })
+
+    latency = {}
+    for key, series in (
+        ("e2e", "fleet.ticket.e2e_ms"),
+        ("spool_wait", "fleet.ticket.spool_wait_ms"),
+        ("execute", "fleet.ticket.execute_ms"),
+    ):
+        rec = _merged_hist(merged, series)
+        if rec is not None and rec["count"]:
+            latency[key] = {
+                "p50_ms": rec["p50"], "p95_ms": rec["p95"],
+                "p99_ms": rec["p99"], "count": rec["count"],
+            }
+    return {
+        "spool": spool.root,
+        "ts": now_wall,
+        "queue": {
+            "pending_batches": pending,
+            "claimed_batches": claimed,
+            "dead_batches": dead,
+            "results": results,
+        },
+        "workers": workers,
+        "latency": latency,
+        "counters": {
+            "worker_deaths": _counter_total(merged, "fleet.worker.deaths"),
+            "lease_requeues": _counter_total(merged, "fleet.lease.requeues"),
+            "straggler_alerts": _counter_total(
+                merged, "fleet.straggler_alerts"
+            ),
+            "dead_letters": _counter_total(merged, "fleet.dead_letters"),
+            "tickets_completed": _counter_total(
+                merged, "fleet.tickets.completed"
+            ),
+        },
+        "metrics_skipped_files": skipped,
+    }
 
 
 # ---------------------------------------------------- config serialization
@@ -262,17 +533,36 @@ class FleetTicket:
 
 
 class FleetResult:
-    """One completed ticket, loaded from the spool (host arrays)."""
+    """One completed ticket, loaded from the spool (host arrays).
 
-    def __init__(self, genomes, scores, generations, best_score, worker):
+    ``latency`` is the ticket's cross-process breakdown dict (ISSUE 9,
+    same content as ``FleetHandle.latency()``), ``trace`` its assembled
+    span-record list — both None when the fleet ran with tracing off.
+    """
+
+    def __init__(self, genomes, scores, generations, best_score, worker,
+                 latency=None, trace=None):
         self.genomes = genomes
         self.scores = scores
         self.generations = int(generations)
         self.best_score = float(best_score)
         self.worker = worker  # which worker published it
+        self.latency = latency
+        self.trace = trace
 
     def best(self) -> np.ndarray:
         return np.asarray(self.genomes[int(np.argmax(self.scores))])
+
+
+#: Cross-process latency spans, in breakdown order. The spans TILE the
+#: ticket's life (each one's end is the next one's start), so their sum
+#: telescopes to the end-to-end time regardless of per-process clock
+#: anchors: intake (submit -> batch file durable, coordinator), spool
+#: wait (batch durable -> winning worker claim), execute (claim -> run
+#: complete, worker — wraps the worker-local ``TicketTiming`` and the
+#: ``pga/<stage>`` spans), publish (complete -> result durable, worker),
+#: readback (result durable -> coordinator loaded it).
+FLEET_SPANS = ("intake", "spool_wait", "execute", "publish", "readback")
 
 
 class FleetHandle:
@@ -281,7 +571,13 @@ class FleetHandle:
     def __init__(self, fleet: "Fleet", tid: str, ticket: FleetTicket):
         self.tid = tid
         self.ticket = ticket
+        self.trace_id = _tl.new_trace_id()
         self._fleet = fleet
+        self._submit_wall = _tl.anchored_wall()
+        self._formed_wall: Optional[float] = None
+        self._batch: Optional[str] = None
+        self._breakdown: Optional[dict] = None
+        self._read_wall: Optional[float] = None
 
     def poll(self) -> bool:
         """True once a result (or a dead-letter verdict) is durable."""
@@ -292,6 +588,48 @@ class FleetHandle:
         :class:`FleetDeadLetter` when its batch was quarantined, and
         ``TimeoutError`` (handle stays re-awaitable) on timeout."""
         return self._fleet._await(self.tid, timeout)
+
+    def latency(self) -> dict:
+        """The ticket's TRUE cross-process latency breakdown (ms):
+        ``<span>_ms`` for each of :data:`FLEET_SPANS` plus ``e2e_ms``
+        (submit -> coordinator readback complete). Spans whose
+        transitions haven't happened (or that tracing-off suppressed)
+        read None. Unlike the worker-local ``TicketTiming`` this
+        composes timestamps from BOTH processes — the spans tile, so
+        they sum to e2e up to per-process clock-anchor error."""
+        if self._breakdown is not None:
+            return dict(self._breakdown)
+        return {f"{s}_ms": None for s in FLEET_SPANS} | {"e2e_ms": None}
+
+    def trace(self) -> List[dict]:
+        """The ticket's assembled span log: coordinator intake, every
+        claim/requeue/lease record of its batch (ALL attempts — a
+        requeued ticket's trace shows each worker that tried), the
+        winning worker's execute/publish spans, and the coordinator
+        readback. Records are schema-valid ``trace_span`` events."""
+        recs: List[dict] = []
+        if self._formed_wall is not None:
+            recs.append(_tl.trace_span_record(
+                "intake", self._submit_wall, self._formed_wall,
+                tid=self.tid, trace_id=self.trace_id, role="coordinator",
+            ))
+        if self._batch is not None:
+            recs += [
+                r for r in _tl.read_trace(
+                    self._fleet.spool.trace_path(self._batch)
+                )
+                if r.get("tid") in (None, self.tid)
+                and r.get("span") != "intake"  # synthesized above
+            ]
+        meta = self._fleet._meta(self.tid)
+        tr = (meta or {}).get("trace") or {}
+        recs += list(tr.get("spans", ()))
+        if self._read_wall is not None and tr.get("published_at") is not None:
+            recs.append(_tl.trace_span_record(
+                "readback", float(tr["published_at"]), self._read_wall,
+                tid=self.tid, trace_id=self.trace_id, role="coordinator",
+            ))
+        return recs
 
 
 def _now() -> float:
@@ -339,6 +677,7 @@ class Fleet:
         mutate_kind: str = "point",
         events=None,
         registry: Optional[_metrics.MetricsRegistry] = None,
+        slo=None,
     ):
         if not isinstance(objective, str):
             raise ValueError(
@@ -354,11 +693,13 @@ class Fleet:
         self.fleet = fleet or FleetConfig()
         self.mutate_kind = mutate_kind
         self.events = events
+        self.slo = slo  # fleet-level SLOConfig (check_slo / readback)
         self.registry = registry if registry is not None else _metrics.REGISTRY
         self._lock = threading.RLock()
         self._buckets: Dict[tuple, _Bucket] = {}
         self._handles: Dict[str, FleetHandle] = {}
         self._meta_cache: Dict[str, dict] = {}
+        self._counted: set = set()  # tids folded into self.completed
         self._workers: Dict[str, subprocess.Popen] = {}
         self._worker_gone: set = set()  # exits already accounted
         self._hb_seen: Dict[str, float] = {}  # batch -> last lease mtime
@@ -378,6 +719,14 @@ class Fleet:
         self.requeues = 0
         self.worker_deaths = 0
         self.quarantined: List[str] = []  # batch names moved to dead/
+        # Fleet observability state (ISSUE 9): coordinator metric-flush
+        # cadence bookkeeping, the workers currently holding a lease
+        # (for lease-age gauge resets), and the workers currently
+        # flagged as stragglers (alerts fire on the TRANSITION, not
+        # every scan).
+        self._last_flush = 0.0
+        self._lease_gauged: set = set()
+        self._stragglers: set = set()
 
     # --------------------------------------------------------------- events
 
@@ -429,6 +778,7 @@ class Fleet:
                         "--worker-id", wid,
                         "--heartbeat-s", str(self.fleet.heartbeat_s),
                         "--poll-s", str(self.fleet.poll_s),
+                        "--metrics-flush-s", str(self.fleet.metrics_flush_s),
                     ],
                     stdout=out, stderr=subprocess.STDOUT, env=env,
                 )
@@ -538,8 +888,11 @@ class Fleet:
             f"b{self._batch_seq:05d}-{self._token}-{size}x{genome_len}"
             f"{'-sup' if supervised else ''}.json"
         )
+        formed = _tl.anchored_wall()
         batch = {
             "batch": name,
+            "formed_at": formed,
+            "trace": bool(self.fleet.trace),
             "spec": {
                 "objective": self.objective,
                 "mutate_kind": self.mutate_kind,
@@ -547,11 +900,38 @@ class Fleet:
             },
             "attempts": [],
             "tickets": [
-                {"tid": tid, **dataclasses.asdict(t)}
+                {
+                    "tid": tid,
+                    "trace_id": getattr(
+                        self._handles.get(tid), "trace_id", None
+                    ),
+                    **dataclasses.asdict(t),
+                }
                 for tid, t in tickets
             ],
         }
         self.spool.write_json(self.spool.path("pending", name), batch)
+        if self.fleet.trace:
+            # The span log opens with one intake span per ticket —
+            # durable BEFORE any worker can claim, so a post-mortem of
+            # a fleet that died right here still has the trace head.
+            tp = self.spool.trace_path(name)
+            for tid, _ in tickets:
+                h = self._handles.get(tid)
+                if h is None:
+                    continue
+                h._formed_wall = formed
+                h._batch = name
+                _tl.append_trace(tp, _tl.trace_span_record(
+                    "intake", h._submit_wall, formed, tid=tid,
+                    trace_id=h.trace_id, batch=name, role="coordinator",
+                ))
+        else:
+            for tid, _ in tickets:
+                h = self._handles.get(tid)
+                if h is not None:
+                    h._formed_wall = formed
+                    h._batch = name
         self._emit(
             "batch_launch", bucket=name, batch_size=len(tickets),
             fill_ratio=round(len(tickets) / self.fleet.max_batch, 4),
@@ -597,9 +977,88 @@ class Fleet:
             ).copy()
             scores = data["scores"].copy()
             gens = int(data["generations"])
+        latency, trace = self._observe_readback(tid, meta)
         return FleetResult(
-            genomes, scores, gens, meta["best_score"], meta.get("worker")
+            genomes, scores, gens, meta["best_score"], meta.get("worker"),
+            latency=latency, trace=trace,
         )
+
+    def _observe_readback(self, tid: str, meta: dict):
+        """Close a completed ticket's trace (the coordinator-readback
+        span), assemble its cross-process breakdown, fold it into the
+        fleet latency histograms, and emit ``fleet_ticket_done`` —
+        exactly once per ticket; later ``result()`` calls reuse the
+        stored breakdown. Returns ``(latency, trace)`` (None, None with
+        tracing off or when the meta carries no trace)."""
+        handle = self._handles.get(tid)
+        if handle is None:
+            return None, None
+        tr = meta.get("trace") or None
+        if tr is None:
+            return None, None
+        ver = tr.get("schema_version")
+        if ver != _tl.TRACE_SCHEMA_VERSION:
+            raise ValueError(
+                f"ticket {tid}: result trace schema_version {ver!r} != "
+                f"supported {_tl.TRACE_SCHEMA_VERSION} — mixed-version "
+                "fleet (refusing to compose spans)"
+            )
+        if handle._breakdown is not None:
+            return dict(handle._breakdown), handle.trace()
+        read_done = _tl.anchored_wall()
+        handle._read_wall = read_done
+        edges = (
+            handle._submit_wall, handle._formed_wall, tr.get("claimed_at"),
+            tr.get("completed_at"), tr.get("published_at"), read_done,
+        )
+
+        def ms(a, b):
+            return (
+                None if a is None or b is None
+                else max((float(b) - float(a)) * 1e3, 0.0)
+            )
+
+        breakdown = {
+            f"{span}_ms": ms(edges[i], edges[i + 1])
+            for i, span in enumerate(FLEET_SPANS)
+        }
+        breakdown["e2e_ms"] = ms(edges[0], edges[-1])
+        handle._breakdown = breakdown
+        for span in FLEET_SPANS:
+            v = breakdown[f"{span}_ms"]
+            if v is not None:
+                self.registry.histogram(f"fleet.ticket.{span}_ms").observe(v)
+        if breakdown["e2e_ms"] is not None:
+            self.registry.histogram("fleet.ticket.e2e_ms").observe(
+                breakdown["e2e_ms"]
+            )
+        self.registry.counter("fleet.tickets.traced").bump()
+        self._emit(
+            "fleet_ticket_done", trace_id=handle.trace_id, tid=tid,
+            worker=meta.get("worker"),
+            **{k: None if v is None else round(v, 3)
+               for k, v in breakdown.items()},
+        )
+        slo = self.slo
+        wait = (
+            None
+            if breakdown["intake_ms"] is None
+            or breakdown["spool_wait_ms"] is None
+            else breakdown["intake_ms"] + breakdown["spool_wait_ms"]
+        )
+        if (
+            slo is not None
+            and slo.max_queue_wait_ms is not None
+            and wait is not None
+            and wait > slo.max_queue_wait_ms
+        ):
+            self.registry.counter("fleet.slo_violations").bump()
+            self._emit(
+                "slo_violation", what="fleet_queue_wait",
+                value_ms=round(wait, 3), limit_ms=slo.max_queue_wait_ms,
+                trace_id=handle.trace_id,
+            )
+        return dict(breakdown), handle.trace()
 
     # -------------------------------------------------------------- monitor
 
@@ -634,13 +1093,18 @@ class Fleet:
                 if b.tickets and b.oldest <= deadline:
                     self._form_batch(key)
         # 2. Completions: new result metas wake blocked result()/submit().
+        # Counted via a dedicated set, NOT meta-cache presence — a
+        # result() call that reads the meta first would otherwise hide
+        # the completion from this accounting (undercounting
+        # ``completed`` and over-tightening max_pending backpressure).
         fresh = False
         for tid in list(self._handles):
-            if tid in self._meta_cache:
+            if tid in self._counted:
                 continue
             meta = self._meta(tid)
             if meta is not None:
                 fresh = True
+                self._counted.add(tid)
                 self.completed += 1
                 self.registry.counter("fleet.tickets.completed").bump()
         if fresh:
@@ -678,6 +1142,10 @@ class Fleet:
             self._alive_gauge()
         # 4. Lease expiry: stale heartbeats (SIGSTOP, wedged worker,
         # dead heartbeat thread) requeue the batch onto a survivor.
+        # Lease ages double as per-worker gauges (ISSUE 9): the merged
+        # exposition and fleet_top read how long each worker has gone
+        # without touching its lease.
+        gauged_now: set = set()
         for name in self.spool.claimed_batches():
             lease_path = self.spool.lease_path(name)
             try:
@@ -694,10 +1162,28 @@ class Fleet:
             if last is not None and mtime > last:
                 self.registry.counter("fleet.lease.heartbeats").bump()
             self._hb_seen[name] = mtime
-            if time.time() - mtime > self.fleet.lease_timeout_s:
+            age = max(time.time() - mtime, 0.0)
+            owner = lease_owner.get(name)
+            if owner is not None:
+                gauged_now.add(owner)
+                self.registry.gauge(
+                    "fleet.lease.age_s", worker=owner
+                ).set(round(age, 3))
+            if age > self.fleet.lease_timeout_s:
                 self._requeue(
                     name, lease_owner.get(name, "?"), "lease_expired"
                 )
+        for owner in self._lease_gauged - gauged_now:
+            self.registry.gauge("fleet.lease.age_s", worker=owner).set(0.0)
+        self._lease_gauged = gauged_now
+        # 5. Observability flush (ISSUE 9): at metrics_flush_s cadence,
+        # persist the coordinator's own registry snapshot to the spool
+        # (so post-mortems and fleet_top see the fleet-level series)
+        # and run the straggler scan over the workers' flushes.
+        if now - self._last_flush >= self.fleet.metrics_flush_s:
+            self._last_flush = now
+            self.flush_metrics()
+            self.detect_stragglers()
 
     # -------------------------------------------------- requeue / quarantine
 
@@ -743,6 +1229,15 @@ class Fleet:
             return  # raced a concurrent transition; next tick re-scans
         self.requeues += 1
         self.registry.counter("fleet.lease.requeues").bump()
+        if batch.get("trace", False):
+            now_w = _tl.anchored_wall()
+            _tl.append_trace(
+                self.spool.trace_path(name),
+                _tl.trace_span_record(
+                    "requeue", now_w, now_w, batch=name, worker=worker,
+                    reason=reason, attempts=distinct, role="coordinator",
+                ),
+            )
         self._emit(
             "lease_requeue", batch=name, worker=worker, reason=reason,
             attempts=distinct,
@@ -756,6 +1251,17 @@ class Fleet:
         ``dead/`` with a flight-recorder dump and fail its unfinished
         tickets instead of feeding it more workers."""
         dead = self.spool.path("dead", name)
+        # The dead batch's span log rides into both post-mortem
+        # artifacts (ISSUE 9): embedded in the dead batch file AND in
+        # the flight dump, so "which workers touched this batch, when"
+        # survives even if the traces/ directory is swept.
+        trace_log: List[dict] = []
+        try:
+            trace_log = _tl.read_trace(self.spool.trace_path(name))
+        except ValueError:
+            pass  # a mixed-version trace must not block quarantine
+        if trace_log:
+            batch["trace_log"] = trace_log
         self.spool.write_json(claimed, batch)
         try:
             os.rename(claimed, dead)
@@ -774,6 +1280,7 @@ class Fleet:
         _tl.FLIGHT.dump(
             path=self.spool.path("dead", f"{name}.flight.jsonl"),
             reason="fleet_dead_letter",
+            extra=trace_log,
         )
         with self._cv:
             self._cv.notify_all()
@@ -786,6 +1293,136 @@ class Fleet:
         with open(tmp, "w", encoding="utf-8") as fh:
             json.dump({"tid": tid, "error": error}, fh)
         self.spool.publish(tmp, meta_path)
+
+    # ------------------------------------------- fleet observability (9)
+
+    def flush_metrics(self) -> None:
+        """Persist the coordinator's registry snapshot to the spool's
+        ``metrics/`` directory (atomic rename) — called by the monitor
+        at ``metrics_flush_s`` cadence and by ``close()``, so the
+        fleet-level series survive the coordinator for post-mortems."""
+        try:
+            write_metrics_file(
+                self.spool, "coordinator", self.registry.snapshot(),
+                submitted=self.submitted, completed=self.completed,
+            )
+        except OSError:
+            pass  # a full disk must not take down the monitor
+
+    def merged_snapshot(self) -> dict:
+        """ONE fleet-wide metrics snapshot: every worker's latest spool
+        flush merged with the coordinator's LIVE registry through the
+        associative histogram merge, per-process labels on every
+        series (``metrics.merge_snapshots``)."""
+        return merge_spool_metrics(
+            self.spool, live={"coordinator": self.registry.snapshot()}
+        )
+
+    def merged_prometheus(self) -> str:
+        """The merged fleet snapshot in Prometheus text exposition
+        format — one scrape target for the whole fleet."""
+        return _metrics.prometheus_text(self.merged_snapshot())
+
+    def detect_stragglers(self) -> List[dict]:
+        """Flag workers whose execute-latency p95 exceeds the fleet
+        median of worker p95s by ``FleetConfig.straggler_factor``
+        (needs >= 2 reporting workers and ``straggler_min_samples``
+        observations each). A NEWLY slow worker emits one schema-valid
+        ``straggler_alert`` event, bumps ``fleet.straggler_alerts``,
+        and drops its ``fleet.worker.health`` gauge to 0; recovery
+        restores it to 1. Returns the alerts raised this scan."""
+        import statistics
+
+        try:
+            payloads, _ = load_spool_metrics(self.spool)
+        except ValueError:
+            raise  # mixed-version fleet: fail loudly, not silently
+        stats: List[Tuple[str, float]] = []
+        for p in payloads:
+            if p["proc"] == "coordinator":
+                continue
+            for rec in p["snapshot"].get("histograms", ()):
+                if (
+                    rec["name"] == "serving.ticket.execute_ms"
+                    and not rec.get("labels")
+                    and rec["count"] >= self.fleet.straggler_min_samples
+                    and rec.get("p95") is not None
+                ):
+                    stats.append((p["proc"], float(rec["p95"])))
+        alerts: List[dict] = []
+        if len(stats) < 2:
+            return alerts
+        median = statistics.median(p95 for _, p95 in stats)
+        for wid, p95 in stats:
+            slow = median > 0 and p95 > self.fleet.straggler_factor * median
+            self.registry.gauge("fleet.worker.health", worker=wid).set(
+                0.0 if slow else 1.0
+            )
+            if slow and wid not in self._stragglers:
+                self._stragglers.add(wid)
+                self.registry.counter(
+                    "fleet.straggler_alerts", worker=wid
+                ).bump()
+                alert = {
+                    "worker": wid,
+                    "p95_ms": round(p95, 3),
+                    "fleet_p95_ms": round(median, 3),
+                    "factor": self.fleet.straggler_factor,
+                }
+                self._emit("straggler_alert", **alert)
+                alerts.append(alert)
+            elif not slow:
+                self._stragglers.discard(wid)
+        return alerts
+
+    def check_slo(self, slo=None) -> List[dict]:
+        """Fleet-level aggregate SLO check: the coordinator's merged
+        end-to-end ticket latency histogram's p99 against
+        ``slo.p99_latency_ms`` (skipped below ``min_samples``), the
+        same contract as ``RunQueue.check_slo`` one level up. Returns
+        violation dicts; each emits one ``slo_violation`` event."""
+        slo = slo or self.slo
+        if slo is None:
+            return []
+        violations: List[dict] = []
+        if slo.p99_latency_ms is not None:
+            snap = self.registry.histogram("fleet.ticket.e2e_ms").snapshot()
+            if snap.count >= slo.min_samples:
+                p99 = snap.percentile(99.0)
+                if p99 > slo.p99_latency_ms:
+                    violations.append({
+                        "what": "fleet_p99_latency",
+                        "value_ms": round(p99, 3),
+                        "limit_ms": slo.p99_latency_ms,
+                        "samples": snap.count,
+                    })
+        for v in violations:
+            self.registry.counter("fleet.slo_violations").bump()
+            self._emit("slo_violation", **v)
+        return violations
+
+    def status(self) -> dict:
+        """The live fleet console feed: :func:`fleet_status` over this
+        fleet's spool (queue depths, per-worker lease age / health /
+        throughput, merged latency percentiles) plus the coordinator's
+        in-memory view (workers alive, outstanding tickets,
+        quarantines). ``tools/fleet_top.py`` renders the same dict for
+        spools whose coordinator is gone."""
+        st = fleet_status(
+            self.spool.root,
+            live={"coordinator": self.registry.snapshot()},
+        )
+        st["coordinator"] = {
+            "pid": os.getpid(),
+            "workers_alive": self.workers_alive(),
+            "submitted": self.submitted,
+            "completed": self.completed,
+            "outstanding": self._outstanding(),
+            "requeues": self.requeues,
+            "worker_deaths": self.worker_deaths,
+            "quarantined": list(self.quarantined),
+        }
+        return st
 
     # ------------------------------------------------------- drain / close
 
@@ -829,6 +1466,7 @@ class Fleet:
             return
         self.flush()
         self.drain()
+        self.flush_metrics()  # final coordinator snapshot for post-mortems
         self._closed = True
         self._stop_monitor.set()
         if self._monitor is not None:

@@ -96,6 +96,29 @@ class TicketTiming:
             "e2e_ms": self.e2e_ms,
         }
 
+    def trace_spans(self, **attrs) -> List[dict]:
+        """The stamped lifecycle as composable ``trace_span`` records
+        (ISSUE 9): the worker-LOCAL sub-spans of a fleet ticket's
+        execute span — ``local_queue_wait`` (submit -> mega-run
+        launch), ``local_run`` (launch -> run complete),
+        ``local_readback`` (complete -> host materialization) — with
+        the monotonic stamps converted to this process's anchored wall
+        clock (``telemetry.anchored_wall``), so they nest inside the
+        cross-process span log a fleet worker publishes. Spans whose
+        transitions haven't happened are omitted."""
+        out: List[dict] = []
+        for name, a, b in (
+            ("local_queue_wait", self.submitted, self.launched),
+            ("local_run", self.launched, self.completed),
+            ("local_readback", self.completed, self.readback),
+        ):
+            if a is not None and b is not None:
+                out.append(_tl.trace_span_record(
+                    name, _tl.anchored_wall(a), _tl.anchored_wall(b),
+                    **attrs,
+                ))
+        return out
+
 
 @dataclasses.dataclass(frozen=True)
 class DeadLetter:

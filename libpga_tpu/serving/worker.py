@@ -35,7 +35,18 @@ wrapping the existing round-9/10 execution engines in a
   — a worker that lost its lease (SIGSTOP + requeue) may finish late
   and publish bits identical to the re-run's, so the race is benign;
   before retiring the batch file it re-checks lease ownership and
-  abandons cleanup if the coordinator reassigned the batch.
+  abandons cleanup if the coordinator reassigned the batch;
+- **observability** (ISSUE 9): when the batch rides with tracing on,
+  the worker appends durable claim / lease-held markers to the
+  batch's span log (``traces/``) and publishes each ticket's
+  spool_wait / execute / publish spans (+ the worker-local
+  ``TicketTiming`` breakdown) inside the result meta — the
+  coordinator composes them with its own intake/readback spans into
+  the cross-process latency breakdown. A background flusher also
+  writes this process's ``MetricsRegistry`` snapshot to
+  ``metrics/<wid>.json`` every ``--metrics-flush-s`` seconds (atomic
+  rename), feeding the merged fleet exposition, straggler detection,
+  and ``tools/fleet_top.py``.
 
 Chaos hooks (environment, set per worker by the coordinator's
 ``start(worker_env=...)`` in tests and ``tools/chaos_smoke.py`` /
@@ -96,11 +107,13 @@ class WorkerHarness:
         worker_id: str,
         heartbeat_s: float = 0.5,
         poll_s: float = 0.05,
+        metrics_flush_s: float = 1.0,
     ):
         self.spool = Spool(spool_dir)
         self.wid = worker_id
         self.heartbeat_s = heartbeat_s
         self.poll_s = poll_s
+        self.metrics_flush_s = metrics_flush_s
         self.drain_evt = threading.Event()
         self._lease_lost = threading.Event()
         self._hb_stop = threading.Event()
@@ -109,6 +122,14 @@ class WorkerHarness:
         self._exec_calls = 0
         self._chaos = _parse_chaos(os.environ.get("PGA_WORKER_CHAOS", ""))
         self.batches_done = 0
+        # Cross-process tracing (ISSUE 9): the anchored-wall claim time
+        # and trace flag of the batch currently held, so every published
+        # ticket's meta carries its spool-composable span edges.
+        self._claim_wall: Dict[str, float] = {}
+        self._trace_on: Dict[str, bool] = {}
+        self._started_wall = _tl.anchored_wall()
+        self._mf_stop = threading.Event()
+        self._mf_thread: Optional[threading.Thread] = None
         # Flight-recorder attribution (ISSUE 8 satellite): dumps from
         # this process carry the worker id + pid in their trailer and
         # land inside the spool for fleet post-mortems.
@@ -166,6 +187,35 @@ class WorkerHarness:
         lease = self.spool.read_json(self.spool.lease_path(batch_name))
         return lease is not None and lease.get("worker") == self.wid
 
+    # -------------------------------------------------------------- metrics
+
+    def _flush_metrics(self) -> None:
+        """One atomic registry-snapshot flush into the spool's
+        ``metrics/`` directory — the coordinator merges these into the
+        fleet exposition and straggler scan (ISSUE 9)."""
+        from libpga_tpu.serving.fleet import write_metrics_file
+
+        try:
+            write_metrics_file(
+                self.spool, self.wid, _metrics.REGISTRY.snapshot(),
+                worker=self.wid, batches_done=self.batches_done,
+                started_at=self._started_wall,
+            )
+        except Exception:
+            pass  # flushing is observability, never worker correctness
+
+    def _start_metrics_flusher(self) -> None:
+        self._flush_metrics()  # first file durable before any claim
+
+        def flush_loop():
+            while not self._mf_stop.wait(self.metrics_flush_s):
+                self._flush_metrics()
+
+        self._mf_thread = threading.Thread(
+            target=flush_loop, name=f"pga-metrics-{self.wid}", daemon=True
+        )
+        self._mf_thread.start()
+
     # ---------------------------------------------------------------- claim
 
     def claim(self) -> Optional[str]:
@@ -174,6 +224,7 @@ class WorkerHarness:
         for name in self.spool.pending_batches():
             src = self.spool.path("pending", name)
             dst = self.spool.path("claimed", name)
+            t0 = _tl.anchored_wall()
             try:
                 os.rename(src, dst)
             except OSError:
@@ -183,6 +234,22 @@ class WorkerHarness:
                 {"worker": self.wid, "pid": os.getpid(),
                  "claimed": time.time()},
             )
+            claimed = _tl.anchored_wall()
+            self._claim_wall[name] = claimed
+            batch = self.spool.read_json(dst)
+            trace_on = bool(batch.get("trace", False)) if batch else False
+            self._trace_on[name] = trace_on
+            if trace_on:
+                # Durable BEFORE execution starts: a worker that dies
+                # mid-batch still leaves its claim in the span log, so
+                # the re-run ticket's trace shows BOTH attempts.
+                _tl.append_trace(
+                    self.spool.trace_path(name),
+                    _tl.trace_span_record(
+                        "claim", t0, claimed, batch=name, worker=self.wid,
+                        role="worker",
+                    ),
+                )
             self._start_heartbeat(name)
             self._emit("lease_claim", worker=self.wid, batch=name)
             return name
@@ -220,7 +287,9 @@ class WorkerHarness:
 
     # -------------------------------------------------------------- publish
 
-    def _publish(self, tid: str, genomes, scores, gens) -> None:
+    def _publish(
+        self, tid: str, genomes, scores, gens, trace: Optional[dict] = None
+    ) -> None:
         from libpga_tpu.utils.checkpoint import _encode
 
         npz_path, meta_path = self.spool.result_paths(tid)
@@ -235,16 +304,73 @@ class WorkerHarness:
         self.spool.publish(tmp, npz_path)
         import json as _json
 
-        mtmp = f"{meta_path}.{os.getpid()}.tmp"
-        with open(mtmp, "w", encoding="utf-8") as fh:
-            _json.dump(
-                {"tid": tid, "generations": int(gens),
-                 "best_score": float(np.max(s)), "worker": self.wid,
-                 "pid": os.getpid(), "error": None},
-                fh,
-            )
+        meta = {"tid": tid, "generations": int(gens),
+                "best_score": float(np.max(s)), "worker": self.wid,
+                "pid": os.getpid(), "error": None}
+        if trace is not None:
+            # The span log travels WITH the result: stamp the publish
+            # edge now (the npz above is already durable), close the
+            # publish span, and version the whole trace block so a
+            # mixed-version coordinator refuses instead of mis-reading.
+            published = _tl.anchored_wall()
+            trace = dict(trace)
+            trace["schema_version"] = _tl.TRACE_SCHEMA_VERSION
+            trace["published_at"] = published
+            completed = trace.get("completed_at")
+            if completed is not None:
+                trace.setdefault("spans", []).append(
+                    _tl.trace_span_record(
+                        "publish", completed, published, tid=tid,
+                        trace_id=trace.get("trace_id"), worker=self.wid,
+                        role="worker",
+                    )
+                )
+            meta["trace"] = trace
+        with open(mtmp := f"{meta_path}.{os.getpid()}.tmp", "w",
+                  encoding="utf-8") as fh:
+            _json.dump(meta, fh)
         self.spool.publish(mtmp, meta_path)
         _metrics.REGISTRY.counter("worker.tickets.published").bump()
+
+    def _trace_base(self, name: str, batch: dict, t: dict,
+                    completed: float, local=None) -> Optional[dict]:
+        """The per-ticket trace block published with its result: the
+        anchored claim/complete edges plus the worker-side span records
+        (spool_wait and execute; publish is appended at publish time).
+        None when the batch rode with tracing off."""
+        if not self._trace_on.get(name, False):
+            return None
+        claimed = self._claim_wall.get(name)
+        formed = batch.get("formed_at")
+        tid, trace_id = t["tid"], t.get("trace_id")
+        spans = []
+        if formed is not None and claimed is not None:
+            spans.append(_tl.trace_span_record(
+                "spool_wait", float(formed), claimed, tid=tid,
+                trace_id=trace_id, worker=self.wid, role="worker",
+            ))
+        if claimed is not None:
+            spans.append(_tl.trace_span_record(
+                "execute", claimed, completed, tid=tid, trace_id=trace_id,
+                worker=self.wid, role="worker",
+            ))
+        base = {
+            "trace_id": trace_id,
+            "worker": self.wid,
+            "claimed_at": claimed,
+            "completed_at": completed,
+            "spans": spans,
+        }
+        if local is not None:
+            # Link to the worker-LOCAL lifecycle (round-11 TicketTiming
+            # on this process's RunQueue ticket): the breakdown dict
+            # plus its anchored sub-spans, which nest inside the
+            # cross-process execute span.
+            base["worker_timing"] = local.latency()
+            spans += local.timing.trace_spans(
+                tid=tid, trace_id=trace_id, worker=self.wid, role="worker",
+            )
+        return base
 
     def _publish_error(self, tid: str, error: BaseException) -> None:
         import json as _json
@@ -294,19 +420,24 @@ class WorkerHarness:
             if t["checkpoint_every"] > 0 and not self._has_result(t["tid"])
         ]
         try:
-            if plain and not self._abandoned():
-                done |= self._run_plain(batch["spec"], plain)
-            for t in supervised:
-                if self._abandoned():
-                    break
-                if self.drain_evt.is_set():
-                    drained = True
-                    break
-                if self._run_supervised(name, batch["spec"], t):
-                    done.add(t["tid"])
-                else:
-                    drained = True  # stopped at a chunk boundary
-                    break
+            # The profiler-visible envelope of this batch: the fleet
+            # "execute" trace span brackets the same interval, so a
+            # jax.profiler capture nests the engine's pga/<stage> spans
+            # under pga/fleet_execute (the cross-layer link, ISSUE 9).
+            with _tl.span("fleet_execute"):
+                if plain and not self._abandoned():
+                    done |= self._run_plain(name, batch, plain)
+                for t in supervised:
+                    if self._abandoned():
+                        break
+                    if self.drain_evt.is_set():
+                        drained = True
+                        break
+                    if self._run_supervised(name, batch, t):
+                        done.add(t["tid"])
+                    else:
+                        drained = True  # stopped at a chunk boundary
+                        break
         except BaseException:
             # The worker is about to die mid-batch (injected fault,
             # unexpected error): leave the claimed file AND the lease
@@ -327,15 +458,19 @@ class WorkerHarness:
             is not None
         )
 
-    def _run_plain(self, spec: dict, tickets: List[dict]) -> set:
+    def _run_plain(self, name: str, batch: dict,
+                   tickets: List[dict]) -> set:
         """All plain tickets of the batch as ONE mega-run through the
         worker-local RunQueue — per-ticket isolation included: a
         poisoned ticket's error becomes its published verdict, innocent
-        co-batched tickets complete."""
+        co-batched tickets complete. With tracing on, each published
+        result carries its span block (spool_wait/execute edges + the
+        worker-local TicketTiming breakdown)."""
         from libpga_tpu.serving.batch import RunRequest
 
-        _, queue = self._engine(spec)
+        _, queue = self._engine(batch["spec"])
         handles = []
+        by_tid = {t["tid"]: t for t in tickets}
         for t in tickets:
             req = RunRequest(
                 size=t["size"], genome_len=t["genome_len"], n=t["n"],
@@ -353,12 +488,16 @@ class WorkerHarness:
                 self._publish_error(tid, e)
             else:
                 self._publish(
-                    tid, res.genomes, res.scores, res.generations
+                    tid, res.genomes, res.scores, res.generations,
+                    trace=self._trace_base(
+                        name, batch, by_tid[tid], _tl.anchored_wall(),
+                        local=ticket,
+                    ),
                 )
             done.add(tid)
         return done
 
-    def _run_supervised(self, name: str, spec: dict, t: dict) -> bool:
+    def _run_supervised(self, name: str, batch: dict, t: dict) -> bool:
         """One supervised ticket at its cadence; True when it finished
         (result published), False when the drain hook stopped it at a
         chunk boundary (checkpoint durable, ticket stays unfinished).
@@ -375,6 +514,7 @@ class WorkerHarness:
             supervised_run,
         )
 
+        spec = batch["spec"]
         cfg = config_from_json(spec["config"])
         if t["mutation_rate"] is not None:
             cfg = _dc.replace(cfg, mutation_rate=t["mutation_rate"])
@@ -399,7 +539,8 @@ class WorkerHarness:
             return False
         pop = pga.populations[0]
         self._publish(
-            t["tid"], pop.genomes, pop.scores, report.generations
+            t["tid"], pop.genomes, pop.scores, report.generations,
+            trace=self._trace_base(name, batch, t, _tl.anchored_wall()),
         )
         return True
 
@@ -440,6 +581,18 @@ class WorkerHarness:
             os.remove(self.spool.lease_path(name))
         except OSError:
             pass
+        if self._trace_on.pop(name, False):
+            claimed = self._claim_wall.get(name)
+            if claimed is not None:
+                _tl.append_trace(
+                    self.spool.trace_path(name),
+                    _tl.trace_span_record(
+                        "lease_held", claimed, _tl.anchored_wall(),
+                        batch=name, worker=self.wid, role="worker",
+                        drained=bool(drained),
+                    ),
+                )
+        self._claim_wall.pop(name, None)
         self.batches_done += 1
         _metrics.REGISTRY.counter("worker.batches.done").bump()
 
@@ -449,6 +602,7 @@ class WorkerHarness:
         """Claim/execute until drained (SIGTERM). Returns the exit
         code: 0 for a clean drain."""
         self._emit("worker_spawn", worker=self.wid, pid=os.getpid())
+        self._start_metrics_flusher()
         clean = False
         try:
             while not self.drain_evt.is_set():
@@ -470,14 +624,19 @@ class WorkerHarness:
 
     def _shutdown(self, clean: bool = True) -> None:
         self._stop_heartbeat()
+        self._mf_stop.set()
+        if self._mf_thread is not None:
+            self._mf_thread.join(timeout=2 * self.metrics_flush_s + 1)
+            self._mf_thread = None
         for _, queue in self._engines.values():
             try:
                 queue.close()
             except Exception:
                 pass
-        # Per-worker metrics exposition for fleet post-mortems and the
-        # CI Prometheus lint (tools/fleet_smoke.py): this process's
-        # registry, rendered once at exit.
+        # Final registry flush (the post-mortem file the coordinator's
+        # merge and fleet_top read) + per-worker Prometheus exposition
+        # for the CI lint (tools/fleet_smoke.py), both written at exit.
+        self._flush_metrics()
         try:
             snap = _metrics.REGISTRY.snapshot()
             with open(
@@ -501,6 +660,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--worker-id", required=True)
     ap.add_argument("--heartbeat-s", type=float, default=0.5)
     ap.add_argument("--poll-s", type=float, default=0.05)
+    ap.add_argument("--metrics-flush-s", type=float, default=1.0)
     args = ap.parse_args(argv)
 
     spec = os.environ.get("PGA_FAULT_SPEC", "")
@@ -510,6 +670,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     harness = WorkerHarness(
         args.spool, args.worker_id,
         heartbeat_s=args.heartbeat_s, poll_s=args.poll_s,
+        metrics_flush_s=args.metrics_flush_s,
     )
     # SIGTERM = preemption notice: finish/checkpoint the current chunk,
     # return the lease, exit 0. Installed on the main thread before any

@@ -524,6 +524,69 @@ class MetricsRegistry:
         return prometheus_text(self.snapshot(), prefix=prefix)
 
 
+def merge_snapshots(
+    parts: Sequence[Tuple[str, dict]], proc_label: str = "proc"
+) -> dict:
+    """Merge per-process :meth:`MetricsRegistry.snapshot` dicts into ONE
+    fleet-wide snapshot (ISSUE 9 — the coordinator's aggregation of
+    worker registry flushes).
+
+    ``parts`` is ``[(proc_name, snapshot), ...]`` — one entry per
+    process, names unique (the fleet uses worker ids plus
+    ``"coordinator"``). Every series gains a ``proc`` label naming its
+    origin (the per-worker labels the merged Prometheus exposition
+    carries), and histograms ADDITIONALLY fold into one aggregate
+    series per (name, original labels) without the ``proc`` label via
+    :meth:`HistogramSnapshot.merge` — associative and commutative, so
+    the merge order cannot change the fleet percentiles, and a bounds
+    mismatch (a worker built on different bucket parameters) raises
+    rather than silently mis-merging. A snapshot from another
+    ``SNAPSHOT_SCHEMA`` version is refused the same way: loudly.
+    """
+    merged: dict = {
+        "schema": MetricsRegistry.SNAPSHOT_SCHEMA,
+        "ts": 0.0,
+        "merged_from": [],
+        "counters": [],
+        "gauges": [],
+        "histograms": [],
+    }
+    agg: Dict[Tuple[str, tuple], HistogramSnapshot] = {}
+    seen: set = set()
+    for proc, snap in parts:
+        if proc in seen:
+            raise ValueError(f"duplicate process name {proc!r} in merge")
+        seen.add(proc)
+        if not isinstance(snap, dict) or snap.get("schema") != (
+            MetricsRegistry.SNAPSHOT_SCHEMA
+        ):
+            raise ValueError(
+                f"snapshot from {proc!r} has schema "
+                f"{None if not isinstance(snap, dict) else snap.get('schema')!r}"
+                f" != supported {MetricsRegistry.SNAPSHOT_SCHEMA} — "
+                "refusing to merge across registry versions"
+            )
+        merged["ts"] = max(merged["ts"], float(snap.get("ts", 0.0)))
+        merged["merged_from"].append(str(proc))
+        for kind in ("counters", "gauges", "histograms"):
+            for rec in snap.get(kind, ()):
+                labeled = dict(rec)
+                labeled["labels"] = {
+                    **rec.get("labels", {}), proc_label: str(proc)
+                }
+                merged[kind].append(labeled)
+                if kind == "histograms":
+                    key = (rec["name"], _labels_key(rec.get("labels", {})))
+                    h = HistogramSnapshot.from_dict(rec)
+                    prev = agg.get(key)
+                    agg[key] = h if prev is None else prev.merge(h)
+    for (name, labels), h in sorted(agg.items()):
+        merged["histograms"].append(
+            {"name": name, "labels": dict(labels), **h.as_dict()}
+        )
+    return merged
+
+
 #: The process-wide registry every instrumented subsystem shares.
 #: Tests that assert exact series contents should construct their own
 #: MetricsRegistry (RunQueue and friends accept one) or reset this.

@@ -111,6 +111,14 @@ EVENT_FIELDS: Dict[str, tuple] = {
     "worker_drain": ("worker",),
     "lease_claim": ("worker", "batch"),
     "lease_requeue": ("batch", "worker"),
+    # Fleet observability (ISSUE 9): cross-process trace spans riding
+    # the spool (``trace_span`` records are BOTH the span-log line
+    # format and flight-dump embeds), the coordinator's end-to-end
+    # ticket verdict with its cross-process breakdown, and straggler
+    # detection over the merged per-worker metric snapshots.
+    "trace_span": ("span", "t0", "t1"),
+    "fleet_ticket_done": ("trace_id", "e2e_ms"),
+    "straggler_alert": ("worker", "p95_ms", "fleet_p95_ms"),
 }
 
 
@@ -398,6 +406,128 @@ class EventLog:
         self.close()
 
 
+# ------------------------------------------------- cross-process tracing
+#
+# The fleet's span log (ISSUE 9). A ticket's life crosses at least two
+# processes (coordinator intake -> spool wait -> worker claim/execute/
+# publish -> coordinator readback), so span timestamps must compose
+# across processes WITHOUT trusting wall-clock sync mid-run: every
+# process anchors its monotonic clock to wall time ONCE at import
+# (:data:`_MONO_ANCHOR`) and stamps spans as anchor + monotonic delta.
+# Within a process, span deltas are exactly monotonic deltas (immune to
+# NTP steps); across processes on one host, anchors agree to the
+# clock's accuracy at process start — and the assembled breakdown
+# TELESCOPES (each span's end is the next span's start), so the sum of
+# a ticket's spans equals its end-to-end wall time regardless of
+# per-process anchor offsets.
+#
+# On-disk format: span logs are JSONL files of ``trace_span`` event
+# records (``traces/<batch>.trace.jsonl`` in a fleet spool), appended
+# with O_APPEND single writes so concurrent writers (two workers racing
+# a requeue) interleave whole lines. :func:`read_trace` tolerates a
+# torn LAST line (a writer killed mid-append) but REFUSES records from
+# another schema version — a mixed-version fleet fails loudly instead
+# of silently mis-composing spans (the same stance as
+# ``HistogramSnapshot.merge``'s bounds-mismatch refusal).
+
+#: Version of the on-disk span-log record layout. Bump on any breaking
+#: change to the trace_span field set; readers refuse other versions.
+TRACE_SCHEMA_VERSION = 1
+
+#: Wall-clock anchor of this process's monotonic clock, captured once
+#: at import. ``anchored_wall()`` timestamps derived from it are
+#: comparable across the processes of one host without trusting
+#: wall-clock stability DURING the run.
+_MONO_ANCHOR = time.time() - time.monotonic()
+
+
+def anchored_wall(mono: Optional[float] = None) -> float:
+    """Wall-clock seconds derived from the monotonic clock and this
+    process's import-time anchor. Pass a ``time.monotonic()`` reading
+    to convert it; default is "now"."""
+    return _MONO_ANCHOR + (time.monotonic() if mono is None else mono)
+
+
+def new_trace_id() -> str:
+    """A fresh trace id for one fleet ticket (random hex — ids must not
+    collide across coordinators sharing a spool)."""
+    import os
+
+    return os.urandom(6).hex()
+
+
+def trace_span_record(
+    span: str, t0: float, t1: float, **attrs
+) -> dict:
+    """One span-log record: a schema-valid ``trace_span`` event naming
+    the span, its anchored-wall [t0, t1] bounds, the writing process,
+    and any attribution (tid/trace_id/batch/worker/role). ``t0 == t1``
+    records are point events (requeue, claim markers)."""
+    import os
+
+    rec = {
+        "schema": EVENT_SCHEMA_VERSION,
+        "ts": float(time.time()),
+        "event": "trace_span",
+        "trace_schema": TRACE_SCHEMA_VERSION,
+        "span": str(span),
+        "t0": float(t0),
+        "t1": float(t1),
+        "pid": os.getpid(),
+    }
+    rec.update(attrs)
+    return rec
+
+
+def span_ms(rec: dict) -> float:
+    """A span record's duration in milliseconds (clamped at 0)."""
+    return max((float(rec["t1"]) - float(rec["t0"])) * 1e3, 0.0)
+
+
+def append_trace(path: str, rec: dict) -> None:
+    """Append one record to a span-log file. One ``write`` call in
+    append mode — concurrent appenders (racing workers) interleave
+    whole lines, and a killed writer tears at most the final line
+    (which :func:`read_trace` tolerates). Never raises: the span log
+    is observability, not correctness."""
+    try:
+        with open(path, "a", encoding="utf-8") as fh:
+            fh.write(json.dumps(rec, default=str) + "\n")
+    except OSError:
+        pass
+
+
+def read_trace(path: str) -> List[dict]:
+    """Parse a span-log file. A torn LAST line (writer killed
+    mid-append) is dropped silently; a torn middle line or a record
+    carrying a different ``trace_schema`` raises ValueError — the
+    mixed-version refusal path. Missing file reads as empty."""
+    records: List[dict] = []
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+    except OSError:
+        return records
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            if i == len(lines) - 1:
+                break  # torn tail: the writer died mid-append
+            raise ValueError(f"{path}:{i + 1}: torn span-log line")
+        ver = rec.get("trace_schema", TRACE_SCHEMA_VERSION)
+        if ver != TRACE_SCHEMA_VERSION:
+            raise ValueError(
+                f"{path}:{i + 1}: span-log schema {ver} != supported "
+                f"{TRACE_SCHEMA_VERSION} — refusing to compose spans "
+                "across fleet versions"
+            )
+        records.append(rec)
+    return records
+
+
 # -------------------------------------------------------- flight recorder
 
 
@@ -481,19 +611,25 @@ class FlightRecorder:
         )
 
     def dump(
-        self, path: Optional[str] = None, reason: str = "manual"
+        self,
+        path: Optional[str] = None,
+        reason: str = "manual",
+        extra: Optional[List[dict]] = None,
     ) -> Optional[str]:
-        """Write the ring (oldest first) + a ``metrics_snapshot`` + a
-        ``flight_dump`` trailer as schema-valid JSONL; returns the path
-        (None when the write failed). Never raises out of a trigger
-        site — the flight recorder is the diagnostic of last resort,
-        and a failing dump must not mask the failure being recorded
-        (it warns instead)."""
+        """Write the ring (oldest first) + any ``extra`` records (e.g.
+        a quarantined batch's span log — ISSUE 9) + a
+        ``metrics_snapshot`` + a ``flight_dump`` trailer as schema-valid
+        JSONL; returns the path (None when the write failed). Never
+        raises out of a trigger site — the flight recorder is the
+        diagnostic of last resort, and a failing dump must not mask the
+        failure being recorded (it warns instead)."""
         import warnings
 
         with self._lock:
             recs = list(self._ring)
             self._seq += 1
+        if extra:
+            recs = recs + list(extra)
         try:
             if path is None:
                 path = self._default_path(reason)

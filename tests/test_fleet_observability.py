@@ -1,0 +1,461 @@
+"""Fleet-wide observability (ISSUE 9): cross-process trace
+propagation, merged metric snapshots, straggler detection, and the
+fleet_top console.
+
+Most tests here are process-free — synthetic spools, synthetic
+registries — because the properties under test are the MERGE and
+REFUSAL semantics (torn files, version mismatches, concurrent
+flushes) and the console's rendering, none of which need a live
+fleet. The one real-process test pins the end-to-end trace contract:
+span monotonicity (submit <= claim <= execute <= publish <= done)
+and breakdown coverage (spans tile >= 95% of measured e2e). The kill
+-9 "trace shows both attempts" property rides on the existing
+process tests in test_fleet.py; the 8-process matrix is
+tools/fleet_smoke.py (CI stage 9) + the tracing gates of CI stage 10.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from libpga_tpu import PGAConfig
+from libpga_tpu.config import FleetConfig
+from libpga_tpu.serving.fleet import (
+    METRICS_FILE_SCHEMA,
+    Fleet,
+    FleetTicket,
+    Spool,
+    fleet_status,
+    load_spool_metrics,
+    merge_spool_metrics,
+    write_metrics_file,
+)
+from libpga_tpu.utils import metrics as M
+from libpga_tpu.utils import telemetry as T
+
+CFG = PGAConfig(use_pallas=False)
+
+
+def make_registry(execute_ms=(), published=0):
+    """A worker-like registry: execute-latency observations + the
+    published-tickets counter."""
+    reg = M.MetricsRegistry()
+    for v in execute_ms:
+        reg.histogram("serving.ticket.execute_ms").observe(v)
+    if published:
+        reg.counter("worker.tickets.published").bump(published)
+    return reg
+
+
+# ----------------------------------------------------------- merge algebra
+
+
+def test_merge_snapshots_proc_labels_and_aggregate():
+    a = make_registry(execute_ms=[10.0, 20.0], published=2)
+    b = make_registry(execute_ms=[30.0], published=1)
+    merged = M.merge_snapshots(
+        [("w0", a.snapshot()), ("w1", b.snapshot())]
+    )
+    assert merged["merged_from"] == ["w0", "w1"]
+    # every per-proc series is labeled with its origin
+    counters = {
+        (r["name"], r["labels"].get("proc")): r["value"]
+        for r in merged["counters"]
+    }
+    assert counters[("worker.tickets.published", "w0")] == 2
+    assert counters[("worker.tickets.published", "w1")] == 1
+    # histograms additionally fold into ONE aggregate without the proc
+    # label, merged through HistogramSnapshot.merge
+    hists = [
+        r for r in merged["histograms"]
+        if r["name"] == "serving.ticket.execute_ms"
+    ]
+    per_proc = [r for r in hists if "proc" in r["labels"]]
+    agg = [r for r in hists if "proc" not in r["labels"]]
+    assert len(per_proc) == 2 and len(agg) == 1
+    assert agg[0]["count"] == 3
+    assert agg[0]["sum"] == pytest.approx(60.0)
+    # merge is order-independent (associative + commutative folding)
+    swapped = M.merge_snapshots(
+        [("w1", b.snapshot()), ("w0", a.snapshot())]
+    )
+    agg2 = [
+        r for r in swapped["histograms"]
+        if r["name"] == "serving.ticket.execute_ms"
+        and "proc" not in r["labels"]
+    ]
+    assert agg2[0]["counts"] == agg[0]["counts"]
+    # and the whole merged snapshot renders to a lint-clean exposition
+    assert M.lint_prometheus(M.prometheus_text(merged)) == []
+
+
+def test_merge_snapshots_refuses_schema_mismatch_and_duplicates():
+    snap = make_registry(execute_ms=[1.0]).snapshot()
+    bad = dict(snap, schema=99)
+    with pytest.raises(ValueError, match="refusing to merge"):
+        M.merge_snapshots([("w0", snap), ("w1", bad)])
+    with pytest.raises(ValueError, match="duplicate"):
+        M.merge_snapshots([("w0", snap), ("w0", snap)])
+
+
+# ------------------------------------------------------- spool snapshots
+
+
+def test_spool_metrics_torn_file_skipped_version_mismatch_refused(tmp_path):
+    spool = Spool(str(tmp_path))
+    write_metrics_file(spool, "w0", make_registry([5.0]).snapshot())
+    # torn file: a crash mid-write of a NON-atomic writer (the real
+    # flusher renames atomically — this is the defensive path)
+    with open(spool.metrics_path("w1"), "w") as fh:
+        fh.write('{"schema_version": 1, "proc": "w1", "snapsho')
+    payloads, skipped = load_spool_metrics(spool)
+    assert [p["proc"] for p in payloads] == ["w0"]
+    assert skipped == ["w1.json"]
+    merged = merge_spool_metrics(spool)
+    assert merged["merged_from"] == ["w0"]
+    assert merged["skipped_files"] == ["w1.json"]
+    # a PARSEABLE file from another schema version refuses loudly
+    Spool.write_json(
+        spool.metrics_path("w2"),
+        {"schema_version": METRICS_FILE_SCHEMA + 1, "proc": "w2",
+         "snapshot": make_registry().snapshot()},
+    )
+    with pytest.raises(ValueError, match="schema_version"):
+        load_spool_metrics(spool)
+    with pytest.raises(ValueError, match="schema_version"):
+        merge_spool_metrics(spool)
+
+
+def test_kill_mid_flush_leaves_previous_valid_file(tmp_path):
+    """The atomic-rename discipline: a writer that dies mid-flush (temp
+    file written, rename never happened) leaves the PREVIOUS snapshot
+    intact and the temp file invisible to the loader."""
+    spool = Spool(str(tmp_path))
+    write_metrics_file(spool, "w0", make_registry([1.0]).snapshot())
+    # simulate the kill: the next flush got as far as the temp file
+    tmp = f"{spool.metrics_path('w0')}.{os.getpid()}.tmp"
+    with open(tmp, "w") as fh:
+        fh.write('{"schema_version": 1, "proc": "w0", "snap')  # torn
+    payloads, skipped = load_spool_metrics(spool)
+    assert len(payloads) == 1 and skipped == []
+    assert payloads[0]["snapshot"]["histograms"][0]["count"] == 1
+
+
+def test_merge_under_concurrent_flushes(tmp_path):
+    """Writers hammering the spool while a reader merges: every merge
+    sees a consistent (atomic-rename) file set — no torn reads, and
+    the final merge carries every writer's last flush."""
+    spool = Spool(str(tmp_path))
+    stop = threading.Event()
+    errors = []
+
+    def writer(wid):
+        i = 0
+        while not stop.is_set():
+            i += 1
+            reg = make_registry(execute_ms=[float(i)] * i, published=i)
+            try:
+                write_metrics_file(spool, wid, reg.snapshot())
+            except Exception as e:  # pragma: no cover - diagnostic
+                errors.append(e)
+
+    threads = [
+        threading.Thread(target=writer, args=(f"w{i}",)) for i in range(3)
+    ]
+    for t in threads:
+        t.start()
+    merges = 0
+    deadline = time.monotonic() + 1.0
+    try:
+        while time.monotonic() < deadline:
+            merged = merge_spool_metrics(spool)
+            assert M.lint_prometheus(M.prometheus_text(merged)) == []
+            merges += 1
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not errors
+    assert merges >= 3
+    final = merge_spool_metrics(spool)
+    assert sorted(final["merged_from"]) == ["w0", "w1", "w2"]
+
+
+# ------------------------------------------------------------ span logs
+
+
+def test_trace_roundtrip_torn_tail_and_version_refusal(tmp_path):
+    path = str(tmp_path / "b1.trace.jsonl")
+    r1 = T.trace_span_record("claim", 1.0, 2.0, worker="w0", batch="b1")
+    r2 = T.trace_span_record("execute", 2.0, 5.0, worker="w0", batch="b1")
+    T.append_trace(path, r1)
+    T.append_trace(path, r2)
+    with open(path, "a") as fh:
+        fh.write('{"schema": 1, "event": "trace_span", "span": "pub')
+    recs = T.read_trace(path)  # torn LAST line dropped silently
+    assert [r["span"] for r in recs] == ["claim", "execute"]
+    for r in recs:
+        T.validate_event(r)
+    assert T.span_ms(recs[1]) == pytest.approx(3000.0)
+    # a record from another trace schema refuses loudly
+    with open(path, "w") as fh:
+        fh.write(json.dumps(dict(r1, trace_schema=99)) + "\n")
+    with pytest.raises(ValueError, match="span-log schema"):
+        T.read_trace(path)
+    # a torn MIDDLE line is corruption, not a benign tail
+    with open(path, "w") as fh:
+        fh.write('{"torn\n' + json.dumps(r1) + "\n")
+    with pytest.raises(ValueError, match="torn span-log line"):
+        T.read_trace(path)
+
+
+def test_anchored_wall_tracks_monotonic_deltas():
+    a = T.anchored_wall()
+    m = time.monotonic()
+    b = T.anchored_wall(m)
+    assert b >= a
+    assert T.anchored_wall(m + 1.0) - b == pytest.approx(1.0)
+
+
+# ----------------------------------------------------- straggler scanning
+
+
+def test_straggler_detection_flags_slow_worker(tmp_path):
+    fleet = Fleet(
+        str(tmp_path), "onemax", config=CFG,
+        fleet=FleetConfig(
+            n_workers=1, straggler_factor=2.0, straggler_min_samples=4,
+        ),
+        registry=M.MetricsRegistry(),
+    )
+    for wid, ms in (("w0", 10.0), ("w1", 10.0), ("w2", 500.0)):
+        write_metrics_file(
+            fleet.spool, wid, make_registry([ms] * 5).snapshot()
+        )
+    alerts = fleet.detect_stragglers()
+    assert [a["worker"] for a in alerts] == ["w2"]
+    assert alerts[0]["p95_ms"] > alerts[0]["fleet_p95_ms"]
+    T.validate_event({
+        "schema": T.EVENT_SCHEMA_VERSION, "ts": 0.0,
+        "event": "straggler_alert", **alerts[0],
+    })
+    health = fleet.registry.gauge("fleet.worker.health", worker="w2")
+    assert health.value == 0.0
+    assert fleet.registry.gauge(
+        "fleet.worker.health", worker="w0"
+    ).value == 1.0
+    # alerts fire on the TRANSITION: a second scan stays quiet
+    assert fleet.detect_stragglers() == []
+    # recovery restores the gauge (and re-arms the alert)
+    write_metrics_file(
+        fleet.spool, "w2", make_registry([10.0] * 5).snapshot()
+    )
+    assert fleet.detect_stragglers() == []
+    assert health.value == 1.0
+
+
+def test_straggler_needs_samples_and_peers(tmp_path):
+    fleet = Fleet(
+        str(tmp_path), "onemax", config=CFG,
+        fleet=FleetConfig(n_workers=1, straggler_min_samples=10),
+        registry=M.MetricsRegistry(),
+    )
+    # one worker only: no fleet median to compare against
+    write_metrics_file(
+        fleet.spool, "w0", make_registry([900.0] * 20).snapshot()
+    )
+    assert fleet.detect_stragglers() == []
+    # a second worker below min_samples stays out of the scan
+    write_metrics_file(
+        fleet.spool, "w1", make_registry([1.0] * 3).snapshot()
+    )
+    assert fleet.detect_stragglers() == []
+
+
+# ------------------------------------------------- status + fleet_top
+
+
+def synthetic_spool(tmp_path):
+    """A dead fleet's spool: one pending batch, one claimed batch with
+    a lease, one dead batch, two worker metric flushes + a coordinator
+    flush, and a span log."""
+    spool = Spool(str(tmp_path / "spool"))
+    Spool.write_json(spool.path("pending", "b1.json"), {
+        "batch": "b1.json", "formed_at": T.anchored_wall() - 3.0,
+        "trace": True, "attempts": [],
+        "tickets": [{"tid": "t1"}, {"tid": "t2"}],
+    })
+    Spool.write_json(spool.path("claimed", "b2.json"), {
+        "batch": "b2.json", "formed_at": T.anchored_wall() - 9.0,
+        "trace": True, "attempts": ["w9"], "tickets": [{"tid": "t3"}],
+    })
+    Spool.write_json(spool.lease_path("b2.json"),
+                     {"worker": "w0", "pid": 1})
+    Spool.write_json(spool.path("dead", "b0.json"),
+                     {"batch": "b0.json", "tickets": []})
+    Spool.write_json(spool.path("results", "t9.json"), {"tid": "t9"})
+    write_metrics_file(
+        spool, "w0", make_registry([12.0] * 6, published=6).snapshot(),
+        batches_done=3,
+    )
+    write_metrics_file(
+        spool, "w1", make_registry([15.0] * 4, published=4).snapshot(),
+        batches_done=2, pid=999_999_999,  # definitely not alive
+    )
+    coord = M.MetricsRegistry()
+    coord.histogram("fleet.ticket.e2e_ms").observe(120.0)
+    coord.histogram("fleet.ticket.e2e_ms").observe(180.0)
+    coord.histogram("fleet.ticket.spool_wait_ms").observe(30.0)
+    coord.counter("fleet.worker.deaths", worker="w9").bump()
+    coord.counter("fleet.lease.requeues").bump(2)
+    coord.counter("fleet.tickets.completed").bump(7)
+    write_metrics_file(spool, "coordinator", coord.snapshot())
+    T.append_trace(
+        spool.trace_path("b2.json"),
+        T.trace_span_record("claim", 1.0, 1.1, worker="w0",
+                            batch="b2.json"),
+    )
+    return spool
+
+
+def test_fleet_status_from_spool_alone(tmp_path):
+    spool = synthetic_spool(tmp_path)
+    st = fleet_status(spool.root)
+    q = st["queue"]
+    assert [b["batch"] for b in q["pending_batches"]] == ["b1.json"]
+    assert q["pending_batches"][0]["tickets"] == 2
+    assert q["pending_batches"][0]["age_s"] > 1.0
+    assert q["claimed_batches"][0]["worker"] == "w0"
+    assert q["dead_batches"] == ["b0.json"]
+    assert q["results"] == 1
+    workers = {w["worker"]: w for w in st["workers"]}
+    assert set(workers) == {"w0", "w1"}
+    assert workers["w0"]["lease"] == "b2.json"
+    assert workers["w0"]["tickets_published"] == 6
+    assert workers["w1"]["alive"] is False  # dead-fleet post-mortem
+    assert workers["w0"]["execute_count"] == 6
+    assert st["latency"]["e2e"]["count"] == 2
+    assert st["counters"]["worker_deaths"] == 1
+    assert st["counters"]["lease_requeues"] == 2
+    assert st["counters"]["tickets_completed"] == 7
+
+
+def test_fleet_top_renders_synthetic_and_empty_spool(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "fleet_top",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "fleet_top.py"),
+    )
+    fleet_top = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fleet_top)
+
+    spool = synthetic_spool(tmp_path)
+    out = fleet_top.render(fleet_status(spool.root))
+    for needle in ("w0", "w1", "b2.json", "DEAD b0.json", "e2e p50=",
+                   "worker_deaths=1", "dead"):
+        assert needle in out, f"{needle!r} missing from:\n{out}"
+    # an EMPTY spool (nothing ever ran) still renders
+    empty = fleet_status(str(tmp_path / "empty"))
+    out2 = fleet_top.render(empty)
+    assert "no worker metric flushes" in out2
+    # and the CLI path returns 0 against the dead spool
+    assert fleet_top.main(["--spool", spool.root]) == 0
+
+
+# ------------------------------------------------- real-process tracing
+
+
+def test_cross_process_span_monotonicity(tmp_path):
+    """ACCEPTANCE (ISSUE 9): a real 1-worker fleet's completed ticket
+    carries a cross-process breakdown whose edges are monotonic
+    (submit <= claim <= execute-end <= publish <= readback-done) and
+    whose spans tile >= 95% of its measured end-to-end time."""
+    fleet = Fleet(
+        str(tmp_path / "spool"), "onemax", config=CFG,
+        fleet=FleetConfig(
+            n_workers=1, max_batch=2, max_wait_ms=5,
+            lease_timeout_s=10.0, heartbeat_s=0.3, poll_s=0.05,
+            metrics_flush_s=0.2,
+        ),
+        registry=M.MetricsRegistry(),
+    )
+    try:
+        fleet.start()
+        handles = [
+            fleet.submit(FleetTicket(size=128, genome_len=16, n=3, seed=s))
+            for s in (1, 2)
+        ]
+        for h in handles:
+            res = h.result(timeout=180)
+            lat = h.latency()
+            spans = [
+                lat[f"{k}_ms"]
+                for k in ("intake", "spool_wait", "execute", "publish",
+                          "readback")
+            ]
+            assert all(v is not None and v >= 0.0 for v in spans), lat
+            assert sum(spans) >= 0.95 * lat["e2e_ms"], lat
+            assert res.latency == lat  # result carries the breakdown too
+            trace = h.trace()
+            for rec in trace:
+                T.validate_event(rec)
+            by_span = {r["span"]: r for r in trace}
+            # the ordered life: intake -> claim -> execute -> publish
+            # -> readback, each edge no earlier than the previous
+            order = ["intake", "claim", "execute", "publish", "readback"]
+            assert all(s in by_span for s in order), sorted(by_span)
+            for a, b in zip(order, order[1:]):
+                assert by_span[b]["t1"] >= by_span[a]["t0"], (a, b, trace)
+            assert by_span["intake"]["t1"] >= by_span["intake"]["t0"]
+            # worker-local TicketTiming rides along (the intra-worker
+            # split of the execute span): the breakdown's anchored
+            # sub-spans nest inside the cross-process execute span
+            assert by_span["execute"]["worker"] == "w0"
+            assert "local_run" in by_span
+            assert by_span["local_run"]["t0"] >= (
+                by_span["execute"]["t0"] - 0.05
+            )
+            assert by_span["local_run"]["t1"] <= (
+                by_span["execute"]["t1"] + 0.05
+            )
+        # the coordinator's fleet histograms saw every ticket
+        snap = fleet.registry.histogram("fleet.ticket.e2e_ms").snapshot()
+        assert snap.count == 2
+        # and the worker's periodic flush reached the spool
+        st = fleet.status()
+        assert [w["worker"] for w in st["workers"]] == ["w0"]
+        assert st["latency"]["e2e"]["count"] == 2
+    finally:
+        fleet.close()
+
+
+def test_tracing_off_suppresses_spans(tmp_path):
+    fleet = Fleet(
+        str(tmp_path / "spool"), "onemax", config=CFG,
+        fleet=FleetConfig(
+            n_workers=1, max_batch=1, max_wait_ms=0,
+            lease_timeout_s=10.0, heartbeat_s=0.3, poll_s=0.05,
+            trace=False,
+        ),
+        registry=M.MetricsRegistry(),
+    )
+    try:
+        fleet.start()
+        h = fleet.submit(FleetTicket(size=128, genome_len=16, n=3, seed=4))
+        res = h.result(timeout=180)
+        assert res.generations == 3
+        assert h.latency()["e2e_ms"] is None
+        assert res.latency is None
+        # no span log was written for the batch
+        assert os.listdir(fleet.spool.path("traces")) == []
+        assert fleet.registry.histogram(
+            "fleet.ticket.e2e_ms"
+        ).snapshot().count == 0
+    finally:
+        fleet.close()

@@ -97,12 +97,32 @@ def stage_kill_one_worker(tmp):
         s for s, r in zip(seeds, results)
         if not np.array_equal(r.genomes, engine_ref(s, GENS))
     ]
+    # ISSUE 9 acceptance on the same run: every completed ticket's
+    # cross-process span breakdown TILES — its five spans sum to >=95%
+    # of its measured end-to-end wall time — and the requeued batch's
+    # trace shows BOTH attempts (two claims around a requeue record).
+    bad_cov, requeued_traced = [], 0
+    for s, h in zip(seeds, handles):
+        lat = h.latency()
+        spans = [lat[f"{k}_ms"] for k in
+                 ("intake", "spool_wait", "execute", "publish", "readback")]
+        if any(v is None for v in spans) or (
+            sum(spans) < 0.95 * lat["e2e_ms"]
+        ):
+            bad_cov.append((s, lat))
+        kinds = [r["span"] for r in h.trace()]
+        if kinds.count("claim") >= 2 and "requeue" in kinds:
+            requeued_traced += 1
     fleet.close()
     check(
-        "kill-one-worker", not mismatches and fleet.worker_deaths == 1,
+        "kill-one-worker",
+        not mismatches and fleet.worker_deaths == 1
+        and not bad_cov and requeued_traced >= 1,
         f"{len(seeds)} tickets on {WORKERS} workers "
         f"({len(workers_used)} served), 1 killed, "
-        f"{fleet.requeues} requeue(s), all bit-identical",
+        f"{fleet.requeues} requeue(s), all bit-identical; spans tile "
+        f">=95% e2e on all, {requeued_traced} trace(s) show both "
+        "attempts",
     )
     return fleet
 
@@ -177,7 +197,7 @@ def stage_quarantine(tmp):
         h.result(timeout=600)
     except FleetDeadLetter:
         dead_lettered = True
-    dump_ok = False
+    dump_ok = trace_ok = False
     if fleet.quarantined:
         dump = fleet.spool.path(
             "dead", f"{fleet.quarantined[0]}.flight.jsonl"
@@ -189,12 +209,23 @@ def stage_quarantine(tmp):
             and trailer["reason"] == "fleet_dead_letter"
             and trailer.get("pid") == os.getpid()
         )
+        # ISSUE 9: the dump embeds the dead batch's span log (both
+        # killed workers' claims), and so does the dead batch file.
+        spans = [r for r in records if r["event"] == "trace_span"]
+        dead_batch = json.load(open(
+            fleet.spool.path("dead", fleet.quarantined[0])
+        ))
+        trace_ok = (
+            sum(1 for r in spans if r["span"] == "claim") >= K
+            and len(dead_batch.get("trace_log", [])) >= K
+        )
     fleet.close()
     check(
         "dead-letter-quarantine",
-        dead_lettered and len(fleet.quarantined) == 1 and dump_ok,
+        dead_lettered and len(fleet.quarantined) == 1 and dump_ok
+        and trace_ok,
         f"quarantined after {K} distinct worker deaths, flight dump "
-        "schema-valid with pid attribution",
+        "schema-valid with pid attribution + embedded span log",
     )
 
 
@@ -219,7 +250,31 @@ def stage_metrics_lint(tmp):
         ]
     if not worker_proms:
         check("metrics-lint", False, "no worker .prom files in the spool")
-    for path in [coord, worker_proms[0]]:
+    # MERGED fleet exposition (ISSUE 9): the kill stage's spool carries
+    # every process's metric flush (8 workers + coordinator); the merge
+    # must lint clean and label every series with its origin process.
+    from libpga_tpu.serving.fleet import Spool, merge_spool_metrics
+
+    merged = merge_spool_metrics(Spool(os.path.join(tmp, "kill")))
+    merged_prom = os.path.join(tmp, "merged.prom")
+    with open(merged_prom, "w", encoding="utf-8") as fh:
+        fh.write(_metrics.prometheus_text(merged))
+    text = open(merged_prom).read()
+    procs = {
+        p for p in merged["merged_from"] if p.startswith("w")
+    }
+    if len(procs) < WORKERS or "coordinator" not in merged["merged_from"]:
+        check(
+            "metrics-lint", False,
+            f"merged exposition covers {sorted(merged['merged_from'])}, "
+            f"expected {WORKERS} workers + coordinator",
+        )
+    # w0 may have died before any non-empty flush (its startup snapshot
+    # has no series yet) — require the label on ANY worker + the
+    # coordinator, not on the deliberately-killed one.
+    if 'proc="w' not in text or 'proc="coordinator"' not in text:
+        check("metrics-lint", False, "merged exposition lacks proc labels")
+    for path in [coord, worker_proms[0], merged_prom]:
         proc = subprocess.run(
             [sys.executable, os.path.join(TOOLS, "metrics_dump.py"),
              "--check", path],
@@ -233,7 +288,8 @@ def stage_metrics_lint(tmp):
             )
     check(
         "metrics-lint", True,
-        f"coordinator + {len(worker_proms)} worker expositions, "
+        f"coordinator + {len(worker_proms)} worker expositions + merged "
+        f"fleet exposition ({len(merged['merged_from'])} procs), "
         "prometheus lint clean",
     )
 

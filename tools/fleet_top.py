@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""Live fleet console (ISSUE 9): render one fleet spool's state.
+
+Reads the SPOOL ALONE (``serving/fleet.fleet_status``) — batch queue
+depths, per-worker lease age / liveness / health / throughput, and the
+merged cross-process latency percentiles from the per-process metric
+flushes — so it works against a live fleet from any terminal AND as a
+post-mortem of a crashed one (the spool of a dead fleet renders the
+same way; worker liveness then reads "dead").
+
+    JAX_PLATFORMS=cpu python tools/fleet_top.py --spool DIR            # once
+    JAX_PLATFORMS=cpu python tools/fleet_top.py --spool DIR --watch    # top-style
+    JAX_PLATFORMS=cpu python tools/fleet_top.py --spool DIR --json     # raw dict
+
+Exit 0 on a renderable spool (even an empty one); nonzero only when
+the spool's on-disk snapshots are from an incompatible schema version
+(the fail-loudly path) or the spool path is unusable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _fmt_ms(v) -> str:
+    if v is None:
+        return "-"
+    return f"{v:,.0f}" if v >= 100 else f"{v:.1f}"
+
+
+def _fmt_s(v) -> str:
+    if v is None:
+        return "-"
+    if v < 120:
+        return f"{v:.1f}s"
+    return f"{v / 60:.1f}m"
+
+
+def _worker_state(w: dict, stale_after_s: float) -> str:
+    if w["alive"] is False:
+        return "dead"
+    if w["health"] is not None and w["health"] < 1.0:
+        return "STRAGGLER"
+    if w["flush_age_s"] > stale_after_s:
+        return "stale"
+    return "up"
+
+
+def render(status: dict, stale_after_s: float = 10.0) -> str:
+    """One screenful of fleet state from a ``fleet_status`` dict —
+    pure string building, no I/O (testable against synthetic spools)."""
+    q = status["queue"]
+    c = status["counters"]
+    lines = [
+        f"fleet spool {status['spool']}",
+        (
+            f"queue: pending={len(q['pending_batches'])} batches "
+            f"({sum(b['tickets'] for b in q['pending_batches'])} tickets)"
+            f"  claimed={len(q['claimed_batches'])}"
+            f"  dead={len(q['dead_batches'])}"
+            f"  results={q['results']}"
+        ),
+        (
+            f"counters: completed={c['tickets_completed']}"
+            f"  worker_deaths={c['worker_deaths']}"
+            f"  lease_requeues={c['lease_requeues']}"
+            f"  straggler_alerts={c['straggler_alerts']}"
+            f"  dead_letters={c['dead_letters']}"
+        ),
+    ]
+    lines.append(
+        f"{'worker':<8}{'pid':>8}  {'state':<10}{'flush':>7}"
+        f"  {'lease(age)':<26}{'batches':>8}{'tickets':>8}"
+        f"  {'exec p50/p95 ms':>16}"
+    )
+    for w in sorted(status["workers"], key=lambda w: w["worker"]):
+        lease = "-"
+        if w["lease"] is not None:
+            lease = f"{w['lease'][:18]} ({_fmt_s(w['lease_age_s'])})"
+        ex = (
+            "-" if not w["execute_count"]
+            else f"{_fmt_ms(w['execute_p50_ms'])}/{_fmt_ms(w['execute_p95_ms'])}"
+        )
+        lines.append(
+            f"{w['worker']:<8}{str(w['pid'] or '?'):>8}"
+            f"  {_worker_state(w, stale_after_s):<10}"
+            f"{_fmt_s(w['flush_age_s']):>7}  {lease:<26}"
+            f"{str(w['batches_done'] if w['batches_done'] is not None else '-'):>8}"
+            f"{w['tickets_published']:>8}  {ex:>16}"
+        )
+    if not status["workers"]:
+        lines.append("  (no worker metric flushes in this spool)")
+    lat = status["latency"]
+    if lat:
+        parts = []
+        for key in ("e2e", "spool_wait", "execute"):
+            rec = lat.get(key)
+            if rec:
+                parts.append(
+                    f"{key} p50={_fmt_ms(rec['p50_ms'])}"
+                    f" p95={_fmt_ms(rec['p95_ms'])}"
+                    f" p99={_fmt_ms(rec['p99_ms'])} (n={rec['count']})"
+                )
+        lines.append("latency ms (merged): " + "   ".join(parts))
+    else:
+        lines.append("latency: (no traced tickets recorded yet)")
+    for b in q["pending_batches"][:8]:
+        lines.append(
+            f"  pending {b['batch']}: {b['tickets']} tickets, "
+            f"age {_fmt_s(b['age_s'])}, attempts {b['attempts']}"
+        )
+    for b in q["dead_batches"][:8]:
+        lines.append(f"  DEAD {b}")
+    if status.get("metrics_skipped_files"):
+        lines.append(
+            f"  note: skipped unreadable metric files "
+            f"{status['metrics_skipped_files']}"
+        )
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--spool", required=True, help="fleet spool directory")
+    ap.add_argument("--watch", action="store_true",
+                    help="redraw every --interval seconds until ^C")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw status dict instead of the table")
+    args = ap.parse_args(argv)
+
+    from libpga_tpu.serving.fleet import fleet_status
+
+    while True:
+        try:
+            status = fleet_status(args.spool)
+        except ValueError as e:
+            print(f"fleet_top: {e}", file=sys.stderr)
+            return 1
+        if args.json:
+            out = json.dumps(status, indent=2, sort_keys=True, default=str)
+        else:
+            out = render(status)
+        if args.watch:
+            os.system("clear" if os.name == "posix" else "cls")
+        print(out, end="" if out.endswith("\n") else "\n")
+        if not args.watch:
+            return 0
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
