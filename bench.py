@@ -956,6 +956,93 @@ def supervised_arm(rounds: int = ROUNDS) -> dict:
     }
 
 
+AUTOTUNE_POP = SERVING_POP  # 16,384 — the CPU-decision-grade shape
+AUTOTUNE_LEN = 100
+AUTOTUNE_BUDGET = 6
+
+
+def autotuned_arm(rounds: int = ROUNDS) -> dict:
+    """``--autotuned`` (ISSUE 10): run the evolutionary autotuner for
+    the 16k×100 OneMax signature into a throwaway DB, then an
+    INTERLEAVED A/B of two live engines — one constructed with the
+    DB-resolved knobs, one stock — emitting ``tuned_vs_default_ratio``
+    (per-round from adjacent samples; >= 1 means the tuned config is
+    at least as fast). On a CPU backend every config resolves to the
+    one XLA plan, so the ratio is a NULL MEASUREMENT of the harness
+    itself (expected 1.0 within the drift floor — stamped in the
+    note); on a chip it is the tuner's live verdict."""
+    import tempfile
+
+    from libpga_tpu import PGA, PGAConfig
+    from libpga_tpu.tuning import tuner as _tuner
+
+    t0 = time.perf_counter()
+    db_path = tempfile.mktemp(
+        suffix=".json", prefix="pga-bench-tuning-"
+    )
+    entry = _tuner.autotune(
+        AUTOTUNE_POP, AUTOTUNE_LEN, objective="onemax",
+        settings=_tuner.TunerSettings(budget=AUTOTUNE_BUDGET, seed=0),
+        db_path=db_path,
+    )
+    autotune_seconds = time.perf_counter() - t0
+
+    def engine(knobs: dict):
+        # Applying the entry's knob values explicitly IS the
+        # DB-resolved config (user-knob precedence = db values here) —
+        # no global DB toggling inside the interleave.
+        pga = PGA(seed=0, config=PGAConfig(**knobs))
+        pga.set_objective("onemax")
+        pga.create_population(AUTOTUNE_POP, AUTOTUNE_LEN)
+
+        def run(n):
+            pga.run(n)
+
+        run.pga = pga
+        return run
+
+    runners = [
+        ("autotuned", engine(entry.knobs)),
+        ("default", engine({})),
+    ]
+    for _, r in runners:
+        r(3)  # compile before the interleave
+    samples = {name: [] for name, _ in runners}
+    ratios = []
+    for _ in range(rounds):
+        for name, r in runners:
+            samples[name].append(_sample_gps(r, 10, 30))
+        ratios.append(samples["autotuned"][-1] / samples["default"][-1])
+    tuned_med = _median_iqr(samples["autotuned"])
+    default_med = _median_iqr(samples["default"])
+    ratio_med, ratio_iqr = _median_iqr(ratios)
+    try:
+        os.remove(db_path)
+    except OSError:
+        pass
+    return {
+        "autotuned_gens_per_sec_median": round(tuned_med[0], 2),
+        "autotuned_gens_per_sec_iqr": round(tuned_med[1], 2),
+        "autotuned_default_gens_per_sec_median": round(default_med[0], 2),
+        "tuned_vs_default_ratio_median": round(ratio_med, 4),
+        "tuned_vs_default_ratio_iqr": round(ratio_iqr, 4),
+        "autotuned_knobs": {k: v for k, v in entry.knobs.items()},
+        "autotuned_plan": entry.plan.get("path"),
+        "autotune_seconds": round(autotune_seconds, 2),
+        "autotune_evaluated": entry.evaluated,
+        "autotune_space_size": entry.space_size,
+        "autotuned_note": (
+            "per-round ratio from ADJACENT tuned/default samples "
+            f"(interleaved, {rounds} rounds) at "
+            f"{AUTOTUNE_POP}x{AUTOTUNE_LEN} OneMax; on CPU backends "
+            "every config resolves to the one XLA plan, so the ratio "
+            "is a null measurement of the harness (expected 1.0 "
+            "within the ~4% drift floor) — the kernel-space verdict "
+            "needs a chip"
+        ),
+    }
+
+
 def single_derived(gene_dtype, gps) -> dict:
     """Roofline-relative figures for the single-population result."""
     import jax.numpy as jnp
@@ -1089,6 +1176,7 @@ def main() -> None:
     out.update(supervised_arm())
     out.update(sharded_arm())
     out.update(fleet_arm())
+    out.update(autotuned_arm())
     print(json.dumps(out))
 
 
@@ -1130,6 +1218,20 @@ def fleet_main() -> None:
     print(json.dumps(out))
 
 
+def autotuned_main() -> None:
+    """``python bench.py --autotuned``: the self-tuning arm alone
+    (ISSUE 10) — CPU-decision-grade as a null measurement of the
+    tuner + resolution harness; the kernel-space verdict needs a
+    chip (see autotuned_note on the artifact)."""
+    cache_dir = enable_persistent_cache()
+    out = {
+        **provenance(cache_dir),
+        "metric": f"tuned_vs_default_ratio_{AUTOTUNE_POP}x{AUTOTUNE_LEN}",
+        **autotuned_arm(),
+    }
+    print(json.dumps(out))
+
+
 def sharded_main() -> None:
     """``python bench.py --pop-shards [S]``: the population-sharding
     arm alone (ISSUE 7). On CPU hosts the multi-device platform is
@@ -1165,6 +1267,8 @@ if __name__ == "__main__":
         supervised_main()
     elif "--fleet" in sys.argv[1:]:
         fleet_main()
+    elif "--autotuned" in sys.argv[1:]:
+        autotuned_main()
     elif "--pop-shards" in sys.argv[1:]:
         sharded_main()
     else:

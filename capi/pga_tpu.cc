@@ -440,6 +440,20 @@ int pga_serving_config(unsigned max_batch, float max_wait_ms) {
                   static_cast<double>(max_wait_ms)));
 }
 
+int pga_set_tuning_db(const char *path) {
+    return static_cast<int>(
+        call_long("set_tuning_db", "(s)", path ? path : ""));
+}
+
+int pga_autotune(unsigned size, unsigned genome_len,
+                 const char *objective, unsigned budget,
+                 const char *db_path, long seed) {
+    if (!objective || !db_path) return -1;
+    return static_cast<int>(call_long(
+        "autotune", "(IIsIsl)", size, genome_len, objective, budget,
+        db_path, seed));
+}
+
 int pga_set_telemetry(pga_t *p, unsigned max_gens) {
     if (!p) return -1;
     return static_cast<int>(

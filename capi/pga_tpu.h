@@ -446,6 +446,37 @@ long pga_fleet_metrics_snapshot(char *buf, unsigned long cap);
 int pga_fleet_drain(void);
 int pga_fleet_close(void);
 
+/* ---- Self-tuning kernels (ISSUE 10) -----------------------------------
+ *
+ * pga_set_tuning_db installs (path) or clears (NULL / "") the
+ * process-global kernel TUNING DATABASE — the artifact
+ * tools/autotune.py produces: best-known fused-kernel configurations
+ * per (population, genome length, dtype, backend, device kind,
+ * objective, operator kinds) signature. While installed, every kernel
+ * selection (pga_run, islands, sharded runs) and every serving AOT
+ * warm-up resolves its knobs with precedence explicit-user-knob >
+ * DB entry > built-in default, and compiled-program caches key on the
+ * RESOLVED knobs. Loads eagerly: a missing/torn/schema-mismatched
+ * file fails HERE with -1 (and leaves the previous installation
+ * unchanged), never inside a serving warm-up. Returns 0 on success.
+ *
+ * pga_autotune runs the evolutionary autotuner for one signature of
+ * the named builtin objective: the library's own GA searches the
+ * kernel config space (deme size, output layout, sub-block pipeline),
+ * measuring up to `budget` distinct configurations interleaved
+ * against the default config (repeat-until-confidence medians; a
+ * config that fails to compile scores worst instead of crashing), and
+ * merges the winner — which NEVER regresses the default beyond the
+ * measurement drift floor — into the database at db_path (created if
+ * absent, atomic replace). Deterministic for a fixed seed where plans
+ * are discrete (always, on a CPU backend). Returns the number of
+ * configurations measured, negative on error. The database is NOT
+ * auto-installed; call pga_set_tuning_db(db_path) to apply it. */
+int pga_set_tuning_db(const char *path);
+int pga_autotune(unsigned size, unsigned genome_len,
+                 const char *objective, unsigned budget,
+                 const char *db_path, long seed);
+
 #ifdef __cplusplus
 }
 #endif

@@ -221,6 +221,41 @@ int main(void) {
             return fprintf(stderr, "pga_fleet_close failed\n"), 1;
     }
 
+    /* Self-tuning kernels (ISSUE 10): autotune a tiny signature into a
+     * fresh database (tiny budget — the ABI round trip, not a perf
+     * claim; determinism and never-regress are proven by
+     * tools/autotune_smoke.py), install it, run a solver under it, and
+     * check the error surfaces. */
+    {
+        char tdir[] = "/tmp/pga-tuning-capi-XXXXXX";
+        if (!mkdtemp(tdir))
+            return fprintf(stderr, "mkdtemp failed\n"), 1;
+        char db_path[256];
+        snprintf(db_path, sizeof db_path, "%s/tuning.json", tdir);
+        int measured = pga_autotune(POP, LEN, "onemax", 2, db_path, 0);
+        if (measured < 1)
+            return fprintf(stderr, "pga_autotune measured %d\n", measured),
+                   1;
+        if (pga_set_tuning_db(db_path) != 0)
+            return fprintf(stderr, "pga_set_tuning_db failed\n"), 1;
+        population_t *tpop;
+        pga_t *tuned = make_solver(77, &tpop);
+        if (!tuned) return fprintf(stderr, "tuned solver failed\n"), 1;
+        if (pga_run_n(tuned, GENS) != GENS)
+            return fprintf(stderr, "tuned pga_run failed\n"), 1;
+        pga_deinit(tuned);
+        /* Error surfaces: a bogus path must fail without disturbing
+         * the installed DB; clearing is always fine. */
+        char bogus[256];
+        snprintf(bogus, sizeof bogus, "%s/nope.json", tdir);
+        if (pga_set_tuning_db(bogus) != -1)
+            return fprintf(stderr, "bogus tuning db not rejected\n"), 1;
+        if (pga_autotune(POP, LEN, "no_such_objective", 2, db_path, 0) != -1)
+            return fprintf(stderr, "bogus objective not rejected\n"), 1;
+        if (pga_set_tuning_db(NULL) != 0)
+            return fprintf(stderr, "pga_set_tuning_db(NULL) failed\n"), 1;
+    }
+
     for (int i = 0; i < NSOLVERS; i++) pga_deinit(solvers[i]);
     pga_deinit(ref);
     printf("PASS\n");

@@ -811,6 +811,40 @@ def serving_config(max_batch: int, max_wait_ms: float) -> None:
     _serving_queue = RunQueue(serving=cfg)
 
 
+def set_tuning_db(path: str) -> int:
+    """``pga_set_tuning_db``: install (path) or clear ("") the
+    process-global kernel tuning database (``libpga_tpu/tuning``,
+    ISSUE 10). Eager load — a missing/torn/schema-mismatched file
+    raises here (→ -1 through the ABI) and leaves the previous
+    installation in place."""
+    from libpga_tpu.tuning import set_tuning_db as _set
+
+    _set(path or None)
+    return 0
+
+
+def autotune(
+    size: int, genome_len: int, objective: str, budget: int,
+    db_path: str, seed: int,
+) -> int:
+    """``pga_autotune``: run the evolutionary kernel autotuner for one
+    (size, genome_len) signature of a named builtin objective and merge
+    the verdict into the database at ``db_path`` (atomic replace).
+    Returns the number of distinct configurations measured. The C
+    surface keeps the tuner's defaults for the measurement protocol;
+    the Python CLI (``tools/autotune.py``) exposes the full knob set."""
+    from libpga_tpu.tuning import tuner as _tuner
+
+    entry = _tuner.autotune(
+        int(size), int(genome_len), objective=str(objective),
+        settings=_tuner.TunerSettings(
+            budget=int(budget), seed=int(seed),
+        ),
+        db_path=str(db_path),
+    )
+    return int(entry.evaluated)
+
+
 def _serving_executor(handle: int):
     """A BatchedRuns matching the solver's current objective/operators.
 

@@ -358,6 +358,14 @@ class FleetConfig:
       straggler_min_samples: minimum execute-latency observations a
         worker needs before the straggler check considers it (a p95
         over three tickets is noise, not a verdict).
+      tuning_db: path to a kernel tuning database
+        (``libpga_tpu/tuning/db.py``, ISSUE 10). When set, every
+        spawned worker inherits it through the ``PGA_TUNING_DB``
+        environment variable (the same transport pattern as
+        ``PGA_FAULT_SPEC``) and installs it at startup, so fleet-served
+        buckets AOT-compile their best-known kernel configs. ``None``
+        (default) = untuned — workers run the stock resolution unless
+        their environment already carries ``PGA_TUNING_DB``.
     """
 
     n_workers: int = 2
@@ -374,6 +382,7 @@ class FleetConfig:
     metrics_flush_s: float = 1.0
     straggler_factor: float = 3.0
     straggler_min_samples: int = 8
+    tuning_db: Optional[str] = None
 
     def __post_init__(self):
         if self.n_workers < 1:

@@ -191,6 +191,9 @@ class PGA:
         # One degradation warning per distinct cause (graceful kernel
         # fallback, config.fallback == "xla").
         self._degraded_warned: set = set()
+        # One tuned_config event per (shape, resolved knobs) — the
+        # tuning-DB resolution provenance record (ISSUE 10).
+        self._tuned_emitted: set = set()
 
     # ------------------------------------------------------------------ RNG
 
@@ -438,6 +441,47 @@ class PGA:
             stacklevel=4,
         )
 
+    def _resolved_pallas_knobs(self, size: int, genome_len: int) -> tuple:
+        """Kernel-knob resolution for one breeding shape under the
+        precedence **explicit user knob > tuning-DB entry > built-in
+        default** (ISSUE 10): returns ``(deme_size, layout, subblock,
+        provenance)``.
+
+        With no tuning database installed (``tuning.set_tuning_db`` /
+        ``PGA_TUNING_DB``), or no entry for this signature, the
+        returned values are LITERALLY the config's own fields and
+        provenance is None — the traced program is byte-identical to
+        the pre-tuning code. A matched entry resolves only the knobs
+        the user left on auto, emits one ``tuned_config`` event per
+        (shape, knobs), and joins the compiled-program cache keys so a
+        database swap re-keys cleanly."""
+        from libpga_tpu.tuning import db as _tdb
+
+        tdb = _tdb.active_db()
+        entry = None
+        if tdb is not None and self._objective is not None:
+            entry = tdb.lookup(_tdb.current_key(
+                size, genome_len, self.config.gene_dtype,
+                self._objective,
+                _kind_key(self._crossover_kind()),
+                _kind_key(self._mutate_kind()),
+            ))
+        knobs, prov = _tdb.resolve_config_knobs(self.config, entry)
+        resolved = (
+            knobs["pallas_deme_size"], knobs["pallas_layout"],
+            knobs["pallas_subblock"],
+        )
+        if prov is not None:
+            mark = (size, genome_len, resolved)
+            if mark not in self._tuned_emitted:
+                self._tuned_emitted.add(mark)
+                self._emit(
+                    "tuned_config", population_size=size,
+                    genome_len=genome_len, knobs=dict(knobs),
+                    provenance=dict(prov), db=_tdb.active_path(),
+                )
+        return resolved + (prov,)
+
     def _compiled_run_meta(
         self, size: int, genome_len: int
     ) -> Tuple[Callable, Optional[tuple]]:
@@ -451,10 +495,16 @@ class PGA:
         if pallas_kind is None:
             self._warn_xla_fallback()
         if pallas_kind is not None:
+            deme, layout, subblock, _ = self._resolved_pallas_knobs(
+                size, genome_len
+            )
             # Keyed by mutation KIND: rate/sigma are runtime inputs of the
             # compiled fn. A declined shape caches the _XLA_FALLBACK
             # sentinel — NOT the XLA fn itself, which bakes the operator
-            # instance in and must stay keyed by it below.
+            # instance in and must stay keyed by it below. The RESOLVED
+            # knobs (not the raw config fields) key the entry, so
+            # installing a different tuning DB re-compiles instead of
+            # reusing a stale kernel.
             pkey = (
                 "engine/run-pallas", size, genome_len, obj,
                 _kind_key(pallas_kind),
@@ -462,7 +512,7 @@ class PGA:
                 self.config.tournament_size, self.config.selection,
                 self.config.selection_param,
                 self.config.pallas_generations_per_launch,
-                self.config.pallas_layout, self.config.pallas_subblock,
+                deme, layout, subblock,
                 hist_gens,
             )
             cached = self._compiled.get(pkey)
@@ -529,7 +579,12 @@ class PGA:
     ):
         """Build the fused run fn for one shape, or ``_XLA_FALLBACK``
         when the factory declines. Raises when the build itself fails —
-        the caller applies the ``config.fallback`` policy."""
+        the caller applies the ``config.fallback`` policy. Kernel knobs
+        are the TUNED resolution (user > DB > default) for this shape —
+        with no DB these are exactly the config fields."""
+        deme, layout, subblock, _ = self._resolved_pallas_knobs(
+            size, genome_len
+        )
         factory = make_pallas_run(
             obj,
             tournament_size=self.config.tournament_size,
@@ -542,15 +597,15 @@ class PGA:
             crossover_kind=self._crossover_kind(),
             mutate_kind=pallas_kind,
             elitism=self.config.elitism,
-            deme_size=self.config.pallas_deme_size,
+            deme_size=deme,
             donate=self.config.donate_buffers,
             gene_dtype=self.config.gene_dtype,
             generations_per_launch=(
                 self.config.pallas_generations_per_launch
             ),
             history_gens=hist_gens,
-            layout=self.config.pallas_layout,
-            subblock=self.config.pallas_subblock,
+            layout=layout,
+            subblock=subblock,
         )
         pallas_fn = factory(size, genome_len) if factory else None
         return pallas_fn if pallas_fn is not None else _XLA_FALLBACK
@@ -742,6 +797,12 @@ class PGA:
             make_pallas_multigen,
         )
 
+        # The tuned resolution keys on the ISLAND size — the shape the
+        # kernel actually breeds (a DB tuned at the full-population
+        # shape deliberately misses here).
+        deme, layout, subblock, _ = self._resolved_pallas_knobs(
+            island_size, genome_len
+        )
         # Cached: runner caching downstream keys on the breed's identity,
         # so rebuilding it per call would defeat compilation reuse.
         cache_key = (
@@ -751,7 +812,7 @@ class PGA:
             self.config.elitism, self.config.tournament_size,
             self.config.selection, self.config.selection_param,
             self.config.pallas_generations_per_launch,
-            self.config.pallas_layout, self.config.pallas_subblock,
+            deme, layout, subblock,
         )
         if cache_key in self._compiled:
             return self._compiled[cache_key]
@@ -785,7 +846,7 @@ class PGA:
                 bm = make_pallas_multigen(
                     island_size,
                     genome_len,
-                    deme_size=self.config.pallas_deme_size,
+                    deme_size=deme,
                     tournament_size=self.config.tournament_size,
                     selection_kind=self.config.selection,
                     selection_param=self.config.selection_param,
@@ -799,7 +860,7 @@ class PGA:
                         getattr(obj, "kernel_rowwise_consts", ())
                     ),
                     gene_dtype=self.config.gene_dtype,
-                    _layout=self.config.pallas_layout,
+                    _layout=layout,
                 )
             except Exception as e:
                 if self.config.fallback == "raise":
@@ -833,7 +894,7 @@ class PGA:
             pb = make_pallas_breed(
                 island_size,
                 genome_len,
-                deme_size=self.config.pallas_deme_size,
+                deme_size=deme,
                 tournament_size=self.config.tournament_size,
                 selection_kind=self.config.selection,
                 selection_param=self.config.selection_param,
@@ -848,8 +909,8 @@ class PGA:
                 fused_obj=fused,
                 fused_consts=tuple(getattr(obj, "kernel_rowwise_consts", ())),
                 gene_dtype=self.config.gene_dtype,
-                _layout=self.config.pallas_layout,
-                _subblock=self.config.pallas_subblock,
+                _layout=layout,
+                _subblock=subblock,
             )
         except Exception as e:
             # Degrade THIS config to the XLA island breed (caller falls
@@ -982,10 +1043,15 @@ class PGA:
             if fused is not None:
                 from libpga_tpu.ops.pallas_step import make_pallas_breed
 
+                # Tuned resolution at the SHARD shape — the block the
+                # per-shard kernel actually breeds.
+                deme, layout, subblock, _ = self._resolved_pallas_knobs(
+                    shard_size, genome_len
+                )
                 try:
                     breed = make_pallas_breed(
                         shard_size, genome_len,
-                        deme_size=self.config.pallas_deme_size,
+                        deme_size=deme,
                         tournament_size=self.config.tournament_size,
                         selection_kind=self.config.selection,
                         selection_param=self.config.selection_param,
@@ -999,8 +1065,8 @@ class PGA:
                             getattr(obj, "kernel_rowwise_consts", ())
                         ),
                         gene_dtype=self.config.gene_dtype,
-                        _layout=self.config.pallas_layout,
-                        _subblock=self.config.pallas_subblock,
+                        _layout=layout,
+                        _subblock=subblock,
                     )
                 except Exception as e:
                     if self.config.fallback == "raise":
@@ -1058,12 +1124,15 @@ class PGA:
         S = self.config.pop_shards
         _sp.validate_shards(size, S)
         hist_gens = self._history_gens()
+        # The per-shard kernel's knobs resolve at the shard shape
+        # (tuning DB included) — key the sharded program on them.
+        shard_knobs = self._resolved_pallas_knobs(size // S, genome_len)[:3]
         cache_key = (
             "engine/run-sharded", S, size, genome_len, obj,
             self._crossover, self._mutate,
             self.config.tournament_size, self.config.elitism,
             self.config.selection, self.config.selection_param,
-            self.config.pallas_layout, self.config.pallas_subblock,
+            shard_knobs,
             hist_gens,
         )
         fn = self._compiled.get(cache_key)

@@ -1921,6 +1921,90 @@ def _resolve_layout(
     return "riffle", D, 1
 
 
+# One-generation kernel demes-per-step policy — shared by the factory
+# (make_pallas_breed) and the dry-run resolver (kernel_plan) so the
+# tuning space can never describe a D the kernel wouldn't pick.
+ONE_GEN_D_POOL = (32, 16, 8, 4, 2, 1)
+
+
+def one_gen_d_default(gene_dtype, const_carrying: bool = False) -> int:
+    """Measured demes-per-step default of the one-generation kernel
+    (see the d_pool comment in make_pallas_breed): bf16 keeps D=4 at
+    K=512; f32 moved to D=8 — except const-carrying fused objectives
+    (NK-class), which measured fastest at the old K=256 D=16."""
+    if gene_dtype == jnp.bfloat16:
+        return 4
+    return 16 if const_carrying else 8
+
+
+def kernel_plan(
+    pop_size: int,
+    genome_len: int,
+    *,
+    deme_size: Optional[int] = None,
+    tournament_size: int = 2,
+    selection_kind: str = "tournament",
+    selection_param: Optional[float] = None,
+    crossover_kind: str = "uniform",
+    mutate_kind: str = "point",
+    gene_dtype=jnp.float32,
+    demes_per_step: Optional[int] = None,
+    layout: Optional[str] = None,
+    subblock: Optional[int] = None,
+    fused: bool = True,
+    const_carrying: bool = False,
+) -> Optional[dict]:
+    """DRY-RUN shape + layout resolution: exactly what
+    :func:`make_pallas_breed` would build for these knobs, WITHOUT
+    compiling anything — the admissibility oracle of the tuning config
+    space (``libpga_tpu/tuning/space.py``), so an invalid configuration
+    is rejected before a kernel is ever built.
+
+    Runs the same ``_kernel_shape`` gates (dtype/kind support, VMEM
+    budget model, deme divisibility/padding policy, demes-per-step
+    candidates) and the same ``_resolve_layout`` (ping-pong mixing gate,
+    sub-block divisibility) as the factory, with the factory's own
+    ``d_pool``/``d_default`` — ONE copy, so the plan and the built
+    kernel can never disagree. Returns ``None`` where the factory would
+    decline, raises ``ValueError`` exactly where it would raise (an
+    explicit inadmissible ping-pong request), and otherwise a dict with
+    the resolved ``deme_size``/``demes_per_step``/``layout``/
+    ``subblock``, the padded ``Pp``/``Lp``, and the per-launch
+    ``grid_steps`` count.
+    """
+    const_obj = bool(const_carrying)
+    shape = _kernel_shape(
+        pop_size, genome_len, deme_size, tournament_size,
+        selection_kind, selection_param, crossover_kind, mutate_kind,
+        gene_dtype,
+        blocks_fit=_blocks_fit,
+        d_pool=ONE_GEN_D_POOL,
+        d_default=one_gen_d_default(gene_dtype, const_obj),
+        demes_per_step=demes_per_step,
+        const_carrying=const_obj,
+    )
+    if shape is None:
+        return None
+    K, G, D, Pp, Lp, sel_param, d_cands = shape
+    lay, D2, B = _resolve_layout(
+        layout,
+        K=K, G=G, D=D, Pp=Pp, q=pingpong_quantum(gene_dtype),
+        d_candidates=d_cands, subblock=subblock, fused=fused,
+        crossover_kind=crossover_kind, ablate=(),
+        d_pinned=demes_per_step is not None,
+    )
+    return {
+        "deme_size": K,
+        "demes_per_step": D2,
+        "layout": lay,
+        "subblock": B,
+        "Pp": Pp,
+        "Lp": Lp,
+        "grid_steps": G // (B * D2) if lay == "pingpong" else G // D2,
+        "d_candidates": d_cands,
+    }
+
+
 def make_pallas_breed(
     pop_size: int,
     genome_len: int,
@@ -2013,10 +2097,8 @@ def make_pallas_breed(
         # predates both the stacked matmul and the batched stores) —
         # EXCEPT const-carrying fused objectives (NK-class), which
         # measured fastest at the old K=256 D=16.
-        d_pool=(32, 16, 8, 4, 2, 1),
-        d_default=(
-            4 if gene_dtype == jnp.bfloat16 else (16 if const_obj else 8)
-        ),
+        d_pool=ONE_GEN_D_POOL,
+        d_default=one_gen_d_default(gene_dtype, const_obj),
         demes_per_step=_demes_per_step,
         const_carrying=const_obj,
     )

@@ -212,8 +212,48 @@ class BatchedRuns:
         self.crossover = crossover or uniform_crossover
         self.mutate_kind = mutate_kind
         self.events = events
+        # Tuning-DB resolution per shape (ISSUE 10): cached so bucket
+        # admission costs one dict lookup, not a DB walk per request.
+        self._tuned_cache: dict = {}
 
     # ------------------------------------------------------------ bucketing
+
+    def _tuning_for(self, size: int, genome_len: int):
+        """``(knobs, provenance)`` of the tuning-DB resolution for one
+        request shape — precedence user knob > DB entry > default
+        (``tuning.db.resolve_config_knobs``). Provenance is None when
+        no DB is installed or no entry matches: the bucket signature
+        then carries ``("tuned", None)`` and nothing else changes —
+        untuned serving is byte-identical to pre-tuning serving."""
+        from libpga_tpu.ops import crossover as _c
+        from libpga_tpu.tuning import db as _tdb
+
+        # Keyed on the active DB path too: a long-lived executor picks
+        # up a set_tuning_db() swap instead of serving stale knobs.
+        # active_db() first — it may install the env-provided DB.
+        tdb = _tdb.active_db()
+        mark = (_tdb.active_path(), size, genome_len)
+        hit = self._tuned_cache.get(mark)
+        if hit is not None:
+            return hit
+        entry = None
+        if tdb is not None:
+            cross_names = {
+                _c.uniform_crossover: "uniform",
+                _c.order_preserving_crossover: "order",
+                _c.one_point_crossover: "one_point",
+                _c.arithmetic_crossover: "arithmetic",
+            }
+            entry = tdb.lookup(_tdb.current_key(
+                size, genome_len, self.config.gene_dtype,
+                self.objective,
+                cross_names.get(self.crossover, self.crossover),
+                self.mutate_kind,
+            ))
+        knobs, prov = _tdb.resolve_config_knobs(self.config, entry)
+        out = (knobs, prov)
+        self._tuned_cache[mark] = out
+        return out
 
     def signature(self, req: RunRequest) -> tuple:
         """The exact shape-bucket signature: everything baked into the
@@ -225,9 +265,17 @@ class BatchedRuns:
         compiled program — and since the cache key
         (:meth:`_program`'s ``prog_key``) extends this signature, the
         separation holds in ``cache.py`` too (collision test in
-        tests/test_shard_pop.py)."""
+        tests/test_shard_pop.py). The trailing ``("tuned", ...)`` pair
+        (ISSUE 10) is the DB-resolved knob tuple when a tuning-DB entry
+        matched this shape (None otherwise), so a tuned bucket can
+        never collide with an untuned one — the AOT warm-up compiles,
+        and the cache keys, exactly the best-known config."""
         from libpga_tpu.engine import _kind_key
 
+        knobs, prov = self._tuning_for(req.size, req.genome_len)
+        tuned = (
+            tuple(sorted(knobs.items())) if prov is not None else None
+        )
         return (
             "serving/run",
             req.size,
@@ -236,6 +284,7 @@ class BatchedRuns:
             _kind_key(self.crossover),
             self.mutate_kind,
             self.config.serving_signature_fields(),
+            ("tuned", tuned),
         )
 
     def _emit(self, event: str, **fields) -> None:
@@ -338,6 +387,20 @@ class BatchedRuns:
         size, genome_len = sig[1], sig[2]
         prog_key = sig + ("layout", layout, "width", N,
                           "donate", self.serving.donate_buffers)
+        # AOT warm-up consults the tuning DB (ISSUE 10): the resolved
+        # knobs already ride ``sig`` (so they're part of prog_key);
+        # here the PROVENANCE is attached to the cached program
+        # (cache.stats()) and announced once per actual build.
+        knobs, prov = self._tuning_for(size, genome_len)
+        tuned = None
+        if prov is not None:
+            from libpga_tpu.tuning import db as _tdb
+
+            tuned = {
+                "population_size": size, "genome_len": genome_len,
+                "knobs": dict(knobs), "provenance": dict(prov),
+                "db": _tdb.active_path(),
+            }
 
         def on_compile():
             self._emit(
@@ -345,11 +408,19 @@ class BatchedRuns:
                 population_size=size, genome_len=genome_len,
                 layout=layout,
             )
+            if tuned is not None:
+                self._emit(
+                    "tuned_config", population_size=size,
+                    genome_len=genome_len, knobs=dict(knobs),
+                    provenance=dict(tuned["provenance"]),
+                    db=tuned["db"], where="serving_warmup",
+                )
 
         return _cache.PROGRAM_CACHE.get_or_build(
             prog_key,
             lambda: self._build_mega(N, size, genome_len, layout),
             on_compile=on_compile,
+            tuned=tuned,
         )
 
     # -------------------------------------------------------------- execute

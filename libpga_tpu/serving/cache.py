@@ -65,6 +65,11 @@ class ProgramCache:
         self.capacity = capacity
         self.counters = counters if counters is not None else COUNTERS
         self._entries: "OrderedDict[tuple, object]" = OrderedDict()
+        # Tuning-DB provenance per cached program (ISSUE 10): which
+        # resolved knobs a tuned bucket compiled under, surfaced by
+        # stats() so an operator (and the CI smoke) can prove "this
+        # served signature runs its best-known config".
+        self._tuned: "OrderedDict[tuple, dict]" = OrderedDict()
         self._lock = threading.Lock()
 
     def __len__(self) -> int:
@@ -98,6 +103,8 @@ class ProgramCache:
                 and len(self._entries) > self.capacity
             ):
                 evicted.append(self._entries.popitem(last=False))
+            for k, _ in evicted:
+                self._tuned.pop(k, None)
             n = len(self._entries)
         _entries_gauge(n)
         for _ in evicted:
@@ -108,11 +115,18 @@ class ProgramCache:
         key: tuple,
         build: Callable[[], object],
         on_compile: Optional[Callable[[], None]] = None,
+        tuned: Optional[dict] = None,
     ):
         """The cached program for ``key``, building (and counting a
         ``builds``) on miss. ``on_compile`` fires once per ACTUAL build
         — the hook the queue uses to emit a ``compile`` telemetry event
-        per bucket, never per request."""
+        per bucket, never per request. ``tuned`` (ISSUE 10) attaches
+        the tuning-DB resolution provenance of this program — recorded
+        hit or miss, surfaced by :meth:`stats`, dropped with the entry
+        on eviction."""
+        if tuned is not None:
+            with self._lock:
+                self._tuned[key] = dict(tuned)
         program = self.get(key)
         if program is not None:
             return program
@@ -135,14 +149,21 @@ class ProgramCache:
         return program
 
     def stats(self) -> dict:
-        """Counter snapshot plus the live entry count."""
+        """Counter snapshot plus the live entry count — and, when any
+        resident program was built under a tuning-DB resolution, the
+        ``tuned`` provenance list (one dict per tuned program: resolved
+        knobs, per-field provenance, source DB path)."""
         out = self.counters.snapshot()
         out["entries"] = len(self)
+        with self._lock:
+            if self._tuned:
+                out["tuned"] = [dict(v) for v in self._tuned.values()]
         return out
 
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
+            self._tuned.clear()
         _entries_gauge(0)
 
 
@@ -158,6 +179,7 @@ def configure(capacity: Optional[int]) -> None:
     if capacity is not None:
         with PROGRAM_CACHE._lock:
             while len(PROGRAM_CACHE._entries) > capacity:
-                PROGRAM_CACHE._entries.popitem(last=False)
+                k, _ = PROGRAM_CACHE._entries.popitem(last=False)
+                PROGRAM_CACHE._tuned.pop(k, None)
                 PROGRAM_CACHE.counters.bump("evictions")
     _entries_gauge(len(PROGRAM_CACHE))

@@ -769,6 +769,10 @@ class Fleet:
                 env = dict(os.environ)
                 if threefry is not None:
                     env["JAX_THREEFRY_PARTITIONABLE"] = threefry
+                if self.fleet.tuning_db:
+                    # Workers inherit the fleet's kernel tuning DB the
+                    # same way faults travel: one env var (ISSUE 10).
+                    env["PGA_TUNING_DB"] = self.fleet.tuning_db
                 if worker_env and i in worker_env:
                     env.update(worker_env[i])
                 proc = subprocess.Popen(

@@ -667,6 +667,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     if spec:
         _faults.install_spec(spec)
 
+    # Kernel tuning DB (ISSUE 10): same env transport as faults —
+    # installed eagerly so a bad DB surfaces in the worker log at
+    # startup; a worker is still serviceable untuned, so warn, don't
+    # die (the engine-side env fallback would otherwise retry lazily).
+    db_path = os.environ.get("PGA_TUNING_DB", "")
+    if db_path:
+        try:
+            from libpga_tpu.tuning import set_tuning_db
+
+            set_tuning_db(db_path)
+        except Exception as exc:
+            import warnings
+
+            warnings.warn(
+                f"PGA_TUNING_DB={db_path!r} is unusable ({exc}) — "
+                "worker running untuned"
+            )
+
     harness = WorkerHarness(
         args.spool, args.worker_id,
         heartbeat_s=args.heartbeat_s, poll_s=args.poll_s,

@@ -1,0 +1,65 @@
+"""Self-tuning kernels (ROADMAP item 4, ISSUE 10).
+
+Three pieces:
+
+- :mod:`libpga_tpu.tuning.space` — the single-source kernel config
+  space (knob domains + admissibility gates) both sweep tools and the
+  autotuner consume;
+- :mod:`libpga_tpu.tuning.tuner` — the evolutionary autotuner: the
+  library's own PGA over integer-encoded configs with an
+  interleaved-medians measurement oracle;
+- :mod:`libpga_tpu.tuning.db` — the persistent, schema-versioned,
+  atomically-written tuning database the engine and the serving
+  warm-up consult at kernel selection (resolution precedence: explicit
+  user knob > DB entry > built-in default).
+
+Heavy imports stay lazy (PEP 562): ``import libpga_tpu`` must not pay
+for the tuner.
+"""
+
+from __future__ import annotations
+
+from libpga_tpu.tuning.db import (  # light, no jax at import
+    TuningDB,
+    TuningDBError,
+    TuningEntry,
+    TuningKey,
+    TuningSchemaError,
+    active_db,
+    active_path,
+    current_key,
+    merge_files,
+    resolve_config_knobs,
+    set_tuning_db,
+)
+
+__all__ = [
+    "TuningDB",
+    "TuningDBError",
+    "TuningSchemaError",
+    "TuningEntry",
+    "TuningKey",
+    "active_db",
+    "active_path",
+    "current_key",
+    "merge_files",
+    "resolve_config_knobs",
+    "set_tuning_db",
+    "autotune",
+    "TunerSettings",
+    "space",
+    "db",
+    "tuner",
+]
+
+
+def __getattr__(name):
+    if name in ("autotune", "TunerSettings"):
+        from libpga_tpu.tuning import tuner as _tuner
+
+        return getattr(_tuner, name)
+    if name in ("space", "db", "tuner"):
+        import importlib
+
+        return importlib.import_module(f"libpga_tpu.tuning.{name}")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,449 @@
+"""Persistent tuning database: best-known kernel configs per signature.
+
+The artifact a chip round produces (``tools/autotune.py``) and every
+subsequent run consults: a JSON file mapping **tuning keys** — the
+measurement context a result is only valid in — to the **knobs** that
+measured fastest there. File conventions match the repo's other durable
+state (``utils/checkpoint``, the fleet spool): schema-versioned,
+written atomically (temp file + ``os.replace``, so a concurrent reader
+or a SIGKILL mid-write can never observe a torn database), and merges
+of multiple DB files are ASSOCIATIVE (entry conflicts resolve by a
+total order, so merging per-host databases in any grouping yields the
+same fleet database).
+
+Key fields (all part of the context the measurement happened in):
+``(pop, genome_len, dtype, backend, device_kind, objective class,
+operator kinds)``. A DB produced on one device kind never silently
+applies to another — lookups from a different backend simply miss.
+
+Failure stances, mirroring ``utils/metrics.merge_snapshots``:
+
+- **torn / partial file** (unparseable JSON, truncated write from a
+  non-atomic producer): :func:`merge_files` SKIPS it and reports
+  (warning + the returned ``skipped`` list); :func:`TuningDB.load`
+  raises :class:`TuningDBError` naming the path.
+- **parseable but schema-mismatched**: always a LOUD
+  :class:`TuningDBError` — a future schema is not guessed at.
+
+Resolution precedence (:func:`resolve_config_knobs`): an EXPLICIT user
+knob on ``PGAConfig`` always beats the DB entry, which beats the
+built-in auto default — so a user pinning ``pallas_deme_size=256`` can
+never be silently overridden by a stale database.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+import warnings
+from typing import Dict, List, Optional, Sequence, Tuple
+
+SCHEMA_VERSION = 1
+
+#: Environment hook: fleet workers (and any subprocess) inherit the
+#: coordinator's tuning database through this variable — the same
+#: transport pattern as PGA_FAULT_SPEC (serving/worker.py).
+ENV_VAR = "PGA_TUNING_DB"
+
+#: PGAConfig fields a DB entry may resolve (the engine-appliable knobs;
+#: tuning/space.KNOB_TO_CONFIG_FIELD maps space knobs onto these).
+TUNABLE_FIELDS = ("pallas_deme_size", "pallas_layout", "pallas_subblock")
+
+
+class TuningDBError(RuntimeError):
+    """Torn/partial or otherwise unusable tuning-database file."""
+
+
+class TuningSchemaError(TuningDBError):
+    """Parseable database whose schema_version this code does not
+    speak — always refused loudly, never skipped."""
+
+
+def objective_class(obj) -> str:
+    """Stable string identity of an objective for the tuning key: its
+    builtin-registry name when it has one (so the engine — which holds
+    the resolved callable — and the tuner — which may have been handed
+    the name — derive the SAME key), else the module-qualified callable
+    name. Exotic objectives get a usable — if verbose — class; lookups
+    for them just miss until tuned."""
+    if isinstance(obj, str):
+        return obj
+    try:
+        from libpga_tpu import objectives as _objectives
+
+        for name in _objectives.names():
+            if _objectives.get(name) is obj:
+                return name
+    except Exception:
+        pass
+    for attr in ("registry_name", "name", "__name__"):
+        v = getattr(obj, attr, None)
+        if isinstance(v, str) and v:
+            mod = getattr(obj, "__module__", "") or ""
+            if attr == "__name__" and mod and not mod.startswith(
+                "libpga_tpu.objectives"
+            ):
+                return f"{mod}.{v}"
+            return v
+    return type(obj).__name__
+
+
+def operator_kinds(crossover_kind, mutate_kind) -> str:
+    """Stable operator-kind pair string (e.g. ``"uniform+point"``).
+    Expression operators key by their compiled cache identity when it
+    is a string, else by a generic marker — again, exotic operators
+    miss rather than mis-match."""
+    def one(kind):
+        if isinstance(kind, str):
+            return kind
+        key = getattr(kind, "kernel_cache_key", None)
+        if isinstance(key, str):
+            return key
+        return f"expr:{getattr(kind, 'role', type(kind).__name__)}"
+
+    return f"{one(crossover_kind)}+{one(mutate_kind)}"
+
+
+@dataclasses.dataclass(frozen=True)
+class TuningKey:
+    """The context a tuned config is valid in — every field is part of
+    the measurement's identity."""
+
+    pop: int
+    genome_len: int
+    dtype: str
+    backend: str
+    device_kind: str
+    objective: str
+    operators: str
+
+    def as_string(self) -> str:
+        return (
+            f"pop={self.pop}|len={self.genome_len}|dtype={self.dtype}"
+            f"|backend={self.backend}|device={self.device_kind}"
+            f"|obj={self.objective}|ops={self.operators}"
+        )
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d: dict) -> "TuningKey":
+        return TuningKey(
+            pop=int(d["pop"]), genome_len=int(d["genome_len"]),
+            dtype=str(d["dtype"]), backend=str(d["backend"]),
+            device_kind=str(d["device_kind"]),
+            objective=str(d["objective"]), operators=str(d["operators"]),
+        )
+
+
+def current_key(
+    pop: int,
+    genome_len: int,
+    gene_dtype,
+    objective,
+    crossover_kind="uniform",
+    mutate_kind="point",
+) -> TuningKey:
+    """The tuning key for a shape on the LIVE backend/device."""
+    import jax
+    import numpy as np
+
+    try:
+        backend = jax.default_backend()
+        device_kind = jax.devices()[0].device_kind
+    except RuntimeError:
+        backend, device_kind = "unknown", "unknown"
+    return TuningKey(
+        pop=int(pop), genome_len=int(genome_len),
+        dtype=np.dtype(gene_dtype).name, backend=str(backend),
+        device_kind=str(device_kind),
+        objective=objective_class(objective),
+        operators=operator_kinds(crossover_kind, mutate_kind),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class TuningEntry:
+    """One tuned result: the knobs that measured best for ``key``, with
+    enough provenance to audit the claim (how fast, against what
+    default, over how many samples, at what confidence)."""
+
+    key: TuningKey
+    knobs: dict                  # PGAConfig field -> value (None = auto)
+    plan: dict = dataclasses.field(default_factory=dict)
+    gens_per_sec: float = 0.0
+    default_gens_per_sec: float = 0.0
+    rel_ci: Optional[float] = None
+    samples: int = 0
+    evaluated: int = 0
+    space_size: int = 0
+    budget: int = 0
+    seed: int = 0
+    created: float = 0.0
+    note: str = ""
+
+    def __post_init__(self):
+        unknown = sorted(set(self.knobs) - set(TUNABLE_FIELDS))
+        if unknown:
+            raise TuningDBError(
+                f"entry knobs {unknown} are not tunable fields "
+                f"{list(TUNABLE_FIELDS)}"
+            )
+
+    def knobs_tuple(self) -> tuple:
+        """Canonical hashable knob form (cache-key ingredient)."""
+        return tuple(
+            (f, self.knobs.get(f)) for f in TUNABLE_FIELDS
+        )
+
+    def as_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["key"] = self.key.as_dict()
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "TuningEntry":
+        d = dict(d)
+        d["key"] = TuningKey.from_dict(d["key"])
+        return TuningEntry(**d)
+
+    def _order(self) -> tuple:
+        """Total order for associative merge: faster wins; ties break
+        on creation time then the canonical knob string, so ANY merge
+        grouping of the same entry set picks the same winner."""
+        return (
+            self.gens_per_sec, self.created, json.dumps(
+                self.knobs, sort_keys=True, default=str
+            ),
+        )
+
+
+class TuningDB:
+    """In-memory tuning database; thread-safe for the engine/serving
+    lookup path (lookups race with a concurrent ``set_tuning_db``)."""
+
+    def __init__(self, entries: Optional[Dict[str, TuningEntry]] = None):
+        self.entries: Dict[str, TuningEntry] = dict(entries or {})
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def lookup(self, key: TuningKey) -> Optional[TuningEntry]:
+        return self.entries.get(key.as_string())
+
+    def add(self, entry: TuningEntry) -> None:
+        """Insert, keeping the better entry on conflict (the merge
+        order, so add() and merge() agree)."""
+        ks = entry.key.as_string()
+        cur = self.entries.get(ks)
+        if cur is None or entry._order() > cur._order():
+            self.entries[ks] = entry
+
+    def merge(self, other: "TuningDB") -> "TuningDB":
+        """Associative, commutative merge: the union of entries with
+        per-key conflicts resolved by the total order."""
+        out = TuningDB(dict(self.entries))
+        for e in other.entries.values():
+            out.add(e)
+        return out
+
+    # ------------------------------------------------------------- file IO
+
+    def to_json(self) -> dict:
+        return {
+            "schema_version": SCHEMA_VERSION,
+            "entries": {
+                k: e.as_dict() for k, e in sorted(self.entries.items())
+            },
+        }
+
+    @staticmethod
+    def from_json(data: dict, path: str = "<memory>") -> "TuningDB":
+        if not isinstance(data, dict) or "schema_version" not in data:
+            raise TuningDBError(
+                f"{path}: not a tuning database (no schema_version)"
+            )
+        if data["schema_version"] != SCHEMA_VERSION:
+            raise TuningSchemaError(
+                f"{path}: tuning-db schema_version "
+                f"{data['schema_version']!r} != supported "
+                f"{SCHEMA_VERSION} — refusing to guess at a different "
+                "schema (re-run tools/autotune.py to regenerate)"
+            )
+        entries = {}
+        for k, d in data.get("entries", {}).items():
+            try:
+                entries[k] = TuningEntry.from_dict(d)
+            except (KeyError, TypeError, ValueError) as exc:
+                raise TuningDBError(
+                    f"{path}: malformed entry {k!r}: {exc}"
+                ) from exc
+        return TuningDB(entries)
+
+    def save(self, path: str) -> str:
+        """Atomic write: temp file in the same directory +
+        ``os.replace`` — the checkpoint/spool durability convention. A
+        reader concurrent with save() sees either the old complete file
+        or the new complete file, never a prefix."""
+        final = os.path.abspath(path)
+        os.makedirs(os.path.dirname(final) or ".", exist_ok=True)
+        tmp = f"{final}.{os.getpid()}.tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(self.to_json(), fh, indent=1, default=str)
+                fh.write("\n")
+            os.replace(tmp, final)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+        return final
+
+    @staticmethod
+    def load(path: str) -> "TuningDB":
+        """Load one DB file. Torn/unparseable → :class:`TuningDBError`
+        naming the path; schema mismatch → :class:`TuningDBError`
+        (loud refusal, see module docstring)."""
+        try:
+            with open(path, encoding="utf-8") as fh:
+                data = json.load(fh)
+        except json.JSONDecodeError as exc:
+            raise TuningDBError(
+                f"{path}: torn or partial tuning database ({exc})"
+            ) from exc
+        return TuningDB.from_json(data, path=path)
+
+
+def merge_files(paths: Sequence[str]) -> Tuple[TuningDB, List[str]]:
+    """Merge several DB files into one (associative — any grouping of
+    the same files produces the same database). TORN/partial files are
+    SKIPPED and reported (warning + returned list); a parseable file
+    with a mismatched schema REFUSES loudly; a merely MISSING file is
+    silently fine (merging "whatever the hosts have written so far" is
+    the normal fleet case, and autotune's first write merges into a
+    not-yet-existing path)."""
+    out = TuningDB()
+    skipped: List[str] = []
+    for p in paths:
+        try:
+            out = out.merge(TuningDB.load(p))
+        except TuningSchemaError:
+            raise  # loud refusal: a future schema is not guessed at
+        except FileNotFoundError:
+            continue
+        except TuningDBError:
+            skipped.append(p)
+    if skipped:
+        warnings.warn(
+            f"tuning merge skipped {len(skipped)} torn/partial file(s): "
+            f"{skipped}",
+            stacklevel=2,
+        )
+    return out, skipped
+
+
+# ------------------------------------------------------- process-global DB
+
+_LOCK = threading.Lock()
+_ACTIVE: dict = {"path": None, "db": None, "env_checked": False}
+
+
+def set_tuning_db(path: Optional[str]) -> Optional["TuningDB"]:
+    """Install (or with None/"" clear) the process-global tuning
+    database every engine and serving executor consults at kernel
+    selection. Loads EAGERLY so a bad path/schema fails here, at the
+    operator's hand, not inside a serving warm-up."""
+    with _LOCK:
+        if not path:
+            _ACTIVE.update(path=None, db=None, env_checked=True)
+            return None
+        db = TuningDB.load(path)
+        _ACTIVE.update(path=os.path.abspath(path), db=db,
+                       env_checked=True)
+        return db
+
+
+def active_db() -> Optional["TuningDB"]:
+    """The installed tuning database, or None. First call falls back to
+    the :data:`ENV_VAR` environment hook (how fleet workers inherit the
+    coordinator's DB); an unreadable env-provided DB warns once and
+    stays off rather than killing a worker at import time."""
+    with _LOCK:
+        if _ACTIVE["db"] is None and not _ACTIVE["env_checked"]:
+            _ACTIVE["env_checked"] = True
+            env_path = os.environ.get(ENV_VAR)
+            if env_path:
+                try:
+                    _ACTIVE.update(
+                        path=os.path.abspath(env_path),
+                        db=TuningDB.load(env_path),
+                    )
+                except (FileNotFoundError, TuningDBError) as exc:
+                    warnings.warn(
+                        f"{ENV_VAR}={env_path!r} is unusable "
+                        f"({exc}) — running untuned",
+                        stacklevel=2,
+                    )
+        return _ACTIVE["db"]
+
+
+def active_path() -> Optional[str]:
+    with _LOCK:
+        return _ACTIVE["path"]
+
+
+def resolve_config_knobs(
+    config, entry: Optional[TuningEntry]
+) -> Tuple[dict, Optional[dict]]:
+    """Apply the resolution precedence — explicit user knob > DB entry
+    > built-in default — to the tunable ``PGAConfig`` fields.
+
+    Returns ``(knobs, provenance)``: ``knobs`` maps every tunable field
+    to its EFFECTIVE value (what kernel selection must use), and
+    ``provenance`` maps each field to ``"user"``/``"db"``/``"default"``.
+    ``provenance`` is None exactly when ``entry`` is None (no DB
+    installed, or no entry for this signature) — the untuned path then
+    carries literally the config's own values and nothing else, the
+    byte-identity guarantee of ``db=None``. A MATCHED entry always
+    yields provenance, even when every knob stays at its default (the
+    CPU case, where the tuner's never-regress rule records the default
+    config): that a database ruled is itself part of a served bucket's
+    identity (``serving/cache`` stats, the ``tuned_config`` event).
+    """
+    knobs, prov = {}, {}
+    for field in TUNABLE_FIELDS:
+        user = getattr(config, field)
+        if user is not None:
+            knobs[field], prov[field] = user, "user"
+        elif entry is not None and entry.knobs.get(field) is not None:
+            knobs[field], prov[field] = entry.knobs[field], "db"
+        else:
+            knobs[field], prov[field] = None, "default"
+    return knobs, (prov if entry is not None else None)
+
+
+def entry_created_now() -> float:
+    return time.time()
+
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "ENV_VAR",
+    "TUNABLE_FIELDS",
+    "TuningDBError",
+    "TuningSchemaError",
+    "TuningKey",
+    "TuningEntry",
+    "TuningDB",
+    "current_key",
+    "objective_class",
+    "operator_kinds",
+    "merge_files",
+    "set_tuning_db",
+    "active_db",
+    "active_path",
+    "resolve_config_knobs",
+]

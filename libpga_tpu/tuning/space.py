@@ -1,0 +1,321 @@
+"""Single-source definition of the fused-kernel configuration space.
+
+Every chip round so far swept the kernel knobs by hand:
+``tools/sweep_kernel.py`` hand-rolled a (dtype, K, D) grid and skipped
+inadmissible points by building the kernel and checking what came back;
+``tools/ablate_floor.py`` hand-rolled its own D sweep with the same
+build-and-check pattern. This module is the ONE definition both tools
+(and the evolutionary autotuner, ``tuning/tuner.py``) consume:
+
+- **knob domains** — every tunable axis with its value set. Index 0 of
+  every domain is the AUTO value (``None`` — "let the factory pick"),
+  so the all-zeros genome is exactly the shipped default configuration.
+- **admissibility gates** — :func:`why_inadmissible` runs the factory's
+  own dry-run resolution (``ops/pallas_step.kernel_plan``: the
+  ``_kernel_shape`` VMEM budget model + deme divisibility and the
+  ``_resolve_layout`` ping-pong mixing gate / sub-block divisibility)
+  so an invalid configuration is rejected BEFORE anything compiles,
+  and the space can never describe a kernel the factory wouldn't
+  build.
+- **genome codec** — configurations encode as fixed-width integer
+  genomes (one gene per knob, the gene value an index into that knob's
+  domain), the representation ``tuning/tuner.py`` evolves with the
+  library's own ``PGA``.
+
+The ENGINE-APPLICABLE knobs (``TUNER_KNOBS``) are the ones
+``PGAConfig`` exposes — ``deme_size``/``layout``/``subblock`` — which
+is what the autotuner searches so a tuning-database entry is directly
+appliable at kernel selection. The sweep tools additionally iterate
+``demes_per_step`` (a factory-internal axis, ``_demes_per_step``) and
+``dimension_semantics`` (parallel vs. serial grid, the
+``serial_grid`` ablation) via ``SWEEP_KNOBS``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import math
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from libpga_tpu.ops.pallas_step import (  # the single admission source
+    LANE,
+    _valid_deme,
+    kernel_plan,
+    pingpong_admissible,
+    pingpong_quantum,
+)
+
+#: Knob domains. Index 0 is always the AUTO value (factory default), so
+#: the zero genome is the shipped default configuration and a decoded
+#: index can never be out of range after clipping.
+DOMAINS: Dict[str, tuple] = {
+    "deme_size": (None, 128, 256, 512, 1024),
+    "layout": (None, "riffle", "pingpong"),
+    "subblock": (None, 2, 4),
+    "demes_per_step": (None, 1, 2, 4, 8, 16, 32),
+    "dimension_semantics": ("parallel", "serial"),
+}
+
+#: The engine-appliable knobs (PGAConfig fields exist for exactly
+#: these) — the autotuner's genome, and what a tuning-DB entry records.
+TUNER_KNOBS: Tuple[str, ...] = ("deme_size", "layout", "subblock")
+
+#: The full sweep space (tools/sweep_kernel.py, tools/ablate_floor.py).
+SWEEP_KNOBS: Tuple[str, ...] = TUNER_KNOBS + (
+    "demes_per_step", "dimension_semantics",
+)
+
+#: KernelConfig knob -> PGAConfig field for the engine-appliable subset.
+KNOB_TO_CONFIG_FIELD: Dict[str, str] = {
+    "deme_size": "pallas_deme_size",
+    "layout": "pallas_layout",
+    "subblock": "pallas_subblock",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelConfig:
+    """One point of the kernel config space. ``None`` anywhere means
+    AUTO — defer to the factory default for that knob (the decoded form
+    of domain index 0)."""
+
+    deme_size: Optional[int] = None
+    layout: Optional[str] = None
+    subblock: Optional[int] = None
+    demes_per_step: Optional[int] = None
+    dimension_semantics: str = "parallel"
+
+    def knobs(self, names: Sequence[str] = TUNER_KNOBS) -> dict:
+        return {n: getattr(self, n) for n in names}
+
+    def config_fields(self) -> dict:
+        """The engine-appliable knobs as ``PGAConfig`` field values."""
+        return {
+            field: getattr(self, knob)
+            for knob, field in KNOB_TO_CONFIG_FIELD.items()
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class SpaceContext:
+    """The shape context a config space is defined against — everything
+    admissibility depends on besides the knobs themselves. ``dtype`` is
+    part of the context (and of the tuning-DB key), not a knob: a tuned
+    entry must never silently change the genome representation."""
+
+    pop: int
+    genome_len: int
+    gene_dtype: object = jnp.float32
+    crossover_kind: str = "uniform"
+    mutate_kind: str = "point"
+    tournament_size: int = 2
+    selection_kind: str = "tournament"
+    selection_param: Optional[float] = None
+    fused: bool = True
+    const_carrying: bool = False
+
+    @property
+    def genome_lanes(self) -> int:
+        return math.ceil(self.genome_len / LANE) * LANE
+
+    @property
+    def gene_bytes(self) -> int:
+        return 2 if self.gene_dtype == jnp.bfloat16 else 4
+
+    @property
+    def quantum(self) -> int:
+        return pingpong_quantum(self.gene_dtype)
+
+    def dtype_name(self) -> str:
+        import numpy as np
+
+        return np.dtype(self.gene_dtype).name
+
+
+def resolve(ctx: SpaceContext, cfg: KernelConfig) -> Optional[dict]:
+    """The factory's dry-run resolution of ``cfg`` in ``ctx`` — the
+    plan :func:`~libpga_tpu.ops.pallas_step.make_pallas_breed` would
+    build, or None where it would decline. Raises where the factory
+    would (explicit inadmissible ping-pong)."""
+    return kernel_plan(
+        ctx.pop, ctx.genome_len,
+        deme_size=cfg.deme_size,
+        gene_dtype=ctx.gene_dtype,
+        demes_per_step=cfg.demes_per_step,
+        layout=cfg.layout,
+        subblock=cfg.subblock,
+        crossover_kind=ctx.crossover_kind,
+        mutate_kind=ctx.mutate_kind,
+        tournament_size=ctx.tournament_size,
+        selection_kind=ctx.selection_kind,
+        selection_param=ctx.selection_param,
+        fused=ctx.fused,
+        const_carrying=ctx.const_carrying,
+    )
+
+
+def why_inadmissible(
+    ctx: SpaceContext, cfg: KernelConfig, strict: bool = True
+) -> Optional[str]:
+    """None when ``cfg`` is admissible in ``ctx``, else a one-line
+    reason. ``strict`` additionally rejects configurations the factory
+    would accept but SILENTLY ROUND AWAY (a requested deme size or
+    demes-per-step the factory replaces, a sub-block request the riffle
+    fallback drops) — the sweep tools' "skip duplicates" rule and the
+    tuner's "measure what you asked for" rule, now enforced before any
+    compile."""
+    if cfg.deme_size is not None:
+        if not _valid_deme(cfg.deme_size):
+            return (
+                f"deme_size {cfg.deme_size} is not a power of two in "
+                "[128, 1024]"
+            )
+        if strict and ctx.pop % cfg.deme_size:
+            return (
+                f"deme_size {cfg.deme_size} does not divide pop "
+                f"{ctx.pop} (factory would re-pick or pad)"
+            )
+    if cfg.subblock is not None and cfg.subblock < 1:
+        return f"subblock {cfg.subblock} must be >= 1"
+    if (
+        strict
+        and cfg.subblock is not None
+        and cfg.subblock > 1
+        and cfg.layout == "riffle"
+    ):
+        return "subblock > 1 is a ping-pong pipeline (riffle drops it)"
+    try:
+        plan = resolve(ctx, cfg)
+    except ValueError as exc:  # explicit ping-pong failing its gate
+        return str(exc)
+    if plan is None:
+        return "factory declines this shape/knob combination"
+    if strict:
+        for knob, resolved in (
+            ("deme_size", plan["deme_size"]),
+            ("demes_per_step", plan["demes_per_step"]),
+            ("layout", plan["layout"]),
+        ):
+            asked = getattr(cfg, knob)
+            if asked is not None and asked != resolved:
+                return (
+                    f"{knob}={asked} rounds away (factory resolves "
+                    f"{resolved})"
+                )
+        if (
+            cfg.subblock is not None
+            and cfg.subblock > 1
+            and plan["subblock"] != cfg.subblock
+        ):
+            return (
+                f"subblock={cfg.subblock} rounds away (factory resolves "
+                f"{plan['subblock']})"
+            )
+    return None
+
+
+def admissible(
+    ctx: SpaceContext, cfg: KernelConfig, strict: bool = True
+) -> bool:
+    return why_inadmissible(ctx, cfg, strict=strict) is None
+
+
+def grid(
+    ctx: SpaceContext,
+    knobs: Sequence[str] = TUNER_KNOBS,
+    strict: bool = True,
+    **pins: Iterable,
+) -> List[KernelConfig]:
+    """Every ADMISSIBLE configuration over the Cartesian product of the
+    named knob domains. ``pins`` overrides a knob's iterated values
+    (e.g. ``layout=("riffle",)`` pins the sweep to one layout); a
+    pinned knob need not be in ``knobs``. Inadmissible points are
+    filtered here — callers never build a kernel to find out."""
+    names = list(dict.fromkeys(list(knobs) + list(pins)))
+    axes = []
+    for name in names:
+        if name not in DOMAINS:
+            raise ValueError(
+                f"unknown knob {name!r}; valid knobs: {sorted(DOMAINS)}"
+            )
+        axes.append(tuple(pins.get(name, DOMAINS[name])))
+    out = []
+    for values in itertools.product(*axes):
+        cfg = KernelConfig(**dict(zip(names, values)))
+        if admissible(ctx, cfg, strict=strict):
+            out.append(cfg)
+    return out
+
+
+def space_size(
+    ctx: SpaceContext, knobs: Sequence[str] = TUNER_KNOBS
+) -> int:
+    """Number of admissible configurations (``--dry-run`` of the
+    autotune CLI)."""
+    return len(grid(ctx, knobs))
+
+
+# ------------------------------------------------------------ genome codec
+
+
+def genome_width(knobs: Sequence[str] = TUNER_KNOBS) -> int:
+    """Fixed genome width: one gene per knob."""
+    return len(knobs)
+
+
+def config_from_indices(
+    idx: Sequence[int], knobs: Sequence[str] = TUNER_KNOBS
+) -> KernelConfig:
+    """Decode a fixed-width integer genome: gene i indexes knob i's
+    domain (clipped into range, so any integer decodes)."""
+    fields = {}
+    for name, i in zip(knobs, idx):
+        dom = DOMAINS[name]
+        fields[name] = dom[max(0, min(int(i), len(dom) - 1))]
+    return KernelConfig(**fields)
+
+
+def indices_from_config(
+    cfg: KernelConfig, knobs: Sequence[str] = TUNER_KNOBS
+) -> Tuple[int, ...]:
+    return tuple(
+        DOMAINS[name].index(getattr(cfg, name)) for name in knobs
+    )
+
+
+def config_from_genes(
+    row, knobs: Sequence[str] = TUNER_KNOBS
+) -> KernelConfig:
+    """Decode one PGA genome row (floats in [0, 1) — the library's gene
+    domain for random init and point mutation) into a configuration:
+    gene g maps to domain index ``floor(g * |domain|)``, clipped, so
+    the decode is total and the all-zeros genome is the default
+    config."""
+    idx = []
+    for name, g in zip(knobs, row):
+        dom = DOMAINS[name]
+        idx.append(int(float(g) * len(dom)))
+    return config_from_indices(idx, knobs)
+
+
+__all__ = [
+    "DOMAINS",
+    "TUNER_KNOBS",
+    "SWEEP_KNOBS",
+    "KNOB_TO_CONFIG_FIELD",
+    "KernelConfig",
+    "SpaceContext",
+    "resolve",
+    "why_inadmissible",
+    "admissible",
+    "grid",
+    "space_size",
+    "genome_width",
+    "config_from_indices",
+    "indices_from_config",
+    "config_from_genes",
+    "pingpong_admissible",
+]

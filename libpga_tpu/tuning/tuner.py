@@ -1,0 +1,512 @@
+"""Evolutionary kernel autotuner: the GA tuning the GA.
+
+The cuPilot direction (PAPERS.md, arxiv 2512.16465) pointed at our own
+hot path: kernel configurations (``tuning/space.py``) encode as
+fixed-width integer genomes — one gene per knob, the gene an index into
+that knob's domain — and the library's OWN :class:`~libpga_tpu.engine.PGA`
+evolves them, with fitness supplied by a measurement oracle through a
+``pure_callback`` whole-population objective (the meta-GA's device
+program calls back into the host to time real kernels).
+
+Oracle design, in the order the guarantees matter:
+
+- **measures the real hot path** — each distinct configuration is
+  measured by running an actual engine (``PGA.run``) with the knobs
+  applied, sampled with the two-length-subtraction estimator inside
+  :func:`~libpga_tpu.utils.profiling.interleaved_medians` in its
+  repeat-until-confidence mode (``min_rel_ci`` bounded by
+  ``max_rounds``), interleaved against the DEFAULT configuration in the
+  same wave — so every candidate-vs-default comparison is adjacent and
+  decision-grade (this box's ~4% drift floor cannot promote noise);
+- **memoized by RESOLVED PLAN, not by genome** — two configurations
+  that resolve to the same compiled kernel (``space.resolve``; on a
+  CPU backend, where the fused kernel never runs, EVERY configuration
+  resolves to the one XLA plan) share one measurement. This is also
+  what makes the CPU smoke deterministic: constant fitness → a
+  seed-deterministic meta-GA trajectory → a deterministic database;
+- **compile-failure → worst fitness, never a crash** — a config whose
+  kernel fails to build or dispatch (``fallback="raise"`` inside the
+  oracle) records 0.0 gens/sec and the error string; inadmissible
+  configurations score below that without ever compiling;
+- **never regresses** — the recorded entry is the measured winner only
+  if it beats the default's same-wave measurement minus the drift
+  floor; otherwise the DEFAULT configuration is recorded (knobs all
+  auto), so applying the database can never make a signature slower
+  than stock.
+
+Deterministic given a seed: the meta-GA's PRNG chain is the engine's
+own seeded chain, waves are ordered, and ties break on a total order.
+(The measured NUMBERS still carry timing noise — determinism claims
+cover the search trajectory and, through plan memoization, the
+recorded knobs wherever plans are discrete, which is what the CI smoke
+pins on CPU.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from libpga_tpu.tuning import db as _db
+from libpga_tpu.tuning import space as _space
+
+#: This box's measured cross-round drift (BASELINE.md round 4/5): a
+#: candidate must beat the default by more than this to be recorded.
+DEFAULT_DRIFT_FLOOR = 0.04
+
+
+@dataclasses.dataclass(frozen=True)
+class TunerSettings:
+    """Autotune run parameters (CLI flags of ``tools/autotune.py``).
+
+    ``budget`` counts DISTINCT measured plans (the default config's
+    plan included); the meta-GA stops once the budget — or the whole
+    admissible plan set, whichever is smaller — is measured, or after
+    ``max_generations``. ``wave`` bounds candidate runners alive per
+    measurement wave (each runner holds a live population buffer —
+    on-device memory, not time, is the binding constraint at 1M-row
+    shapes)."""
+
+    budget: int = 16
+    seed: int = 0
+    ga_population: int = 16
+    max_generations: int = 32
+    rounds: int = 3
+    min_rel_ci: float = 0.05
+    max_rounds: int = 9
+    measure_lo: int = 3
+    measure_hi: int = 9
+    measure_tries: int = 2
+    drift_floor: float = DEFAULT_DRIFT_FLOOR
+    wave: int = 4
+
+    def __post_init__(self):
+        if self.budget < 1:
+            raise ValueError("budget must be >= 1")
+        if self.ga_population < 2:
+            raise ValueError("ga_population must be >= 2")
+        if self.max_generations < 1:
+            raise ValueError("max_generations must be >= 1")
+        if not (0 <= self.drift_floor < 1):
+            raise ValueError("drift_floor must be in [0, 1)")
+        if self.measure_hi <= self.measure_lo:
+            raise ValueError("measure_hi must be > measure_lo")
+        if self.wave < 1:
+            raise ValueError("wave must be >= 1")
+
+
+_DEFAULT_CONFIG = _space.KernelConfig()  # all knobs auto
+
+
+def _plan_key(ctx, cfg, pallas_live: bool) -> tuple:
+    """Measurement identity of a configuration: the compiled kernel it
+    resolves to. Off-TPU every config resolves to the XLA step path —
+    ONE plan — which is both honest (the knobs are no-ops there) and
+    what makes the CPU smoke deterministic."""
+    if not pallas_live:
+        return ("xla",)
+    plan = _space.resolve(ctx, cfg)
+    if plan is None:
+        return ("xla",)
+    return (
+        "pallas", plan["deme_size"], plan["demes_per_step"],
+        plan["layout"], plan["subblock"],
+    )
+
+
+def _canonical_knobs(plan_key: tuple) -> dict:
+    """The PGAConfig knob dict a winning plan records in the database.
+    The XLA plan (and the default plan on ties / never-regress) records
+    all-auto knobs — applying the entry reproduces the stock config."""
+    if plan_key[0] != "pallas":
+        return {f: None for f in _db.TUNABLE_FIELDS}
+    _, K, _D, layout, B = plan_key
+    return {
+        "pallas_deme_size": int(K),
+        "pallas_layout": str(layout),
+        "pallas_subblock": int(B) if B and B > 1 else None,
+    }
+
+
+class MeasurementOracle:
+    """Plan-memoized gens/sec oracle over real engine runs."""
+
+    def __init__(
+        self,
+        ctx: _space.SpaceContext,
+        objective,
+        settings: TunerSettings,
+        use_pallas: Optional[bool] = None,
+    ):
+        self.ctx = ctx
+        self.objective = objective
+        self.settings = settings
+        self.use_pallas = use_pallas
+        from libpga_tpu.config import PGAConfig
+
+        probe = PGAConfig(use_pallas=use_pallas,
+                          gene_dtype=ctx.gene_dtype)
+        import jax
+
+        self.pallas_live = (
+            probe.pallas_enabled() and jax.default_backend() == "tpu"
+        )
+        #: plan key -> record dict (gens_per_sec, default_gens_per_sec
+        #: from the same wave, rel_ci, n, error)
+        self.measured: Dict[tuple, dict] = {}
+        self.default_key = _plan_key(ctx, _DEFAULT_CONFIG,
+                                     self.pallas_live)
+        self._inadmissible: Dict[_space.KernelConfig, str] = {}
+
+    # ------------------------------------------------------------ runners
+
+    def _make_runner(self, knobs: dict) -> Callable[[int], None]:
+        """A fresh engine with the knobs applied; ``run(n)`` executes n
+        generations synchronously. ``fallback="raise"`` so a broken
+        lowering surfaces HERE (worst fitness) instead of silently
+        measuring the XLA path as if it were the candidate."""
+        import jax
+
+        from libpga_tpu.config import PGAConfig
+        from libpga_tpu.engine import PGA
+
+        cfg = PGAConfig(
+            gene_dtype=self.ctx.gene_dtype,
+            use_pallas=self.use_pallas,
+            fallback="raise",
+            tournament_size=self.ctx.tournament_size,
+            selection=self.ctx.selection_kind,
+            selection_param=self.ctx.selection_param,
+            **knobs,
+        )
+        pga = PGA(seed=0, config=cfg)
+        pga.set_objective(self.objective)
+        pga.create_population(self.ctx.pop, self.ctx.genome_len)
+
+        def run(n: int) -> None:
+            pga.run(int(n))
+
+        run.pga = pga  # keep the engine (and its buffers) alive
+        return run
+
+    def _measure_wave(self, new_keys: List[tuple]) -> None:
+        """Measure the new plans interleaved WITH the default plan in
+        one wave (adjacent samples — the only decision-grade
+        comparison on a drifting host), honoring the wave width."""
+        from libpga_tpu.utils.profiling import (
+            best_ms_per_unit,
+            interleaved_medians,
+        )
+
+        s = self.settings
+        waves = [
+            new_keys[i:i + s.wave]
+            for i in range(0, len(new_keys), s.wave)
+        ] or [[]]  # an empty request still measures the default plan
+        for wave_keys in waves:
+            chunk = [
+                k for k in wave_keys
+                if k not in self.measured and k != self.default_key
+            ]
+            runners, errors = {}, {}
+            for key in [self.default_key] + chunk:
+                if key in self.measured and key != self.default_key:
+                    continue
+                try:
+                    r = self._make_runner(_canonical_knobs(key))
+                    r(2)  # compile + warm outside the timed samples
+                    runners[key] = r
+                except Exception as exc:  # compile/dispatch failure
+                    errors[key] = f"{type(exc).__name__}: {exc}"
+            med = interleaved_medians(
+                {str(k): r for k, r in runners.items()},
+                rounds=s.rounds,
+                min_rel_ci=s.min_rel_ci,
+                max_rounds=s.max_rounds,
+                sample=lambda run: best_ms_per_unit(
+                    run, s.measure_lo, s.measure_hi,
+                    tries=s.measure_tries,
+                ),
+            ) if runners else {}
+            default_gps = None
+            if str(self.default_key) in (med or {}):
+                ms = med[str(self.default_key)]
+                default_gps = 1000.0 / ms if ms and ms == ms else 0.0
+            elif self.default_key in self.measured:
+                default_gps = self.measured[self.default_key][
+                    "gens_per_sec"
+                ]
+            for key in runners:
+                ms = med[str(key)]
+                gps = 1000.0 / ms if ms and ms == ms else 0.0
+                rec = {
+                    "gens_per_sec": gps,
+                    "default_gens_per_sec": default_gps,
+                    "rel_ci": med.rel_ci[str(key)],
+                    "samples": med.n[str(key)],
+                    "error": None,
+                }
+                if key == self.default_key:
+                    rec["default_gens_per_sec"] = gps
+                    if key in self.measured:
+                        continue  # keep the first default measurement
+                self.measured[key] = rec
+            for key, err in errors.items():
+                # Compile-failure → worst MEASURED fitness, never a
+                # crash: the plan is recorded as dead, not retried.
+                self.measured[key] = {
+                    "gens_per_sec": 0.0,
+                    "default_gens_per_sec": default_gps,
+                    "rel_ci": None, "samples": 0, "error": err,
+                }
+
+    # ------------------------------------------------------------ fitness
+
+    def _decode_keys(self, genomes: np.ndarray) -> List[Optional[tuple]]:
+        """Rows -> plan keys (None = inadmissible, rejected before any
+        compile)."""
+        keys: List[Optional[tuple]] = []
+        for row in genomes:
+            cfg = _space.config_from_genes(row, _space.TUNER_KNOBS)
+            if cfg not in self._inadmissible:
+                reason = _space.why_inadmissible(self.ctx, cfg)
+                self._inadmissible[cfg] = reason or ""
+            if self._inadmissible[cfg]:
+                keys.append(None)
+            else:
+                keys.append(_plan_key(self.ctx, cfg, self.pallas_live))
+        return keys
+
+    def prepare(self, genomes) -> None:
+        """ASK phase, called on the HOST thread between meta-GA
+        generations: decode the current meta population, measure every
+        not-yet-measured admissible plan it proposes (budget
+        permitting). Measurement runs real jitted programs, which a
+        jax host callback must never do — hence the ask/measure/tell
+        split: the traced objective (:func:`_meta_objective`) only does
+        memo LOOKUPS."""
+        keys = self._decode_keys(np.asarray(genomes))
+        budget_left = self.settings.budget - len(self.measured)
+        new = []
+        for k in keys:
+            if k is None or k in self.measured or k in new:
+                continue
+            if len(new) >= max(budget_left, 0):
+                continue
+            new.append(k)
+        if new or self.default_key not in self.measured:
+            self._measure_wave(new)
+
+    def lookup_host(self, genomes) -> np.ndarray:
+        """TELL phase — the pure-numpy host callback behind the
+        meta-GA's objective. Inadmissible rows score -1.0 (below any
+        measurement, below failed compiles at 0.0) without ever
+        compiling; plans beyond the budget (or children bred after the
+        last ``prepare``) read 0.0 until the next ask phase measures
+        them. No jax calls happen here (callback deadlock hazard)."""
+        out = np.empty(len(genomes), np.float32)
+        for i, k in enumerate(self._decode_keys(np.asarray(genomes))):
+            if k is None:
+                out[i] = -1.0
+            elif k in self.measured:
+                out[i] = self.measured[k]["gens_per_sec"]
+            else:
+                out[i] = 0.0
+        return out
+
+    # ------------------------------------------------------------- verdict
+
+    def winner(self) -> Tuple[tuple, dict]:
+        """The recorded plan under the never-regress rule: the fastest
+        measured plan if it beats its same-wave default measurement by
+        more than the drift floor, else the default plan. Ties break on
+        a total order (prefer default, then the smaller plan string) so
+        the verdict is deterministic."""
+        if self.default_key not in self.measured:
+            self._measure_wave([])
+        best = max(
+            self.measured.items(),
+            key=lambda kv: (
+                kv[1]["gens_per_sec"],
+                kv[0] == self.default_key,
+                str(kv[0]),
+            ),
+        )
+        key, rec = best
+        if key != self.default_key:
+            baseline = rec.get("default_gens_per_sec") or (
+                self.measured[self.default_key]["gens_per_sec"]
+            )
+            floor = baseline * (1.0 - self.settings.drift_floor)
+            if rec["gens_per_sec"] <= floor:
+                key, rec = self.default_key, self.measured[
+                    self.default_key
+                ]
+        return key, rec
+
+
+def _meta_objective(oracle: MeasurementOracle):
+    """The meta-GA's objective: a whole-population (``.rows``) form
+    calling back into the oracle's MEMO (``lookup_host`` — pure numpy,
+    never jax; the measurements themselves happen in the ask phase,
+    ``oracle.prepare``, between generations). The engine's evaluate
+    path uses ``rows`` directly, so one callback scores the whole
+    population."""
+    import jax
+    import jax.numpy as jnp
+
+    def rows(genomes):
+        return jax.pure_callback(
+            oracle.lookup_host,
+            jax.ShapeDtypeStruct((genomes.shape[0],), jnp.float32),
+            genomes,
+        )
+
+    def obj(genome):
+        return rows(genome[None, :])[0]
+
+    obj.rows = rows
+    return obj
+
+
+def autotune(
+    pop: int,
+    genome_len: int,
+    *,
+    objective="onemax",
+    gene_dtype=None,
+    crossover_kind: str = "uniform",
+    mutate_kind: str = "point",
+    settings: Optional[TunerSettings] = None,
+    use_pallas: Optional[bool] = None,
+    db_path: Optional[str] = None,
+    events=None,
+) -> _db.TuningEntry:
+    """Tune the kernel config for one signature and (optionally) persist
+    the result.
+
+    Runs the library's own PGA over the engine-appliable knob space
+    (``space.TUNER_KNOBS``) with the measurement oracle above, applies
+    the never-regress rule, and returns the :class:`~libpga_tpu.tuning.db.TuningEntry`.
+    With ``db_path`` the entry is MERGED into the file at that path
+    (existing entries for other keys survive; a better existing entry
+    for the same key survives too — merge order) and written
+    atomically.
+    """
+    import jax.numpy as jnp
+
+    from libpga_tpu.config import PGAConfig
+    from libpga_tpu.engine import PGA
+
+    settings = settings or TunerSettings()
+    if gene_dtype is None:
+        gene_dtype = jnp.float32
+    obj = objective
+    if isinstance(obj, str):
+        from libpga_tpu import objectives
+
+        obj = objectives.get(obj)
+    ctx = _space.SpaceContext(
+        pop=pop, genome_len=genome_len, gene_dtype=gene_dtype,
+        crossover_kind=crossover_kind, mutate_kind=mutate_kind,
+    )
+    oracle = MeasurementOracle(
+        ctx, obj, settings, use_pallas=use_pallas,
+    )
+    admissible = _space.grid(ctx, _space.TUNER_KNOBS)
+    distinct_plans = {
+        _plan_key(ctx, cfg, oracle.pallas_live) for cfg in admissible
+    }
+    distinct_plans.add(oracle.default_key)
+    budget_eff = min(settings.budget, len(distinct_plans))
+
+    t0 = time.perf_counter()
+    # The meta-GA: the library tuning itself. Small population of
+    # genome-width gene vectors in [0,1); XLA path (a 16-row population
+    # has no business in the fused kernel); generous mutation so a
+    # 3-gene genome keeps exploring.
+    meta = PGA(
+        seed=settings.seed,
+        config=PGAConfig(
+            use_pallas=False,
+            mutation_rate=0.3,
+            seed=settings.seed,
+        ),
+    )
+    meta.set_objective(_meta_objective(oracle))
+    # The engine's reference-parity floor is 4 genes per genome; pad
+    # the knob genome with inert genes (config_from_genes decodes only
+    # the first genome_width positions).
+    handle = meta.create_population(
+        settings.ga_population,
+        max(4, _space.genome_width(_space.TUNER_KNOBS)),
+    )
+    gens = 0
+    while (
+        len(oracle.measured) < budget_eff
+        and gens < settings.max_generations
+    ):
+        # Ask/measure/tell: measure the current population's new plans
+        # on the host, THEN step the meta-GA one generation — its
+        # traced objective reads the memo (children bred this step are
+        # measured at the top of the next iteration, before selection
+        # ever uses their scores).
+        oracle.prepare(np.asarray(meta.population(handle).genomes))
+        meta.run(1)
+        gens += 1
+
+    key, rec = oracle.winner()
+    knobs = _canonical_knobs(key)
+    plan = {"path": key[0]}
+    if key[0] == "pallas":
+        plan.update(
+            deme_size=key[1], demes_per_step=key[2], layout=key[3],
+            subblock=key[4],
+        )
+    entry = _db.TuningEntry(
+        key=_db.current_key(
+            pop, genome_len, gene_dtype, obj, crossover_kind,
+            mutate_kind,
+        ),
+        knobs=knobs,
+        plan=plan,
+        gens_per_sec=float(rec["gens_per_sec"]),
+        default_gens_per_sec=float(
+            rec.get("default_gens_per_sec")
+            or oracle.measured[oracle.default_key]["gens_per_sec"]
+        ),
+        rel_ci=rec.get("rel_ci"),
+        samples=int(rec.get("samples") or 0),
+        evaluated=len(oracle.measured),
+        space_size=len(admissible),
+        budget=settings.budget,
+        seed=settings.seed,
+        created=_db.entry_created_now(),
+        note=(
+            "never-regress: default kept"
+            if key == oracle.default_key else ""
+        ),
+    )
+    if events is not None:
+        events.emit(
+            "tuned_config", population_size=pop, genome_len=genome_len,
+            knobs={k: v for k, v in knobs.items()},
+            gens_per_sec=entry.gens_per_sec,
+            evaluated=entry.evaluated,
+        )
+    if db_path:
+        merged, _ = _db.merge_files([db_path])
+        merged.add(entry)
+        merged.save(db_path)
+    return entry
+
+
+__all__ = [
+    "DEFAULT_DRIFT_FLOOR",
+    "TunerSettings",
+    "MeasurementOracle",
+    "autotune",
+]

@@ -93,19 +93,50 @@ def best_ms_per_unit(
 class InterleavedMedians(dict):
     """``{runner: median}`` plus the sample accounting a decision-grade
     median must state: ``.n[runner]`` = samples the median rests on,
-    ``.dropped[runner]`` = degenerate (NaN) samples excluded. Plain-dict
-    compatible, so existing callers are unaffected."""
+    ``.dropped[runner]`` = degenerate (NaN) samples excluded,
+    ``.rel_ci[runner]`` = the relative spread proxy (half-IQR over
+    median) of the kept samples, ``.rounds`` = interleaved rounds
+    actually executed (>= the requested count under the
+    repeat-until-confidence mode). Plain-dict compatible, so existing
+    callers are unaffected."""
 
     def __init__(self):
         super().__init__()
         self.n: Dict[str, int] = {}
         self.dropped: Dict[str, int] = {}
+        self.rel_ci: Dict[str, float] = {}
+        self.rounds: int = 0
+
+
+def _median(kept) -> float:
+    mid = len(kept) // 2
+    return (
+        kept[mid] if len(kept) % 2 else 0.5 * (kept[mid - 1] + kept[mid])
+    )
+
+
+def _rel_ci(kept) -> float:
+    """Relative confidence proxy of a kept-sample list: half the
+    interquartile range over the median (a robust coefficient of
+    spread). ``inf`` below 2 samples (one sample carries no spread
+    information — the repeat mode must keep going), 0.0 for identical
+    samples."""
+    if len(kept) < 2:
+        return float("inf")
+    med = _median(kept)
+    if med == 0:
+        return 0.0 if kept[0] == kept[-1] else float("inf")
+    q1 = kept[max(0, (len(kept) - 1) // 4)]
+    q3 = kept[min(len(kept) - 1, (3 * (len(kept) - 1) + 3) // 4)]
+    return abs(0.5 * (q3 - q1) / med)
 
 
 def interleaved_medians(
     runners: Dict[str, Callable[[int], None]],
     rounds: int = 5,
     sample: Optional[Callable[[Callable], float]] = None,
+    min_rel_ci: Optional[float] = None,
+    max_rounds: Optional[int] = None,
 ) -> "InterleavedMedians":
     """Per-runner MEDIAN of ``sample`` over ``rounds`` interleaved
     rounds with a fixed per-round ordering.
@@ -120,34 +151,66 @@ def interleaved_medians(
     runner's surviving/excluded sample counts, and any drop emits a
     warning (a median over 2 of 5 rounds is a much weaker claim than
     the number alone suggests; silently shrinking n hid that).
+
+    **Repeat-until-confidence** (the autotuner oracle's mode,
+    ISSUE 10): with ``min_rel_ci`` set, after the initial ``rounds``
+    the protocol keeps appending FULL interleaved rounds until every
+    runner's relative spread proxy (half-IQR / median of its kept
+    samples, ``.rel_ci``) is at or under ``min_rel_ci`` — bounded by
+    ``max_rounds`` total rounds (default ``3 * rounds``), so a noisy
+    host terminates with an honest wide CI instead of looping forever.
+    Interaction with the ``.n``/``.dropped`` accounting: both count
+    over ALL executed rounds (``.rounds`` of them), so ``n + dropped ==
+    rounds_executed`` per runner — extension rounds tighten the median
+    AND grow the stated n, never silently. A runner whose samples are
+    all degenerate keeps ``rel_ci = inf`` and stops extending only at
+    ``max_rounds``.
     """
     import warnings
 
     if sample is None:
         sample = best_ms_per_unit
+    if min_rel_ci is not None and min_rel_ci < 0:
+        raise ValueError("min_rel_ci must be >= 0")
+    if max_rounds is None:
+        max_rounds = rounds if min_rel_ci is None else 3 * rounds
+    if max_rounds < rounds:
+        raise ValueError("max_rounds must be >= rounds")
     samples: Dict[str, list] = {name: [] for name in runners}
-    for _ in range(rounds):
+
+    def one_round():
         for name, run in runners.items():
             samples[name].append(sample(run))
+
+    def kept(name):
+        return sorted(x for x in samples[name] if x == x)
+
+    done = 0
+    for _ in range(rounds):
+        one_round()
+        done += 1
+    if min_rel_ci is not None:
+        while done < max_rounds and any(
+            _rel_ci(kept(name)) > min_rel_ci for name in runners
+        ):
+            one_round()
+            done += 1
+
     out = InterleavedMedians()
+    out.rounds = done
     for name, xs in samples.items():
-        kept = sorted(x for x in xs if x == x)
-        out.n[name] = len(kept)
-        out.dropped[name] = len(xs) - len(kept)
+        k = kept(name)
+        out.n[name] = len(k)
+        out.dropped[name] = len(xs) - len(k)
+        out.rel_ci[name] = _rel_ci(k)
         if out.dropped[name]:
             warnings.warn(
                 f"interleaved_medians: runner {name!r} median rests on "
-                f"n={len(kept)} of {len(xs)} rounds "
+                f"n={len(k)} of {len(xs)} rounds "
                 f"({out.dropped[name]} degenerate sample(s) dropped)",
                 stacklevel=2,
             )
-        if not kept:
-            out[name] = float("nan")
-            continue
-        mid = len(kept) // 2
-        out[name] = (
-            kept[mid] if len(kept) % 2 else 0.5 * (kept[mid - 1] + kept[mid])
-        )
+        out[name] = _median(k) if k else float("nan")
     return out
 
 

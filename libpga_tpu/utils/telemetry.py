@@ -119,6 +119,11 @@ EVENT_FIELDS: Dict[str, tuple] = {
     "trace_span": ("span", "t0", "t1"),
     "fleet_ticket_done": ("trace_id", "e2e_ms"),
     "straggler_alert": ("worker", "p95_ms", "fleet_p95_ms"),
+    # Self-tuning kernels (ISSUE 10): one record per (shape, resolved
+    # knobs) naming the tuning-DB resolution a kernel selection or a
+    # serving warm-up applied — the provenance trail of "which config
+    # did this signature actually compile".
+    "tuned_config": ("population_size", "genome_len", "knobs"),
 }
 
 

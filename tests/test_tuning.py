@@ -1,0 +1,463 @@
+"""Self-tuning kernels (ISSUE 10): config space, tuning DB, resolution
+precedence, autotuner determinism, serving cache-key inclusion."""
+
+import json
+import os
+import threading
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from libpga_tpu import PGA, PGAConfig
+from libpga_tpu.tuning import db as tdb
+from libpga_tpu.tuning import space
+from libpga_tpu.tuning import set_tuning_db
+
+
+@pytest.fixture(autouse=True)
+def _clean_tuning_state():
+    yield
+    set_tuning_db(None)
+
+
+def _entry(pop=256, length=16, knobs=None, gps=5.0, created=1.0,
+           objective="onemax"):
+    return tdb.TuningEntry(
+        key=tdb.current_key(pop, length, jnp.float32, objective),
+        knobs=knobs or {"pallas_deme_size": 256, "pallas_layout": None,
+                        "pallas_subblock": None},
+        gens_per_sec=gps, created=created,
+    )
+
+
+def _db_file(tmp_path, name, *entries):
+    d = tdb.TuningDB()
+    for e in entries:
+        d.add(e)
+    path = str(tmp_path / name)
+    d.save(path)
+    return path
+
+
+# ------------------------------------------------------------ config space
+
+
+class TestSpace:
+    def test_zero_genome_is_default_config(self):
+        cfg = space.config_from_genes([0.0, 0.0, 0.0])
+        assert cfg == space.KernelConfig()
+        assert all(
+            space.DOMAINS[k][0] is None or k == "dimension_semantics"
+            for k in space.DOMAINS
+        )
+
+    def test_codec_roundtrip_every_index(self):
+        import itertools
+
+        knobs = space.TUNER_KNOBS
+        sizes = [len(space.DOMAINS[k]) for k in knobs]
+        for idx in itertools.product(*[range(s) for s in sizes]):
+            cfg = space.config_from_indices(idx, knobs)
+            assert space.indices_from_config(cfg, knobs) == tuple(idx)
+
+    def test_gene_decode_clips_out_of_range(self):
+        cfg = space.config_from_genes([5.0, -1.0, 0.999])
+        assert cfg.deme_size == space.DOMAINS["deme_size"][-1]
+        assert cfg.layout is None
+        assert cfg.subblock == space.DOMAINS["subblock"][-1]
+
+    def test_invalid_deme_rejected_before_compile(self):
+        ctx = space.SpaceContext(1024, 32)
+        bad = space.KernelConfig(deme_size=300)
+        reason = space.why_inadmissible(ctx, bad)
+        assert reason and "power of two" in reason
+
+    def test_non_dividing_deme_rejected_strict(self):
+        ctx = space.SpaceContext(1000, 32)
+        reason = space.why_inadmissible(
+            ctx, space.KernelConfig(deme_size=512)
+        )
+        assert reason and "divide" in reason
+
+    def test_subblock_requires_pingpong(self):
+        ctx = space.SpaceContext(1 << 16, 64)
+        reason = space.why_inadmissible(
+            ctx, space.KernelConfig(layout="riffle", subblock=2)
+        )
+        assert reason and "ping-pong" in reason
+
+    def test_pingpong_gate_reason_names_the_gate(self):
+        # A shape where the explicit ping-pong mixing gate fails: tiny
+        # pop at max deme size leaves too few chunks per group.
+        ctx = space.SpaceContext(256, 16)
+        reason = space.why_inadmissible(
+            ctx, space.KernelConfig(deme_size=256, layout="pingpong",
+                                    subblock=4)
+        )
+        assert reason is not None
+
+    def test_grid_matches_factory_resolution(self):
+        """Every admissible (K, D) the grid yields builds EXACTLY as
+        asked — the sweep tools' old build-and-check loop, now a
+        guarantee of the space."""
+        from libpga_tpu.ops.pallas_step import make_pallas_breed
+        from libpga_tpu.objectives import onemax
+
+        ctx = space.SpaceContext(1 << 14, 32)
+        cfgs = space.grid(
+            ctx, ("deme_size", "demes_per_step"),
+            deme_size=(128, 256, 512), demes_per_step=(1, 2, 4),
+            layout=("riffle",),
+        )
+        assert cfgs, "grid admitted nothing at a healthy shape"
+        for cfg in cfgs:
+            b = make_pallas_breed(
+                1 << 14, 32, deme_size=cfg.deme_size,
+                fused_obj=onemax.kernel_rowwise,
+                _demes_per_step=cfg.demes_per_step, _layout="riffle",
+            )
+            assert b is not None
+            assert (b.K, b.D) == (cfg.deme_size, cfg.demes_per_step)
+
+    def test_space_size_counts_admissible(self):
+        ctx = space.SpaceContext(2048, 64)
+        assert space.space_size(ctx) == len(
+            space.grid(ctx, space.TUNER_KNOBS)
+        )
+
+
+# -------------------------------------------------------------- tuning DB
+
+
+class TestTuningDB:
+    def test_roundtrip(self, tmp_path):
+        e = _entry()
+        path = _db_file(tmp_path, "t.json", e)
+        loaded = tdb.TuningDB.load(path)
+        assert loaded.lookup(e.key).knobs == e.knobs
+
+    def test_schema_version_refusal(self, tmp_path):
+        path = str(tmp_path / "future.json")
+        with open(path, "w") as fh:
+            json.dump({"schema_version": 99, "entries": {}}, fh)
+        with pytest.raises(tdb.TuningSchemaError):
+            tdb.TuningDB.load(path)
+        # merge REFUSES loudly too — never skip a parseable future DB.
+        with pytest.raises(tdb.TuningSchemaError):
+            tdb.merge_files([path])
+
+    def test_torn_file_load_raises_naming_path(self, tmp_path):
+        path = str(tmp_path / "torn.json")
+        with open(path, "w") as fh:
+            fh.write('{"schema_version": 1, "entries": {"x"')
+        with pytest.raises(tdb.TuningDBError) as exc:
+            tdb.TuningDB.load(path)
+        assert "torn" in str(exc.value)
+
+    def test_merge_skips_and_reports_torn(self, tmp_path):
+        good = _db_file(tmp_path, "good.json", _entry())
+        torn = str(tmp_path / "torn.json")
+        with open(torn, "w") as fh:
+            fh.write('{"schema_version": 1, "entr')
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            merged, skipped = tdb.merge_files([good, torn])
+        assert len(merged) == 1
+        assert skipped == [torn]
+        assert any("skipped 1 torn" in str(x.message) for x in w)
+
+    def test_merge_missing_file_is_silent(self, tmp_path):
+        good = _db_file(tmp_path, "good.json", _entry())
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            merged, skipped = tdb.merge_files(
+                [good, str(tmp_path / "absent.json")]
+            )
+        assert len(merged) == 1 and skipped == []
+        assert not w
+
+    def test_merge_associative_and_commutative(self):
+        # Same key, three conflicting entries; plus disjoint keys.
+        a = tdb.TuningDB()
+        a.add(_entry(gps=5.0, created=1.0))
+        b = tdb.TuningDB()
+        b.add(_entry(gps=9.0, created=2.0))
+        b.add(_entry(pop=512, gps=1.0))
+        c = tdb.TuningDB()
+        c.add(_entry(gps=9.0, created=3.0))  # tie on gps → created
+        left = a.merge(b).merge(c)
+        right = a.merge(b.merge(c))
+        swapped = c.merge(a).merge(b)
+        for m in (right, swapped):
+            assert {
+                k: e.as_dict() for k, e in left.entries.items()
+            } == {k: e.as_dict() for k, e in m.entries.items()}
+        winner = left.lookup(_entry().key)
+        assert winner.gens_per_sec == 9.0 and winner.created == 3.0
+
+    def test_atomic_write_under_concurrent_reader(self, tmp_path):
+        path = str(tmp_path / "live.json")
+        tdb.TuningDB().save(path)
+        stop = threading.Event()
+        errors = []
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                d = tdb.TuningDB()
+                d.add(_entry(gps=float(i), created=float(i)))
+                d.save(path)
+                i += 1
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        try:
+            for _ in range(200):
+                try:
+                    tdb.TuningDB.load(path)  # must never see a prefix
+                except tdb.TuningDBError as exc:
+                    errors.append(exc)
+                    break
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert not errors, f"reader observed a torn database: {errors}"
+
+    def test_unknown_knob_rejected(self):
+        with pytest.raises(tdb.TuningDBError):
+            tdb.TuningEntry(
+                key=tdb.current_key(8, 8, jnp.float32, "onemax"),
+                knobs={"pallas_bogus": 1},
+            )
+
+
+# ------------------------------------------------------------- resolution
+
+
+class TestResolution:
+    def test_precedence_user_beats_db_beats_default(self):
+        entry = _entry(knobs={
+            "pallas_deme_size": 256, "pallas_layout": "riffle",
+            "pallas_subblock": None,
+        })
+        cfg = PGAConfig(pallas_deme_size=512)  # explicit user knob
+        knobs, prov = tdb.resolve_config_knobs(cfg, entry)
+        assert knobs["pallas_deme_size"] == 512
+        assert prov["pallas_deme_size"] == "user"
+        assert knobs["pallas_layout"] == "riffle"
+        assert prov["pallas_layout"] == "db"
+        assert knobs["pallas_subblock"] is None
+        assert prov["pallas_subblock"] == "default"
+
+    def test_no_entry_is_provenance_free(self):
+        knobs, prov = tdb.resolve_config_knobs(PGAConfig(), None)
+        assert prov is None
+        assert all(v is None for v in knobs.values())
+
+    def test_engine_resolution_and_event(self, tmp_path):
+        from libpga_tpu.utils import telemetry
+        from libpga_tpu.utils.telemetry import TelemetryConfig
+
+        path = _db_file(tmp_path, "t.json", _entry())
+        set_tuning_db(path)
+        events = str(tmp_path / "events.jsonl")
+        pga = PGA(seed=0, config=PGAConfig(
+            use_pallas=False,
+            telemetry=TelemetryConfig(history_gens=0, events_path=events),
+        ))
+        pga.set_objective("onemax")
+        pga.create_population(256, 16)
+        deme, layout, subblock, prov = pga._resolved_pallas_knobs(256, 16)
+        assert deme == 256 and prov["pallas_deme_size"] == "db"
+        pga.run(2)
+        records = telemetry.validate_log(events)
+        tuned = [r for r in records if r["event"] == "tuned_config"]
+        assert tuned and tuned[0]["knobs"]["pallas_deme_size"] == 256
+        # once per (shape, knobs), not per run
+        pga._resolved_pallas_knobs(256, 16)
+        pga.run(2)
+        records = telemetry.validate_log(events)
+        assert len([
+            r for r in records if r["event"] == "tuned_config"
+        ]) == 1
+
+    def test_db_none_is_byte_identical(self, tmp_path):
+        """db=None lowers the EXACT StableHLO of a matched all-default
+        entry: the resolution layer is host-side only."""
+        def lowered():
+            pga = PGA(seed=0, config=PGAConfig(use_pallas=False))
+            pga.set_objective("onemax")
+            pga.create_population(128, 16)
+            fn, _ = pga._compiled_run_meta(128, 16)
+            k = jax.eval_shape(lambda: jax.random.key(0))
+            return fn.lower(
+                jax.ShapeDtypeStruct((128, 16), jnp.float32),
+                jax.ShapeDtypeStruct(k.shape, k.dtype),
+                jax.ShapeDtypeStruct((), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.float32),
+                jax.ShapeDtypeStruct((1, 2), jnp.float32),
+            ).as_text()
+
+        default_entry = _entry(pop=128, knobs={
+            "pallas_deme_size": None, "pallas_layout": None,
+            "pallas_subblock": None,
+        })
+        path = _db_file(tmp_path, "d.json", default_entry)
+        set_tuning_db(path)
+        with_db = lowered()
+        set_tuning_db(None)
+        without_db = lowered()
+        assert with_db == without_db
+
+    def test_env_var_transport(self, tmp_path, monkeypatch):
+        """PGA_TUNING_DB — the fleet-worker transport — installs the DB
+        lazily on first active_db() when nothing was set explicitly."""
+        path = _db_file(tmp_path, "env.json", _entry())
+        set_tuning_db(None)
+        monkeypatch.setenv(tdb.ENV_VAR, path)
+        tdb._ACTIVE.update(env_checked=False, db=None, path=None)
+        db = tdb.active_db()
+        assert db is not None and len(db) == 1
+        assert tdb.active_path() == os.path.abspath(path)
+
+    def test_fleet_config_carries_tuning_db(self):
+        from libpga_tpu.config import FleetConfig
+
+        assert FleetConfig(tuning_db="/x/t.json").tuning_db == "/x/t.json"
+
+
+# ----------------------------------------------------- serving cache keys
+
+
+class TestServingCacheKey:
+    def test_tuned_signature_never_collides_with_untuned(self, tmp_path):
+        from libpga_tpu.serving import BatchedRuns, RunRequest
+
+        req = RunRequest(size=256, genome_len=16, n=2, seed=0)
+        untuned_ex = BatchedRuns(
+            "onemax", config=PGAConfig(use_pallas=False)
+        )
+        sig_untuned = untuned_ex.signature(req)
+        path = _db_file(tmp_path, "t.json", _entry())
+        set_tuning_db(path)
+        tuned_ex = BatchedRuns(
+            "onemax", config=PGAConfig(use_pallas=False)
+        )
+        sig_tuned = tuned_ex.signature(req)
+        assert sig_tuned != sig_untuned
+        assert ("tuned", None) in sig_untuned
+        tail = dict([sig_tuned[-1]])["tuned"]
+        assert ("pallas_deme_size", 256) in tail
+
+    def test_warmup_records_provenance_and_event(self, tmp_path):
+        from libpga_tpu.serving import BatchedRuns, RunRequest
+        from libpga_tpu.serving import cache as scache
+        from libpga_tpu.utils import telemetry
+
+        path = _db_file(tmp_path, "t.json", _entry())
+        set_tuning_db(path)
+        events = str(tmp_path / "ev.jsonl")
+        log = telemetry.EventLog(events)
+        ex = BatchedRuns(
+            "onemax", config=PGAConfig(use_pallas=False), events=log,
+        )
+        res = ex.run([RunRequest(size=256, genome_len=16, n=2, seed=0)])
+        [r.block() for r in res]
+        log.close()
+        stats = scache.PROGRAM_CACHE.stats()
+        mine = [
+            t for t in stats.get("tuned", [])
+            if t["population_size"] == 256 and t["genome_len"] == 16
+            and t["db"] == os.path.abspath(path)
+        ]
+        assert mine and mine[0]["knobs"]["pallas_deme_size"] == 256
+        assert mine[0]["provenance"]["pallas_deme_size"] == "db"
+        records = telemetry.validate_log(events)
+        assert any(r["event"] == "tuned_config" for r in records)
+
+
+# ---------------------------------------------------------------- tuner
+
+
+class TestTuner:
+    def _settings(self):
+        from libpga_tpu.tuning.tuner import TunerSettings
+
+        return TunerSettings(
+            budget=3, seed=11, ga_population=8, max_generations=3,
+            rounds=2, min_rel_ci=0.5, max_rounds=3,
+            measure_lo=2, measure_hi=5, measure_tries=1,
+        )
+
+    def test_autotune_deterministic_and_never_regresses(self, tmp_path):
+        from libpga_tpu.tuning.tuner import autotune
+
+        path = str(tmp_path / "t.json")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            e1 = autotune(256, 16, objective="onemax",
+                          settings=self._settings(), db_path=path)
+            e2 = autotune(256, 16, objective="onemax",
+                          settings=self._settings(), db_path=path)
+        assert e1.knobs == e2.knobs and e1.plan == e2.plan
+        # CPU: one XLA plan → the default is recorded, by construction
+        # never regressing it.
+        assert e1.plan["path"] == "xla"
+        assert e1.gens_per_sec >= e1.default_gens_per_sec * (1 - 0.04)
+        loaded = tdb.TuningDB.load(path)
+        assert loaded.lookup(e1.key).knobs == e1.knobs
+
+    def test_compile_failure_scores_worst_not_crash(self):
+        from libpga_tpu.tuning.tuner import (
+            MeasurementOracle, TunerSettings,
+        )
+
+        ctx = space.SpaceContext(256, 16)
+        oracle = MeasurementOracle(
+            ctx, "onemax", self._settings(), use_pallas=None,
+        )
+
+        def boom(knobs):
+            raise RuntimeError("injected build failure")
+
+        oracle._make_runner = boom
+        oracle._measure_wave([])
+        rec = oracle.measured[oracle.default_key]
+        assert rec["gens_per_sec"] == 0.0
+        assert "injected build failure" in rec["error"]
+
+    def test_oracle_rejects_inadmissible_without_compiling(self):
+        from libpga_tpu.tuning.tuner import MeasurementOracle
+
+        ctx = space.SpaceContext(256, 16)
+        oracle = MeasurementOracle(
+            ctx, "onemax", self._settings(), use_pallas=None,
+        )
+        # riffle + subblock is inadmissible (strict): fitness -1
+        # without a measurement.
+        row = np.zeros(4, np.float32)
+        row[1] = 0.5   # layout -> "riffle"
+        row[2] = 0.5   # subblock -> 2
+        out = oracle.lookup_host(row[None, :])
+        assert out[0] == -1.0
+        assert not oracle.measured
+
+    def test_capi_bridge_roundtrip(self, tmp_path):
+        from libpga_tpu import capi_bridge as cb
+
+        path = str(tmp_path / "t.json")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            measured = cb.autotune(256, 16, "onemax", 2, path, 0)
+        assert measured >= 1 and os.path.exists(path)
+        assert cb.set_tuning_db(path) == 0
+        assert tdb.active_path() == os.path.abspath(path)
+        with pytest.raises(Exception):
+            cb.set_tuning_db(str(tmp_path / "missing.json"))
+        # failed install leaves the previous DB active
+        assert tdb.active_path() == os.path.abspath(path)
+        assert cb.set_tuning_db("") == 0
+        assert tdb.active_db() is None

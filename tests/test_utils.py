@@ -118,6 +118,54 @@ def test_interleaved_medians_counts_dropped_samples():
     assert any("n=2 of 3" in str(x.message) for x in w)
 
 
+def test_interleaved_medians_repeat_until_confidence():
+    """min_rel_ci extends the interleave with FULL rounds until every
+    runner's half-IQR/median is at or under the target, bounded by
+    max_rounds; .n/.dropped count over ALL executed rounds and .rel_ci
+    states the achieved confidence (ISSUE 10 satellite)."""
+    # Runner "noisy" needs extra rounds to tighten; "tight" is
+    # constant from the start.
+    seqs = {
+        "noisy": iter([10.0, 20.0, 15.0, 15.1, 15.0, 15.0, 15.0, 15.0]),
+        "tight": iter([5.0] * 8),
+    }
+    med = profiling.interleaved_medians(
+        {"noisy": "noisy", "tight": "tight"}, rounds=2,
+        min_rel_ci=0.05, max_rounds=8,
+        sample=lambda name: next(seqs[name]),
+    )
+    assert med.rounds > 2, "confidence mode never extended"
+    assert med.rel_ci["noisy"] <= 0.05
+    assert med.rel_ci["tight"] == 0.0
+    assert med.n["noisy"] == med.rounds and med.dropped["noisy"] == 0
+    assert med["tight"] == 5.0
+
+
+def test_interleaved_medians_max_rounds_bounds_noise():
+    """A runner that never converges stops at max_rounds with an
+    honest wide rel_ci instead of looping forever."""
+    import itertools
+
+    flip = itertools.cycle([1.0, 100.0])
+    med = profiling.interleaved_medians(
+        {"wild": "wild"}, rounds=2, min_rel_ci=0.01, max_rounds=5,
+        sample=lambda name: next(flip),
+    )
+    assert med.rounds == 5
+    assert med.rel_ci["wild"] > 0.01
+    assert med.n["wild"] == 5
+
+
+def test_interleaved_medians_default_mode_unchanged():
+    """Without min_rel_ci the protocol is exactly the old one: the
+    requested rounds, no extension (max_rounds defaults to rounds)."""
+    seq = iter([1.0, 2.0, 3.0])
+    med = profiling.interleaved_medians(
+        {"a": "a"}, rounds=3, sample=lambda name: next(seq),
+    )
+    assert med.rounds == 3 and med["a"] == 2.0
+
+
 def test_auto_checkpointer_saves_and_resumes(tmp_path):
     path = str(tmp_path / "state.npz")
     pga, handle = _solver(seed=7)

@@ -338,6 +338,19 @@ def main() -> None:
     if D is None:
         D = 4 if dt == jnp.bfloat16 else 8
 
+    # Admissibility comes from the SINGLE config-space source
+    # (tuning/space.py) — an inadmissible --k/--d aborts here with the
+    # gate's reason, before any kernel compiles (this tool used to
+    # discover it mid-run from factory asserts).
+    from libpga_tpu.tuning import space as _space
+
+    ctx = _space.SpaceContext(pop, L, dt)
+    reason = _space.why_inadmissible(ctx, _space.KernelConfig(
+        deme_size=K, demes_per_step=D, layout="riffle",
+    ))
+    if reason:
+        raise SystemExit(f"inadmissible --k {K} --d {D}: {reason}")
+
     mk = lambda name, **kw: build_variant(name, dt, K, D, pop, L, **kw)
 
     def mk_pp(name, **kw):
@@ -384,18 +397,30 @@ def main() -> None:
     G = -(-pop // K)
     dsweep_ms, a_ms, b_ms = {}, None, None
     if args.dsweep:
+        # The admissible D values at this K come from the config space
+        # (one source with sweep_kernel.py and the autotuner) — the old
+        # build-and-check loop compiled kernels just to discover that a
+        # point rounds away.
+        d_values = [
+            c.demes_per_step
+            for c in _space.grid(
+                ctx, ("demes_per_step",),
+                deme_size=(K,), layout=("riffle",),
+                demes_per_step=(1, 2, 4, 8, 16, 32),
+            )
+        ]
         dr = {}
-        for d in (1, 2, 4, 8, 16, 32):
-            # interpret_ok skips the exact-(K, D) assert: an
-            # inadmissible D rounds down in the factory and the sweep
-            # just drops that point instead of crashing.
+        for d in d_values:
             v = build_variant(
                 f"copy_riffle_d{d}", dt, K, d, pop, L, ablate=COPY,
                 fused=False, interpret_ok=True,
             )
-            if v is not None and v.breed.K == K and v.breed.D == d:
-                v(3)
-                dr[d] = v
+            assert v is not None and v.breed.K == K and v.breed.D == d, (
+                f"space admitted D={d} at K={K} but the factory "
+                "resolved differently"
+            )
+            v(3)
+            dr[d] = v
         sw = measure_interleaved(
             {f"d{d}": r for d, r in dr.items()}, args.rounds
         )

@@ -1,0 +1,134 @@
+"""Evolutionary kernel autotuner CLI (ROADMAP item 4, ISSUE 10).
+
+Runs the library's own GA over the fused-kernel config space
+(``libpga_tpu/tuning``) for one shape signature and merges the winning
+configuration into a persistent tuning database that the engine and
+the serving AOT warm-up consult at kernel selection. A chip round
+becomes::
+
+    python tools/autotune.py --shape 1048576x100 --dtype f32 \
+        --budget 16 --db tuning.json --seed 0
+    git add tuning.json            # commit the round's verdicts
+
+    # every subsequent run / serving fleet:
+    PGA_TUNING_DB=tuning.json python serve.py ...
+
+``--dry-run`` prints the admissible space size (and the distinct
+compiled-plan count) without measuring anything. Guarantees (see
+tuning/tuner.py): measured interleaved against the default config with
+repeat-until-confidence, compile-failure scores worst instead of
+crashing, and the recorded entry NEVER regresses the default by more
+than the drift floor. On a CPU backend every config resolves to the
+one XLA plan, so the produced database is deterministic for a fixed
+seed/budget — the CI smoke (tools/autotune_smoke.py) pins that.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_shape(s: str):
+    try:
+        pop, length = s.lower().split("x")
+        return int(pop), int(length)
+    except Exception:
+        raise argparse.ArgumentTypeError(
+            f"--shape wants POPxLEN (e.g. 1048576x100), got {s!r}"
+        )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="evolutionary kernel autotuner"
+    )
+    ap.add_argument("--shape", type=parse_shape, required=True,
+                    help="POPxLEN, e.g. 1048576x100")
+    ap.add_argument("--dtype", choices=["f32", "bf16"], default="f32")
+    ap.add_argument("--objective", default="onemax",
+                    help="builtin objective name (tools surface; the "
+                    "Python API takes any objective)")
+    ap.add_argument("--budget", type=int, default=16,
+                    help="distinct kernel configurations to measure")
+    ap.add_argument("--db", default=None,
+                    help="tuning database path (merged + written "
+                    "atomically; omit to print the entry only)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--rounds", type=int, default=3,
+                    help="initial interleaved measurement rounds")
+    ap.add_argument("--min-rel-ci", type=float, default=0.05,
+                    dest="min_rel_ci",
+                    help="repeat-until-confidence target (half-IQR / "
+                    "median) for the oracle's medians")
+    ap.add_argument("--max-rounds", type=int, default=9,
+                    dest="max_rounds")
+    ap.add_argument("--ga-pop", type=int, default=16, dest="ga_pop")
+    ap.add_argument("--max-generations", type=int, default=32,
+                    dest="max_generations")
+    ap.add_argument("--measure-lo", type=int, default=3,
+                    dest="measure_lo")
+    ap.add_argument("--measure-hi", type=int, default=9,
+                    dest="measure_hi")
+    ap.add_argument("--drift-floor", type=float, default=None,
+                    dest="drift_floor",
+                    help="never-regress margin (default: the tuner's "
+                    "measured-host default)")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print the admissible space size and exit")
+    args = ap.parse_args(argv)
+
+    import jax.numpy as jnp
+
+    from libpga_tpu.tuning import space, tuner
+
+    pop, length = args.shape
+    dt = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+    ctx = space.SpaceContext(pop, length, dt)
+
+    if args.dry_run:
+        cfgs = space.grid(ctx, space.TUNER_KNOBS)
+        import jax
+
+        from libpga_tpu.config import PGAConfig
+        from libpga_tpu.tuning.tuner import _plan_key
+
+        pallas_live = (
+            PGAConfig(gene_dtype=dt).pallas_enabled()
+            and jax.default_backend() == "tpu"
+        )
+        plans = {_plan_key(ctx, c, pallas_live) for c in cfgs}
+        print(json.dumps({
+            "shape": f"{pop}x{length}", "dtype": args.dtype,
+            "admissible_configs": len(cfgs),
+            "distinct_plans": len(plans),
+            "pallas_live": pallas_live,
+            "knobs": list(space.TUNER_KNOBS),
+        }))
+        return 0
+
+    kw = dict(
+        budget=args.budget, seed=args.seed, ga_population=args.ga_pop,
+        max_generations=args.max_generations, rounds=args.rounds,
+        min_rel_ci=args.min_rel_ci, max_rounds=args.max_rounds,
+        measure_lo=args.measure_lo, measure_hi=args.measure_hi,
+    )
+    if args.drift_floor is not None:
+        kw["drift_floor"] = args.drift_floor
+    settings = tuner.TunerSettings(**kw)
+    entry = tuner.autotune(
+        pop, length, objective=args.objective, gene_dtype=dt,
+        settings=settings, db_path=args.db,
+    )
+    out = entry.as_dict()
+    out["db"] = os.path.abspath(args.db) if args.db else None
+    print(json.dumps(out, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,176 @@
+"""CI smoke for the self-tuning kernel loop (ISSUE 10, ci.sh stage 11).
+
+Tier-1-safe (CPU, tiny shapes). Gates, in order:
+
+1. ``tools/autotune.py`` on a tiny CPU space produces a DB file that
+   schema-validates, and — with the same seed and budget — a second
+   run resolves the IDENTICAL knobs (the determinism acceptance: on a
+   CPU backend every config memoizes to the one XLA plan, so the
+   verdict cannot wobble with timing noise);
+2. the never-regress rule holds: the recorded config's measured
+   gens/sec is >= the default's measurement minus the drift floor (on
+   CPU they are the same memoized measurement — equal by
+   construction);
+3. a WARM SERVING RUN under the produced DB compiles exactly the
+   DB-resolved config: the bucket's program is built under a cache key
+   carrying the resolved knobs, ``cache.stats()["tuned"]`` records the
+   provenance (every knob either "db" or unchanged default), and a
+   schema-valid ``tuned_config`` event is emitted at warm-up;
+4. ``tuning.set_tuning_db(None)`` (db=None) leaves the engine's
+   traced run program BYTE-IDENTICAL to the tuned-but-default case —
+   the resolution layer is host-side only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+POP, LEN = 512, 32
+
+
+def run_autotune(db_path: str, seed: int = 7) -> dict:
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    cmd = [
+        sys.executable, "tools/autotune.py",
+        "--shape", f"{POP}x{LEN}", "--dtype", "f32",
+        "--budget", "4", "--seed", str(seed), "--db", db_path,
+        "--rounds", "2", "--max-rounds", "3", "--min-rel-ci", "0.5",
+        "--ga-pop", "8", "--max-generations", "3",
+        "--measure-lo", "2", "--measure-hi", "5",
+    ]
+    proc = subprocess.run(cmd, capture_output=True, text=True, env=env)
+    if proc.returncode != 0:
+        sys.exit(f"autotune failed:\n{proc.stdout}\n{proc.stderr}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="pga-autotune-smoke-")
+    db_path = os.path.join(tmp, "tuning.json")
+
+    # -- 1: CLI produces a schema-valid DB; deterministic verdict -----
+    first = run_autotune(db_path, seed=7)
+    assert os.path.exists(db_path), "autotune produced no DB file"
+    from libpga_tpu.tuning import db as tdb
+
+    loaded = tdb.TuningDB.load(db_path)  # schema-validates or raises
+    assert len(loaded) == 1, f"expected 1 entry, got {len(loaded)}"
+    second = run_autotune(db_path, seed=7)
+    if first["knobs"] != second["knobs"] or first["plan"] != second["plan"]:
+        sys.exit(
+            "autotune verdict not deterministic at fixed seed/budget: "
+            f"{first['knobs']}/{first['plan']} vs "
+            f"{second['knobs']}/{second['plan']}"
+        )
+    entry = next(iter(loaded.entries.values()))
+
+    # -- 2: never-regress --------------------------------------------
+    floor = entry.default_gens_per_sec * (1.0 - 0.04)
+    if entry.gens_per_sec < floor:
+        sys.exit(
+            f"recorded config regresses the default: "
+            f"{entry.gens_per_sec} < {entry.default_gens_per_sec} - floor"
+        )
+
+    # -- 3: warm serving run compiles the DB-resolved config ---------
+    events_path = os.path.join(tmp, "events.jsonl")
+    from libpga_tpu import PGAConfig
+    from libpga_tpu import tuning
+    from libpga_tpu.serving import BatchedRuns, RunQueue, RunRequest
+    from libpga_tpu.serving import cache as scache
+    from libpga_tpu.utils import telemetry
+
+    tuning.set_tuning_db(db_path)
+    log = telemetry.EventLog(events_path)
+    ex = BatchedRuns(
+        "onemax", config=PGAConfig(use_pallas=False), events=log,
+    )
+    from libpga_tpu.config import ServingConfig
+
+    q = RunQueue(
+        ex, serving=ServingConfig(max_batch=2, max_wait_ms=0),
+        events=log,
+    )
+    tickets = [
+        q.submit(RunRequest(size=POP, genome_len=LEN, n=2, seed=i))
+        for i in range(2)
+    ]
+    q.drain()
+    for t in tickets:
+        t.result(timeout=300)
+    q.close()
+    log.close()
+
+    stats = scache.PROGRAM_CACHE.stats()
+    tuned = stats.get("tuned") or []
+    mine = [
+        t for t in tuned
+        if t["population_size"] == POP and t["genome_len"] == LEN
+    ]
+    if not mine:
+        sys.exit(
+            f"warm serving run recorded no tuned provenance: {stats}"
+        )
+    for t in mine:
+        if t["knobs"] != entry.knobs:
+            sys.exit(
+                "serving warm-up compiled knobs != DB entry: "
+                f"{t['knobs']} vs {entry.knobs}"
+            )
+        if os.path.abspath(t["db"] or "") != os.path.abspath(db_path):
+            sys.exit(f"provenance names wrong DB: {t['db']}")
+    records = telemetry.validate_log(events_path)
+    kinds = [r["event"] for r in records]
+    if "tuned_config" not in kinds:
+        sys.exit(f"no tuned_config event at warm-up (got {sorted(set(kinds))})")
+
+    # -- 4: db=None is byte-identical --------------------------------
+    import jax
+
+    from libpga_tpu import PGA
+
+    def lowered_text():
+        pga = PGA(seed=0, config=PGAConfig(use_pallas=False))
+        pga.set_objective("onemax")
+        pga.create_population(POP, LEN)
+        fn, _ = pga._compiled_run_meta(POP, LEN)
+        import jax.numpy as jnp
+
+        g = jax.ShapeDtypeStruct((POP, LEN), jnp.float32)
+        k = jax.eval_shape(lambda: jax.random.key(0))
+        args = (
+            g, jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct((), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.float32),
+            jax.ShapeDtypeStruct((1, 2), jnp.float32),
+        )
+        return fn.lower(*args).as_text()
+
+    with_db = lowered_text()
+    tuning.set_tuning_db(None)
+    without_db = lowered_text()
+    if with_db != without_db:
+        sys.exit(
+            "db=None changed the traced program (tuning must be "
+            "host-side only)"
+        )
+
+    print(
+        "autotune smoke OK: deterministic DB "
+        f"(knobs {entry.knobs}, plan {entry.plan['path']}), "
+        f"never-regress holds ({entry.gens_per_sec:.1f} vs default "
+        f"{entry.default_gens_per_sec:.1f} gens/sec), warm serving "
+        f"compiled the DB-resolved config ({len(mine)} tuned "
+        "program(s) in cache), db=None byte-identical"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

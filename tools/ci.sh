@@ -62,6 +62,16 @@
 #                                 flight dump, and the per-worker
 #                                 Prometheus expositions pass
 #                                 tools/metrics_dump.py --check.
+#  11. autotune smoke            — tools/autotune_smoke.py (ISSUE 10):
+#                                 tools/autotune.py on a tiny CPU
+#                                 space deterministically produces a
+#                                 schema-valid tuning DB, the recorded
+#                                 config never regresses the default,
+#                                 a warm serving run compiles exactly
+#                                 the DB-resolved config (cache
+#                                 provenance + tuned_config event),
+#                                 and db=None leaves the traced run
+#                                 program byte-identical.
 # Exits nonzero on the first failing stage.
 set -e
 cd "$(dirname "$0")/.."
@@ -395,5 +405,8 @@ print(
     "dead-fleet fleet_top rendered"
 )
 PY
+
+echo "== ci: autotune smoke =="
+JAX_PLATFORMS=cpu python tools/autotune_smoke.py
 
 echo "== ci: all stages passed =="

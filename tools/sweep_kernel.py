@@ -52,22 +52,37 @@ def best_gps(fn, lo=30, hi=90, tries=3):
 def main():
     assert jax.default_backend() == "tpu", "sweep needs the real chip"
     quick = "--quick" in sys.argv
+    # The (dtype, K, D) grid comes from the SINGLE config-space source
+    # (tuning/space.py): inadmissible and silently-rounding points are
+    # rejected there, before anything compiles — this tool no longer
+    # hand-rolls the grid or rediscovers admissibility by building.
+    from libpga_tpu.tuning import space
+
     combos = []
     for dt in (jnp.float32, jnp.bfloat16):
-        for K in (128, 256, 512, 1024):
-            for D in (1, 2, 4, 8):
-                combos.append((dt, K, D))
+        ctx = space.SpaceContext(POP, L, dt)
+        for cfg in space.grid(
+            ctx,
+            ("deme_size", "demes_per_step"),
+            deme_size=(128, 256, 512, 1024),
+            demes_per_step=(1, 2, 4, 8),
+            # Riffle pinned: the ping-pong mixing gate admits only some
+            # (K, D) points, which would silently mix layouts across
+            # the sweep; the layout A/B lives in tools/ablate_floor.py.
+            layout=("riffle",),
+        ):
+            combos.append((dt, cfg.deme_size, cfg.demes_per_step))
     for dt, K, D in combos:
         breed = make_pallas_breed(
             POP, L, deme_size=K, fused_obj=onemax.kernel_rowwise,
             gene_dtype=dt, _demes_per_step=D,
-            # Riffle pinned: the ping-pong mixing gate admits only some
-            # (K, D) points, which would silently mix layouts across
-            # the sweep; the layout A/B lives in tools/ablate_floor.py.
             _layout="riffle",
         )
-        if breed is None or breed.K != K or breed.D != D:
-            continue  # combination rounded away; skip duplicates
+        assert breed is not None and breed.K == K and breed.D == D, (
+            "space.grid admitted a config the factory resolves "
+            f"differently: K={K} D={D} -> "
+            f"{None if breed is None else (breed.K, breed.D)}"
+        )
         gp = jax.random.uniform(jax.random.key(1), (breed.Pp, breed.Lp)).astype(dt)
         sp = jnp.sum(gp[:, :L].astype(jnp.float32), axis=1)
         loop = make_loop(breed)
