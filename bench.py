@@ -1043,6 +1043,113 @@ def autotuned_arm(rounds: int = ROUNDS) -> dict:
     }
 
 
+# GP arm (ISSUE 11): a symbolic-regression workload over postfix tree
+# genomes — GP_POP programs of up to GP_NODES tokens scored against a
+# GP_SAMPLES-point dataset every generation by the fused stack-machine
+# interpreter (gp/interpreter.py on CPU; the Pallas VMEM-stack kernel
+# on chips). Interleaved against (a) an identical GP engine with a
+# trivial vector objective, isolating the EVALUATOR's share of a
+# generation, and (b) a same-shape vector-genome OneMax engine, the
+# cross-representation baseline.
+GP_POP = 1024
+GP_NODES = 16
+GP_SAMPLES = 64
+
+
+def gp_arm(rounds: int = ROUNDS) -> dict:
+    """``--gp``: the tree-GP symbolic-regression arm."""
+    import jax
+    import jax.numpy as jnp
+
+    from libpga_tpu import PGA, PGAConfig
+    from libpga_tpu.gp import encoding as _genc
+    from libpga_tpu.gp import operators as _gpo
+    from libpga_tpu.gp.sr import make_dataset, symbolic_regression
+
+    gp = _genc.GPConfig(max_nodes=GP_NODES, n_vars=2)
+    X, y = make_dataset(
+        lambda a, b: a * b + a, n_samples=GP_SAMPLES, n_vars=2, seed=0
+    )
+
+    def gp_engine(objective):
+        pga = PGA(seed=0, config=PGAConfig(
+            use_pallas=False, selection="truncation", elitism=2,
+        ))
+        pga.set_objective(objective)
+        pga.set_crossover(_gpo.make_subtree_crossover(gp))
+        pga.set_mutate(_gpo.make_gp_mutate(gp))
+        pga.install_population(
+            _genc.random_population(jax.random.key(0), GP_POP, gp)
+        )
+
+        def run(n):
+            pga.run(n)
+
+        run.pga = pga
+        return run
+
+    def vector_engine():
+        pga = PGA(seed=0, config=PGAConfig(
+            use_pallas=False, selection="truncation", elitism=2,
+        ))
+        pga.create_population(GP_POP, gp.genome_len)
+        pga.set_objective("onemax")
+
+        def run(n):
+            pga.run(n)
+
+        run.pga = pga
+        return run
+
+    runners = [
+        ("gp_sr", gp_engine(symbolic_regression(X, y, gp=gp))),
+        # Same breeding, trivial objective: the adjacent pair isolates
+        # the stack-machine evaluator's share of a generation.
+        ("gp_cheap", gp_engine(lambda g: jnp.sum(g))),
+        ("vector", vector_engine()),
+    ]
+    for _, r in runners:
+        r(3)  # compile + warm outside the timed samples
+    samples = {name: [] for name, _ in runners}
+    ratios, overheads = [], []
+    for _ in range(rounds):
+        for name, r in runners:
+            samples[name].append(_sample_gps(r, 5, 15))
+        ratios.append(samples["gp_sr"][-1] / samples["vector"][-1])
+        overheads.append(
+            (1.0 / samples["gp_sr"][-1] - 1.0 / samples["gp_cheap"][-1])
+            / (1.0 / samples["gp_sr"][-1]) * 100.0
+        )
+    sr_med = _median_iqr(samples["gp_sr"])
+    cheap_med = _median_iqr(samples["gp_cheap"])
+    vec_med = _median_iqr(samples["vector"])
+    ratio_med, ratio_iqr = _median_iqr(ratios)
+    ov_med, ov_iqr = _median_iqr(overheads)
+    return {
+        "gp_gens_per_sec": round(sr_med[0], 2),
+        "gp_gens_per_sec_median": round(sr_med[0], 2),
+        "gp_gens_per_sec_iqr": round(sr_med[1], 2),
+        "gp_cheap_obj_gens_per_sec_median": round(cheap_med[0], 2),
+        "gp_vector_gens_per_sec_median": round(vec_med[0], 2),
+        "gp_vs_vector_ratio_median": round(ratio_med, 4),
+        "gp_vs_vector_ratio_iqr": round(ratio_iqr, 4),
+        "gp_eval_overhead_pct_median": round(ov_med, 2),
+        "gp_eval_overhead_pct_iqr": round(ov_iqr, 2),
+        "gp_shape": f"{GP_POP}x{GP_NODES}nodes",
+        "gp_samples": GP_SAMPLES,
+        "gp_note": (
+            f"symbolic regression over {GP_POP} postfix programs of up "
+            f"to {GP_NODES} tokens, {GP_SAMPLES}-sample -RMSE fitness; "
+            "per-round ratios from ADJACENT interleaved samples. "
+            "gp_eval_overhead_pct = the stack-machine evaluator's share "
+            "of a generation (gp_sr vs identical breeding with a "
+            "trivial objective); gp_vs_vector = same-shape OneMax "
+            "vector-genome engine. CPU backend: the XLA interpreter "
+            "path — the fused VMEM-stack kernel's figure needs a chip."
+        ),
+    }
+
+
 def single_derived(gene_dtype, gps) -> dict:
     """Roofline-relative figures for the single-population result."""
     import jax.numpy as jnp
@@ -1177,6 +1284,7 @@ def main() -> None:
     out.update(sharded_arm())
     out.update(fleet_arm())
     out.update(autotuned_arm())
+    out.update(gp_arm())
     print(json.dumps(out))
 
 
@@ -1232,6 +1340,20 @@ def autotuned_main() -> None:
     print(json.dumps(out))
 
 
+def gp_main() -> None:
+    """``python bench.py --gp``: the tree-GP symbolic-regression arm
+    alone (ISSUE 11) — CPU-decision-grade for the interpreter path and
+    the evaluator-share model; the fused-kernel figure needs a chip
+    (see gp_note on the artifact)."""
+    cache_dir = enable_persistent_cache()
+    out = {
+        **provenance(cache_dir),
+        "metric": f"gp_gens_per_sec_{GP_POP}x{GP_NODES}nodes",
+        **gp_arm(),
+    }
+    print(json.dumps(out))
+
+
 def sharded_main() -> None:
     """``python bench.py --pop-shards [S]``: the population-sharding
     arm alone (ISSUE 7). On CPU hosts the multi-device platform is
@@ -1269,6 +1391,8 @@ if __name__ == "__main__":
         fleet_main()
     elif "--autotuned" in sys.argv[1:]:
         autotuned_main()
+    elif "--gp" in sys.argv[1:]:
+        gp_main()
     elif "--pop-shards" in sys.argv[1:]:
         sharded_main()
     else:

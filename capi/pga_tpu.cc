@@ -454,6 +454,43 @@ int pga_autotune(unsigned size, unsigned genome_len,
         db_path, seed));
 }
 
+int pga_gp_config(pga_t *p, unsigned max_nodes, unsigned n_vars,
+                  float mutation_rate) {
+    if (!p) return -1;
+    return static_cast<int>(call_long(
+        "gp_config", "(lIIf)", solver_of(p), max_nodes, n_vars,
+        static_cast<double>(mutation_rate)));
+}
+
+population_t *pga_gp_create_population(pga_t *p, unsigned size) {
+    if (!p) return nullptr;
+    long idx = call_long("gp_create_population", "(lI)", solver_of(p),
+                         size);
+    return idx < 0 ? nullptr
+                   : pack_pop<population_t *>(solver_of(p), idx);
+}
+
+int pga_set_objective_sr(pga_t *p, const float *X, const float *y,
+                         unsigned n_samples) {
+    if (!p || !X || !y || !n_samples) return -1;
+    /* n_vars comes from the installed GP encoding on the bridge side;
+     * the X buffer length is validated there against it. The byte
+     * count here trusts the caller's n_samples times the encoding's
+     * n_vars — read it back from the bridge first. */
+    long n_vars = call_long("gp_n_vars", "(l)", solver_of(p));
+    if (n_vars <= 0) return -1;
+    return static_cast<int>(call_long(
+        "set_objective_sr", "(ly#y#I)", solver_of(p),
+        reinterpret_cast<const char *>(X),
+        static_cast<Py_ssize_t>(static_cast<size_t>(n_samples) *
+                                static_cast<size_t>(n_vars) *
+                                sizeof(float)),
+        reinterpret_cast<const char *>(y),
+        static_cast<Py_ssize_t>(static_cast<size_t>(n_samples) *
+                                sizeof(float)),
+        n_samples));
+}
+
 int pga_set_telemetry(pga_t *p, unsigned max_gens) {
     if (!p) return -1;
     return static_cast<int>(

@@ -477,6 +477,44 @@ int pga_autotune(unsigned size, unsigned genome_len,
                  const char *objective, unsigned budget,
                  const char *db_path, long seed);
 
+/* ---- Genetic programming (ISSUE 11) -----------------------------------
+ *
+ * Tree GP on the ordinary gene-vector populations: programs are
+ * bounded POSTFIX token sequences, two genes per token (opcode +
+ * operand), genome_len = 2 * max_nodes. Evaluation is a fused stack
+ * machine (VMEM-scratch Pallas kernel on TPU, XLA interpreter
+ * elsewhere); breeding is size-fair subtree crossover plus chained
+ * subtree/point mutation — both provably preserve postfix
+ * well-formedness, so every population stays decodable.
+ *
+ * pga_gp_config switches a solver to GP breeding: installs the
+ * encoding (max_nodes tokens over n_vars input variables with the
+ * default constant/function tables), the subtree crossover, and the
+ * standard mutation (mutation_rate drives the subtree half; pass a
+ * negative rate for the default 0.4). Validation precedes any state
+ * change — on error (-1) the solver's operators and any previous GP
+ * config are untouched. Call BEFORE creating GP populations.
+ *
+ * pga_gp_create_population creates a population of size
+ * strictly-well-formed random programs under the installed encoding
+ * (ramped-length grow init) — use this instead of
+ * pga_create_population for GP solvers (plain RANDOM_POPULATION noise
+ * still evaluates — the interpreter is total — but starts from
+ * degenerate programs). Returns NULL without pga_gp_config.
+ *
+ * pga_set_objective_sr installs a symbolic-regression objective over
+ * an (n_samples, n_vars) float32 dataset X (row-major) and target
+ * vector y: fitness is -RMSE of each genome's decoded program over
+ * the batch (higher is better; 0 = exact fit, the natural pga_run
+ * target). Requires pga_gp_config first (the encoding fixes n_vars);
+ * all validation precedes installation, so -1 leaves the previously
+ * installed objective intact. */
+int pga_gp_config(pga_t *p, unsigned max_nodes, unsigned n_vars,
+                  float mutation_rate);
+population_t *pga_gp_create_population(pga_t *p, unsigned size);
+int pga_set_objective_sr(pga_t *p, const float *X, const float *y,
+                         unsigned n_samples);
+
 #ifdef __cplusplus
 }
 #endif

@@ -256,6 +256,60 @@ int main(void) {
             return fprintf(stderr, "pga_set_tuning_db(NULL) failed\n"), 1;
     }
 
+    /* Genetic programming (ISSUE 11): switch a solver to tree-GP
+     * breeding, install a symbolic-regression objective over a tiny
+     * dataset, run, and check the error surfaces leave installed
+     * state intact (the round-15 pattern). Exact recovery and
+     * bit-determinism are proven by tools/gp_smoke.py. */
+    {
+        enum { NS = 16, NV = 2, NODES = 8 };
+        float X[NS * NV], Y[NS];
+        for (int i = 0; i < NS; i++) {
+            float a = -1.0f + 2.0f * (float)i / (NS - 1);
+            float b = 1.0f - 2.0f * (float)i / (NS - 1);
+            X[i * NV] = a;
+            X[i * NV + 1] = b;
+            Y[i] = a * a + b;
+        }
+        pga_t *gps = pga_init(123);
+        if (!gps) return fprintf(stderr, "gp solver init failed\n"), 1;
+        /* Error surface: SR objective before gp_config must fail. */
+        if (pga_set_objective_sr(gps, X, Y, NS) != -1)
+            return fprintf(stderr, "sr-before-gp_config not rejected\n"), 1;
+        /* Error surface: a degenerate encoding must fail... */
+        if (pga_gp_config(gps, 1, NV, -1.0f) != -1)
+            return fprintf(stderr, "max_nodes=1 not rejected\n"), 1;
+        if (pga_gp_create_population(gps, 64) != NULL)
+            return fprintf(stderr,
+                           "gp population without gp_config not rejected\n"),
+                   1;
+        /* ...and leave nothing half-installed: the real config works. */
+        if (pga_gp_config(gps, NODES, NV, -1.0f) != 0)
+            return fprintf(stderr, "pga_gp_config failed\n"), 1;
+        population_t *gpop = pga_gp_create_population(gps, 64);
+        if (!gpop)
+            return fprintf(stderr, "pga_gp_create_population failed\n"), 1;
+        if (pga_set_objective_sr(gps, X, Y, NS) != 0)
+            return fprintf(stderr, "pga_set_objective_sr failed\n"), 1;
+        /* Error surface: a bad sample count must fail WITHOUT
+         * disturbing the installed objective... */
+        if (pga_set_objective_sr(gps, X, Y, 0) != -1)
+            return fprintf(stderr, "n_samples=0 not rejected\n"), 1;
+        /* ...proven by running: fitness is -RMSE, so best in [-inf, 0]
+         * and finite for a bred population of well-formed programs. */
+        if (pga_run_n(gps, 5) != 5)
+            return fprintf(stderr, "gp pga_run failed\n"), 1;
+        gene *gbest = pga_get_best(gps, gpop);
+        if (!gbest) return fprintf(stderr, "gp get_best failed\n"), 1;
+        for (unsigned j = 0; j < 2 * NODES; j++)
+            if (!(gbest[j] >= 0.0f && gbest[j] < 1.0f))
+                return fprintf(stderr, "gp best gene %u = %g out of [0,1)\n",
+                               j, gbest[j]),
+                       1;
+        free(gbest);
+        pga_deinit(gps);
+    }
+
     for (int i = 0; i < NSOLVERS; i++) pga_deinit(solvers[i]);
     pga_deinit(ref);
     printf("PASS\n");

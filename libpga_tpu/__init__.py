@@ -23,7 +23,13 @@ Design stance (TPU-first, not a port):
   — is a ``lax.ppermute`` ring neighbor-exchange over ICI.
 """
 
-from libpga_tpu.config import FleetConfig, PGAConfig, ServingConfig, SLOConfig
+from libpga_tpu.config import (
+    FleetConfig,
+    GPConfig,
+    PGAConfig,
+    ServingConfig,
+    SLOConfig,
+)
 from libpga_tpu.population import Population
 from libpga_tpu.engine import PGA
 from libpga_tpu.utils.telemetry import TelemetryConfig
@@ -31,6 +37,7 @@ from libpga_tpu import ops
 from libpga_tpu import objectives
 from libpga_tpu import parallel
 from libpga_tpu import robustness
+from libpga_tpu import gp
 from libpga_tpu.api import (
     pga_init,
     pga_deinit,
@@ -63,6 +70,7 @@ __version__ = "0.1.0"
 __all__ = [
     "PGA",
     "PGAConfig",
+    "GPConfig",
     "ServingConfig",
     "SLOConfig",
     "FleetConfig",
@@ -71,6 +79,7 @@ __all__ = [
     "objectives",
     "parallel",
     "robustness",
+    "gp",
     # C-shaped parity API
     "pga_init",
     "pga_deinit",

@@ -192,6 +192,7 @@ def deinit(handle: int) -> None:
     _host_ops.pop(handle, None)
     _expr_consts.pop(handle, None)
     _serving_execs.pop(handle, None)
+    _gp_cfgs.pop(handle, None)
 
 
 def create_population(handle: int, size: int, genome_len: int, ptype: int) -> int:
@@ -388,6 +389,105 @@ def set_objective_tsp_coords(
         arr.reshape(n_cities, 2).copy(),
         duplicate_penalty=10_000.0 if penalty < 0 else float(penalty),
         duplicate_mode="genes" if genes_mode else "pairs",
+    )
+    pga.set_objective(obj)
+    _set_host_op(handle, "obj", False)
+
+
+#: Per-solver GP encoding installed by ``pga_gp_config`` — the context
+#: ``pga_set_objective_sr`` builds its objective against.
+_gp_cfgs: Dict[int, object] = {}
+
+
+def gp_config(
+    handle: int, max_nodes: int, n_vars: int, mutation_rate: float
+) -> None:
+    """``pga_gp_config``: switch a solver to tree-GP breeding (ISSUE
+    11). Installs the postfix encoding (default constant/function
+    tables), size-fair subtree crossover, and the standard chained
+    subtree+point mutation (``mutation_rate`` drives the subtree half;
+    negative = the operator default). Populations created AFTER this
+    call with ``genome_len == 2 * max_nodes`` are initialized as
+    well-formed random programs. Validation runs BEFORE any state
+    changes — an invalid encoding leaves the solver's operators and
+    any previous GP config intact (the round-15 error-surface
+    pattern)."""
+    from libpga_tpu.gp.encoding import GPConfig
+    from libpga_tpu.gp.operators import (
+        make_gp_mutate,
+        make_subtree_crossover,
+    )
+
+    pga = _solver(handle)  # validate the handle first
+    gp = GPConfig(max_nodes=int(max_nodes), n_vars=int(n_vars))
+    rate = 0.4 if mutation_rate < 0 else float(mutation_rate)
+    if not (0.0 <= rate <= 1.0):
+        raise ValueError(f"mutation_rate {rate} not in [0, 1]")
+    pga.set_crossover(make_subtree_crossover(gp))
+    pga.set_mutate(make_gp_mutate(gp, subtree_rate=rate))
+    _gp_cfgs[handle] = gp
+    _set_host_op(handle, "cross", False)
+    _set_host_op(handle, "mut", False)
+
+
+def gp_create_population(handle: int, size: int) -> int:
+    """``pga_gp_create_population``: a population of STRICTLY
+    WELL-FORMED random postfix programs under the solver's installed
+    GP encoding (``pga_gp_config`` first) — the GP analog of
+    ``pga_create_population``'s RANDOM_POPULATION init. Returns the
+    population index."""
+    from libpga_tpu.gp.encoding import random_population
+
+    pga = _solver(handle)
+    gp = _gp_cfgs.get(handle)
+    if gp is None:
+        raise ValueError(
+            "pga_gp_create_population requires pga_gp_config first"
+        )
+    h = pga.install_population(
+        random_population(pga.next_key(), int(size), gp)
+    )
+    return h.index
+
+
+def gp_n_vars(handle: int) -> int:
+    """Input-variable count of the solver's installed GP encoding, or
+    -1 — how the C shim sizes the ``pga_set_objective_sr`` X buffer
+    before marshaling it."""
+    gp = _gp_cfgs.get(handle)
+    return -1 if gp is None else int(gp.n_vars)
+
+
+def set_objective_sr(
+    handle: int, xdata: bytes, ydata: bytes, n_samples: int
+) -> None:
+    """``pga_set_objective_sr``: install a symbolic-regression
+    objective over an ``(n_samples, n_vars)`` float32 dataset
+    (``gp/sr.symbolic_regression`` — fitness is -RMSE, higher better,
+    evaluated by the fused stack machine on TPU and the XLA
+    interpreter elsewhere). Requires ``pga_gp_config`` first (the
+    encoding gives ``n_vars``); all validation precedes any state
+    change, so an error leaves the previously installed objective
+    intact."""
+    from libpga_tpu.gp.sr import symbolic_regression
+
+    pga = _solver(handle)
+    gp = _gp_cfgs.get(handle)
+    if gp is None:
+        raise ValueError("pga_set_objective_sr requires pga_gp_config first")
+    X = np.frombuffer(xdata, dtype=np.float32)
+    y = np.frombuffer(ydata, dtype=np.float32)
+    if n_samples <= 0 or X.size != n_samples * gp.n_vars:
+        raise ValueError(
+            f"X carries {X.size} floats; expected {n_samples} x "
+            f"{gp.n_vars}"
+        )
+    if y.size != n_samples:
+        raise ValueError(
+            f"y carries {y.size} floats; expected {n_samples}"
+        )
+    obj = symbolic_regression(
+        X.reshape(n_samples, gp.n_vars).copy(), y.copy(), gp=gp
     )
     pga.set_objective(obj)
     _set_host_op(handle, "obj", False)

@@ -17,6 +17,13 @@ import jax.numpy as jnp
 
 from libpga_tpu.utils.telemetry import TelemetryConfig
 
+# The GP encoding config is part of the library's runtime-config
+# surface (a solver's GP search space is configuration, exactly like
+# its serving or fleet settings) but lives with the encoding it
+# describes — re-exported here so ``from libpga_tpu.config import
+# GPConfig`` works like every other *Config.
+from libpga_tpu.gp.encoding import GPConfig  # noqa: F401
+
 
 @dataclasses.dataclass(frozen=True)
 class PGAConfig:

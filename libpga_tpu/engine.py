@@ -217,6 +217,28 @@ class PGA:
         self._history.append(None)
         return PopulationHandle(len(self._populations) - 1)
 
+    def install_population(self, genomes) -> PopulationHandle:
+        """Install an EXPLICIT genome matrix as a new population (scores
+        read ``-inf`` until the first evaluation, the
+        ``swap_generations`` stance). The init path for representations
+        whose valid genomes are not uniform noise — e.g. postfix GP
+        programs (``gp.random_population``), warm starts, transfer
+        seeding. Does not consume PRNG state."""
+        genomes = jnp.asarray(genomes, dtype=self.config.gene_dtype)
+        if genomes.ndim != 2:
+            raise ValueError(
+                f"install_population needs a (size, genome_len) matrix; "
+                f"got shape {genomes.shape}"
+            )
+        limit = self.config.max_populations
+        if limit is not None and len(self._populations) >= limit:
+            raise RuntimeError(f"max_populations={limit} reached")
+        scores = jnp.full((genomes.shape[0],), -jnp.inf, dtype=jnp.float32)
+        self._populations.append(Population(genomes=genomes, scores=scores))
+        self._staged.append(None)
+        self._history.append(None)
+        return PopulationHandle(len(self._populations) - 1)
+
     def population(self, handle: PopulationHandle) -> Population:
         return self._populations[handle.index]
 
@@ -268,6 +290,23 @@ class PGA:
         log = self._event_log()
         if log is not None:
             log.emit(event, **fields)
+
+    def _emit_gp_run(self, population_size: int) -> None:
+        """One ``gp_run`` record per run whose objective is a GP
+        objective family member (``gp/sr.py`` stamps ``gp_config``):
+        the encoding the run is evolving under — the observability
+        anchor for SR-as-a-service traffic (tools/gp_smoke.py gates
+        the schema)."""
+        gpc = getattr(self._objective, "gp_config", None)
+        if gpc is None:
+            return
+        self._emit(
+            "gp_run",
+            population_size=population_size,
+            max_nodes=gpc.max_nodes,
+            n_ops=gpc.n_ops,
+            n_vars=gpc.n_vars,
+        )
 
     def _check_stall_alert(self, hist: Optional[_tl.History]) -> None:
         t = self.config.telemetry
@@ -760,11 +799,15 @@ class PGA:
             return
         missing = [
             name
-            for name, kind in (
-                ("crossover", self._crossover_kind()),
-                ("mutation", self._mutate_kind()),
+            for name, kind, op in (
+                ("crossover", self._crossover_kind(), self._crossover),
+                ("mutation", self._mutate_kind(), self._mutate),
             )
-            if kind is None
+            # xla_only operators (the GP structural operators,
+            # gp/operators.py) are LEGITIMATELY kernel-less — their
+            # fused half is the evaluator, not the breed — so the
+            # "you forgot an in-kernel form" warning stays quiet.
+            if kind is None and not getattr(op, "xla_only", False)
         ]
         if not missing:
             return
@@ -964,6 +1007,7 @@ class PGA:
             genome_len=pop.genome_len, n=int(n),
             target=None if target is None else float(target),
         )
+        self._emit_gp_run(pop.size)
         # Fault-injection site "objective.eval" (robustness/faults):
         # kind "raise" propagates from here — BEFORE the key is consumed
         # or any buffer donated, so a supervised retry replays the exact
@@ -1174,6 +1218,7 @@ class PGA:
             target=None if target is None else float(target),
             pop_shards=fn.shards,
         )
+        self._emit_gp_run(pop.size)
         self._emit(
             "shard_sync", shards=fn.shards, topk=fn.k_sync,
             mix_rows=fn.mix,

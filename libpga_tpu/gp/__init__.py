@@ -1,0 +1,66 @@
+"""Tree-based genetic programming subsystem (ROADMAP item 1).
+
+Programs are linear postfix-encoded trees packed into the library's
+ordinary fixed-width gene vectors (``gp/encoding.py``), evaluated by a
+fused stack machine — an XLA interpreter everywhere
+(``gp/interpreter.py``), a Pallas VMEM-stack kernel on TPU
+(``ops/gp_eval.py``), a pure-numpy oracle behind both
+(``gp/reference.py``) — and bred by size-fair subtree crossover and
+subtree/point mutation on the existing operator protocol
+(``gp/operators.py``). The symbolic-regression objective family
+(``gp/sr.py``) closes the loop: dataset-resident ``-RMSE`` fitness
+with tuning-DB-resolved evaluator knobs.
+
+Submodules load lazily (PEP 562): importing :mod:`libpga_tpu` must not
+pay for GP, and a vector-genome engine's traced programs are
+byte-identical with this package imported or not (structural test,
+tests/test_gp.py). NOTE the round-11 lesson: the lazy getattr must
+never recurse through itself — attribute names are resolved through an
+explicit table only.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+_SUBMODULES = ("encoding", "interpreter", "operators", "reference", "sr")
+
+_LAZY_NAMES = {
+    # encoding
+    "GPConfig": "encoding",
+    "encode_program": "encoding",
+    "decode_expression": "encoding",
+    "is_well_formed": "encoding",
+    "random_population": "encoding",
+    "program_structure": "encoding",
+    "canonicalize": "encoding",
+    # operators
+    "make_subtree_crossover": "operators",
+    "make_subtree_mutate": "operators",
+    "make_gp_point_mutate": "operators",
+    "make_gp_mutate": "operators",
+    "CROSSOVER_KINDS": "operators",
+    "MUTATE_KINDS": "operators",
+    # sr
+    "symbolic_regression": "sr",
+    "make_dataset": "sr",
+    # reference
+    "reference_predict": "reference",
+    "reference_scores": "reference",
+}
+
+__all__ = sorted(set(_LAZY_NAMES) | set(_SUBMODULES))
+
+
+def __getattr__(name: str):
+    if name in _SUBMODULES:
+        return importlib.import_module(f"{__name__}.{name}")
+    target = _LAZY_NAMES.get(name)
+    if target is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    module = importlib.import_module(f"{__name__}.{target}")
+    return getattr(module, name)
+
+
+def __dir__():
+    return __all__

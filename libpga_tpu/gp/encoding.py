@@ -1,0 +1,521 @@
+"""Linear postfix tree encoding packed into fixed-width gene vectors.
+
+Tree-based genetic programming (ROADMAP item 1: population-level
+parallel tree GP, arxiv 2501.17168; TensorGP, arxiv 2103.07512) on the
+library's EXISTING genome contract: a program is a bounded sequence of
+``max_nodes`` postfix tokens, each token TWO genes of the ordinary
+``(P, L)`` float population matrix (``L = 2 * max_nodes``, genes in
+[0, 1) — the same domain every other workload uses, so checkpointing,
+``pop_shards``, islands, serving buckets, and the validation oracle all
+compose with zero special cases):
+
+- gene ``2t``   — the OPCODE: ``floor(g * n_ops)`` indexes the config's
+  opcode table (explicit arity per entry, below);
+- gene ``2t+1`` — the OPERAND: terminals decode it (``var`` →
+  ``floor(g * n_vars)`` input column, ``const`` → ``floor(g *
+  n_consts)`` row of the registered constant table); internal nodes
+  ignore it (a neutral mutation surface, like the reference TSP
+  drivers' unused gene tails).
+
+Opcode table layout (``op_table``): index 0 is always ``pad`` —
+tokens after the program's end — then ``var``, then ``const`` (present
+only when the constant table is non-empty), then the configured unary
+and binary function sets, in declaration order. Encoded opcode genes
+are CENTERED on their bucket (``(k + 0.5) / n_ops``) so float32
+round-trips exactly; the decode floors, so ANY gene value still maps
+to a token (the decode is total).
+
+**Well-formedness.** A genome is *strictly well-formed* when its
+non-pad tokens form one contiguous prefix, every one of them executes
+(stack depth ≥ arity at its position), and the final stack depth is
+exactly 1 — i.e. the token sequence IS the postfix traversal of one
+expression tree. Every genome the subsystem's own machinery produces
+is strictly well-formed *by construction*: random initialization grows
+programs under a feasibility invariant (:func:`random_program_genes`),
+and the GP operators (``gp/operators.py``) splice complete subtrees
+only. For ARBITRARY gene matrices (e.g. a plain ``create_population``
+random init arriving through the serving path) the evaluator and the
+operators first apply the SKIP RULE — a token whose arity exceeds the
+current stack depth is a no-op — which makes every decode a
+well-formed program (the executable subsequence) and every operator
+total; :func:`canonicalize` materializes that normalization (live
+tokens compacted front, pads stamped behind), and the pure-numpy
+reference interpreter (``gp/reference.py``) is the semantics oracle
+the fused evaluators are tested against.
+
+Subtree geometry is recovered in one forward scan
+(:func:`program_structure`): the same stack walk the interpreter runs,
+carrying the SUBTREE-START position of every stack slot — so the
+subtree ending at token ``i`` is exactly the gene slice ``[start[i],
+i]``, which is what size-fair crossover swaps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: Unary/binary function vocabulary. Protected forms keep every
+#: program total: div guards |b| < DIV_EPS -> 1.0, sqrt takes |x|,
+#: log takes log(|x| + LOG_EPS). One table — the numpy reference, the
+#: XLA interpreter, and the Pallas kernel all derive from it.
+UNARY_NAMES: Tuple[str, ...] = ("neg", "sin", "cos", "sqrt", "abs", "exp", "log")
+BINARY_NAMES: Tuple[str, ...] = ("add", "sub", "mul", "div", "min", "max")
+
+DIV_EPS = 1e-6
+LOG_EPS = 1e-9
+
+PAD_OP = 0  #: opcode index 0 is always the pad token
+
+
+@dataclasses.dataclass(frozen=True)
+class GPConfig:
+    """Encoding of one GP search space (re-exported by
+    ``libpga_tpu.config``).
+
+    Attributes:
+      max_nodes: token capacity per program; the genome length is
+        ``2 * max_nodes`` genes. Programs shorter than the cap carry
+        pad tokens behind their prefix.
+      n_vars: input-variable count (``x0 .. x{n_vars-1}`` — the
+        feature columns of a symbolic-regression dataset).
+      consts: indexed constant table terminals may reference. Empty
+        drops the ``const`` opcode entirely.
+      unary: enabled unary function names (subset of
+        :data:`UNARY_NAMES`). May be empty — random growth then
+        rounds target lengths to odd (binary trees over terminals
+        have odd token counts).
+      binary: enabled binary function names (subset of
+        :data:`BINARY_NAMES`).
+      min_nodes: ramped-init lower bound on program length.
+      stack_depth: explicit evaluator stack depth, or None = auto
+        (``max_nodes``, the provable worst case — a program of
+        ``max_nodes`` terminals). Explicit values below the bound are
+        rejected by the evaluator plan (``ops/gp_eval.gp_eval_plan``);
+        values above it are admissible and form the
+        ``gp_stack_depth`` tuning axis.
+      opcode_block: tokens interpreted per fused-loop iteration
+        (unroll factor), or None = auto (1). Must divide
+        ``max_nodes``; the ``gp_opcode_block`` tuning axis.
+
+    The gene dtype for GP populations is float32: bfloat16's ~0.004
+    resolution near 1.0 corrupts ``floor(g * n)`` opcode decodes, the
+    same reason order crossover is f32-only (``ops/pallas_step``).
+    """
+
+    max_nodes: int = 16
+    n_vars: int = 1
+    consts: Tuple[float, ...] = (0.5, 1.0, 2.0, 3.0, 5.0)
+    unary: Tuple[str, ...] = ("neg", "sin", "cos")
+    binary: Tuple[str, ...] = ("add", "sub", "mul", "div")
+    min_nodes: int = 1
+    stack_depth: Optional[int] = None
+    opcode_block: Optional[int] = None
+
+    def __post_init__(self):
+        if self.max_nodes < 2:
+            # genome_len = 2*max_nodes must satisfy the library's
+            # reference-parity floor of 4 genes.
+            raise ValueError("max_nodes must be >= 2")
+        if self.n_vars < 1:
+            raise ValueError("n_vars must be >= 1")
+        bad = sorted(set(self.unary) - set(UNARY_NAMES))
+        if bad:
+            raise ValueError(
+                f"unknown unary ops {bad}; available: {list(UNARY_NAMES)}"
+            )
+        bad = sorted(set(self.binary) - set(BINARY_NAMES))
+        if bad:
+            raise ValueError(
+                f"unknown binary ops {bad}; available: {list(BINARY_NAMES)}"
+            )
+        if not (1 <= self.min_nodes <= self.max_nodes):
+            raise ValueError("min_nodes must be in [1, max_nodes]")
+        if self.stack_depth is not None and self.stack_depth < 1:
+            raise ValueError("stack_depth must be >= 1 or None")
+        if self.opcode_block is not None and (
+            self.opcode_block < 1 or self.max_nodes % self.opcode_block
+        ):
+            raise ValueError(
+                f"opcode_block must divide max_nodes ({self.max_nodes})"
+            )
+
+    @property
+    def genome_len(self) -> int:
+        return 2 * self.max_nodes
+
+    def op_names(self) -> Tuple[str, ...]:
+        """The opcode table: pad, terminals, then functions."""
+        terms = ("pad", "var") + (("const",) if self.consts else ())
+        return terms + tuple(self.unary) + tuple(self.binary)
+
+    def op_arities(self) -> Tuple[int, ...]:
+        arity = {"pad": 0, "var": 0, "const": 0}
+        arity.update({n: 1 for n in self.unary})
+        arity.update({n: 2 for n in self.binary})
+        return tuple(arity[n] for n in self.op_names())
+
+    @property
+    def n_ops(self) -> int:
+        return len(self.op_names())
+
+    def op_index(self, name: str) -> int:
+        return self.op_names().index(name)
+
+    def opcode_gene(self, op: int) -> float:
+        """Bucket-centered gene value encoding opcode ``op``."""
+        return (op + 0.5) / self.n_ops
+
+    def operand_gene(self, idx: int, domain: int) -> float:
+        return (idx + 0.5) / max(domain, 1)
+
+    @property
+    def pad_gene(self) -> float:
+        return self.opcode_gene(PAD_OP)
+
+    def required_stack(self) -> int:
+        """The provable stack bound: a well-formed program of
+        ``max_nodes`` tokens can hold at most ``max_nodes`` pending
+        values (all-terminal sequences under the skip rule)."""
+        return self.max_nodes
+
+    def cache_key(self) -> tuple:
+        """Hashable identity of the encoding (operator/objective cache
+        keys and the serving bucket signature derive from it)."""
+        return (
+            "gp", self.max_nodes, self.n_vars, tuple(self.consts),
+            tuple(self.unary), tuple(self.binary), self.min_nodes,
+        )
+
+
+# ------------------------------------------------------------- decoding
+
+
+def decode_ops(genomes: jax.Array, gp: GPConfig) -> jax.Array:
+    """(P, max_nodes) int32 opcode matrix from the even gene columns.
+    Total: any float gene decodes (floored, clipped into the table)."""
+    opg = genomes[:, 0 :: 2].astype(jnp.float32)
+    return jnp.clip(
+        jnp.floor(opg * gp.n_ops).astype(jnp.int32), 0, gp.n_ops - 1
+    )
+
+
+def decode_args(genomes: jax.Array, gp: GPConfig) -> jax.Array:
+    """(P, max_nodes) float32 operand matrix (the odd gene columns)."""
+    return genomes[:, 1 :: 2].astype(jnp.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class Structure:
+    """Per-token program geometry under the skip rule (all ``(P, T)``
+    unless noted): ``live`` — the token executes; ``start`` — first
+    token of the subtree it completes (= its own index for dead
+    tokens); ``span`` — ``t - start + 1``; ``length`` ``(P,)`` — live
+    token count; ``final_depth`` ``(P,)`` — stack depth after the last
+    token (1 for strictly well-formed programs)."""
+
+    live: jax.Array
+    start: jax.Array
+    span: jax.Array
+    length: jax.Array
+    final_depth: jax.Array
+
+
+def program_structure(genomes: jax.Array, gp: GPConfig) -> Structure:
+    """One forward stack walk recovering subtree geometry.
+
+    The same scan the interpreter runs, but carrying subtree START
+    positions instead of values: executing a leaf pushes its own
+    index; executing an arity-``a`` function pushes the start of its
+    DEEPEST popped operand (the leftmost token of the completed
+    subtree). Pure XLA (the GP operators are XLA-path operators —
+    gathers are fine here, unlike in the Mosaic kernel).
+    """
+    P, L = genomes.shape
+    T = gp.max_nodes
+    if L != 2 * T:
+        raise ValueError(
+            f"genome_len {L} != 2 * max_nodes ({2 * T}) for this GPConfig"
+        )
+    ops = decode_ops(genomes, gp)
+    arity = jnp.asarray(gp.op_arities(), jnp.int32)
+
+    def body(carry, xs):
+        sp, sstack = carry  # (P,), (P, T)
+        t, op = xs
+        a = arity[op]
+        ex = (op != PAD_OP) & (sp >= a)
+        idx = jnp.clip(sp - a, 0, T - 1)
+        st_inner = jnp.take_along_axis(sstack, idx[:, None], axis=1)[:, 0]
+        st = jnp.where(a == 0, t, st_inner)
+        nsp = jnp.where(ex, sp - a + 1, sp)
+        wid = jnp.clip(nsp - 1, 0, T - 1)
+        onehot = (
+            jnp.arange(T, dtype=jnp.int32)[None, :] == wid[:, None]
+        ) & ex[:, None]
+        sstack = jnp.where(onehot, st[:, None], sstack)
+        return (nsp, sstack), (ex, jnp.where(ex, st, t))
+
+    zeros = jnp.zeros((P,), jnp.int32)
+    (sp_f, _), (live_t, start_t) = jax.lax.scan(
+        body,
+        (zeros, jnp.zeros((P, T), jnp.int32)),
+        (jnp.arange(T, dtype=jnp.int32), ops.T),
+    )
+    live = live_t.T
+    start = start_t.T
+    span = jnp.arange(T, dtype=jnp.int32)[None, :] - start + 1
+    return Structure(
+        live=live,
+        start=start,
+        span=span,
+        length=jnp.sum(live.astype(jnp.int32), axis=1),
+        final_depth=sp_f,
+    )
+
+
+def canonicalize(genomes: jax.Array, gp: GPConfig) -> jax.Array:
+    """Normalize arbitrary genomes to strict layout: live tokens
+    compacted to the front (order preserved — their stack profile, and
+    therefore the program's value, is unchanged: dead tokens never
+    altered the depth), pad tokens STAMPED behind (a dead token left in
+    the tail could come alive at the shallower depth of a future
+    splice site). Idempotent; strictly well-formed genomes (modulo the
+    pad tail's operand genes) pass through with the same live prefix.
+    """
+    st = program_structure(genomes, gp)
+    T = gp.max_nodes
+    # Stable live-first token order (jax sorts are stable).
+    order = jnp.argsort((~st.live).astype(jnp.int32), axis=1)
+    gidx = jnp.stack([2 * order, 2 * order + 1], axis=2).reshape(
+        genomes.shape[0], 2 * T
+    )
+    out = jnp.take_along_axis(genomes, gidx, axis=1)
+    tail = jnp.arange(T, dtype=jnp.int32)[None, :] >= st.length[:, None]
+    pad_pair = jnp.stack(
+        [jnp.full((), gp.pad_gene, out.dtype), jnp.full((), 0.5, out.dtype)]
+    )
+    tail_genes = jnp.repeat(tail, 2, axis=1)
+    pad_row = jnp.tile(pad_pair, T)[None, :]
+    return jnp.where(tail_genes, pad_row, out)
+
+
+# ----------------------------------------------------- random programs
+
+#: Column layout of the random-growth rand block: one length gene,
+#: then max_nodes opcode-choice genes, then max_nodes operand genes.
+def grow_rand_cols(gp: GPConfig) -> int:
+    return 1 + 2 * gp.max_nodes
+
+
+def random_program_genes(rand: jax.Array, gp: GPConfig) -> jax.Array:
+    """Grow one strictly well-formed program per row from a uniform
+    rand block (``(P, grow_rand_cols)``).
+
+    Ramped lengths in ``[min_nodes, max_nodes]`` (rounded to odd when
+    the unary set is empty — pure binary trees have odd token counts),
+    then a left-to-right draw under the feasibility invariant
+    ``depth' <= remaining'``: at every step the allowed arities are
+    ``a <= depth`` with ``depth - a <= remaining - 1``, which is never
+    empty and forces the final depth to exactly 1 — well-formed BY
+    CONSTRUCTION, no repair pass. Deterministic in the rand block, so
+    the same draw is reusable as a mutation donor (``gp/operators``)
+    and as a seeded population init (:func:`random_population`).
+    """
+    P = rand.shape[0]
+    T = gp.max_nodes
+    arity = jnp.asarray(gp.op_arities(), jnp.int32)
+    n_ops = gp.n_ops
+    lo, hi = gp.min_nodes, gp.max_nodes
+    tlen = lo + jnp.floor(rand[:, 0] * (hi - lo + 1)).astype(jnp.int32)
+    tlen = jnp.clip(tlen, lo, hi)
+    if not gp.unary:
+        # No arity-1 filler: only odd lengths close to depth 1.
+        tlen = jnp.maximum(tlen - (1 - tlen % 2), 1)
+    op_ids = jnp.arange(n_ops, dtype=jnp.int32)
+
+    def body(carry, xs):
+        d = carry
+        t, r_op, r_arg = xs
+        active = t < tlen
+        remaining = tlen - t
+        allowed = (
+            (arity[None, :] <= d[:, None])
+            & ((d[:, None] - arity[None, :]) <= remaining[:, None] - 1)
+            & (op_ids != PAD_OP)[None, :]
+            & active[:, None]
+        )
+        cnt = jnp.sum(allowed.astype(jnp.int32), axis=1)
+        choice = jnp.floor(r_op * cnt).astype(jnp.int32)
+        cum = jnp.cumsum(allowed.astype(jnp.int32), axis=1)
+        sel = allowed & (cum == choice[:, None] + 1)
+        op = jnp.argmax(sel, axis=1).astype(jnp.int32)
+        d = jnp.where(active, d - arity[op] + 1, d)
+        op_gene = jnp.where(
+            active, (op.astype(jnp.float32) + 0.5) / n_ops, gp.pad_gene
+        )
+        arg_gene = jnp.where(active, r_arg, 0.5)
+        return d, (op_gene, arg_gene)
+
+    _, (op_g, arg_g) = jax.lax.scan(
+        body,
+        jnp.zeros((P,), jnp.int32),
+        (
+            jnp.arange(T, dtype=jnp.int32),
+            rand[:, 1 : T + 1].T.astype(jnp.float32),
+            rand[:, T + 1 : 2 * T + 1].T.astype(jnp.float32),
+        ),
+    )
+    genes = jnp.stack([op_g.T, arg_g.T], axis=2).reshape(P, 2 * T)
+    return genes.astype(jnp.float32)
+
+
+def random_population(key: jax.Array, size: int, gp: GPConfig) -> jax.Array:
+    """``(size, 2 * max_nodes)`` float32 matrix of strictly well-formed
+    random programs — the GP init (install with
+    ``PGA.install_population``)."""
+    rand = jax.random.uniform(key, (size, grow_rand_cols(gp)))
+    return random_program_genes(rand, gp)
+
+
+# --------------------------------------------------------- host helpers
+
+
+def encode_program(tokens: Sequence, gp: GPConfig) -> np.ndarray:
+    """Encode an explicit token list into one genome (host-side — test
+    fixtures and known-target construction). Tokens: ``("var", i)``,
+    ``("const", i)``, or a function name string."""
+    T = gp.max_nodes
+    if len(tokens) > T:
+        raise ValueError(f"{len(tokens)} tokens exceed max_nodes {T}")
+    names = gp.op_names()
+    g = np.empty(2 * T, np.float32)
+    g[0::2] = gp.pad_gene
+    g[1::2] = 0.5
+    for t, tok in enumerate(tokens):
+        if isinstance(tok, tuple):
+            kind, idx = tok
+            if kind == "var":
+                if not (0 <= idx < gp.n_vars):
+                    raise ValueError(f"var index {idx} out of range")
+                g[2 * t] = gp.opcode_gene(names.index("var"))
+                g[2 * t + 1] = gp.operand_gene(idx, gp.n_vars)
+            elif kind == "const":
+                if not (0 <= idx < len(gp.consts)):
+                    raise ValueError(f"const index {idx} out of range")
+                g[2 * t] = gp.opcode_gene(names.index("const"))
+                g[2 * t + 1] = gp.operand_gene(idx, len(gp.consts))
+            else:
+                raise ValueError(f"unknown terminal kind {kind!r}")
+        else:
+            if tok not in names or tok == "pad":
+                raise ValueError(f"unknown op {tok!r}; table: {names}")
+            g[2 * t] = gp.opcode_gene(names.index(tok))
+    return g
+
+
+def is_well_formed(genome: np.ndarray, gp: GPConfig) -> bool:
+    """STRICT host-side well-formedness check (the property-test
+    oracle): non-pad tokens form one prefix, every one executes, and
+    the final stack depth is exactly 1."""
+    g = np.asarray(genome, np.float32)
+    T = gp.max_nodes
+    if g.shape != (2 * T,):
+        return False
+    ops = np.clip(
+        np.floor(g[0::2] * gp.n_ops).astype(np.int64), 0, gp.n_ops - 1
+    )
+    arity = np.asarray(gp.op_arities())
+    nonpad = ops != PAD_OP
+    length = int(nonpad.sum())
+    if length == 0:
+        return False
+    if not np.all(nonpad[:length]) or np.any(nonpad[length:]):
+        return False  # pads interleaved with live tokens
+    depth = 0
+    for t in range(length):
+        a = int(arity[ops[t]])
+        if depth < a:
+            return False  # token would underflow (skip rule would fire)
+        depth += 1 - a
+    return depth == 1
+
+
+def decode_expression(genome: np.ndarray, gp: GPConfig) -> str:
+    """Human-readable infix rendering of one genome's program (under
+    the skip rule, so it is total). Empty programs render ``"0"``."""
+    g = np.asarray(genome, np.float32)
+    ops = np.clip(
+        np.floor(g[0::2] * gp.n_ops).astype(np.int64), 0, gp.n_ops - 1
+    )
+    args = g[1::2]
+    names = gp.op_names()
+    arity = np.asarray(gp.op_arities())
+    infix = {"add": "+", "sub": "-", "mul": "*", "div": "/"}
+    stack: list = []
+    for t in range(gp.max_nodes):
+        name = names[ops[t]]
+        a = int(arity[ops[t]])
+        if name == "pad" or len(stack) < a:
+            continue
+        if name == "var":
+            v = min(int(args[t] * gp.n_vars), gp.n_vars - 1)
+            stack.append(f"x{v}")
+        elif name == "const":
+            c = min(int(args[t] * len(gp.consts)), len(gp.consts) - 1)
+            stack.append(repr(float(gp.consts[c])))
+        elif a == 1:
+            x = stack.pop()
+            stack.append(f"(-{x})" if name == "neg" else f"{name}({x})")
+        else:
+            rhs, lhs = stack.pop(), stack.pop()
+            if name in infix:
+                stack.append(f"({lhs} {infix[name]} {rhs})")
+            else:
+                stack.append(f"{name}({lhs}, {rhs})")
+    return stack[-1] if stack else "0"
+
+
+def program_length(genome: np.ndarray, gp: GPConfig) -> int:
+    """Host-side live-token count (skip-rule semantics)."""
+    g = np.asarray(genome, np.float32)
+    ops = np.clip(
+        np.floor(g[0::2] * gp.n_ops).astype(np.int64), 0, gp.n_ops - 1
+    )
+    arity = np.asarray(gp.op_arities())
+    depth = 0
+    n = 0
+    for t in range(gp.max_nodes):
+        a = int(arity[ops[t]])
+        if ops[t] == PAD_OP or depth < a:
+            continue
+        depth += 1 - a
+        n += 1
+    return n
+
+
+__all__ = [
+    "GPConfig",
+    "UNARY_NAMES",
+    "BINARY_NAMES",
+    "PAD_OP",
+    "DIV_EPS",
+    "LOG_EPS",
+    "decode_ops",
+    "decode_args",
+    "Structure",
+    "program_structure",
+    "canonicalize",
+    "grow_rand_cols",
+    "random_program_genes",
+    "random_population",
+    "encode_program",
+    "is_well_formed",
+    "decode_expression",
+    "program_length",
+]

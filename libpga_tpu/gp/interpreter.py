@@ -1,0 +1,240 @@
+"""Fused stack-machine interpreter for postfix GP genomes (XLA path).
+
+One jitted program evaluates the WHOLE population on the WHOLE sample
+batch: a bounded ``lax.fori_loop`` over token positions carrying a
+``(stack_depth, P, B)`` value-stack tensor and a ``(P,)`` per-individual
+stack pointer. Every stack access is an iota-compare mask (no gathers,
+no scatters — the same scatter-free formulation as the batched
+order-preserving crossover, ``ops/crossover.py``), so the IDENTICAL
+token-step code lowers both here under XLA and inside the Pallas VMEM
+kernel (``ops/gp_eval.py``) — one copy of the semantics, which is what
+keeps the fused path and the fallback path from drifting
+(``tools/gp_smoke.py`` gates their agreement; the pure-numpy oracle in
+``gp/reference.py`` anchors both).
+
+Knobs (the ``gp_stack_depth`` / ``gp_opcode_block`` tuning axes,
+``tuning/space.py``):
+
+- ``stack_depth`` — rows of the value stack. Auto = ``max_nodes`` (the
+  provable worst case); anything smaller is rejected by the plan
+  (``ops/gp_eval.gp_eval_plan``) rather than silently mis-evaluating.
+  Larger values trade scratch for nothing on paper — which is exactly
+  why they are a MEASURED axis, not a hardcoded choice.
+- ``opcode_block`` — tokens interpreted per loop iteration (the body
+  unrolls this many steps). Must divide ``max_nodes``.
+
+Both knobs change the traced program, so distinct settings are
+distinct compiled plans even on CPU — the first non-null autotuner
+search space off-chip (ISSUE 11 tentpole).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from libpga_tpu.gp.encoding import (
+    DIV_EPS,
+    GPConfig,
+    LOG_EPS,
+    PAD_OP,
+    decode_args,
+    decode_ops,
+)
+
+_UNARY_FNS = {
+    "neg": lambda a: -a,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "sqrt": lambda a: jnp.sqrt(jnp.abs(a)),
+    "abs": jnp.abs,
+    "exp": jnp.exp,
+    "log": lambda a: jnp.log(jnp.abs(a) + jnp.float32(LOG_EPS)),
+}
+
+_BINARY_FNS = {
+    "add": lambda a, b: a + b,
+    "sub": lambda a, b: a - b,
+    "mul": lambda a, b: a * b,
+    "div": lambda a, b: jnp.where(
+        jnp.abs(b) < DIV_EPS,
+        jnp.float32(1.0),
+        a / jnp.where(jnp.abs(b) < DIV_EPS, jnp.float32(1.0), b),
+    ),
+    "min": jnp.minimum,
+    "max": jnp.maximum,
+}
+
+
+def make_token_step(gp: GPConfig) -> Callable:
+    """The one token-step implementation both evaluators share.
+
+    Returns ``step(stack, sp, op, arg, xt, consts) -> (stack, sp)``
+    with ``stack (S, P, B)`` f32, ``sp (P,)`` i32, ``op (P,)`` i32,
+    ``arg (P,)`` f32, ``xt (n_vars, B)`` f32 (the sample matrix,
+    variable-major), ``consts (n_consts,)`` f32. Mask-only: terminal
+    lookups are masked accumulations over the (small) variable/constant
+    tables, stack reads/writes are iota-compare selects — Mosaic-legal
+    inside a kernel, ordinary VPU code under XLA.
+    """
+    names = gp.op_names()
+    arity_tab = gp.op_arities()
+    var_op = names.index("var")
+    const_op = names.index("const") if gp.consts else -1
+    unary_ids = [(names.index(n), _UNARY_FNS[n]) for n in gp.unary]
+    binary_ids = [(names.index(n), _BINARY_FNS[n]) for n in gp.binary]
+    n_vars = gp.n_vars
+    n_consts = len(gp.consts)
+
+    def step(stack, sp, op, arg, xt, consts):
+        S = stack.shape[0]
+        # Per-row arity: masked accumulation over the static table.
+        a_of = jnp.zeros_like(op)
+        for k, a in enumerate(arity_tab):
+            if a:
+                a_of = jnp.where(op == k, jnp.int32(a), a_of)
+        sidx = jax.lax.broadcasted_iota(jnp.int32, stack.shape, 0)
+        spb = sp[None, :, None]
+        top = jnp.sum(jnp.where(sidx == spb - 1, stack, 0.0), axis=0)
+        sec = jnp.sum(jnp.where(sidx == spb - 2, stack, 0.0), axis=0)
+
+        # Terminals: masked accumulation over the variable / constant
+        # tables (both small by construction — no gather).
+        opb = op[:, None]
+        argb = arg[:, None]
+        vidx = jnp.clip(
+            jnp.floor(argb * n_vars).astype(jnp.int32), 0, n_vars - 1
+        )
+        leaf = jnp.zeros_like(top)
+        for v in range(n_vars):
+            leaf = jnp.where(vidx == v, xt[v][None, :], leaf)
+        if const_op >= 0:
+            cidx = jnp.clip(
+                jnp.floor(argb * n_consts).astype(jnp.int32), 0, n_consts - 1
+            )
+            cval = jnp.zeros_like(top)
+            for c in range(n_consts):
+                cval = jnp.where(cidx == c, consts[c], cval)
+            leaf = jnp.where(opb == const_op, cval, leaf)
+
+        res = leaf
+        for k, fn in unary_ids:
+            res = jnp.where(opb == k, fn(top), res)
+        for k, fn in binary_ids:
+            res = jnp.where(opb == k, fn(sec, top), res)
+
+        ex = (op != PAD_OP) & (sp >= a_of) & (sp - a_of < S)
+        nsp = jnp.where(ex, sp - a_of + 1, sp)
+        write = (sidx == nsp[None, :, None] - 1) & ex[None, :, None]
+        stack = jnp.where(write, res[None, :, :], stack)
+        return stack, nsp
+
+    return step
+
+
+def stack_predict(
+    genomes: jax.Array,
+    xt: jax.Array,
+    gp: GPConfig,
+    *,
+    stack_depth: Optional[int] = None,
+    opcode_block: Optional[int] = None,
+) -> jax.Array:
+    """Run the stack machine over a gene matrix: ``(P, 2T)`` genomes ×
+    ``(n_vars, B)`` variable-major samples → ``(P, B)`` predictions.
+    Total over arbitrary gene values (skip rule). Traceable — the
+    engine's ``evaluate`` jits straight through it.
+    """
+    S = int(stack_depth or gp.required_stack())
+    block = int(opcode_block or 1)
+    T = gp.max_nodes
+    if S < gp.required_stack():
+        raise ValueError(
+            f"stack_depth {S} < required bound {gp.required_stack()} "
+            f"(a well-formed {T}-token program can hold {T} values)"
+        )
+    if T % block:
+        raise ValueError(f"opcode_block {block} does not divide {T}")
+    P = genomes.shape[0]
+    B = xt.shape[1]
+    ops = decode_ops(genomes, gp)
+    args = decode_args(genomes, gp)
+    consts = jnp.asarray(gp.consts or (0.0,), jnp.float32)
+    step = make_token_step(gp)
+
+    def body(i, carry):
+        stack, sp = carry
+        for j in range(block):
+            t = i * block + j
+            op = jax.lax.dynamic_index_in_dim(ops, t, 1, keepdims=False)
+            arg = jax.lax.dynamic_index_in_dim(args, t, 1, keepdims=False)
+            stack, sp = step(stack, sp, op, arg, xt, consts)
+        return stack, sp
+
+    stack0 = jnp.zeros((S, P, B), jnp.float32)
+    sp0 = jnp.zeros((P,), jnp.int32)
+    stack, sp = jax.lax.fori_loop(0, T // block, body, (stack0, sp0))
+    sidx = jax.lax.broadcasted_iota(jnp.int32, stack.shape, 0)
+    top = jnp.sum(
+        jnp.where(sidx == sp[None, :, None] - 1, stack, 0.0), axis=0
+    )
+    return jnp.where(sp[:, None] > 0, top, 0.0)
+
+
+def make_eval_rows(
+    gp: GPConfig,
+    X,
+    y,
+    *,
+    stack_depth: Optional[int] = None,
+    opcode_block: Optional[int] = None,
+    parsimony: float = 0.0,
+) -> Callable:
+    """Whole-population symbolic-regression scorer: ``rows(m) -> (P,)``
+    float32 ``-RMSE`` scores (higher is better), with non-finite scores
+    sanitized to ``-inf`` (one overflowing ``exp``/``mul`` chain must
+    not poison the run loop's ``max(scores)`` target check), and an
+    optional parsimony penalty per non-pad token."""
+    import numpy as np
+
+    # NUMPY closures deliberately: this factory may run INSIDE an
+    # active jit trace (the engine's first evaluate builds the rows fn
+    # lazily), where any jnp op would stage a tracer into the cached
+    # closure and leak it into later traces. Numpy constants convert
+    # fresh per trace.
+    Xa = np.asarray(X, np.float32)
+    if Xa.ndim == 1:
+        Xa = Xa[:, None]
+    if Xa.shape[1] != gp.n_vars:
+        raise ValueError(
+            f"X has {Xa.shape[1]} columns; GPConfig.n_vars is {gp.n_vars}"
+        )
+    ya = np.asarray(y, np.float32).reshape(-1)
+    if ya.shape[0] != Xa.shape[0]:
+        raise ValueError(
+            f"X has {Xa.shape[0]} samples but y has {ya.shape[0]}"
+        )
+    xt = np.ascontiguousarray(Xa.T)  # (n_vars, B), variable-major
+    pfloat = float(parsimony)
+
+    def rows(m):
+        preds = stack_predict(
+            m, xt, gp, stack_depth=stack_depth, opcode_block=opcode_block
+        )
+        err = preds - ya[None, :]
+        score = -jnp.sqrt(jnp.mean(err * err, axis=1))
+        if pfloat:
+            live = jnp.sum(
+                (decode_ops(m, gp) != PAD_OP).astype(jnp.float32), axis=1
+            )
+            score = score - jnp.float32(pfloat) * live
+        return jnp.where(jnp.isfinite(score), score, -jnp.inf).astype(
+            jnp.float32
+        )
+
+    return rows
+
+
+__all__ = ["make_token_step", "stack_predict", "make_eval_rows"]

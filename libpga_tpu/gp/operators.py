@@ -1,0 +1,301 @@
+"""GP variation operators: size-fair subtree crossover, subtree and
+point mutation — named operator kinds on the library's EXISTING
+operator protocol.
+
+Each factory returns a standard per-individual callable ``(p1, p2,
+rand) -> child`` / ``(genome, rand) -> genome`` carrying the optional
+attributes the engine's breed step already dispatches on
+(``ops/step.make_breed``): ``.batched`` (whole-population
+implementation), ``.rand_cols`` (uniform columns consumed per
+individual), plus the identity attributes the rest of the stack keys
+on — ``kernel_cache_key`` (compiled-program caches and the serving
+bucket signature derive operator identity from it, ``engine._kind_key``)
+and ``param_batched`` (mutation rate as a RUNTIME input — how the
+serving mega-run packs distinct rates into one compilation,
+``ops/step.make_param_breed``). ``xla_only = True`` marks them as
+legitimately kernel-less: they run on the XLA operator path everywhere
+(the fused path for GP is the EVALUATOR, ``ops/gp_eval.py``), and the
+engine's "no in-kernel form" warning stays quiet.
+
+**Closure.** Both structural operators provably preserve strict postfix
+well-formedness (``gp/encoding.is_well_formed``) for all admissible
+genome pairs — the property test in tests/test_gp.py:
+
+- a complete postfix subtree is a contiguous token slice with net
+  stack effect +1 whose every proper prefix keeps at least one pending
+  value, so replacing the slice ``[start[i], i]`` with ANOTHER complete
+  subtree leaves every suffix token's stack depth unchanged — no
+  underflow can appear;
+- size-fair donor choice bounds growth two ways: the Langdon-style
+  fairness cap (donor span ≤ ``2 * span(A) + 1``) and the hard
+  capacity cap (donor span ≤ ``span(A) + max_nodes - len(parent)``,
+  so the child NEVER exceeds ``max_nodes`` tokens). A leaf (span 1)
+  always qualifies, so the choice set is never empty;
+- subtree mutation is crossover against a freshly GROWN donor
+  (``encoding.random_program_genes`` — well-formed by construction);
+  point mutation replaces one token's opcode ARITY-PRESERVINGLY (and
+  refreshes its operand gene), which leaves the depth profile
+  untouched.
+
+Arbitrary (non-canonical) inputs are first normalized by
+``encoding.canonicalize`` — the operators are total, so a plain
+random-float population arriving through the serving path breeds
+instead of crashing.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from libpga_tpu.gp.encoding import (
+    GPConfig,
+    PAD_OP,
+    canonicalize,
+    decode_ops,
+    grow_rand_cols,
+    program_structure,
+    random_program_genes,
+)
+
+
+def _pick_nth(mask: jax.Array, n: jax.Array) -> jax.Array:
+    """Index of the (n+1)-th True per row of ``mask`` (cumsum trick —
+    callers guarantee at least one True where the result is used)."""
+    cum = jnp.cumsum(mask.astype(jnp.int32), axis=1)
+    sel = mask & (cum == n[:, None] + 1)
+    return jnp.argmax(sel, axis=1).astype(jnp.int32)
+
+
+def _gene_gather(p: jax.Array, src: jax.Array, T: int) -> jax.Array:
+    """Gather whole tokens (gene pairs) by token index ``src (P, T)``."""
+    src = jnp.clip(src, 0, T - 1)
+    gidx = jnp.stack([2 * src, 2 * src + 1], axis=2).reshape(
+        p.shape[0], 2 * T
+    )
+    return jnp.take_along_axis(p, gidx, axis=1)
+
+
+def _splice(p1c, p2c, r0, r1, gp: GPConfig) -> jax.Array:
+    """Size-fair subtree replacement on CANONICAL parents: swap a
+    uniformly chosen subtree of ``p1c`` for a size-capped subtree of
+    ``p2c``. The closure argument lives in the module docstring."""
+    T = gp.max_nodes
+    st1 = program_structure(p1c, gp)
+    st2 = program_structure(p2c, gp)
+    len1, len2 = st1.length, st2.length
+    # Subtree A: uniform over p1's live prefix.
+    i1 = jnp.clip(
+        jnp.floor(r0 * len1).astype(jnp.int32), 0, jnp.maximum(len1 - 1, 0)
+    )
+    spanA = jnp.take_along_axis(st1.span, i1[:, None], axis=1)[:, 0]
+    startA = i1 - spanA + 1
+    # Size-fair cap ∧ hard capacity cap (child <= max_nodes tokens).
+    limit = jnp.minimum(spanA + (T - len1), 2 * spanA + 1)
+    iota = jnp.arange(T, dtype=jnp.int32)[None, :]
+    valid = (iota < len2[:, None]) & (st2.span <= limit[:, None])
+    cnt = jnp.sum(valid.astype(jnp.int32), axis=1)
+    k2 = jnp.clip(
+        jnp.floor(r1 * cnt).astype(jnp.int32), 0, jnp.maximum(cnt - 1, 0)
+    )
+    j2 = _pick_nth(valid, k2)
+    spanB = jnp.take_along_axis(st2.span, j2[:, None], axis=1)[:, 0]
+    startB = j2 - spanB + 1
+
+    in_mid = (iota >= startA[:, None]) & (
+        iota < (startA + spanB)[:, None]
+    )
+    after = iota >= (startA + spanB)[:, None]
+    src1 = jnp.where(after, iota - spanB[:, None] + spanA[:, None], iota)
+    src2 = startB[:, None] + (iota - startA[:, None])
+    g1 = _gene_gather(p1c, src1, T)
+    g2 = _gene_gather(p2c, src2, T)
+    child = jnp.where(jnp.repeat(in_mid, 2, axis=1), g2, g1)
+    newlen = len1 - spanA + spanB
+    tail = jnp.repeat(iota >= newlen[:, None], 2, axis=1)
+    pad_row = jnp.tile(
+        jnp.asarray([gp.pad_gene, 0.5], child.dtype), T
+    )[None, :]
+    child = jnp.where(tail, pad_row, child)
+    # Degenerate guards: an empty parent contributes nothing to splice.
+    child = jnp.where((len1 == 0)[:, None], p2c, child)
+    return jnp.where((len2 == 0)[:, None], p1c, child)
+
+
+def make_subtree_crossover(gp: GPConfig) -> Callable:
+    """Size-fair subtree crossover (named kind ``gp_subtree``)."""
+
+    def batched(p1, p2, rand):
+        p1c = canonicalize(p1, gp)
+        p2c = canonicalize(p2, gp)
+        return _splice(p1c, p2c, rand[:, 0], rand[:, 1], gp)
+
+    def op(p1, p2, rand):
+        return batched(p1[None, :], p2[None, :], rand[None, :])[0]
+
+    op.batched = batched
+    op.rand_cols = 2
+    op.kernel_cache_key = f"gp_subtree_crossover/{gp.cache_key()}"
+    op.xla_only = True
+    op.gp_config = gp
+    return op
+
+
+def make_subtree_mutate(gp: GPConfig, rate: float = 0.3) -> Callable:
+    """Subtree mutation (named kind ``gp_subtree``): with probability
+    ``rate`` per individual, size-fair-splice a freshly grown random
+    subtree over a uniformly chosen one. ``param_batched`` takes the
+    rate as a runtime input (the serving mega-run contract)."""
+    gc = grow_rand_cols(gp)
+
+    def _mutate(genomes, rand, rate_val):
+        donors = random_program_genes(rand[:, 3:], gp)  # canonical
+        base = canonicalize(genomes, gp)
+        mutated = _splice(base, donors, rand[:, 1], rand[:, 2], gp)
+        fire = (rand[:, 0] < rate_val)[:, None]
+        return jnp.where(fire, mutated, genomes)
+
+    def batched(genomes, rand):
+        return _mutate(genomes, rand, rate)
+
+    def param_batched(genomes, rand, rate_val, sigma):
+        del sigma  # GP mutation has no sigma axis
+        return _mutate(genomes, rand, rate_val)
+
+    def op(genome, rand):
+        return batched(genome[None, :], rand[None, :])[0]
+
+    op.batched = batched
+    op.param_batched = param_batched
+    op.rand_cols = 3 + gc
+    op.rate = rate
+    op.kernel_cache_key = f"gp_subtree_mutate/{gp.cache_key()}"
+    op.xla_only = True
+    op.gp_config = gp
+    return op
+
+
+def make_gp_point_mutate(gp: GPConfig, rate: float = 0.2) -> Callable:
+    """Point mutation (named kind ``gp_point``): with probability
+    ``rate`` per individual, replace one uniformly chosen live token's
+    opcode with a random SAME-ARITY opcode and refresh its operand
+    gene — the depth profile is untouched, so well-formedness is
+    preserved by construction."""
+    arity = jnp.asarray(gp.op_arities(), jnp.int32)
+    op_ids = jnp.arange(gp.n_ops, dtype=jnp.int32)
+    n_ops = gp.n_ops
+
+    def _mutate(genomes, rand, rate_val):
+        P, L = genomes.shape
+        T = gp.max_nodes
+        st = program_structure(genomes, gp)
+        length = st.length
+        k = jnp.clip(
+            jnp.floor(rand[:, 1] * length).astype(jnp.int32),
+            0,
+            jnp.maximum(length - 1, 0),
+        )
+        pos = _pick_nth(st.live, k)
+        ops = decode_ops(genomes, gp)
+        op_i = jnp.take_along_axis(ops, pos[:, None], axis=1)[:, 0]
+        a_i = arity[op_i]
+        allowed = (arity[None, :] == a_i[:, None]) & (
+            op_ids != PAD_OP
+        )[None, :]
+        cnt = jnp.sum(allowed.astype(jnp.int32), axis=1)
+        choice = jnp.clip(
+            jnp.floor(rand[:, 2] * cnt).astype(jnp.int32),
+            0,
+            jnp.maximum(cnt - 1, 0),
+        )
+        new_op = _pick_nth(allowed, choice)
+        new_opg = (new_op.astype(jnp.float32) + 0.5) / n_ops
+        fire = (rand[:, 0] < rate_val) & (length > 0)
+        cols = jnp.arange(L, dtype=jnp.int32)[None, :]
+        hit_op = (cols == (2 * pos)[:, None]) & fire[:, None]
+        hit_arg = (cols == (2 * pos + 1)[:, None]) & fire[:, None]
+        out = jnp.where(hit_op, new_opg[:, None].astype(genomes.dtype),
+                        genomes)
+        return jnp.where(
+            hit_arg, rand[:, 3:4].astype(genomes.dtype), out
+        )
+
+    def batched(genomes, rand):
+        return _mutate(genomes, rand, rate)
+
+    def param_batched(genomes, rand, rate_val, sigma):
+        del sigma
+        return _mutate(genomes, rand, rate_val)
+
+    def op(genome, rand):
+        return batched(genome[None, :], rand[None, :])[0]
+
+    op.batched = batched
+    op.param_batched = param_batched
+    op.rand_cols = 4
+    op.rate = rate
+    op.kernel_cache_key = f"gp_point_mutate/{gp.cache_key()}"
+    op.xla_only = True
+    op.gp_config = gp
+    return op
+
+
+def make_gp_mutate(
+    gp: GPConfig, subtree_rate: float = 0.4, point_rate: float = 0.6
+) -> Callable:
+    """The STANDARD GP mutation (named kind ``gp_mutate``): subtree
+    mutation chained with point mutation — structural innovation plus
+    the local repair pressure that keeps populations from collapsing
+    onto one shape (measured on the recovery smoke: subtree-only
+    stalls a third of seeds at a local optimum; the chain recovers
+    them). Runtime-parameter mapping for the serving mega-run:
+    ``mparams`` rate drives the SUBTREE rate and sigma drives the
+    POINT rate, so both axes stay sweepable per request."""
+    sub = make_subtree_mutate(gp, rate=subtree_rate)
+    pt = make_gp_point_mutate(gp, rate=point_rate)
+    c1 = sub.rand_cols
+
+    def batched(genomes, rand):
+        return pt.batched(sub.batched(genomes, rand[:, :c1]), rand[:, c1:])
+
+    def param_batched(genomes, rand, rate_val, sigma):
+        mid = sub.param_batched(genomes, rand[:, :c1], rate_val, 0.0)
+        return pt.param_batched(mid, rand[:, c1:], sigma, 0.0)
+
+    def op(genome, rand):
+        return batched(genome[None, :], rand[None, :])[0]
+
+    op.batched = batched
+    op.param_batched = param_batched
+    op.rand_cols = c1 + pt.rand_cols
+    op.rate = subtree_rate
+    op.sigma = point_rate  # the serving mparams mapping above
+    op.kernel_cache_key = (
+        f"gp_mutate/{subtree_rate}/{point_rate}/{gp.cache_key()}"
+    )
+    op.xla_only = True
+    op.gp_config = gp
+    return op
+
+
+#: Named operator registry — the GP analog of the builtin
+#: crossover/mutation name maps the C ABI dispatches on
+#: (``capi_bridge.set_crossover_name`` / ``set_mutate_name``).
+CROSSOVER_KINDS = {"gp_subtree": make_subtree_crossover}
+MUTATE_KINDS = {
+    "gp_subtree": make_subtree_mutate,
+    "gp_point": make_gp_point_mutate,
+    "gp_mutate": make_gp_mutate,
+}
+
+
+__all__ = [
+    "make_subtree_crossover",
+    "make_subtree_mutate",
+    "make_gp_point_mutate",
+    "make_gp_mutate",
+    "CROSSOVER_KINDS",
+    "MUTATE_KINDS",
+]

@@ -1,0 +1,240 @@
+"""Symbolic-regression objective family: dataset-resident GP fitness.
+
+``symbolic_regression(X, y, gp=...)`` compiles a dataset into the
+library's standard objective protocol — a per-genome callable whose
+whole-population ``.rows`` form the engine's ``evaluate`` dispatches
+through (``ops/evaluate.py``) — scoring ``-RMSE`` of each genome's
+decoded program over the ``(B, n_vars)``/``(B,)`` sample batch (higher
+is better, like every objective in the library; non-finite scores
+sanitize to ``-inf``).
+
+Evaluator selection mirrors the engine's kernel stance: the fused
+Pallas stack machine (``ops/gp_eval.py``) on a real TPU backend (or
+when forced with ``fused=True`` — how the interpret-mode agreement
+gates run off-chip), the XLA interpreter (``gp/interpreter.py``)
+everywhere else; a fused build/dispatch failure degrades to the
+interpreter with one warning (the ``PGAConfig.fallback="xla"``
+stance), never a crash.
+
+Tuning integration (the round-15 autotuner finally gets a >1-plan
+space on CPU):
+
+- **reverse-registry name**: every objective carries a stable
+  ``registry_name`` (``gp_sr/<dataset+encoding digest>``), so
+  ``tuning.db.objective_class`` derives the SAME tuning-DB key from
+  the engine's resolved callable and from the tuner's handle —
+  collision-tested against the builtin registry names
+  (tests/test_gp.py).
+- **knob resolution** (``gp_stack_depth`` / ``gp_opcode_block``):
+  explicit factory argument > tuning-DB entry for this
+  ``(pop, genome_len, dtype, backend, device, objective, "gp+gp")``
+  signature > built-in auto — resolved at trace time per population
+  shape and recorded on ``obj.resolved`` for provenance.
+- ``with_knobs(...)`` rebuilds the objective at explicit knob values —
+  the hook the measurement oracle uses to time candidate configs
+  (``tuning/tuner.py``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import warnings
+from typing import Callable, Optional
+
+import numpy as np
+
+from libpga_tpu.gp.encoding import GPConfig
+from libpga_tpu.gp.interpreter import make_eval_rows
+
+
+def _digest(X: np.ndarray, y: np.ndarray, gp: GPConfig,
+            parsimony: float) -> str:
+    h = hashlib.sha1()
+    h.update(np.ascontiguousarray(X, np.float32).tobytes())
+    h.update(np.ascontiguousarray(y, np.float32).tobytes())
+    h.update(repr(gp.cache_key()).encode())
+    h.update(repr(float(parsimony)).encode())
+    return h.hexdigest()[:12]
+
+
+def symbolic_regression(
+    X,
+    y,
+    *,
+    gp: Optional[GPConfig] = None,
+    stack_depth: Optional[int] = None,
+    opcode_block: Optional[int] = None,
+    parsimony: float = 0.0,
+    fused: Optional[bool] = None,
+) -> Callable:
+    """Build a symbolic-regression objective over an ``(B, n_vars)``
+    dataset. ``stack_depth``/``opcode_block`` pin the evaluator knobs
+    explicitly (user precedence over any installed tuning DB);
+    ``parsimony`` subtracts that many score units per program token;
+    ``fused`` forces the Pallas evaluator on (True), off (False), or
+    auto — TPU backends only (None)."""
+    gp = gp or GPConfig()
+    Xa = np.asarray(X, np.float32)
+    if Xa.ndim == 1:
+        Xa = Xa[:, None]
+    if Xa.ndim != 2 or Xa.shape[1] != gp.n_vars:
+        raise ValueError(
+            f"X must be (samples, {gp.n_vars}); got {Xa.shape}"
+        )
+    ya = np.asarray(y, np.float32).reshape(-1)
+    if ya.shape[0] != Xa.shape[0]:
+        raise ValueError(
+            f"X has {Xa.shape[0]} samples but y has {ya.shape[0]}"
+        )
+    if stack_depth is not None or opcode_block is not None:
+        # Validate explicit knobs eagerly (registration-time errors,
+        # the expression-objective stance).
+        from libpga_tpu.ops.gp_eval import gp_eval_plan
+
+        gp_eval_plan(
+            8, gp, Xa.shape[0],
+            stack_depth=stack_depth, opcode_block=opcode_block,
+        )
+
+    name = f"gp_sr/{_digest(Xa, ya, gp, parsimony)}"
+    #: (pop, active-db path) -> (stack_depth, opcode_block, provenance)
+    resolved: dict = {}
+    #: (stack_depth, opcode_block) -> rows fn (knob-shaped program)
+    rows_cache: dict = {}
+    #: (pop, stack_depth, opcode_block) -> fused eval fn or None
+    fused_cache: dict = {}
+    degraded: set = set()
+
+    def _resolve(pop: int):
+        from libpga_tpu.tuning import db as _tdb
+
+        tdb = _tdb.active_db()
+        mark = (pop, _tdb.active_path())
+        hit = resolved.get(mark)
+        if hit is not None:
+            return hit
+        S, B, prov = stack_depth, opcode_block, None
+        if tdb is not None and (S is None or B is None):
+            entry = tdb.lookup(_tdb.current_key(
+                pop, gp.genome_len, np.float32, per_genome, "gp", "gp",
+            ))
+            if entry is not None:
+                prov = {}
+                if S is None:
+                    S = entry.knobs.get("gp_stack_depth")
+                    prov["gp_stack_depth"] = (
+                        "db" if S is not None else "default"
+                    )
+                else:
+                    prov["gp_stack_depth"] = "user"
+                if B is None:
+                    B = entry.knobs.get("gp_opcode_block")
+                    prov["gp_opcode_block"] = (
+                        "db" if B is not None else "default"
+                    )
+                else:
+                    prov["gp_opcode_block"] = "user"
+        out = (S, B, prov)
+        resolved[mark] = out
+        return out
+
+    def _fused_wanted() -> bool:
+        if fused is not None:
+            return fused
+        import jax
+
+        try:
+            return jax.default_backend() == "tpu"
+        except RuntimeError:
+            return False
+
+    def _fused_eval(pop: int, S, B):
+        mark = (pop, S, B)
+        if mark in fused_cache:
+            return fused_cache[mark]
+        fn = None
+        try:
+            from libpga_tpu.ops.gp_eval import make_gp_eval
+
+            fn = make_gp_eval(
+                gp, Xa, ya, pop=pop, stack_depth=S, opcode_block=B,
+            )
+        except Exception as exc:  # declines or fails: interpreter serves
+            if "fused" not in degraded:
+                degraded.add("fused")
+                warnings.warn(
+                    f"fused GP evaluator unavailable for pop={pop} "
+                    f"({type(exc).__name__}: {exc}) — scoring through "
+                    "the XLA interpreter",
+                    stacklevel=3,
+                )
+        fused_cache[mark] = fn
+        return fn
+
+    def rows(m):
+        pop = int(m.shape[0])
+        S, B, prov = _resolve(pop)
+        if _fused_wanted() and parsimony == 0.0:
+            fn = _fused_eval(pop, S, B)
+            if fn is not None:
+                return fn(m)
+        key = (S, B)
+        fn = rows_cache.get(key)
+        if fn is None:
+            fn = make_eval_rows(
+                gp, Xa, ya,
+                stack_depth=S, opcode_block=B, parsimony=parsimony,
+            )
+            rows_cache[key] = fn
+        del prov  # provenance is inspectable via obj.resolved
+        return fn(m)
+
+    def per_genome(genome):
+        return rows(genome[None, :])[0]
+
+    def with_knobs(
+        stack_depth: Optional[int] = None,
+        opcode_block: Optional[int] = None,
+    ):
+        """Rebuild at explicit evaluator knobs (the autotuner's
+        measurement hook — user-precedence semantics)."""
+        return symbolic_regression(
+            Xa, ya, gp=gp,
+            stack_depth=stack_depth, opcode_block=opcode_block,
+            parsimony=parsimony, fused=fused,
+        )
+
+    per_genome.rows = rows
+    per_genome.registry_name = name
+    per_genome.gp_config = gp
+    per_genome.sr_samples = int(Xa.shape[0])
+    per_genome.with_knobs = with_knobs
+    per_genome.resolved = resolved
+    per_genome.knob_args = (stack_depth, opcode_block)
+    per_genome.parsimony = float(parsimony)
+    per_genome.__doc__ = (
+        f"Symbolic-regression objective ({Xa.shape[0]} samples, "
+        f"{gp.n_vars} vars, {gp.max_nodes}-token programs): -RMSE."
+    )
+    return per_genome
+
+
+def make_dataset(
+    fn: Callable,
+    n_samples: int = 64,
+    n_vars: int = 1,
+    lo: float = -1.0,
+    hi: float = 1.0,
+    seed: int = 0,
+):
+    """Sample ``(X, y)`` from a ground-truth function on a uniform grid
+    of random points — test/bench/example fixture."""
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(lo, hi, size=(n_samples, n_vars)).astype(np.float32)
+    y = np.asarray(
+        fn(*[X[:, v] for v in range(n_vars)]), np.float32
+    ).reshape(-1)
+    return X, y
+
+
+__all__ = ["symbolic_regression", "make_dataset"]

@@ -1,0 +1,265 @@
+"""Fused Pallas stack-machine evaluator for GP genomes + its dry-run plan.
+
+The accelerator half of the GP subsystem (``libpga_tpu/gp/``): one
+``pallas_call`` scores a whole population block against the whole
+sample batch with the value stacks resident in VMEM scratch — the same
+kernel shape as the round-4 VMEM-scratch order-crossover walk
+(``ops/pallas_step.py``): a bounded ``fori_loop`` over token positions
+whose every stack access is an iota-compare mask (no gathers — TPU
+gathers neither lower in Mosaic nor pay for themselves at ~10
+ns/element).
+
+Grid: one step per ``rows_per_block`` population rows. Per step the
+kernel holds in VMEM: the block's decoded opcode/operand matrices
+``(R, Tp)``, the variable-major sample matrix ``(Vp, Bp)`` (replicated
+— SR batches are small), the target row + sample mask ``(8, Bp)``, the
+``(S, R, Bp)`` value-stack scratch, and the ``(R, LANE)`` score block
+it writes. The token-step body is LITERALLY the XLA interpreter's
+(``gp/interpreter.make_token_step``) — one copy of the semantics, so
+the fused and fallback paths cannot drift; ``tools/gp_smoke.py`` gates
+their agreement (interpret mode off-TPU) and ``gp/reference.py`` is
+the numpy oracle behind both.
+
+:func:`gp_eval_plan` is the DRY-RUN resolution — the admissibility
+oracle the tuning config space consumes (``tuning/space.py``,
+``gp_stack_depth`` / ``gp_opcode_block`` knobs), mirroring
+``pallas_step.kernel_plan``'s contract: ``None`` where the kernel
+declines (the XLA interpreter serves), ``ValueError`` exactly where an
+explicit knob is invalid, a resolved-plan dict otherwise. Because the
+two knobs shape the TRACED program of the XLA path too, distinct
+admissible settings are distinct plans even on CPU — the first >1-plan
+autotuner space off-chip.
+
+CHIP-ROUND NOTE: like every Mosaic kernel in the tree this round is
+CPU-validated through interpret mode only; first-hardware items are
+the 3-D ``(S, R, Bp)`` scratch layout and the int32 masked-accumulation
+token reads.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from libpga_tpu.gp.encoding import GPConfig, PAD_OP, decode_args, decode_ops
+from libpga_tpu.gp.interpreter import make_token_step
+
+LANE = 128
+SUBLANE = 8
+
+#: Population rows per grid step, largest-first (the plan walks this
+#: pool under the VMEM budget, exactly like the breed kernel's deme
+#: pool).
+GP_ROW_POOL = (256, 128, 64, 32, 16, 8)
+
+#: Scoped-VMEM budget for one grid step's working set. Conservative —
+#: the stack tensor dominates and the budget keeps it well under the
+#: ~16 MB/core VMEM alongside the breed kernel's own residency.
+GP_VMEM_BUDGET = 4 * 1024 * 1024
+
+
+def _lanes(n: int) -> int:
+    return max(LANE, math.ceil(n / LANE) * LANE)
+
+
+def _sublanes(n: int) -> int:
+    return max(SUBLANE, math.ceil(n / SUBLANE) * SUBLANE)
+
+
+def gp_eval_plan(
+    pop: int,
+    gp: GPConfig,
+    n_samples: int,
+    *,
+    stack_depth: Optional[int] = None,
+    opcode_block: Optional[int] = None,
+) -> Optional[dict]:
+    """Dry-run shape resolution of the fused GP evaluator.
+
+    Returns the plan dict (resolved ``stack_depth``/``opcode_block``,
+    fused-kernel geometry with ``rows_per_block``/``grid``/
+    ``vmem_bytes`` — or ``path="xla"`` with ``rows_per_block=None``
+    when no block size fits the budget or divides ``pop``), raises
+    ``ValueError`` for an explicitly invalid knob (a stack depth below
+    the provable bound, a block that does not divide ``max_nodes``),
+    and never returns a geometry the factory wouldn't build —
+    :func:`make_gp_eval` resolves through THIS function.
+    """
+    if pop < 1 or n_samples < 1:
+        return None
+    required = gp.required_stack()
+    S = int(stack_depth or gp.stack_depth or required)
+    if S < required:
+        raise ValueError(
+            f"gp_stack_depth {S} < required bound {required} (a "
+            f"well-formed {gp.max_nodes}-token program can hold "
+            f"{required} values)"
+        )
+    B = int(opcode_block or gp.opcode_block or 1)
+    if B < 1 or gp.max_nodes % B:
+        raise ValueError(
+            f"gp_opcode_block {B} does not divide max_nodes "
+            f"{gp.max_nodes}"
+        )
+    Bp = _lanes(n_samples)
+    Tp = _lanes(gp.max_nodes)
+    Vp = _sublanes(gp.n_vars)
+
+    def vmem_bytes(R: int) -> int:
+        stack = S * R * Bp * 4
+        toks = 2 * R * Tp * 4  # ops (i32) + args (f32)
+        samples = Vp * Bp * 4 + SUBLANE * Bp * 4  # xt + y/mask rows
+        ctab = SUBLANE * LANE * 4  # constant-table row
+        out = R * LANE * 4
+        return stack + toks + samples + ctab + out
+
+    rows = next(
+        (
+            R
+            for R in GP_ROW_POOL
+            if pop % R == 0 and vmem_bytes(R) <= GP_VMEM_BUDGET
+        ),
+        None,
+    )
+    plan = {
+        "stack_depth": S,
+        "opcode_block": B,
+        "batch_lanes": Bp,
+        "token_lanes": Tp,
+        "rows_per_block": rows,
+        "grid": None if rows is None else pop // rows,
+        "vmem_bytes": None if rows is None else vmem_bytes(rows),
+        "path": "xla" if rows is None else "fused",
+    }
+    return plan
+
+
+def make_gp_eval(
+    gp: GPConfig,
+    X,
+    y,
+    *,
+    pop: int,
+    stack_depth: Optional[int] = None,
+    opcode_block: Optional[int] = None,
+) -> Callable:
+    """Build the fused evaluator for one population size: ``fn(genomes
+    (pop, 2T)) -> (pop,)`` float32 ``-RMSE`` scores, semantics
+    bit-matching the XLA interpreter path (same token step, same
+    sanitization). Raises ``ValueError`` where the plan declines —
+    callers (``gp/sr.py``) apply the ``PGAConfig.fallback`` stance.
+    """
+    import numpy as np
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    Xa = np.asarray(X, np.float32)
+    if Xa.ndim == 1:
+        Xa = Xa[:, None]
+    ya = np.asarray(y, np.float32).reshape(-1)
+    n_samples = Xa.shape[0]
+    plan = gp_eval_plan(
+        pop, gp, n_samples,
+        stack_depth=stack_depth, opcode_block=opcode_block,
+    )
+    if plan is None or plan["rows_per_block"] is None:
+        raise ValueError(
+            f"fused GP evaluator declines pop={pop} "
+            f"(no admissible rows_per_block in {GP_ROW_POOL})"
+        )
+    S, B = plan["stack_depth"], plan["opcode_block"]
+    R, Bp, Tp = plan["rows_per_block"], plan["batch_lanes"], plan["token_lanes"]
+    T = gp.max_nodes
+    n_vars = gp.n_vars
+    Vp = _sublanes(n_vars)
+
+    xt = np.zeros((Vp, Bp), np.float32)
+    xt[:n_vars, :n_samples] = Xa.T
+    ym = np.zeros((SUBLANE, Bp), np.float32)
+    ym[0, :n_samples] = ya
+    ym[1, :n_samples] = 1.0  # sample mask (pad lanes are dead)
+    n_consts = max(len(gp.consts), 1)
+    if n_consts > LANE:
+        raise ValueError(
+            f"constant table of {n_consts} entries exceeds the kernel's "
+            f"one-lane-row layout ({LANE})"
+        )
+    ctab = np.zeros((SUBLANE, LANE), np.float32)
+    ctab[0, :n_consts] = np.asarray(gp.consts or (0.0,), np.float32)
+    xt_j = jnp.asarray(xt)
+    ym_j = jnp.asarray(ym)
+    ctab_j = jnp.asarray(ctab)
+    step = make_token_step(gp)
+
+    def kernel(ops_ref, args_ref, xt_ref, ym_ref, c_ref, out_ref,
+               stack_ref):
+        ops_b = ops_ref[...]  # (R, Tp) int32
+        args_b = args_ref[...]
+        xts = xt_ref[...]
+        consts = c_ref[0, :]
+        yrow = ym_ref[0, :]
+        mask = ym_ref[1, :]
+        stack_ref[...] = jnp.zeros((S, R, Bp), jnp.float32)
+        lane_t = jax.lax.broadcasted_iota(jnp.int32, (R, Tp), 1)
+
+        def body(i, sp):
+            stack = stack_ref[...]
+            for j in range(B):
+                t = i * B + j
+                tm = lane_t == t
+                op = jnp.sum(jnp.where(tm, ops_b, 0), axis=1)
+                arg = jnp.sum(jnp.where(tm, args_b, 0.0), axis=1)
+                stack, sp = step(stack, sp, op, arg, xts, consts)
+            stack_ref[...] = stack
+            return sp
+
+        sp = jax.lax.fori_loop(
+            0, T // B, body, jnp.zeros((R,), jnp.int32)
+        )
+        stack = stack_ref[...]
+        sidx = jax.lax.broadcasted_iota(jnp.int32, (S, R, Bp), 0)
+        top = jnp.sum(
+            jnp.where(sidx == sp[None, :, None] - 1, stack, 0.0), axis=0
+        )
+        top = jnp.where(sp[:, None] > 0, top, 0.0)
+        err = (top - yrow[None, :]) * mask[None, :]
+        mse = jnp.sum(err * err, axis=1) / jnp.sum(mask)
+        score = -jnp.sqrt(mse)
+        score = jnp.where(jnp.isfinite(score), score, -jnp.float32(jnp.inf))
+        out_ref[...] = jnp.broadcast_to(score[:, None], (R, LANE))
+
+    grid = plan["grid"]
+
+    def run(genomes):
+        ops = decode_ops(genomes, gp)
+        args = decode_args(genomes, gp)
+        if Tp != T:
+            ops = jnp.pad(ops, ((0, 0), (0, Tp - T)),
+                          constant_values=PAD_OP)
+            args = jnp.pad(args, ((0, 0), (0, Tp - T)))
+        out = pl.pallas_call(
+            kernel,
+            grid=(grid,),
+            in_specs=[
+                pl.BlockSpec((R, Tp), lambda i: (i, 0)),
+                pl.BlockSpec((R, Tp), lambda i: (i, 0)),
+                pl.BlockSpec((Vp, Bp), lambda i: (0, 0)),
+                pl.BlockSpec((SUBLANE, Bp), lambda i: (0, 0)),
+                pl.BlockSpec((SUBLANE, LANE), lambda i: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((R, LANE), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((pop, LANE), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((S, R, Bp), jnp.float32)],
+        )(ops, args, xt_j, ym_j, ctab_j)
+        return out[:, 0]
+
+    run.plan = dict(plan)
+    return jax.jit(run)
+
+
+__all__ = ["LANE", "GP_ROW_POOL", "GP_VMEM_BUDGET", "gp_eval_plan",
+           "make_gp_eval"]

@@ -128,6 +128,12 @@ def make_param_breed(
     so results are bit-identical to a baked-parameter breed — the
     property the serving bit-exactness tests assert.
 
+    ``mutate_kind`` may also be a CALLABLE operator carrying a
+    ``param_batched(genomes, rand, rate, sigma)`` attribute — the GP
+    structural mutations (``gp/operators.py``) ship one, so GP runs
+    batch-serve through the same mega-run machinery as every vector
+    workload (ISSUE 11).
+
     The returned callable carries ``takes_params = True`` (the marker
     the island epochs already dispatch on) and ``default_params``.
     """
@@ -138,12 +144,21 @@ def make_param_breed(
         "gaussian": (_m.gaussian_mutate, None),
         "swap": (_m.swap_mutate_batched, 3),
     }
-    if mutate_kind not in batched_kinds:
+    if callable(mutate_kind):
+        mut_batched = getattr(mutate_kind, "param_batched", None)
+        if mut_batched is None:
+            raise ValueError(
+                "callable mutate kinds must carry .param_batched"
+                "(genomes, rand, rate, sigma) — see gp/operators.py"
+            )
+        mut_cols = getattr(mutate_kind, "rand_cols", None)
+    elif mutate_kind not in batched_kinds:
         raise ValueError(
             f"unknown mutate kind {mutate_kind!r}; "
             f"available: {sorted(batched_kinds)}"
         )
-    mut_batched, mut_cols = batched_kinds[mutate_kind]
+    else:
+        mut_batched, mut_cols = batched_kinds[mutate_kind]
     cross_batched = getattr(crossover_fn, "batched", None)
     cross_cols = getattr(crossover_fn, "rand_cols", None)
 
@@ -169,7 +184,9 @@ def make_param_breed(
         rand_m = jax.random.uniform(
             k_mut, (P, mut_cols or L), dtype=jnp.float32
         )
-        if mutate_kind == "gaussian":
+        if callable(mutate_kind):
+            nxt = mut_batched(children, rand_m, rate, mparams[0, 1])
+        elif mutate_kind == "gaussian":
             nxt = mut_batched(children, rand_m, rate, mparams[0, 1])
         else:
             nxt = mut_batched(children, rand_m, rate)

@@ -282,7 +282,12 @@ class BatchedRuns:
             req.genome_len,
             self.objective,
             _kind_key(self.crossover),
-            self.mutate_kind,
+            # Builtin kinds key by name; CALLABLE kinds (the GP
+            # structural mutations) by their compiled semantics
+            # (kernel_cache_key), exactly like crossovers — so two
+            # executors over the same GP encoding share a program and
+            # distinct encodings never collide.
+            _kind_key(self.mutate_kind),
             self.config.serving_signature_fields(),
             ("tuned", tuned),
         )

@@ -48,9 +48,16 @@ SCHEMA_VERSION = 1
 #: transport pattern as PGA_FAULT_SPEC (serving/worker.py).
 ENV_VAR = "PGA_TUNING_DB"
 
-#: PGAConfig fields a DB entry may resolve (the engine-appliable knobs;
-#: tuning/space.KNOB_TO_CONFIG_FIELD maps space knobs onto these).
-TUNABLE_FIELDS = ("pallas_deme_size", "pallas_layout", "pallas_subblock")
+#: Fields a DB entry may resolve: the engine-appliable PGAConfig knobs
+#: (tuning/space.KNOB_TO_CONFIG_FIELD maps space knobs onto these) and
+#: the GP evaluator knobs (ISSUE 11 — applied at OBJECTIVE build by
+#: ``gp/sr.symbolic_regression``, which consults the active DB itself;
+#: ``resolve_config_knobs`` reads them as None off a plain PGAConfig,
+#: so vector-genome resolution is untouched).
+TUNABLE_FIELDS = (
+    "pallas_deme_size", "pallas_layout", "pallas_subblock",
+    "gp_stack_depth", "gp_opcode_block",
+)
 
 
 class TuningDBError(RuntimeError):
@@ -415,7 +422,9 @@ def resolve_config_knobs(
     """
     knobs, prov = {}, {}
     for field in TUNABLE_FIELDS:
-        user = getattr(config, field)
+        # GP evaluator fields have no PGAConfig attribute — user
+        # precedence for them lives at objective build (gp/sr.py).
+        user = getattr(config, field, None)
         if user is not None:
             knobs[field], prov[field] = user, "user"
         elif entry is not None and entry.knobs.get(field) is not None:

@@ -57,11 +57,23 @@ DOMAINS: Dict[str, tuple] = {
     "subblock": (None, 2, 4),
     "demes_per_step": (None, 1, 2, 4, 8, 16, 32),
     "dimension_semantics": ("parallel", "serial"),
+    # GP stack-machine evaluator axes (ISSUE 11, ``ops/gp_eval.py``):
+    # value-stack depth and tokens-per-loop-iteration. Both shape the
+    # TRACED program of the XLA interpreter too, so distinct
+    # admissible values are distinct plans even on CPU — the first
+    # >1-plan autotuner space off-chip.
+    "gp_stack_depth": (None, 8, 16, 32, 64),
+    "gp_opcode_block": (None, 1, 2, 4, 8),
 }
 
 #: The engine-appliable knobs (PGAConfig fields exist for exactly
-#: these) — the autotuner's genome, and what a tuning-DB entry records.
+#: these) — the vector-genome autotuner's genome, and what a tuning-DB
+#: entry records.
 TUNER_KNOBS: Tuple[str, ...] = ("deme_size", "layout", "subblock")
+
+#: The GP evaluator knobs (applied at objective build —
+#: ``gp/sr.symbolic_regression`` — not through PGAConfig).
+GP_KNOBS: Tuple[str, ...] = ("gp_stack_depth", "gp_opcode_block")
 
 #: The full sweep space (tools/sweep_kernel.py, tools/ablate_floor.py).
 SWEEP_KNOBS: Tuple[str, ...] = TUNER_KNOBS + (
@@ -87,6 +99,8 @@ class KernelConfig:
     subblock: Optional[int] = None
     demes_per_step: Optional[int] = None
     dimension_semantics: str = "parallel"
+    gp_stack_depth: Optional[int] = None
+    gp_opcode_block: Optional[int] = None
 
     def knobs(self, names: Sequence[str] = TUNER_KNOBS) -> dict:
         return {n: getattr(self, n) for n in names}
@@ -116,6 +130,13 @@ class SpaceContext:
     selection_param: Optional[float] = None
     fused: bool = True
     const_carrying: bool = False
+    # GP context (ISSUE 11): non-None max_nodes switches the space to
+    # the stack-machine EVALUATOR axes (``GP_KNOBS``) — the fused-breed
+    # knobs are inert for GP engines (their operators are XLA-path by
+    # design) and strictly inadmissible here, so a genome can never
+    # claim credit for a knob that changed nothing.
+    gp_nodes: Optional[int] = None
+    gp_samples: int = 64
 
     @property
     def genome_lanes(self) -> int:
@@ -135,11 +156,34 @@ class SpaceContext:
         return np.dtype(self.gene_dtype).name
 
 
+def tuner_knobs_for(ctx: SpaceContext) -> Tuple[str, ...]:
+    """The knob set an autotuner searches in ``ctx``: the
+    engine-appliable fused-breed knobs for vector-genome contexts, the
+    stack-machine evaluator knobs for GP contexts."""
+    return GP_KNOBS if ctx.gp_nodes is not None else TUNER_KNOBS
+
+
+def _gp_config(ctx: SpaceContext):
+    from libpga_tpu.gp.encoding import GPConfig
+
+    return GPConfig(max_nodes=int(ctx.gp_nodes))
+
+
 def resolve(ctx: SpaceContext, cfg: KernelConfig) -> Optional[dict]:
     """The factory's dry-run resolution of ``cfg`` in ``ctx`` — the
-    plan :func:`~libpga_tpu.ops.pallas_step.make_pallas_breed` would
-    build, or None where it would decline. Raises where the factory
-    would (explicit inadmissible ping-pong)."""
+    plan :func:`~libpga_tpu.ops.pallas_step.make_pallas_breed` (or,
+    for GP contexts, :func:`~libpga_tpu.ops.gp_eval.gp_eval_plan`)
+    would build, or None where it would decline. Raises where the
+    factory would (explicit inadmissible ping-pong / explicit invalid
+    GP knob)."""
+    if ctx.gp_nodes is not None:
+        from libpga_tpu.ops.gp_eval import gp_eval_plan
+
+        return gp_eval_plan(
+            ctx.pop, _gp_config(ctx), ctx.gp_samples,
+            stack_depth=cfg.gp_stack_depth,
+            opcode_block=cfg.gp_opcode_block,
+        )
     return kernel_plan(
         ctx.pop, ctx.genome_len,
         deme_size=cfg.deme_size,
@@ -167,6 +211,36 @@ def why_inadmissible(
     fallback drops) — the sweep tools' "skip duplicates" rule and the
     tuner's "measure what you asked for" rule, now enforced before any
     compile."""
+    gp_set = [
+        n for n in GP_KNOBS if getattr(cfg, n) is not None
+    ]
+    if ctx.gp_nodes is None:
+        if gp_set:
+            return (
+                f"{gp_set} are GP evaluator knobs; this context has no "
+                "GP encoding (SpaceContext.gp_nodes is None)"
+            )
+    else:
+        inert = [
+            n for n in ("deme_size", "layout", "subblock",
+                        "demes_per_step")
+            if getattr(cfg, n) is not None
+        ]
+        if cfg.dimension_semantics != "parallel":
+            inert.append("dimension_semantics")
+        if inert:
+            return (
+                f"{inert} are fused-breed knobs — inert for GP engines "
+                "(XLA-path operators by design); only "
+                f"{list(GP_KNOBS)} tune the stack-machine evaluator"
+            )
+        try:
+            plan = resolve(ctx, cfg)
+        except ValueError as exc:  # explicit invalid GP knob
+            return str(exc)
+        if plan is None:
+            return "GP evaluator declines this shape"
+        return None
     if cfg.deme_size is not None:
         if not _valid_deme(cfg.deme_size):
             return (
@@ -225,15 +299,18 @@ def admissible(
 
 def grid(
     ctx: SpaceContext,
-    knobs: Sequence[str] = TUNER_KNOBS,
+    knobs: Optional[Sequence[str]] = None,
     strict: bool = True,
     **pins: Iterable,
 ) -> List[KernelConfig]:
     """Every ADMISSIBLE configuration over the Cartesian product of the
-    named knob domains. ``pins`` overrides a knob's iterated values
-    (e.g. ``layout=("riffle",)`` pins the sweep to one layout); a
-    pinned knob need not be in ``knobs``. Inadmissible points are
+    named knob domains (default: the context's tuner knob set —
+    :func:`tuner_knobs_for`). ``pins`` overrides a knob's iterated
+    values (e.g. ``layout=("riffle",)`` pins the sweep to one layout);
+    a pinned knob need not be in ``knobs``. Inadmissible points are
     filtered here — callers never build a kernel to find out."""
+    if knobs is None:
+        knobs = tuner_knobs_for(ctx)
     names = list(dict.fromkeys(list(knobs) + list(pins)))
     axes = []
     for name in names:
@@ -251,7 +328,7 @@ def grid(
 
 
 def space_size(
-    ctx: SpaceContext, knobs: Sequence[str] = TUNER_KNOBS
+    ctx: SpaceContext, knobs: Optional[Sequence[str]] = None
 ) -> int:
     """Number of admissible configurations (``--dry-run`` of the
     autotune CLI)."""
@@ -304,6 +381,8 @@ def config_from_genes(
 __all__ = [
     "DOMAINS",
     "TUNER_KNOBS",
+    "GP_KNOBS",
+    "tuner_knobs_for",
     "SWEEP_KNOBS",
     "KNOB_TO_CONFIG_FIELD",
     "KernelConfig",

@@ -103,9 +103,17 @@ _DEFAULT_CONFIG = _space.KernelConfig()  # all knobs auto
 
 def _plan_key(ctx, cfg, pallas_live: bool) -> tuple:
     """Measurement identity of a configuration: the compiled kernel it
-    resolves to. Off-TPU every config resolves to the XLA step path —
-    ONE plan — which is both honest (the knobs are no-ops there) and
-    what makes the CPU smoke deterministic."""
+    resolves to. Off-TPU every VECTOR-GENOME config resolves to the XLA
+    step path — ONE plan — which is both honest (the knobs are no-ops
+    there) and what makes the CPU smoke deterministic. GP contexts key
+    on the resolved stack-machine geometry instead: the evaluator knobs
+    shape the TRACED program on every backend, so the space carries
+    real >1-plan structure even on CPU (ISSUE 11)."""
+    if ctx.gp_nodes is not None:
+        plan = _space.resolve(ctx, cfg)
+        if plan is None:
+            return ("xla",)
+        return ("gp", plan["stack_depth"], plan["opcode_block"])
     if not pallas_live:
         return ("xla",)
     plan = _space.resolve(ctx, cfg)
@@ -118,17 +126,25 @@ def _plan_key(ctx, cfg, pallas_live: bool) -> tuple:
 
 
 def _canonical_knobs(plan_key: tuple) -> dict:
-    """The PGAConfig knob dict a winning plan records in the database.
-    The XLA plan (and the default plan on ties / never-regress) records
-    all-auto knobs — applying the entry reproduces the stock config."""
+    """The knob dict a winning plan records in the database. The XLA
+    plan (and the default plan on ties / never-regress) records
+    all-auto knobs — applying the entry reproduces the stock config.
+    GP plans record the RESOLVED evaluator geometry (applying explicit
+    resolved values is the identical traced program)."""
+    knobs = {f: None for f in _db.TUNABLE_FIELDS}
+    if plan_key[0] == "gp":
+        knobs["gp_stack_depth"] = int(plan_key[1])
+        knobs["gp_opcode_block"] = int(plan_key[2])
+        return knobs
     if plan_key[0] != "pallas":
-        return {f: None for f in _db.TUNABLE_FIELDS}
+        return knobs
     _, K, _D, layout, B = plan_key
-    return {
-        "pallas_deme_size": int(K),
-        "pallas_layout": str(layout),
-        "pallas_subblock": int(B) if B and B > 1 else None,
-    }
+    knobs.update(
+        pallas_deme_size=int(K),
+        pallas_layout=str(layout),
+        pallas_subblock=int(B) if B and B > 1 else None,
+    )
+    return knobs
 
 
 class MeasurementOracle:
@@ -140,11 +156,21 @@ class MeasurementOracle:
         objective,
         settings: TunerSettings,
         use_pallas: Optional[bool] = None,
+        crossover_op=None,
+        mutate_op=None,
     ):
         self.ctx = ctx
         self.objective = objective
         self.settings = settings
         self.use_pallas = use_pallas
+        # The knob set this context searches (GP contexts evolve the
+        # evaluator axes, vector contexts the fused-breed axes) and the
+        # operators the measurement engines breed with (GP runs must
+        # time REAL structural breeding, not uniform crossover over
+        # token genes).
+        self.knob_names = _space.tuner_knobs_for(ctx)
+        self.crossover_op = crossover_op
+        self.mutate_op = mutate_op
         from libpga_tpu.config import PGAConfig
 
         probe = PGAConfig(use_pallas=use_pallas,
@@ -173,6 +199,9 @@ class MeasurementOracle:
         from libpga_tpu.config import PGAConfig
         from libpga_tpu.engine import PGA
 
+        cfg_knobs = {
+            k: v for k, v in knobs.items() if k.startswith("pallas_")
+        }
         cfg = PGAConfig(
             gene_dtype=self.ctx.gene_dtype,
             use_pallas=self.use_pallas,
@@ -180,11 +209,30 @@ class MeasurementOracle:
             tournament_size=self.ctx.tournament_size,
             selection=self.ctx.selection_kind,
             selection_param=self.ctx.selection_param,
-            **knobs,
+            **cfg_knobs,
         )
         pga = PGA(seed=0, config=cfg)
-        pga.set_objective(self.objective)
-        pga.create_population(self.ctx.pop, self.ctx.genome_len)
+        obj = self.objective
+        if self.ctx.gp_nodes is not None:
+            # GP evaluator knobs apply at objective build (user
+            # precedence semantics — gp/sr.with_knobs).
+            obj = obj.with_knobs(
+                stack_depth=knobs.get("gp_stack_depth"),
+                opcode_block=knobs.get("gp_opcode_block"),
+            )
+        pga.set_objective(obj)
+        if self.crossover_op is not None:
+            pga.set_crossover(self.crossover_op)
+        if self.mutate_op is not None:
+            pga.set_mutate(self.mutate_op)
+        if self.ctx.gp_nodes is not None:
+            from libpga_tpu.gp.encoding import random_population
+
+            pga.install_population(random_population(
+                pga.next_key(), self.ctx.pop, self.objective.gp_config
+            ))
+        else:
+            pga.create_population(self.ctx.pop, self.ctx.genome_len)
 
         def run(n: int) -> None:
             pga.run(int(n))
@@ -270,7 +318,7 @@ class MeasurementOracle:
         compile)."""
         keys: List[Optional[tuple]] = []
         for row in genomes:
-            cfg = _space.config_from_genes(row, _space.TUNER_KNOBS)
+            cfg = _space.config_from_genes(row, self.knob_names)
             if cfg not in self._inadmissible:
                 reason = _space.why_inadmissible(self.ctx, cfg)
                 self._inadmissible[cfg] = reason or ""
@@ -409,14 +457,42 @@ def autotune(
         from libpga_tpu import objectives
 
         obj = objectives.get(obj)
+    gpc = getattr(obj, "gp_config", None)
+    crossover_op = mutate_op = None
+    if gpc is not None:
+        # GP engine (ISSUE 11): tune the stack-machine evaluator axes,
+        # breeding with the real structural operators; the tuning key's
+        # operator field is the fixed "gp+gp" marker — the same key
+        # gp/sr's own DB lookup derives, so the entry round-trips.
+        if genome_len != gpc.genome_len:
+            raise ValueError(
+                f"genome_len {genome_len} != GP encoding's "
+                f"{gpc.genome_len} (2 * max_nodes)"
+            )
+        if not hasattr(obj, "with_knobs"):
+            raise ValueError(
+                "GP objectives must carry .with_knobs "
+                "(gp/sr.symbolic_regression provides it)"
+            )
+        from libpga_tpu.gp.operators import (
+            make_gp_mutate,
+            make_subtree_crossover,
+        )
+
+        crossover_kind = mutate_kind = "gp"
+        crossover_op = make_subtree_crossover(gpc)
+        mutate_op = make_gp_mutate(gpc)
     ctx = _space.SpaceContext(
         pop=pop, genome_len=genome_len, gene_dtype=gene_dtype,
         crossover_kind=crossover_kind, mutate_kind=mutate_kind,
+        gp_nodes=None if gpc is None else gpc.max_nodes,
+        gp_samples=getattr(obj, "sr_samples", 64),
     )
     oracle = MeasurementOracle(
         ctx, obj, settings, use_pallas=use_pallas,
+        crossover_op=crossover_op, mutate_op=mutate_op,
     )
-    admissible = _space.grid(ctx, _space.TUNER_KNOBS)
+    admissible = _space.grid(ctx, oracle.knob_names)
     distinct_plans = {
         _plan_key(ctx, cfg, oracle.pallas_live) for cfg in admissible
     }
@@ -442,7 +518,7 @@ def autotune(
     # the first genome_width positions).
     handle = meta.create_population(
         settings.ga_population,
-        max(4, _space.genome_width(_space.TUNER_KNOBS)),
+        max(4, _space.genome_width(oracle.knob_names)),
     )
     gens = 0
     while (
@@ -466,6 +542,8 @@ def autotune(
             deme_size=key[1], demes_per_step=key[2], layout=key[3],
             subblock=key[4],
         )
+    elif key[0] == "gp":
+        plan.update(stack_depth=key[1], opcode_block=key[2])
     entry = _db.TuningEntry(
         key=_db.current_key(
             pop, genome_len, gene_dtype, obj, crossover_kind,

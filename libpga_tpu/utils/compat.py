@@ -33,6 +33,62 @@ def force_cpu_device_count(n: int) -> None:
         jax.config.update("jax_num_cpu_devices", n)
 
 
+def install_pallas_interpret_compat() -> None:
+    """Version-gate ``pltpu.force_tpu_interpret_mode`` for old JAX.
+
+    The fused-vs-XLA agreement gates (tests/conftest.py for the test
+    harness, ``tools/gp_smoke.py`` for CI) run the Mosaic kernels on
+    CPU via ``pltpu.force_tpu_interpret_mode``, which the installed JAX
+    0.4.37 predates. The shim reproduces the two properties those gates
+    rely on: every ``pl.pallas_call`` built inside the context runs
+    with ``interpret=True``, and the Mosaic-only PRNG primitives
+    execute on CPU with the documented interpret-mode semantics
+    (``prng_random_bits`` yields all-zero bits, ``prng_seed`` is a
+    no-op). On newer JAX the real context manager is used untouched.
+    Idempotent.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    if hasattr(pltpu, "force_tpu_interpret_mode"):
+        return
+    import contextlib
+
+    import jax.numpy as jnp
+    from jax.interpreters import mlir
+    from jax._src.pallas.mosaic import primitives as _mp
+    from jax.experimental import pallas as pl
+
+    mlir.register_lowering(
+        _mp.prng_seed_p,
+        mlir.lower_fun(lambda *seeds: [], multiple_results=True),
+        "cpu",
+    )
+    mlir.register_lowering(
+        _mp.prng_random_bits_p,
+        mlir.lower_fun(
+            lambda *, shape: jnp.zeros(shape, jnp.int32),
+            multiple_results=False,
+        ),
+        "cpu",
+    )
+
+    _real_call = pl.pallas_call
+
+    @contextlib.contextmanager
+    def force_tpu_interpret_mode():
+        def interpret_call(*args, **kwargs):
+            kwargs["interpret"] = True
+            return _real_call(*args, **kwargs)
+
+        pl.pallas_call = interpret_call
+        try:
+            yield
+        finally:
+            pl.pallas_call = _real_call
+
+    pltpu.force_tpu_interpret_mode = force_tpu_interpret_mode
+
+
 def shard_map(fn, *, mesh, in_specs, out_specs, check=False):
     """``jax.shard_map`` with the pre-0.5 fallback.
 

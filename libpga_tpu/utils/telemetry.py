@@ -124,6 +124,10 @@ EVENT_FIELDS: Dict[str, tuple] = {
     # serving warm-up applied — the provenance trail of "which config
     # did this signature actually compile".
     "tuned_config": ("population_size", "genome_len", "knobs"),
+    # Genetic programming (ISSUE 11): one record per run evolving a
+    # GP objective (``gp/sr.py``), naming the postfix encoding — the
+    # observability anchor for SR-as-a-service traffic.
+    "gp_run": ("population_size", "max_nodes", "n_ops", "n_vars"),
 }
 
 

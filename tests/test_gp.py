@@ -1,0 +1,720 @@
+"""Tree-based genetic programming subsystem (ISSUE 11).
+
+Covers the acceptance gates:
+
+- the stack-machine evaluator (XLA interpreter AND interpret-mode
+  Pallas kernel) agrees with the pure-numpy reference interpreter on
+  randomized well-formed postfix programs, on max-stack-depth and
+  constant-only edge cases, and on ARBITRARY gene matrices (skip-rule
+  totality);
+- size-fair subtree crossover and subtree/point mutation provably
+  preserve strict postfix well-formedness for all admissible genome
+  pairs (randomized property test over encodings), and never exceed
+  the token capacity;
+- GP runs compose with ``pop_shards > 1`` bit-identically (final
+  best) with single-shard same-seed runs;
+- GP requests batch-serve bit-identically to the engine path, in
+  their own shape buckets;
+- the tuning space exposes a >1-plan GP knob space ON CPU, the SR
+  reverse-registry name derives tuning-DB keys without colliding with
+  builtin objective names, and resolution precedence holds;
+- vector-genome engines lower BYTE-IDENTICAL StableHLO with the GP
+  subsystem imported and exercised (structural guard).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from libpga_tpu import PGA, GPConfig, PGAConfig, TelemetryConfig
+from libpga_tpu.gp import encoding as enc
+from libpga_tpu.gp import operators as gpo
+from libpga_tpu.gp.interpreter import make_eval_rows, stack_predict
+from libpga_tpu.gp.reference import reference_predict, reference_scores
+from libpga_tpu.gp.sr import make_dataset, symbolic_regression
+
+GP = GPConfig(max_nodes=10, n_vars=2)
+SMALL = GPConfig(
+    max_nodes=8, n_vars=2, consts=(1.0, 2.0), unary=("neg",),
+    binary=("add", "sub", "mul"),
+)
+CONFIGS = [
+    GP,
+    SMALL,
+    GPConfig(max_nodes=12, n_vars=3, unary=(), binary=("add", "mul")),
+    GPConfig(max_nodes=6, n_vars=1, consts=()),
+]
+
+
+def _rand_pop(gp, n, seed=0):
+    return enc.random_population(jax.random.key(seed), n, gp)
+
+
+def _dataset(gp, n=24, seed=0):
+    return make_dataset(
+        lambda *xs: xs[0] * xs[-1] + xs[0],
+        n_samples=n, n_vars=gp.n_vars, seed=seed,
+    )
+
+
+# ------------------------------------------------------------- encoding
+
+
+class TestEncoding:
+    def test_roundtrip_and_render(self):
+        g = enc.encode_program(
+            [("var", 0), ("var", 1), "mul", ("var", 0), "add"], GP
+        )
+        assert enc.is_well_formed(g, GP)
+        assert enc.program_length(g, GP) == 5
+        assert enc.decode_expression(g, GP) == "((x0 * x1) + x0)"
+
+    def test_opcode_table_layout(self):
+        names = GP.op_names()
+        assert names[0] == "pad" and names[1] == "var"
+        assert len(names) == len(GP.op_arities())
+        no_const = GPConfig(max_nodes=6, consts=())
+        assert "const" not in no_const.op_names()
+
+    @pytest.mark.parametrize("gp", CONFIGS)
+    def test_random_programs_well_formed(self, gp):
+        pop = np.asarray(_rand_pop(gp, 128, seed=3))
+        assert all(enc.is_well_formed(r, gp) for r in pop)
+        lengths = [enc.program_length(r, gp) for r in pop]
+        assert max(lengths) <= gp.max_nodes
+        assert min(lengths) >= 1
+
+    def test_no_unary_grow_yields_odd_lengths(self):
+        gp = CONFIGS[2]
+        assert not gp.unary
+        pop = np.asarray(_rand_pop(gp, 64, seed=5))
+        assert all(enc.program_length(r, gp) % 2 == 1 for r in pop)
+
+    def test_structure_spans_match_bruteforce(self):
+        gp = SMALL
+        pop = _rand_pop(gp, 32, seed=9)
+        st = enc.program_structure(pop, gp)
+        arr = np.asarray(pop)
+        ops = np.clip(
+            np.floor(arr[:, 0::2] * gp.n_ops).astype(int), 0, gp.n_ops - 1
+        )
+        arity = np.asarray(gp.op_arities())
+        for p in range(arr.shape[0]):
+            n = enc.program_length(arr[p], gp)
+            for i in range(n):
+                # brute force: walk back until the slice's net stack
+                # effect is exactly +1 (a complete subtree).
+                need = 1
+                j = i
+                while True:
+                    need += arity[ops[p, j]] - 1
+                    if need == 0:
+                        break
+                    j -= 1
+                assert int(st.span[p, i]) == i - j + 1
+                assert int(st.start[p, i]) == j
+
+    def test_canonicalize_preserves_semantics_and_idempotent(self):
+        gp = GP
+        X, _ = _dataset(gp)
+        rnd = np.random.default_rng(2).uniform(
+            0, 1, (64, gp.genome_len)
+        ).astype(np.float32)
+        canon = np.asarray(enc.canonicalize(jnp.asarray(rnd), gp))
+        a = reference_predict(rnd, X, gp)
+        b = reference_predict(canon, X, gp)
+        assert np.allclose(a, b, rtol=1e-6, atol=1e-6, equal_nan=True)
+        twice = np.asarray(enc.canonicalize(jnp.asarray(canon), gp))
+        assert np.array_equal(canon, twice)
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            GPConfig(max_nodes=1)
+        with pytest.raises(ValueError):
+            GPConfig(unary=("nope",))
+        with pytest.raises(ValueError):
+            GPConfig(max_nodes=10, opcode_block=3)
+
+
+# ----------------------------------------------------------- evaluators
+
+
+class TestInterpreter:
+    @pytest.mark.parametrize("gp", CONFIGS)
+    def test_matches_reference_on_well_formed(self, gp):
+        X, _ = _dataset(gp)
+        pop = _rand_pop(gp, 96, seed=11)
+        got = np.asarray(stack_predict(pop, jnp.asarray(X.T), gp))
+        want = reference_predict(np.asarray(pop), X, gp)
+        assert np.allclose(got, want, rtol=1e-5, atol=1e-5, equal_nan=True)
+
+    def test_matches_reference_on_arbitrary_genomes(self):
+        gp = GP
+        X, _ = _dataset(gp)
+        rnd = np.random.default_rng(7).uniform(
+            0, 1, (64, gp.genome_len)
+        ).astype(np.float32)
+        got = np.asarray(stack_predict(jnp.asarray(rnd), jnp.asarray(X.T), gp))
+        want = reference_predict(rnd, X, gp)
+        assert np.allclose(got, want, rtol=1e-5, atol=1e-5, equal_nan=True)
+
+    def test_max_depth_and_constant_only_edges(self):
+        gp = SMALL
+        X, _ = _dataset(gp)
+        # Max stack pressure: T//2 terminals then binary reductions —
+        # the deepest profile a strictly well-formed program of this
+        # capacity reaches (2k-1 tokens, peak depth k).
+        k = gp.max_nodes // 2
+        toks = [("var", 0)] * k + ["add"] * (k - 1)
+        deep = enc.encode_program(toks, gp)
+        assert enc.is_well_formed(deep, gp)
+        const_only = enc.encode_program([("const", 1)], gp)
+        empty = np.full(gp.genome_len, gp.pad_gene, np.float32)
+        batch = jnp.asarray(np.stack([deep, const_only, empty]))
+        got = np.asarray(stack_predict(batch, jnp.asarray(X.T), gp))
+        want = reference_predict(np.asarray(batch), X, gp)
+        assert np.allclose(got, want, rtol=1e-6, atol=1e-6)
+        assert np.allclose(got[1], 2.0)  # consts[1]
+        assert np.all(got[2] == 0.0)  # empty program reads 0
+
+    def test_scores_sanitize_nonfinite(self):
+        gp = GPConfig(max_nodes=8, n_vars=1, unary=("exp",),
+                      binary=("mul", "add"))
+        X = np.full((8, 1), 80.0, np.float32)  # exp(80) overflows f32
+        y = np.zeros(8, np.float32)
+        prog = enc.encode_program(
+            [("var", 0), "exp", "exp"], gp
+        )
+        rows = make_eval_rows(gp, X, y)
+        s = np.asarray(rows(jnp.asarray(prog[None, :])))
+        assert s[0] == -np.inf  # sanitized, not NaN
+        ref = reference_scores(prog[None, :], X, y, gp)
+        assert ref[0] == -np.inf
+
+    def test_knobs_change_plan_not_semantics(self):
+        gp = GP
+        X, y = _dataset(gp)
+        pop = _rand_pop(gp, 32, seed=1)
+        base = np.asarray(make_eval_rows(gp, X, y)(pop))
+        for S, B in ((32, 1), (16, 5), (64, 2)):
+            if gp.max_nodes % B:
+                continue
+            got = np.asarray(
+                make_eval_rows(gp, X, y, stack_depth=S, opcode_block=B)(pop)
+            )
+            assert np.allclose(base, got, rtol=1e-6, atol=1e-6)
+
+    def test_invalid_knobs_raise(self):
+        gp = GP
+        X, y = _dataset(gp)
+        with pytest.raises(ValueError):
+            make_eval_rows(gp, X, y, stack_depth=4)(_rand_pop(gp, 4))
+        with pytest.raises(ValueError):
+            make_eval_rows(gp, X, y, opcode_block=3)(_rand_pop(gp, 4))
+
+
+class TestFusedKernel:
+    def test_plan_resolution_and_gates(self):
+        from libpga_tpu.ops.gp_eval import GP_ROW_POOL, gp_eval_plan
+
+        gp = GPConfig(max_nodes=16, n_vars=2)
+        plan = gp_eval_plan(256, gp, 48)
+        assert plan["path"] == "fused"
+        assert plan["stack_depth"] == 16 and plan["opcode_block"] == 1
+        assert plan["rows_per_block"] in GP_ROW_POOL
+        assert plan["grid"] * plan["rows_per_block"] == 256
+        with pytest.raises(ValueError):
+            gp_eval_plan(256, gp, 48, stack_depth=8)
+        with pytest.raises(ValueError):
+            gp_eval_plan(256, gp, 48, opcode_block=3)
+        # A pop no pool entry divides: the XLA interpreter serves.
+        assert gp_eval_plan(100, gp, 48)["path"] == "xla"
+
+    def test_fused_agrees_with_interpreter(self):
+        from jax.experimental.pallas import tpu as pltpu
+
+        from libpga_tpu.ops.gp_eval import make_gp_eval
+
+        gp = GPConfig(max_nodes=16, n_vars=2)
+        X, y = make_dataset(
+            lambda a, b: a * b + a, n_samples=48, n_vars=2
+        )
+        pop = enc.random_population(jax.random.key(0), 128, gp)
+        want = np.asarray(make_eval_rows(gp, X, y)(pop))
+        with pltpu.force_tpu_interpret_mode():
+            for kw in ({}, {"stack_depth": 32, "opcode_block": 4}):
+                got = np.asarray(make_gp_eval(gp, X, y, pop=128, **kw)(pop))
+                assert np.allclose(want, got, rtol=1e-5, atol=1e-5), kw
+
+
+# ------------------------------------------------------------ operators
+
+
+class TestOperators:
+    @pytest.mark.parametrize("gp", CONFIGS)
+    def test_crossover_closure_property(self, gp):
+        xo = gpo.make_subtree_crossover(gp)
+        pop = _rand_pop(gp, 200, seed=21)
+        perm = jax.random.permutation(jax.random.key(22), 200)
+        rand = jax.random.uniform(jax.random.key(23), (200, xo.rand_cols))
+        kids = np.asarray(xo.batched(pop, pop[perm], rand))
+        assert all(enc.is_well_formed(r, gp) for r in kids)
+        assert max(enc.program_length(r, gp) for r in kids) <= gp.max_nodes
+
+    @pytest.mark.parametrize("gp", CONFIGS)
+    def test_mutation_closure_property(self, gp):
+        pop = _rand_pop(gp, 200, seed=31)
+        for make in (
+            lambda: gpo.make_subtree_mutate(gp, rate=0.9),
+            lambda: gpo.make_gp_point_mutate(gp, rate=0.9),
+            lambda: gpo.make_gp_mutate(gp, 0.7, 0.7),
+        ):
+            op = make()
+            rand = jax.random.uniform(
+                jax.random.key(32), (200, op.rand_cols)
+            )
+            out = np.asarray(op.batched(pop, rand))
+            assert all(enc.is_well_formed(r, gp) for r in out)
+
+    def test_operators_total_on_arbitrary_genomes(self):
+        gp = GP
+        rnd = jnp.asarray(np.random.default_rng(5).uniform(
+            0, 1, (64, gp.genome_len)
+        ).astype(np.float32))
+        xo = gpo.make_subtree_crossover(gp)
+        kids = xo.batched(
+            rnd, _rand_pop(gp, 64),
+            jax.random.uniform(jax.random.key(0), (64, 2)),
+        )
+        assert np.isfinite(np.asarray(kids)).all()  # total, no crash
+        X, _ = _dataset(gp)
+        # children still evaluate identically under both interpreters
+        a = np.asarray(stack_predict(kids, jnp.asarray(X.T), gp))
+        b = reference_predict(np.asarray(kids), X, gp)
+        assert np.allclose(a, b, rtol=1e-5, atol=1e-5, equal_nan=True)
+
+    def test_point_mutation_preserves_arity(self):
+        gp = GP
+        pop = _rand_pop(gp, 128, seed=41)
+        op = gpo.make_gp_point_mutate(gp, rate=1.0)
+        rand = jax.random.uniform(jax.random.key(42), (128, op.rand_cols))
+        out = np.asarray(op.batched(pop, rand))
+        arity = np.asarray(gp.op_arities())
+        before = np.asarray(enc.decode_ops(pop, gp))
+        after = np.asarray(enc.decode_ops(jnp.asarray(out), gp))
+        changed = before != after
+        assert changed.any()  # rate 1.0 fires
+        assert (arity[before[changed]] == arity[after[changed]]).all()
+
+    def test_param_batched_matches_baked_rate(self):
+        gp = SMALL
+        pop = _rand_pop(gp, 64, seed=51)
+        op = gpo.make_gp_mutate(gp, 0.4, 0.6)
+        rand = jax.random.uniform(jax.random.key(52), (64, op.rand_cols))
+        baked = np.asarray(op.batched(pop, rand))
+        runtime = np.asarray(op.param_batched(
+            pop, rand, jnp.float32(0.4), jnp.float32(0.6)
+        ))
+        assert np.array_equal(baked, runtime)
+
+
+# ----------------------------------------------------- engine + serving
+
+
+def _gp_solver(seed, gp=SMALL, pop=256, **cfg):
+    X, y = _dataset(gp, n=32, seed=0)
+    cfg.setdefault("use_pallas", False)
+    cfg.setdefault("selection", "truncation")
+    cfg.setdefault("elitism", 2)
+    pga = PGA(seed=seed, config=PGAConfig(**cfg))
+    pga.set_objective(symbolic_regression(X, y, gp=gp))
+    pga.set_crossover(gpo.make_subtree_crossover(gp))
+    pga.set_mutate(gpo.make_gp_mutate(gp, 0.4, 0.6))
+    h = pga.install_population(
+        enc.random_population(jax.random.key(seed), pop, gp)
+    )
+    return pga, h
+
+
+class TestEngine:
+    def test_run_improves_and_is_deterministic(self):
+        pga, h = _gp_solver(7)
+        pga.evaluate(h)
+        before = float(jnp.max(pga.population(h).scores))
+        pga.run(15)
+        g1, s1 = pga.get_best_with_score(h)
+        assert s1 >= before
+        pga2, h2 = _gp_solver(7)
+        pga2.run(15)
+        g2, s2 = pga2.get_best_with_score(h2)
+        assert np.array_equal(g1, g2)
+        assert np.float32(s1).tobytes() == np.float32(s2).tobytes()
+
+    def test_install_population_validates(self):
+        pga = PGA(seed=0, config=PGAConfig(use_pallas=False))
+        with pytest.raises(ValueError):
+            pga.install_population(np.zeros(8, np.float32))
+        h = pga.install_population(np.zeros((4, 8), np.float32))
+        assert pga.population(h).size == 4
+        assert float(pga.population(h).scores[0]) == -np.inf
+
+    def test_gp_run_event_schema(self, tmp_path):
+        from libpga_tpu.utils import telemetry
+
+        path = str(tmp_path / "events.jsonl")
+        gp = SMALL
+        X, y = _dataset(gp, n=16)
+        pga = PGA(seed=0, config=PGAConfig(
+            use_pallas=False,
+            telemetry=TelemetryConfig(history_gens=8, events_path=path),
+        ))
+        pga.set_objective(symbolic_regression(X, y, gp=gp))
+        pga.set_crossover(gpo.make_subtree_crossover(gp))
+        pga.set_mutate(gpo.make_gp_mutate(gp))
+        pga.install_population(
+            enc.random_population(jax.random.key(1), 64, gp)
+        )
+        pga.run(2)
+        records = telemetry.validate_log(path)
+        gp_runs = [r for r in records if r["event"] == "gp_run"]
+        assert len(gp_runs) == 1
+        rec = gp_runs[0]
+        assert rec["max_nodes"] == gp.max_nodes
+        assert rec["n_ops"] == gp.n_ops
+        assert rec["n_vars"] == gp.n_vars
+
+    def test_no_gp_run_event_for_vector_objectives(self, tmp_path):
+        from libpga_tpu.utils import telemetry
+
+        path = str(tmp_path / "events.jsonl")
+        pga = PGA(seed=0, config=PGAConfig(
+            use_pallas=False,
+            telemetry=TelemetryConfig(history_gens=8, events_path=path),
+        ))
+        pga.create_population(64, 16)
+        pga.set_objective("onemax")
+        pga.run(2)
+        kinds = {r["event"] for r in telemetry.validate_log(path)}
+        assert "gp_run" not in kinds
+
+    def test_islands_compose(self):
+        gp = SMALL
+        X, y = _dataset(gp, n=16)
+        pga = PGA(seed=3, config=PGAConfig(use_pallas=False))
+        pga.set_objective(symbolic_regression(X, y, gp=gp))
+        pga.set_crossover(gpo.make_subtree_crossover(gp))
+        pga.set_mutate(gpo.make_gp_mutate(gp))
+        for i in range(4):
+            pga.install_population(
+                enc.random_population(jax.random.key(10 + i), 64, gp)
+            )
+        gens = pga.run_islands(8, 4, 0.1)
+        assert gens == 8
+        for i in range(4):
+            from libpga_tpu.engine import PopulationHandle
+
+            g = np.asarray(pga.population(PopulationHandle(i)).genomes)
+            assert all(enc.is_well_formed(r, gp) for r in g)
+
+
+@pytest.mark.skipif(
+    len(jax.devices()) < 2, reason="needs the multi-device CPU harness"
+)
+class TestShards:
+    def test_sharded_final_best_bit_identical(self):
+        """The ISSUE 11 composition gate: a GP run at pop_shards=2
+        reaches the bit-identical final best as the same-seed
+        single-shard run (the round-12 panmictic-equivalence contract,
+        now over tree genomes — the optimum here is EXACT recovery, so
+        both runs' best scores must be bit-equal -0.0, not merely
+        close)."""
+        X, y = make_dataset(
+            lambda a, b: a * a + b, n_samples=32, n_vars=2, seed=0
+        )
+
+        def final_best(S):
+            pga = PGA(seed=11, config=PGAConfig(
+                use_pallas=False, selection="truncation", elitism=2,
+                pop_shards=S,
+            ))
+            pga.set_objective(symbolic_regression(X, y, gp=SMALL))
+            pga.set_crossover(gpo.make_subtree_crossover(SMALL))
+            pga.set_mutate(gpo.make_gp_mutate(SMALL, 0.4, 0.6))
+            h = pga.install_population(
+                enc.random_population(jax.random.key(11), 128, SMALL)
+            )
+            gens = pga.run(80, target=0.0)
+            g, s = pga.get_best_with_score(h)
+            return gens, g, np.float32(s)
+
+        gens1, g1, s1 = final_best(1)
+        assert gens1 < 80, "single-shard run never recovered the target"
+        gens2, g2, s2 = final_best(2)
+        assert gens2 < 80, "sharded run never recovered the target"
+        assert s1.tobytes() == s2.tobytes()
+        assert enc.is_well_formed(g2, SMALL)
+
+
+class TestServing:
+    def test_batched_gp_run_bit_identical_to_engine(self):
+        from libpga_tpu.serving import BatchedRuns, RunRequest
+
+        gp = SMALL
+        X, y = _dataset(gp, n=32, seed=0)
+        cfg = PGAConfig(use_pallas=False, selection="truncation",
+                        elitism=2)
+        # numpy snapshot FIRST: the engine donates the installed
+        # buffer to its run program.
+        genomes = np.asarray(
+            enc.random_population(jax.random.key(99), 128, gp)
+        )
+
+        pga = PGA(seed=77, config=cfg)
+        pga.set_objective(symbolic_regression(X, y, gp=gp))
+        pga.set_crossover(gpo.make_subtree_crossover(gp))
+        pga.set_mutate(gpo.make_gp_mutate(gp, 0.4, 0.6))
+        h = pga.install_population(genomes)
+        pga.run(6)
+
+        ex = BatchedRuns(
+            symbolic_regression(X, y, gp=gp),
+            config=cfg,
+            crossover=gpo.make_subtree_crossover(gp),
+            mutate_kind=gpo.make_gp_mutate(gp, 0.4, 0.6),
+        )
+        res = ex.run([RunRequest(
+            size=128, genome_len=gp.genome_len, n=6, seed=77,
+            genomes=genomes,
+            mutation_rate=0.4, mutation_sigma=0.6,
+        )])[0]
+        assert np.array_equal(
+            np.asarray(res.genomes), np.asarray(pga.population(h).genomes)
+        )
+
+    def test_bucket_signatures_separate_encodings(self):
+        from libpga_tpu.serving import BatchedRuns, RunRequest
+
+        gp_a = SMALL
+        gp_b = GPConfig(
+            max_nodes=8, n_vars=2, consts=(1.0,), unary=("neg",),
+            binary=("add", "sub", "mul"),
+        )
+        X, y = _dataset(gp_a, n=16)
+        cfg = PGAConfig(use_pallas=False)
+
+        def executor(gp):
+            return BatchedRuns(
+                symbolic_regression(X, y, gp=gp), config=cfg,
+                crossover=gpo.make_subtree_crossover(gp),
+                mutate_kind=gpo.make_gp_mutate(gp),
+            )
+
+        req = RunRequest(size=64, genome_len=16, n=2, seed=0)
+        sig_a = executor(gp_a).signature(req)
+        sig_b = executor(gp_b).signature(req)
+        assert sig_a != sig_b
+        vec = BatchedRuns("onemax", config=cfg)
+        assert vec.signature(req) != sig_a
+
+
+# --------------------------------------------------------------- tuning
+
+
+class TestTuning:
+    def test_gp_space_has_multiple_plans_on_cpu(self):
+        from libpga_tpu.tuning import space as S
+
+        ctx = S.SpaceContext(
+            pop=256, genome_len=32, gp_nodes=16, gp_samples=48,
+            crossover_kind="gp", mutate_kind="gp",
+        )
+        assert S.tuner_knobs_for(ctx) == S.GP_KNOBS
+        cfgs = S.grid(ctx)
+        plans = {
+            (p["stack_depth"], p["opcode_block"])
+            for p in (S.resolve(ctx, c) for c in cfgs)
+        }
+        assert len(plans) > 1, "GP knobs must resolve to >1 plan on CPU"
+
+    def test_gp_knob_admissibility(self):
+        from libpga_tpu.tuning import space as S
+
+        gctx = S.SpaceContext(pop=256, genome_len=32, gp_nodes=16)
+        vctx = S.SpaceContext(pop=256, genome_len=32)
+        assert S.why_inadmissible(
+            gctx, S.KernelConfig(gp_stack_depth=8)
+        ) is not None  # below the bound
+        assert S.why_inadmissible(
+            gctx, S.KernelConfig(deme_size=256)
+        ) is not None  # breed knobs inert for GP
+        assert S.why_inadmissible(
+            vctx, S.KernelConfig(gp_stack_depth=32)
+        ) is not None  # gp knobs need a GP context
+        assert S.why_inadmissible(
+            gctx, S.KernelConfig(gp_stack_depth=32, gp_opcode_block=4)
+        ) is None
+
+    def test_reverse_registry_name_and_no_collision(self):
+        from libpga_tpu import objectives
+        from libpga_tpu.tuning import db as D
+
+        gp = SMALL
+        X, y = _dataset(gp, n=16)
+        obj = symbolic_regression(X, y, gp=gp)
+        name = D.objective_class(obj)
+        assert name.startswith("gp_sr/")
+        assert name not in objectives.names()
+        # same data + encoding -> same key; different data -> different
+        obj2 = symbolic_regression(X, y, gp=gp)
+        assert D.objective_class(obj2) == name
+        X3, y3 = _dataset(gp, n=16, seed=9)
+        assert D.objective_class(
+            symbolic_regression(X3, y3, gp=gp)
+        ) != name
+        # key round-trips through the DB string form
+        key = D.current_key(64, gp.genome_len, np.float32, obj, "gp", "gp")
+        assert D.TuningKey.from_dict(key.as_dict()) == key
+
+    def test_entry_with_gp_knobs_roundtrips(self, tmp_path):
+        from libpga_tpu.tuning import db as D
+
+        key = D.TuningKey(
+            pop=64, genome_len=16, dtype="float32", backend="cpu",
+            device_kind="cpu", objective="gp_sr/abc", operators="gp+gp",
+        )
+        entry = D.TuningEntry(
+            key=key,
+            knobs={"gp_stack_depth": 32, "gp_opcode_block": 4},
+            gens_per_sec=10.0, created=1.0,
+        )
+        db = D.TuningDB()
+        db.add(entry)
+        path = str(tmp_path / "t.json")
+        db.save(path)
+        loaded = D.TuningDB.load(path)
+        got = loaded.lookup(key)
+        assert got.knobs["gp_stack_depth"] == 32
+        assert got.knobs["gp_opcode_block"] == 4
+
+    def test_sr_resolution_precedence(self, tmp_path):
+        from libpga_tpu.tuning import db as D
+
+        gp = GPConfig(max_nodes=16, n_vars=2)
+        X, y = make_dataset(
+            lambda a, b: a + b, n_samples=16, n_vars=2
+        )
+        obj = symbolic_regression(X, y, gp=gp)
+        key = D.current_key(64, gp.genome_len, np.float32, obj, "gp", "gp")
+        db = D.TuningDB()
+        db.add(D.TuningEntry(
+            key=key,
+            knobs={"gp_stack_depth": 32, "gp_opcode_block": 4},
+            gens_per_sec=1.0, created=1.0,
+        ))
+        path = str(tmp_path / "t.json")
+        db.save(path)
+        pop = _rand_pop(gp, 64)
+        try:
+            D.set_tuning_db(path)
+            obj.rows(pop)
+            (knobs,) = [
+                v for k, v in obj.resolved.items() if k[0] == 64
+            ]
+            assert knobs[:2] == (32, 4)
+            assert knobs[2] == {
+                "gp_stack_depth": "db", "gp_opcode_block": "db",
+            }
+            user = symbolic_regression(X, y, gp=gp, stack_depth=64)
+            user.rows(pop)
+            (uk,) = [
+                v for k, v in user.resolved.items() if k[0] == 64
+            ]
+            assert uk[0] == 64 and uk[1] == 4  # user beats db, db fills
+        finally:
+            D.set_tuning_db(None)
+
+    def test_resolve_config_knobs_reads_gp_fields_as_none(self):
+        from libpga_tpu.tuning import db as D
+
+        knobs, prov = D.resolve_config_knobs(PGAConfig(), None)
+        assert knobs["gp_stack_depth"] is None
+        assert knobs["gp_opcode_block"] is None
+        assert prov is None
+
+
+# ---------------------------------------------------------- C ABI bridge
+
+
+class TestCapiBridge:
+    def test_gp_config_sr_objective_and_error_surfaces(self):
+        from libpga_tpu import capi_bridge as b
+
+        h = b.init(123)
+        try:
+            X = np.random.default_rng(0).uniform(
+                -1, 1, (16, 2)
+            ).astype(np.float32)
+            y = (X[:, 0] ** 2 + X[:, 1]).astype(np.float32)
+            # Error surfaces BEFORE any state: SR needs gp_config,
+            # degenerate encodings are rejected.
+            with pytest.raises(ValueError):
+                b.set_objective_sr(h, X.tobytes(), y.tobytes(), 16)
+            with pytest.raises(ValueError):
+                b.gp_config(h, 1, 2, -1.0)
+            with pytest.raises(ValueError):
+                b.gp_create_population(h, 64)
+            assert b.gp_n_vars(h) == -1
+            # The real config installs; errors above left nothing.
+            b.gp_config(h, 8, 2, -1.0)
+            assert b.gp_n_vars(h) == 2
+            idx = b.gp_create_population(h, 64)
+            b.set_objective_sr(h, X.tobytes(), y.tobytes(), 16)
+            # Bad sample count rejected, installed objective intact
+            # (proven by running).
+            with pytest.raises(ValueError):
+                b.set_objective_sr(h, X.tobytes(), y.tobytes(), 0)
+            assert b.run(h, 3, 0, 0.0) == 3
+            arr = np.frombuffer(b.get_best(h, idx), np.float32)
+            assert arr.shape == (16,)
+            assert ((arr >= 0) & (arr < 1)).all()
+        finally:
+            b.deinit(h)
+
+
+# --------------------------------------------------- structural guards
+
+
+class TestByteIdentity:
+    def test_vector_engine_stablehlo_unchanged_by_gp(self):
+        """ISSUE 11 bugfix guard: a vector-genome engine's traced run
+        program is BYTE-IDENTICAL with the GP subsystem imported and
+        exercised (the subsystem must be purely additive — no global
+        state, no monkey-patching)."""
+
+        def lowered_text():
+            pga = PGA(seed=0, config=PGAConfig(use_pallas=False))
+            pga.create_population(128, 16)
+            pga.set_objective("onemax")
+            fn = pga._compiled_run(128, 16)
+            args = (
+                pga.population(pga._handles()[0]).genomes,
+                jax.random.key(1), jnp.int32(3), jnp.float32(jnp.inf),
+                pga._mutate_params(),
+            )
+            return fn.lower(*args).as_text()
+
+        before = lowered_text()
+        # Exercise the subsystem end to end, then re-lower.
+        gp = SMALL
+        X, y = _dataset(gp, n=8)
+        obj = symbolic_regression(X, y, gp=gp)
+        obj.rows(_rand_pop(gp, 16))
+        op = gpo.make_gp_mutate(gp)
+        op.batched(
+            _rand_pop(gp, 8),
+            jax.random.uniform(jax.random.key(0), (8, op.rand_cols)),
+        )
+        after = lowered_text()
+        assert before == after

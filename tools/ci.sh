@@ -72,6 +72,18 @@
 #                                 provenance + tuned_config event),
 #                                 and db=None leaves the traced run
 #                                 program byte-identical.
+#  12. gp smoke                  — tools/gp_smoke.py (ISSUE 11):
+#                                 random-grown postfix programs are
+#                                 strictly well-formed and the GP
+#                                 operators preserve that; the fused
+#                                 Pallas stack-machine evaluator
+#                                 (interpret mode) agrees with the XLA
+#                                 interpreter at two plans; a
+#                                 seed-pinned symbolic-regression run
+#                                 recovers a known expression to exact
+#                                 zero RMSE bit-identically across two
+#                                 runs; the gp_run event kind is
+#                                 schema-valid.
 # Exits nonzero on the first failing stage.
 set -e
 cd "$(dirname "$0")/.."
@@ -408,5 +420,8 @@ PY
 
 echo "== ci: autotune smoke =="
 JAX_PLATFORMS=cpu python tools/autotune_smoke.py
+
+echo "== ci: gp smoke =="
+JAX_PLATFORMS=cpu python tools/gp_smoke.py
 
 echo "== ci: all stages passed =="

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""CI smoke for the GP subsystem (ISSUE 11) — tools/ci.sh stage 12.
+
+Four gates, all CPU (no chip needed):
+
+1. well-formedness machinery: random-grown programs are strictly
+   well-formed and the structural operators preserve that for a
+   randomized batch of pairs (the property the full test suite proves
+   across encodings — this is the fast canary);
+2. fused-vs-XLA evaluator agreement: the Pallas VMEM-stack kernel
+   (interpret mode off-TPU) scores a population within float tolerance
+   of the XLA interpreter, at the default AND a non-default
+   (gp_stack_depth, gp_opcode_block) plan;
+3. deterministic exact recovery: a seed-pinned symbolic-regression run
+   evolves the known target expression ``x0*x0 + x1`` to EXACT zero
+   RMSE, and a second identical run reproduces the best genome
+   BIT-IDENTICALLY (same generation count, same bytes, same decoded
+   expression);
+4. the ``gp_run`` event kind is emitted once per GP run and validates
+   against the versioned EVENT_FIELDS schema.
+
+Exits nonzero on the first failing gate.
+"""
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+
+    from libpga_tpu import PGA, GPConfig, PGAConfig, TelemetryConfig
+    from libpga_tpu.gp import encoding as enc
+    from libpga_tpu.gp import operators as gpo
+    from libpga_tpu.gp.interpreter import make_eval_rows
+    from libpga_tpu.gp.sr import make_dataset, symbolic_regression
+    from libpga_tpu.utils import telemetry
+    from libpga_tpu.utils.compat import install_pallas_interpret_compat
+
+    gp = GPConfig(
+        max_nodes=8, n_vars=2, consts=(1.0, 2.0), unary=("neg",),
+        binary=("add", "sub", "mul"),
+    )
+    X, y = make_dataset(
+        lambda a, b: a * a + b, n_samples=32, n_vars=2, seed=0
+    )
+
+    # -- gate 1: well-formedness by construction + operator closure
+    pop = enc.random_population(jax.random.key(1), 128, gp)
+    arr = np.asarray(pop)
+    if not all(enc.is_well_formed(r, gp) for r in arr):
+        return fail("random-grown programs are not all well-formed")
+    xo = gpo.make_subtree_crossover(gp)
+    mut = gpo.make_gp_mutate(gp, 0.7, 0.7)
+    perm = jax.random.permutation(jax.random.key(2), 128)
+    kids = xo.batched(
+        pop, pop[perm], jax.random.uniform(jax.random.key(3), (128, 2))
+    )
+    kids = mut.batched(
+        kids, jax.random.uniform(jax.random.key(4), (128, mut.rand_cols))
+    )
+    kids = np.asarray(kids)
+    bad = sum(not enc.is_well_formed(r, gp) for r in kids)
+    if bad:
+        return fail(f"{bad}/128 bred children are not well-formed")
+    if max(enc.program_length(r, gp) for r in kids) > gp.max_nodes:
+        return fail("breeding exceeded the token capacity")
+    print("gp smoke: well-formedness + operator closure OK (128 pairs)")
+
+    # -- gate 2: fused kernel (interpret mode) vs XLA interpreter
+    install_pallas_interpret_compat()
+    from jax.experimental.pallas import tpu as pltpu
+
+    from libpga_tpu.ops.gp_eval import make_gp_eval
+
+    want = np.asarray(make_eval_rows(gp, X, y)(pop))
+    with pltpu.force_tpu_interpret_mode():
+        for kw in ({}, {"stack_depth": 32, "opcode_block": 4}):
+            got = np.asarray(make_gp_eval(gp, X, y, pop=128, **kw)(pop))
+            if not np.allclose(want, got, rtol=1e-5, atol=1e-5):
+                return fail(
+                    f"fused evaluator disagrees with the XLA "
+                    f"interpreter at {kw or 'default knobs'}: "
+                    f"max |diff| = {np.max(np.abs(want - got))}"
+                )
+    print("gp smoke: fused-vs-XLA evaluator agreement OK (2 plans)")
+
+    # -- gates 3+4: deterministic exact recovery + gp_run schema
+    def solve():
+        path = tempfile.mktemp(suffix=".jsonl", prefix="pga-gp-smoke-")
+        pga = PGA(seed=0, config=PGAConfig(
+            use_pallas=False, selection="truncation", elitism=2,
+            telemetry=TelemetryConfig(history_gens=16, events_path=path),
+        ))
+        pga.set_objective(symbolic_regression(X, y, gp=gp))
+        pga.set_crossover(gpo.make_subtree_crossover(gp))
+        pga.set_mutate(gpo.make_gp_mutate(gp, 0.4, 0.6))
+        h = pga.install_population(
+            enc.random_population(jax.random.key(0), 64, gp)
+        )
+        gens = pga.run(80, target=0.0)
+        best, score = pga.get_best_with_score(h)
+        return gens, best, np.float32(score), path
+
+    gens1, best1, s1, path1 = solve()
+    if not (gens1 < 80 and s1 == np.float32(0.0)):
+        return fail(
+            f"SR run failed to recover the target exactly "
+            f"(gens={gens1}, score={s1})"
+        )
+    expr = enc.decode_expression(best1, gp)
+    gens2, best2, s2, _ = solve()
+    if gens2 != gens1 or best1.tobytes() != best2.tobytes():
+        return fail(
+            f"SR recovery is not bit-deterministic: gens {gens1} vs "
+            f"{gens2}, genomes equal={np.array_equal(best1, best2)}"
+        )
+    if enc.decode_expression(best2, gp) != expr:
+        return fail("decoded expressions diverge across identical runs")
+    print(
+        f"gp smoke: deterministic exact recovery OK "
+        f"({gens1} generations, best = {expr})"
+    )
+
+    records = telemetry.validate_log(path1)  # raises on schema breaks
+    gp_runs = [r for r in records if r["event"] == "gp_run"]
+    if len(gp_runs) != 1:
+        return fail(f"expected exactly 1 gp_run event, got {len(gp_runs)}")
+    rec = gp_runs[0]
+    if rec["max_nodes"] != gp.max_nodes or rec["n_ops"] != gp.n_ops:
+        return fail(f"gp_run record carries wrong encoding: {rec}")
+    print(
+        f"gp smoke: gp_run event schema OK "
+        f"({len(records)} schema-valid records)"
+    )
+    return 0
+
+
+def fail(msg: str) -> int:
+    print(f"gp smoke FAILED: {msg}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
