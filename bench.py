@@ -1150,6 +1150,137 @@ def gp_arm(rounds: int = ROUNDS) -> dict:
     }
 
 
+STREAM_POP = 4096
+STREAM_LEN = 64
+STREAM_CHURN_POP = 512
+STREAM_CHURN_LEN = 16
+
+
+def streaming_arm(rounds: int = ROUNDS) -> dict:
+    """``--streaming``: the streaming evolution service arm (ISSUE 12).
+
+    Three figures, interleaved per round per the house protocol:
+
+    - ``streaming_first_ask_ms_{cold,warm}`` — time from ``acquire`` to
+      the first ask+step completing, cold (a NEVER-seen signature: the
+      genome length varies per round, so every cold sample pays a real
+      trace+compile) vs warm (the pooled signature, engine reuse —
+      0 compiles), sampled back to back;
+    - ``streaming_fold_overhead_pct`` — a ``step`` whose boundary folds
+      one pending tell (the injection-slot program) vs an identical
+      plain step, per-round ratios from ADJACENT samples;
+    - ``streaming_sessions_per_sec`` — warm-pool tenant churn:
+      acquire -> step(2) -> release, sessions completed per second.
+    """
+    import numpy as np
+
+    from libpga_tpu import PGAConfig
+    from libpga_tpu.streaming import (
+        EnginePool, EvolutionSession, StreamingConfig,
+    )
+    from libpga_tpu.utils.metrics import Counters
+
+    cfg = PGAConfig(use_pallas=False)
+    pool = EnginePool(config=cfg, counters=Counters())
+
+    def first_ask_cold(genome_len: int) -> float:
+        p = EnginePool(
+            config=cfg, counters=Counters(),
+            streaming=StreamingConfig(prewarm=False),
+        )
+        t0 = time.perf_counter()
+        s = p.acquire("sphere", STREAM_POP, genome_len, seed=0)
+        s.ask(8)
+        s.step(1)
+        return (time.perf_counter() - t0) * 1e3
+
+    def first_ask_warm() -> float:
+        t0 = time.perf_counter()
+        s = pool.acquire("sphere", STREAM_POP, STREAM_LEN, seed=0)
+        s.ask(8)
+        s.step(1)
+        dt = (time.perf_counter() - t0) * 1e3
+        pool.release(s)
+        return dt
+
+    # Fold-overhead pair: one persistent session, adjacent fold/plain
+    # steps (both programs compiled outside the timed samples).
+    fold_sess = EvolutionSession(
+        "sphere", STREAM_POP, STREAM_LEN, seed=1, config=cfg
+    )
+    told = np.zeros((1, STREAM_LEN), np.float32)
+    fold_sess.tell(told, np.array([-1e9], np.float32))
+    fold_sess.step(2)  # compiles the inject program
+    fold_sess.step(2)  # compiles the plain program
+
+    def step_with_fold(n: int) -> float:
+        fold_sess.tell(told, np.array([-1e9], np.float32))
+        t0 = time.perf_counter()
+        fold_sess.step(n)
+        return time.perf_counter() - t0
+
+    def step_plain(n: int) -> float:
+        t0 = time.perf_counter()
+        fold_sess.step(n)
+        return time.perf_counter() - t0
+
+    def churn(seconds: float = 0.5) -> float:
+        t0 = time.perf_counter()
+        done = 0
+        while time.perf_counter() - t0 < seconds:
+            s = pool.acquire(
+                "sphere", STREAM_CHURN_POP, STREAM_CHURN_LEN, seed=done
+            )
+            s.step(2)
+            pool.release(s)
+            done += 1
+        return done / (time.perf_counter() - t0)
+
+    # Warm every pooled signature once outside the timed rounds.
+    first_ask_warm()
+    churn(0.1)
+
+    cold_ms, warm_ms, fold_pct, churn_sps = [], [], [], []
+    for r in range(rounds):
+        # A fresh genome length per round keeps the cold sample cold
+        # (process-wide caches key on shape).
+        cold_ms.append(first_ask_cold(STREAM_LEN + 2 * (r + 1)))
+        warm_ms.append(first_ask_warm())
+        f = step_with_fold(20)
+        p = step_plain(20)
+        fold_pct.append((f / p - 1.0) * 100.0)
+        churn_sps.append(churn())
+    cold = _median_iqr(cold_ms)
+    warm = _median_iqr(warm_ms)
+    fold = _median_iqr(fold_pct)
+    sps = _median_iqr(churn_sps)
+    return {
+        "streaming_first_ask_ms_cold": round(cold[0], 1),
+        "streaming_first_ask_ms_cold_iqr": round(cold[1], 1),
+        "streaming_first_ask_ms_warm": round(warm[0], 2),
+        "streaming_first_ask_ms_warm_iqr": round(warm[1], 2),
+        "streaming_warm_speedup": round(cold[0] / max(warm[0], 1e-9), 1),
+        "streaming_fold_overhead_pct": round(fold[0], 2),
+        "streaming_fold_overhead_pct_iqr": round(fold[1], 2),
+        "streaming_sessions_per_sec": round(sps[0], 1),
+        "streaming_sessions_per_sec_iqr": round(sps[1], 1),
+        "streaming_shape": f"{STREAM_POP}x{STREAM_LEN}",
+        "streaming_churn_shape": f"{STREAM_CHURN_POP}x{STREAM_CHURN_LEN}",
+        "streaming_note": (
+            "cold = acquire+first ask+1 gen on a never-seen signature "
+            "(fresh genome length per round, real compile); warm = the "
+            "pooled signature (engine reuse, 0 compiles); "
+            "fold_overhead = a 20-gen step whose boundary folds one "
+            "pending tell (injection-slot program: one argsort + "
+            "scatter) vs an adjacent plain step; sessions_per_sec = "
+            "acquire->step(2)->release churn on the warm pool at "
+            f"{STREAM_CHURN_POP}x{STREAM_CHURN_LEN}. CPU backend "
+            "figures; the cold/warm gap widens on TPU (Mosaic "
+            "compiles are tens of seconds)."
+        ),
+    }
+
+
 def single_derived(gene_dtype, gps) -> dict:
     """Roofline-relative figures for the single-population result."""
     import jax.numpy as jnp
@@ -1285,6 +1416,7 @@ def main() -> None:
     out.update(fleet_arm())
     out.update(autotuned_arm())
     out.update(gp_arm())
+    out.update(streaming_arm())
     print(json.dumps(out))
 
 
@@ -1354,6 +1486,20 @@ def gp_main() -> None:
     print(json.dumps(out))
 
 
+def streaming_main() -> None:
+    """``python bench.py --streaming``: the streaming evolution service
+    arm alone (ISSUE 12) — CPU-decision-grade for the warm-pool
+    compile-amortization, fold-overhead, and tenant-churn figures (see
+    streaming_note on the artifact)."""
+    cache_dir = enable_persistent_cache()
+    out = {
+        **provenance(cache_dir),
+        "metric": f"streaming_first_ask_ms_{STREAM_POP}x{STREAM_LEN}",
+        **streaming_arm(),
+    }
+    print(json.dumps(out))
+
+
 def sharded_main() -> None:
     """``python bench.py --pop-shards [S]``: the population-sharding
     arm alone (ISSUE 7). On CPU hosts the multi-device platform is
@@ -1393,6 +1539,8 @@ if __name__ == "__main__":
         autotuned_main()
     elif "--gp" in sys.argv[1:]:
         gp_main()
+    elif "--streaming" in sys.argv[1:]:
+        streaming_main()
     elif "--pop-shards" in sys.argv[1:]:
         sharded_main()
     else:

@@ -324,8 +324,13 @@ int pga_await_ex(pga_ticket_t *t, float latency_ms[4]) {
     return gens;
 }
 
-long pga_metrics_snapshot(char *buf, unsigned long cap) {
-    PyObject *out = call("metrics_snapshot_json", "()");
+namespace {
+/* Shared body of the sized-snapshot entry points: copy the rendered
+ * JSON into buf (NUL-terminated, truncated at cap) and return the full
+ * length. The RETRY-ONCE guarantee lives on the Python side
+ * (capi_bridge._sized_snapshot parks renderings that did not fit), so
+ * the bridge call must carry the caller's cap. */
+long snapshot_out(PyObject *out, char *buf, unsigned long cap) {
     if (!out) return -1;
     char *data = nullptr;
     Py_ssize_t len = 0;
@@ -343,6 +348,11 @@ long pga_metrics_snapshot(char *buf, unsigned long cap) {
     }
     Py_DECREF(out);
     return static_cast<long>(len);
+}
+}  // namespace
+
+long pga_metrics_snapshot(char *buf, unsigned long cap) {
+    return snapshot_out(call("metrics_snapshot_json", "(k)", cap), buf, cap);
 }
 
 int pga_fleet_start(const char *spool_dir, const char *objective,
@@ -406,24 +416,8 @@ int pga_fleet_await_ex(pga_fleet_ticket_t *t, float *best,
 }
 
 long pga_fleet_metrics_snapshot(char *buf, unsigned long cap) {
-    PyObject *out = call("fleet_metrics_snapshot_json", "()");
-    if (!out) return -1;
-    char *data = nullptr;
-    Py_ssize_t len = 0;
-    if (PyBytes_AsStringAndSize(out, &data, &len) != 0) {
-        PyErr_Print();
-        Py_DECREF(out);
-        return -1;
-    }
-    if (buf && cap > 0) {
-        size_t n = static_cast<size_t>(len) < cap - 1
-                       ? static_cast<size_t>(len)
-                       : cap - 1;
-        std::memcpy(buf, data, n);
-        buf[n] = '\0';
-    }
-    Py_DECREF(out);
-    return static_cast<long>(len);
+    return snapshot_out(call("fleet_metrics_snapshot_json", "(k)", cap),
+                        buf, cap);
 }
 
 int pga_fleet_drain(void) {
@@ -501,6 +495,105 @@ int pga_set_pop_shards(pga_t *p, unsigned shards) {
     if (!p) return -1;
     return static_cast<int>(
         call_long("set_pop_shards", "(lI)", solver_of(p), shards));
+}
+
+/* ---- Streaming evolution service (ISSUE 12) -------------------------- */
+
+static pga_session_t *pack_session(long h) {
+    return h <= 0 ? nullptr
+                  : reinterpret_cast<pga_session_t *>(
+                        static_cast<intptr_t>(h));
+}
+
+static long session_of(pga_session_t *s) {
+    return static_cast<long>(reinterpret_cast<intptr_t>(s));
+}
+
+pga_session_t *pga_session_open(const char *objective, unsigned size,
+                                unsigned genome_len, long seed) {
+    if (!objective || !size || !genome_len) return nullptr;
+    return pack_session(call_long("session_open", "(sIIl)", objective,
+                                  size, genome_len, seed));
+}
+
+long pga_session_ask(pga_session_t *s, float *out, unsigned k) {
+    if (!s || !out || !k) return -1;
+    size_t nbytes = 0;
+    float *vals = bytes_to_floats(
+        call("session_ask", "(lI)", session_of(s), k), &nbytes);
+    if (!vals || nbytes == 0) {
+        std::free(vals);
+        return -1;
+    }
+    std::memcpy(out, vals, nbytes);
+    std::free(vals);
+    return static_cast<long>(k);
+}
+
+int pga_session_tell(pga_session_t *s, const float *genomes,
+                     const float *fitness, unsigned k) {
+    if (!s || !genomes || !fitness || !k) return -1;
+    /* genome_len comes from the session on the bridge side; the byte
+     * count is validated there against it. Read it back first. */
+    long glen = call_long("session_genome_len", "(l)", session_of(s));
+    if (glen <= 0) return -1;
+    return static_cast<int>(call_long(
+        "session_tell", "(ly#y#I)", session_of(s),
+        reinterpret_cast<const char *>(genomes),
+        static_cast<Py_ssize_t>(static_cast<size_t>(k) *
+                                static_cast<size_t>(glen) *
+                                sizeof(float)),
+        reinterpret_cast<const char *>(fitness),
+        static_cast<Py_ssize_t>(static_cast<size_t>(k) * sizeof(float)),
+        k));
+}
+
+int pga_session_step(pga_session_t *s, unsigned n, float target) {
+    if (!s) return -1;
+    int has_target = target == target; /* NAN = no target */
+    return static_cast<int>(call_long(
+        "session_step", "(lIif)", session_of(s), n, has_target,
+        has_target ? target : 0.0f));
+}
+
+int pga_session_best(pga_session_t *s, float *best, float *genome) {
+    if (!s) return -1;
+    size_t nbytes = 0;
+    /* float32[1 + genome_len]: best score, then the best genome. */
+    float *vals = bytes_to_floats(
+        call("session_best", "(l)", session_of(s)), &nbytes);
+    if (!vals || nbytes < 2 * sizeof(float)) {
+        std::free(vals);
+        return -1;
+    }
+    if (best) *best = vals[0];
+    if (genome)
+        std::memcpy(genome, vals + 1, nbytes - sizeof(float));
+    std::free(vals);
+    return 0;
+}
+
+int pga_session_suspend(pga_session_t *s, const char *path) {
+    if (!s || !path) return -1;
+    return static_cast<int>(
+        call_long("session_suspend", "(ls)", session_of(s), path));
+}
+
+pga_session_t *pga_session_resume(const char *path, const char *objective) {
+    if (!path) return nullptr;
+    return pack_session(call_long("session_resume", "(ss)", path,
+                                  objective ? objective : ""));
+}
+
+int pga_session_close(pga_session_t *s) {
+    if (!s) return -1;
+    return static_cast<int>(
+        call_long("session_close", "(l)", session_of(s)));
+}
+
+long pga_session_snapshot(char *buf, unsigned long cap) {
+    return snapshot_out(call("session_snapshot_json", "(k)", cap), buf,
+                        cap);
 }
 
 float *pga_get_history(pga_t *p, population_t *pop, unsigned *rows,

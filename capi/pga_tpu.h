@@ -374,7 +374,24 @@ int pga_serving_config(unsigned max_batch, float max_wait_ms);
  * truncated at cap). Returns the full JSON length in bytes (excluding
  * the NUL) so a caller receiving ret >= cap can retry with a larger
  * buffer; negative on error. buf may be NULL with cap 0 to query the
- * size. */
+ * size.
+ *
+ * RETRY-ONCE CONTRACT (all pga_*_snapshot entry points): the snapshot
+ * is LIVE — it can grow between a size query and the fill call (new
+ * metric series, new sessions, even timestamp width). The library
+ * therefore PARKS any rendering that did not fit the caller's cap
+ * (the cap-0 size query included): the immediately following call
+ * with cap > ret receives exactly the parked bytes, never a fresh,
+ * larger rendering. So the loop
+ *
+ *     long need = pga_metrics_snapshot(NULL, 0);
+ *     char *buf = malloc(need + 1);
+ *     long got = pga_metrics_snapshot(buf, need + 1);
+ *
+ * is guaranteed to succeed with got == need — one retry after a
+ * truncated fill always suffices (a truncated fill re-parks, so the
+ * invariant holds for its retry too). A fill that truncates is always
+ * safe: the buffer is NUL-terminated at cap - 1, never overrun. */
 int pga_await_ex(pga_ticket_t *t, float latency_ms[4]);
 long pga_metrics_snapshot(char *buf, unsigned long cap);
 
@@ -514,6 +531,69 @@ int pga_gp_config(pga_t *p, unsigned max_nodes, unsigned n_vars,
 population_t *pga_gp_create_population(pga_t *p, unsigned size);
 int pga_set_objective_sr(pga_t *p, const float *X, const float *y,
                          unsigned n_samples);
+
+/* ---- Streaming evolution service (ISSUE 12) ---------------------------
+ *
+ * Long-lived ask/tell tenants over the serving stack: a SESSION holds
+ * a population open across calls, breeds candidates for EXTERNAL
+ * evaluation (ask), folds externally measured fitnesses back in at
+ * the next generation boundary (tell), advances on the internal
+ * objective (step), and persists across processes (suspend/resume,
+ * bit-identical). Sessions draw engines from a process-global WARM
+ * POOL keyed by bucket signature: the second pga_session_open of one
+ * signature compiles 0 programs.
+ *
+ * pga_session_open creates a session of a fresh size x genome_len
+ * population from `seed` over the named builtin objective. Returns a
+ * session or NULL. A step-only session is bit-identical to pga_run on
+ * a same-seed solver.
+ *
+ * pga_session_ask writes k candidate genomes (k * genome_len floats,
+ * row-major) into out; returns k, negative on error. Candidates are
+ * bred from the current population under its last known fitnesses
+ * (internal evaluations and told values alike); before any fitness is
+ * known the first k population rows are returned.
+ *
+ * pga_session_tell hands back k externally evaluated candidates
+ * (genomes: k * genome_len floats, fitness: k floats, higher better,
+ * finite). They fold at the next generation boundary: the first breed
+ * after the fold selects over the told fitnesses. Returns 0/-1.
+ *
+ * pga_session_step advances up to n generations on the internal
+ * objective (target as in pga_run; pass NAN for none), folding any
+ * pending tells first. Returns generations executed, negative on
+ * error.
+ *
+ * pga_session_best writes the best score into *best (may be NULL) and
+ * the best genome into genome (genome_len floats; may be NULL).
+ * Returns 0/-1.
+ *
+ * pga_session_suspend persists the session durably at path (atomic
+ * checkpoint + sidecar meta, written commit-last); the session stays
+ * usable. pga_session_resume restores it — in this or ANY process
+ * that sees the files — bit-identically (objective may be NULL to use
+ * the name recorded at suspend). pga_session_close releases the
+ * session's engine back to the warm pool (the population is dropped —
+ * suspend first to keep it).
+ *
+ * pga_session_snapshot writes the streaming layer's state — one
+ * record per open session (shape, generations done, pending tells,
+ * best) plus the warm-pool hit/miss/prewarm counters — as a UTF-8
+ * JSON document into buf. Same size-query and RETRY-ONCE contract as
+ * pga_metrics_snapshot (see above); this snapshot grows with every
+ * opened session, which is exactly the race the contract covers. */
+typedef struct pga_session pga_session_t;
+pga_session_t *pga_session_open(const char *objective, unsigned size,
+                                unsigned genome_len, long seed);
+long pga_session_ask(pga_session_t *s, float *out, unsigned k);
+int pga_session_tell(pga_session_t *s, const float *genomes,
+                     const float *fitness, unsigned k);
+int pga_session_step(pga_session_t *s, unsigned n, float target);
+int pga_session_best(pga_session_t *s, float *best, float *genome);
+int pga_session_suspend(pga_session_t *s, const char *path);
+pga_session_t *pga_session_resume(const char *path, const char *objective);
+int pga_session_close(pga_session_t *s);
+long pga_session_snapshot(char *buf, unsigned long cap);
 
 #ifdef __cplusplus
 }

@@ -310,6 +310,159 @@ int main(void) {
         pga_deinit(gps);
     }
 
+    /* Streaming evolution service (ISSUE 12): the ask/tell/step round
+     * trip, suspend/resume bit-identity through the ABI, the warm-pool
+     * reuse path, and the sized-snapshot RETRY-ONCE contract. */
+    {
+        enum { SPOP = 256, SLEN = 16 };
+        pga_session_t *sess = pga_session_open("onemax", SPOP, SLEN, 7);
+        if (!sess) return fprintf(stderr, "pga_session_open failed\n"), 1;
+
+        /* ask before any fitness: k rows of the initial population. */
+        float cand[4 * SLEN], fit[4];
+        if (pga_session_ask(sess, cand, 4) != 4)
+            return fprintf(stderr, "pga_session_ask failed\n"), 1;
+        for (int i = 0; i < 4; i++) {
+            float sum = 0.0f;
+            for (int j = 0; j < SLEN; j++) sum += cand[i * SLEN + j];
+            fit[i] = sum; /* external evaluation (onemax itself) */
+        }
+        if (pga_session_tell(sess, cand, fit, 4) != 0)
+            return fprintf(stderr, "pga_session_tell failed\n"), 1;
+        if (pga_session_step(sess, GENS, NAN) != GENS)
+            return fprintf(stderr, "pga_session_step failed\n"), 1;
+        float sbest = -1.0f, sbest_genome[SLEN];
+        if (pga_session_best(sess, &sbest, sbest_genome) != 0)
+            return fprintf(stderr, "pga_session_best failed\n"), 1;
+        if (!(sbest >= 0.0f && sbest <= (float)SLEN))
+            return fprintf(stderr, "session best %g out of range\n",
+                           (double)sbest),
+                   1;
+
+        /* A step-only session is bit-identical to pga_run: drive a
+         * second session and a same-seed solver side by side. */
+        pga_session_t *only = pga_session_open("onemax", SPOP, SLEN, 9);
+        population_t *rpop2;
+        pga_t *ref2 = make_solver(9, &rpop2);
+        if (!only || !ref2)
+            return fprintf(stderr, "step-only setup failed\n"), 1;
+        /* make_solver builds POP x LEN — rebuild at the session shape. */
+        pga_deinit(ref2);
+        ref2 = pga_init(9);
+        rpop2 = pga_create_population(ref2, SPOP, SLEN, RANDOM_POPULATION);
+        if (!rpop2 || pga_set_objective_name(ref2, "onemax") != 0)
+            return fprintf(stderr, "step-only solver failed\n"), 1;
+        if (pga_session_step(only, GENS, NAN) != GENS ||
+            pga_run_n(ref2, GENS) != GENS)
+            return fprintf(stderr, "step-only advance failed\n"), 1;
+        float only_best = -1.0f, only_genome[SLEN];
+        if (pga_session_best(only, &only_best, only_genome) != 0)
+            return fprintf(stderr, "step-only best failed\n"), 1;
+        gene *ref2_best = pga_get_best(ref2, rpop2);
+        if (!ref2_best)
+            return fprintf(stderr, "step-only ref best failed\n"), 1;
+        for (unsigned j = 0; j < SLEN; j++)
+            if (only_genome[j] != ref2_best[j])
+                return fprintf(stderr,
+                               "session step diverges from pga_run at gene "
+                               "%u (%.9g != %.9g)\n",
+                               j, only_genome[j], ref2_best[j]),
+                       1;
+        free(ref2_best);
+        pga_deinit(ref2);
+
+        /* Suspend → resume: the resumed session's next step must land
+         * bit-identically with the original's. */
+        char sdir[] = "/tmp/pga-session-capi-XXXXXX";
+        if (!mkdtemp(sdir)) return fprintf(stderr, "mkdtemp failed\n"), 1;
+        char spath[256];
+        snprintf(spath, sizeof spath, "%s/tenant.ckpt.npz", sdir);
+        if (pga_session_suspend(only, spath) != 0)
+            return fprintf(stderr, "pga_session_suspend failed\n"), 1;
+        pga_session_t *back = pga_session_resume(spath, NULL);
+        if (!back) return fprintf(stderr, "pga_session_resume failed\n"), 1;
+        if (pga_session_step(only, GENS, NAN) != GENS ||
+            pga_session_step(back, GENS, NAN) != GENS)
+            return fprintf(stderr, "post-resume step failed\n"), 1;
+        float g1[SLEN], g2[SLEN];
+        if (pga_session_best(only, NULL, g1) != 0 ||
+            pga_session_best(back, NULL, g2) != 0)
+            return fprintf(stderr, "post-resume best failed\n"), 1;
+        for (unsigned j = 0; j < SLEN; j++)
+            if (g1[j] != g2[j])
+                return fprintf(stderr,
+                               "resume diverges at gene %u (%.9g != %.9g)\n",
+                               j, g1[j], g2[j]),
+                       1;
+
+        /* Sized-snapshot retry-once contract: (a) the canonical
+         * size-query -> fill loop succeeds with got == need even
+         * though the snapshot is live; (b) a deliberately under-sized
+         * fill truncates safely (NUL-terminated) and its ONE retry
+         * with the returned length succeeds exactly. Opening another
+         * session between query and fill is the growth race the
+         * contract exists for — the parked rendering absorbs it. */
+        long need = pga_session_snapshot(NULL, 0);
+        if (need <= 0)
+            return fprintf(stderr, "session snapshot size %ld\n", need), 1;
+        pga_session_t *grow = pga_session_open("onemax", SPOP, SLEN, 11);
+        if (!grow) return fprintf(stderr, "growth session failed\n"), 1;
+        {
+            char *json = (char *)malloc((unsigned long)need + 1);
+            if (!json) return fprintf(stderr, "malloc failed\n"), 1;
+            long got = pga_session_snapshot(json, (unsigned long)need + 1);
+            if (got != need)
+                return fprintf(stderr,
+                               "retry-once violated: fill %ld != query %ld\n",
+                               got, need),
+                       1;
+            if (json[0] != '{' || json[got] != '\0' ||
+                !strstr(json, "\"pool\""))
+                return fprintf(stderr, "session snapshot malformed\n"), 1;
+            free(json);
+        }
+        {
+            char tiny[8];
+            long got = pga_session_snapshot(tiny, sizeof tiny);
+            if (got < (long)sizeof tiny || tiny[sizeof tiny - 1] != '\0')
+                return fprintf(stderr, "truncated fill unsafe (%ld)\n", got),
+                       1;
+            char *json = (char *)malloc((unsigned long)got + 1);
+            if (!json) return fprintf(stderr, "malloc failed\n"), 1;
+            long got2 = pga_session_snapshot(json, (unsigned long)got + 1);
+            if (got2 != got)
+                return fprintf(stderr,
+                               "truncated-fill retry %ld != %ld\n", got2,
+                               got),
+                       1;
+            free(json);
+        }
+        /* Same contract holds for pga_metrics_snapshot. */
+        {
+            long mneed = pga_metrics_snapshot(NULL, 0);
+            if (mneed <= 0)
+                return fprintf(stderr, "metrics size query %ld\n", mneed), 1;
+            char *json = (char *)malloc((unsigned long)mneed + 1);
+            if (!json) return fprintf(stderr, "malloc failed\n"), 1;
+            long mgot = pga_metrics_snapshot(json, (unsigned long)mneed + 1);
+            if (mgot != mneed)
+                return fprintf(stderr,
+                               "metrics retry-once violated: %ld != %ld\n",
+                               mgot, mneed),
+                       1;
+            free(json);
+        }
+
+        /* Error surfaces + pool release. */
+        if (pga_session_ask(NULL, cand, 4) != -1)
+            return fprintf(stderr, "NULL session ask not rejected\n"), 1;
+        if (pga_session_close(NULL) != -1)
+            return fprintf(stderr, "NULL session close not rejected\n"), 1;
+        if (pga_session_close(sess) != 0 || pga_session_close(only) != 0 ||
+            pga_session_close(back) != 0 || pga_session_close(grow) != 0)
+            return fprintf(stderr, "pga_session_close failed\n"), 1;
+    }
+
     for (int i = 0; i < NSOLVERS; i++) pga_deinit(solvers[i]);
     pga_deinit(ref);
     printf("PASS\n");

@@ -26,9 +26,11 @@ Design stance (TPU-first, not a port):
 from libpga_tpu.config import (
     FleetConfig,
     GPConfig,
+    PBTConfig,
     PGAConfig,
     ServingConfig,
     SLOConfig,
+    StreamingConfig,
 )
 from libpga_tpu.population import Population
 from libpga_tpu.engine import PGA
@@ -74,6 +76,8 @@ __all__ = [
     "ServingConfig",
     "SLOConfig",
     "FleetConfig",
+    "StreamingConfig",
+    "PBTConfig",
     "Population",
     "ops",
     "objectives",

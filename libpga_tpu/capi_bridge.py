@@ -1062,15 +1062,49 @@ def await_ticket_ex(ticket_id: int) -> bytes:
     return np.asarray(vals, dtype=np.float32).tobytes()
 
 
-def metrics_snapshot_json() -> bytes:
+# Retry-once parking lot for the sized-snapshot entry points (ISSUE 12
+# satellite): snapshot kind -> last rendering that did not fit the
+# caller's buffer (including the cap=0 size query).
+_snapshot_pending: Dict[str, bytes] = {}
+
+
+def _sized_snapshot(kind: str, render, cap: int) -> bytes:
+    """Size-query hardening for the ``pga_*_snapshot`` entry points.
+
+    These snapshots are LIVE — they can grow between a caller's size
+    query and its fill call (new sessions, new metric series, even the
+    timestamp width). Whenever a call cannot be satisfied by ``cap``
+    (the cap=0 size query included), the rendered bytes are PARKED, and
+    the caller's immediate retry with a sufficient cap receives exactly
+    the parked snapshot instead of a fresh (possibly larger) rendering
+    — which is what makes the header's retry-ONCE contract a guarantee
+    rather than a hope. A retry with a still-too-small cap re-parks the
+    fresh rendering, preserving the invariant for the next retry."""
+    cap = int(cap)
+    parked = _snapshot_pending.pop(kind, None)
+    if parked is not None and cap > len(parked):
+        return parked
+    data = render()
+    if cap <= len(data):
+        _snapshot_pending[kind] = data
+    return data
+
+
+def metrics_snapshot_json(cap: int = 0) -> bytes:
     """``pga_metrics_snapshot``: the process-global metrics registry
     snapshot (counters, gauges, histograms with p50/p95/p99) as UTF-8
-    JSON — the C-side export of the ISSUE 6 observability layer."""
+    JSON — the C-side export of the ISSUE 6 observability layer.
+    ``cap`` is the caller's buffer capacity (retry-once contract, see
+    :func:`_sized_snapshot`)."""
     import json
 
     from libpga_tpu.utils import metrics as _metrics
 
-    return json.dumps(_metrics.REGISTRY.snapshot()).encode("utf-8")
+    return _sized_snapshot(
+        "metrics",
+        lambda: json.dumps(_metrics.REGISTRY.snapshot()).encode("utf-8"),
+        cap,
+    )
 
 
 # ------------------------------------------------------------------ fleet
@@ -1159,16 +1193,23 @@ def fleet_await_ex(ticket_id: int, timeout_s: float) -> bytes:
     return np.asarray(vals, dtype=np.float32).tobytes()
 
 
-def fleet_metrics_snapshot_json() -> bytes:
+def fleet_metrics_snapshot_json(cap: int = 0) -> bytes:
     """``pga_fleet_metrics_snapshot``: the MERGED fleet metrics
     snapshot — every worker's latest spool flush + the coordinator's
     live registry, per-process labels, aggregate histograms — as UTF-8
-    JSON (size-query contract handled by the C shim)."""
+    JSON. ``cap`` is the caller's buffer capacity (retry-once
+    contract, see :func:`_sized_snapshot`)."""
     import json
 
     if _fleet is None:
         raise ValueError("no fleet: call pga_fleet_start first")
-    return json.dumps(_fleet.merged_snapshot(), default=str).encode("utf-8")
+    return _sized_snapshot(
+        "fleet_metrics",
+        lambda: json.dumps(
+            _fleet.merged_snapshot(), default=str
+        ).encode("utf-8"),
+        cap,
+    )
 
 
 def fleet_drain() -> int:
@@ -1189,6 +1230,157 @@ def fleet_close() -> int:
     _fleet = None
     _fleet_handles.clear()
     return 0
+
+
+# -------------------------------------------------- streaming (ISSUE 12)
+
+_streaming_sessions: Dict[int, object] = {}
+_next_session_handle = 1
+_streaming_pool = None
+
+
+def _session_pool():
+    """The process-global warm engine pool the C ABI's sessions share —
+    a second pga_session_open of one signature compiles 0 programs."""
+    global _streaming_pool
+    if _streaming_pool is None:
+        from libpga_tpu.config import PGAConfig
+        from libpga_tpu.streaming import EnginePool
+
+        _streaming_pool = EnginePool(config=PGAConfig())
+    return _streaming_pool
+
+
+def _session(handle: int):
+    session = _streaming_sessions.get(int(handle))
+    if session is None:
+        raise ValueError(f"invalid session handle {handle}")
+    return session
+
+
+def session_open(
+    objective: str, size: int, genome_len: int, seed: int
+) -> int:
+    """``pga_session_open``: a warm streaming session over a named
+    builtin objective. Returns a session handle (> 0)."""
+    global _next_session_handle
+    session = _session_pool().acquire(
+        objective, int(size), int(genome_len), seed=int(seed)
+    )
+    handle = _next_session_handle
+    _next_session_handle += 1
+    _streaming_sessions[handle] = session
+    return handle
+
+
+def session_genome_len(handle: int) -> int:
+    """Genome length of a session — the C shim reads it back to size
+    tell() marshal buffers (the ``gp_n_vars`` pattern)."""
+    return int(_session(handle).genome_len)
+
+
+def session_ask(handle: int, k: int) -> bytes:
+    """``pga_session_ask``: k bred candidate genomes as raw float32
+    bytes (k * genome_len values, row-major)."""
+    return np.ascontiguousarray(
+        _session(handle).ask(int(k)), dtype=np.float32
+    ).tobytes()
+
+
+def session_tell(handle: int, genomes: bytes, fitness: bytes, k: int) -> int:
+    """``pga_session_tell``: fold k externally evaluated candidates in
+    at the next generation boundary."""
+    session = _session(handle)
+    g = np.frombuffer(genomes, dtype=np.float32).reshape(
+        int(k), session.genome_len
+    )
+    f = np.frombuffer(fitness, dtype=np.float32)[: int(k)]
+    session.tell(g, f)
+    return 0
+
+
+def session_step(handle: int, n: int, has_target: int, target: float) -> int:
+    """``pga_session_step``: advance n generations on the internal
+    objective (folding pending tells); returns generations executed."""
+    return int(_session(handle).step(
+        int(n), target=float(target) if has_target else None
+    ))
+
+
+def session_best(handle: int) -> bytes:
+    """``pga_session_best``: float32 [best_score, genome...] of the
+    current population."""
+    genome, score = _session(handle).best()
+    return np.concatenate(
+        [np.asarray([score], np.float32), genome.astype(np.float32)]
+    ).tobytes()
+
+
+def session_suspend(handle: int, path: str) -> int:
+    """``pga_session_suspend``: durably persist the session (atomic
+    checkpoint + sidecars); the handle stays usable."""
+    _session(handle).suspend(path)
+    return 0
+
+
+def session_resume(path: str, objective: str) -> int:
+    """``pga_session_resume``: restore a suspended session
+    bit-identically. ``objective`` may be empty to use the name
+    recorded at suspend time."""
+    global _next_session_handle
+    from libpga_tpu.streaming import EvolutionSession
+
+    session = EvolutionSession.resume(path, objective=objective or None)
+    handle = _next_session_handle
+    _next_session_handle += 1
+    _streaming_sessions[handle] = session
+    return handle
+
+
+def session_close(handle: int) -> int:
+    """``pga_session_close``: release the session's engine back to the
+    process-global warm pool (the population is dropped — suspend first
+    to keep it)."""
+    session = _streaming_sessions.pop(int(handle), None)
+    if session is None:
+        return -1
+    if getattr(session, "_pool", None) is not None:
+        _session_pool().release(session)
+    return 0
+
+
+def session_snapshot_json(cap: int = 0) -> bytes:
+    """``pga_session_snapshot``: the streaming layer's state — one
+    record per open session (id, shape, generations done, pending
+    tells, last known best) plus the warm-pool counters — as UTF-8
+    JSON. Same retry-once size-query contract as
+    ``pga_metrics_snapshot`` (:func:`_sized_snapshot`); this snapshot
+    GROWS with every opened session, which is exactly the race the
+    contract exists for."""
+    import json
+
+    def render() -> bytes:
+        sessions = []
+        for handle, s in sorted(_streaming_sessions.items()):
+            import jax.numpy as jnp
+
+            pop = s.population()
+            best = float(jnp.max(pop.scores))
+            sessions.append({
+                "handle": handle,
+                "session": s.sid,
+                "population_size": s.size,
+                "genome_len": s.genome_len,
+                "gens_done": s.gens_done,
+                "pending_tells": s.pending_tells,
+                "best": best if np.isfinite(best) else None,
+            })
+        return json.dumps({
+            "sessions": sessions,
+            "pool": _session_pool().stats(),
+        }).encode("utf-8")
+
+    return _sized_snapshot("session", render, cap)
 
 
 # ------------------------------------------------------------ robustness

@@ -426,6 +426,81 @@ class FleetConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class PBTConfig:
+    """Population-based-training hyperparameter adaptation across the
+    co-batched sessions of a :class:`~libpga_tpu.streaming.SessionGroup`
+    (ISSUE 12). At every ``epoch_gens``-generation boundary the group
+    argsorts the sessions by best fitness (ONE cross-run argsort over N
+    scalars); each of the bottom ``exploit_frac`` sessions copies its
+    mutation rate/sigma from a uniformly drawn top-``exploit_frac``
+    partner (exploit), then multiplies the rate by ``explore_factor``
+    or its inverse, coin-flipped (explore), clipped to ``rate_bounds``/
+    ``sigma_bounds``. Rate and sigma are RUNTIME inputs of the shared
+    mega-run (``ops/step.make_param_breed``), so adaptation never
+    recompiles. Deterministic for a fixed ``seed`` (epoch-indexed host
+    PRNG).
+
+    Off by default: ``StreamingConfig.pbt = None`` never touches a
+    session's parameters — byte-identity asserted in
+    ``tests/test_streaming.py``.
+    """
+
+    epoch_gens: int = 10
+    exploit_frac: float = 0.25
+    explore_factor: float = 1.2
+    rate_bounds: tuple = (1e-4, 0.5)
+    sigma_bounds: tuple = (0.0, 1.0)
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.epoch_gens < 1:
+            raise ValueError("epoch_gens must be >= 1")
+        if not (0.0 < self.exploit_frac <= 0.5):
+            raise ValueError("exploit_frac must be in (0, 0.5]")
+        if self.explore_factor <= 1.0:
+            raise ValueError("explore_factor must be > 1")
+        if not (0 < self.rate_bounds[0] <= self.rate_bounds[1] <= 1.0):
+            raise ValueError("rate_bounds must satisfy 0 < lo <= hi <= 1")
+        if not (0 <= self.sigma_bounds[0] <= self.sigma_bounds[1]):
+            raise ValueError("sigma_bounds must satisfy 0 <= lo <= hi")
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamingConfig:
+    """Settings for the streaming evolution service (``streaming/``,
+    ISSUE 12) — long-lived ask/tell tenants over the serving stack.
+
+    Attributes:
+      pool_capacity: idle warm engines retained per signature by an
+        :class:`~libpga_tpu.streaming.EnginePool` (each holds compiled
+        programs; beyond the cap a released engine is dropped).
+        ``None`` = unbounded.
+      prewarm: compile a fresh signature's run program at pool admission
+        (one zero-generation dummy dispatch — the engine-path analog of
+        the serving cache's AOT ``lower().compile()`` warm-up), so a
+        tenant's first ``ask``/``step`` executes, never compiles.
+      max_tell_slots: cap on pending external evaluations folded per
+        generation boundary; ``None`` = the population size (everything
+        pending folds).
+      pbt: live hyperparameter adaptation across co-batched sessions
+        (:class:`PBTConfig`). ``None`` (default) = off — session
+        parameters are never touched and group stepping is
+        byte-identical to the pre-PBT path.
+    """
+
+    pool_capacity: Optional[int] = 8
+    prewarm: bool = True
+    max_tell_slots: Optional[int] = None
+    pbt: Optional[PBTConfig] = None
+
+    def __post_init__(self):
+        if self.pool_capacity is not None and self.pool_capacity < 1:
+            raise ValueError("pool_capacity must be >= 1 or None")
+        if self.max_tell_slots is not None and self.max_tell_slots < 1:
+            raise ValueError("max_tell_slots must be >= 1 or None")
+
+
+@dataclasses.dataclass(frozen=True)
 class SLOConfig:
     """Latency service-level objectives for the serving queue (ISSUE 6).
 

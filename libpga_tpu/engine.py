@@ -66,7 +66,31 @@ def _kind_key(kind):
     return getattr(kind, "kernel_cache_key", kind)
 
 
-def make_run_loop(obj, breed, history_gens: Optional[int] = None):
+def fold_injection(genomes, scores, inj_genomes, inj_scores, inj_n):
+    """Fold externally evaluated candidates into a population at a
+    generation boundary (the streaming ask/tell protocol, ISSUE 12):
+    the first ``inj_n`` of the ``K`` injection slots replace the
+    current WORST-scoring rows, and their TOLD fitnesses override the
+    internal evaluation for the next selection (offspring are re-scored
+    by the internal objective as usual). Pure jnp — runs inside the
+    jitted run loops. With ``inj_n == 0`` the scatter writes back the
+    values it read, so the folded state is value-identical to the
+    unfolded one (the group-stepping no-op guarantee,
+    tests/test_streaming.py)."""
+    K = inj_genomes.shape[0]
+    mask = jnp.arange(K) < inj_n
+    worst = jnp.argsort(scores)[:K]
+    cur_g = jnp.take(genomes, worst, axis=0)
+    cur_s = jnp.take(scores, worst)
+    new_g = jnp.where(mask[:, None], inj_genomes.astype(genomes.dtype), cur_g)
+    new_s = jnp.where(mask, inj_scores, cur_s)
+    return genomes.at[worst].set(new_g), scores.at[worst].set(new_s)
+
+
+def make_run_loop(
+    obj, breed, history_gens: Optional[int] = None,
+    inject_slots: Optional[int] = None,
+):
     """Build the fused single-run loop — the one implementation shared by
     the engine's XLA path and the serving mega-run executor
     (``serving/batch.py``), so their semantics cannot drift.
@@ -87,7 +111,81 @@ def make_run_loop(obj, breed, history_gens: Optional[int] = None):
     scalars and returns a trailing history array; the disabled path
     traces to the exact pre-telemetry jaxpr (structurally asserted in
     tests/test_telemetry.py).
+
+    ``inject_slots`` (ISSUE 12) grows the loop an INJECTION SLOT for
+    the streaming ask/tell protocol: the returned loop takes three
+    trailing inputs ``(inj_genomes (K, L), inj_scores (K,), inj_n)``
+    and folds the first ``inj_n`` externally evaluated candidates over
+    the worst rows at the generation boundary BEFORE the first breed
+    (:func:`fold_injection`) — told fitnesses seed the next selection;
+    every later generation re-scores through the internal objective.
+    ``None`` (the default, every pre-streaming caller) leaves the code
+    below untouched — the no-injection path traces to the exact
+    pre-streaming jaxpr, which is what makes a ``step()``-only
+    streaming session bit-identical to ``PGA.run``.
     """
+    if inject_slots is not None:
+        if history_gens is None:
+
+            def run_loop(genomes, key, n, target, mparams,
+                         inj_genomes, inj_scores, inj_n):
+                scores0 = _evaluate(obj, genomes)
+                genomes, scores0 = fold_injection(
+                    genomes, scores0, inj_genomes, inj_scores, inj_n
+                )
+
+                def cond(carry):
+                    g, s, k, gen = carry
+                    return jnp.logical_and(gen < n, jnp.max(s) < target)
+
+                def body(carry):
+                    g, s, k, gen = carry
+                    k, sub = jax.random.split(k)
+                    g2 = breed(g, s, sub, mparams)
+                    s2 = _evaluate(obj, g2)
+                    return (g2, s2, k, gen + 1)
+
+                init = (genomes, scores0, key, jnp.int32(0))
+                g, s, k, gens_done = jax.lax.while_loop(cond, body, init)
+                return g, s, gens_done
+
+        else:
+
+            def run_loop(genomes, key, n, target, mparams,
+                         inj_genomes, inj_scores, inj_n):
+                scores0 = _evaluate(obj, genomes)
+                genomes, scores0 = fold_injection(
+                    genomes, scores0, inj_genomes, inj_scores, inj_n
+                )
+
+                def cond(carry):
+                    g, s, k, gen, best, stall, buf = carry
+                    return jnp.logical_and(gen < n, jnp.max(s) < target)
+
+                def body(carry):
+                    g, s, k, gen, best, stall, buf = carry
+                    k, sub = jax.random.split(k)
+                    with jax.named_scope("pga/select_breed"):
+                        g2 = breed(g, s, sub, mparams)
+                    with jax.named_scope("pga/evaluate"):
+                        s2 = _evaluate(obj, g2)
+                    with jax.named_scope("pga/telemetry"):
+                        row, best, stall = _tl.stats_row(g2, s2, best, stall)
+                        buf = _tl.write_row(buf, gen, row)
+                    return (g2, s2, k, gen + 1, best, stall, buf)
+
+                init = (
+                    genomes, scores0, key, jnp.int32(0),
+                    jnp.max(scores0), jnp.int32(0),
+                    _tl.history_init(history_gens),
+                )
+                g, s, k, gens_done, _, _, buf = jax.lax.while_loop(
+                    cond, body, init
+                )
+                return g, s, gens_done, buf
+
+        return run_loop
+
     if history_gens is None:
 
         def run_loop(genomes, key, n, target, mparams):
@@ -968,11 +1066,81 @@ class PGA:
         self._compiled[cache_key] = pb
         return pb
 
+    # ----------------------------------------------------- injection (ask/tell)
+
+    def _compiled_run_inject(self, size: int, genome_len: int, K: int):
+        """Compiled XLA run loop WITH the ``inject_slots=K`` boundary
+        fold (ISSUE 12) — the program a streaming session's fold-step
+        dispatches. Cached per (shape, K, operators) exactly like the
+        plain XLA run; the fused Pallas path has no injection slot, so
+        a folding run always takes this program (the fold itself is one
+        argsort + scatter — negligible next to a generation)."""
+        obj = self._require_objective()
+        hist_gens = self._history_gens()
+        cache_key = (
+            "engine/run-xla-inject", K, size, genome_len, obj,
+            self._crossover, self._mutate,
+            self.config.tournament_size, self.config.elitism,
+            self.config.selection, self.config.selection_param,
+            hist_gens,
+        )
+        fn = self._compiled.get(cache_key)
+        if fn is not None:
+            return fn
+        self._emit(
+            "compile", what="run_xla_inject", population_size=size,
+            genome_len=genome_len, inject_slots=K,
+        )
+        breed3 = self._breed_fn()
+
+        def breed(g, s, k, mparams):
+            return breed3(g, s, k)
+
+        run_loop = make_run_loop(obj, breed, hist_gens, inject_slots=K)
+        donate = (0,) if self.config.donate_buffers else ()
+        fn = jax.jit(run_loop, donate_argnums=donate)
+        self._compiled[cache_key] = fn
+        return fn
+
+    def _prepare_inject(self, pop: Population, inject) -> tuple:
+        """Validate and normalize a ``run(inject=...)`` payload:
+        ``(genomes (m, L) f32-host, scores (m,) f32, m)``."""
+        inj_g, inj_s = inject
+        inj_g = np.asarray(inj_g, dtype=np.float32)
+        inj_s = np.asarray(inj_s, dtype=np.float32).reshape(-1)
+        if inj_g.ndim != 2 or inj_g.shape[1] != pop.genome_len:
+            raise ValueError(
+                f"inject genomes {inj_g.shape} incompatible with "
+                f"genome_len {pop.genome_len}"
+            )
+        if inj_g.shape[0] != inj_s.shape[0]:
+            raise ValueError(
+                f"inject carries {inj_g.shape[0]} genomes but "
+                f"{inj_s.shape[0]} fitnesses"
+            )
+        if inj_g.shape[0] > pop.size:
+            raise ValueError(
+                f"cannot fold {inj_g.shape[0]} candidates into a "
+                f"population of {pop.size}"
+            )
+        return inj_g, inj_s, inj_g.shape[0]
+
+    @staticmethod
+    def _inject_slot_width(m: int, size: int) -> int:
+        """Slot count the fold program compiles at: next power of two
+        >= m, capped at the population size — so repeated folds of
+        varying widths reuse a handful of compiled programs."""
+        K = 1
+        while K < m:
+            K *= 2
+        return min(K, size)
+
     def run(
         self,
         n: int,
         target: Optional[float] = None,
         population: Optional[PopulationHandle] = None,
+        inject=None,
     ) -> int:
         """Run the standard GA for up to ``n`` generations.
 
@@ -981,6 +1149,17 @@ class PGA:
         soon as a generation's best score reaches ``target`` — the behavior
         promised by ``pga.h:137-143`` and missing from the reference
         implementation.
+
+        ``inject`` (ISSUE 12): an optional ``(genomes (m, L), fitnesses
+        (m,))`` pair of EXTERNALLY evaluated candidates folded in at the
+        generation boundary before the first breed — they replace the
+        current worst rows and their told fitnesses seed the next
+        selection (see :func:`fold_injection`). ``None`` (every
+        pre-streaming caller) leaves the run paths byte-identical to the
+        pre-injection code. On a POPULATION-SHARDED run the fold happens
+        host-side before dispatch and the told fitnesses are re-scored
+        by the internal objective (the sharded loop evaluates its own
+        scores inside ``shard_map``).
 
         Returns the number of generations actually executed. Without a
         target this is exactly ``n``; with one, the default
@@ -997,15 +1176,38 @@ class PGA:
             # reaches the sharded path — the code below is byte-for-byte
             # the pre-sharding run loop (tests/test_shard_pop.py pins
             # its StableHLO).
+            if inject is not None:
+                self._fold_host(population or PopulationHandle(0), inject)
             return self._run_sharded(n, target, population)
         handle = population or PopulationHandle(0)
         pop = self._populations[handle.index]
-        fn, pallas_key = self._compiled_run_meta(pop.size, pop.genome_len)
+        inject_extra = ()
+        if inject is not None:
+            inj_g, inj_s, m = self._prepare_inject(pop, inject)
+            K = self._inject_slot_width(m, pop.size)
+            pad = K - m
+            if pad:
+                inj_g = np.concatenate(
+                    [inj_g, np.zeros((pad, pop.genome_len), np.float32)]
+                )
+                inj_s = np.concatenate(
+                    [inj_s, np.full(pad, -np.inf, np.float32)]
+                )
+            fn = self._compiled_run_inject(pop.size, pop.genome_len, K)
+            pallas_key = None
+            inject_extra = (
+                jnp.asarray(inj_g), jnp.asarray(inj_s), jnp.int32(m),
+            )
+        else:
+            fn, pallas_key = self._compiled_run_meta(
+                pop.size, pop.genome_len
+            )
         tgt = jnp.float32(jnp.inf if target is None else target)
         self._emit(
             "run_start", population_size=pop.size,
             genome_len=pop.genome_len, n=int(n),
             target=None if target is None else float(target),
+            **({"injected": int(inject_extra[2])} if inject_extra else {}),
         )
         self._emit_gp_run(pop.size)
         # Fault-injection site "objective.eval" (robustness/faults):
@@ -1020,7 +1222,7 @@ class PGA:
         args = (
             pop.genomes, self.next_key(), jnp.int32(n), tgt,
             self._mutate_params(),
-        )
+        ) + inject_extra
         with _tl.span("run"):
             try:
                 out = fn(*args)
@@ -1197,6 +1399,32 @@ class PGA:
             )
             self._compiled[cache_key] = fn
         return fn
+
+    def _fold_host(self, handle: PopulationHandle, inject) -> None:
+        """Host-side injection fold for paths whose compiled loop has no
+        injection slot (the sharded run): replace the current worst rows
+        with the told candidates BEFORE dispatch. The told fitnesses are
+        stored on the installed population but the sharded loop
+        re-evaluates its own scores inside ``shard_map``, so they steer
+        survival only through the genomes themselves — documented in the
+        streaming README section."""
+        pop = self._populations[handle.index]
+        inj_g, inj_s, m = self._prepare_inject(pop, inject)
+        scores = np.array(pop.scores, dtype=np.float32)
+        if not np.isfinite(scores).any():
+            # Never-evaluated population (-inf scores): any m rows are
+            # "the worst"; take the leading ones deterministically.
+            worst = np.arange(m)
+        else:
+            worst = np.argsort(scores)[:m]
+        genomes = np.asarray(pop.genomes).copy()
+        genomes[worst] = inj_g.astype(genomes.dtype)
+        scores[worst] = inj_s
+        self._populations[handle.index] = Population(
+            genomes=jnp.asarray(genomes, dtype=self.config.gene_dtype),
+            scores=jnp.asarray(scores),
+        )
+        self._staged[handle.index] = None
 
     def _run_sharded(
         self, n: int, target: Optional[float],

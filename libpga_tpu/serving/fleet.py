@@ -106,6 +106,38 @@ class FleetDeadLetter(RuntimeError):
 # ------------------------------------------------------------------- spool
 
 
+def _jax_env_knobs() -> Dict[str, str]:
+    """JAX settings that must MATCH across the process boundary, as the
+    environment a spawned worker needs (ISSUE 12 satellite).
+
+    Env-var settings already inherit through ``dict(os.environ)`` — the
+    gap is knobs the parent flipped PROGRAMMATICALLY via
+    ``jax.config.update`` (e.g. the test harness sets threefry
+    partitionability in-process): a worker left on the default would
+    derive DIFFERENT random streams from the very same ticket seed,
+    silently voiding the fleet's bit-identity contract. Collected here
+    for every spawn site: threefry partitionability, x64 mode, the
+    platform list, and the default PRNG implementation.
+    """
+    out: Dict[str, str] = {}
+    try:
+        import jax
+
+        out["JAX_THREEFRY_PARTITIONABLE"] = (
+            "1" if jax.config.jax_threefry_partitionable else "0"
+        )
+        out["JAX_ENABLE_X64"] = "1" if jax.config.jax_enable_x64 else "0"
+        platforms = getattr(jax.config, "jax_platforms", None)
+        if platforms:
+            out["JAX_PLATFORMS"] = str(platforms)
+        prng_impl = getattr(jax.config, "jax_default_prng_impl", None)
+        if prng_impl:
+            out["JAX_DEFAULT_PRNG_IMPL"] = str(prng_impl)
+    except Exception:
+        pass
+    return out
+
+
 class Spool:
     """Path layout + atomic-write helpers for one fleet spool directory.
 
@@ -115,7 +147,7 @@ class Spool:
     """
 
     DIRS = ("pending", "claimed", "leases", "results", "dead", "ckpt",
-            "logs", "traces", "metrics")
+            "logs", "traces", "metrics", "sessions")
 
     def __init__(self, root: str):
         self.root = os.path.abspath(root)
@@ -747,18 +779,7 @@ class Fleet:
         if self._closed:
             raise RuntimeError("fleet is closed")
         spawned = []
-        # PRNG semantics must MATCH across the process boundary or the
-        # fleet's bit-identity contract is void: the coordinator may
-        # have flipped threefry partitionability via jax.config (not
-        # the environment — e.g. the test harness), and a worker left
-        # on the default would derive different random streams from
-        # the very same ticket seed.
-        try:
-            import jax
-
-            threefry = "1" if jax.config.jax_threefry_partitionable else "0"
-        except Exception:
-            threefry = None
+        jax_knobs = _jax_env_knobs()
         with self._lock:
             base = len(self._workers)
             for i in range(self.fleet.n_workers):
@@ -767,8 +788,7 @@ class Fleet:
                     self.spool.path("logs", f"{wid}.out"), "ab"
                 )
                 env = dict(os.environ)
-                if threefry is not None:
-                    env["JAX_THREEFRY_PARTITIONABLE"] = threefry
+                env.update(jax_knobs)
                 if self.fleet.tuning_db:
                     # Workers inherit the fleet's kernel tuning DB the
                     # same way faults travel: one env var (ISSUE 10).
@@ -794,6 +814,16 @@ class Fleet:
         self._alive_gauge()
         self._ensure_monitor()
         return spawned
+
+    def session_store(self):
+        """The fleet's spool-resident streaming session directory
+        (ISSUE 12): suspended :class:`~libpga_tpu.streaming
+        .EvolutionSession` states any worker process (or the
+        coordinator) can resume — same shared-filesystem, atomic-rename
+        contract as every other spool subdirectory."""
+        from libpga_tpu.streaming.store import SessionStore
+
+        return SessionStore(self.spool.path("sessions"))
 
     def workers_alive(self) -> List[str]:
         with self._lock:

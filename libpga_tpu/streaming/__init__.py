@@ -1,0 +1,37 @@
+"""Streaming evolution service (ISSUE 12): ask/tell tenants, persistent
+populations, warm engine pools, co-batched PBT.
+
+The session layer that turns "serving runs" into "serving evolution":
+
+- :class:`EvolutionSession` — a long-lived tenant: ``ask(k)`` /
+  ``tell(genomes, fitnesses)`` / ``step(n)``, with external evaluations
+  folded at generation boundaries inside the compiled engine loop
+  (``engine.make_run_loop``'s injection slot) and ``step()``-only
+  sessions bit-identical to plain ``PGA.run``;
+- :class:`EnginePool` — warm pre-compiled engines keyed by the serving
+  bucket signature, so a new tenant's first ask executes instead of
+  compiling (``streaming.pool.POOL_COUNTERS`` + the
+  ``streaming.pool.*`` metrics prove the 0-compile hit path);
+- :class:`SessionGroup` — N same-signature sessions advanced as ONE
+  mega-run, with optional population-based training across the
+  co-batched runs (``StreamingConfig(pbt=PBTConfig(...))``);
+- :class:`SessionStore` — suspended sessions in a spool directory any
+  fleet worker can resume (``Fleet.session_store()``).
+"""
+
+from libpga_tpu.config import PBTConfig, StreamingConfig
+from libpga_tpu.streaming.group import SessionGroup
+from libpga_tpu.streaming.pool import POOL_COUNTERS, EnginePool
+from libpga_tpu.streaming.session import EvolutionSession, make_ask_breed
+from libpga_tpu.streaming.store import SessionStore
+
+__all__ = [
+    "EvolutionSession",
+    "EnginePool",
+    "SessionGroup",
+    "SessionStore",
+    "StreamingConfig",
+    "PBTConfig",
+    "POOL_COUNTERS",
+    "make_ask_breed",
+]

@@ -1,0 +1,311 @@
+"""Co-batched session stepping + population-based training (ISSUE 12).
+
+N same-signature sessions stepped one at a time cost N dispatches of N
+programs' worth of launch overhead; a :class:`SessionGroup` advances
+them as ONE compiled mega-run over a leading run axis — the round-9
+serving layout (``serving/batch.py``) driven by live sessions instead
+of one-shot requests. Each session contributes its current population,
+its next engine key split, and its runtime mutation parameters; results
+install back into each session's engine, so group stepping is
+**bit-identical** to stepping every session individually (the breed is
+``ops/step.make_param_breed``, whose equal-parameter trace is the
+engine breed's — the serving bit-exactness contract).
+
+The group's program always carries the ``inject_slots`` boundary fold
+(``engine.make_run_loop``) at a fixed width ``tell_slots``: sessions
+with pending tells fold them INSIDE the loop (told fitnesses seed the
+next selection); sessions without pending pass ``inj_n = 0``, and the
+zero-mask fold writes back exactly the values it read — so a no-tell
+session's group step stays bit-identical to its solo step
+(tests/test_streaming.py pins both).
+
+**PBT** (``StreamingConfig(pbt=PBTConfig(...))``): at every
+``epoch_gens`` boundary the group argsorts the sessions by best fitness
+— one cross-run argsort over N scalars — and each bottom-quantile
+session copies its mutation rate/sigma from a top-quantile partner,
+then perturbs (exploit/explore). Rate/sigma are RUNTIME inputs of the
+shared program, so adaptation never recompiles. Off by default;
+``pbt=None`` never touches a session's parameters.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from libpga_tpu.config import StreamingConfig
+from libpga_tpu.engine import make_run_loop
+from libpga_tpu.ops.step import make_param_breed
+from libpga_tpu.population import Population
+from libpga_tpu.serving import cache as _cache
+from libpga_tpu.streaming.session import EvolutionSession
+from libpga_tpu.utils import metrics as _metrics
+from libpga_tpu.utils import telemetry as _tl
+
+
+class SessionGroup:
+    """Advance N same-signature sessions as one compiled mega-run.
+
+    Sessions must share shape, objective, config signature, and
+    operator KINDS with a runtime-parameter form (the builtin
+    point/gaussian/swap mutations and any ``param_batched`` callable —
+    ``ops/step.make_param_breed``'s contract).
+    """
+
+    def __init__(
+        self,
+        sessions: Sequence[EvolutionSession],
+        streaming: Optional[StreamingConfig] = None,
+        tell_slots: int = 8,
+        layout: Optional[str] = None,
+    ):
+        if not sessions:
+            raise ValueError("SessionGroup needs at least one session")
+        self.sessions: List[EvolutionSession] = list(sessions)
+        self.streaming = streaming or sessions[0].streaming
+        lead = sessions[0]
+        self.size = lead.size
+        self.genome_len = lead.genome_len
+        self.tell_slots = min(int(tell_slots), self.size)
+        if self.tell_slots < 1:
+            raise ValueError("tell_slots must be >= 1")
+        self._epoch = 0
+        eng = lead.pga
+        self._objective = eng._require_objective()
+        self._mutate_kind = eng._mutate_kind()
+        if self._mutate_kind is None:
+            raise ValueError(
+                "group stepping needs a runtime-parameter mutation kind "
+                "(builtin point/gaussian/swap or a param_batched operator)"
+            )
+        self._crossover = eng._crossover
+        self._config = eng.config
+        mark = self._signature(lead)
+        for s in sessions[1:]:
+            if self._signature(s) != mark:
+                raise ValueError(
+                    "group sessions must share one bucket signature "
+                    "(shape, objective, operators, config)"
+                )
+        # Per-session runtime mutation parameters — the PBT-adapted
+        # state. Seeded from each engine's own operator resolution so a
+        # group step of an unadapted session equals its solo step.
+        self._mparams = [
+            np.asarray(
+                [[s.pga._mutation_rate(),
+                  s.pga._operator_param("sigma", 0.0)]], np.float32
+            )
+            for s in self.sessions
+        ]
+        if layout is None:
+            try:
+                backend = jax.default_backend()
+            except RuntimeError:
+                backend = "cpu"
+            layout = "run_major" if backend == "cpu" else "lockstep"
+        self.layout = layout
+
+    def _signature(self, s: EvolutionSession) -> tuple:
+        from libpga_tpu.engine import _kind_key
+
+        eng = s.pga
+        return (
+            s.size, s.genome_len, eng._objective,
+            _kind_key(eng._crossover_kind()),
+            _kind_key(eng._mutate_kind()),
+            eng.config.serving_signature_fields(),
+        )
+
+    # ------------------------------------------------------------- program
+
+    def mutation_params(self, i: int) -> tuple:
+        """(rate, sigma) currently applied to session ``i`` — the
+        PBT-adapted values, runtime inputs of the shared program."""
+        return float(self._mparams[i][0, 0]), float(self._mparams[i][0, 1])
+
+    def _hist_gens(self) -> Optional[int]:
+        t = self._config.telemetry
+        return (
+            t.history_gens if t is not None and t.history_gens > 0 else None
+        )
+
+    def _program(self, N: int):
+        cfg = self._config
+        hist = self._hist_gens()
+        K = self.tell_slots
+        key = (
+            "streaming/group", N, self.size, self.genome_len,
+            self._objective, self._crossover,
+            ("kind", getattr(self._mutate_kind, "kernel_cache_key",
+                             self._mutate_kind)),
+            cfg.serving_signature_fields(), K, self.layout,
+        )
+
+        def build():
+            breed = make_param_breed(
+                self._crossover,
+                self._mutate_kind,
+                tournament_size=cfg.tournament_size,
+                selection_kind=cfg.selection,
+                selection_param=cfg.selection_param,
+                elitism=cfg.elitism,
+            )
+            run_loop = make_run_loop(
+                self._objective, breed, hist, inject_slots=K
+            )
+            if self.layout == "lockstep":
+
+                def mega(genomes, key_data, n, target, mparams,
+                         inj_g, inj_s, inj_n):
+                    keys = jax.random.wrap_key_data(key_data)
+                    return jax.vmap(run_loop)(
+                        genomes, keys, n, target, mparams,
+                        inj_g, inj_s, inj_n,
+                    )
+
+            else:
+
+                def mega(genomes, key_data, n, target, mparams,
+                         inj_g, inj_s, inj_n):
+                    keys = jax.random.wrap_key_data(key_data)
+
+                    def one(carry, xs):
+                        return carry, run_loop(*xs)
+
+                    _, out = jax.lax.scan(
+                        one, 0,
+                        (genomes, keys, n, target, mparams,
+                         inj_g, inj_s, inj_n),
+                    )
+                    return out
+
+            donate = (0,) if cfg.donate_buffers else ()
+            return jax.jit(mega, donate_argnums=donate)
+
+        def on_compile():
+            self.sessions[0]._emit(
+                "compile", what="streaming_group", batch_width=N,
+                population_size=self.size, genome_len=self.genome_len,
+                tell_slots=K,
+            )
+
+        return _cache.PROGRAM_CACHE.get_or_build(
+            key, build, on_compile=on_compile
+        )
+
+    # ---------------------------------------------------------------- step
+
+    def _step_once(self, n: int, target: Optional[float]) -> None:
+        """One co-batched advance of every session by up to ``n``
+        generations (one device program)."""
+        N = len(self.sessions)
+        K = self.tell_slots
+        L = self.genome_len
+        genomes, key_data, mparams = [], [], []
+        inj_g = np.zeros((N, K, L), np.float32)
+        inj_s = np.full((N, K), -np.inf, np.float32)
+        inj_n = np.zeros((N,), np.int32)
+        for i, s in enumerate(self.sessions):
+            pending = s.take_pending(limit=K)
+            if pending is not None:
+                g, f = pending
+                m = g.shape[0]
+                inj_g[i, :m] = g
+                inj_s[i, :m] = f
+                inj_n[i] = m
+                s._emit(
+                    "session_fold", session=s.sid, folded=int(m),
+                    where="group_step",
+                )
+                _metrics.REGISTRY.counter("streaming.folds").bump(m)
+            pop = s.pga.population(s.handle)
+            genomes.append(pop.genomes)
+            key_data.append(jax.random.key_data(s.pga.next_key()))
+            mparams.append(self._mparams[i])
+        fn = self._program(N)
+        tgt = np.float32(np.inf if target is None else target)
+        with _tl.span("group_step"):
+            out = fn(
+                jnp.stack(genomes),
+                jnp.stack(key_data).astype(jnp.uint32),
+                jnp.full((N,), n, jnp.int32),
+                jnp.full((N,), tgt, jnp.float32),
+                jnp.stack([jnp.asarray(m) for m in mparams]),
+                jnp.asarray(inj_g), jnp.asarray(inj_s),
+                jnp.asarray(inj_n),
+            )
+        g, s_, gens = out[:3]
+        buf = out[3] if len(out) > 3 else None
+        hist_gens = self._hist_gens()
+        for i, sess in enumerate(self.sessions):
+            sess.pga._populations[sess.handle.index] = Population(
+                genomes=g[i], scores=s_[i]
+            )
+            sess.pga._staged[sess.handle.index] = None
+            done = int(gens[i])
+            sess.gens_done += done
+            hist = None
+            if buf is not None and hist_gens:
+                hist = _tl.History(buf[i], done)
+                sess._histories.append(hist)
+            sess.pga._history[sess.handle.index] = hist
+
+    def step(self, n: int, target: Optional[float] = None) -> int:
+        """Advance every session ``n`` generations. With PBT enabled the
+        advance is chunked at ``PBTConfig.epoch_gens`` boundaries and
+        the exploit/explore pass runs between chunks. Returns the
+        generations advanced (``n``)."""
+        pbt = self.streaming.pbt
+        if pbt is None:
+            self._step_once(n, target)
+            return n
+        left = n
+        while left > 0:
+            chunk = min(left, pbt.epoch_gens)
+            self._step_once(chunk, target)
+            left -= chunk
+            if left > 0 or chunk == pbt.epoch_gens:
+                self._pbt_epoch()
+        return n
+
+    # ----------------------------------------------------------------- pbt
+
+    def _pbt_epoch(self) -> None:
+        """One exploit/explore pass: ONE cross-run argsort over the
+        sessions' best fitnesses, then a parameter copy + perturbation
+        for the bottom quantile. Deterministic (epoch-indexed PRNG)."""
+        pbt = self.streaming.pbt
+        N = len(self.sessions)
+        q = max(1, int(N * pbt.exploit_frac))
+        if N < 2:
+            return
+        self._epoch += 1
+        best = np.asarray([
+            float(jnp.max(s.pga.population(s.handle).scores))
+            for s in self.sessions
+        ])
+        order = np.argsort(best)  # ascending: worst first
+        bottom, top = order[:q], order[-q:]
+        rng = np.random.default_rng(pbt.seed * 1_000_003 + self._epoch)
+        moved = 0
+        for idx in bottom:
+            partner = int(rng.choice(top))
+            rate, sigma = self._mparams[partner][0]
+            factor = (
+                pbt.explore_factor
+                if rng.random() < 0.5 else 1.0 / pbt.explore_factor
+            )
+            rate = float(np.clip(rate * factor, *pbt.rate_bounds))
+            sigma = float(np.clip(sigma, *pbt.sigma_bounds))
+            self._mparams[idx] = np.asarray(
+                [[rate, sigma]], np.float32
+            )
+            moved += 1
+        _metrics.REGISTRY.counter("streaming.pbt.exploits").bump(moved)
+        self.sessions[0]._emit(
+            "pbt_epoch", epoch=self._epoch, exploited=moved,
+            best=float(best.max()),
+        )

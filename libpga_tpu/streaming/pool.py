@@ -1,0 +1,276 @@
+"""Warm engine pools: a tenant's first ask costs milliseconds, not a
+compile (ISSUE 12).
+
+A cold :class:`~libpga_tpu.streaming.session.EvolutionSession` pays the
+full trace+compile pipeline on its first ``step``/``ask`` — on the CPU
+host that is ~hundreds of milliseconds; on a TPU with Mosaic kernels it
+is tens of seconds. The pool removes that cost from the tenant path the
+same way the serving cache (``serving/cache.py``) removes it from the
+batch path, and reuses its SIGNATURE discipline: engines are keyed by
+the exact tuple of everything baked into their compiled programs —
+shape, objective, operator instances, and
+``PGAConfig.serving_signature_fields()`` — so two tenants share warm
+state iff they could share a compiled program.
+
+Three mechanisms, cheapest first:
+
+- **engine reuse** — a released session's engine returns to the pool
+  with its ``_compiled`` programs intact; ``acquire`` resets ONLY its
+  PRNG/population state to the new tenant's seed (the reset replays the
+  ``PGA(seed)`` construction exactly, so a pooled session stays
+  bit-identical to a fresh one — pinned in tests);
+- **compiled-program sharing** — engines of one signature share their
+  compiled-program dict entries (the cache keys are equal because the
+  pool hands every engine the same objective/operator instances), so
+  even a pool that must GROW under concurrent tenants compiles each
+  program once;
+- **prewarm** — ``prewarm()`` (and ``acquire`` on a cold signature,
+  when ``StreamingConfig.prewarm``) compiles the run program eagerly
+  with one zero-generation dispatch — the engine-path analog of the
+  serving cache's AOT ``lower().compile()`` warm-up.
+
+``hits``/``misses``/``prewarms`` land in the round-11 metrics registry
+(``streaming.pool.*``) and in :data:`POOL_COUNTERS` for exact-delta
+asserts (the CI smoke proves a pooled signature compiles 0 programs).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+import jax
+
+from libpga_tpu.config import PGAConfig, StreamingConfig
+from libpga_tpu.engine import PGA, _kind_key
+from libpga_tpu.streaming.session import EvolutionSession
+from libpga_tpu.utils import metrics as _metrics
+from libpga_tpu.utils.metrics import Counters
+
+#: Module-level counter set: hits / misses / prewarms / releases.
+POOL_COUNTERS = Counters()
+
+
+class EnginePool:
+    """Pool of pre-compiled, pre-warmed engines keyed by bucket
+    signature. Thread-safe (tenant handlers race on acquire/release)."""
+
+    def __init__(
+        self,
+        config: Optional[PGAConfig] = None,
+        streaming: Optional[StreamingConfig] = None,
+        counters: Optional[Counters] = None,
+    ):
+        self.config = config or PGAConfig()
+        self.streaming = streaming or StreamingConfig()
+        self.counters = counters if counters is not None else POOL_COUNTERS
+        self._lock = threading.Lock()
+        # signature -> {"idle": [PGA...], "objective", "crossover",
+        #               "mutate", "compiled": shared template dict}
+        self._entries: Dict[tuple, dict] = {}
+
+    # ------------------------------------------------------------ signature
+
+    def signature(
+        self, objective, size: int, genome_len: int,
+        crossover=None, mutate=None,
+    ) -> tuple:
+        """The warm-pool bucket signature: the serving signature
+        discipline (everything baked into a compiled program) applied
+        to engine-path sessions."""
+        return (
+            "streaming/engine", size, genome_len, objective,
+            _kind_key(crossover), _kind_key(mutate),
+            self.config.serving_signature_fields(),
+        )
+
+    def _gauge(self) -> None:
+        with self._lock:
+            n = sum(len(e["idle"]) for e in self._entries.values())
+        _metrics.REGISTRY.gauge("streaming.pool.idle").set(n)
+
+    # --------------------------------------------------------------- warmup
+
+    def _warm_engine(self, eng: PGA, size: int, genome_len: int) -> None:
+        """Compile the run program eagerly: one zero-generation dispatch
+        at the real shape fills the jit wrapper's executable cache, so
+        the tenant's first step only executes. Consumes no engine PRNG
+        state (the dummy key is synthesized here)."""
+        import jax.numpy as jnp
+
+        fn, _ = eng._compiled_run_meta(size, genome_len)
+        dummy = jnp.zeros((size, genome_len), dtype=eng.config.gene_dtype)
+        fn(
+            dummy, jax.random.key(0), jnp.int32(0), jnp.float32(jnp.inf),
+            eng._mutate_params(),
+        )
+
+    def prewarm(
+        self, objective, size: int, genome_len: int,
+        crossover=None, mutate=None,
+    ) -> None:
+        """Admit a signature and compile its programs ahead of the first
+        tenant. Idempotent; parks one warm idle engine."""
+        if isinstance(objective, str):
+            from libpga_tpu import objectives
+
+            objective = objectives.get(objective)
+        sig = self.signature(objective, size, genome_len, crossover, mutate)
+        with self._lock:
+            entry = self._entries.get(sig)
+            if entry is not None and entry["idle"]:
+                return
+        eng = self._fresh_engine(sig, objective, crossover, mutate, seed=0)
+        self._warm_engine(eng, size, genome_len)
+        self.counters.bump("prewarms")
+        _metrics.REGISTRY.counter("streaming.pool.prewarms").bump()
+        with self._lock:
+            entry = self._entries[sig]
+            entry["compiled"].update(eng._compiled)
+            self._reset_engine(eng, 0)
+            entry["idle"].append(eng)
+        self._gauge()
+
+    # -------------------------------------------------------------- engines
+
+    def _entry(self, sig: tuple, objective, crossover, mutate) -> dict:
+        with self._lock:
+            entry = self._entries.get(sig)
+            if entry is None:
+                entry = {
+                    "idle": [], "objective": objective,
+                    "crossover": crossover, "mutate": mutate,
+                    "compiled": {},
+                }
+                self._entries[sig] = entry
+            return entry
+
+    def _fresh_engine(
+        self, sig: tuple, objective, crossover, mutate, seed,
+    ) -> PGA:
+        entry = self._entry(sig, objective, crossover, mutate)
+        eng = PGA(seed=seed, config=self.config)
+        # The pool's canonical operator instances make the compiled-
+        # program cache keys EQUAL across this signature's engines, so
+        # the shared template dict below actually shares programs.
+        eng.set_objective(entry["objective"])
+        if entry["crossover"] is not None:
+            eng.set_crossover(entry["crossover"])
+        if entry["mutate"] is not None:
+            eng.set_mutate(entry["mutate"])
+        eng._compiled.update(entry["compiled"])
+        return eng
+
+    @staticmethod
+    def _reset_engine(eng: PGA, seed: Optional[int]) -> None:
+        """Replay the ``PGA(seed)`` construction on a pooled engine:
+        fresh key chain, no populations — everything EXCEPT the compiled
+        programs, which are the point of the pool."""
+        if seed is None:
+            import os
+
+            seed = int.from_bytes(os.urandom(4), "little")
+        eng._key = jax.random.key(seed)
+        eng._populations = []
+        eng._staged = []
+        eng._history = []
+
+    # ------------------------------------------------------ acquire/release
+
+    def acquire(
+        self,
+        objective,
+        size: int,
+        genome_len: int,
+        seed: Optional[int] = None,
+        crossover=None,
+        mutate=None,
+        genomes=None,
+        session_id: Optional[str] = None,
+    ) -> EvolutionSession:
+        """A warm :class:`EvolutionSession` for one tenant: a pooled
+        engine when the signature is warm (hit — 0 compiles), a fresh
+        one otherwise (miss — prewarmed per ``StreamingConfig.prewarm``
+        before the session sees it). Bit-identity with a cold session
+        holds either way."""
+        objective_name = objective if isinstance(objective, str) else None
+        if isinstance(objective, str):
+            from libpga_tpu import objectives
+
+            objective = objectives.get(objective)
+        sig = self.signature(objective, size, genome_len, crossover, mutate)
+        eng = None
+        with self._lock:
+            entry = self._entries.get(sig)
+            if entry is not None and entry["idle"]:
+                eng = entry["idle"].pop()
+        if eng is not None:
+            self.counters.bump("hits")
+            _metrics.REGISTRY.counter("streaming.pool.hits").bump()
+            self._reset_engine(eng, seed)
+        else:
+            self.counters.bump("misses")
+            _metrics.REGISTRY.counter("streaming.pool.misses").bump()
+            eng = self._fresh_engine(
+                sig, objective, crossover, mutate, seed
+            )
+            if self.streaming.prewarm and genomes is None:
+                t0 = time.perf_counter()
+                self._warm_engine(eng, size, genome_len)
+                _metrics.REGISTRY.histogram(
+                    "streaming.pool.prewarm_seconds"
+                ).observe(time.perf_counter() - t0)
+                # The dummy dispatch consumed nothing from the tenant's
+                # chain, but set_* cleared per-op caches — re-share.
+                with self._lock:
+                    self._entries[sig]["compiled"].update(eng._compiled)
+        self._gauge()
+        # Create the tenant's population through the engine exactly like
+        # a cold construction would — this consumes the first key split
+        # of the fresh chain, which is what keeps pooled sessions
+        # bit-identical to cold ones.
+        if genomes is not None:
+            handle = eng.install_population(genomes)
+        else:
+            handle = eng.create_population(size, genome_len)
+        session = EvolutionSession(
+            streaming=self.streaming,
+            session_id=session_id,
+            _engine=eng,
+            _handle=handle,
+        )
+        session.objective_name = objective_name
+        session._pool = (self, sig)
+        return session
+
+    def release(self, session: EvolutionSession) -> None:
+        """Return a session's engine to the pool (idle, populations
+        dropped, compiled programs kept). Suspend first if the tenant
+        may come back — release alone discards the population."""
+        pool_mark = getattr(session, "_pool", None)
+        if pool_mark is None or pool_mark[0] is not self:
+            raise ValueError("session was not acquired from this pool")
+        _, sig = pool_mark
+        eng = session.pga
+        session._pool = None
+        self.counters.bump("releases")
+        with self._lock:
+            entry = self._entries.get(sig)
+            if entry is None:
+                return
+            entry["compiled"].update(eng._compiled)
+            cap = self.streaming.pool_capacity
+            if cap is None or len(entry["idle"]) < cap:
+                self._reset_engine(eng, 0)
+                entry["idle"].append(eng)
+        self._gauge()
+
+    def stats(self) -> dict:
+        out = self.counters.snapshot()
+        with self._lock:
+            out["signatures"] = len(self._entries)
+            out["idle"] = sum(
+                len(e["idle"]) for e in self._entries.values()
+            )
+        return out

@@ -1,0 +1,523 @@
+"""Long-lived ask/tell evolution sessions (ISSUE 12).
+
+Every workload before this module was batch-shaped: submit a ticket, run
+N generations, read one result. A :class:`EvolutionSession` is the
+interactive class the ROADMAP's item 3 names — a TENANT that holds a
+population open across requests and steers it:
+
+- ``ask(k)``    — breed k candidate genomes from the current population
+  for EXTERNAL evaluation (the autotuner's ask/measure/tell protocol,
+  ``tuning/tuner.py``, generalized to arbitrary clients — cuPilot's
+  strategy-coordination loop, PAPERS.md arxiv 2512.16465);
+- ``tell(genomes, fitnesses)`` — hand externally evaluated candidates
+  back; they are folded in at the NEXT GENERATION BOUNDARY (the
+  ``inject_slots`` grown onto ``engine.make_run_loop``): the first
+  breed after a fold selects over the told fitnesses, later
+  generations re-score through the internal objective;
+- ``step(n)``   — advance n generations on the internal objective.
+  A session that is only ever ``step()``ped is **bit-identical** to a
+  plain ``PGA.run`` of the same seed/config — the session owns a real
+  :class:`~libpga_tpu.engine.PGA` and replays nothing: construction IS
+  ``PGA(seed)`` + ``create_population``, so the PRNG chain, the
+  telemetry history, and every composition (``pop_shards``, GP
+  genomes, islands operators) hold with zero special cases;
+- ``suspend(path)`` / ``resume(path)`` — persistent populations: the
+  full session state (populations + PRNG key via the atomic
+  ``utils/checkpoint`` machinery, pending tells + session meta via
+  sidecar files, meta written LAST as the commit point — the
+  ``serving/fleet.py`` atomic-rename discipline) round-trips across
+  processes, so a tenant reconnecting can land on ANY fleet worker
+  hosting the session directory (:class:`streaming.store.SessionStore`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from libpga_tpu.config import PGAConfig, StreamingConfig
+from libpga_tpu.engine import PGA, PopulationHandle
+from libpga_tpu.ops.select import select_parent_pairs
+from libpga_tpu.population import Population
+from libpga_tpu.serving import cache as _cache
+from libpga_tpu.utils import checkpoint as _ckpt
+from libpga_tpu.utils import metrics as _metrics
+from libpga_tpu.utils import telemetry as _tl
+
+#: Session sidecar schema (the ``<path>.session.json`` commit file).
+SESSION_META_VERSION = 1
+
+_SID_LOCK = threading.Lock()
+_SID_SEQ = 0
+
+
+def _next_sid() -> str:
+    global _SID_SEQ
+    with _SID_LOCK:
+        _SID_SEQ += 1
+        return f"sess-{os.getpid()}-{_SID_SEQ}"
+
+
+def _atomic_write_text(path: str, text: str) -> None:
+    """Same temp-file + os.replace discipline as the checkpoint and the
+    fleet spool: a crash mid-write never tears an existing good file."""
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as fh:
+        fh.write(text)
+    os.replace(tmp, path)
+
+
+def make_ask_breed(
+    crossover_fn: Callable,
+    mutate_fn: Callable,
+    k: int,
+    *,
+    tournament_size: int = 2,
+    selection_kind: str = "tournament",
+    selection_param: Optional[float] = None,
+):
+    """``ask(genomes, scores, key) -> (k, L) candidates``: one
+    selection+variation pass producing exactly ``k`` children — the
+    engine's breed semantics (``ops/step.make_breed``, same operator
+    protocol: ``.batched`` / ``.rand_cols``) at candidate width instead
+    of population width. No elitism: candidates are proposals for
+    external evaluation, not survivors."""
+    cross_batched = getattr(crossover_fn, "batched", None)
+    cross_cols = getattr(crossover_fn, "rand_cols", None)
+    mut_batched = getattr(mutate_fn, "batched", None)
+    mut_cols = getattr(mutate_fn, "rand_cols", None)
+
+    def ask(genomes, scores, key):
+        L = genomes.shape[1]
+        k_sel, k_cross, k_mut = jax.random.split(key, 3)
+        i1, i2 = select_parent_pairs(
+            k_sel, scores, k, k=tournament_size,
+            kind=selection_kind, param=selection_param,
+        )
+        p1 = jnp.take(genomes, i1, axis=0)
+        p2 = jnp.take(genomes, i2, axis=0)
+        rand_c = jax.random.uniform(
+            k_cross, (k, cross_cols or L), dtype=jnp.float32
+        )
+        if cross_batched is not None:
+            children = cross_batched(p1, p2, rand_c)
+        else:
+            children = jax.vmap(crossover_fn)(p1, p2, rand_c)
+        rand_m = jax.random.uniform(
+            k_mut, (k, mut_cols or L), dtype=jnp.float32
+        )
+        if mut_batched is not None:
+            out = mut_batched(children, rand_m)
+        else:
+            out = jax.vmap(mutate_fn)(children, rand_m)
+        return out.astype(genomes.dtype)
+
+    return ask
+
+
+class EvolutionSession:
+    """One streaming tenant: a persistent population + ask/tell/step.
+
+    Construction is EXACTLY an engine construction — ``PGA(seed=seed,
+    config=config)`` + ``create_population(size, genome_len)`` (or
+    ``install_population(genomes)`` for non-noise representations like
+    GP programs) — so a ``step()``-only session cannot diverge from a
+    plain ``PGA.run`` by even a bit (final best AND telemetry history;
+    pinned by ``tools/streaming_smoke.py``).
+    """
+
+    def __init__(
+        self,
+        objective=None,
+        size: int = 0,
+        genome_len: int = 0,
+        seed: Optional[int] = None,
+        config: Optional[PGAConfig] = None,
+        streaming: Optional[StreamingConfig] = None,
+        crossover: Optional[Callable] = None,
+        mutate: Optional[Callable] = None,
+        genomes=None,
+        session_id: Optional[str] = None,
+        _engine: Optional[PGA] = None,
+        _handle: Optional[PopulationHandle] = None,
+    ):
+        self.sid = session_id or _next_sid()
+        self.streaming = streaming or StreamingConfig()
+        if _engine is not None:
+            self.pga = _engine
+            self.handle = _handle or PopulationHandle(0)
+        else:
+            self.pga = PGA(seed=seed, config=config)
+            if genomes is not None:
+                self.handle = self.pga.install_population(genomes)
+            else:
+                if size < 1 or genome_len < 1:
+                    raise ValueError(
+                        "EvolutionSession needs (size, genome_len) or an "
+                        "explicit genomes matrix"
+                    )
+                self.handle = self.pga.create_population(size, genome_len)
+        # Remembered for the suspend meta: a string objective resumes by
+        # name alone; opaque callables must be re-provided at resume.
+        self.objective_name = (
+            objective if isinstance(objective, str)
+            else getattr(objective, "name", None)
+        )
+        if objective is not None:
+            self.pga.set_objective(objective)
+        if crossover is not None:
+            self.pga.set_crossover(crossover)
+        if mutate is not None:
+            self.pga.set_mutate(mutate)
+        self.gens_done = 0
+        # Pending external evaluations, folded at the next boundary.
+        self._pending_g: List[np.ndarray] = []
+        self._pending_s: List[np.ndarray] = []
+        self._histories: List[_tl.History] = []
+        pop = self.pga.population(self.handle)
+        self._emit(
+            "session_open", session=self.sid,
+            population_size=pop.size, genome_len=pop.genome_len,
+        )
+        _metrics.REGISTRY.counter("streaming.sessions.opened").bump()
+
+    # ------------------------------------------------------------- plumbing
+
+    def _emit(self, event: str, **fields) -> None:
+        self.pga._emit(event, **fields)
+
+    @property
+    def objective(self):
+        return self.pga._objective
+
+    @property
+    def size(self) -> int:
+        return self.pga.population(self.handle).size
+
+    @property
+    def genome_len(self) -> int:
+        return self.pga.population(self.handle).genome_len
+
+    def population(self) -> Population:
+        return self.pga.population(self.handle)
+
+    @property
+    def history(self) -> Optional[_tl.History]:
+        """Telemetry history of the most recent step (the engine
+        contract — ``PGA.history``); ``histories`` keeps every step's."""
+        return self.pga.history(self.handle)
+
+    @property
+    def histories(self) -> List[_tl.History]:
+        return list(self._histories)
+
+    @property
+    def pending_tells(self) -> int:
+        return sum(g.shape[0] for g in self._pending_g)
+
+    def best(self) -> tuple:
+        """(best genome host array, best score) of the current
+        population under its last known scores."""
+        pop = self.pga.population(self.handle)
+        idx = int(jnp.argmax(pop.scores))
+        return np.asarray(pop.genomes[idx]), float(pop.scores[idx])
+
+    # -------------------------------------------------------------- ask/tell
+
+    def tell(self, genomes, fitnesses) -> int:
+        """Hand back externally evaluated candidates. Buffered host-side
+        and folded at the next generation boundary (the next ``step`` —
+        inside the compiled loop's injection slot — or the next ``ask``,
+        host-side). Returns the pending count."""
+        g = np.asarray(genomes, dtype=np.float32)
+        if g.ndim == 1:
+            g = g[None, :]
+        s = np.asarray(fitnesses, dtype=np.float32).reshape(-1)
+        L = self.genome_len
+        if g.ndim != 2 or g.shape[1] != L:
+            raise ValueError(
+                f"tell genomes {g.shape} incompatible with genome_len {L}"
+            )
+        if g.shape[0] != s.shape[0]:
+            raise ValueError(
+                f"tell carries {g.shape[0]} genomes but {s.shape[0]} "
+                "fitnesses"
+            )
+        if not np.isfinite(s).all():
+            raise ValueError("tell fitnesses must be finite")
+        self._pending_g.append(g)
+        self._pending_s.append(s)
+        _metrics.REGISTRY.counter("streaming.tells").bump(g.shape[0])
+        return self.pending_tells
+
+    def take_pending(self, limit: Optional[int] = None) -> Optional[tuple]:
+        """Drain (up to ``limit`` of) the pending tells as one
+        ``(genomes, fitnesses)`` pair, newest last — the payload of the
+        engine's injection slot. None when nothing is pending."""
+        if not self._pending_g:
+            return None
+        g = np.concatenate(self._pending_g)
+        s = np.concatenate(self._pending_s)
+        cap = self.streaming.max_tell_slots
+        cap = self.size if cap is None else min(cap, self.size)
+        if limit is not None:
+            cap = min(cap, limit)
+        if g.shape[0] > cap:
+            self._pending_g = [g[cap:]]
+            self._pending_s = [s[cap:]]
+            g, s = g[:cap], s[:cap]
+        else:
+            self._pending_g = []
+            self._pending_s = []
+        return g, s
+
+    def _fold_pending_host(self) -> int:
+        """Fold pending tells host-side (the ``ask`` boundary — no
+        compiled loop runs, so the fold is a numpy scatter): told
+        candidates replace the worst-scoring rows and their fitnesses
+        are INSTALLED as those rows' scores, so the very next ask
+        selects over them."""
+        pending = self.take_pending()
+        if pending is None:
+            return 0
+        g, s = pending
+        pop = self.pga.population(self.handle)
+        scores = np.array(pop.scores, dtype=np.float32)
+        m = g.shape[0]
+        worst = np.argsort(scores)[:m]
+        genomes = np.asarray(pop.genomes).copy()
+        genomes[worst] = g.astype(genomes.dtype)
+        scores[worst] = s
+        self.pga._populations[self.handle.index] = Population(
+            genomes=jnp.asarray(
+                genomes, dtype=self.pga.config.gene_dtype
+            ),
+            scores=jnp.asarray(scores),
+        )
+        self.pga._staged[self.handle.index] = None
+        self._emit("session_fold", session=self.sid, folded=m, where="ask")
+        _metrics.REGISTRY.counter("streaming.folds").bump(m)
+        return m
+
+    def ask(self, k: int) -> np.ndarray:
+        """Propose ``k`` candidate genomes for external evaluation, bred
+        from the current population (tournament/ranked selection over
+        the last known fitnesses — internal evaluations and told values
+        alike). Pending tells fold first, so a tell→ask round trip
+        selects over the told fitnesses. Before ANY fitness is known
+        (fresh session, no tells, never stepped) the first ``k``
+        population rows are returned unchanged — they are random, and
+        breeding over uniform ``-inf`` scores would only pretend to
+        select."""
+        if k < 1:
+            raise ValueError("ask k must be >= 1")
+        if k > self.size:
+            raise ValueError(f"ask k={k} exceeds population size {self.size}")
+        self._fold_pending_host()
+        pop = self.pga.population(self.handle)
+        scores = np.asarray(pop.scores, dtype=np.float32)
+        if not np.isfinite(scores).any():
+            return np.asarray(pop.genomes[:k], dtype=np.float32)
+        fn = self._ask_program(k)
+        with _tl.span("ask"):
+            out = fn(pop.genomes, pop.scores, self.pga.next_key())
+        return np.asarray(out, dtype=np.float32)
+
+    def _ask_program(self, k: int):
+        """Compiled ask breed for candidate width ``k`` — shared
+        process-wide through the serving program cache, so every session
+        of one signature compiles it once (the warm-pool stats the CI
+        smoke asserts count these builds too)."""
+        cfg = self.pga.config
+        key = (
+            "streaming/ask", k, self.size, self.genome_len,
+            self.pga._crossover, self.pga._mutate,
+            cfg.tournament_size, cfg.selection, cfg.selection_param,
+            np.dtype(cfg.gene_dtype).name,
+        )
+
+        def build():
+            ask = make_ask_breed(
+                self.pga._crossover, self.pga._mutate, k,
+                tournament_size=cfg.tournament_size,
+                selection_kind=cfg.selection,
+                selection_param=cfg.selection_param,
+            )
+            return jax.jit(ask)
+
+        def on_compile():
+            self._emit(
+                "compile", what="streaming_ask", k=k,
+                population_size=self.size, genome_len=self.genome_len,
+            )
+
+        return _cache.PROGRAM_CACHE.get_or_build(
+            key, build, on_compile=on_compile
+        )
+
+    # ------------------------------------------------------------------ step
+
+    def step(self, n: int, target: Optional[float] = None) -> int:
+        """Advance up to ``n`` generations on the internal objective.
+        Pending tells fold at the boundary inside the compiled loop
+        (``engine.make_run_loop``'s injection slot); with none pending
+        this IS ``PGA.run`` — the bit-identity anchor."""
+        inject = self.take_pending()
+        if inject is not None:
+            self._emit(
+                "session_fold", session=self.sid,
+                folded=int(inject[0].shape[0]), where="step",
+            )
+            _metrics.REGISTRY.counter("streaming.folds").bump(
+                inject[0].shape[0]
+            )
+        gens = self.pga.run(
+            n, target=target, population=self.handle, inject=inject
+        )
+        self.gens_done += gens
+        hist = self.pga.history(self.handle)
+        if hist is not None:
+            self._histories.append(hist)
+        return gens
+
+    # ------------------------------------------------------- suspend/resume
+
+    def suspend(self, path: str) -> str:
+        """Write the session durably to ``path``: the engine checkpoint
+        (atomic, CRC-manifested — ``utils/checkpoint``), a pending-tells
+        sidecar, and the session meta JSON LAST as the commit point.
+        The session object stays usable; a tenant reconnecting anywhere
+        the files are visible resumes bit-identically."""
+        _ckpt.save(self.pga, path)
+        tells_path = f"{path}.tells.npz"
+        if self._pending_g:
+            _ckpt._atomic_savez(tells_path, {
+                "genomes": np.concatenate(self._pending_g),
+                "fitness": np.concatenate(self._pending_s),
+            })
+        elif os.path.exists(tells_path):
+            os.remove(tells_path)
+        cfg = self.pga.config
+        obj = self.pga._objective
+        meta = {
+            "version": SESSION_META_VERSION,
+            "session": self.sid,
+            "population_size": self.size,
+            "genome_len": self.genome_len,
+            "gens_done": self.gens_done,
+            "pending_tells": self.pending_tells,
+            "objective": self.objective_name or getattr(obj, "name", None),
+            "config": {
+                "tournament_size": cfg.tournament_size,
+                "selection": cfg.selection,
+                "selection_param": cfg.selection_param,
+                "mutation_rate": cfg.mutation_rate,
+                "elitism": cfg.elitism,
+                "gene_dtype": np.dtype(cfg.gene_dtype).name,
+                "pop_shards": cfg.pop_shards,
+                "use_pallas": cfg.use_pallas,
+                "history_gens": (
+                    None if cfg.telemetry is None
+                    else cfg.telemetry.history_gens
+                ),
+            },
+        }
+        _atomic_write_text(
+            f"{path}.session.json",
+            json.dumps(meta, sort_keys=True) + "\n",
+        )
+        self._emit("session_suspend", session=self.sid, path=path)
+        _metrics.REGISTRY.counter("streaming.sessions.suspended").bump()
+        return path
+
+    @classmethod
+    def resume(
+        cls,
+        path: str,
+        objective=None,
+        config: Optional[PGAConfig] = None,
+        streaming: Optional[StreamingConfig] = None,
+        crossover: Optional[Callable] = None,
+        mutate: Optional[Callable] = None,
+    ) -> "EvolutionSession":
+        """Restore a suspended session bit-identically: populations and
+        the PRNG key come back through ``checkpoint.restore`` (so the
+        next ``step`` splits the exact key the uninterrupted session
+        would have), pending tells from the sidecar. ``objective`` (and
+        any custom operators) must be re-provided unless the suspended
+        objective was a named builtin recorded in the meta. ``config``
+        defaults to the serialized config fields (telemetry excluded —
+        pass a config to re-enable history/events)."""
+        meta_path = f"{path}.session.json"
+        try:
+            with open(meta_path) as fh:
+                meta = json.load(fh)
+        except FileNotFoundError:
+            raise FileNotFoundError(
+                f"no suspended session at {path} ({meta_path} missing — "
+                "suspend() writes it last, so the session never committed)"
+            )
+        if int(meta.get("version", -1)) != SESSION_META_VERSION:
+            raise _ckpt.CheckpointError(
+                f"unsupported session meta version {meta.get('version')}",
+                meta_path,
+            )
+        if config is None:
+            c = meta["config"]
+            import ml_dtypes
+
+            dtype = (
+                jnp.float32 if c["gene_dtype"] == "float32"
+                else np.dtype(getattr(ml_dtypes, c["gene_dtype"]))
+                if hasattr(ml_dtypes, c["gene_dtype"])
+                else np.dtype(c["gene_dtype"])
+            )
+            from libpga_tpu.utils.telemetry import TelemetryConfig
+
+            config = PGAConfig(
+                tournament_size=c["tournament_size"],
+                selection=c["selection"],
+                selection_param=c["selection_param"],
+                mutation_rate=c["mutation_rate"],
+                elitism=c["elitism"],
+                gene_dtype=dtype,
+                pop_shards=c["pop_shards"],
+                use_pallas=c["use_pallas"],
+                telemetry=(
+                    None if not c.get("history_gens")
+                    else TelemetryConfig(history_gens=c["history_gens"])
+                ),
+            )
+        if objective is None:
+            objective = meta.get("objective")
+            if objective is None:
+                raise ValueError(
+                    "suspended session has no named objective — pass "
+                    "objective= to resume()"
+                )
+        pga = PGA(seed=0, config=config)
+        _ckpt.restore(pga, path)
+        session = cls(
+            objective=objective,
+            streaming=streaming,
+            crossover=crossover,
+            mutate=mutate,
+            session_id=meta["session"],
+            _engine=pga,
+            _handle=PopulationHandle(0),
+        )
+        session.gens_done = int(meta.get("gens_done", 0))
+        tells_path = f"{path}.tells.npz"
+        if os.path.exists(tells_path):
+            with np.load(tells_path) as data:
+                session._pending_g = [np.asarray(data["genomes"])]
+                session._pending_s = [np.asarray(data["fitness"])]
+        session._emit("session_resume", session=session.sid, path=path)
+        _metrics.REGISTRY.counter("streaming.sessions.resumed").bump()
+        return session

@@ -1,0 +1,76 @@
+"""Spool-resident session directory: suspended tenants any worker can
+host (ISSUE 12).
+
+A :class:`SessionStore` is a directory of suspended
+:class:`~libpga_tpu.streaming.session.EvolutionSession` states under
+the same atomic-rename discipline as the serving fleet's spool
+(``serving/fleet.py``): every payload file (checkpoint npz, pending
+tells npz) is written via temp-file + ``os.replace``, and the session
+meta JSON is written LAST as the commit point — a crash mid-suspend
+leaves either the previous good state or nothing, never a torn one.
+``list()`` reads only committed metas.
+
+Fleet integration: ``Fleet.session_store()`` returns the store rooted
+at the fleet spool's ``sessions/`` directory, so a tenant suspended by
+one worker process resumes bit-identically on ANY process that sees the
+spool — the persistent-population half of "serving evolution".
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import List, Optional
+
+from libpga_tpu.streaming.session import EvolutionSession
+
+
+class SessionStore:
+    """Directory of suspended sessions, keyed by session id."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def path(self, sid: str) -> str:
+        if not sid or "/" in sid or sid.startswith("."):
+            raise ValueError(f"invalid session id {sid!r}")
+        return os.path.join(self.root, f"{sid}.ckpt.npz")
+
+    def suspend(self, session: EvolutionSession) -> str:
+        """Suspend a session into the store under its own id."""
+        return session.suspend(self.path(session.sid))
+
+    def resume(self, sid: str, **kw) -> EvolutionSession:
+        """Resume a stored session (``EvolutionSession.resume`` kwargs
+        pass through — objective/config/operators)."""
+        return EvolutionSession.resume(self.path(sid), **kw)
+
+    def list(self) -> List[str]:
+        """Committed session ids (meta file present), sorted."""
+        out = []
+        for meta in glob.glob(os.path.join(self.root, "*.session.json")):
+            try:
+                with open(meta) as fh:
+                    out.append(json.load(fh)["session"])
+            except (OSError, ValueError, KeyError):
+                continue  # torn/foreign file — never committed
+        return sorted(out)
+
+    def meta(self, sid: str) -> Optional[dict]:
+        meta = f"{self.path(sid)}.session.json"
+        try:
+            with open(meta) as fh:
+                return json.load(fh)
+        except FileNotFoundError:
+            return None
+
+    def discard(self, sid: str) -> None:
+        """Drop a stored session (meta first, so a racing resume sees
+        either the whole session or none of it)."""
+        base = self.path(sid)
+        for suffix in (".session.json", ".tells.npz", ""):
+            p = f"{base}{suffix}"
+            if os.path.exists(p):
+                os.remove(p)

@@ -128,6 +128,14 @@ EVENT_FIELDS: Dict[str, tuple] = {
     # GP objective (``gp/sr.py``), naming the postfix encoding — the
     # observability anchor for SR-as-a-service traffic.
     "gp_run": ("population_size", "max_nodes", "n_ops", "n_vars"),
+    # Streaming evolution service (ISSUE 12): session lifecycle —
+    # tenant open, external-evaluation folds at generation boundaries
+    # (``where`` names the boundary: step / ask / group_step),
+    # suspend-to-spool and resume-from-spool.
+    "session_open": ("session", "population_size", "genome_len"),
+    "session_fold": ("session", "folded"),
+    "session_suspend": ("session", "path"),
+    "session_resume": ("session", "path"),
 }
 
 

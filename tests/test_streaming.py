@@ -1,0 +1,574 @@
+"""Streaming evolution service (ISSUE 12).
+
+The acceptance matrix of `libpga_tpu/streaming/`:
+
+- a step()-only EvolutionSession is BIT-IDENTICAL to a same-seed
+  PGA.run (final population AND telemetry history) — including when
+  stepped in chunks, pooled, or co-batched in a SessionGroup;
+- the make_run_loop injection slot folds told candidates over the
+  worst rows with told-fitness override, and an empty fold (inj_n=0)
+  is value-identical to the uninjected program;
+- suspend -> resume (a fresh engine = a simulated fresh process) is
+  bit-identical at any generation boundary, pending tells and all, and
+  composes with pop_shards > 1 and GP genomes with zero special cases;
+- the warm pool's hit path reuses engines and compiles 0 new programs;
+- PBT is off by default and byte-inert when off; deterministic when on;
+- the C bridge's sized-snapshot entry points honor the retry-once
+  contract; fleet worker spawns propagate the parent's JAX config
+  knobs.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from libpga_tpu import (
+    PGA,
+    GPConfig,
+    PBTConfig,
+    PGAConfig,
+    StreamingConfig,
+    TelemetryConfig,
+)
+from libpga_tpu.engine import fold_injection, make_run_loop
+from libpga_tpu.ops.crossover import uniform_crossover
+from libpga_tpu.ops.mutate import make_point_mutate
+from libpga_tpu.ops.step import make_breed
+from libpga_tpu.streaming import (
+    EnginePool,
+    EvolutionSession,
+    SessionGroup,
+    SessionStore,
+)
+from libpga_tpu.utils import telemetry as T
+from libpga_tpu.utils.metrics import Counters
+
+CFG = PGAConfig(use_pallas=False)
+TCFG = PGAConfig(use_pallas=False, telemetry=TelemetryConfig(history_gens=32))
+
+
+def _engine(seed, size=128, genome_len=16, config=CFG, objective="onemax"):
+    pga = PGA(seed=seed, config=config)
+    h = pga.create_population(size, genome_len)
+    pga.set_objective(objective)
+    return pga, h
+
+
+def _same_pop(a, b) -> bool:
+    return np.array_equal(
+        np.asarray(a.genomes), np.asarray(b.genomes)
+    ) and np.array_equal(np.asarray(a.scores), np.asarray(b.scores))
+
+
+# ------------------------------------------------------------ injection slot
+
+
+class TestInjectionSlot:
+    def _loop(self, inject_slots=None, hist=None):
+        from libpga_tpu import objectives
+
+        obj = objectives.get("onemax")
+        breed3 = make_breed(uniform_crossover, make_point_mutate(0.01))
+        return make_run_loop(
+            obj, lambda g, s, k, mp: breed3(g, s, k), hist,
+            inject_slots=inject_slots,
+        )
+
+    @pytest.mark.parametrize("hist", [None, 16])
+    def test_empty_fold_is_value_identical(self, hist):
+        plain = self._loop(hist=hist)
+        inj = self._loop(inject_slots=4, hist=hist)
+        g0 = jax.random.uniform(jax.random.key(3), (64, 8))
+        key = jax.random.key(7)
+        args = (g0, key, jnp.int32(4), jnp.float32(np.inf),
+                jnp.zeros((1, 2), jnp.float32))
+        a = plain(*args)
+        b = inj(*args, jnp.zeros((4, 8)), jnp.full((4,), -jnp.inf),
+                jnp.int32(0))
+        for x, y in zip(a, b):
+            # equal_nan: the history buffer's never-written rows are NaN
+            assert np.array_equal(
+                np.asarray(x), np.asarray(y), equal_nan=True
+            )
+
+    def test_fold_replaces_worst_and_overrides_scores(self):
+        g = jnp.asarray(np.random.default_rng(0).uniform(size=(8, 4)),
+                        jnp.float32)
+        s = jnp.arange(8, dtype=jnp.float32)
+        inj_g = jnp.full((2, 4), 0.5, jnp.float32)
+        inj_s = jnp.asarray([100.0, 200.0], jnp.float32)
+        g2, s2 = fold_injection(g, s, inj_g, inj_s, jnp.int32(2))
+        s2 = np.asarray(s2)
+        # worst rows (scores 0 and 1) were replaced, told scores installed
+        assert set(np.asarray(jnp.sort(s2))[-2:]) == {100.0, 200.0}
+        assert np.allclose(np.asarray(g2)[np.argmax(s2)], 0.5)
+        # untouched rows intact
+        assert float(s2.sum()) == float(2 + 3 + 4 + 5 + 6 + 7 + 300)
+
+    def test_engine_run_inject(self):
+        pga, h = _engine(0, 64, 8)
+        told = np.full((3, 8), 0.75, np.float32)
+        gens = pga.run(0, inject=(told, np.full(3, 50.0, np.float32)))
+        assert gens == 0
+        pop = pga.population(h)
+        # a zero-generation inject run returns the folded state verbatim
+        assert float(jnp.max(pop.scores)) == 50.0
+        assert np.allclose(
+            np.asarray(pop.genomes)[int(jnp.argmax(pop.scores))], 0.75
+        )
+
+    def test_engine_run_inject_validation(self):
+        pga, h = _engine(1, 32, 8)
+        with pytest.raises(ValueError, match="incompatible"):
+            pga.run(1, inject=(np.zeros((2, 5), np.float32), np.zeros(2)))
+        with pytest.raises(ValueError, match="fitnesses"):
+            pga.run(1, inject=(np.zeros((2, 8), np.float32), np.zeros(3)))
+        with pytest.raises(ValueError, match="cannot fold"):
+            pga.run(1, inject=(
+                np.zeros((64, 8), np.float32), np.zeros(64)
+            ))
+
+
+# ----------------------------------------------------------------- sessions
+
+
+class TestSession:
+    def test_step_only_bit_identity(self):
+        s = EvolutionSession("onemax", 128, 16, seed=5, config=TCFG)
+        s.step(6)
+        pga, h = _engine(5, config=TCFG)
+        pga.run(6)
+        assert _same_pop(s.population(), pga.population(h))
+        assert np.array_equal(s.history._rows, pga.history(h)._rows)
+
+    def test_step_chunks_match_engine_runs(self):
+        s = EvolutionSession("onemax", 64, 8, seed=9, config=CFG)
+        s.step(3)
+        s.step(4)
+        pga, h = _engine(9, 64, 8)
+        pga.run(3)
+        pga.run(4)
+        assert _same_pop(s.population(), pga.population(h))
+        assert s.gens_done == 7
+
+    def test_ask_before_fitness_returns_population_rows(self):
+        s = EvolutionSession("onemax", 32, 8, seed=1, config=CFG)
+        cand = s.ask(4)
+        assert np.array_equal(
+            cand, np.asarray(s.population().genomes[:4], np.float32)
+        )
+
+    def test_tell_folds_at_ask_boundary(self):
+        s = EvolutionSession("onemax", 32, 8, seed=2, config=CFG)
+        told = np.full((2, 8), 0.9, np.float32)
+        s.tell(told, np.array([30.0, 40.0], np.float32))
+        assert s.pending_tells == 2
+        cand = s.ask(4)
+        assert cand.shape == (4, 8)
+        assert s.pending_tells == 0
+        pop = s.population()
+        assert float(jnp.max(pop.scores)) == 40.0  # told score installed
+
+    def test_tell_folds_inside_step(self):
+        s = EvolutionSession("onemax", 32, 8, seed=3, config=CFG)
+        s.tell(np.full((1, 8), 0.5, np.float32), np.array([99.0]))
+        gens = s.step(3, target=98.0)
+        # the told fitness already beats the target at the boundary:
+        # the loop exits before breeding a single generation.
+        assert gens == 0
+        assert float(jnp.max(s.population().scores)) == 99.0
+
+    def test_tell_validation(self):
+        s = EvolutionSession("onemax", 32, 8, seed=4, config=CFG)
+        with pytest.raises(ValueError, match="incompatible"):
+            s.tell(np.zeros((1, 5), np.float32), np.zeros(1))
+        with pytest.raises(ValueError, match="fitnesses"):
+            s.tell(np.zeros((2, 8), np.float32), np.zeros(1))
+        with pytest.raises(ValueError, match="finite"):
+            s.tell(np.zeros((1, 8), np.float32), np.array([np.nan]))
+
+    def test_events_schema(self, tmp_path):
+        events = str(tmp_path / "events.jsonl")
+        cfg = PGAConfig(
+            use_pallas=False,
+            telemetry=TelemetryConfig(history_gens=8, events_path=events),
+        )
+        s = EvolutionSession("onemax", 32, 8, seed=0, config=cfg)
+        s.tell(np.full((1, 8), 0.5, np.float32), np.array([1.0]))
+        s.step(2)
+        s.suspend(str(tmp_path / "s.ckpt.npz"))
+        s.pga._events.close()
+        records = T.validate_log(events)
+        kinds = [r["event"] for r in records]
+        assert "session_open" in kinds
+        assert "session_fold" in kinds
+        assert "session_suspend" in kinds
+        fold = next(r for r in records if r["event"] == "session_fold")
+        assert fold["folded"] == 1 and fold["session"] == s.sid
+
+
+# ----------------------------------------------------------- suspend/resume
+
+
+class TestSuspendResume:
+    def test_bit_identity_across_simulated_process(self, tmp_path):
+        path = str(tmp_path / "tenant.ckpt.npz")
+        s = EvolutionSession("onemax", 64, 8, seed=11, config=TCFG)
+        s.step(3)
+        s.suspend(path)
+        # a fresh resume is a simulated different process: nothing is
+        # shared with the original but the files.
+        r = EvolutionSession.resume(path, objective="onemax", config=TCFG)
+        s.step(4)
+        r.step(4)
+        assert _same_pop(s.population(), r.population())
+        assert np.array_equal(s.history._rows, r.history._rows)
+        assert r.gens_done == 7 and r.sid == s.sid
+
+    def test_resume_reads_meta_objective_and_config(self, tmp_path):
+        path = str(tmp_path / "named.ckpt.npz")
+        s = EvolutionSession(
+            "sphere", 32, 8, seed=2,
+            config=PGAConfig(use_pallas=False, elitism=2,
+                             selection="truncation"),
+        )
+        s.step(2)
+        s.suspend(path)
+        r = EvolutionSession.resume(path)  # objective + config from meta
+        assert r.pga.config.elitism == 2
+        assert r.pga.config.selection == "truncation"
+        s.step(2)
+        r.step(2)
+        assert _same_pop(s.population(), r.population())
+
+    def test_pending_tells_roundtrip(self, tmp_path):
+        path = str(tmp_path / "tells.ckpt.npz")
+        s = EvolutionSession("onemax", 32, 8, seed=3, config=CFG)
+        s.tell(np.full((2, 8), 0.25, np.float32), np.array([7.0, 8.0]))
+        s.suspend(path)
+        r = EvolutionSession.resume(path, objective="onemax", config=CFG)
+        assert r.pending_tells == 2
+        s.step(3)
+        r.step(3)
+        assert _same_pop(s.population(), r.population())
+
+    def test_uncommitted_resume_raises(self, tmp_path):
+        path = str(tmp_path / "never.ckpt.npz")
+        with pytest.raises(FileNotFoundError, match="never committed"):
+            EvolutionSession.resume(path, objective="onemax")
+
+    @pytest.mark.skipif(
+        jax.device_count() < 2, reason="needs a multi-device platform"
+    )
+    def test_composes_with_pop_shards(self, tmp_path):
+        # zero special cases: the sharded engine checkpoints through the
+        # same save/restore, the session layer does nothing extra.
+        cfg = PGAConfig(use_pallas=False, pop_shards=2)
+        path = str(tmp_path / "sharded.ckpt.npz")
+        s = EvolutionSession("onemax", 64, 8, seed=4, config=cfg)
+        s.step(2)
+        s.suspend(path)
+        r = EvolutionSession.resume(path, objective="onemax", config=cfg)
+        s.step(2)
+        r.step(2)
+        a, b = s.population(), r.population()
+        assert np.array_equal(np.asarray(a.genomes), np.asarray(b.genomes))
+
+    def test_composes_with_gp_genomes(self, tmp_path):
+        from libpga_tpu.gp import encoding as enc
+        from libpga_tpu.gp import operators as gpo
+        from libpga_tpu.gp.sr import make_dataset, symbolic_regression
+
+        gp = GPConfig(max_nodes=8, n_vars=2)
+        X, y = make_dataset(lambda a, b: a * a + b, n_samples=16, n_vars=2)
+        obj = symbolic_regression(X, y, gp=gp)
+        genomes = enc.random_population(jax.random.key(0), 64, gp)
+
+        def build():
+            return EvolutionSession(
+                obj,
+                genomes=genomes,
+                config=PGAConfig(use_pallas=False, elitism=2),
+                crossover=gpo.make_subtree_crossover(gp),
+                mutate=gpo.make_gp_mutate(gp, 0.4, 0.6),
+            )
+
+        path = str(tmp_path / "gp.ckpt.npz")
+        s = build()
+        s.step(2)
+        s.suspend(path)
+        # GP operators are opaque callables: re-provide at resume.
+        r = EvolutionSession.resume(
+            path, objective=obj,
+            config=PGAConfig(use_pallas=False, elitism=2),
+            crossover=gpo.make_subtree_crossover(gp),
+            mutate=gpo.make_gp_mutate(gp, 0.4, 0.6),
+        )
+        s.step(2)
+        r.step(2)
+        assert _same_pop(s.population(), r.population())
+
+
+# ---------------------------------------------------------------- warm pool
+
+
+class TestEnginePool:
+    def test_hit_reuses_engine_and_compiles_nothing(self):
+        pool = EnginePool(config=CFG, counters=Counters())
+        w1 = pool.acquire("onemax", 64, 8, seed=3)
+        w1.step(2)
+        eng = w1.pga
+        programs = len(eng._compiled)
+        pool.release(w1)
+        w2 = pool.acquire("onemax", 64, 8, seed=12)
+        assert w2.pga is eng  # the warm engine itself came back
+        w2.step(2)
+        assert len(eng._compiled) == programs  # 0 new programs
+        assert pool.stats()["hits"] == 1
+
+    def test_pooled_session_bit_identical_to_cold(self):
+        pool = EnginePool(config=CFG, counters=Counters())
+        w1 = pool.acquire("onemax", 64, 8, seed=3)
+        w1.step(2)
+        pool.release(w1)
+        w2 = pool.acquire("onemax", 64, 8, seed=3)
+        w2.step(2)
+        cold = EvolutionSession("onemax", 64, 8, seed=3, config=CFG)
+        cold.step(2)
+        assert _same_pop(w2.population(), cold.population())
+
+    def test_prewarm_counts_and_signature_separation(self):
+        pool = EnginePool(config=CFG, counters=Counters())
+        pool.prewarm("onemax", 32, 8)
+        assert pool.stats()["prewarms"] == 1
+        w = pool.acquire("onemax", 32, 8, seed=0)
+        assert pool.stats()["hits"] == 1  # the prewarmed engine
+        # a different shape is a different signature: miss
+        w2 = pool.acquire("onemax", 64, 8, seed=0)
+        assert pool.stats()["misses"] == 1
+        pool.release(w)
+        pool.release(w2)
+        assert pool.stats()["idle"] == 2
+
+    def test_release_foreign_session_rejected(self):
+        pool = EnginePool(config=CFG, counters=Counters())
+        s = EvolutionSession("onemax", 32, 8, seed=0, config=CFG)
+        with pytest.raises(ValueError, match="not acquired"):
+            pool.release(s)
+
+    def test_capacity_bounds_idle_engines(self):
+        pool = EnginePool(
+            config=CFG, counters=Counters(),
+            streaming=StreamingConfig(pool_capacity=1, prewarm=False),
+        )
+        a = pool.acquire("onemax", 32, 8, seed=0)
+        b = pool.acquire("onemax", 32, 8, seed=1)
+        pool.release(a)
+        pool.release(b)  # beyond capacity: dropped
+        assert pool.stats()["idle"] == 1
+
+
+# -------------------------------------------------------------- group + PBT
+
+
+class TestSessionGroup:
+    def _sessions(self, n, base_seed, config=CFG):
+        return [
+            EvolutionSession("onemax", 64, 8, seed=base_seed + i,
+                             config=config)
+            for i in range(n)
+        ]
+
+    def test_group_step_bit_identical_to_solo(self):
+        grouped = self._sessions(4, 10)
+        solo = self._sessions(4, 10)
+        SessionGroup(grouped).step(3)
+        for s in solo:
+            s.step(3)
+        for a, b in zip(grouped, solo):
+            assert _same_pop(a.population(), b.population())
+            assert a.gens_done == b.gens_done == 3
+
+    def test_group_step_with_history(self):
+        grouped = self._sessions(2, 20, config=TCFG)
+        solo = self._sessions(2, 20, config=TCFG)
+        SessionGroup(grouped).step(4)
+        for s in solo:
+            s.step(4)
+        for a, b in zip(grouped, solo):
+            assert np.array_equal(a.history._rows, b.history._rows)
+
+    def test_group_folds_tells_like_solo(self):
+        grouped = self._sessions(2, 30)
+        solo = self._sessions(2, 30)
+        told = np.full((2, 8), 0.8, np.float32)
+        fits = np.array([60.0, 70.0], np.float32)
+        grouped[1].tell(told, fits)
+        solo[1].tell(told, fits)
+        SessionGroup(grouped, tell_slots=2).step(3)
+        for s in solo:
+            s.step(3)
+        for a, b in zip(grouped, solo):
+            assert _same_pop(a.population(), b.population())
+
+    def test_mixed_signature_rejected(self):
+        a = EvolutionSession("onemax", 64, 8, seed=0, config=CFG)
+        b = EvolutionSession("onemax", 32, 8, seed=0, config=CFG)
+        with pytest.raises(ValueError, match="signature"):
+            SessionGroup([a, b])
+
+    def test_pbt_off_is_inert(self):
+        grouped = self._sessions(4, 40)
+        g = SessionGroup(grouped)  # pbt defaults off
+        before = [g.mutation_params(i) for i in range(4)]
+        g.step(6)
+        assert [g.mutation_params(i) for i in range(4)] == before
+
+    def test_pbt_adapts_deterministically(self):
+        def run():
+            sessions = self._sessions(4, 50)
+            g = SessionGroup(
+                sessions,
+                streaming=StreamingConfig(
+                    pbt=PBTConfig(epoch_gens=2, exploit_frac=0.25)
+                ),
+            )
+            g.step(6)
+            return (
+                [g.mutation_params(i) for i in range(4)],
+                [np.asarray(s.population().genomes) for s in sessions],
+            )
+
+        p1, g1 = run()
+        p2, g2 = run()
+        assert p1 == p2
+        for a, b in zip(g1, g2):
+            assert np.array_equal(a, b)
+        # something actually moved
+        assert len(set(r for r, _ in p1)) > 1
+
+
+# -------------------------------------------------------------------- store
+
+
+class TestSessionStore:
+    def test_roundtrip_list_discard(self, tmp_path):
+        store = SessionStore(str(tmp_path / "sessions"))
+        s = EvolutionSession("onemax", 32, 8, seed=0, config=CFG)
+        s.step(2)
+        store.suspend(s)
+        assert store.list() == [s.sid]
+        assert store.meta(s.sid)["gens_done"] == 2
+        r = store.resume(s.sid, objective="onemax", config=CFG)
+        s.step(2)
+        r.step(2)
+        assert _same_pop(s.population(), r.population())
+        store.discard(s.sid)
+        assert store.list() == []
+
+    def test_fleet_spool_hosts_sessions(self, tmp_path):
+        from libpga_tpu.serving.fleet import Spool
+
+        spool = Spool(str(tmp_path / "spool"))
+        assert os.path.isdir(spool.path("sessions"))
+
+    def test_invalid_sid_rejected(self, tmp_path):
+        store = SessionStore(str(tmp_path / "s"))
+        with pytest.raises(ValueError):
+            store.path("../escape")
+
+
+# ------------------------------------------------------- satellites (12.x)
+
+
+class TestJaxEnvKnobs:
+    def test_parent_config_knobs_propagate(self):
+        from libpga_tpu.serving.fleet import _jax_env_knobs
+
+        knobs = _jax_env_knobs()
+        # conftest flips threefry partitionability PROGRAMMATICALLY —
+        # exactly the knob class that silently diverges worker RNG.
+        assert knobs["JAX_THREEFRY_PARTITIONABLE"] == "1"
+        assert knobs["JAX_ENABLE_X64"] == "0"
+        assert knobs.get("JAX_PLATFORMS") == "cpu"
+
+
+class TestSizedSnapshots:
+    def test_retry_once_contract(self):
+        from libpga_tpu import capi_bridge as B
+        from libpga_tpu.utils import metrics as M
+
+        need = len(B.metrics_snapshot_json(0))  # size query: parks
+        # grow the snapshot between query and fill — the race the
+        # contract covers.
+        M.REGISTRY.counter(
+            "test.retry_once.growth", label="x" * 64
+        ).bump()
+        filled = B.metrics_snapshot_json(need + 1)
+        assert len(filled) == need  # parked rendering, not the grown one
+        # next call re-renders fresh (the park was consumed)
+        assert len(B.metrics_snapshot_json(10 ** 9)) >= need
+
+    def test_truncated_fill_reparks(self):
+        from libpga_tpu import capi_bridge as B
+
+        tiny = B.metrics_snapshot_json(8)  # too small: parks
+        again = B.metrics_snapshot_json(len(tiny) + 1)
+        assert again == tiny
+
+    def test_session_snapshot_lists_sessions(self):
+        from libpga_tpu import capi_bridge as B
+
+        h = B.session_open("onemax", 32, 8, 5)
+        try:
+            B.session_step(h, 2, 0, 0.0)
+            snap = json.loads(B.session_snapshot_json(0).decode())
+            mine = [s for s in snap["sessions"] if s["handle"] == h]
+            assert mine and mine[0]["gens_done"] == 2
+            assert "pool" in snap
+        finally:
+            B.session_close(h)
+
+    def test_bridge_session_roundtrip(self, tmp_path):
+        from libpga_tpu import capi_bridge as B
+
+        h = B.session_open("onemax", 32, 8, 7)
+        cand = np.frombuffer(
+            B.session_ask(h, 4), np.float32
+        ).reshape(4, 8)
+        B.session_tell(
+            h, cand.tobytes(), cand.sum(axis=1).tobytes(), 4
+        )
+        assert B.session_step(h, 3, 0, 0.0) == 3
+        best = np.frombuffer(B.session_best(h), np.float32)
+        assert best.shape == (9,) and 0.0 <= best[0] <= 8.0
+        path = str(tmp_path / "abi.ckpt.npz")
+        assert B.session_suspend(h, path) == 0
+        h2 = B.session_resume(path, "")
+        assert B.session_step(h, 2, 0, 0.0) == 2
+        assert B.session_step(h2, 2, 0, 0.0) == 2
+        b1 = np.frombuffer(B.session_best(h), np.float32)
+        b2 = np.frombuffer(B.session_best(h2), np.float32)
+        assert np.array_equal(b1, b2)
+        assert B.session_close(h) == 0
+        assert B.session_close(h2) == 0
+
+
+class TestStreamingConfig:
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            StreamingConfig(pool_capacity=0)
+        with pytest.raises(ValueError):
+            StreamingConfig(max_tell_slots=0)
+        with pytest.raises(ValueError):
+            PBTConfig(epoch_gens=0)
+        with pytest.raises(ValueError):
+            PBTConfig(exploit_frac=0.9)
+        with pytest.raises(ValueError):
+            PBTConfig(explore_factor=1.0)
+        with pytest.raises(ValueError):
+            PBTConfig(rate_bounds=(0.5, 0.1))

@@ -72,6 +72,20 @@
 #                                 provenance + tuned_config event),
 #                                 and db=None leaves the traced run
 #                                 program byte-identical.
+#  13. streaming smoke           — tools/streaming_smoke.py (ISSUE 12):
+#                                 a step()-only EvolutionSession is
+#                                 bit-identical to same-seed PGA.run
+#                                 (population + telemetry history),
+#                                 suspend/resume at a generation
+#                                 boundary is bit-identical, the warm
+#                                 engine pool's hit path compiles 0
+#                                 programs (with a measured cold/warm
+#                                 first-ask A/B), an ask/tell-only
+#                                 external-fitness loop recovers a
+#                                 hidden target, and the
+#                                 session_open/session_fold/
+#                                 session_suspend/session_resume event
+#                                 kinds are schema-valid.
 #  12. gp smoke                  — tools/gp_smoke.py (ISSUE 11):
 #                                 random-grown postfix programs are
 #                                 strictly well-formed and the GP
@@ -423,5 +437,8 @@ JAX_PLATFORMS=cpu python tools/autotune_smoke.py
 
 echo "== ci: gp smoke =="
 JAX_PLATFORMS=cpu python tools/gp_smoke.py
+
+echo "== ci: streaming smoke =="
+JAX_PLATFORMS=cpu python tools/streaming_smoke.py
 
 echo "== ci: all stages passed =="

@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""CI smoke for the streaming evolution service (ISSUE 12) — ci.sh
+stage 13.
+
+Five gates, all CPU-runnable:
+
+1. **step-only byte-identity** — an EvolutionSession that is only ever
+   step()ped produces the bit-identical final population AND telemetry
+   history to a same-seed PGA.run;
+2. **suspend/resume bit-identity** — suspend at a generation boundary,
+   resume into a fresh engine (the simulated different process), and
+   the continued trajectory is bit-identical to the uninterrupted one;
+3. **warm pool: 0 compiles** — after a session of a signature has run,
+   a second tenant acquired from the pool executes its first ask and
+   step WITHOUT building a single new program (asserted via the
+   engine's compiled-program table and the pool counters), and the
+   measured warm first-ask latency beats the cold one;
+4. **ask/tell external-fitness loop** — a session driven ONLY by
+   external evaluations (tell) recovers a hidden target;
+5. **event schema** — session_open / session_fold / session_suspend
+   (and session_resume) records validate against EVENT_FIELDS.
+"""
+
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from libpga_tpu import PGA, PGAConfig, TelemetryConfig  # noqa: E402
+from libpga_tpu.streaming import (  # noqa: E402
+    EnginePool,
+    EvolutionSession,
+    SessionStore,
+)
+from libpga_tpu.utils import telemetry as T  # noqa: E402
+from libpga_tpu.utils.metrics import Counters  # noqa: E402
+
+
+def fail(msg: str) -> None:
+    print(f"FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> None:
+    tmp = tempfile.mkdtemp(prefix="pga-streaming-smoke-")
+    events_path = os.path.join(tmp, "events.jsonl")
+    cfg = PGAConfig(
+        use_pallas=False,
+        telemetry=TelemetryConfig(history_gens=64, events_path=events_path),
+    )
+
+    # -------------------------------------------- 1. step-only identity
+    session = EvolutionSession("onemax", 512, 32, seed=7, config=cfg)
+    session.step(10)
+    ref = PGA(seed=7, config=cfg)
+    href = ref.create_population(512, 32)
+    ref.set_objective("onemax")
+    ref.run(10)
+    a, b = session.population(), ref.population(href)
+    if not (
+        np.array_equal(np.asarray(a.genomes), np.asarray(b.genomes))
+        and np.array_equal(np.asarray(a.scores), np.asarray(b.scores))
+    ):
+        fail("step()-only session diverged from same-seed PGA.run")
+    if not np.array_equal(session.history._rows, ref.history(href)._rows):
+        fail("step()-only session telemetry history diverged")
+    print("streaming smoke: step-only byte-identity OK (512x32, 10 gens)")
+
+    # ------------------------------------------ 2. suspend/resume
+    store = SessionStore(os.path.join(tmp, "sessions"))
+    store.suspend(session)
+    resumed = store.resume(session.sid, objective="onemax", config=cfg)
+    session.step(5)
+    resumed.step(5)
+    a, b = session.population(), resumed.population()
+    if not np.array_equal(np.asarray(a.genomes), np.asarray(b.genomes)):
+        fail("suspend->resume trajectory diverged")
+    if not np.array_equal(session.history._rows, resumed.history._rows):
+        fail("suspend->resume telemetry history diverged")
+    print(
+        "streaming smoke: suspend/resume bit-identity OK "
+        f"(resumed @gen {resumed.gens_done - 5}, stepped 5 more)"
+    )
+
+    # ------------------------------------------ 3. warm pool, 0 compiles
+    pool = EnginePool(config=cfg, counters=Counters())
+    t0 = time.perf_counter()
+    cold = pool.acquire("sphere", 256, 24, seed=1)
+    cold.ask(8)
+    cold.step(1)
+    cold_ms = (time.perf_counter() - t0) * 1e3
+    eng = cold.pga
+    programs_before = len(eng._compiled)
+    pool.release(cold)
+    t0 = time.perf_counter()
+    warm = pool.acquire("sphere", 256, 24, seed=2)
+    warm.ask(8)
+    warm.step(1)
+    warm_ms = (time.perf_counter() - t0) * 1e3
+    if warm.pga is not eng:
+        fail("pool did not reuse the warm engine")
+    if len(eng._compiled) != programs_before:
+        fail(
+            f"warm acquire built {len(eng._compiled) - programs_before} "
+            "new programs (expected 0)"
+        )
+    stats = pool.stats()
+    if stats.get("hits") != 1 or stats.get("misses") != 1:
+        fail(f"unexpected pool counters: {stats}")
+    if warm_ms >= cold_ms:
+        fail(
+            f"warm first-ask {warm_ms:.1f} ms not faster than cold "
+            f"{cold_ms:.1f} ms"
+        )
+    print(
+        "streaming smoke: warm pool OK — 0 compiles on the hit path, "
+        f"first ask+step cold {cold_ms:.1f} ms vs warm {warm_ms:.1f} ms "
+        f"({cold_ms / warm_ms:.1f}x)"
+    )
+
+    # --------------------------- 4. external-fitness (ask/tell only) loop
+    rng = np.random.default_rng(0)
+    target = rng.uniform(0.2, 0.8, size=12).astype(np.float32)
+    ext = EvolutionSession("sphere", 128, 12, seed=3, config=cfg)
+
+    def external_fitness(genomes: np.ndarray) -> np.ndarray:
+        return -np.sum((genomes - target) ** 2, axis=1)
+
+    first = ext.ask(16)
+    ext.tell(first, external_fitness(first))
+    start_best = float(external_fitness(first).max())
+    best = start_best
+    for _ in range(80):
+        cand = ext.ask(16)
+        fit = external_fitness(cand)
+        ext.tell(cand, fit)
+        best = max(best, float(fit.max()))
+    if not (best > start_best and best > -0.15):
+        fail(
+            f"external-fitness loop did not recover the target "
+            f"(start {start_best:.4f}, best {best:.4f})"
+        )
+    print(
+        "streaming smoke: ask/tell external-fitness loop OK "
+        f"(best distance^2 {-best:.4f} from {-start_best:.4f})"
+    )
+
+    # ----------------------------------------------- 5. event schema
+    for s in (session, resumed, ext, warm):
+        log = s.pga._events
+        if log is not None:
+            log.close()
+    records = T.validate_log(events_path)
+    kinds = {r["event"] for r in records}
+    need = {"session_open", "session_fold", "session_suspend",
+            "session_resume"}
+    missing = need - kinds
+    if missing:
+        fail(f"event log missing kinds: {sorted(missing)}")
+    print(
+        f"streaming smoke: {len(records)} schema-valid events, kinds "
+        f"include {sorted(need)}"
+    )
+    print("streaming smoke: all gates passed")
+
+
+if __name__ == "__main__":
+    main()
