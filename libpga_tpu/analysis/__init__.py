@@ -1,0 +1,72 @@
+"""Repo-specific static analysis: the invariant guard (ISSUE 13).
+
+Twelve rounds of this codebase rest on conventions nothing enforced
+mechanically: the atomic-rename spool discipline, event kinds that must
+exist in ``telemetry.EVENT_FIELDS`` (the recurring bug class of rounds
+9/12/13/14), StableHLO byte-identity gates copy-pasted across test
+files, the hand-rolled "exactly 1 ppermute + 1 all_gather" jaxpr scan,
+and a 3-way C ABI kept in sync by eyeball. This package turns those
+implicit contracts into a checked analysis layer — the prerequisite for
+the ROADMAP GPU port (every new backend must re-prove the same IR
+contracts) and for letting fleet work touch the spool safely.
+
+Three analyzers behind one runner (``tools/lint_pga.py``, CI stage 14):
+
+- :mod:`~libpga_tpu.analysis.lint` — an AST visitor framework with
+  repo-specific rules (``spool-atomic-write``, ``event-kind-registered``,
+  ``no-wallclock-in-traced``, ``lock-guarded-registry``), each
+  suppressible via a scoped ``# pga-lint: disable=<rule>`` comment with
+  an unused-suppression check;
+- :mod:`~libpga_tpu.analysis.ir_audit` — programmatic jaxpr/StableHLO
+  contracts: :func:`fingerprint` (the canonical digest powering every
+  byte-identity gate), :func:`collective_budget` (the sharded runs'
+  1-ppermute + 1-all_gather cost model), :func:`donation_check`
+  (``input_output_aliases`` actually present on donated paths) and
+  :func:`callback_free` (no host callbacks in hot loops);
+- :mod:`~libpga_tpu.analysis.abi_check` — the 3-way C ABI cross-check
+  (``capi/pga_tpu.h`` prototypes ↔ ``capi/pga_tpu.cc`` marshal calls ↔
+  ``capi_bridge.py`` defs ↔ the symbols ``capi/test_serving.c``
+  exercises), including the retry-once sized-snapshot shape.
+
+Import cost: ``lint`` and ``abi_check`` are pure-stdlib and
+``ir_audit`` imports jax lazily, so the ANALYZERS cost nothing — but
+importing them through this package pulls ``libpga_tpu/__init__``
+(and therefore jax). ``tools/lint_pga.py`` loads the lint/ABI modules
+standalone from their file paths for its jax-free fast path; test code
+(which has jax anyway) imports from here.
+"""
+
+from libpga_tpu.analysis.lint import (  # noqa: F401
+    Finding,
+    RULES,
+    lint_file,
+    lint_paths,
+    default_paths,
+)
+from libpga_tpu.analysis.ir_audit import (  # noqa: F401
+    IRContractError,
+    callback_free,
+    canonical_text,
+    collective_budget,
+    count_primitives,
+    donation_check,
+    fingerprint,
+)
+from libpga_tpu.analysis.abi_check import check_abi, check_repo_abi  # noqa: F401
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "lint_file",
+    "lint_paths",
+    "default_paths",
+    "IRContractError",
+    "fingerprint",
+    "canonical_text",
+    "collective_budget",
+    "count_primitives",
+    "donation_check",
+    "callback_free",
+    "check_abi",
+    "check_repo_abi",
+]

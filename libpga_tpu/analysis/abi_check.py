@@ -1,0 +1,368 @@
+"""C-ABI cross-checker: pga_tpu.h ↔ pga_tpu.cc ↔ capi_bridge.py (ISSUE 13).
+
+The improved C ABI is a 3-layer sandwich kept in sync — until now — by
+eyeball: ``capi/pga_tpu.h`` declares the ``extern "C"`` surface,
+``capi/pga_tpu.cc`` forwards each entry point to a named
+``libpga_tpu.capi_bridge`` function through a ``Py_BuildValue`` format
+string, and the bridge function's Python signature must accept exactly
+what that format string marshals. A drift in any pairing (renamed
+bridge function, added parameter, edited format string) compiles
+cleanly and fails only at RUNTIME inside an embedded interpreter —
+the worst possible place. This module pins all of it statically:
+
+- every header prototype has a definition in the .cc (and vice versa);
+- every bridge call inside a definition targets a real
+  ``capi_bridge`` function, with a format-string arity the Python
+  signature accepts (``y#`` pairs marshal ONE Python bytes argument);
+- header functions whose definitions forward nothing are flagged (a
+  stub that silently returns is drift, not an implementation);
+- every ``pga_*`` symbol a C driver (``capi/test_serving.c``, ...)
+  exercises must be declared in the header;
+- the sized-snapshot entry points (``pga_*_snapshot``) keep the
+  documented retry-once shape: ``long`` return, trailing
+  ``(char *buf, unsigned long cap)``.
+
+Pure stdlib (regex + ast over source text): runs without compiling C
+or importing jax, so it belongs in the lint fast path whenever the ABI
+files change.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from libpga_tpu.analysis.lint import Finding
+
+__all__ = [
+    "HeaderFn",
+    "BridgeCall",
+    "BridgeFn",
+    "parse_header",
+    "parse_cc",
+    "parse_bridge",
+    "parse_driver_symbols",
+    "format_arg_count",
+    "check_abi",
+    "check_repo_abi",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class HeaderFn:
+    name: str
+    ret: str
+    args: Tuple[str, ...]
+    line: int
+
+
+@dataclasses.dataclass(frozen=True)
+class BridgeCall:
+    bridge_name: str
+    fmt: str
+    line: int
+
+
+@dataclasses.dataclass(frozen=True)
+class CcFn:
+    name: str
+    line: int
+    calls: Tuple[BridgeCall, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class BridgeFn:
+    name: str
+    line: int
+    min_args: int
+    max_args: int
+    has_varargs: bool
+
+
+def _strip_c_comments(text: str) -> str:
+    """Remove /* */ and // comments, preserving line numbers (each
+    stripped character becomes a space or keeps its newline)."""
+    out = []
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c == "/" and i + 1 < n and text[i + 1] == "*":
+            j = text.find("*/", i + 2)
+            j = n if j < 0 else j + 2
+            out.append("".join(ch if ch == "\n" else " " for ch in text[i:j]))
+            i = j
+        elif c == "/" and i + 1 < n and text[i + 1] == "/":
+            j = text.find("\n", i)
+            j = n if j < 0 else j
+            out.append(" " * (j - i))
+            i = j
+        elif c == '"':
+            j = i + 1
+            while j < n and text[j] != '"':
+                j += 2 if text[j] == "\\" else 1
+            j = min(j + 1, n)
+            out.append(text[i:j])
+            i = j
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _split_args(argtext: str) -> Tuple[str, ...]:
+    argtext = " ".join(argtext.split())
+    if not argtext or argtext == "void":
+        return ()
+    parts, depth, cur = [], 0, []
+    for ch in argtext:
+        if ch in "([":
+            depth += 1
+        elif ch in ")]":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur).strip())
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur).strip())
+    return tuple(p for p in parts if p)
+
+
+_PROTO_RE = re.compile(
+    r"(?P<ret>[A-Za-z_][\w \t\*]*?)\s*\**\s*\b(?P<name>pga_\w+)\s*"
+    r"\((?P<args>[^;{}]*)\)\s*(?P<tail>[;{])",
+    re.S,
+)
+
+
+def _line_of(text: str, pos: int) -> int:
+    return text.count("\n", 0, pos) + 1
+
+
+def parse_header(path: str) -> Dict[str, HeaderFn]:
+    """``extern "C"`` prototypes (``...;``) of every pga_* function."""
+    with open(path, "r", encoding="utf-8") as fh:
+        text = _strip_c_comments(fh.read())
+    out: Dict[str, HeaderFn] = {}
+    for m in _PROTO_RE.finditer(text):
+        if m.group("tail") != ";":
+            continue
+        name = m.group("name")
+        ret = " ".join(m.group("ret").split())
+        # the regex's ret group stops before '*'s; recover pointerness
+        between = text[m.start():m.start("name")]
+        if "*" in between:
+            ret += " *"
+        out[name] = HeaderFn(
+            name=name,
+            ret=ret,
+            args=_split_args(m.group("args")),
+            line=_line_of(text, m.start("name")),
+        )
+    return out
+
+
+_CALL_RE = re.compile(
+    r"\bcall(?:_long)?\s*\(\s*\"(?P<bridge>\w+)\"\s*,\s*"
+    r"\"(?P<fmt>\([^\"]*\))\"",
+    re.S,
+)
+
+
+def _body_span(text: str, brace_pos: int) -> int:
+    """End index of the balanced {...} body starting at brace_pos."""
+    depth = 0
+    for i in range(brace_pos, len(text)):
+        if text[i] == "{":
+            depth += 1
+        elif text[i] == "}":
+            depth -= 1
+            if depth == 0:
+                return i + 1
+    return len(text)
+
+
+def parse_cc(path: str) -> Dict[str, CcFn]:
+    """pga_* function DEFINITIONS in the .cc shim with the bridge calls
+    each body makes (bridge function name + marshal format string)."""
+    with open(path, "r", encoding="utf-8") as fh:
+        text = _strip_c_comments(fh.read())
+    out: Dict[str, CcFn] = {}
+    for m in _PROTO_RE.finditer(text):
+        if m.group("tail") != "{":
+            continue
+        name = m.group("name")
+        start = m.end() - 1
+        end = _body_span(text, start)
+        body = text[start:end]
+        calls = tuple(
+            BridgeCall(
+                bridge_name=c.group("bridge"),
+                fmt=c.group("fmt"),
+                line=_line_of(text, start + c.start()),
+            )
+            for c in _CALL_RE.finditer(body)
+        )
+        out[name] = CcFn(
+            name=name, line=_line_of(text, m.start("name")), calls=calls
+        )
+    return out
+
+
+def parse_bridge(path: str) -> Dict[str, BridgeFn]:
+    """Module-level function signatures of the Python bridge."""
+    with open(path, "r", encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    out: Dict[str, BridgeFn] = {}
+    for node in tree.body:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        a = node.args
+        npos = len(a.posonlyargs) + len(a.args)
+        ndefaults = len(a.defaults)
+        out[node.name] = BridgeFn(
+            name=node.name,
+            line=node.lineno,
+            min_args=npos - ndefaults,
+            max_args=npos,
+            has_varargs=a.vararg is not None,
+        )
+    return out
+
+
+_SYMBOL_RE = re.compile(r"\b(pga_\w+)\s*\(")
+
+
+def parse_driver_symbols(path: str) -> Dict[str, int]:
+    """pga_* symbols a C driver calls (first-use line each)."""
+    with open(path, "r", encoding="utf-8") as fh:
+        text = _strip_c_comments(fh.read())
+    out: Dict[str, int] = {}
+    for m in _SYMBOL_RE.finditer(text):
+        out.setdefault(m.group(1), _line_of(text, m.start()))
+    return out
+
+
+def format_arg_count(fmt: str) -> int:
+    """Python-argument count a ``Py_BuildValue`` format marshals.
+    ``y#``/``s#`` pairs (pointer + length) marshal ONE Python bytes/str
+    argument."""
+    count = 0
+    for ch in fmt:
+        if ch in "()# ":
+            continue
+        if ch in "lLiIfdsykKbBhHnz":
+            count += 1
+        else:
+            raise ValueError(f"unknown marshal unit {ch!r} in {fmt!r}")
+    return count
+
+
+_SNAPSHOT_RE = re.compile(r"_snapshot$")
+
+
+def check_abi(
+    header_path: str,
+    cc_path: str,
+    bridge_path: str,
+    driver_paths: Tuple[str, ...] = (),
+) -> List[Finding]:
+    """Cross-check the three ABI layers (+ driver symbol coverage).
+    Returns lint-style findings (empty = in sync)."""
+    findings: List[Finding] = []
+    header = parse_header(header_path)
+    cc = parse_cc(cc_path)
+    bridge = parse_bridge(bridge_path)
+
+    def f(path, line, msg):
+        findings.append(Finding(path, line, "abi-drift", msg))
+
+    # Header ↔ .cc definition set equality.
+    for name, proto in sorted(header.items()):
+        if name not in cc:
+            f(header_path, proto.line,
+              f"{name} is declared in the header but has no definition "
+              f"in {os.path.basename(cc_path)}")
+    for name, impl in sorted(cc.items()):
+        if name not in header:
+            f(cc_path, impl.line,
+              f"{name} is defined in the shim but has no prototype in "
+              f"{os.path.basename(header_path)} — C callers cannot "
+              "reach it")
+
+    # Every definition forwards to the bridge; every bridge call
+    # resolves, with a marshal arity the Python signature accepts.
+    for name, impl in sorted(cc.items()):
+        if name in header and not impl.calls:
+            f(cc_path, impl.line,
+              f"{name} forwards nothing to capi_bridge — a silent stub "
+              "is ABI drift, not an implementation")
+        for call in impl.calls:
+            target = bridge.get(call.bridge_name)
+            if target is None:
+                f(cc_path, call.line,
+                  f"{name} calls bridge function "
+                  f"{call.bridge_name!r} which does not exist in "
+                  f"{os.path.basename(bridge_path)}")
+                continue
+            try:
+                n = format_arg_count(call.fmt)
+            except ValueError as e:
+                f(cc_path, call.line, f"{name}: {e}")
+                continue
+            if target.has_varargs:
+                ok = n >= target.min_args
+            else:
+                ok = target.min_args <= n <= target.max_args
+            if not ok:
+                want = (
+                    f">= {target.min_args}" if target.has_varargs
+                    else f"{target.min_args}"
+                    if target.min_args == target.max_args
+                    else f"{target.min_args}..{target.max_args}"
+                )
+                f(cc_path, call.line,
+                  f"{name} marshals {n} argument(s) via {call.fmt!r} "
+                  f"to {call.bridge_name}() which takes {want} "
+                  f"(capi_bridge.py:{target.line}) — signature drift")
+
+    # Retry-once sized-snapshot shape.
+    for name, proto in sorted(header.items()):
+        if not _SNAPSHOT_RE.search(name):
+            continue
+        shape_ok = (
+            proto.ret.strip() == "long"
+            and len(proto.args) >= 2
+            and "char" in proto.args[-2]
+            and "unsigned long" in proto.args[-1]
+        )
+        if not shape_ok:
+            f(header_path, proto.line,
+              f"{name} must keep the documented retry-once snapshot "
+              f"shape: `long {name}(..., char *buf, unsigned long "
+              f"cap)` — found `{proto.ret} {name}"
+              f"({', '.join(proto.args)})`")
+
+    # Driver coverage: symbols a C test exercises must be declared.
+    for dpath in driver_paths:
+        for sym, line in sorted(parse_driver_symbols(dpath).items()):
+            if sym not in header:
+                f(dpath, line,
+                  f"driver calls {sym} which "
+                  f"{os.path.basename(header_path)} does not declare")
+    findings.sort(key=lambda x: (x.path, x.line))
+    return findings
+
+
+def check_repo_abi(repo_root: str) -> List[Finding]:
+    """The repo's own ABI file set (the ``lint_pga.py --abi`` body)."""
+    capi = os.path.join(repo_root, "capi")
+    return check_abi(
+        os.path.join(capi, "pga_tpu.h"),
+        os.path.join(capi, "pga_tpu.cc"),
+        os.path.join(repo_root, "libpga_tpu", "capi_bridge.py"),
+        driver_paths=(os.path.join(capi, "test_serving.c"),),
+    )

@@ -1,0 +1,394 @@
+"""IR contract auditor: programmatic jaxpr/StableHLO invariants (ISSUE 13).
+
+Single-sources the structural gates the test suite used to re-derive by
+hand in six places:
+
+- :func:`fingerprint` — the canonical StableHLO digest behind every
+  byte-identity gate (telemetry-off, fallback, db=None, GP-import
+  inertness, pbt-off, pop_shards=1). The lowering text is canonicalized
+  (the ``module @jit_<name>`` line is the ONLY thing JAX derives from
+  the traced function's *name*), so two structurally identical programs
+  fingerprint equal regardless of what their Python functions are
+  called — strictly stronger than the old copy-pasted
+  ``as_text() == as_text()`` checks, which silently required the
+  replica to shadow the engine function's name.
+- :func:`collective_budget` — the sharded-run cost model
+  ("exactly one ppermute + one all_gather per generation, nothing
+  else") asserted on the while-loop body of any lowered run function,
+  replacing ``test_shard_pop.py``'s hand-rolled jaxpr scan and
+  extensible to the islands/streaming paths and to any future backend
+  (the GPU port must re-prove exactly this contract).
+- :func:`donation_check` — ``input_output_aliases`` actually present on
+  the ping-pong/donated paths (``tf.aliasing_output`` in the lowered
+  module). Donation was an unverified assumption before this: a
+  refactor dropping ``donate_argnums`` would have doubled peak HBM
+  silently.
+- :func:`callback_free` — no host callbacks in hot loops (the
+  round-15 deadlock class: a ``pure_callback`` inside a fused while
+  loop serializes every generation on the host).
+
+All checks raise :class:`IRContractError` with the offending counts /
+a text excerpt, and return their evidence for callers that assert more.
+
+JAX is imported lazily inside functions: importing this module costs
+nothing, so the lint fast path can expose the whole analysis package.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from typing import Dict, Optional, Sequence, Tuple
+
+__all__ = [
+    "IRContractError",
+    "canonical_text",
+    "fingerprint",
+    "count_primitives",
+    "while_body_counts",
+    "collective_budget",
+    "donation_check",
+    "callback_free",
+]
+
+
+class IRContractError(AssertionError):
+    """A lowered program violates one of the repo's IR contracts."""
+
+
+#: Cross-device collective primitives: the complete vocabulary the
+#: budget accounts for. Anything here that is not explicitly budgeted
+#: must appear zero times.
+COLLECTIVE_PRIMS = (
+    "ppermute", "all_gather", "all_to_all", "psum", "pmax", "pmin",
+    "pmean", "reduce_scatter", "pgather", "axis_index",
+)
+
+#: Host-callback primitives (jaxpr names) + StableHLO custom-call
+#: targets that round-trip through Python. Any of these inside a run
+#: loop is the round-15 deadlock class.
+CALLBACK_PRIMS = ("pure_callback", "io_callback", "debug_callback")
+CALLBACK_CUSTOM_CALLS = (
+    "xla_python_cpu_callback", "xla_python_gpu_callback",
+    "xla_ffi_python_cpu_callback",
+)
+
+_MODULE_NAME_RE = re.compile(r"module @[\w.\-]+")
+
+
+def _lowered(fn, *args, donate_argnums: Optional[Tuple[int, ...]] = None):
+    """A ``Lowered`` for ``fn`` at ``args`` (concrete arrays or
+    ShapeDtypeStructs). ``fn`` may be a plain callable, a jit wrapper,
+    or anything with ``.lower``; plain callables are jitted here (with
+    ``donate_argnums`` when given)."""
+    import jax
+
+    if hasattr(fn, "lower"):
+        return fn.lower(*args)
+    kw = {}
+    if donate_argnums is not None:
+        kw["donate_argnums"] = donate_argnums
+    return jax.jit(fn, **kw).lower(*args)
+
+
+def canonical_text(
+    fn, *args, donate_argnums: Optional[Tuple[int, ...]] = None
+) -> str:
+    """The lowering's StableHLO text with the function-name-derived
+    module id normalized away. Everything else — every op, every shape,
+    every donation attribute — is preserved byte-for-byte, so equality
+    of canonical texts is exactly "the same program"."""
+    text = _lowered(fn, *args, donate_argnums=donate_argnums).as_text()
+    return _MODULE_NAME_RE.sub("module @jit__canonical", text, count=1)
+
+
+def fingerprint(
+    fn, *args, donate_argnums: Optional[Tuple[int, ...]] = None
+) -> str:
+    """Canonical StableHLO digest (sha256 hex) of ``fn`` lowered at
+    ``args`` — the one implementation behind every byte-identity gate.
+    Stable across processes at a fixed seed (asserted by
+    ``tests/test_analysis.py``); compare digests with ``==`` and diff
+    :func:`canonical_text` when a gate trips."""
+    text = canonical_text(fn, *args, donate_argnums=donate_argnums)
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()
+
+
+# ------------------------------------------------------------ jaxpr walks
+
+
+def _subjaxprs(eqn):
+    from jax.core import ClosedJaxpr, Jaxpr
+
+    for v in eqn.params.values():
+        vals = v if isinstance(v, (list, tuple)) else (v,)
+        for vv in vals:
+            if isinstance(vv, ClosedJaxpr):
+                yield vv.jaxpr
+            elif isinstance(vv, Jaxpr):
+                yield vv
+
+
+def _count(jxp, counts: Dict[str, int]) -> Dict[str, int]:
+    for eqn in jxp.eqns:
+        counts[eqn.primitive.name] = counts.get(eqn.primitive.name, 0) + 1
+        for sub in _subjaxprs(eqn):
+            _count(sub, counts)
+    return counts
+
+
+def _find_eqns(jxp, name: str, acc: list) -> list:
+    for eqn in jxp.eqns:
+        if eqn.primitive.name == name:
+            acc.append(eqn)
+        for sub in _subjaxprs(eqn):
+            _find_eqns(sub, name, acc)
+    return acc
+
+
+def _jaxpr(fn, *args):
+    import jax
+
+    # ``lambda *a: fn(*a)`` unwraps jit wrappers (make_jaxpr of a jitted
+    # fn yields one opaque pjit eqn whose body the recursive walks then
+    # open anyway — going through a plain call keeps one code path).
+    return jax.make_jaxpr(lambda *a: fn(*a))(*args)
+
+
+def count_primitives(fn, *args) -> Dict[str, int]:
+    """Recursive primitive histogram of ``fn``'s whole jaxpr (control
+    flow bodies included)."""
+    return _count(_jaxpr(fn, *args).jaxpr, {})
+
+
+def while_body_counts(fn, *args) -> Dict[str, int]:
+    """Primitive histogram of the (single) while-loop body — i.e. one
+    generation of a fused run loop. Raises when the program does not
+    contain exactly one while loop (the fused-run-loop shape every
+    engine path guarantees)."""
+    whiles = _find_eqns(_jaxpr(fn, *args).jaxpr, "while", [])
+    if len(whiles) != 1:
+        raise IRContractError(
+            f"expected exactly one while loop in the lowered run, "
+            f"found {len(whiles)} — not a fused run loop?"
+        )
+    return _count(whiles[0].params["body_jaxpr"].jaxpr, {})
+
+
+def collective_budget(
+    fn,
+    *args,
+    ppermute: int = 1,
+    all_gather: int = 1,
+    others: int = 0,
+    where: str = "while_body",
+) -> Dict[str, int]:
+    """Assert the per-generation cross-shard collective budget on a
+    lowered run function: exactly ``ppermute`` ppermutes, exactly
+    ``all_gather`` all_gathers, and at most ``others`` occurrences of
+    any other collective (default: none at all) inside the fused while
+    body (``where="while_body"``, the per-generation cost) or the whole
+    program (``where="program"``). Returns the counted histogram.
+
+    This is ISSUE 7's cost model as a library function: the shard_pop
+    gate calls it with the defaults; a future islands/GPU path calls it
+    with ITS budget — one implementation, every backend."""
+    counts = (
+        while_body_counts(fn, *args)
+        if where == "while_body"
+        else count_primitives(fn, *args)
+    )
+    problems = []
+    if counts.get("ppermute", 0) != ppermute:
+        problems.append(
+            f"ppermute x{counts.get('ppermute', 0)} (budget {ppermute})"
+        )
+    if counts.get("all_gather", 0) != all_gather:
+        problems.append(
+            f"all_gather x{counts.get('all_gather', 0)} "
+            f"(budget {all_gather})"
+        )
+    for prim in COLLECTIVE_PRIMS:
+        if prim in ("ppermute", "all_gather"):
+            continue
+        if counts.get(prim, 0) > others:
+            problems.append(
+                f"{prim} x{counts[prim]} (budget {others})"
+            )
+    if problems:
+        raise IRContractError(
+            "collective budget violated in "
+            f"{where}: {'; '.join(problems)}; full counts: "
+            + str({
+                k: v for k, v in sorted(counts.items())
+                if k in COLLECTIVE_PRIMS
+            })
+        )
+    return counts
+
+
+def donation_check(
+    fn, *args,
+    min_donated: int = 1,
+    donate_argnums: Optional[Tuple[int, ...]] = None,
+) -> int:
+    """Assert the lowered module actually carries input/output aliasing
+    (``tf.aliasing_output`` on at least ``min_donated`` parameters) —
+    i.e. the ping-pong donation the breed paths assume is REAL, not
+    just requested. Returns the number of aliased parameters."""
+    text = canonical_text(fn, *args, donate_argnums=donate_argnums)
+    aliased = len(re.findall(r"tf\.aliasing_output", text))
+    if aliased < min_donated:
+        raise IRContractError(
+            f"expected >= {min_donated} donated (aliased) parameters, "
+            f"lowering carries {aliased} — donate_argnums dropped, or "
+            "donation rejected (shape/dtype mismatch between input and "
+            "output)?"
+        )
+    return aliased
+
+
+def callback_free(fn, *args, where: str = "program") -> Dict[str, int]:
+    """Assert no host-callback primitive appears in the lowered program
+    (``where="program"``) or the fused while body only
+    (``where="while_body"``). A callback inside a run loop serializes
+    every generation on the host — the round-15 deadlock class.
+    Returns the primitive histogram for further assertions."""
+    counts = (
+        while_body_counts(fn, *args)
+        if where == "while_body"
+        else count_primitives(fn, *args)
+    )
+    offending = {
+        p: counts[p] for p in CALLBACK_PRIMS if counts.get(p, 0)
+    }
+    if offending:
+        raise IRContractError(
+            f"host callback(s) inside {where}: {offending} — hot loops "
+            "must stay on-device (evaluate through a builtin/expression "
+            "objective, or hoist the callback out of the loop)"
+        )
+    return counts
+
+
+def text_callback_free(text: str) -> None:
+    """StableHLO-text variant of :func:`callback_free` for already
+    lowered programs: refuses python-callback custom-call targets."""
+    hits = [t for t in CALLBACK_CUSTOM_CALLS if t in text]
+    if hits:
+        raise IRContractError(
+            f"host-callback custom calls in lowered text: {hits}"
+        )
+
+
+# --------------------------------------------------------- repo contracts
+
+
+def audit_repo(verbose: bool = False) -> list:
+    """The CPU-lowerable IR contracts, audited on the LIVE engine — the
+    ``tools/lint_pga.py --ir`` body. Returns a list of problem strings
+    (empty = all contracts hold). Requires >= 4 visible devices for the
+    sharded leg (the runner forces a simulated multi-device CPU
+    platform before importing jax, as tests/conftest.py does)."""
+    import jax
+    import jax.numpy as jnp
+
+    from libpga_tpu import PGA, PGAConfig, TelemetryConfig
+
+    problems = []
+
+    def note(msg):
+        if verbose:
+            print(f"  ir-audit: {msg}")
+
+    def engine(**cfg):
+        pga = PGA(seed=0, config=PGAConfig(use_pallas=False, **cfg))
+        pga.create_population(64, 16)
+        pga.set_objective("onemax")
+        pop = pga._populations[0]
+        args = (
+            pop.genomes, jax.random.key(0), jnp.int32(3),
+            jnp.float32(jnp.inf), pga._mutate_params(),
+        )
+        return pga._compiled_run(64, 16), args
+
+    # 1. Host-config purity: the fallback policy (host-side robustness)
+    #    must not reach the traced program.
+    fn_default, args = engine()
+    fn_raise, _ = engine(fallback="raise")
+    fp_default = fingerprint(fn_default, *args)
+    if fp_default != fingerprint(fn_raise, *args):
+        problems.append(
+            "fallback='raise' changed the lowered run program — the "
+            "robustness layer leaked into the trace"
+        )
+    note("fallback purity OK")
+
+    # 2. Telemetry: off-path carries no history machinery; on-path does
+    #    (the auditor must SEE differences, not just equalities).
+    fn_tel, _ = engine(telemetry=TelemetryConfig(history_gens=16))
+    if fp_default == fingerprint(fn_tel, *args):
+        problems.append(
+            "telemetry-enabled run lowered identically to disabled — "
+            "the history carry is not being traced"
+        )
+    if "dynamic_update_slice" in canonical_text(fn_default, *args):
+        problems.append(
+            "telemetry-off run contains dynamic_update_slice — history "
+            "machinery leaked into the disabled path"
+        )
+    note("telemetry on/off structural split OK")
+
+    # 3. Donation: the engine's ping-pong breed path really aliases its
+    #    population buffer (config default donate_buffers=True).
+    try:
+        donation_check(fn_default, *args, min_donated=1)
+        note("donation (input_output_aliases) OK")
+    except IRContractError as e:
+        problems.append(str(e))
+
+    # 4. No host callbacks anywhere in the fused run.
+    try:
+        callback_free(fn_default, *args)
+        note("callback-free run loop OK")
+    except IRContractError as e:
+        problems.append(str(e))
+
+    # 5. The sharded collective budget on the real pop_shards=4
+    #    lowering (skipped with a problem note when the platform has
+    #    too few devices — the runner is expected to force 8).
+    if len(jax.devices()) >= 4:
+        pga = PGA(seed=7, config=PGAConfig(
+            pop_shards=4, selection="truncation", mutation_rate=0.05,
+            use_pallas=False,
+        ))
+        pga.create_population(256, 32)
+        pga.set_objective("onemax_bits")
+        sharded = pga._compiled_sharded_run(256, 32)
+        pop = pga._populations[0]
+        keys = jax.random.split(jax.random.key(0), 4)
+        sargs = (
+            pop.genomes, keys, jnp.int32(3), jnp.float32(jnp.inf),
+            pga._mutate_params(),
+        )
+        try:
+            collective_budget(
+                sharded.jitted, *sargs, ppermute=1, all_gather=1
+            )
+            note("pop_shards=4 collective budget (1 ppermute + "
+                 "1 all_gather) OK")
+        except IRContractError as e:
+            problems.append(str(e))
+        # and the unsharded program must carry no collectives at all
+        if "ppermute" in canonical_text(fn_default, *args):
+            problems.append(
+                "unsharded run program contains ppermute — cross-shard "
+                "machinery leaked into pop_shards=1"
+            )
+    else:
+        problems.append(
+            f"ir-audit needs >= 4 devices for the sharded leg, have "
+            f"{len(jax.devices())} (set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8)"
+        )
+    return problems

@@ -1,0 +1,802 @@
+"""AST lint pass: repo-specific rules over the Python tree (ISSUE 13).
+
+A small visitor framework plus four rules encoding the conventions this
+codebase actually relies on (each one a bug class that has already
+happened, or an invariant a future backend port must not silently
+break):
+
+``spool-atomic-write``
+    No bare ``open(path, "w")`` / ``np.savez(path)`` landing in durable
+    state (spool / tuning DB / checkpoint files) inside ``libpga_tpu``:
+    writes must route through the temp-file + ``os.replace``/``os.link``
+    helpers (the discipline every crash-recovery proof in
+    ``tools/chaos_smoke.py`` and ``tools/fleet_smoke.py`` rests on). A
+    write is atomic-safe when its target is a temp name (the path
+    expression — or the binding of the name it opens — mentions
+    ``.tmp`` or comes from ``tempfile``). Append mode is allowed: the
+    O_APPEND whole-line protocol is the spool's OTHER sanctioned write
+    (trace/event logs).
+
+``event-kind-registered``
+    Every literal event kind at an ``_emit`` / ``emit`` /
+    ``flight_note`` / ``note`` site must exist in
+    ``telemetry.EVENT_FIELDS`` (parsed from the source, no import
+    needed), and — where the call has no ``**kwargs`` — must pass every
+    required field. Unknown kinds are the recurring bug: the schema
+    validator allows them (forward compatibility), so a typo'd or
+    unregistered kind ships silently and only fails when a consumer
+    looks for its fields.
+
+``no-wallclock-in-traced``
+    No wall-clock reads (``time.time``/``monotonic``/...), host RNG
+    (``np.random.*``, stdlib ``random``, ``os.urandom``, ``uuid``) or
+    set-iteration nondeterminism inside functions that get traced —
+    resolved by a call-graph walk from every function passed to
+    ``jit``/``scan``/``while_loop``/``cond``/``fori_loop``/
+    ``shard_map``/``pallas_call``/``vmap``. A wall-clock read inside a
+    traced function is baked in at trace time (silently stale), and
+    host RNG breaks the bit-identity guarantees every replay/recovery
+    proof depends on.
+
+``lock-guarded-registry``
+    In any class that takes ``with self._lock:`` somewhere, an
+    attribute the class mutates under that lock is a *protected*
+    attribute — and every other mutation of it (outside ``__init__``)
+    must also hold the lock. This is self-calibrating: classes without
+    a lock, and attributes never locked, are untouched.
+
+Suppression: append ``# pga-lint: disable=<rule>[,<rule>...]`` to the
+flagged line. Suppressions are scoped to that line and CHECKED — one
+that never fires is itself reported (``unused-suppression``), so stale
+exemptions cannot accumulate.
+
+This module is deliberately import-light (stdlib only) so the runner's
+``--changed`` fast path never pays a JAX import.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+# ----------------------------------------------------------------- model
+
+#: Rule ids, in documentation order. ``unused-suppression`` is the
+#: meta-rule emitted by the suppression checker itself.
+RULES = (
+    "spool-atomic-write",
+    "event-kind-registered",
+    "no-wallclock-in-traced",
+    "lock-guarded-registry",
+    "unused-suppression",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic: ``path:line: [rule] message``."""
+
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+_SUPPRESS_RE = re.compile(r"#\s*pga-lint:\s*disable=([\w,\- ]+)")
+
+
+def _suppressions(source: str) -> Dict[int, Set[str]]:
+    """line -> suppressed rule set, from ``# pga-lint: disable=...``
+    comments (found with the tokenizer, so a '#' inside a string can
+    never be misread as a directive)."""
+    out: Dict[int, Set[str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(iter(source.splitlines(True)).__next__)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+                out.setdefault(tok.start[0], set()).update(rules)
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+# ----------------------------------------------------- shared AST helpers
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """`a.b.c` -> "a.b.c"; bare name -> "a"; anything else -> None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _call_name(call: ast.Call) -> Optional[str]:
+    """Last component of the callee (``jax.lax.scan`` -> "scan")."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ""
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+class _Parents(ast.NodeVisitor):
+    """Parent links + enclosing-function chains for a module tree."""
+
+    def __init__(self, tree: ast.AST):
+        self.parent: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parent[child] = node
+
+    def enclosing_functions(self, node: ast.AST) -> List[ast.AST]:
+        """Innermost-first chain of FunctionDef/Lambda containing node."""
+        out = []
+        cur = self.parent.get(node)
+        while cur is not None:
+            if isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                out.append(cur)
+            cur = self.parent.get(cur)
+        return out
+
+
+# ------------------------------------------------- rule: spool-atomic-write
+
+#: Write-intent open() modes. "a"/"ab" are exempt (the O_APPEND
+#: whole-line protocol); "r+" is a read-modify that never lands durable
+#: state here.
+_WRITE_MODES = ("w", "x")
+
+#: Path-expression markers that make a write atomic-safe.
+_TMP_MARKERS = (".tmp", "tempfile", "mktemp", "TemporaryFile", "mkdtemp")
+
+#: Path markers that pull the rule in even OUTSIDE libpga_tpu/ — writes
+#: that name a spool/checkpoint location are durable state wherever
+#: they live.
+_SPOOL_MARKERS = (
+    "spool", "pending", "claimed", "results", "leases", "ckpt",
+    "checkpoint", "dead", "sessions",
+)
+
+
+def _binding_texts(
+    name: str, scopes: List[ast.AST], module: ast.AST
+) -> List[str]:
+    """Unparsed value expressions of every visible binding of ``name``
+    (enclosing functions innermost-first, then TOP-LEVEL module
+    statements — another function's same-named local is not a
+    binding)."""
+    out = []
+
+    def nodes_of(scope):
+        if isinstance(scope, ast.Module):
+            return list(scope.body)  # top level only: no descent
+        return list(ast.walk(scope))
+
+    for scope in list(scopes) + [module]:
+        for node in nodes_of(scope):
+            value = None
+            if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == name
+                for t in node.targets
+            ):
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) and isinstance(
+                node.target, ast.Name
+            ) and node.target.id == name:
+                value = node.value
+            elif isinstance(node, ast.NamedExpr) and isinstance(
+                node.target, ast.Name
+            ) and node.target.id == name:
+                value = node.value
+            if value is not None:
+                out.append(_unparse(value))
+    return out
+
+
+def _path_texts(
+    path_arg: ast.AST, parents: _Parents, module: ast.AST
+) -> List[str]:
+    """The path expression's source text plus the texts of every
+    visible binding feeding it (one indirection level: the
+    ``tmp = f"{path}.tmp"`` / ``meta = spool.path(...)`` idioms)."""
+    texts = [_unparse(path_arg)]
+    if isinstance(path_arg, ast.Name):
+        scopes = parents.enclosing_functions(path_arg)
+        texts += _binding_texts(path_arg.id, scopes, module)
+    return texts
+
+
+def rule_spool_atomic_write(ctx: "FileContext") -> List[Finding]:
+    in_package = "libpga_tpu" in ctx.path.replace(os.sep, "/").split("/")
+    findings = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        path_arg = None
+        what = None
+        if isinstance(node.func, ast.Name) and name == "open" and node.args:
+            mode = None
+            if len(node.args) >= 2:
+                mode = _const_str(node.args[1])
+            for kw in node.keywords:
+                if kw.arg == "mode":
+                    mode = _const_str(kw.value)
+            if mode is None or not any(m in mode for m in _WRITE_MODES):
+                continue
+            path_arg = node.args[0]
+            what = f'open(..., "{mode}")'
+        elif name in ("savez", "savez_compressed", "save") and isinstance(
+            node.func, ast.Attribute
+        ):
+            root = _dotted(node.func) or ""
+            if not root.startswith(("np.", "numpy.")):
+                continue
+            if not node.args:
+                continue
+            path_arg = node.args[0]
+            what = f"{root}(...)"
+        else:
+            continue
+        texts = _path_texts(path_arg, ctx.parents, ctx.tree)
+        spoolish = any(
+            m in t.lower() for t in texts for m in _SPOOL_MARKERS
+        )
+        if not (in_package or spoolish):
+            continue
+        if any(m in t for t in texts for m in _TMP_MARKERS):
+            continue
+        findings.append(Finding(
+            ctx.path, node.lineno, "spool-atomic-write",
+            f"bare {what} on {texts[0]!r} — durable state must go "
+            "through a temp file + os.replace/os.link (or append mode "
+            "for whole-line logs)",
+        ))
+    return findings
+
+
+# --------------------------------------------- rule: event-kind-registered
+
+_EMIT_NAMES = ("_emit", "emit", "flight_note", "note")
+
+#: Emitter names generic enough that only METHOD calls (``x.emit``,
+#: ``self.note``) count — a local helper happening to be called
+#: ``note(...)`` is not a telemetry site. ``_emit``/``flight_note`` are
+#: repo-specific enough to match as bare names too.
+_METHOD_ONLY_EMITTERS = ("emit", "note")
+
+#: Emitter parameter names that carry a whole field dict (their field
+#: sets are opaque to a static check — kind membership only).
+_DICT_EMITTERS = ("flight_note", "note")
+
+
+def load_event_fields(repo_root: str) -> Dict[str, Tuple[str, ...]]:
+    """EVENT_FIELDS parsed out of ``utils/telemetry.py`` source — the
+    single schema source, read without importing the package (the lint
+    fast path must not pay a JAX import, and must keep working even
+    when the package itself is broken)."""
+    path = os.path.join(
+        repo_root, "libpga_tpu", "utils", "telemetry.py"
+    )
+    with open(path, "r", encoding="utf-8") as fh:
+        tree = ast.parse(fh.read())
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == "EVENT_FIELDS":
+                value = node.value
+                if not isinstance(value, ast.Dict):
+                    break
+                out = {}
+                for k, v in zip(value.keys, value.values):
+                    kind = _const_str(k)
+                    if kind is None:
+                        continue
+                    fields = tuple(
+                        f for f in (
+                            _const_str(e) for e in getattr(v, "elts", [])
+                        ) if f is not None
+                    )
+                    out[kind] = fields
+                return out
+    raise ValueError(f"EVENT_FIELDS dict not found in {path}")
+
+
+def rule_event_kind_registered(ctx: "FileContext") -> List[Finding]:
+    fields = ctx.event_fields
+    if fields is None:
+        return []
+    if ctx.path.replace(os.sep, "/").endswith("utils/telemetry.py"):
+        return []  # the schema module itself (validators, doc examples)
+    findings = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if name not in _EMIT_NAMES:
+            continue
+        if name in _METHOD_ONLY_EMITTERS and not isinstance(
+            node.func, ast.Attribute
+        ):
+            continue
+        if not node.args:
+            continue
+        kind = _const_str(node.args[0])
+        if kind is None:
+            continue  # dynamic kind (e.g. re-emit of a parsed record)
+        if kind not in fields:
+            findings.append(Finding(
+                ctx.path, node.lineno, "event-kind-registered",
+                f"event kind {kind!r} is not registered in "
+                "telemetry.EVENT_FIELDS — unknown kinds pass the schema "
+                "validator silently; register the kind (with its "
+                "required fields) instead",
+            ))
+            continue
+        if name in _DICT_EMITTERS or any(
+            kw.arg is None for kw in node.keywords
+        ) or len(node.args) > 1:
+            continue  # field dict / **kwargs: membership check only
+        passed = {kw.arg for kw in node.keywords}
+        missing = [f for f in fields[kind] if f not in passed]
+        if missing:
+            findings.append(Finding(
+                ctx.path, node.lineno, "event-kind-registered",
+                f"event {kind!r} emitted without required field(s) "
+                f"{missing} (EVENT_FIELDS[{kind!r}] = "
+                f"{list(fields[kind])})",
+            ))
+    return findings
+
+
+# --------------------------------------------- rule: no-wallclock-in-traced
+
+#: Call sites whose function-valued positional arguments get traced.
+_TRACE_ENTRIES = (
+    "jit", "while_loop", "scan", "fori_loop", "cond", "switch",
+    "shard_map", "pallas_call", "vmap", "pmap", "checkpoint", "remat",
+)
+
+#: Attribute-chain patterns that read the host environment. Matched
+#: against the dotted callee (aliases of the numpy/time/random modules
+#: included below).
+_WALLCLOCK_CALLS = {
+    "time", "monotonic", "perf_counter", "time_ns", "monotonic_ns",
+    "perf_counter_ns",
+}
+_HOST_RANDOM_ROOTS = ("np.random", "numpy.random", "random")
+_BANNED_EXACT = {"os.urandom", "uuid.uuid1", "uuid.uuid4", "datetime.now",
+                 "datetime.utcnow", "datetime.datetime.now",
+                 "datetime.datetime.utcnow"}
+
+
+class _ModuleIndex:
+    """Per-module name resolution for the traced-call-graph walk."""
+
+    def __init__(self, ctx: "FileContext"):
+        self.ctx = ctx
+        self.defs: Dict[str, ast.AST] = {}
+        self.imports: Dict[str, str] = {}       # alias -> module
+        self.from_imports: Dict[str, Tuple[str, str]] = {}  # name -> (mod, orig)
+        for node in ctx.tree.body:
+            self._index(node)
+        # function defs at any nesting (for scope-chain resolution)
+        self.all_defs: Dict[ast.AST, Dict[str, ast.AST]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scope = self.all_defs.setdefault(node, {})
+                for child in ast.walk(node):
+                    if isinstance(
+                        child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ) and child is not node and self._directly_inside(
+                        child, node
+                    ):
+                        scope[child.name] = child
+
+    def _directly_inside(self, child: ast.AST, func: ast.AST) -> bool:
+        cur = self.ctx.parents.parent.get(child)
+        while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            cur = self.ctx.parents.parent.get(cur)
+        return cur is func
+
+    def _index(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.defs[node.name] = node
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                self.imports[alias.asname or alias.name.split(".")[0]] = (
+                    alias.name
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for alias in node.names:
+                self.from_imports[alias.asname or alias.name] = (
+                    node.module, alias.name
+                )
+
+    def resolve_local(
+        self, name: str, site: ast.AST
+    ) -> Optional[ast.AST]:
+        """A FunctionDef for ``name`` visible from ``site`` (enclosing
+        scopes innermost-first, then module level)."""
+        for scope in self.ctx.parents.enclosing_functions(site):
+            got = self.all_defs.get(scope, {}).get(name)
+            if got is not None:
+                return got
+        return self.defs.get(name)
+
+
+def _banned_call(dotted: str, index: _ModuleIndex) -> Optional[str]:
+    """Why this dotted callee is banned inside traced code, or None."""
+    if dotted in _BANNED_EXACT:
+        return f"host-environment call {dotted}()"
+    parts = dotted.split(".")
+    root_alias = parts[0]
+    root_module = index.imports.get(root_alias, root_alias)
+    normalized = ".".join([root_module] + parts[1:])
+    if (
+        len(parts) == 2
+        and root_module == "time"
+        and parts[1] in _WALLCLOCK_CALLS
+    ):
+        return f"wall-clock read {dotted}()"
+    for r in _HOST_RANDOM_ROOTS:
+        if normalized == r or normalized.startswith(r + "."):
+            # jax.random is fine; only numpy/stdlib random are host RNG
+            return f"host RNG {dotted}()"
+    return None
+
+
+def _walk_traced(
+    func: ast.AST,
+    index: _ModuleIndex,
+    root_desc: str,
+    findings: List[Finding],
+    seen: Set[int],
+    depth: int = 0,
+) -> None:
+    if id(func) in seen or depth > 8:
+        return
+    seen.add(id(func))
+    body = func.body if isinstance(func.body, list) else [func.body]
+    for stmt in body:
+        for node in ast.walk(stmt):
+            # don't descend into nested defs unless they are called —
+            # ast.walk does descend, but a nested def that is returned
+            # (a factory pattern) IS usually the traced payload, so the
+            # over-approximation errs on the safe side deliberately.
+            if isinstance(node, ast.For):
+                it = node.iter
+                if isinstance(it, ast.Set) or (
+                    isinstance(it, ast.Call)
+                    and _call_name(it) == "set"
+                ):
+                    findings.append(Finding(
+                        index.ctx.path, node.lineno,
+                        "no-wallclock-in-traced",
+                        "iteration over a set inside traced code "
+                        f"(reached from {root_desc}) — set order is "
+                        "nondeterministic across processes",
+                    ))
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            if dotted is not None:
+                why = _banned_call(dotted, index)
+                if why is not None:
+                    findings.append(Finding(
+                        index.ctx.path, node.lineno,
+                        "no-wallclock-in-traced",
+                        f"{why} inside traced code (reached from "
+                        f"{root_desc}) — traced programs must be pure; "
+                        "pass the value in as an argument instead",
+                    ))
+                    continue
+            callee = None
+            if isinstance(node.func, ast.Name):
+                callee = index.resolve_local(node.func.id, node)
+            if callee is not None:
+                _walk_traced(
+                    callee, index, root_desc, findings, seen, depth + 1
+                )
+
+
+def rule_no_wallclock_in_traced(ctx: "FileContext") -> List[Finding]:
+    index = _ModuleIndex(ctx)
+    findings: List[Finding] = []
+    seen: Set[int] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        entry = _call_name(node)
+        if entry not in _TRACE_ENTRIES:
+            continue
+        # Only trust dotted jax-ish entries or bare names imported from
+        # jax modules — a local helper that happens to be called
+        # ``cond`` must not pull its arguments into the traced set.
+        dotted = _dotted(node.func) or ""
+        if "." not in dotted:
+            src = ctx.module_index_fallback(dotted)
+            if src is None or not src.startswith("jax"):
+                continue
+        for arg in node.args:
+            root = None
+            if isinstance(arg, ast.Lambda):
+                root = arg
+            elif isinstance(arg, ast.Name):
+                root = index.resolve_local(arg.id, node)
+            if root is not None:
+                desc = (
+                    f"{entry}() at line {node.lineno}"
+                )
+                _walk_traced(root, index, desc, findings, seen)
+    return findings
+
+
+# --------------------------------------------- rule: lock-guarded-registry
+
+_MUTATOR_METHODS = {
+    "append", "extend", "add", "update", "clear", "pop", "popleft",
+    "remove", "discard", "insert", "setdefault",
+}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.X`` or ``self.X[...]`` -> "X"."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and isinstance(
+        node.value, ast.Name
+    ) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _is_lock_with(node: ast.With) -> bool:
+    for item in node.items:
+        expr = item.context_expr
+        if isinstance(expr, ast.Attribute) and expr.attr.endswith("_lock"):
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+                return True
+    return False
+
+
+def _class_mutations(
+    cls: ast.ClassDef,
+) -> List[Tuple[str, ast.AST, bool, str]]:
+    """(attr, node, under_lock, method_name) for every ``self.X``
+    mutation in the class body."""
+    out = []
+
+    def visit(node: ast.AST, under: bool, method: str) -> None:
+        if isinstance(node, ast.With):
+            under2 = under or _is_lock_with(node)
+            for child in node.body:
+                visit(child, under2, method)
+            return
+        attrs: List[Tuple[str, ast.AST]] = []
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [
+                node.target
+            ]
+            for t in targets:
+                a = _self_attr(t)
+                if a is not None:
+                    attrs.append((a, node))
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                a = _self_attr(t)
+                if a is not None:
+                    attrs.append((a, node))
+        if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            call = node.value
+            if isinstance(call.func, ast.Attribute) and (
+                call.func.attr in _MUTATOR_METHODS
+            ):
+                a = _self_attr(call.func.value)
+                if a is not None:
+                    attrs.append((a, node))
+        for a, n in attrs:
+            out.append((a, n, under, method))
+        for child in ast.iter_child_nodes(node):
+            visit(child, under, method)
+
+    for item in cls.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for stmt in item.body:
+                visit(stmt, False, item.name)
+    return out
+
+
+def rule_lock_guarded_registry(ctx: "FileContext") -> List[Finding]:
+    findings = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        muts = _class_mutations(node)
+        protected = {a for a, _, under, m in muts if under}
+        if not protected:
+            continue
+        for attr, site, under, method in muts:
+            if under or method == "__init__" or attr not in protected:
+                continue
+            findings.append(Finding(
+                ctx.path, site.lineno, "lock-guarded-registry",
+                f"{node.name}.{attr} is mutated under self._lock "
+                f"elsewhere but written here ({method}) without it — "
+                "lock-protected state must stay lock-protected",
+            ))
+    return findings
+
+
+# ----------------------------------------------------------------- driver
+
+
+class FileContext:
+    """Everything a rule needs about one file."""
+
+    def __init__(
+        self,
+        path: str,
+        source: str,
+        event_fields: Optional[Dict[str, Tuple[str, ...]]],
+    ):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.parents = _Parents(self.tree)
+        self.event_fields = event_fields
+        self._bare_import_sources: Optional[Dict[str, str]] = None
+
+    def module_index_fallback(self, name: str) -> Optional[str]:
+        """Source module of a bare imported name (``from jax import
+        jit`` -> "jax"); None for locals/builtins."""
+        if self._bare_import_sources is None:
+            out: Dict[str, str] = {}
+            for node in ast.walk(self.tree):
+                if isinstance(node, ast.ImportFrom) and node.module:
+                    for alias in node.names:
+                        out[alias.asname or alias.name] = node.module
+            self._bare_import_sources = out
+        return self._bare_import_sources.get(name)
+
+
+_FILE_RULES = {
+    "spool-atomic-write": rule_spool_atomic_write,
+    "event-kind-registered": rule_event_kind_registered,
+    "no-wallclock-in-traced": rule_no_wallclock_in_traced,
+    "lock-guarded-registry": rule_lock_guarded_registry,
+}
+
+
+def repo_root_of(path: str) -> str:
+    """Walk up from ``path`` to the directory containing libpga_tpu/."""
+    cur = os.path.abspath(path if os.path.isdir(path) else os.path.dirname(path))
+    while cur != os.path.dirname(cur):
+        if os.path.isdir(os.path.join(cur, "libpga_tpu")):
+            return cur
+        cur = os.path.dirname(cur)
+    return os.getcwd()
+
+
+def lint_file(
+    path: str,
+    source: Optional[str] = None,
+    rules: Optional[Iterable[str]] = None,
+    event_fields: Optional[Dict[str, Tuple[str, ...]]] = None,
+) -> List[Finding]:
+    """Lint one Python file; returns surviving findings (suppressions
+    applied, unused suppressions reported)."""
+    if source is None:
+        with open(path, "r", encoding="utf-8") as fh:
+            source = fh.read()
+    if event_fields is None:
+        try:
+            event_fields = load_event_fields(repo_root_of(path))
+        except (OSError, ValueError):
+            event_fields = None
+    try:
+        ctx = FileContext(path, source, event_fields)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 0, "parse-error", str(e))]
+    selected = rules if rules is not None else _FILE_RULES.keys()
+    raw: List[Finding] = []
+    for rule in selected:
+        fn = _FILE_RULES.get(rule)
+        if fn is not None:
+            raw.extend(fn(ctx))
+    sup = _suppressions(source)
+    used: Dict[int, Set[str]] = {}
+    kept = []
+    for f in raw:
+        if f.rule in sup.get(f.line, ()):  # scoped, same-line
+            used.setdefault(f.line, set()).add(f.rule)
+            continue
+        kept.append(f)
+    for line, rules_here in sorted(sup.items()):
+        for rule in sorted(rules_here - used.get(line, set())):
+            if rules is not None and rule not in selected:
+                continue  # a partial run can't prove a suppression dead
+            kept.append(Finding(
+                path, line, "unused-suppression",
+                f"suppression for {rule!r} never fired on this line — "
+                "remove it (or fix the directive)",
+            ))
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    return kept
+
+
+def default_paths(repo_root: str) -> List[str]:
+    """The full-tree lint set: every .py under libpga_tpu/, tools/ and
+    tests/ (fixtures excluded — they exist to violate the rules) plus
+    the top-level scripts."""
+    out = []
+    for base in ("libpga_tpu", "tools", "tests"):
+        root = os.path.join(repo_root, base)
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [
+                d for d in dirnames
+                if d not in ("__pycache__", "fixtures")
+            ]
+            for f in sorted(filenames):
+                if f.endswith(".py"):
+                    out.append(os.path.join(dirpath, f))
+    for f in ("bench.py",):
+        p = os.path.join(repo_root, f)
+        if os.path.exists(p):
+            out.append(p)
+    return out
+
+
+def lint_paths(
+    paths: Iterable[str], rules: Optional[Iterable[str]] = None
+) -> List[Finding]:
+    findings: List[Finding] = []
+    event_fields: Optional[Dict[str, Tuple[str, ...]]] = None
+    for path in paths:
+        if event_fields is None:
+            try:
+                event_fields = load_event_fields(repo_root_of(path))
+            except (OSError, ValueError):
+                event_fields = None
+        findings.extend(
+            lint_file(path, rules=rules, event_fields=event_fields)
+        )
+    return findings

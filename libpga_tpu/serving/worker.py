@@ -639,11 +639,11 @@ class WorkerHarness:
         self._flush_metrics()
         try:
             snap = _metrics.REGISTRY.snapshot()
-            with open(
-                self.spool.path("logs", f"{self.wid}.prom"), "w",
-                encoding="utf-8",
-            ) as fh:
+            prom_path = self.spool.path("logs", f"{self.wid}.prom")
+            tmp = f"{prom_path}.{os.getpid()}.tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
                 fh.write(_metrics.prometheus_text(snap))
+            os.replace(tmp, prom_path)
         except Exception:
             pass
         if clean:

@@ -136,6 +136,13 @@ EVENT_FIELDS: Dict[str, tuple] = {
     "session_fold": ("session", "folded"),
     "session_suspend": ("session", "path"),
     "session_resume": ("session", "path"),
+    # Co-batched PBT (ISSUE 12): one record per exploit/explore pass of
+    # a SessionGroup — epoch index, how many sessions copied a
+    # partner's parameters, and the group's best at the boundary.
+    # (Registered by the round-18 lint sweep: the emit site shipped in
+    # round 17 without a schema entry — exactly the bug class
+    # ``event-kind-registered`` exists for.)
+    "pbt_epoch": ("epoch", "exploited", "best"),
 }
 
 
@@ -674,7 +681,13 @@ class FlightRecorder:
             }
             if self.worker_id is not None:
                 trailer["worker"] = str(self.worker_id)
-            with open(path, "w", encoding="utf-8") as fh:
+            # Not the spool discipline, deliberately: flight dumps are
+            # the diagnostic of last resort, written into a dump/temp
+            # directory (never the spool) while the process may already
+            # be dying — one direct write maximizes the chance ANY
+            # context survives, and a torn tail is acceptable in a
+            # post-mortem artifact (validate_log flags it).
+            with open(path, "w", encoding="utf-8") as fh:  # pga-lint: disable=spool-atomic-write
                 for rec in recs + [snap_rec, trailer]:
                     fh.write(json.dumps(rec, default=str) + "\n")
         except Exception as e:

@@ -1,0 +1,16 @@
+"""POSITIVE fixture: unregistered / underfilled event emissions.
+
+Never imported — linted by tests/test_analysis.py only.
+"""
+
+
+class Emitter:
+    def _emit(self, event, **fields):
+        pass
+
+
+def report(e: Emitter):
+    # BAD: kind not in telemetry.EVENT_FIELDS (a round-17-style typo).
+    e._emit("pbt_epohc", epoch=1, exploited=2, best=3.0)
+    # BAD: registered kind missing a required field (no **kwargs escape).
+    e._emit("run_start", population_size=256, genome_len=16)

@@ -1,0 +1,20 @@
+"""NEGATIVE fixture: schema-honest event emissions.
+
+Never imported — linted by tests/test_analysis.py only.
+"""
+
+
+class Emitter:
+    def _emit(self, event, **fields):
+        pass
+
+
+def report(e: Emitter, extra):
+    # registered kind, every required field present
+    e._emit("run_start", population_size=256, genome_len=16, n=1)
+    # registered kind with **kwargs: membership check only
+    e._emit("ticket_done", bucket="b", **extra)
+    # dynamic kind: not a literal, out of static scope
+    kind = "run_end" if extra else "run_start"
+    e._emit(kind, generations=3, seconds=0.1, best=1.0,
+            population_size=1, genome_len=1, n=1)

@@ -1,0 +1,22 @@
+"""POSITIVE fixture: lock-protected state mutated without the lock.
+
+Never imported — linted by tests/test_analysis.py only.
+"""
+
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._series = {}
+        self._lock = threading.Lock()
+
+    def record(self, name, value):
+        with self._lock:
+            self._series[name] = value  # calibrates: _series is protected
+
+    def reset(self):
+        self._series.clear()  # BAD: unlocked mutation of protected state
+
+    def bulk(self, items):
+        self._series.update(items)  # BAD: same

@@ -1,0 +1,40 @@
+"""NEGATIVE fixture: every protected mutation holds the lock.
+
+Never imported — linted by tests/test_analysis.py only.
+"""
+
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._series = {}
+        self._listeners = []  # never locked: unprotected by choice
+        self._lock = threading.Lock()
+
+    def record(self, name, value):
+        with self._lock:
+            self._series[name] = value
+
+    def reset(self):
+        with self._lock:
+            self._series.clear()
+
+    def add_listener(self, fn):
+        # _listeners is never mutated under the lock anywhere, so the
+        # self-calibrating rule leaves it alone.
+        self._listeners.append(fn)
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._series)
+
+
+class NoLock:
+    """A lockless class: the rule does not apply at all."""
+
+    def __init__(self):
+        self.items = []
+
+    def push(self, x):
+        self.items.append(x)

@@ -1,0 +1,21 @@
+"""POSITIVE fixture: bare writes landing in durable spool state.
+
+Never imported — linted by tests/test_analysis.py only.
+"""
+
+import json
+import os
+
+import numpy as np
+
+
+def publish_result(spool_dir, tid, payload):
+    # BAD: a crash mid-write leaves a torn result a reader will parse.
+    meta_path = os.path.join(spool_dir, "results", f"{tid}.json")
+    with open(meta_path, "w", encoding="utf-8") as fh:  # line 15: flagged
+        json.dump(payload, fh)
+
+
+def save_checkpoint(spool_dir, tid, genomes):
+    # BAD: np.savez straight onto the durable checkpoint name.
+    np.savez(os.path.join(spool_dir, "ckpt", f"{tid}.npz"), g=genomes)

@@ -1,0 +1,37 @@
+"""NEGATIVE fixture: the sanctioned durable-write shapes.
+
+Never imported — linted by tests/test_analysis.py only.
+"""
+
+import json
+import os
+
+import numpy as np
+
+
+def publish_result(spool_dir, tid, payload):
+    # temp name + os.replace: the atomic-rename discipline.
+    meta_path = os.path.join(spool_dir, "results", f"{tid}.json")
+    tmp = f"{meta_path}.{os.getpid()}.tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh)
+    os.replace(tmp, meta_path)
+
+
+def save_checkpoint(spool_dir, tid, genomes):
+    final = os.path.join(spool_dir, "ckpt", f"{tid}.npz")
+    tmp = f"{final}.{os.getpid()}.tmp.npz"
+    np.savez(tmp, g=genomes)
+    os.replace(tmp, final)
+
+
+def append_trace(spool_dir, tid, line):
+    # append mode: the O_APPEND whole-line protocol is sanctioned.
+    with open(os.path.join(spool_dir, "traces", f"{tid}.jsonl"), "a") as fh:
+        fh.write(line + "\n")
+
+
+def read_result(spool_dir, tid):
+    # reads are never the rule's business.
+    with open(os.path.join(spool_dir, "results", f"{tid}.json")) as fh:
+        return json.load(fh)

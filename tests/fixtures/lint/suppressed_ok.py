@@ -1,0 +1,16 @@
+"""Fixture: a real violation silenced by a scoped, documented
+suppression — the sanctioned escape hatch.
+
+Never imported — linted by tests/test_analysis.py only.
+"""
+
+import json
+import os
+
+
+def torn_file_simulation(spool_dir):
+    # Deliberate torn write: this exercises a reader's defense path,
+    # exactly the legitimate-suppression shape.
+    path = os.path.join(spool_dir, "results", "torn.json")
+    with open(path, "w") as fh:  # pga-lint: disable=spool-atomic-write
+        fh.write(json.dumps({"x": 1})[:7])

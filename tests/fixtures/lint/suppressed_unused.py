@@ -1,0 +1,10 @@
+"""Fixture: a suppression that silences nothing — itself a finding
+(stale exemptions must not accumulate).
+
+Never imported — linted by tests/test_analysis.py only.
+"""
+
+
+def harmless():
+    x = 1  # pga-lint: disable=spool-atomic-write
+    return x

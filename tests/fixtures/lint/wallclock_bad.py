@@ -1,0 +1,43 @@
+"""POSITIVE fixture: host-environment reads inside traced code.
+
+Never imported — linted by tests/test_analysis.py only.
+"""
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def run(genomes, n):
+    def cond(carry):
+        g, gen = carry
+        return gen < n
+
+    def body(carry):
+        g, gen = carry
+        # BAD: baked in at trace time, silently stale afterwards
+        noise = time.time()
+        return g + noise, gen + 1
+
+    return jax.lax.while_loop(cond, body, (genomes, jnp.int32(0)))
+
+
+def scored(genomes):
+    def scorer(g):
+        # BAD: host RNG breaks bit-identical replay
+        return jnp.sum(g) * np.random.rand()
+
+    return jax.jit(scorer)(genomes)
+
+
+def transitive(genomes):
+    def helper(g):
+        return g * time.monotonic()  # BAD: reached through the walk
+
+    def step(g):
+        return helper(g) + 1.0
+
+    return jax.jit(step)(genomes)

@@ -1,0 +1,39 @@
+"""NEGATIVE fixture: host reads stay OUTSIDE the traced closure.
+
+Never imported — linted by tests/test_analysis.py only.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def run(genomes, n):
+    started = time.time()  # host side: fine
+
+    def cond(carry):
+        g, gen = carry
+        return gen < n
+
+    def body(carry):
+        g, gen = carry
+        key = jax.random.key(gen)  # jax RNG is traced-pure: fine
+        return g + jax.random.uniform(key, g.shape), gen + 1
+
+    out = jax.lax.while_loop(cond, body, (genomes, jnp.int32(0)))
+    elapsed = time.time() - started
+    return out, elapsed
+
+
+def cond(pred):
+    """A local helper named like a trace entry: its args must NOT be
+    pulled into the traced set (it is not jax.lax.cond)."""
+    return pred
+
+
+def uses_local_cond(flag):
+    def reads_clock():
+        return time.time()
+
+    return cond(reads_clock)

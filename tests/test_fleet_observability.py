@@ -109,8 +109,10 @@ def test_spool_metrics_torn_file_skipped_version_mismatch_refused(tmp_path):
     spool = Spool(str(tmp_path))
     write_metrics_file(spool, "w0", make_registry([5.0]).snapshot())
     # torn file: a crash mid-write of a NON-atomic writer (the real
-    # flusher renames atomically — this is the defensive path)
-    with open(spool.metrics_path("w1"), "w") as fh:
+    # flusher renames atomically — this bare write DELIBERATELY
+    # violates the spool discipline to exercise the reader's
+    # torn-file defense)
+    with open(spool.metrics_path("w1"), "w") as fh:  # pga-lint: disable=spool-atomic-write
         fh.write('{"schema_version": 1, "proc": "w1", "snapsho')
     payloads, skipped = load_spool_metrics(spool)
     assert [p["proc"] for p in payloads] == ["w0"]

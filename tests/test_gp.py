@@ -691,7 +691,8 @@ class TestByteIdentity:
         """ISSUE 11 bugfix guard: a vector-genome engine's traced run
         program is BYTE-IDENTICAL with the GP subsystem imported and
         exercised (the subsystem must be purely additive — no global
-        state, no monkey-patching)."""
+        state, no monkey-patching). Gate: ``analysis.fingerprint``."""
+        from libpga_tpu.analysis import fingerprint
 
         def lowered_text():
             pga = PGA(seed=0, config=PGAConfig(use_pallas=False))
@@ -703,7 +704,7 @@ class TestByteIdentity:
                 jax.random.key(1), jnp.int32(3), jnp.float32(jnp.inf),
                 pga._mutate_params(),
             )
-            return fn.lower(*args).as_text()
+            return fingerprint(fn, *args)
 
         before = lowered_text()
         # Exercise the subsystem end to end, then re-lower.

@@ -158,13 +158,16 @@ def test_fault_injected_events_validate(tmp_path):
 
 
 def test_disabled_path_lowering_is_byte_identical():
-    """No fault plan + any fallback setting: the compiled run loop's
-    StableHLO is byte-identical across configurations (and to the
+    """No fault plan + any fallback setting: the compiled run loop
+    fingerprints identically across configurations (and to the
     telemetry purity gate's replica, transitively) — the robustness
-    layer is host-side only."""
+    layer is host-side only. The digest is ``analysis.fingerprint``,
+    the same canonical-StableHLO gate every other purity test uses."""
     import jax
 
-    texts = []
+    from libpga_tpu.analysis import fingerprint
+
+    prints = []
     for fallback in ("xla", "raise"):
         pga = _engine(fallback=fallback)
         pop = pga._populations[0]
@@ -172,11 +175,10 @@ def test_disabled_path_lowering_is_byte_identical():
             pop.genomes, jax.random.key(0), jnp.int32(3),
             jnp.float32(jnp.inf), pga._mutate_params(),
         )
-        texts.append(
-            pga._compiled_run(pop.size, pop.genome_len)
-            .lower(*args).as_text()
+        prints.append(
+            fingerprint(pga._compiled_run(pop.size, pop.genome_len), *args)
         )
-    assert texts[0] == texts[1]
+    assert prints[0] == prints[1]
 
 
 def test_run_results_unchanged_with_inert_plan_installed():

@@ -286,20 +286,24 @@ class TestResolution:
 
     def test_db_none_is_byte_identical(self, tmp_path):
         """db=None lowers the EXACT StableHLO of a matched all-default
-        entry: the resolution layer is host-side only."""
+        entry: the resolution layer is host-side only. Compared through
+        ``analysis.fingerprint`` — the shared canonical digest."""
+        from libpga_tpu.analysis import fingerprint
+
         def lowered():
             pga = PGA(seed=0, config=PGAConfig(use_pallas=False))
             pga.set_objective("onemax")
             pga.create_population(128, 16)
             fn, _ = pga._compiled_run_meta(128, 16)
             k = jax.eval_shape(lambda: jax.random.key(0))
-            return fn.lower(
+            return fingerprint(
+                fn,
                 jax.ShapeDtypeStruct((128, 16), jnp.float32),
                 jax.ShapeDtypeStruct(k.shape, k.dtype),
                 jax.ShapeDtypeStruct((), jnp.int32),
                 jax.ShapeDtypeStruct((), jnp.float32),
                 jax.ShapeDtypeStruct((1, 2), jnp.float32),
-            ).as_text()
+            )
 
         default_entry = _entry(pop=128, knobs={
             "pallas_deme_size": None, "pallas_layout": None,

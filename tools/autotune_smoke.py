@@ -130,10 +130,11 @@ def main() -> int:
     if "tuned_config" not in kinds:
         sys.exit(f"no tuned_config event at warm-up (got {sorted(set(kinds))})")
 
-    # -- 4: db=None is byte-identical --------------------------------
+    # -- 4: db=None is byte-identical (analysis.fingerprint gate) ----
     import jax
 
     from libpga_tpu import PGA
+    from libpga_tpu.analysis import fingerprint
 
     def lowered_text():
         pga = PGA(seed=0, config=PGAConfig(use_pallas=False))
@@ -150,7 +151,7 @@ def main() -> int:
             jax.ShapeDtypeStruct((), jnp.float32),
             jax.ShapeDtypeStruct((1, 2), jnp.float32),
         )
-        return fn.lower(*args).as_text()
+        return fingerprint(fn, *args)
 
     with_db = lowered_text()
     tuning.set_tuning_db(None)

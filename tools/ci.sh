@@ -86,6 +86,27 @@
 #                                 session_open/session_fold/
 #                                 session_suspend/session_resume event
 #                                 kinds are schema-valid.
+#  14. static analysis           — tools/lint_pga.py --all (ISSUE 13):
+#                                 the invariant guard — repo-specific
+#                                 AST lints (spool-atomic-write,
+#                                 event-kind-registered,
+#                                 no-wallclock-in-traced,
+#                                 lock-guarded-registry; scoped
+#                                 suppressions checked for staleness),
+#                                 the IR contract audit on the live
+#                                 engine's CPU lowerings (fallback and
+#                                 telemetry purity via the canonical
+#                                 StableHLO fingerprint, buffer
+#                                 donation actually aliased, run loops
+#                                 callback-free, pop_shards=4 carries
+#                                 exactly 1 ppermute + 1 all_gather
+#                                 per generation), and the 3-way C-ABI
+#                                 cross-check (pga_tpu.h prototypes ↔
+#                                 pga_tpu.cc marshal formats ↔
+#                                 capi_bridge.py signatures ↔
+#                                 test_serving.c symbol coverage,
+#                                 retry-once snapshot shapes). Exits
+#                                 nonzero with file:line diagnostics.
 #  12. gp smoke                  — tools/gp_smoke.py (ISSUE 11):
 #                                 random-grown postfix programs are
 #                                 strictly well-formed and the GP
@@ -440,5 +461,8 @@ JAX_PLATFORMS=cpu python tools/gp_smoke.py
 
 echo "== ci: streaming smoke =="
 JAX_PLATFORMS=cpu python tools/streaming_smoke.py
+
+echo "== ci: static analysis =="
+JAX_PLATFORMS=cpu python tools/lint_pga.py --all
 
 echo "== ci: all stages passed =="

@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""Invariant guard runner: AST lints + IR contract audit + C-ABI
+cross-check (ISSUE 13, CI stage 14).
+
+    tools/lint_pga.py                 # lint the whole tree (fast, no jax)
+    tools/lint_pga.py path.py ...     # lint specific files
+    tools/lint_pga.py --abi           # C-ABI cross-check only
+    tools/lint_pga.py --ir            # IR contracts on the live engine
+    tools/lint_pga.py --all           # lint + ABI + IR  (the CI gate)
+    tools/lint_pga.py --changed       # git-diff-scoped fast path
+
+Exit status: 0 on a clean tree, 1 with ``file:line: [rule] message``
+diagnostics otherwise, 2 on an internal error.
+
+``--changed`` keeps the full-tree walk out of the edit loop: it lints
+only files touched per ``git status`` (staged, unstaged and untracked),
+adds the ABI cross-check exactly when an ABI layer file changed, and
+skips the IR audit (which needs a jax import + engine lowerings —
+that's the CI stage's job).
+
+The lint and ABI passes import NOTHING from the package (the analysis
+modules are loaded standalone from their file paths), so they run in
+milliseconds even where jax is missing or broken. Only ``--ir`` pays
+the jax import; it forces the simulated 8-device CPU platform first,
+exactly as tests/conftest.py does.
+"""
+
+import argparse
+import importlib.util
+import os
+import subprocess
+import sys
+import types
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: Files whose change triggers the ABI cross-check under --changed.
+ABI_FILES = (
+    "capi/pga_tpu.h",
+    "capi/pga_tpu.cc",
+    "libpga_tpu/capi_bridge.py",
+    "capi/test_serving.c",
+)
+
+
+def _load_standalone(relpath: str, dotted: str):
+    """Load an analysis module from its file path WITHOUT importing the
+    libpga_tpu package (whose __init__ pulls jax). The module is
+    registered under its dotted name — with stub parent packages — so
+    the analyzers' own `from libpga_tpu.analysis.lint import ...`
+    statements resolve from sys.modules instead of triggering the real
+    package import."""
+    if dotted in sys.modules:
+        return sys.modules[dotted]
+    parts = dotted.split(".")
+    for i in range(1, len(parts)):
+        pkg = ".".join(parts[:i])
+        if pkg not in sys.modules:
+            stub = types.ModuleType(pkg)
+            stub.__path__ = []  # mark as package
+            sys.modules[pkg] = stub
+    spec = importlib.util.spec_from_file_location(
+        dotted, os.path.join(REPO_ROOT, relpath)
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[dotted] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _lint_module():
+    return _load_standalone(
+        "libpga_tpu/analysis/lint.py", "libpga_tpu.analysis.lint"
+    )
+
+
+def _abi_module():
+    _lint_module()  # Finding import target
+    return _load_standalone(
+        "libpga_tpu/analysis/abi_check.py", "libpga_tpu.analysis.abi_check"
+    )
+
+
+def changed_files():
+    """Repo-relative paths touched per git (staged + unstaged +
+    untracked); None when git is unavailable."""
+    try:
+        out = subprocess.run(
+            ["git", "status", "--porcelain"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=30,
+        )
+    except OSError:
+        return None
+    if out.returncode != 0:
+        return None
+    files = []
+    for line in out.stdout.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:].strip()
+        if " -> " in path:  # rename: lint the new name
+            path = path.split(" -> ", 1)[1]
+        files.append(path.strip('"'))
+    return files
+
+
+def run_lint(paths, lint):
+    findings = lint.lint_paths(paths)
+    # parse errors are real failures too, but syntactically broken
+    # files are pytest's department — keep them visible regardless.
+    return findings
+
+
+def run_ir(verbose):
+    # Mirror tests/conftest.py: the sharded contract needs a simulated
+    # multi-device CPU platform, configured BEFORE jax initializes.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    sys.path.insert(0, REPO_ROOT)
+    # drop the standalone stubs so the real package imports cleanly
+    for name in [
+        n for n in list(sys.modules)
+        if n == "libpga_tpu" or n.startswith("libpga_tpu.")
+    ]:
+        del sys.modules[name]
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_threefry_partitionable", True)
+
+    from libpga_tpu.analysis import ir_audit
+
+    return ir_audit.audit_repo(verbose=verbose)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="repo-specific static analysis (lint + IR + ABI)"
+    )
+    ap.add_argument("paths", nargs="*", help="files to lint (default: tree)")
+    ap.add_argument("--lint", action="store_true", help="AST lint pass")
+    ap.add_argument("--abi", action="store_true", help="C-ABI cross-check")
+    ap.add_argument("--ir", action="store_true",
+                    help="IR contract audit (imports jax)")
+    ap.add_argument("--all", action="store_true", help="lint + ABI + IR")
+    ap.add_argument("--changed", action="store_true",
+                    help="git-diff-scoped fast path")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    do_lint = args.lint or args.all or args.changed or (
+        not (args.abi or args.ir)
+    )
+    do_abi = args.abi or args.all
+    do_ir = args.ir or args.all
+
+    lint = _lint_module()
+    problems = 0
+
+    if args.changed:
+        changed = changed_files()
+        if changed is None:
+            print("lint_pga: --changed needs git; falling back to full tree")
+            changed = None
+        if changed is not None:
+            py = [
+                os.path.join(REPO_ROOT, p) for p in changed
+                if p.endswith(".py") and "fixtures" not in p.split("/")
+                and os.path.exists(os.path.join(REPO_ROOT, p))
+            ]
+            lint_paths = py
+            if any(p in ABI_FILES for p in changed):
+                do_abi = True
+        else:
+            lint_paths = lint.default_paths(REPO_ROOT)
+    elif args.paths:
+        lint_paths = [os.path.abspath(p) for p in args.paths]
+    else:
+        lint_paths = lint.default_paths(REPO_ROOT)
+
+    if do_lint:
+        findings = run_lint(lint_paths, lint)
+        for f in findings:
+            print(str(f).replace(REPO_ROOT + os.sep, ""))
+        problems += len(findings)
+        if args.verbose or findings:
+            print(
+                f"lint: {len(findings)} finding(s) across "
+                f"{len(lint_paths)} file(s)"
+            )
+
+    if do_abi:
+        abi = _abi_module()
+        findings = abi.check_repo_abi(REPO_ROOT)
+        for f in findings:
+            print(str(f).replace(REPO_ROOT + os.sep, ""))
+        problems += len(findings)
+        if args.verbose or findings:
+            print(f"abi: {len(findings)} finding(s)")
+
+    if do_ir:
+        try:
+            ir_problems = run_ir(args.verbose)
+        except Exception as e:  # an import/lowering crash is a failure
+            print(f"ir-audit: crashed: {type(e).__name__}: {e}")
+            return 2
+        for p in ir_problems:
+            print(f"ir-audit: {p}")
+        problems += len(ir_problems)
+        if args.verbose or ir_problems:
+            print(f"ir: {len(ir_problems)} problem(s)")
+
+    if problems:
+        print(f"lint_pga: {problems} problem(s)")
+        return 1
+    print("lint_pga: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
