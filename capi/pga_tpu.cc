@@ -273,18 +273,21 @@ int pga_set_fault_plan(const char *json_spec) {
         "set_fault_plan", "(s)", json_spec ? json_spec : ""));
 }
 
-pga_ticket_t *pga_submit(pga_t *p, unsigned n, float target) {
+pga_ticket_t *pga_submit(pga_t *p, unsigned n, float target,
+                         const char *tenant) {
     if (!p) return nullptr;
-    long tid = call_long("submit", "(lIif)", solver_of(p), n, 1,
-                         static_cast<double>(target));
+    long tid = call_long("submit", "(lIifs)", solver_of(p), n, 1,
+                         static_cast<double>(target),
+                         tenant ? tenant : "");
     return tid <= 0 ? nullptr
                     : reinterpret_cast<pga_ticket_t *>(
                           static_cast<intptr_t>(tid));
 }
 
-pga_ticket_t *pga_submit_n(pga_t *p, unsigned n) {
+pga_ticket_t *pga_submit_n(pga_t *p, unsigned n, const char *tenant) {
     if (!p) return nullptr;
-    long tid = call_long("submit", "(lIif)", solver_of(p), n, 0, 0.0);
+    long tid = call_long("submit", "(lIifs)", solver_of(p), n, 0, 0.0,
+                         tenant ? tenant : "");
     return tid <= 0 ? nullptr
                     : reinterpret_cast<pga_ticket_t *>(
                           static_cast<intptr_t>(tid));
@@ -366,9 +369,10 @@ int pga_fleet_start(const char *spool_dir, const char *objective,
 
 pga_fleet_ticket_t *pga_fleet_submit(unsigned size, unsigned genome_len,
                                      unsigned n, long seed,
-                                     unsigned checkpoint_every) {
-    long tid = call_long("fleet_submit", "(IIIlI)", size, genome_len, n,
-                         seed, checkpoint_every);
+                                     unsigned checkpoint_every,
+                                     const char *tenant) {
+    long tid = call_long("fleet_submit", "(IIIlIs)", size, genome_len, n,
+                         seed, checkpoint_every, tenant ? tenant : "");
     return tid <= 0 ? nullptr
                     : reinterpret_cast<pga_fleet_ticket_t *>(
                           static_cast<intptr_t>(tid));
@@ -510,10 +514,12 @@ static long session_of(pga_session_t *s) {
 }
 
 pga_session_t *pga_session_open(const char *objective, unsigned size,
-                                unsigned genome_len, long seed) {
+                                unsigned genome_len, long seed,
+                                const char *tenant) {
     if (!objective || !size || !genome_len) return nullptr;
-    return pack_session(call_long("session_open", "(sIIl)", objective,
-                                  size, genome_len, seed));
+    return pack_session(call_long("session_open", "(sIIls)", objective,
+                                  size, genome_len, seed,
+                                  tenant ? tenant : ""));
 }
 
 long pga_session_ask(pga_session_t *s, float *out, unsigned k) {

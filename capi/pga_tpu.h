@@ -349,10 +349,25 @@ int pga_set_pop_shards(pga_t *p, unsigned shards);
  * pga_serving_config adjusts the process-global queue (applies to
  * subsequent submissions): max_batch requests per bucket launch,
  * max_wait_ms accumulation window (0 = launch only when a bucket
- * fills or an await forces the flush). Returns 0, -1 on error. */
+ * fills or an await forces the flush). Returns 0, -1 on error.
+ *
+ * TENANT ATTRIBUTION (ISSUE 14): every submission entry point takes a
+ * `tenant` id — NULL (or "") submits as the default "anon" tenant,
+ * preserving pre-tenancy behavior bit for bit. An explicit id must be
+ * 1-64 chars of [A-Za-z0-9_.-] not starting with '_' (the reserved
+ * library prefix); anything else fails the call. The id is host-side
+ * attribution ONLY — it never reaches a compiled program, so two
+ * tenants with equal configurations share buckets, programs, and warm
+ * engines exactly as before — but it rides every ticket's latency
+ * breakdown, trace span, event record, and the tenant-labeled metric
+ * series (serving.tenant.* / fleet.tenant.* / streaming.tenant.*)
+ * reachable through pga_metrics_snapshot and
+ * pga_fleet_metrics_snapshot, so per-tenant p99s, queue depths, and
+ * SLO burn rates can be sliced out of one snapshot. */
 typedef struct pga_ticket pga_ticket_t;
-pga_ticket_t *pga_submit(pga_t *p, unsigned n, float target);
-pga_ticket_t *pga_submit_n(pga_t *p, unsigned n);
+pga_ticket_t *pga_submit(pga_t *p, unsigned n, float target,
+                         const char *tenant);
+pga_ticket_t *pga_submit_n(pga_t *p, unsigned n, const char *tenant);
 int pga_poll(pga_ticket_t *t);
 int pga_await(pga_ticket_t *t);
 int pga_serving_config(unsigned max_batch, float max_wait_ms);
@@ -414,7 +429,11 @@ long pga_metrics_snapshot(char *buf, unsigned long cap);
  * from `seed`, `n` generations); `checkpoint_every` > 0 makes the
  * ticket SUPERVISED — executed under the supervisor at that
  * auto-checkpoint cadence, so drains and worker deaths resume it from
- * the last durable chunk boundary. Returns a ticket or NULL.
+ * the last durable chunk boundary. `tenant` attributes the ticket
+ * (NULL = "anon"; see the tenant-attribution block above) — the id
+ * rides the batch file to the worker and back in the result meta, so
+ * the merged fleet snapshot carries per-tenant latency histograms,
+ * queue gauges, and burn-rate series. Returns a ticket or NULL.
  *
  * pga_fleet_await blocks (up to timeout_s; <= 0 = forever) for one
  * ticket, releases it, writes the best objective value into *best
@@ -455,7 +474,8 @@ int pga_fleet_start(const char *spool_dir, const char *objective,
                     float max_wait_ms);
 pga_fleet_ticket_t *pga_fleet_submit(unsigned size, unsigned genome_len,
                                      unsigned n, long seed,
-                                     unsigned checkpoint_every);
+                                     unsigned checkpoint_every,
+                                     const char *tenant);
 int pga_fleet_await(pga_fleet_ticket_t *t, float *best, double timeout_s);
 int pga_fleet_await_ex(pga_fleet_ticket_t *t, float *best,
                        float latency_ms[6], double timeout_s);
@@ -544,9 +564,11 @@ int pga_set_objective_sr(pga_t *p, const float *X, const float *y,
  * signature compiles 0 programs.
  *
  * pga_session_open creates a session of a fresh size x genome_len
- * population from `seed` over the named builtin objective. Returns a
- * session or NULL. A step-only session is bit-identical to pga_run on
- * a same-seed solver.
+ * population from `seed` over the named builtin objective; `tenant`
+ * attributes the session, its warm-pool hit/miss, and every
+ * ask/tell/step metric (NULL = "anon"; see the tenant-attribution
+ * block above). Returns a session or NULL. A step-only session is
+ * bit-identical to pga_run on a same-seed solver.
  *
  * pga_session_ask writes k candidate genomes (k * genome_len floats,
  * row-major) into out; returns k, negative on error. Candidates are
@@ -584,7 +606,8 @@ int pga_set_objective_sr(pga_t *p, const float *X, const float *y,
  * opened session, which is exactly the race the contract covers. */
 typedef struct pga_session pga_session_t;
 pga_session_t *pga_session_open(const char *objective, unsigned size,
-                                unsigned genome_len, long seed);
+                                unsigned genome_len, long seed,
+                                const char *tenant);
 long pga_session_ask(pga_session_t *s, float *out, unsigned k);
 int pga_session_tell(pga_session_t *s, const float *genomes,
                      const float *fitness, unsigned k);

@@ -56,9 +56,10 @@ int main(void) {
     }
 
     /* Submit NSOLVERS-1 runs: bucket below max_batch, so everything
-     * must still be pending. */
+     * must still be pending. NULL tenant = the "anon" default; the
+     * two-tenant attribution leg runs below. */
     for (int i = 0; i < NSOLVERS - 1; i++) {
-        tickets[i] = pga_submit_n(solvers[i], GENS);
+        tickets[i] = pga_submit_n(solvers[i], GENS, NULL);
         if (!tickets[i])
             return fprintf(stderr, "pga_submit %d failed\n", i), 1;
         if (pga_poll(tickets[i]) != 0)
@@ -66,7 +67,7 @@ int main(void) {
     }
 
     /* The filling submission launches the bucket: every ticket done. */
-    tickets[NSOLVERS - 1] = pga_submit_n(solvers[NSOLVERS - 1], GENS);
+    tickets[NSOLVERS - 1] = pga_submit_n(solvers[NSOLVERS - 1], GENS, NULL);
     if (!tickets[NSOLVERS - 1])
         return fprintf(stderr, "filling pga_submit failed\n"), 1;
     for (int i = 0; i < NSOLVERS; i++)
@@ -108,7 +109,7 @@ int main(void) {
 
     /* A run with an unreachable-from-start target must also terminate
      * early identically: target barely above the initial best. */
-    pga_ticket_t *t = pga_submit(solvers[1], 200, (float)LEN);
+    pga_ticket_t *t = pga_submit(solvers[1], 200, (float)LEN, NULL);
     if (!t) return fprintf(stderr, "target submit failed\n"), 1;
     int gens = pga_await(t); /* await forces the flush */
     if (gens < 0 || gens > 200)
@@ -142,8 +143,35 @@ int main(void) {
         return fprintf(stderr, "NULL ticket await not rejected\n"), 1;
     if (pga_await(tickets[0]) >= 0) /* already awaited: released */
         return fprintf(stderr, "double await not rejected\n"), 1;
-    if (pga_submit_n(NULL, 5) != NULL)
+    if (pga_submit_n(NULL, 5, NULL) != NULL)
         return fprintf(stderr, "NULL solver submit not rejected\n"), 1;
+
+    /* Two-tenant attribution leg (ISSUE 14): submit one run per tenant
+     * and check the metrics snapshot carries a per-tenant slice for
+     * each — the tenant id is host-side labeling only, so these runs
+     * share the warm bucket program compiled above. An ill-formed
+     * tenant id must be rejected at submit. */
+    {
+        pga_ticket_t *ta = pga_submit_n(solvers[1], GENS, "tenant-a");
+        pga_ticket_t *tb = pga_submit_n(solvers[2], GENS, "tenant-b");
+        if (!ta || !tb)
+            return fprintf(stderr, "tenant submit failed\n"), 1;
+        if (pga_await(ta) != GENS || pga_await(tb) != GENS)
+            return fprintf(stderr, "tenant await failed\n"), 1;
+        if (pga_submit_n(solvers[2], GENS, "bad tenant!") != NULL)
+            return fprintf(stderr, "ill-formed tenant not rejected\n"), 1;
+        long tneed = pga_metrics_snapshot(NULL, 0);
+        unsigned long tcap = (unsigned long)tneed + 4096;
+        char *tjson = (char *)malloc(tcap);
+        if (!tjson) return fprintf(stderr, "malloc failed\n"), 1;
+        long tgot = pga_metrics_snapshot(tjson, tcap);
+        if (tgot <= 0 || (unsigned long)tgot >= tcap)
+            return fprintf(stderr, "tenant metrics read %ld\n", tgot), 1;
+        if (!strstr(tjson, "serving.tenant.e2e_ms") ||
+            !strstr(tjson, "tenant-a") || !strstr(tjson, "tenant-b"))
+            return fprintf(stderr, "snapshot missing tenant slices\n"), 1;
+        free(tjson);
+    }
 
     /* Cross-process serving fleet (ISSUE 8): start a 2-worker fleet on
      * a private spool, submit a plain and a supervised ticket, await
@@ -157,8 +185,13 @@ int main(void) {
             return fprintf(stderr, "mkdtemp failed\n"), 1;
         if (pga_fleet_start(spool, "onemax", 2, 2, 5.0f) != 0)
             return fprintf(stderr, "pga_fleet_start failed\n"), 1;
-        pga_fleet_ticket_t *f1 = pga_fleet_submit(POP, LEN, GENS, 42, 0);
-        pga_fleet_ticket_t *f2 = pga_fleet_submit(POP, LEN, 2 * GENS, 43, GENS);
+        /* Two tenants through the fleet (ISSUE 14): the ids ride the
+         * batch files to the workers and back in the result metas, so
+         * the merged snapshot below must carry both tenant slices. */
+        pga_fleet_ticket_t *f1 =
+            pga_fleet_submit(POP, LEN, GENS, 42, 0, "fleet-ten-a");
+        pga_fleet_ticket_t *f2 =
+            pga_fleet_submit(POP, LEN, 2 * GENS, 43, GENS, "fleet-ten-b");
         if (!f1 || !f2)
             return fprintf(stderr, "pga_fleet_submit failed\n"), 1;
         /* Ticket 1 through the observability-extended await (ISSUE 9):
@@ -212,6 +245,14 @@ int main(void) {
                 !strstr(fjson, "coordinator"))
                 return fprintf(stderr,
                                "fleet snapshot missing merged series\n"),
+                       1;
+            /* Per-tenant slice (ISSUE 14): both tenants' series must
+             * be reachable through the merged snapshot. */
+            if (!strstr(fjson, "fleet.tenant.e2e_ms") ||
+                !strstr(fjson, "fleet-ten-a") ||
+                !strstr(fjson, "fleet-ten-b"))
+                return fprintf(stderr,
+                               "fleet snapshot missing tenant slices\n"),
                        1;
             free(fjson);
         }
@@ -315,7 +356,8 @@ int main(void) {
      * reuse path, and the sized-snapshot RETRY-ONCE contract. */
     {
         enum { SPOP = 256, SLEN = 16 };
-        pga_session_t *sess = pga_session_open("onemax", SPOP, SLEN, 7);
+        pga_session_t *sess =
+            pga_session_open("onemax", SPOP, SLEN, 7, "stream-ten-a");
         if (!sess) return fprintf(stderr, "pga_session_open failed\n"), 1;
 
         /* ask before any fitness: k rows of the initial population. */
@@ -341,7 +383,8 @@ int main(void) {
 
         /* A step-only session is bit-identical to pga_run: drive a
          * second session and a same-seed solver side by side. */
-        pga_session_t *only = pga_session_open("onemax", SPOP, SLEN, 9);
+        pga_session_t *only =
+            pga_session_open("onemax", SPOP, SLEN, 9, "stream-ten-b");
         population_t *rpop2;
         pga_t *ref2 = make_solver(9, &rpop2);
         if (!only || !ref2)
@@ -405,7 +448,8 @@ int main(void) {
         long need = pga_session_snapshot(NULL, 0);
         if (need <= 0)
             return fprintf(stderr, "session snapshot size %ld\n", need), 1;
-        pga_session_t *grow = pga_session_open("onemax", SPOP, SLEN, 11);
+        pga_session_t *grow =
+            pga_session_open("onemax", SPOP, SLEN, 11, NULL);
         if (!grow) return fprintf(stderr, "growth session failed\n"), 1;
         {
             char *json = (char *)malloc((unsigned long)need + 1);
@@ -419,6 +463,12 @@ int main(void) {
             if (json[0] != '{' || json[got] != '\0' ||
                 !strstr(json, "\"pool\""))
                 return fprintf(stderr, "session snapshot malformed\n"), 1;
+            /* Tenant attribution rides the session records (ISSUE 14). */
+            if (!strstr(json, "stream-ten-a") ||
+                !strstr(json, "stream-ten-b"))
+                return fprintf(stderr,
+                               "session snapshot missing tenants\n"),
+                       1;
             free(json);
         }
         {

@@ -978,10 +978,14 @@ def _serving_executor(handle: int):
     return ex
 
 
-def submit(handle: int, n: int, has_target: int, target: float) -> int:
+def submit(
+    handle: int, n: int, has_target: int, target: float,
+    tenant: str = "",
+) -> int:
     """``pga_submit``: admit an async run of the solver's FIRST
     population (the population pga_run operates on) and return a
-    ticket id (> 0)."""
+    ticket id (> 0). ``tenant`` attributes the ticket (ISSUE 14);
+    the empty string — the C side's NULL — submits as ``anon``."""
     global _next_ticket
     from libpga_tpu.serving.batch import RunRequest
 
@@ -1003,7 +1007,9 @@ def submit(handle: int, n: int, has_target: int, target: float) -> int:
         mutation_rate=float(mp[0, 0]),
         mutation_sigma=float(mp[0, 1]),
     )
-    ticket = _get_serving_queue().submit(req, executor=ex)
+    ticket = _get_serving_queue().submit(
+        req, executor=ex, tenant=tenant or None
+    )
     tid = _next_ticket
     _next_ticket += 1
     _tickets[tid] = (handle, 0, ticket, pga)
@@ -1141,11 +1147,13 @@ def fleet_start(
 
 
 def fleet_submit(
-    size: int, genome_len: int, n: int, seed: int, checkpoint_every: int
+    size: int, genome_len: int, n: int, seed: int,
+    checkpoint_every: int, tenant: str = "",
 ) -> int:
     """``pga_fleet_submit``: admit one ticket to the process-global
     fleet; returns a ticket id (> 0). ``checkpoint_every`` > 0 makes
-    the ticket supervised (drain-safe at that cadence)."""
+    the ticket supervised (drain-safe at that cadence). ``tenant``
+    attributes it (ISSUE 14; empty string = ``anon``)."""
     global _next_fleet_ticket
     from libpga_tpu.serving.fleet import FleetTicket
 
@@ -1154,6 +1162,7 @@ def fleet_submit(
     handle = _fleet.submit(FleetTicket(
         size=int(size), genome_len=int(genome_len), n=int(n),
         seed=int(seed), checkpoint_every=int(checkpoint_every),
+        tenant=tenant or None,
     ))
     tid = _next_fleet_ticket
     _next_fleet_ticket += 1
@@ -1259,13 +1268,17 @@ def _session(handle: int):
 
 
 def session_open(
-    objective: str, size: int, genome_len: int, seed: int
+    objective: str, size: int, genome_len: int, seed: int,
+    tenant: str = "",
 ) -> int:
     """``pga_session_open``: a warm streaming session over a named
-    builtin objective. Returns a session handle (> 0)."""
+    builtin objective. Returns a session handle (> 0). ``tenant``
+    attributes the session and its warm-pool traffic (ISSUE 14;
+    empty string = ``anon``)."""
     global _next_session_handle
     session = _session_pool().acquire(
-        objective, int(size), int(genome_len), seed=int(seed)
+        objective, int(size), int(genome_len), seed=int(seed),
+        tenant=tenant or None,
     )
     handle = _next_session_handle
     _next_session_handle += 1
@@ -1369,6 +1382,7 @@ def session_snapshot_json(cap: int = 0) -> bytes:
             sessions.append({
                 "handle": handle,
                 "session": s.sid,
+                "tenant": s.tenant,
                 "population_size": s.size,
                 "genome_len": s.genome_len,
                 "gens_done": s.gens_done,

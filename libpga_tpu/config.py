@@ -501,6 +501,54 @@ class StreamingConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class BurnRateConfig:
+    """Multi-window error-budget burn-rate alerting (ISSUE 14) —
+    the SRE alerting shape applied to per-tenant latency objectives.
+
+    Each completed request either met ``objective_ms`` (end-to-end
+    latency) or violated it; ``budget`` is the fraction of requests
+    allowed to violate. The burn rate over a window is the observed
+    violation rate divided by the budget, and an ``slo_burn`` alert
+    fires when BOTH the fast window (catches sharp regressions
+    quickly) and the slow window (confirms they are sustained) burn at
+    >= ``threshold`` — fast to fire, slow to flap. Evaluated by
+    :class:`~libpga_tpu.utils.metrics.BurnRateMonitor` on the serving
+    queue and fleet coordinator readback paths; burn rates export as
+    ``*.tenant.slo_burn{tenant=,window=}`` gauges either way, alerts
+    additionally emit one schema-valid ``slo_burn`` event per
+    excursion (transition-edge, re-armed on recovery).
+
+    Attributes:
+      objective_ms: per-request end-to-end latency objective whose
+        violations consume the error budget.
+      budget: allowed violation fraction (0 < budget <= 1).
+      fast_window_s / slow_window_s: the two alerting windows.
+      threshold: burn-rate multiple (in both windows) that alerts.
+      min_samples: slow-window observations required before alerting —
+        a burn rate over three requests is noise, not an incident.
+    """
+
+    objective_ms: float = 1000.0
+    budget: float = 0.01
+    fast_window_s: float = 300.0
+    slow_window_s: float = 3600.0
+    threshold: float = 10.0
+    min_samples: int = 20
+
+    def __post_init__(self):
+        if self.objective_ms <= 0:
+            raise ValueError("objective_ms must be > 0")
+        if not (0.0 < self.budget <= 1.0):
+            raise ValueError("budget must be in (0, 1]")
+        if not (0.0 < self.fast_window_s <= self.slow_window_s):
+            raise ValueError("need 0 < fast_window_s <= slow_window_s")
+        if self.threshold <= 0:
+            raise ValueError("threshold must be > 0")
+        if self.min_samples < 1:
+            raise ValueError("min_samples must be >= 1")
+
+
+@dataclasses.dataclass(frozen=True)
 class SLOConfig:
     """Latency service-level objectives for the serving queue (ISSUE 6).
 
@@ -517,6 +565,18 @@ class SLOConfig:
       (meaningful once ``min_samples`` tickets completed — a p99 over
       three tickets is noise, not an objective).
 
+    Per-tenant attribution (ISSUE 14) adds two layers:
+
+    - **tenants**: a mapping of tenant id -> :class:`SLOConfig`
+      overriding this config for that tenant's tickets
+      (:meth:`for_tenant` resolves; overrides must not nest).
+      ``RunQueue.check_slo(tenant=...)`` / ``Fleet.check_slo(tenant=
+      ...)`` check the TENANT-LABELED latency histogram against the
+      resolved objective.
+    - **burn**: a :class:`BurnRateConfig` enabling the multi-window
+      error-budget burn-rate monitor over per-tenant request
+      outcomes (``slo_burn`` events + ``*.tenant.slo_burn`` gauges).
+
     ``tools/serving_throughput.py --slo`` turns violations into a
     nonzero exit — the CI/SLO gate; ``None`` fields are unchecked.
     """
@@ -524,6 +584,8 @@ class SLOConfig:
     p99_latency_ms: Optional[float] = None
     max_queue_wait_ms: Optional[float] = None
     min_samples: int = 20
+    tenants: Optional[dict] = None
+    burn: Optional[BurnRateConfig] = None
 
     def __post_init__(self):
         if self.p99_latency_ms is not None and self.p99_latency_ms <= 0:
@@ -535,3 +597,29 @@ class SLOConfig:
             raise ValueError("max_queue_wait_ms must be >= 0 or None")
         if self.min_samples < 1:
             raise ValueError("min_samples must be >= 1")
+        if self.tenants is not None:
+            for tenant, cfg in self.tenants.items():
+                if not isinstance(cfg, SLOConfig):
+                    raise ValueError(
+                        f"tenants[{tenant!r}] must be an SLOConfig"
+                    )
+                if cfg.tenants is not None:
+                    raise ValueError(
+                        f"tenants[{tenant!r}]: per-tenant overrides "
+                        "must not nest further overrides"
+                    )
+        if self.burn is not None and not isinstance(
+            self.burn, BurnRateConfig
+        ):
+            raise ValueError("burn must be a BurnRateConfig or None")
+
+    def for_tenant(self, tenant: Optional[str]) -> "SLOConfig":
+        """The SLO governing one tenant: its override when present
+        (inheriting this config's ``burn`` unless the override carries
+        its own), else this config unchanged."""
+        if not self.tenants or tenant not in self.tenants:
+            return self
+        override = self.tenants[tenant]
+        if override.burn is None and self.burn is not None:
+            override = dataclasses.replace(override, burn=self.burn)
+        return override

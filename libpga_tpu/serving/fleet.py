@@ -91,9 +91,10 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from libpga_tpu.config import FleetConfig, PGAConfig
-from libpga_tpu.serving.queue import QueueFull
+from libpga_tpu.serving.queue import QueueFull, TenantBurnTracker
 from libpga_tpu.utils import metrics as _metrics
 from libpga_tpu.utils import telemetry as _tl
+from libpga_tpu.utils.tenancy import ANON, validate_tenant
 from libpga_tpu.utils.telemetry import TelemetryConfig
 
 
@@ -341,6 +342,33 @@ def _counter_total(merged: dict, name: str) -> int:
     )
 
 
+def _tenant_counter_totals(merged: dict, name: str) -> Dict[str, int]:
+    """Per-tenant totals of one tenant-labeled counter across all
+    processes of a merged snapshot."""
+    out: Dict[str, int] = {}
+    for rec in merged.get("counters", ()):
+        if rec["name"] != name:
+            continue
+        tenant = rec.get("labels", {}).get("tenant")
+        if tenant is not None:
+            out[tenant] = out.get(tenant, 0) + int(rec["value"])
+    return out
+
+
+def _tenant_hists(merged: dict, name: str) -> Dict[str, dict]:
+    """AGGREGATE (proc-free) tenant-labeled histogram records of one
+    series, keyed by tenant."""
+    out: Dict[str, dict] = {}
+    for rec in merged.get("histograms", ()):
+        labels = rec.get("labels", {})
+        if (
+            rec["name"] == name and "proc" not in labels
+            and "tenant" in labels
+        ):
+            out[labels["tenant"]] = rec
+    return out
+
+
 def _pid_alive(pid) -> Optional[bool]:
     try:
         os.kill(int(pid), 0)
@@ -362,9 +390,20 @@ def fleet_status(
     spool = Spool(spool_dir)
     now_wall = _tl.anchored_wall()
     pending = []
+    tenant_depth: Dict[str, Dict[str, int]] = {}
+
+    def _tally(batch: Optional[dict], state: str) -> None:
+        for t in () if batch is None else batch.get("tickets", ()):
+            tenant = t.get("tenant", ANON)
+            d = tenant_depth.setdefault(
+                tenant, {"pending": 0, "claimed": 0}
+            )
+            d[state] += 1
+
     for name in spool.pending_batches():
         batch = Spool.read_json(spool.path("pending", name))
         formed = None if batch is None else batch.get("formed_at")
+        _tally(batch, "pending")
         pending.append({
             "batch": name,
             "tickets": 0 if batch is None else len(batch.get("tickets", ())),
@@ -377,6 +416,7 @@ def fleet_status(
         })
     claimed = []
     for name in spool.claimed_batches():
+        _tally(Spool.read_json(spool.path("claimed", name)), "claimed")
         lease = Spool.read_json(spool.lease_path(name))
         try:
             age = max(time.time() - os.stat(spool.lease_path(name)).st_mtime,
@@ -456,6 +496,52 @@ def fleet_status(
                 "p50_ms": rec["p50"], "p95_ms": rec["p95"],
                 "p99_ms": rec["p99"], "count": rec["count"],
             }
+
+    # Per-tenant view (ISSUE 14) — assembled from the spool alone:
+    # queue depth from the batch files' ticket tenants, completions /
+    # dead letters from the merged tenant-labeled counters, latency
+    # percentiles from the merged tenant-labeled histograms, and the
+    # burn-rate gauges from the coordinator's latest flush. Live fleet
+    # or dead-spool post-mortem, same math.
+    tenants: Dict[str, dict] = {}
+
+    def _trec(tenant: str) -> dict:
+        return tenants.setdefault(tenant, {
+            "pending": 0, "claimed": 0, "submitted": 0, "completed": 0,
+            "dead_letters": 0, "e2e": None, "spool_wait": None,
+            "burn": {}, "burn_alerts": 0,
+        })
+
+    for tenant, d in tenant_depth.items():
+        _trec(tenant).update(d)
+    for field, series in (
+        ("submitted", "fleet.tenant.submissions"),
+        ("completed", "fleet.tenant.completions"),
+        ("dead_letters", "fleet.tenant.dead_letters"),
+        ("burn_alerts", "fleet.slo_burn_alerts"),
+    ):
+        for tenant, total in _tenant_counter_totals(merged, series).items():
+            _trec(tenant)[field] = total
+    for key, series in (
+        ("e2e", "fleet.tenant.e2e_ms"),
+        ("spool_wait", "fleet.tenant.spool_wait_ms"),
+    ):
+        for tenant, rec in _tenant_hists(merged, series).items():
+            if rec["count"]:
+                _trec(tenant)[key] = {
+                    "p50_ms": rec["p50"], "p95_ms": rec["p95"],
+                    "p99_ms": rec["p99"], "count": rec["count"],
+                }
+    for rec in merged.get("gauges", ()):
+        labels = rec.get("labels", {})
+        if (
+            rec["name"] == "fleet.tenant.slo_burn"
+            and labels.get("proc") == "coordinator"
+        ):
+            _trec(labels["tenant"])["burn"][labels.get("window", "?")] = (
+                float(rec["value"])
+            )
+
     return {
         "spool": spool.root,
         "ts": now_wall,
@@ -467,6 +553,7 @@ def fleet_status(
         },
         "workers": workers,
         "latency": latency,
+        "tenants": tenants,
         "counters": {
             "worker_deaths": _counter_total(merged, "fleet.worker.deaths"),
             "lease_requeues": _counter_total(merged, "fleet.lease.requeues"),
@@ -539,7 +626,14 @@ class FleetTicket:
     checkpoint in the spool, so drains and deaths resume from the last
     chunk boundary. ``max_retries`` bounds the supervisor's in-worker
     retries; failures beyond it escalate to a worker death and the
-    fleet's lease-requeue path."""
+    fleet's lease-requeue path.
+
+    ``tenant`` (ISSUE 14) attributes the ticket: it rides the batch
+    file to the worker (so worker-side serving metrics are
+    tenant-labeled), comes back in the result meta and every trace
+    span, and drives the coordinator's per-tenant latency/burn
+    accounting. ``None`` → the default ``anon`` tenant; explicit ids
+    are validated label-safe here, at the submit boundary."""
 
     size: int
     genome_len: int
@@ -550,6 +644,7 @@ class FleetTicket:
     mutation_sigma: Optional[float] = None
     checkpoint_every: int = 0
     max_retries: int = 1
+    tenant: Optional[str] = None
 
     def __post_init__(self):
         if self.size < 1 or self.genome_len < 1:
@@ -562,6 +657,7 @@ class FleetTicket:
             raise ValueError("checkpoint_every must be >= 0")
         if self.max_retries < 0:
             raise ValueError("max_retries must be >= 0")
+        object.__setattr__(self, "tenant", validate_tenant(self.tenant))
 
 
 class FleetResult:
@@ -759,6 +855,17 @@ class Fleet:
         self._last_flush = 0.0
         self._lease_gauged: set = set()
         self._stragglers: set = set()
+        # Tenant attribution (ISSUE 14): ids seen (one tenant_admit
+        # each), per-tenant submitted/completed tallies behind the
+        # fleet.tenant.outstanding gauges (the fairness signal ROADMAP
+        # item 1 consumes), and the fleet-level error-budget burn
+        # tracker over coordinator readbacks.
+        self._tenants_seen: set = set()
+        self._tenant_submitted: Dict[str, int] = {}
+        self._tenant_completed: Dict[str, int] = {}
+        self.burn = TenantBurnTracker(
+            self.slo, self.registry, self._emit, "fleet"
+        )
 
     # --------------------------------------------------------------- events
 
@@ -864,13 +971,22 @@ class Fleet:
         # ticket's latency to an undrainable dispatch.
         return (t.size, t.genome_len, t.checkpoint_every > 0)
 
-    def submit(self, ticket: FleetTicket) -> FleetHandle:
+    def submit(
+        self, ticket: FleetTicket, tenant: Optional[str] = None
+    ) -> FleetHandle:
         """Admit one ticket; returns its handle. Applies the fleet-wide
         backpressure policy first, then buckets the ticket; the bucket
         becomes a claimable batch file at ``max_batch`` tickets or
-        ``max_wait_ms`` after its oldest admission."""
+        ``max_wait_ms`` after its oldest admission. ``tenant`` (ISSUE
+        14) overrides the ticket's own tenant field — either way the
+        id is validated label-safe and rides the batch file, result
+        meta, spans, and every per-tenant metric series."""
         if self._closed:
             raise RuntimeError("fleet is closed")
+        if tenant is not None:
+            ticket = dataclasses.replace(
+                ticket, tenant=validate_tenant(tenant)
+            )
         self._admit_slot()
         with self._lock:
             self._tid_seq += 1
@@ -887,18 +1003,42 @@ class Fleet:
                 bucket.oldest = _now()
             bucket.tickets.append((tid, ticket))
             self.submitted += 1
+            t_id = ticket.tenant
+            if t_id not in self._tenants_seen:
+                self._tenants_seen.add(t_id)
+                self._emit("tenant_admit", tenant=t_id, where="fleet")
+            self._tenant_submitted[t_id] = (
+                self._tenant_submitted.get(t_id, 0) + 1
+            )
+            self.registry.counter(
+                "fleet.tenant.submissions", tenant=t_id
+            ).bump()
             self._emit(
                 "batch_admit", bucket=f"{ticket.size}x{ticket.genome_len}",
                 pending=len(bucket.tickets), population_size=ticket.size,
-                genome_len=ticket.genome_len,
+                genome_len=ticket.genome_len, tenant=t_id,
             )
             if len(bucket.tickets) >= self.fleet.max_batch:
                 self._form_batch(key)
         self.registry.gauge("fleet.tickets.outstanding").set(
             self._outstanding()
         )
+        self._tenant_outstanding_gauge(ticket.tenant)
         self._ensure_monitor()
         return handle
+
+    def _tenant_outstanding_gauge(self, tenant: str) -> None:
+        """Refresh one tenant's pending-work gauge — the per-tenant
+        depth signal the elastic-fleet fairness work (ROADMAP item 1)
+        schedules against."""
+        with self._lock:
+            n = (
+                self._tenant_submitted.get(tenant, 0)
+                - self._tenant_completed.get(tenant, 0)
+            )
+        self.registry.gauge(
+            "fleet.tenant.outstanding", tenant=tenant
+        ).set(max(n, 0))
 
     def flush(self) -> int:
         """Write every non-empty bucket out as a pending batch file now
@@ -950,7 +1090,7 @@ class Fleet:
             # durable BEFORE any worker can claim, so a post-mortem of
             # a fleet that died right here still has the trace head.
             tp = self.spool.trace_path(name)
-            for tid, _ in tickets:
+            for tid, t in tickets:
                 h = self._handles.get(tid)
                 if h is None:
                     continue
@@ -959,6 +1099,7 @@ class Fleet:
                 _tl.append_trace(tp, _tl.trace_span_record(
                     "intake", h._submit_wall, formed, tid=tid,
                     trace_id=h.trace_id, batch=name, role="coordinator",
+                    tenant=t.tenant,
                 ))
         else:
             for tid, _ in tickets:
@@ -1058,6 +1199,7 @@ class Fleet:
         }
         breakdown["e2e_ms"] = ms(edges[0], edges[-1])
         handle._breakdown = breakdown
+        tenant = handle.ticket.tenant
         for span in FLEET_SPANS:
             v = breakdown[f"{span}_ms"]
             if v is not None:
@@ -1066,14 +1208,25 @@ class Fleet:
             self.registry.histogram("fleet.ticket.e2e_ms").observe(
                 breakdown["e2e_ms"]
             )
+        # Tenant-labeled twins (ISSUE 14): e2e + spool_wait per tenant —
+        # the latency and queueing signals a per-tenant SLO needs. The
+        # aggregate series above stay label-free for every round-14
+        # consumer.
+        for name, v in (
+            ("fleet.tenant.e2e_ms", breakdown["e2e_ms"]),
+            ("fleet.tenant.spool_wait_ms", breakdown["spool_wait_ms"]),
+        ):
+            if v is not None:
+                self.registry.histogram(name, tenant=tenant).observe(v)
         self.registry.counter("fleet.tickets.traced").bump()
         self._emit(
             "fleet_ticket_done", trace_id=handle.trace_id, tid=tid,
-            worker=meta.get("worker"),
+            worker=meta.get("worker"), tenant=tenant,
             **{k: None if v is None else round(v, 3)
                for k, v in breakdown.items()},
         )
         slo = self.slo
+        tslo = None if slo is None else slo.for_tenant(tenant)
         wait = (
             None
             if breakdown["intake_ms"] is None
@@ -1081,17 +1234,18 @@ class Fleet:
             else breakdown["intake_ms"] + breakdown["spool_wait_ms"]
         )
         if (
-            slo is not None
-            and slo.max_queue_wait_ms is not None
+            tslo is not None
+            and tslo.max_queue_wait_ms is not None
             and wait is not None
-            and wait > slo.max_queue_wait_ms
+            and wait > tslo.max_queue_wait_ms
         ):
             self.registry.counter("fleet.slo_violations").bump()
             self._emit(
                 "slo_violation", what="fleet_queue_wait",
-                value_ms=round(wait, 3), limit_ms=slo.max_queue_wait_ms,
-                trace_id=handle.trace_id,
+                value_ms=round(wait, 3), limit_ms=tslo.max_queue_wait_ms,
+                trace_id=handle.trace_id, tenant=tenant,
             )
+        self.burn.observe(tenant, breakdown["e2e_ms"])
         return dict(breakdown), handle.trace()
 
     # -------------------------------------------------------------- monitor
@@ -1132,6 +1286,7 @@ class Fleet:
         # the completion from this accounting (undercounting
         # ``completed`` and over-tightening max_pending backpressure).
         fresh = False
+        fresh_tenants: set = set()
         for tid in list(self._handles):
             if tid in self._counted:
                 continue
@@ -1141,10 +1296,21 @@ class Fleet:
                 self._counted.add(tid)
                 self.completed += 1
                 self.registry.counter("fleet.tickets.completed").bump()
+                tenant = self._handles[tid].ticket.tenant
+                fresh_tenants.add(tenant)
+                with self._lock:
+                    self._tenant_completed[tenant] = (
+                        self._tenant_completed.get(tenant, 0) + 1
+                    )
+                self.registry.counter(
+                    "fleet.tenant.completions", tenant=tenant
+                ).bump()
         if fresh:
             self.registry.gauge("fleet.tickets.outstanding").set(
                 self._outstanding()
             )
+            for tenant in fresh_tenants:
+                self._tenant_outstanding_gauge(tenant)
             with self._cv:
                 self._cv.notify_all()
         # 3. Worker liveness: a worker that EXITED while holding a lease
@@ -1309,6 +1475,10 @@ class Fleet:
         )
         for t in unfinished:
             self._publish_error(t["tid"], error)
+            self.registry.counter(
+                "fleet.tenant.dead_letters",
+                tenant=t.get("tenant", ANON),
+            ).bump()
         self.registry.counter("fleet.dead_letters").bump()
         self._emit("dead_letter", bucket=name, error=error)
         _tl.FLIGHT.dump(
@@ -1409,27 +1579,50 @@ class Fleet:
                 self._stragglers.discard(wid)
         return alerts
 
-    def check_slo(self, slo=None) -> List[dict]:
+    def check_slo(self, slo=None, tenant: Optional[str] = None) -> List[dict]:
         """Fleet-level aggregate SLO check: the coordinator's merged
         end-to-end ticket latency histogram's p99 against
         ``slo.p99_latency_ms`` (skipped below ``min_samples``), the
-        same contract as ``RunQueue.check_slo`` one level up. Returns
-        violation dicts; each emits one ``slo_violation`` event."""
+        same contract as ``RunQueue.check_slo`` one level up. With
+        ``tenant`` given (ISSUE 14), checks that tenant's LABELED
+        latency histogram against its resolved override and counts an
+        active burn-rate excursion as a violation. Returns violation
+        dicts; each emits one ``slo_violation`` event."""
         slo = slo or self.slo
         if slo is None:
             return []
         violations: List[dict] = []
-        if slo.p99_latency_ms is not None:
+        if tenant is not None:
+            tenant = validate_tenant(tenant)
+            slo = slo.for_tenant(tenant)
+            snap = self.registry.histogram(
+                "fleet.tenant.e2e_ms", tenant=tenant
+            ).snapshot()
+            what = "fleet_tenant_p99_latency"
+        else:
             snap = self.registry.histogram("fleet.ticket.e2e_ms").snapshot()
-            if snap.count >= slo.min_samples:
-                p99 = snap.percentile(99.0)
-                if p99 > slo.p99_latency_ms:
-                    violations.append({
-                        "what": "fleet_p99_latency",
-                        "value_ms": round(p99, 3),
-                        "limit_ms": slo.p99_latency_ms,
-                        "samples": snap.count,
-                    })
+            what = "fleet_p99_latency"
+        if slo.p99_latency_ms is not None and snap.count >= slo.min_samples:
+            p99 = snap.percentile(99.0)
+            if p99 > slo.p99_latency_ms:
+                v = {
+                    "what": what,
+                    "value_ms": round(p99, 3),
+                    "limit_ms": slo.p99_latency_ms,
+                    "samples": snap.count,
+                }
+                if tenant is not None:
+                    v["tenant"] = tenant
+                violations.append(v)
+        if tenant is not None:
+            mon = self.burn.monitors.get(tenant)
+            if mon is not None and mon.alerting(tenant):
+                b = mon.burn(tenant)
+                violations.append({
+                    "what": "fleet_tenant_burn_rate", "tenant": tenant,
+                    "value_ms": round(b["fast_burn"], 4),
+                    "limit_ms": mon.threshold,
+                })
         for v in violations:
             self.registry.counter("fleet.slo_violations").bump()
             self._emit("slo_violation", **v)

@@ -39,6 +39,7 @@ from libpga_tpu.robustness import faults as _faults
 from libpga_tpu.serving.batch import BatchedRuns, RunRequest, RunResult
 from libpga_tpu.utils import metrics as _metrics
 from libpga_tpu.utils import telemetry as _tl
+from libpga_tpu.utils.tenancy import ANON, validate_tenant
 
 
 class QueueFull(RuntimeError):
@@ -59,6 +60,11 @@ class TicketTiming:
     failure point — its post-mortem is exactly these timestamps.
     Derived spans are in milliseconds and ``None`` while the
     corresponding transition hasn't happened.
+
+    ``tenant`` (ISSUE 14) is the submitting tenant's validated id —
+    stamped at submit so every downstream consumer of this breakdown
+    (``ticket_done`` events, worker result metas, flight dumps) can be
+    sliced by tenant without a join.
     """
 
     submitted: Optional[float] = None
@@ -66,6 +72,7 @@ class TicketTiming:
     launched: Optional[float] = None
     completed: Optional[float] = None
     readback: Optional[float] = None
+    tenant: str = ANON
 
     @staticmethod
     def _ms(a: Optional[float], b: Optional[float]) -> Optional[float]:
@@ -89,6 +96,9 @@ class TicketTiming:
         return self._ms(self.submitted, end)
 
     def as_dict(self) -> dict:
+        # The pure latency breakdown — the ``ticket.latency()``
+        # contract. The tenant rides the dataclass field and is added
+        # explicitly where records need it (ticket_done, result metas).
         return {
             "queue_wait_ms": self.queue_wait_ms,
             "execute_ms": self.execute_ms,
@@ -106,6 +116,7 @@ class TicketTiming:
         clock (``telemetry.anchored_wall``), so they nest inside the
         cross-process span log a fleet worker publishes. Spans whose
         transitions haven't happened are omitted."""
+        attrs.setdefault("tenant", self.tenant)
         out: List[dict] = []
         for name, a, b in (
             ("local_queue_wait", self.submitted, self.launched),
@@ -137,6 +148,80 @@ def _bucket_id(sig: tuple) -> str:
     return f"b{abs(hash(sig)) & 0xFFFFFFFF:08x}"
 
 
+class TenantBurnTracker:
+    """Per-tenant error-budget burn tracking for one serving surface
+    (ISSUE 14) — the glue between :class:`SLOConfig` (what the
+    objective is, per tenant) and
+    :class:`~libpga_tpu.utils.metrics.BurnRateMonitor` (how fast the
+    budget is burning). One instance per surface: the RunQueue uses
+    ``prefix="serving"``, the fleet coordinator ``prefix="fleet"`` —
+    both export ``<prefix>.tenant.slo_burn{tenant=,window=}`` gauges
+    and emit one transition-edge ``slo_burn`` event per excursion.
+    """
+
+    def __init__(self, slo: Optional[SLOConfig], registry, emit,
+                 prefix: str):
+        self.slo = slo
+        self.registry = registry
+        self._emit = emit
+        self.prefix = prefix
+        self.monitors: Dict[str, _metrics.BurnRateMonitor] = {}
+
+    def _monitor(self, tenant: str):
+        mon = self.monitors.get(tenant)
+        if mon is not None:
+            return mon
+        if self.slo is None:
+            return None
+        burn = self.slo.for_tenant(tenant).burn
+        if burn is None:
+            return None
+        mon = _metrics.BurnRateMonitor(
+            budget=burn.budget, fast_window_s=burn.fast_window_s,
+            slow_window_s=burn.slow_window_s, threshold=burn.threshold,
+            min_samples=burn.min_samples,
+        )
+        self.monitors[tenant] = mon
+        return mon
+
+    def observe(self, tenant: str, e2e_ms: Optional[float]) -> None:
+        """Record one completed request against the tenant's error
+        budget, refresh that tenant's burn gauges, and emit alerts."""
+        mon = self._monitor(tenant)
+        if mon is None or e2e_ms is None:
+            return
+        objective = self.slo.for_tenant(tenant).burn.objective_ms
+        mon.record(tenant, e2e_ms > objective)
+        b = mon.burn(tenant)
+        for window in ("fast", "slow"):
+            self.registry.gauge(
+                f"{self.prefix}.tenant.slo_burn",
+                tenant=tenant, window=window,
+            ).set(round(b[f"{window}_burn"], 4))
+        for alert in mon.check():
+            self.registry.counter(
+                f"{self.prefix}.slo_burn_alerts", tenant=tenant
+            ).bump()
+            self._emit(
+                "slo_burn", tenant=tenant,
+                fast_burn=round(alert["fast_burn"], 4),
+                slow_burn=round(alert["slow_burn"], 4),
+                budget=alert["budget"], threshold=alert["threshold"],
+                objective_ms=objective, where=self.prefix,
+            )
+
+    def status(self) -> List[dict]:
+        """Current burn state of every tracked tenant (the
+        ``check_slo``/console feed): burn rates plus whether the
+        tenant is currently inside an alert excursion."""
+        out = []
+        for tenant, mon in sorted(self.monitors.items()):
+            b = mon.burn(tenant)
+            b["alerting"] = mon.alerting(tenant)
+            out.append(b)
+        return out
+
+
 class RunTicket:
     """Handle for one submitted run.
 
@@ -147,9 +232,10 @@ class RunTicket:
     ticket intact — call ``result()`` again to keep waiting.
     """
 
-    def __init__(self, queue: "RunQueue", bucket: str):
+    def __init__(self, queue: "RunQueue", bucket: str, tenant: str = ANON):
         self.bucket = bucket
-        self.timing = TicketTiming()
+        self.tenant = tenant
+        self.timing = TicketTiming(tenant=tenant)
         self._queue = queue
         self._event = threading.Event()
         self._result: Optional[RunResult] = None
@@ -161,7 +247,7 @@ class RunTicket:
         self._result = result
         self._error = error
         self._event.set()
-        self._queue._ticket_done()
+        self._queue._ticket_done(self)
 
     def latency(self) -> dict:
         """The latency breakdown recorded so far (ms; ``None`` for
@@ -253,6 +339,16 @@ class RunQueue:
         self.submitted = 0
         self.requeues = 0
         self.dead_letters: List[DeadLetter] = []
+        # Tenant attribution (ISSUE 14): ids seen (for one tenant_admit
+        # event each), per-tenant pending counts behind the
+        # serving.tenant.pending gauges, and the error-budget burn
+        # tracker (active for tenants whose resolved SLO carries a
+        # BurnRateConfig).
+        self._tenants_seen: set = set()
+        self._tenant_pending: Dict[str, int] = {}
+        self.burn = TenantBurnTracker(
+            self.slo, self.registry, self._emit, "serving"
+        )
 
     # --------------------------------------------------------------- events
 
@@ -265,10 +361,12 @@ class RunQueue:
 
     def _observe_ticket(self, ticket: RunTicket) -> None:
         """Fold one successfully read-back ticket into the latency
-        histograms, emit its ``ticket_done`` event, and apply the
-        per-ticket SLO check. Called exactly once per ticket, from
+        histograms (aggregate AND tenant-labeled), emit its
+        ``ticket_done`` event, and apply the tenant-resolved per-ticket
+        SLO + burn-rate checks. Called exactly once per ticket, from
         ``RunTicket.result()`` after readback."""
         t = ticket.timing
+        tenant = ticket.tenant
         for name, value in (
             ("serving.ticket.queue_wait_ms", t.queue_wait_ms),
             ("serving.ticket.execute_ms", t.execute_ms),
@@ -277,46 +375,91 @@ class RunQueue:
         ):
             if value is not None:
                 self.registry.histogram(name).observe(value)
+        # Tenant-labeled twins of the latency histograms (ISSUE 14):
+        # the aggregate series above stay label-free so every existing
+        # consumer (check_slo, fleet_status, stragglers) is unchanged.
+        for name, value in (
+            ("serving.tenant.queue_wait_ms", t.queue_wait_ms),
+            ("serving.tenant.e2e_ms", t.e2e_ms),
+        ):
+            if value is not None:
+                self.registry.histogram(name, tenant=tenant).observe(value)
         self.registry.counter("serving.tickets_done").bump()
-        self._emit("ticket_done", bucket=ticket.bucket, **t.as_dict())
+        self.registry.counter(
+            "serving.tenant.completions", tenant=tenant
+        ).bump()
+        self._emit(
+            "ticket_done", bucket=ticket.bucket, tenant=tenant,
+            **t.as_dict(),
+        )
         slo = self.slo
+        tslo = None if slo is None else slo.for_tenant(tenant)
         if (
-            slo is not None
-            and slo.max_queue_wait_ms is not None
+            tslo is not None
+            and tslo.max_queue_wait_ms is not None
             and t.queue_wait_ms is not None
-            and t.queue_wait_ms > slo.max_queue_wait_ms
+            and t.queue_wait_ms > tslo.max_queue_wait_ms
         ):
             self.registry.counter("serving.slo_violations").bump()
             self._emit(
                 "slo_violation", what="queue_wait",
                 value_ms=round(t.queue_wait_ms, 3),
-                limit_ms=slo.max_queue_wait_ms, bucket=ticket.bucket,
+                limit_ms=tslo.max_queue_wait_ms, bucket=ticket.bucket,
+                tenant=tenant,
             )
+        self.burn.observe(tenant, t.e2e_ms)
 
-    def check_slo(self, slo: Optional[SLOConfig] = None) -> List[dict]:
+    def check_slo(
+        self, slo: Optional[SLOConfig] = None,
+        tenant: Optional[str] = None,
+    ) -> List[dict]:
         """Aggregate SLO check: compare the end-to-end latency
         histogram's p99 against ``slo.p99_latency_ms`` (skipped until
-        ``min_samples`` tickets completed). Returns violation dicts
-        (empty = within objective) and emits one ``slo_violation``
-        event per breach. ``tools/serving_throughput.py --slo`` exits
-        nonzero on a non-empty return."""
+        ``min_samples`` tickets completed). With ``tenant`` given
+        (ISSUE 14), the TENANT-LABELED latency histogram is checked
+        against that tenant's resolved override instead, and the
+        tenant's current burn-rate alert state counts as a violation.
+        Returns violation dicts (empty = within objective) and emits
+        one ``slo_violation`` event per breach.
+        ``tools/serving_throughput.py --slo`` exits nonzero on a
+        non-empty return."""
         slo = slo or self.slo
         if slo is None:
             return []
         violations: List[dict] = []
-        if slo.p99_latency_ms is not None:
+        if tenant is not None:
+            tenant = validate_tenant(tenant)
+            slo = slo.for_tenant(tenant)
+            snap = self.registry.histogram(
+                "serving.tenant.e2e_ms", tenant=tenant
+            ).snapshot()
+            what = "tenant_p99_latency"
+        else:
             snap = self.registry.histogram(
                 "serving.ticket.e2e_ms"
             ).snapshot()
-            if snap.count >= slo.min_samples:
-                p99 = snap.percentile(99.0)
-                if p99 > slo.p99_latency_ms:
-                    violations.append({
-                        "what": "p99_latency",
-                        "value_ms": round(p99, 3),
-                        "limit_ms": slo.p99_latency_ms,
-                        "samples": snap.count,
-                    })
+            what = "p99_latency"
+        if slo.p99_latency_ms is not None and snap.count >= slo.min_samples:
+            p99 = snap.percentile(99.0)
+            if p99 > slo.p99_latency_ms:
+                v = {
+                    "what": what,
+                    "value_ms": round(p99, 3),
+                    "limit_ms": slo.p99_latency_ms,
+                    "samples": snap.count,
+                }
+                if tenant is not None:
+                    v["tenant"] = tenant
+                violations.append(v)
+        if tenant is not None:
+            mon = self.burn.monitors.get(tenant)
+            if mon is not None and mon.alerting(tenant):
+                b = mon.burn(tenant)
+                violations.append({
+                    "what": "tenant_burn_rate", "tenant": tenant,
+                    "value_ms": round(b["fast_burn"], 4),
+                    "limit_ms": mon.threshold,
+                })
         for v in violations:
             self.registry.counter("serving.slo_violations").bump()
             self._emit("slo_violation", **v)
@@ -324,12 +467,21 @@ class RunQueue:
 
     # --------------------------------------------------------- backpressure
 
-    def _ticket_done(self) -> None:
+    def _ticket_done(self, ticket: Optional[RunTicket] = None) -> None:
+        tenant = None if ticket is None else ticket.tenant
         with self._pending_cv:
             self._pending -= 1
             depth = self._pending
+            t_depth = None
+            if tenant is not None:
+                t_depth = self._tenant_pending.get(tenant, 1) - 1
+                self._tenant_pending[tenant] = max(t_depth, 0)
             self._pending_cv.notify_all()
         self.registry.gauge("serving.queue.depth").set(depth)
+        if tenant is not None:
+            self.registry.gauge(
+                "serving.tenant.pending", tenant=tenant
+            ).set(max(t_depth, 0))
 
     @property
     def pending(self) -> int:
@@ -337,7 +489,7 @@ class RunQueue:
         with self._pending_cv:
             return self._pending
 
-    def _admit_slot(self) -> None:
+    def _admit_slot(self, tenant: str) -> None:
         """Reserve a pending slot, blocking or raising per the overflow
         policy at the ``max_pending`` bound. Called OUTSIDE the bucket
         lock (a blocked submit must not stall completions)."""
@@ -354,28 +506,53 @@ class RunQueue:
                 self._pending_cv.wait(timeout=0.05)
             self._pending += 1
             depth = self._pending
+            t_depth = self._tenant_pending.get(tenant, 0) + 1
+            self._tenant_pending[tenant] = t_depth
         self.registry.gauge("serving.queue.depth").set(depth)
+        self.registry.gauge(
+            "serving.tenant.pending", tenant=tenant
+        ).set(t_depth)
 
-    def _unadmit(self) -> None:
+    def _unadmit(self, tenant: str) -> None:
         """Roll back a slot reserved by :meth:`_admit_slot` when the
         admission itself fails (closed race, executor error)."""
-        self._ticket_done()
+        with self._pending_cv:
+            self._pending -= 1
+            self._tenant_pending[tenant] = max(
+                self._tenant_pending.get(tenant, 1) - 1, 0
+            )
+            self._pending_cv.notify_all()
 
     # ---------------------------------------------------------------- admit
 
+    def _admit_tenant(self, tenant: Optional[str], where: str) -> str:
+        """Validate a tenant id at the submit boundary and emit one
+        ``tenant_admit`` event the first time it is seen."""
+        tenant = validate_tenant(tenant)
+        if tenant not in self._tenants_seen:
+            self._tenants_seen.add(tenant)
+            self._emit("tenant_admit", tenant=tenant, where=where)
+        return tenant
+
     def submit(
-        self, request: RunRequest, executor: Optional[BatchedRuns] = None
+        self, request: RunRequest,
+        executor: Optional[BatchedRuns] = None,
+        tenant: Optional[str] = None,
     ) -> RunTicket:
         """Admit a run; returns its ticket. Launches the request's
         bucket inline when it reaches ``max_batch``. With
-        ``max_pending`` set, applies the overflow policy first."""
+        ``max_pending`` set, applies the overflow policy first.
+        ``tenant`` (ISSUE 14) attributes the ticket — it rides the
+        ticket's timing, events, and every tenant-labeled metric
+        series; ``None`` submits as the default ``anon`` tenant."""
         if self._closed:
             raise RuntimeError("queue is closed")
         ex = executor or self.executor
         if ex is None:
             raise ValueError("no executor: pass one here or at init")
+        tenant = self._admit_tenant(tenant, "serving_queue")
         t_submit = time.monotonic()  # before any backpressure wait
-        self._admit_slot()
+        self._admit_slot(tenant)
         try:
             sig = ex.signature(request)
             name = _bucket_id(sig)
@@ -389,16 +566,19 @@ class RunQueue:
                     self._bucket_names[name] = sig
                 if not bucket.items:
                     bucket.oldest = time.monotonic()
-                ticket = RunTicket(self, name)
+                ticket = RunTicket(self, name, tenant=tenant)
                 ticket.timing.submitted = t_submit
                 ticket.timing.admitted = time.monotonic()
                 bucket.items.append((request, ticket))
                 n_pending = len(bucket.items)
                 self.submitted += 1
+                self.registry.counter(
+                    "serving.tenant.submissions", tenant=tenant
+                ).bump()
                 self._emit(
                     "batch_admit", bucket=name, pending=n_pending,
                     population_size=request.size,
-                    genome_len=request.genome_len,
+                    genome_len=request.genome_len, tenant=tenant,
                 )
                 if n_pending >= self.serving.max_batch:
                     launch = self._take(sig)
@@ -407,7 +587,7 @@ class RunQueue:
                 "serving.bucket.pending", bucket=name
             ).set(0 if launch is not None else n_pending)
         except BaseException:
-            self._unadmit()
+            self._unadmit(tenant)
             raise
         if launch is not None:
             self._launch(sig, *launch)
@@ -504,8 +684,12 @@ class RunQueue:
         self._emit(
             "dead_letter", bucket=name, error=str(error),
             population_size=req.size, genome_len=req.genome_len,
+            tenant=ticket.tenant,
         )
         self.registry.counter("serving.dead_letters").bump()
+        self.registry.counter(
+            "serving.tenant.dead_letters", tenant=ticket.tenant
+        ).bump()
         self.registry.gauge("serving.dead_letters.pending").set(
             len(self.dead_letters)
         )

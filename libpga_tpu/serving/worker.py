@@ -288,7 +288,8 @@ class WorkerHarness:
     # -------------------------------------------------------------- publish
 
     def _publish(
-        self, tid: str, genomes, scores, gens, trace: Optional[dict] = None
+        self, tid: str, genomes, scores, gens,
+        trace: Optional[dict] = None, tenant: str = "anon",
     ) -> None:
         from libpga_tpu.utils.checkpoint import _encode
 
@@ -306,7 +307,7 @@ class WorkerHarness:
 
         meta = {"tid": tid, "generations": int(gens),
                 "best_score": float(np.max(s)), "worker": self.wid,
-                "pid": os.getpid(), "error": None}
+                "pid": os.getpid(), "error": None, "tenant": tenant}
         if trace is not None:
             # The span log travels WITH the result: stamp the publish
             # edge now (the npz above is already durable), close the
@@ -343,20 +344,23 @@ class WorkerHarness:
         claimed = self._claim_wall.get(name)
         formed = batch.get("formed_at")
         tid, trace_id = t["tid"], t.get("trace_id")
+        tenant = t.get("tenant", "anon")
         spans = []
         if formed is not None and claimed is not None:
             spans.append(_tl.trace_span_record(
                 "spool_wait", float(formed), claimed, tid=tid,
                 trace_id=trace_id, worker=self.wid, role="worker",
+                tenant=tenant,
             ))
         if claimed is not None:
             spans.append(_tl.trace_span_record(
                 "execute", claimed, completed, tid=tid, trace_id=trace_id,
-                worker=self.wid, role="worker",
+                worker=self.wid, role="worker", tenant=tenant,
             ))
         base = {
             "trace_id": trace_id,
             "worker": self.wid,
+            "tenant": tenant,
             "claimed_at": claimed,
             "completed_at": completed,
             "spans": spans,
@@ -478,7 +482,13 @@ class WorkerHarness:
                 mutation_rate=t["mutation_rate"],
                 mutation_sigma=t["mutation_sigma"],
             )
-            handles.append((t["tid"], queue.submit(req)))
+            # Tenant identity rides the batch file (ISSUE 14): submit
+            # under it, so this worker's serving.tenant.* series — and
+            # therefore the merged fleet exposition — attribute the
+            # work correctly.
+            handles.append((t["tid"], queue.submit(
+                req, tenant=t.get("tenant")
+            )))
         queue.drain()
         done = set()
         for tid, ticket in handles:
@@ -493,6 +503,7 @@ class WorkerHarness:
                         name, batch, by_tid[tid], _tl.anchored_wall(),
                         local=ticket,
                     ),
+                    tenant=by_tid[tid].get("tenant", "anon"),
                 )
             done.add(tid)
         return done
@@ -541,6 +552,7 @@ class WorkerHarness:
         self._publish(
             t["tid"], pop.genomes, pop.scores, report.generations,
             trace=self._trace_base(name, batch, t, _tl.anchored_wall()),
+            tenant=t.get("tenant", "anon"),
         )
         return True
 

@@ -221,6 +221,9 @@ class SessionGroup:
                     where="group_step",
                 )
                 _metrics.REGISTRY.counter("streaming.folds").bump(m)
+                _metrics.REGISTRY.counter(
+                    "streaming.tenant.injected", tenant=s.tenant
+                ).bump(m)
             pop = s.pga.population(s.handle)
             genomes.append(pop.genomes)
             key_data.append(jax.random.key_data(s.pga.next_key()))
@@ -252,6 +255,12 @@ class SessionGroup:
                 hist = _tl.History(buf[i], done)
                 sess._histories.append(hist)
             sess.pga._history[sess.handle.index] = hist
+            # Each co-batched session's lifecycle trace keeps tiling
+            # (ISSUE 14): a group step is that session's step.
+            sess._record_span("group_step", gens=done)
+            _metrics.REGISTRY.counter(
+                "streaming.tenant.steps", tenant=sess.tenant
+            ).bump()
 
     def step(self, n: int, target: Optional[float] = None) -> int:
         """Advance every session ``n`` generations. With PBT enabled the

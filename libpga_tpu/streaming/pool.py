@@ -188,12 +188,17 @@ class EnginePool:
         mutate=None,
         genomes=None,
         session_id: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> EvolutionSession:
         """A warm :class:`EvolutionSession` for one tenant: a pooled
         engine when the signature is warm (hit — 0 compiles), a fresh
         one otherwise (miss — prewarmed per ``StreamingConfig.prewarm``
         before the session sees it). Bit-identity with a cold session
-        holds either way."""
+        holds either way. ``tenant`` (ISSUE 14) attributes the session
+        and this acquire's warm-pool hit/miss."""
+        from libpga_tpu.utils.tenancy import validate_tenant
+
+        tenant_id = validate_tenant(tenant)
         objective_name = objective if isinstance(objective, str) else None
         if isinstance(objective, str):
             from libpga_tpu import objectives
@@ -208,10 +213,16 @@ class EnginePool:
         if eng is not None:
             self.counters.bump("hits")
             _metrics.REGISTRY.counter("streaming.pool.hits").bump()
+            _metrics.REGISTRY.counter(
+                "streaming.tenant.pool_hits", tenant=tenant_id
+            ).bump()
             self._reset_engine(eng, seed)
         else:
             self.counters.bump("misses")
             _metrics.REGISTRY.counter("streaming.pool.misses").bump()
+            _metrics.REGISTRY.counter(
+                "streaming.tenant.pool_misses", tenant=tenant_id
+            ).bump()
             eng = self._fresh_engine(
                 sig, objective, crossover, mutate, seed
             )
@@ -237,6 +248,7 @@ class EnginePool:
         session = EvolutionSession(
             streaming=self.streaming,
             session_id=session_id,
+            tenant=tenant,
             _engine=eng,
             _handle=handle,
         )
@@ -254,6 +266,7 @@ class EnginePool:
         _, sig = pool_mark
         eng = session.pga
         session._pool = None
+        session.close()  # active-sessions accounting (idempotent)
         self.counters.bump("releases")
         with self._lock:
             entry = self._entries.get(sig)

@@ -35,6 +35,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from typing import Callable, List, Optional
 
 import jax
@@ -49,6 +50,7 @@ from libpga_tpu.serving import cache as _cache
 from libpga_tpu.utils import checkpoint as _ckpt
 from libpga_tpu.utils import metrics as _metrics
 from libpga_tpu.utils import telemetry as _tl
+from libpga_tpu.utils.tenancy import validate_tenant
 
 #: Session sidecar schema (the ``<path>.session.json`` commit file).
 SESSION_META_VERSION = 1
@@ -144,10 +146,13 @@ class EvolutionSession:
         mutate: Optional[Callable] = None,
         genomes=None,
         session_id: Optional[str] = None,
+        tenant: Optional[str] = None,
         _engine: Optional[PGA] = None,
         _handle: Optional[PopulationHandle] = None,
     ):
+        opened = _tl.anchored_wall()
         self.sid = session_id or _next_sid()
+        self.tenant = validate_tenant(tenant)
         self.streaming = streaming or StreamingConfig()
         if _engine is not None:
             self.pga = _engine
@@ -180,17 +185,84 @@ class EvolutionSession:
         self._pending_g: List[np.ndarray] = []
         self._pending_s: List[np.ndarray] = []
         self._histories: List[_tl.History] = []
+        # Session lifecycle trace (ISSUE 14): telescoping spans on the
+        # anchored clock — each lifecycle operation's span runs from
+        # the END of the previous one, so the spans TILE the session's
+        # lifetime (the round-14 ticket-span discipline applied to a
+        # long-lived tenant) and survive suspend/resume via the trace
+        # sidecar.
+        self._spans: List[dict] = []
+        self._last_edge: float = opened
+        self._closed = False
         pop = self.pga.population(self.handle)
+        self._record_span("open")
         self._emit(
-            "session_open", session=self.sid,
+            "session_open", session=self.sid, tenant=self.tenant,
             population_size=pop.size, genome_len=pop.genome_len,
         )
         _metrics.REGISTRY.counter("streaming.sessions.opened").bump()
+        _metrics.REGISTRY.counter(
+            "streaming.tenant.sessions_opened", tenant=self.tenant
+        ).bump()
+        _metrics.REGISTRY.gauge(
+            "streaming.tenant.sessions_active", tenant=self.tenant
+        ).add(1)
 
     # ------------------------------------------------------------- plumbing
 
     def _emit(self, event: str, **fields) -> None:
         self.pga._emit(event, **fields)
+
+    def _record_span(self, span: str, **attrs) -> dict:
+        """Record one lifecycle span ending NOW and starting at the
+        previous span's end (telescoping — any client idle time between
+        operations is charged to the operation that ended it, exactly
+        like a ticket's queue_wait). Records are schema-valid
+        ``session_span`` events carrying the session and tenant ids."""
+        now = _tl.anchored_wall()
+        rec = _tl.trace_span_record(
+            span, self._last_edge, now, session=self.sid,
+            tenant=self.tenant, **attrs,
+        )
+        rec["event"] = "session_span"
+        self._last_edge = now
+        self._spans.append(rec)
+        _tl.flight_note("session_span", {
+            "session": self.sid, "span": span, "tenant": self.tenant,
+            "t0": rec["t0"], "t1": rec["t1"],
+        })
+        return rec
+
+    def trace(self) -> List[dict]:
+        """The session's lifecycle span log (schema-valid
+        ``session_span`` records): open → every ask/tell/step →
+        suspend, persisted across suspend/resume — a tenant's trace
+        survives re-hosting on another process."""
+        return list(self._spans)
+
+    def trace_coverage(self) -> float:
+        """Fraction of the session's lifetime (first span start → last
+        span end) covered by its spans — 1.0 by construction while the
+        session lives in one process; the ≥0.95 CI gate guards the
+        suspend/resume composition across processes."""
+        if not self._spans:
+            return 0.0
+        total = self._spans[-1]["t1"] - self._spans[0]["t0"]
+        if total <= 0:
+            return 1.0
+        covered = sum(_tl.span_ms(r) for r in self._spans) / 1e3
+        return min(covered / total, 1.0)
+
+    def close(self) -> None:
+        """Mark the session closed for accounting (the active-sessions
+        gauge). Idempotent; called by ``EnginePool.release``. The
+        populations are untouched — suspend first to keep them."""
+        if self._closed:
+            return
+        self._closed = True
+        _metrics.REGISTRY.gauge(
+            "streaming.tenant.sessions_active", tenant=self.tenant
+        ).add(-1)
 
     @property
     def objective(self):
@@ -254,6 +326,10 @@ class EvolutionSession:
         self._pending_g.append(g)
         self._pending_s.append(s)
         _metrics.REGISTRY.counter("streaming.tells").bump(g.shape[0])
+        _metrics.REGISTRY.counter(
+            "streaming.tenant.tells", tenant=self.tenant
+        ).bump(g.shape[0])
+        self._record_span("tell", told=int(g.shape[0]))
         return self.pending_tells
 
     def take_pending(self, limit: Optional[int] = None) -> Optional[tuple]:
@@ -303,6 +379,9 @@ class EvolutionSession:
         self.pga._staged[self.handle.index] = None
         self._emit("session_fold", session=self.sid, folded=m, where="ask")
         _metrics.REGISTRY.counter("streaming.folds").bump(m)
+        _metrics.REGISTRY.counter(
+            "streaming.tenant.injected", tenant=self.tenant
+        ).bump(m)
         return m
 
     def ask(self, k: int) -> np.ndarray:
@@ -319,15 +398,25 @@ class EvolutionSession:
             raise ValueError("ask k must be >= 1")
         if k > self.size:
             raise ValueError(f"ask k={k} exceeds population size {self.size}")
-        self._fold_pending_host()
-        pop = self.pga.population(self.handle)
-        scores = np.asarray(pop.scores, dtype=np.float32)
-        if not np.isfinite(scores).any():
-            return np.asarray(pop.genomes[:k], dtype=np.float32)
-        fn = self._ask_program(k)
-        with _tl.span("ask"):
-            out = fn(pop.genomes, pop.scores, self.pga.next_key())
-        return np.asarray(out, dtype=np.float32)
+        t0 = time.perf_counter()
+        try:
+            self._fold_pending_host()
+            pop = self.pga.population(self.handle)
+            scores = np.asarray(pop.scores, dtype=np.float32)
+            if not np.isfinite(scores).any():
+                return np.asarray(pop.genomes[:k], dtype=np.float32)
+            fn = self._ask_program(k)
+            with _tl.span("ask"):
+                out = fn(pop.genomes, pop.scores, self.pga.next_key())
+            return np.asarray(out, dtype=np.float32)
+        finally:
+            _metrics.REGISTRY.counter(
+                "streaming.tenant.asks", tenant=self.tenant
+            ).bump()
+            _metrics.REGISTRY.histogram(
+                "streaming.tenant.ask_ms", tenant=self.tenant
+            ).observe((time.perf_counter() - t0) * 1e3)
+            self._record_span("ask", k=int(k))
 
     def _ask_program(self, k: int):
         """Compiled ask breed for candidate width ``k`` — shared
@@ -368,6 +457,7 @@ class EvolutionSession:
         Pending tells fold at the boundary inside the compiled loop
         (``engine.make_run_loop``'s injection slot); with none pending
         this IS ``PGA.run`` — the bit-identity anchor."""
+        t0 = time.perf_counter()
         inject = self.take_pending()
         if inject is not None:
             self._emit(
@@ -377,6 +467,9 @@ class EvolutionSession:
             _metrics.REGISTRY.counter("streaming.folds").bump(
                 inject[0].shape[0]
             )
+            _metrics.REGISTRY.counter(
+                "streaming.tenant.injected", tenant=self.tenant
+            ).bump(inject[0].shape[0])
         gens = self.pga.run(
             n, target=target, population=self.handle, inject=inject
         )
@@ -384,6 +477,13 @@ class EvolutionSession:
         hist = self.pga.history(self.handle)
         if hist is not None:
             self._histories.append(hist)
+        _metrics.REGISTRY.counter(
+            "streaming.tenant.steps", tenant=self.tenant
+        ).bump()
+        _metrics.REGISTRY.histogram(
+            "streaming.tenant.step_ms", tenant=self.tenant
+        ).observe((time.perf_counter() - t0) * 1e3)
+        self._record_span("step", gens=int(gens))
         return gens
 
     # ------------------------------------------------------- suspend/resume
@@ -394,6 +494,7 @@ class EvolutionSession:
         sidecar, and the session meta JSON LAST as the commit point.
         The session object stays usable; a tenant reconnecting anywhere
         the files are visible resumes bit-identically."""
+        self._record_span("suspend")
         _ckpt.save(self.pga, path)
         tells_path = f"{path}.tells.npz"
         if self._pending_g:
@@ -403,11 +504,21 @@ class EvolutionSession:
             })
         elif os.path.exists(tells_path):
             os.remove(tells_path)
+        # Lifecycle trace sidecar (ISSUE 14): the session's span log
+        # rides the suspension, so a tenant's trace survives re-hosting
+        # — written BEFORE the meta (the commit point), atomic like
+        # every other payload file.
+        _atomic_write_text(
+            f"{path}.trace.jsonl",
+            "".join(json.dumps(r, default=str) + "\n"
+                    for r in self._spans),
+        )
         cfg = self.pga.config
         obj = self.pga._objective
         meta = {
             "version": SESSION_META_VERSION,
             "session": self.sid,
+            "tenant": self.tenant,
             "population_size": self.size,
             "genome_len": self.genome_len,
             "gens_done": self.gens_done,
@@ -432,8 +543,14 @@ class EvolutionSession:
             f"{path}.session.json",
             json.dumps(meta, sort_keys=True) + "\n",
         )
-        self._emit("session_suspend", session=self.sid, path=path)
+        self._emit(
+            "session_suspend", session=self.sid, path=path,
+            tenant=self.tenant,
+        )
         _metrics.REGISTRY.counter("streaming.sessions.suspended").bump()
+        _metrics.REGISTRY.counter(
+            "streaming.tenant.suspends", tenant=self.tenant
+        ).bump()
         return path
 
     @classmethod
@@ -509,6 +626,7 @@ class EvolutionSession:
             crossover=crossover,
             mutate=mutate,
             session_id=meta["session"],
+            tenant=meta.get("tenant"),
             _engine=pga,
             _handle=PopulationHandle(0),
         )
@@ -518,6 +636,27 @@ class EvolutionSession:
             with np.load(tells_path) as data:
                 session._pending_g = [np.asarray(data["genomes"])]
                 session._pending_s = [np.asarray(data["fitness"])]
-        session._emit("session_resume", session=session.sid, path=path)
+        # Rejoin the suspended lifecycle trace (ISSUE 14): the restored
+        # span log replaces this construction's "open" span, and the
+        # resume span telescopes from the suspend edge — anchored walls
+        # agree across the processes of one host, so the trace keeps
+        # tiling the session's WHOLE lifetime across the re-hosting.
+        trace_path = f"{path}.trace.jsonl"
+        try:
+            with open(trace_path, encoding="utf-8") as fh:
+                prior = [
+                    json.loads(line) for line in fh
+                    if line.strip()
+                ]
+        except (OSError, ValueError):
+            prior = []
+        if prior:
+            session._spans = prior
+            session._last_edge = float(prior[-1]["t1"])
+        session._record_span("resume")
+        session._emit(
+            "session_resume", session=session.sid, path=path,
+            tenant=session.tenant,
+        )
         _metrics.REGISTRY.counter("streaming.sessions.resumed").bump()
         return session

@@ -70,7 +70,7 @@ class SessionStore:
         """Drop a stored session (meta first, so a racing resume sees
         either the whole session or none of it)."""
         base = self.path(sid)
-        for suffix in (".session.json", ".tells.npz", ""):
+        for suffix in (".session.json", ".tells.npz", ".trace.jsonl", ""):
             p = f"{base}{suffix}"
             if os.path.exists(p):
                 os.remove(p)

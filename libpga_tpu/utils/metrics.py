@@ -446,18 +446,73 @@ class MetricsRegistry:
     renders the text exposition format. A name maps to exactly one
     instrument kind (a ``gauge("x")`` after ``counter("x")`` raises —
     silent kind confusion corrupts dashboards).
+
+    **Label-cardinality guard** (ISSUE 14): per-tenant attribution means
+    label values now arrive from CLIENTS, and one misbehaving client
+    cycling tenant ids would otherwise mint unbounded metric series —
+    blowing up every snapshot, flush, and exposition in the process.
+    The registry therefore caps the distinct values of each label NAME
+    at :attr:`label_cardinality_limit`; past the cap, new values route
+    into one shared ``_overflow`` series (existing values keep their
+    own), a warning fires ONCE per label name, and the overflow count
+    is exported as ``registry.label_overflow{label=...}`` gauges so
+    dashboards (and ``tools/metrics_dump.py --check``, which flags
+    ``_overflow`` label values) can see the guard engaged.
     """
 
     SNAPSHOT_SCHEMA = 1
+
+    #: Distinct values allowed per label name before new values fold
+    #: into the ``_overflow`` bucket. Class-level so a serving host can
+    #: raise it deliberately; the default comfortably covers workers,
+    #: buckets, and a healthy tenant population.
+    label_cardinality_limit = 64
+
+    #: The shared overflow label value (``tenancy.OVERFLOW`` — reserved,
+    #: so a client can never legitimately collide with it).
+    OVERFLOW_VALUE = "_overflow"
 
     def __init__(self):
         self._series: Dict[tuple, object] = {}
         self._kinds: Dict[str, str] = {}
         self._lock = threading.Lock()
+        self._label_values: Dict[str, set] = {}
+        self._label_overflow: Dict[str, int] = {}
+        self._card_warned: set = set()
+
+    def _guard_labels(self, labels: dict) -> Tuple[dict, List[str]]:
+        """Apply the cardinality cap. Returns the (possibly rewritten)
+        labels and the label names that newly overflowed — warnings
+        fire OUTSIDE the lock."""
+        if not labels:
+            return labels, []
+        limit = self.label_cardinality_limit
+        out = None
+        newly = []
+        with self._lock:
+            for k, v in labels.items():
+                v = str(v)
+                seen = self._label_values.setdefault(k, set())
+                if v in seen:
+                    continue
+                if len(seen) < limit or v == self.OVERFLOW_VALUE:
+                    seen.add(v)
+                    continue
+                self._label_overflow[k] = (
+                    self._label_overflow.get(k, 0) + 1
+                )
+                if out is None:
+                    out = dict(labels)
+                out[k] = self.OVERFLOW_VALUE
+                if k not in self._card_warned:
+                    self._card_warned.add(k)
+                    newly.append(k)
+        return (labels if out is None else out), newly
 
     def _get(self, kind: str, name: str, labels: dict, make):
-        key = (name, _labels_key(labels))
+        labels, newly = self._guard_labels(labels)
         with self._lock:
+            key = (name, _labels_key(labels))
             prev = self._kinds.get(name)
             if prev is not None and prev != kind:
                 raise ValueError(
@@ -468,7 +523,16 @@ class MetricsRegistry:
             if got is None:
                 self._kinds[name] = kind
                 got = self._series[key] = make()
-            return got
+        for label in newly:
+            warnings.warn(
+                f"metric label {label!r} exceeded "
+                f"{self.label_cardinality_limit} distinct values — new "
+                f"values now share the {self.OVERFLOW_VALUE!r} series "
+                "(one warning per label; see "
+                "MetricsRegistry.label_cardinality_limit)",
+                stacklevel=3,
+            )
+        return got
 
     def counter(self, name: str, **labels) -> Counter:
         return self._get("counter", name, labels, Counter)
@@ -489,6 +553,15 @@ class MetricsRegistry:
         with self._lock:
             self._series.clear()
             self._kinds.clear()
+            self._label_values.clear()
+            self._label_overflow.clear()
+            self._card_warned.clear()
+
+    def label_overflow(self) -> Dict[str, int]:
+        """Label names whose distinct-value count exceeded the guard,
+        mapped to how many values were folded into ``_overflow``."""
+        with self._lock:
+            return dict(self._label_overflow)
 
     # ------------------------------------------------------------ export
 
@@ -518,6 +591,15 @@ class MetricsRegistry:
             else:
                 rec.update(series.snapshot().as_dict())
                 out["histograms"].append(rec)
+        # Cardinality-guard visibility: one synthetic gauge per
+        # overflowed label name (built here, not via gauge() — the
+        # guard must never be able to mint series of its own).
+        for label, count in sorted(self.label_overflow().items()):
+            out["gauges"].append({
+                "name": "registry.label_overflow",
+                "labels": {"label": label},
+                "value": float(count),
+            })
         return out
 
     def to_prometheus(self, prefix: str = "pga_") -> str:
@@ -585,6 +667,131 @@ def merge_snapshots(
             {"name": name, "labels": dict(labels), **h.as_dict()}
         )
     return merged
+
+
+# ------------------------------------------------ SLO burn rate (ISSUE 14)
+
+
+class BurnRateMonitor:
+    """Multi-window error-budget burn-rate tracking, per tenant.
+
+    The SRE alerting shape: each completed request either met its
+    latency objective or violated it; the ERROR BUDGET says a
+    ``budget`` fraction of requests may violate; the BURN RATE over a
+    window is ``observed_violation_rate / budget`` (1.0 = burning the
+    budget exactly as fast as allowed). An alert requires BOTH a fast
+    window (catches a sharp regression quickly) and a slow window
+    (confirms it is sustained, not one spike) over ``threshold`` — the
+    classic multi-window rule, which is simultaneously fast to fire
+    and slow to flap.
+
+    Host-side and allocation-bounded: one deque of (monotonic stamp,
+    violated) pairs per tenant, pruned past the slow window on every
+    touch. ``record`` is what instrumented readback paths call;
+    ``check`` returns TRANSITION-EDGE alerts (a tenant alerts once per
+    excursion, and recovery re-arms it) so callers can emit one
+    ``slo_burn`` event per incident instead of one per scan.
+    """
+
+    def __init__(
+        self,
+        budget: float = 0.01,
+        fast_window_s: float = 300.0,
+        slow_window_s: float = 3600.0,
+        threshold: float = 10.0,
+        min_samples: int = 1,
+        *,
+        clock=time.monotonic,
+    ):
+        if not (0.0 < budget <= 1.0):
+            raise ValueError("budget must be in (0, 1]")
+        if not (0.0 < fast_window_s <= slow_window_s):
+            raise ValueError("need 0 < fast_window_s <= slow_window_s")
+        if threshold <= 0:
+            raise ValueError("threshold must be > 0")
+        if min_samples < 1:
+            raise ValueError("min_samples must be >= 1")
+        self.budget = float(budget)
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self.threshold = float(threshold)
+        self.min_samples = int(min_samples)
+        self._clock = clock
+        self._events: Dict[str, object] = {}  # tenant -> deque
+        self._alerting: set = set()
+        self._mon_lock = threading.Lock()
+
+    def record(self, tenant: str, violated: bool) -> None:
+        import collections
+
+        now = self._clock()
+        with self._mon_lock:
+            dq = self._events.get(tenant)
+            if dq is None:
+                dq = self._events[tenant] = collections.deque()
+            dq.append((now, bool(violated)))
+            cutoff = now - self.slow_window_s
+            while dq and dq[0][0] < cutoff:
+                dq.popleft()
+
+    def _window_rate(self, dq, now: float, window: float):
+        total = bad = 0
+        cutoff = now - window
+        for t, violated in dq:
+            if t >= cutoff:
+                total += 1
+                bad += violated
+        return (0.0 if total == 0 else bad / total), total
+
+    def burn(self, tenant: str) -> dict:
+        """Current burn state for one tenant: fast/slow burn rates
+        (violation rate over the window divided by the budget) and the
+        sample counts behind them."""
+        now = self._clock()
+        with self._mon_lock:
+            dq = list(self._events.get(tenant, ()))
+        fast_rate, n_fast = self._window_rate(dq, now, self.fast_window_s)
+        slow_rate, n_slow = self._window_rate(dq, now, self.slow_window_s)
+        return {
+            "tenant": tenant,
+            "fast_burn": fast_rate / self.budget,
+            "slow_burn": slow_rate / self.budget,
+            "fast_samples": n_fast,
+            "slow_samples": n_slow,
+        }
+
+    def tenants(self) -> List[str]:
+        with self._mon_lock:
+            return sorted(self._events)
+
+    def alerting(self, tenant: str) -> bool:
+        with self._mon_lock:
+            return tenant in self._alerting
+
+    def check(self) -> List[dict]:
+        """Scan every recorded tenant; returns the NEW alerts (burn
+        over ``threshold`` in BOTH windows with at least
+        ``min_samples`` slow-window observations, transition-edge).
+        Tenants back under threshold silently re-arm."""
+        alerts: List[dict] = []
+        for tenant in self.tenants():
+            b = self.burn(tenant)
+            hot = (
+                b["fast_burn"] >= self.threshold
+                and b["slow_burn"] >= self.threshold
+                and b["slow_samples"] >= self.min_samples
+            )
+            with self._mon_lock:
+                if hot and tenant not in self._alerting:
+                    self._alerting.add(tenant)
+                    alerts.append({
+                        **b,
+                        "budget": self.budget,
+                        "threshold": self.threshold,
+                    })
+                elif not hot:
+                    self._alerting.discard(tenant)
+        return alerts
 
 
 #: The process-wide registry every instrumented subsystem shares.
@@ -679,7 +886,13 @@ def lint_prometheus(text: str) -> List[str]:
     """Line-format lint of a Prometheus text exposition (the
     ``tools/metrics_dump.py --check`` gate). Returns a list of problem
     strings (empty = clean). Checks per-line syntax, histogram bucket
-    cumulativity, the ``+Inf`` bucket, and ``_count`` consistency."""
+    cumulativity, the ``+Inf`` bucket, ``_count`` consistency, and —
+    ISSUE 14 — label-value hygiene: values must be printable ASCII
+    after unescaping (a control character or non-ASCII byte in a label
+    is a scrape-breaking writer bug), and an ``_overflow`` label value
+    is flagged because it means the registry's cardinality guard
+    engaged — some client minted more distinct values of that label
+    than :attr:`MetricsRegistry.label_cardinality_limit` allows."""
     import re
 
     errors: List[str] = []
@@ -740,6 +953,23 @@ def lint_prometheus(text: str) -> List[str]:
                         f"line {lineno}: bad label syntax: {labelstr!r}"
                     )
                     continue
+        for lk, lv in labels.items():
+            raw = (
+                lv.replace("\\\\", "\\").replace('\\"', '"')
+                .replace("\\n", "\n")
+            )
+            if any(c < " " or c > "~" for c in raw):
+                errors.append(
+                    f"line {lineno}: label {lk}={lv!r} is not "
+                    "prometheus-safe (control or non-ASCII character)"
+                )
+            elif lk != "le" and raw == MetricsRegistry.OVERFLOW_VALUE:
+                errors.append(
+                    f"line {lineno}: label {lk}=\"_overflow\" — the "
+                    "registry's label-cardinality guard engaged (a "
+                    "client exceeded the distinct-value cap for this "
+                    "label)"
+                )
         try:
             fval = float(value)
         except ValueError:

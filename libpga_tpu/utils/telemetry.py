@@ -143,6 +143,17 @@ EVENT_FIELDS: Dict[str, tuple] = {
     # round 17 without a schema entry — exactly the bug class
     # ``event-kind-registered`` exists for.)
     "pbt_epoch": ("epoch", "exploited", "best"),
+    # Tenant-attributed observability (ISSUE 14): one record the first
+    # time a tenant id is admitted at a surface (``where`` names it:
+    # serving_queue / fleet / session), the multi-window error-budget
+    # burn-rate alert (transition-edge, per tenant), and the streaming
+    # session lifecycle span — the ``trace_span`` shape carrying the
+    # session id, emitted by EvolutionSession's anchored-clock
+    # lifecycle trace (open/ask/tell/step/suspend/resume, telescoping
+    # so they tile the session's lifetime).
+    "tenant_admit": ("tenant", "where"),
+    "slo_burn": ("tenant", "fast_burn", "slow_burn"),
+    "session_span": ("session", "span", "t0", "t1"),
 }
 
 

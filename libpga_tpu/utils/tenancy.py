@@ -1,0 +1,63 @@
+"""Tenant identity (ISSUE 14).
+
+The north star is a service handling millions of users, and every
+fairness/quota/admission policy (ROADMAP item 1) presupposes that each
+unit of work — a serving ticket, a fleet batch, a streaming session —
+knows which TENANT it belongs to. This module is the single source for
+that identity: one validated, label-safe string that rides every
+observability surface (metric labels, trace-span attributes, event
+records, result metas, suspended-session sidecars) without ever
+entering a traced program — attribution is host-side by construction,
+so the tenant-on and tenant-off paths lower byte-identical StableHLO
+(pinned via ``analysis.fingerprint`` in ``tests/test_tenancy.py``).
+
+Rules:
+
+- ``None`` means "no tenant stated" and resolves to :data:`ANON` — the
+  default tenant every pre-tenancy caller lands in, so enabling
+  attribution never changes behavior, only labeling;
+- explicit ids must be Prometheus-label-safe (``[A-Za-z0-9_.-]``, 1-64
+  chars, not starting with a dot or dash) — anything else raises at the
+  API boundary rather than poisoning an exposition downstream;
+- ids beginning with ``_`` are RESERVED for the library (the metrics
+  registry's cardinality-overflow bucket is ``_overflow``).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+#: The default tenant: work submitted without an identity.
+ANON = "anon"
+
+#: The registry's label-cardinality overflow bucket (a reserved id —
+#: clients can never submit as it, so an ``_overflow`` label value in an
+#: exposition is always the guard speaking, never a tenant).
+OVERFLOW = "_overflow"
+
+_TENANT_RE = re.compile(r"^[A-Za-z0-9_][A-Za-z0-9_.-]{0,63}$")
+
+
+def validate_tenant(tenant: Optional[str]) -> str:
+    """Resolve and validate a tenant id at an API boundary.
+
+    ``None`` → :data:`ANON`. Explicit ids must match the label-safe
+    charset and must not use the reserved ``_``-prefix; violations
+    raise ``ValueError`` naming the rule, so a misbehaving client is
+    rejected at submit time instead of corrupting the exposition."""
+    if tenant is None:
+        return ANON
+    tenant = str(tenant)
+    if not _TENANT_RE.match(tenant):
+        raise ValueError(
+            f"invalid tenant id {tenant!r}: must be 1-64 chars of "
+            "[A-Za-z0-9_.-] starting with a letter, digit or underscore"
+        )
+    if tenant.startswith("_"):
+        raise ValueError(
+            f"invalid tenant id {tenant!r}: the '_' prefix is reserved "
+            "for library-internal label values (e.g. the cardinality "
+            "overflow bucket)"
+        )
+    return tenant

@@ -1,0 +1,677 @@
+"""Tenant-attributed observability (ISSUE 14).
+
+Covers the identity layer (validation, the default-anon rule), the
+registry's label-cardinality guard, the exposition lint's label-value
+checks, the multi-window burn-rate monitor and its SLOConfig wiring,
+tenant plumbing through the serving queue, per-tenant snapshot merging
+across the fleet spool flush/merge path, streaming session lifecycle
+tracing, and the acceptance pin that attribution is host-side only
+(tenant on/off lowers byte-identical StableHLO, zero extra compiles).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from libpga_tpu.config import BurnRateConfig, PGAConfig, SLOConfig
+from libpga_tpu.utils import metrics as M
+from libpga_tpu.utils import telemetry as T
+from libpga_tpu.utils.tenancy import ANON, OVERFLOW, validate_tenant
+
+CFG = PGAConfig(use_pallas=False)
+
+
+# ------------------------------------------------------------- identity
+
+
+class TestValidateTenant:
+    def test_none_is_anon(self):
+        assert validate_tenant(None) == ANON == "anon"
+
+    @pytest.mark.parametrize(
+        "ok", ["anon", "team-a", "u.123", "A_b-c.d", "x" * 64]
+    )
+    def test_label_safe_ids_pass(self, ok):
+        assert validate_tenant(ok) == ok
+
+    @pytest.mark.parametrize(
+        "bad",
+        ["", "a b", "x" * 65, "naïve", 'q"uote', "a/b", "-lead", ".lead"],
+    )
+    def test_unsafe_ids_rejected(self, bad):
+        with pytest.raises(ValueError, match="invalid tenant id"):
+            validate_tenant(bad)
+
+    def test_reserved_prefix_rejected(self):
+        with pytest.raises(ValueError, match="reserved"):
+            validate_tenant(OVERFLOW)
+
+
+# ---------------------------------------------------- cardinality guard
+
+
+class TestCardinalityGuard:
+    def _registry(self, limit=3):
+        r = M.MetricsRegistry()
+        r.label_cardinality_limit = limit
+        return r
+
+    def test_overflow_bucket_and_warn_once(self):
+        r = self._registry()
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            for i in range(8):
+                r.counter("x.hits", tenant=f"t{i}").bump()
+        guard_warnings = [
+            x for x in w if "distinct values" in str(x.message)
+        ]
+        assert len(guard_warnings) == 1  # once per label name, not per value
+        snap = r.snapshot()
+        series = {
+            tuple(sorted(c["labels"].items())): c["value"]
+            for c in snap["counters"]
+        }
+        # First 3 values kept their own series; the other 5 share one.
+        assert series[(("tenant", "t0"),)] == 1
+        assert series[(("tenant", OVERFLOW),)] == 5
+        assert r.label_overflow() == {"tenant": 5}
+
+    def test_overflow_gauge_in_snapshot(self):
+        r = self._registry(limit=1)
+        r.gauge("d", tenant="a").set(1)
+        r.gauge("d", tenant="b").set(1)
+        recs = [
+            g for g in r.snapshot()["gauges"]
+            if g["name"] == "registry.label_overflow"
+        ]
+        assert recs == [
+            {"name": "registry.label_overflow",
+             "labels": {"label": "tenant"}, "value": 1.0}
+        ]
+
+    def test_existing_values_unaffected_past_cap(self):
+        r = self._registry(limit=2)
+        a = r.counter("c", tenant="a")
+        r.counter("c", tenant="b")
+        r.counter("c", tenant="c")  # overflows
+        assert r.counter("c", tenant="a") is a  # still its own series
+
+    def test_reset_clears_guard_state(self):
+        r = self._registry(limit=1)
+        r.counter("c", tenant="a")
+        r.counter("c", tenant="b")
+        r.reset()
+        assert r.label_overflow() == {}
+        r.counter("c", tenant="z")  # fits again after reset
+
+
+# ------------------------------------------------------ exposition lint
+
+
+class TestExpositionLint:
+    def test_clean_labeled_exposition_passes(self):
+        r = M.MetricsRegistry()
+        r.counter("ok.hits", tenant="team-a").bump()
+        r.histogram("ok.ms", tenant="team-a").observe(3.0)
+        assert M.lint_prometheus(M.prometheus_text(r.snapshot())) == []
+
+    def test_control_char_label_value_flagged(self):
+        bad = 'pga_x{tenant="a\\nb"} 1\n'
+        errors = M.lint_prometheus(bad)
+        assert any("not prometheus-safe" in e.replace(
+            "not prometheus-safe", "not prometheus-safe"
+        ) for e in errors)
+
+    def test_non_ascii_label_value_flagged(self):
+        errors = M.lint_prometheus('pga_x{tenant="naïve"} 1\n')
+        assert any("prometheus-safe" in e for e in errors)
+
+    def test_overflow_label_value_flagged(self):
+        errors = M.lint_prometheus('pga_x{tenant="_overflow"} 1\n')
+        assert any("cardinality guard" in e for e in errors)
+
+    def test_le_histogram_label_not_confused_with_overflow(self):
+        r = M.MetricsRegistry()
+        r.histogram("h.ms").observe(1.0)
+        assert M.lint_prometheus(M.prometheus_text(r.snapshot())) == []
+
+    def test_guarded_registry_exposition_is_flagged_end_to_end(self):
+        r = M.MetricsRegistry()
+        r.label_cardinality_limit = 1
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            r.counter("c", tenant="a").bump()
+            r.counter("c", tenant="b").bump()
+        errors = M.lint_prometheus(M.prometheus_text(r.snapshot()))
+        assert any("cardinality guard" in e for e in errors)
+
+
+# ------------------------------------------------------------ burn rate
+
+
+class TestBurnRateMonitor:
+    def _monitor(self, **kw):
+        self.t = [0.0]
+        kw.setdefault("budget", 0.1)
+        kw.setdefault("fast_window_s", 10.0)
+        kw.setdefault("slow_window_s", 40.0)
+        kw.setdefault("threshold", 5.0)
+        return M.BurnRateMonitor(clock=lambda: self.t[0], **kw)
+
+    def test_burn_is_rate_over_budget(self):
+        mon = self._monitor()
+        for i in range(10):
+            self.t[0] += 0.5
+            mon.record("a", violated=(i % 2 == 0))
+        b = mon.burn("a")
+        assert b["fast_burn"] == pytest.approx(0.5 / 0.1)
+        assert b["fast_samples"] == 10
+
+    def test_alert_needs_both_windows(self):
+        mon = self._monitor()
+        # Violations confined to the distant past: outside the fast
+        # window but inside the slow one — no alert (sustained-and-
+        # current is what the two windows encode).
+        for _ in range(10):
+            self.t[0] += 1.0
+            mon.record("a", True)
+        self.t[0] += 25.0  # past the fast window, within the slow one
+        mon.record("a", False)
+        b = mon.burn("a")
+        assert b["fast_burn"] == 0.0 and b["slow_burn"] > 5.0
+        assert mon.check() == []
+
+    def test_alert_transition_edge_and_rearm(self):
+        mon = self._monitor()
+        for _ in range(6):
+            self.t[0] += 1.0
+            mon.record("a", True)
+        alerts = mon.check()
+        assert [a["tenant"] for a in alerts] == ["a"]
+        assert mon.check() == []  # still hot: no re-alert
+        self.t[0] += 100.0  # everything ages out of both windows
+        mon.record("a", False)
+        assert mon.check() == []  # recovered: re-armed
+        for _ in range(6):
+            self.t[0] += 1.0
+            mon.record("a", True)
+        assert len(mon.check()) == 1  # fresh excursion alerts again
+
+    def test_min_samples_gate(self):
+        mon = self._monitor(min_samples=5)
+        for _ in range(4):
+            self.t[0] += 1.0
+            mon.record("a", True)
+        assert mon.check() == []  # burning, but under min_samples
+        self.t[0] += 1.0
+        mon.record("a", True)
+        assert len(mon.check()) == 1
+
+    def test_tenants_isolated(self):
+        mon = self._monitor()
+        for _ in range(6):
+            self.t[0] += 1.0
+            mon.record("hot", True)
+            mon.record("cold", False)
+        assert [a["tenant"] for a in mon.check()] == ["hot"]
+        assert not mon.alerting("cold")
+
+
+class TestSLOConfigTenants:
+    def test_for_tenant_resolves_override(self):
+        base = SLOConfig(
+            p99_latency_ms=100.0,
+            tenants={"vip": SLOConfig(p99_latency_ms=10.0)},
+        )
+        assert base.for_tenant("vip").p99_latency_ms == 10.0
+        assert base.for_tenant("other") is base
+        assert base.for_tenant(None) is base
+
+    def test_override_inherits_base_burn(self):
+        burn = BurnRateConfig(objective_ms=50.0)
+        base = SLOConfig(
+            burn=burn, tenants={"vip": SLOConfig(p99_latency_ms=10.0)}
+        )
+        assert base.for_tenant("vip").burn is burn
+        own = BurnRateConfig(objective_ms=5.0)
+        base2 = SLOConfig(
+            burn=burn, tenants={"vip": SLOConfig(burn=own)}
+        )
+        assert base2.for_tenant("vip").burn is own
+
+    def test_nested_overrides_rejected(self):
+        inner = SLOConfig(tenants={"x": SLOConfig()})
+        with pytest.raises(ValueError, match="nest"):
+            SLOConfig(tenants={"vip": inner})
+
+    def test_burn_config_validation(self):
+        with pytest.raises(ValueError):
+            BurnRateConfig(budget=0.0)
+        with pytest.raises(ValueError):
+            BurnRateConfig(fast_window_s=100.0, slow_window_s=10.0)
+        with pytest.raises(ValueError):
+            BurnRateConfig(threshold=0.0)
+
+
+# --------------------------------------------------- serving queue path
+
+
+@pytest.fixture
+def queue_env():
+    from libpga_tpu.config import ServingConfig
+    from libpga_tpu.serving.batch import BatchedRuns
+    from libpga_tpu.serving.queue import RunQueue
+
+    registry = M.MetricsRegistry()
+    ex = BatchedRuns("onemax", config=CFG)
+    q = RunQueue(
+        ex, serving=ServingConfig(max_batch=4, max_wait_ms=0),
+        registry=registry,
+    )
+    yield q, registry
+    q.close()
+
+
+class TestQueueTenancy:
+    def _req(self, seed=0):
+        from libpga_tpu.serving.batch import RunRequest
+
+        return RunRequest(size=128, genome_len=8, n=2, seed=seed)
+
+    def test_ticket_carries_validated_tenant(self, queue_env):
+        q, _ = queue_env
+        t = q.submit(self._req(), tenant="team-a")
+        anon = q.submit(self._req(1))
+        q.drain()
+        t.result(timeout=300)
+        anon.result(timeout=300)
+        assert t.tenant == "team-a" and t.timing.tenant == "team-a"
+        assert anon.tenant == ANON and anon.timing.tenant == ANON
+        # latency() stays the pure breakdown (round-11 contract).
+        assert "tenant" not in t.latency()
+
+    def test_invalid_tenant_rejected_at_submit(self, queue_env):
+        q, _ = queue_env
+        with pytest.raises(ValueError, match="invalid tenant"):
+            q.submit(self._req(), tenant="bad tenant!")
+        assert q.pending == 0  # nothing leaked into backpressure
+
+    def test_per_tenant_series_and_gauges(self, queue_env):
+        q, registry = queue_env
+        for seed, tenant in enumerate(["a", "a", "b"]):
+            q.submit(self._req(seed), tenant=tenant)
+        q.drain()
+        snap = registry.snapshot()
+        counters = {
+            (c["name"], c["labels"].get("tenant")): c["value"]
+            for c in snap["counters"]
+        }
+        assert counters[("serving.tenant.submissions", "a")] == 2
+        assert counters[("serving.tenant.submissions", "b")] == 1
+        gauges = {
+            (g["name"], g["labels"].get("tenant")): g["value"]
+            for g in snap["gauges"]
+        }
+        assert ("serving.tenant.pending", "a") in gauges
+
+    def test_completion_histograms_and_events_labeled(self, queue_env):
+        q, registry = queue_env
+        ticket = q.submit(self._req(), tenant="team-a")
+        q.drain()
+        ticket.result(timeout=300)
+        snap = registry.snapshot()
+        hists = {
+            (h["name"], h["labels"].get("tenant")): h["count"]
+            for h in snap["histograms"]
+        }
+        assert hists[("serving.tenant.e2e_ms", "team-a")] == 1
+        assert hists[("serving.tenant.queue_wait_ms", "team-a")] == 1
+        done = [
+            r for r in T.FLIGHT.records() if r["event"] == "ticket_done"
+        ]
+        assert done and done[-1]["tenant"] == "team-a"
+
+    def test_tenant_admit_emitted_once(self, queue_env):
+        q, _ = queue_env
+        T.FLIGHT.clear()
+        q.submit(self._req(0), tenant="once")
+        q.submit(self._req(1), tenant="once")
+        q.drain()
+        admits = [
+            r for r in T.FLIGHT.records()
+            if r["event"] == "tenant_admit" and r["tenant"] == "once"
+        ]
+        assert len(admits) == 1 and admits[0]["where"] == "serving_queue"
+
+    def test_dead_letter_attributed(self, queue_env):
+        from libpga_tpu.serving.batch import RunRequest
+
+        q, registry = queue_env
+        bad = q.submit(
+            RunRequest(size=128, genome_len=8, n=2, seed=9,
+                       genomes=np.zeros((3, 3), np.float32)),
+            tenant="clumsy",
+        )
+        q.drain()
+        with pytest.raises(ValueError):
+            bad.result(timeout=300)
+        snap = registry.snapshot()
+        counters = {
+            (c["name"], c["labels"].get("tenant")): c["value"]
+            for c in snap["counters"]
+        }
+        assert counters[("serving.tenant.dead_letters", "clumsy")] == 1
+
+    def test_tenant_burn_and_check_slo(self):
+        from libpga_tpu.config import ServingConfig
+        from libpga_tpu.serving.batch import BatchedRuns
+        from libpga_tpu.serving.queue import RunQueue
+
+        registry = M.MetricsRegistry()
+        burn = BurnRateConfig(
+            objective_ms=1e-4, budget=0.5, fast_window_s=30,
+            slow_window_s=60, threshold=1.5, min_samples=1,
+        )
+        slo = SLOConfig(tenants={"slow": SLOConfig(burn=burn)})
+        q = RunQueue(
+            BatchedRuns("onemax", config=CFG),
+            serving=ServingConfig(max_batch=4, max_wait_ms=0),
+            slo=slo, registry=registry,
+        )
+        try:
+            t1 = q.submit(self._req(0), tenant="slow")
+            t2 = q.submit(self._req(1), tenant="fast")
+            q.drain()
+            t1.result(timeout=300)
+            t2.result(timeout=300)
+            violations = q.check_slo(tenant="slow")
+            assert any(
+                v["what"] == "tenant_burn_rate" for v in violations
+            )
+            assert q.check_slo(tenant="fast") == []
+            gauges = {
+                (g["labels"].get("tenant"), g["labels"].get("window"))
+                for g in registry.snapshot()["gauges"]
+                if g["name"] == "serving.tenant.slo_burn"
+            }
+            assert ("slow", "fast") in gauges and ("slow", "slow") in gauges
+        finally:
+            q.close()
+
+
+# ------------------------------------------- spool flush / merge (fleet)
+
+
+class TestTenantSnapshotMerge:
+    def _snap(self, tenants):
+        r = M.MetricsRegistry()
+        for tenant, values in tenants.items():
+            for v in values:
+                r.histogram(
+                    "serving.tenant.e2e_ms", tenant=tenant
+                ).observe(v)
+            r.counter(
+                "serving.tenant.completions", tenant=tenant
+            ).bump(len(values))
+        return r.snapshot()
+
+    def test_labels_preserved_through_spool_flush_merge(self, tmp_path):
+        from libpga_tpu.serving.fleet import (
+            Spool, merge_spool_metrics, write_metrics_file,
+        )
+
+        spool = Spool(str(tmp_path / "spool"))
+        write_metrics_file(
+            spool, "w0", self._snap({"a": [1.0, 2.0], "b": [5.0]})
+        )
+        write_metrics_file(
+            spool, "w1", self._snap({"a": [3.0]})
+        )
+        merged = merge_spool_metrics(spool)
+        # Per-proc labeled series keep their tenant label...
+        labeled = {
+            (h["labels"].get("proc"), h["labels"].get("tenant")): h
+            for h in merged["histograms"]
+            if h["name"] == "serving.tenant.e2e_ms"
+            and "proc" in h["labels"]
+        }
+        assert labeled[("w0", "a")]["count"] == 2
+        assert labeled[("w1", "a")]["count"] == 1
+        # ...and the proc-free aggregates fold PER TENANT.
+        agg = {
+            h["labels"]["tenant"]: h for h in merged["histograms"]
+            if h["name"] == "serving.tenant.e2e_ms"
+            and "proc" not in h["labels"]
+        }
+        assert agg["a"]["count"] == 3 and agg["b"]["count"] == 1
+        counters = {
+            (c["labels"].get("proc"), c["labels"].get("tenant")):
+                c["value"]
+            for c in merged["counters"]
+            if c["name"] == "serving.tenant.completions"
+        }
+        assert counters[("w0", "b")] == 1
+
+    def test_mixed_tenant_merge_associative(self):
+        """Folding three mixed-tenant process snapshots in one call
+        equals folding the first pair's per-tenant aggregates with the
+        third via ``HistogramSnapshot.merge`` — per tenant."""
+        s1 = self._snap({"a": [1.0, 10.0]})
+        s2 = self._snap({"a": [100.0], "b": [2.0]})
+        s3 = self._snap({"a": [7.0], "b": [4.0, 8.0]})
+
+        def agg_of(merged):
+            return {
+                h["labels"]["tenant"]: M.HistogramSnapshot.from_dict(h)
+                for h in merged["histograms"]
+                if h["name"] == "serving.tenant.e2e_ms"
+                and "proc" not in h["labels"]
+            }
+
+        all_three = agg_of(
+            M.merge_snapshots([("p1", s1), ("p2", s2), ("p3", s3)])
+        )
+        pair = agg_of(M.merge_snapshots([("p1", s1), ("p2", s2)]))
+        third = agg_of(M.merge_snapshots([("p3", s3)]))
+        for tenant in ("a", "b"):
+            refolded = pair[tenant].merge(third[tenant]) if (
+                tenant in pair
+            ) else third[tenant]
+            assert all_three[tenant].counts == refolded.counts
+            assert all_three[tenant].sum == refolded.sum
+        assert all_three["a"].count == 4
+        assert all_three["b"].count == 3
+
+    def test_schema_version_refusal_still_applies(self):
+        s1 = self._snap({"a": [1.0]})
+        s2 = dict(self._snap({"b": [1.0]}), schema=99)
+        with pytest.raises(ValueError, match="refusing to merge"):
+            M.merge_snapshots([("p1", s1), ("p2", s2)])
+
+
+# ------------------------------------------------------ fleet ticket ids
+
+
+class TestFleetTicketTenant:
+    def test_ticket_normalizes_and_validates(self):
+        from libpga_tpu.serving.fleet import FleetTicket
+
+        t = FleetTicket(size=64, genome_len=8, n=1, seed=0)
+        assert t.tenant == ANON
+        t2 = FleetTicket(size=64, genome_len=8, n=1, seed=0,
+                         tenant="team-a")
+        assert t2.tenant == "team-a"
+        import dataclasses
+
+        assert dataclasses.asdict(t2)["tenant"] == "team-a"
+        with pytest.raises(ValueError, match="invalid tenant"):
+            FleetTicket(size=64, genome_len=8, n=1, seed=0,
+                        tenant="no way")
+
+
+# --------------------------------------------- session lifecycle tracing
+
+
+class TestSessionLifecycleTrace:
+    def test_spans_telescope_and_validate(self):
+        from libpga_tpu.streaming import EvolutionSession
+
+        s = EvolutionSession(
+            "onemax", 128, 8, seed=3, config=CFG, tenant="team-a"
+        )
+        s.ask(2)
+        s.tell(np.zeros((1, 8), np.float32), np.array([1.0], np.float32))
+        s.step(2)
+        spans = s.trace()
+        assert [r["span"] for r in spans] == ["open", "ask", "tell", "step"]
+        for rec in spans:
+            T.validate_event(rec)
+            assert rec["event"] == "session_span"
+            assert rec["tenant"] == "team-a"
+            assert rec["session"] == s.sid
+        # Telescoping: each span starts where the previous ended.
+        for prev, cur in zip(spans, spans[1:]):
+            assert cur["t0"] == prev["t1"]
+        assert s.trace_coverage() >= 0.95
+
+    def test_trace_survives_suspend_resume(self, tmp_path):
+        from libpga_tpu.streaming import EvolutionSession
+
+        s = EvolutionSession(
+            "onemax", 128, 8, seed=3, config=CFG, tenant="team-a"
+        )
+        s.step(1)
+        path = str(tmp_path / "sess.npz")
+        s.suspend(path)
+        assert os.path.exists(f"{path}.trace.jsonl")
+        back = EvolutionSession.resume(path, config=CFG)
+        assert back.tenant == "team-a"
+        back.step(1)
+        spans = [r["span"] for r in back.trace()]
+        assert spans == ["open", "step", "suspend", "resume", "step"]
+        assert back.trace_coverage() >= 0.95
+        for prev, cur in zip(back.trace(), back.trace()[1:]):
+            assert cur["t0"] == prev["t1"]
+
+    def test_group_step_keeps_each_sessions_trace(self):
+        from libpga_tpu.streaming import EvolutionSession, SessionGroup
+
+        sessions = [
+            EvolutionSession(
+                "onemax", 128, 8, seed=i, config=CFG,
+                tenant=f"g{i}",
+            )
+            for i in range(2)
+        ]
+        group = SessionGroup(sessions, tell_slots=2)
+        group.step(2)
+        for s in sessions:
+            assert [r["span"] for r in s.trace()] == ["open", "group_step"]
+            assert s.trace()[-1]["tenant"] == s.tenant
+
+    def test_store_discard_removes_trace_sidecar(self, tmp_path):
+        from libpga_tpu.streaming import EvolutionSession
+        from libpga_tpu.streaming.store import SessionStore
+
+        store = SessionStore(str(tmp_path / "store"))
+        s = EvolutionSession("onemax", 128, 8, seed=1, config=CFG)
+        store.suspend(s)
+        trace_path = f"{store.path(s.sid)}.trace.jsonl"
+        assert os.path.exists(trace_path)
+        store.discard(s.sid)
+        assert not os.path.exists(trace_path)
+
+    def test_suspend_meta_carries_tenant(self, tmp_path):
+        from libpga_tpu.streaming import EvolutionSession
+
+        s = EvolutionSession(
+            "onemax", 128, 8, seed=1, config=CFG, tenant="kept"
+        )
+        path = str(tmp_path / "m.npz")
+        s.suspend(path)
+        with open(f"{path}.session.json") as fh:
+            assert json.load(fh)["tenant"] == "kept"
+
+
+# --------------------------------------------- host-side-only acceptance
+
+
+class TestAttributionIsHostSideOnly:
+    def test_mega_run_stablehlo_byte_identical(self):
+        """The compiled serving program cannot see the tenant: the
+        canonical StableHLO digest of the bucket's mega-run is one and
+        the same whether the executor serves attributed or anonymous
+        traffic (there is nothing tenant-shaped to bake in — pinned
+        here so a future 'optimization' cannot quietly change that)."""
+        import dataclasses as _dc
+
+        import jax
+        import jax.numpy as jnp
+
+        from libpga_tpu.analysis import fingerprint
+        from libpga_tpu.config import ServingConfig
+        from libpga_tpu.serving.batch import BatchedRuns
+
+        shapes = (
+            jax.ShapeDtypeStruct((2, 64, 8), jnp.float32),
+            jax.ShapeDtypeStruct((2, 2), jnp.uint32),
+            jax.ShapeDtypeStruct((2,), jnp.int32),
+            jax.ShapeDtypeStruct((2,), jnp.float32),
+            jax.ShapeDtypeStruct((2, 1, 2), jnp.float32),
+        )
+        serving = _dc.replace(ServingConfig(), aot_warmup=False)
+
+        def build():
+            ex = BatchedRuns("onemax", config=CFG, serving=serving)
+            return ex._build_mega(2, 64, 8, "run_major")
+
+        fp = [fingerprint(build(), *shapes) for _ in range(2)]
+        assert fp[0] == fp[1]
+
+    def test_two_tenants_share_one_compiled_program(self):
+        from libpga_tpu.config import ServingConfig
+        from libpga_tpu.serving import COUNTERS
+        from libpga_tpu.serving.batch import BatchedRuns, RunRequest
+        from libpga_tpu.serving.queue import RunQueue
+
+        ex = BatchedRuns("onemax", config=CFG)
+        before = COUNTERS.snapshot().get("builds", 0)
+        q = RunQueue(
+            ex, serving=ServingConfig(max_batch=2, max_wait_ms=0),
+            registry=M.MetricsRegistry(),
+        )
+        try:
+            ta = q.submit(
+                RunRequest(size=96, genome_len=8, n=2, seed=1),
+                tenant="a",
+            )
+            tb = q.submit(
+                RunRequest(size=96, genome_len=8, n=2, seed=2),
+                tenant="b",
+            )
+            q.drain()
+            ra = np.asarray(ta.result(timeout=300).genomes)
+            tb.result(timeout=300)
+        finally:
+            q.close()
+        assert COUNTERS.snapshot().get("builds", 0) - before == 1
+        # And the attributed result is bit-identical to the anonymous
+        # one: attribution cannot touch the math.
+        q2 = RunQueue(
+            BatchedRuns("onemax", config=CFG),
+            serving=ServingConfig(max_batch=2, max_wait_ms=0),
+            registry=M.MetricsRegistry(),
+        )
+        try:
+            t_anon = q2.submit(RunRequest(size=96, genome_len=8, n=2,
+                                          seed=1))
+            q2.drain()
+            r_anon = np.asarray(t_anon.result(timeout=300).genomes)
+        finally:
+            q2.close()
+        assert np.array_equal(ra, r_anon)

@@ -107,6 +107,22 @@
 #                                 test_serving.c symbol coverage,
 #                                 retry-once snapshot shapes). Exits
 #                                 nonzero with file:line diagnostics.
+#  15. tenant smoke              — tools/tenant_smoke.py (ISSUE 14):
+#                                 tenant-attributed observability —
+#                                 two tenants through a real 4-worker
+#                                 fleet: the per-tenant expositions
+#                                 lint clean, an injected-slow tenant
+#                                 trips its multi-window burn-rate
+#                                 alert while the steady tenant stays
+#                                 green, per-tenant p99/queue-depth/
+#                                 burn are reconstructible from the
+#                                 spool alone (fleet_top --tenants,
+#                                 dead fleet), streaming session
+#                                 lifecycle spans tile >=95% across a
+#                                 suspend/resume re-hosting, and two
+#                                 tenants of one shape share ONE
+#                                 compiled program (attribution is
+#                                 host-side only).
 #  12. gp smoke                  — tools/gp_smoke.py (ISSUE 11):
 #                                 random-grown postfix programs are
 #                                 strictly well-formed and the GP
@@ -464,5 +480,8 @@ JAX_PLATFORMS=cpu python tools/streaming_smoke.py
 
 echo "== ci: static analysis =="
 JAX_PLATFORMS=cpu python tools/lint_pga.py --all
+
+echo "== ci: tenant smoke =="
+JAX_PLATFORMS=cpu python tools/tenant_smoke.py
 
 echo "== ci: all stages passed =="

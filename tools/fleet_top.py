@@ -11,6 +11,13 @@ same way; worker liveness then reads "dead").
     JAX_PLATFORMS=cpu python tools/fleet_top.py --spool DIR            # once
     JAX_PLATFORMS=cpu python tools/fleet_top.py --spool DIR --watch    # top-style
     JAX_PLATFORMS=cpu python tools/fleet_top.py --spool DIR --json     # raw dict
+    JAX_PLATFORMS=cpu python tools/fleet_top.py --spool DIR --tenants  # per-tenant
+
+``--tenants`` (ISSUE 14) renders the per-tenant view — queue depth
+(pending/claimed tickets from the batch files themselves), completions
+and dead letters, e2e/spool-wait percentiles from the merged
+tenant-labeled histograms, and the SLO burn-rate gauges — all
+reconstructed from the spool alone, live or post-mortem.
 
 Exit 0 on a renderable spool (even an empty one); nonzero only when
 the spool's on-disk snapshots are from an incompatible schema version
@@ -126,6 +133,38 @@ def render(status: dict, stale_after_s: float = 10.0) -> str:
     return "\n".join(lines) + "\n"
 
 
+def render_tenants(status: dict) -> str:
+    """The per-tenant screenful (``--tenants``) from a ``fleet_status``
+    dict — pure string building, like :func:`render`."""
+    tenants = status.get("tenants", {})
+    lines = [f"fleet spool {status['spool']} — tenants"]
+    if not tenants:
+        lines.append("  (no tenant-attributed work in this spool)")
+        return "\n".join(lines) + "\n"
+    lines.append(
+        f"{'tenant':<16}{'pend':>6}{'clmd':>6}{'done':>7}{'dead':>6}"
+        f"  {'e2e p50/p99 ms':>16}  {'wait p99':>9}"
+        f"  {'burn f/s':>12}{'alerts':>7}"
+    )
+    for tenant in sorted(tenants):
+        t = tenants[tenant]
+        e2e = t.get("e2e")
+        wait = t.get("spool_wait")
+        burn = t.get("burn") or {}
+        burn_s = (
+            "-" if not burn else
+            f"{burn.get('fast', 0):.1f}/{burn.get('slow', 0):.1f}"
+        )
+        lines.append(
+            f"{tenant:<16}{t.get('pending', 0):>6}{t.get('claimed', 0):>6}"
+            f"{t.get('completed', 0):>7}{t.get('dead_letters', 0):>6}"
+            f"  {'-' if not e2e else _fmt_ms(e2e['p50_ms']) + '/' + _fmt_ms(e2e['p99_ms']):>16}"
+            f"  {'-' if not wait else _fmt_ms(wait['p99_ms']):>9}"
+            f"  {burn_s:>12}{t.get('burn_alerts', 0):>7}"
+        )
+    return "\n".join(lines) + "\n"
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description=__doc__,
@@ -137,6 +176,8 @@ def main(argv=None) -> int:
     ap.add_argument("--interval", type=float, default=2.0)
     ap.add_argument("--json", action="store_true",
                     help="print the raw status dict instead of the table")
+    ap.add_argument("--tenants", action="store_true",
+                    help="render the per-tenant depth/latency/burn view")
     args = ap.parse_args(argv)
 
     from libpga_tpu.serving.fleet import fleet_status
@@ -149,6 +190,8 @@ def main(argv=None) -> int:
             return 1
         if args.json:
             out = json.dumps(status, indent=2, sort_keys=True, default=str)
+        elif args.tenants:
+            out = render_tenants(status)
         else:
             out = render(status)
         if args.watch:

@@ -1,0 +1,217 @@
+#!/usr/bin/env python
+"""Tenant-attributed observability smoke (ISSUE 14) — ci.sh stage 15.
+
+Two tenants through a real 4-worker fleet, end to end:
+
+1. **Attribution + burn-rate**: a ``steady`` tenant submits light
+   tickets under a lenient latency objective; a ``bursty`` tenant
+   submits heavy tickets under a tight per-tenant override. The bursty
+   tenant must trip its multi-window burn-rate alert (``slo_burn``
+   event + ``fleet.check_slo(tenant=...)`` violation) while the steady
+   tenant stays green — per-tenant SLOs isolating tenants is the whole
+   point of the layer.
+2. **Spool-only reconstruction**: after the fleet is CLOSED, per-tenant
+   p99 latency, queue depth, and burn gauges must be reconstructible
+   from the spool alone (``fleet_status``; ``tools/fleet_top.py
+   --tenants`` renders it), and the merged per-tenant Prometheus
+   exposition must pass ``tools/metrics_dump.py --check``.
+3. **Session lifecycle tracing**: one streaming session per tenant —
+   open → ask → tell → step → suspend → resume → step — must carry a
+   schema-valid span log tiling ≥95% of the session's lifetime across
+   the suspend/resume re-hosting.
+4. **Zero-compile attribution**: two tenants of one shape share one
+   compiled program — the tenant id is host-side labeling only.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    from libpga_tpu import PGAConfig
+    from libpga_tpu.config import BurnRateConfig, FleetConfig, SLOConfig
+    from libpga_tpu.serving.fleet import Fleet, FleetTicket, fleet_status
+    from libpga_tpu.utils import metrics as M
+    from libpga_tpu.utils import telemetry as T
+
+    tmp = tempfile.mkdtemp(prefix="pga-tenant-smoke-")
+    spool = os.path.join(tmp, "spool")
+    events_path = os.path.join(tmp, "events.jsonl")
+    log = T.EventLog(events_path)
+
+    # Per-tenant SLOs: the steady tenant's objective is unreachable
+    # (never violates); the bursty tenant's is far below its heavy
+    # tickets' real latency (every completion violates) — so its burn
+    # rate is deterministically over threshold while the steady
+    # tenant's budget never burns, regardless of this host's drift.
+    def burn(objective_ms: float) -> BurnRateConfig:
+        return BurnRateConfig(
+            objective_ms=objective_ms, budget=0.25, fast_window_s=60.0,
+            slow_window_s=120.0, threshold=2.0, min_samples=3,
+        )
+
+    slo = SLOConfig(
+        burn=burn(1e9),
+        tenants={"bursty": SLOConfig(burn=burn(5.0))},
+    )
+    fleet = Fleet(
+        spool, "onemax", config=PGAConfig(use_pallas=False),
+        fleet=FleetConfig(
+            n_workers=4, max_batch=2, max_wait_ms=5, lease_timeout_s=15.0,
+            heartbeat_s=0.3, poll_s=0.05, metrics_flush_s=0.3,
+        ),
+        events=log, slo=slo,
+    )
+    fleet.start()
+    handles = []
+    for i in range(4):
+        handles.append(fleet.submit(FleetTicket(
+            size=256, genome_len=16, n=2, seed=i, tenant="steady",
+        )))
+        handles.append(fleet.submit(FleetTicket(
+            size=256, genome_len=16, n=40, seed=100 + i, tenant="bursty",
+        )))
+    for h in handles:
+        h.result(timeout=600)
+
+    bursty = fleet.check_slo(tenant="bursty")
+    if not any(v["what"] == "fleet_tenant_burn_rate" for v in bursty):
+        sys.exit(f"bursty tenant did not trip its burn-rate alert: {bursty}")
+    steady = fleet.check_slo(tenant="steady")
+    if steady:
+        sys.exit(f"steady tenant flagged despite lenient SLO: {steady}")
+
+    # Per-ticket traces carry the tenant.
+    for h in handles[:2]:
+        for rec in h.trace():
+            T.validate_event(rec)
+        if not any(r.get("tenant") for r in h.trace()):
+            sys.exit("ticket trace lost its tenant attribution")
+
+    merged = fleet.merged_snapshot()
+    prom = M.prometheus_text(merged)
+    if 'tenant="steady"' not in prom or 'tenant="bursty"' not in prom:
+        sys.exit("merged exposition lacks per-tenant series")
+    prom_path = os.path.join(tmp, "merged.prom")
+    with open(prom_path, "w") as fh:
+        fh.write(prom)
+    fleet.flush_metrics()
+    fleet.close()
+    log.close()
+
+    # Event schema: tenant_admit for both tenants, slo_burn ONLY for
+    # the bursty one.
+    records = T.validate_log(events_path)
+    admits = {r["tenant"] for r in records if r["event"] == "tenant_admit"}
+    if admits != {"steady", "bursty"}:
+        sys.exit(f"tenant_admit events wrong: {admits}")
+    burn_tenants = {r["tenant"] for r in records if r["event"] == "slo_burn"}
+    if burn_tenants != {"bursty"}:
+        sys.exit(f"slo_burn fired for the wrong tenants: {burn_tenants}")
+
+    # Spool-only post-mortem: the fleet is closed; per-tenant p99,
+    # depth, and burn must come back from the files alone.
+    st = fleet_status(spool)
+    tenants = st.get("tenants", {})
+    for tenant in ("steady", "bursty"):
+        rec = tenants.get(tenant)
+        if rec is None:
+            sys.exit(f"dead-spool status lost tenant {tenant}")
+        if rec["completed"] != 4:
+            sys.exit(f"{tenant}: completed {rec['completed']} != 4")
+        if not rec["e2e"] or rec["e2e"]["p99_ms"] is None:
+            sys.exit(f"{tenant}: no e2e percentiles from the spool")
+        if "pending" not in rec or "claimed" not in rec:
+            sys.exit(f"{tenant}: no queue-depth fields from the spool")
+    if tenants["bursty"]["burn"].get("fast", 0.0) < 2.0:
+        sys.exit(f"bursty burn gauge not reconstructed: {tenants['bursty']}")
+    if tenants["steady"]["burn"].get("fast", 1.0) != 0.0:
+        sys.exit(f"steady tenant burning: {tenants['steady']}")
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run(
+        [sys.executable, "tools/metrics_dump.py", "--check", prom_path],
+        capture_output=True, text=True, env=env,
+    )
+    if proc.returncode != 0:
+        sys.exit(f"merged exposition lint failed:\n{proc.stdout}\n"
+                 f"{proc.stderr}")
+    proc = subprocess.run(
+        [sys.executable, "tools/fleet_top.py", "--spool", spool,
+         "--tenants"],
+        capture_output=True, text=True, env=env,
+    )
+    if proc.returncode != 0 or "bursty" not in proc.stdout:
+        sys.exit(f"fleet_top --tenants failed:\n{proc.stdout}\n"
+                 f"{proc.stderr}")
+
+    # Session lifecycle tracing: one session per tenant, spans tile
+    # >=95% across a suspend/resume re-hosting.
+    import numpy as np
+
+    from libpga_tpu.streaming import EnginePool, EvolutionSession
+
+    pool = EnginePool(config=PGAConfig(use_pallas=False))
+    for tenant in ("steady", "bursty"):
+        s = pool.acquire("onemax", 256, 16, seed=5, tenant=tenant)
+        s.ask(4)
+        s.tell(np.zeros((1, 16), np.float32), np.array([1.0], np.float32))
+        s.step(2)
+        path = os.path.join(tmp, f"{tenant}.ckpt.npz")
+        s.suspend(path)
+        pool.release(s)
+        back = EvolutionSession.resume(
+            path, config=PGAConfig(use_pallas=False)
+        )
+        back.step(2)
+        for rec in back.trace():
+            T.validate_event(rec)
+            if rec.get("tenant") != tenant:
+                sys.exit(f"session span lost tenant: {rec}")
+        cov = back.trace_coverage()
+        if cov < 0.95:
+            sys.exit(f"{tenant}: session spans tile {cov:.3f} < 0.95")
+        spans = [r["span"] for r in back.trace()]
+        if spans[:1] != ["open"] or "resume" not in spans:
+            sys.exit(f"{tenant}: span sequence wrong: {spans}")
+
+    # Zero-compile attribution: two tenants of one shape share one
+    # compiled mega-run program.
+    from libpga_tpu import ServingConfig
+    from libpga_tpu.serving import COUNTERS, BatchedRuns, RunQueue, RunRequest
+
+    ex = BatchedRuns("onemax", config=PGAConfig(use_pallas=False))
+    before = COUNTERS.snapshot().get("builds", 0)
+    with RunQueue(
+        ex, serving=ServingConfig(max_batch=2, max_wait_ms=0)
+    ) as q:
+        ta = q.submit(RunRequest(size=128, genome_len=8, n=2, seed=1),
+                      tenant="steady")
+        tb = q.submit(RunRequest(size=128, genome_len=8, n=2, seed=2),
+                      tenant="bursty")
+        q.drain()
+        ta.result(timeout=300)
+        tb.result(timeout=300)
+    builds = COUNTERS.snapshot().get("builds", 0) - before
+    if builds != 1:
+        sys.exit(f"two tenants of one shape built {builds} programs != 1")
+
+    print(
+        "tenant smoke OK: 8 tickets / 2 tenants through a 4-worker "
+        "fleet, bursty burn-rate alert fired (steady green), "
+        "per-tenant p99/depth/burn reconstructed from the dead spool, "
+        "merged exposition linted, session spans tiled >=95% across "
+        "resume, 1 compile for 2 tenants"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
