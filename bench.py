@@ -803,6 +803,110 @@ def fleet_arm(rounds: int = ROUNDS) -> dict:
     h.result(timeout=600)
     drain_resume_s = time.perf_counter() - t0
     dr.close()
+
+    # Fairness isolation A/B (ISSUE 15): the steady tenant's spool-wait
+    # p99 served ALONE vs with a CONCURRENT 12-ticket burst tenant —
+    # two identical 2-worker fleets with the weighted-fair scheduler,
+    # modes served adjacent within every round. The ratio is the
+    # latency-isolation figure ROADMAP item 1 asked for (1.0 = perfect
+    # isolation; the FIFO intake this round replaced had no bound at
+    # all — the burst simply served first).
+    from libpga_tpu.config import AutoscaleConfig, TenantPolicy
+
+    fair, fair_regs = {}, {}
+    for mode in ("alone", "contended"):
+        fair_regs[mode] = _metrics.MetricsRegistry()
+        fair[mode] = Fleet(
+            os.path.join(root, f"fair_{mode}"), "onemax", config=cfg,
+            fleet=FleetConfig(
+                n_workers=2, max_batch=2, max_wait_ms=2,
+                lease_timeout_s=30.0, heartbeat_s=0.5, poll_s=0.02,
+                sched_lookahead=1,
+                tenants={"steady": TenantPolicy(weight=2.0)},
+            ),
+            registry=fair_regs[mode],
+        )
+        fair[mode].start()
+        base = 95_000 if mode == "alone" else 95_500
+        serve(fair[mode], 4, base)  # width-2 warm
+        fair[mode].submit(FleetTicket(  # width-1 warm
+            size=FLEET_POP, genome_len=FLEET_LEN, n=FLEET_GENS,
+            seed=base + 900,
+        )).result(timeout=600)
+        fair_regs[mode].reset()
+    for rnd in range(rounds):
+        base = 100_000 + 1_000 * rnd
+        for mode in ("alone", "contended"):
+            f = fair[mode]
+            burst = []
+            if mode == "contended":
+                burst = [
+                    f.submit(FleetTicket(
+                        size=FLEET_POP, genome_len=FLEET_LEN,
+                        n=FLEET_GENS, seed=base + 100 + i,
+                    ), tenant="burst")
+                    for i in range(12)
+                ]
+            # Steady tickets awaited promptly: their spans must read
+            # fleet latency, not driver patience.
+            for i in range(4):
+                f.submit(FleetTicket(
+                    size=FLEET_POP, genome_len=FLEET_LEN, n=FLEET_GENS,
+                    seed=base + i,
+                ), tenant="steady").result(timeout=600)
+            for h2 in burst:
+                h2.result(timeout=600)
+    fair_p99 = {}
+    for mode in ("alone", "contended"):
+        snap = fair_regs[mode].histogram(
+            "fleet.tenant.spool_wait_ms", tenant="steady"
+        ).snapshot()
+        fair_p99[mode] = (
+            None if snap.count == 0 else snap.percentile(99.0)
+        )
+        fair[mode].close()
+    isolation_ratio = (
+        None
+        if not fair_p99["alone"] or fair_p99["contended"] is None
+        else round(fair_p99["contended"] / max(fair_p99["alone"], 1e-6), 3)
+    )
+
+    # Autoscale settle (ISSUE 15): a 1-worker floor fleet under an
+    # 8-ticket burst must scale up and, once idle, drain back to the
+    # floor; settle_s is the wall time from last result to floor.
+    az = Fleet(
+        os.path.join(root, "az"), "onemax", config=cfg,
+        fleet=FleetConfig(
+            n_workers=1, max_batch=1, max_wait_ms=2, poll_s=0.02,
+            lease_timeout_s=60.0, heartbeat_s=0.5,
+            autoscale=AutoscaleConfig(
+                min_workers=1, max_workers=3, target_backlog=1.0,
+                up_cooldown_s=0.3, down_cooldown_s=0.5,
+                idle_grace_s=0.5, check_s=0.1,
+            ),
+        ),
+        registry=_metrics.MetricsRegistry(),
+    )
+    az.start()
+    serve(az, 2, 98_000)  # warm the floor worker
+    az_handles = [
+        az.submit(FleetTicket(
+            size=FLEET_POP, genome_len=FLEET_LEN, n=FLEET_GENS,
+            seed=99_000 + i,
+        ))
+        for i in range(8)
+    ]
+    az_peak = 1
+    while not all(h.poll() for h in az_handles):
+        az_peak = max(az_peak, len(az.workers_alive()))
+        time.sleep(0.05)
+    for h in az_handles:
+        h.result(timeout=600)
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < 120 and len(az.workers_alive()) > 1:
+        time.sleep(0.05)
+    autoscale_settle_s = time.perf_counter() - t0
+    az.close()
     shutil.rmtree(root, ignore_errors=True)
 
     med = {w: _median_iqr(xs) for w, xs in samples.items()}
@@ -829,6 +933,18 @@ def fleet_arm(rounds: int = ROUNDS) -> dict:
         ),
         "fleet_trace_overhead_pct_median": round(trace_med, 2),
         "fleet_trace_overhead_pct_iqr": round(trace_iqr, 2),
+        # ISSUE 15: weighted-fair scheduling + autoscaling figures.
+        "fleet_fairness_isolation_ratio": isolation_ratio,
+        "fleet_fairness_steady_p99_alone_ms": (
+            None if fair_p99["alone"] is None
+            else round(fair_p99["alone"], 2)
+        ),
+        "fleet_fairness_steady_p99_contended_ms": (
+            None if fair_p99["contended"] is None
+            else round(fair_p99["contended"], 2)
+        ),
+        "fleet_autoscale_settle_s": round(autoscale_settle_s, 3),
+        "fleet_autoscale_peak_workers": az_peak,
         "fleet_note": (
             "runs/sec of whole fleet round trips (submit -> spool "
             "batch -> worker mega-run -> published result) at 1/4/8 "
@@ -846,7 +962,15 @@ def fleet_arm(rounds: int = ROUNDS) -> dict:
             "fleet_trace_overhead_pct_median is the interleaved "
             "tracing-on vs tracing-off A/B on identical 2-worker "
             "fleets — acceptance bar: within this host's CPU drift "
-            "floor (~4%, BASELINE.md), direction-only below that"
+            "floor (~4%, BASELINE.md), direction-only below that. "
+            "fleet_fairness_isolation_ratio (ISSUE 15) is the steady "
+            "tenant's spool-wait p99 with a concurrent 12-ticket "
+            "burst vs alone (adjacent within every round) under the "
+            "weighted-fair scheduler — 1.0 = perfect isolation; "
+            "fleet_autoscale_settle_s is the wall seconds an "
+            "autoscaled fleet takes to drain from its burst peak "
+            "(fleet_autoscale_peak_workers) back to the 1-worker "
+            "floor after the last result"
         ),
     }
     for w in FLEET_WIDTHS:

@@ -370,12 +370,21 @@ int pga_fleet_start(const char *spool_dir, const char *objective,
 pga_fleet_ticket_t *pga_fleet_submit(unsigned size, unsigned genome_len,
                                      unsigned n, long seed,
                                      unsigned checkpoint_every,
-                                     const char *tenant) {
-    long tid = call_long("fleet_submit", "(IIIlIs)", size, genome_len, n,
-                         seed, checkpoint_every, tenant ? tenant : "");
+                                     int priority, const char *tenant) {
+    long tid = call_long("fleet_submit", "(IIIlIis)", size, genome_len, n,
+                         seed, checkpoint_every, priority,
+                         tenant ? tenant : "");
     return tid <= 0 ? nullptr
                     : reinterpret_cast<pga_fleet_ticket_t *>(
                           static_cast<intptr_t>(tid));
+}
+
+int pga_fleet_tenant_policy(const char *tenant, float weight,
+                            long max_pending, int priority) {
+    if (!tenant) return -1;
+    return static_cast<int>(call_long(
+        "fleet_tenant_policy", "(sdli)", tenant,
+        static_cast<double>(weight), max_pending, priority));
 }
 
 int pga_fleet_await(pga_fleet_ticket_t *t, float *best, double timeout_s) {

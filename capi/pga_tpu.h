@@ -429,11 +429,24 @@ long pga_metrics_snapshot(char *buf, unsigned long cap);
  * from `seed`, `n` generations); `checkpoint_every` > 0 makes the
  * ticket SUPERVISED — executed under the supervisor at that
  * auto-checkpoint cadence, so drains and worker deaths resume it from
- * the last durable chunk boundary. `tenant` attributes the ticket
+ * the last durable chunk boundary. `priority` picks the scheduling
+ * lane (0-9, higher claims first and may preempt a lower-priority
+ * supervised batch at a chunk boundary; < 0 = the tenant policy's
+ * default lane). `tenant` attributes the ticket
  * (NULL = "anon"; see the tenant-attribution block above) — the id
  * rides the batch file to the worker and back in the result meta, so
  * the merged fleet snapshot carries per-tenant latency histograms,
- * queue gauges, and burn-rate series. Returns a ticket or NULL.
+ * queue gauges, and burn-rate series. Returns a ticket or NULL — NULL
+ * also when the tenant is at its pga_fleet_tenant_policy quota
+ * (deterministic shed; the installed fleet state is unchanged and
+ * later submits succeed once outstanding work completes).
+ *
+ * pga_fleet_tenant_policy installs (or replaces) one tenant's
+ * scheduling policy on the live fleet (ISSUE 15): `weight` is the
+ * tenant's deficit-round-robin service share (> 0), `max_pending` its
+ * submission quota (<= 0 = unlimited; a breach makes pga_fleet_submit
+ * return NULL deterministically), `priority` its default lane (0-9).
+ * Returns 0, or -1 on invalid values / no running fleet.
  *
  * pga_fleet_await blocks (up to timeout_s; <= 0 = forever) for one
  * ticket, releases it, writes the best objective value into *best
@@ -475,7 +488,9 @@ int pga_fleet_start(const char *spool_dir, const char *objective,
 pga_fleet_ticket_t *pga_fleet_submit(unsigned size, unsigned genome_len,
                                      unsigned n, long seed,
                                      unsigned checkpoint_every,
-                                     const char *tenant);
+                                     int priority, const char *tenant);
+int pga_fleet_tenant_policy(const char *tenant, float weight,
+                            long max_pending, int priority);
 int pga_fleet_await(pga_fleet_ticket_t *t, float *best, double timeout_s);
 int pga_fleet_await_ex(pga_fleet_ticket_t *t, float *best,
                        float latency_ms[6], double timeout_s);

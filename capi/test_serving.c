@@ -189,11 +189,35 @@ int main(void) {
          * batch files to the workers and back in the result metas, so
          * the merged snapshot below must carry both tenant slices. */
         pga_fleet_ticket_t *f1 =
-            pga_fleet_submit(POP, LEN, GENS, 42, 0, "fleet-ten-a");
+            pga_fleet_submit(POP, LEN, GENS, 42, 0, -1, "fleet-ten-a");
         pga_fleet_ticket_t *f2 =
-            pga_fleet_submit(POP, LEN, 2 * GENS, 43, GENS, "fleet-ten-b");
+            pga_fleet_submit(POP, LEN, 2 * GENS, 43, GENS, -1,
+                             "fleet-ten-b");
         if (!f1 || !f2)
             return fprintf(stderr, "pga_fleet_submit failed\n"), 1;
+        /* Admission control (ISSUE 15): install a quota of 1 for a
+         * third tenant — its first submit admits, the second sheds
+         * DETERMINISTICALLY (NULL ticket), and the installed fleet
+         * state stays intact: the admitted ticket still completes and
+         * every other tenant is untouched. Bad policy values error
+         * without clobbering the installed policy. */
+        if (pga_fleet_tenant_policy("fleet-ten-q", 2.0f, 1, 0) != 0)
+            return fprintf(stderr, "pga_fleet_tenant_policy failed\n"), 1;
+        if (pga_fleet_tenant_policy("fleet-ten-q", -1.0f, 1, 0) == 0)
+            return fprintf(stderr, "bad tenant weight accepted\n"), 1;
+        pga_fleet_ticket_t *q1 =
+            pga_fleet_submit(POP, LEN, GENS, 44, 0, 1, "fleet-ten-q");
+        if (!q1)
+            return fprintf(stderr, "quota tenant first submit failed\n"), 1;
+        if (pga_fleet_submit(POP, LEN, GENS, 45, 0, 1, "fleet-ten-q"))
+            return fprintf(stderr, "quota breach not shed\n"), 1;
+        float bestq = -1.0f;
+        if (pga_fleet_await(q1, &bestq, 300.0) != GENS)
+            return fprintf(stderr, "quota tenant await failed\n"), 1;
+        if (!(bestq >= 0.0f && bestq <= (float)LEN))
+            return fprintf(stderr, "quota tenant best %g out of range\n",
+                           (double)bestq),
+                   1;
         /* Ticket 1 through the observability-extended await (ISSUE 9):
          * same release semantics, plus the six-span cross-process
          * breakdown — every span finite with tracing on (the default),

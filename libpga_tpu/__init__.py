@@ -24,6 +24,7 @@ Design stance (TPU-first, not a port):
 """
 
 from libpga_tpu.config import (
+    AutoscaleConfig,
     FleetConfig,
     GPConfig,
     PBTConfig,
@@ -31,6 +32,7 @@ from libpga_tpu.config import (
     ServingConfig,
     SLOConfig,
     StreamingConfig,
+    TenantPolicy,
 )
 from libpga_tpu.population import Population
 from libpga_tpu.engine import PGA
@@ -76,6 +78,8 @@ __all__ = [
     "ServingConfig",
     "SLOConfig",
     "FleetConfig",
+    "TenantPolicy",
+    "AutoscaleConfig",
     "StreamingConfig",
     "PBTConfig",
     "Population",

@@ -1148,12 +1148,16 @@ def fleet_start(
 
 def fleet_submit(
     size: int, genome_len: int, n: int, seed: int,
-    checkpoint_every: int, tenant: str = "",
+    checkpoint_every: int, priority: int = -1, tenant: str = "",
 ) -> int:
     """``pga_fleet_submit``: admit one ticket to the process-global
     fleet; returns a ticket id (> 0). ``checkpoint_every`` > 0 makes
-    the ticket supervised (drain-safe at that cadence). ``tenant``
-    attributes it (ISSUE 14; empty string = ``anon``)."""
+    the ticket supervised (drain-safe at that cadence). ``priority``
+    picks the scheduling lane (ISSUE 15; negative = the tenant
+    policy's default). ``tenant`` attributes it (ISSUE 14; empty
+    string = ``anon``). A tenant at its quota raises
+    :class:`~libpga_tpu.serving.scheduler.QuotaExceeded` — the C side
+    sees a NULL ticket with the installed fleet state intact."""
     global _next_fleet_ticket
     from libpga_tpu.serving.fleet import FleetTicket
 
@@ -1162,12 +1166,33 @@ def fleet_submit(
     handle = _fleet.submit(FleetTicket(
         size=int(size), genome_len=int(genome_len), n=int(n),
         seed=int(seed), checkpoint_every=int(checkpoint_every),
+        priority=None if priority < 0 else int(priority),
         tenant=tenant or None,
     ))
     tid = _next_fleet_ticket
     _next_fleet_ticket += 1
     _fleet_handles[tid] = handle
     return tid
+
+
+def fleet_tenant_policy(
+    tenant: str, weight: float, max_pending: int, priority: int,
+) -> int:
+    """``pga_fleet_tenant_policy``: install or replace one tenant's
+    scheduling policy (ISSUE 15) on the process-global fleet —
+    deficit-round-robin ``weight``, submission quota ``max_pending``
+    (<= 0 = unlimited), default priority lane. Invalid values raise
+    (the C side sees -1) and leave the installed policies intact."""
+    from libpga_tpu.config import TenantPolicy
+
+    if _fleet is None:
+        raise ValueError("no fleet: call pga_fleet_start first")
+    _fleet.set_tenant_policy(tenant, TenantPolicy(
+        weight=float(weight),
+        max_pending=None if max_pending <= 0 else int(max_pending),
+        priority=int(priority),
+    ))
+    return 0
 
 
 def fleet_await(ticket_id: int, timeout_s: float) -> bytes:

@@ -303,6 +303,115 @@ class ServingConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class TenantPolicy:
+    """Per-tenant scheduling policy for the elastic fleet (ISSUE 15).
+
+    One entry of ``FleetConfig.tenants`` — how the coordinator's
+    weighted-fair scheduler (``serving/scheduler.py``) treats one
+    tenant's tickets. Tenants without an entry run under the default
+    policy (weight 1, no quota, priority 0), so enabling scheduling
+    never changes behavior for unconfigured tenants.
+
+    Attributes:
+      weight: deficit-round-robin service share. A tenant with weight 2
+        accrues scheduling credit twice as fast as a weight-1 tenant,
+        so under contention it is served ~2x as often. Must be > 0.
+      max_pending: per-tenant submission quota — the admission-control
+        bound on this tenant's submitted-but-incomplete tickets.
+        Breaching it raises
+        :class:`~libpga_tpu.serving.scheduler.QuotaExceeded`
+        DETERMINISTICALLY (never blocks, unlike the fleet-wide
+        ``max_pending``) and emits one ``quota_reject`` event.
+        ``None`` = unlimited.
+      priority: scheduling lane, 0-9 (higher = more urgent). Lanes are
+        served strictly priority-first: batch files sort so workers
+        claim higher lanes before lower ones, and a high-priority
+        arrival may preempt a worker busy on a lower-priority
+        SUPERVISED batch (chunk-boundary drain, bit-identical resume —
+        the round-13 machinery). Fairness (the DRR weights) applies
+        WITHIN a lane; across lanes priority wins, which is the point.
+        A ticket's own ``priority`` field overrides this default.
+    """
+
+    weight: float = 1.0
+    max_pending: Optional[int] = None
+    priority: int = 0
+
+    def __post_init__(self):
+        if not (self.weight > 0.0 and self.weight == self.weight):
+            raise ValueError("weight must be a positive number")
+        if self.max_pending is not None and self.max_pending < 1:
+            raise ValueError("max_pending must be >= 1 or None")
+        if not (0 <= int(self.priority) <= 9):
+            raise ValueError("priority must be in [0, 9]")
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscaleConfig:
+    """Closed-loop worker autoscaling for the fleet coordinator
+    (ISSUE 15): a policy thread spawns/retires workers from the signals
+    the fleet already exports — claimable backlog, spool-wait p99,
+    per-tenant SLO burn alerts, straggler health — with hysteresis and
+    cooldowns so worker count follows offered load up AND down without
+    flapping. Scale-down always DRAINS (SIGTERM, chunk-boundary
+    checkpoint, lease return) and never kills, so results stay
+    bit-identical to a fixed-size fleet on the same seeds.
+
+    Attributes:
+      min_workers: the floor the fleet drains back to when idle.
+      max_workers: hard ceiling on concurrently live workers.
+      target_backlog: scale-up threshold — claimable batches (pending
+        spool files + queued coordinator batches) per live worker the
+        fleet tolerates before adding capacity. The DOWN condition is
+        deliberately far away (complete idleness for ``idle_grace_s``),
+        which is the hysteresis band.
+      spool_wait_p99_ms: optional latency up-trigger: scale up when the
+        coordinator's cumulative ``fleet.ticket.spool_wait_ms`` p99
+        exceeds this. ``None`` disables the trigger.
+      up_cooldown_s / down_cooldown_s: minimum spacing between
+        consecutive scale-ups / scale-downs.
+      idle_grace_s: the fleet must be COMPLETELY idle (no queued
+        tickets, no pending or claimed batches) this long before one
+        worker is retired.
+      step: workers added/removed per decision.
+      check_s: policy-thread evaluation cadence.
+    """
+
+    min_workers: int = 1
+    max_workers: int = 4
+    target_backlog: float = 2.0
+    spool_wait_p99_ms: Optional[float] = None
+    up_cooldown_s: float = 2.0
+    down_cooldown_s: float = 5.0
+    idle_grace_s: float = 2.0
+    step: int = 1
+    check_s: float = 0.25
+
+    def __post_init__(self):
+        if self.min_workers < 0:
+            raise ValueError("min_workers must be >= 0")
+        if self.max_workers < max(self.min_workers, 1):
+            raise ValueError(
+                "max_workers must be >= max(min_workers, 1)"
+            )
+        if self.target_backlog <= 0:
+            raise ValueError("target_backlog must be > 0")
+        if (
+            self.spool_wait_p99_ms is not None
+            and self.spool_wait_p99_ms <= 0
+        ):
+            raise ValueError("spool_wait_p99_ms must be > 0 or None")
+        if self.up_cooldown_s < 0 or self.down_cooldown_s < 0:
+            raise ValueError("cooldowns must be >= 0")
+        if self.idle_grace_s < 0:
+            raise ValueError("idle_grace_s must be >= 0")
+        if self.step < 1:
+            raise ValueError("step must be >= 1")
+        if self.check_s <= 0:
+            raise ValueError("check_s must be > 0")
+
+
+@dataclasses.dataclass(frozen=True)
 class FleetConfig:
     """Settings for the cross-process serving fleet (``serving/fleet.py``).
 
@@ -373,6 +482,29 @@ class FleetConfig:
         buckets AOT-compile their best-known kernel configs. ``None``
         (default) = untuned — workers run the stock resolution unless
         their environment already carries ``PGA_TUNING_DB``.
+      tenants: per-tenant :class:`TenantPolicy` map (ISSUE 15) —
+        weights for the deficit-round-robin batch former, per-tenant
+        submission quotas, and priority-lane defaults. Unlisted
+        tenants get ``TenantPolicy()``; ``Fleet.set_tenant_policy``
+        adjusts policies on a live fleet.
+      autoscale: :class:`AutoscaleConfig` enabling the coordinator's
+        load-following worker autoscaler; ``None`` (default) keeps the
+        fixed ``n_workers`` pool.
+      sched_quantum: deficit credit a weight-1 tenant accrues per
+        scheduler rotation, in tickets. The fairness bound: a steady
+        tenant's next batch is delayed by a burst tenant's deep queue
+        by at most the release window plus ``1/quantum`` rotations.
+      sched_lookahead: claimable-batch release window per live worker —
+        the coordinator keeps at most ``sched_lookahead x
+        max(live_workers, 1)`` unclaimed batch files on the spool and
+        holds the rest back in its fair queues, so late-arriving
+        tenants compete against a bounded runway instead of a fully
+        spooled burst. ``Fleet.flush()`` overrides the window.
+      poll_idle_max_s: ceiling of the coordinator monitor's adaptive
+        idle backoff (ISSUE 15 satellite): with no queued work, no
+        outstanding tickets, and no claimed batches, the monitor's
+        poll interval doubles from ``poll_s`` up to this cap, and any
+        submission wakes it immediately.
     """
 
     n_workers: int = 2
@@ -390,6 +522,11 @@ class FleetConfig:
     straggler_factor: float = 3.0
     straggler_min_samples: int = 8
     tuning_db: Optional[str] = None
+    tenants: Optional[dict] = None
+    autoscale: Optional[AutoscaleConfig] = None
+    sched_quantum: float = 1.0
+    sched_lookahead: int = 2
+    poll_idle_max_s: float = 1.0
 
     def __post_init__(self):
         if self.n_workers < 1:
@@ -423,6 +560,23 @@ class FleetConfig:
             )
         if self.straggler_min_samples < 1:
             raise ValueError("straggler_min_samples must be >= 1")
+        if self.tenants is not None:
+            for tid, pol in self.tenants.items():
+                if not isinstance(pol, TenantPolicy):
+                    raise ValueError(
+                        f"tenants[{tid!r}] must be a TenantPolicy, "
+                        f"got {type(pol).__name__}"
+                    )
+        if self.autoscale is not None and not isinstance(
+            self.autoscale, AutoscaleConfig
+        ):
+            raise ValueError("autoscale must be an AutoscaleConfig or None")
+        if self.sched_quantum <= 0:
+            raise ValueError("sched_quantum must be > 0")
+        if self.sched_lookahead < 1:
+            raise ValueError("sched_lookahead must be >= 1")
+        if self.poll_idle_max_s < self.poll_s:
+            raise ValueError("poll_idle_max_s must be >= poll_s")
 
 
 @dataclasses.dataclass(frozen=True)

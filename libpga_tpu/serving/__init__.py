@@ -47,9 +47,24 @@ draining through the supervisor's checkpoint machinery. A worker killed
 mid-batch (SIGKILL included) has its lease expire and its batch re-run
 bit-identically on a survivor: seeds and runtime parameters travel with
 the ticket, never with the worker.
+
+Scheduling layer (ISSUE 15 — ``serving/scheduler.py``): the fleet's
+FIFO intake is replaced by per-tenant deficit-round-robin batch
+formation over priority lanes (:class:`~libpga_tpu.config.TenantPolicy`
+weights/quotas/priorities in ``FleetConfig.tenants``), deterministic
+per-tenant admission control (:class:`QuotaExceeded`), chunk-boundary
+preemption of lower-priority supervised batches, and a closed-loop
+:class:`~libpga_tpu.config.AutoscaleConfig` worker autoscaler that
+follows offered load up and down without changing a single result bit.
 """
 
-from libpga_tpu.config import FleetConfig, ServingConfig, SLOConfig
+from libpga_tpu.config import (
+    AutoscaleConfig,
+    FleetConfig,
+    ServingConfig,
+    SLOConfig,
+    TenantPolicy,
+)
 from libpga_tpu.serving.batch import BatchedRuns, RunRequest, RunResult
 from libpga_tpu.serving.cache import COUNTERS, PROGRAM_CACHE, ProgramCache
 from libpga_tpu.serving.fleet import (
@@ -69,6 +84,11 @@ from libpga_tpu.serving.queue import (
     RunTicket,
     TicketTiming,
 )
+from libpga_tpu.serving.scheduler import (
+    Autoscaler,
+    FleetScheduler,
+    QuotaExceeded,
+)
 
 __all__ = [
     "BatchedRuns",
@@ -82,6 +102,11 @@ __all__ = [
     "ServingConfig",
     "SLOConfig",
     "FleetConfig",
+    "TenantPolicy",
+    "AutoscaleConfig",
+    "FleetScheduler",
+    "Autoscaler",
+    "QuotaExceeded",
     "Fleet",
     "FleetTicket",
     "FleetHandle",

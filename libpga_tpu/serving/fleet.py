@@ -90,8 +90,15 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from libpga_tpu.config import FleetConfig, PGAConfig
+from libpga_tpu.config import FleetConfig, PGAConfig, TenantPolicy
 from libpga_tpu.serving.queue import QueueFull, TenantBurnTracker
+from libpga_tpu.serving.scheduler import (
+    Autoscaler,
+    DirWatch,
+    FleetScheduler,
+    QuotaExceeded,
+    SchedEntry,
+)
 from libpga_tpu.utils import metrics as _metrics
 from libpga_tpu.utils import telemetry as _tl
 from libpga_tpu.utils.tenancy import ANON, validate_tenant
@@ -225,6 +232,26 @@ class Spool:
 
     def ckpt_path(self, tid: str) -> str:
         return self.path("ckpt", f"{tid}.npz")
+
+    def preempt_path(self, batch_name: str) -> str:
+        """The batch's preemption marker (ISSUE 15): written by the
+        coordinator when a higher-priority batch needs the slot; the
+        worker's supervised stop hook checks it every chunk boundary
+        (the SIGTERM-drain discipline, without losing the process)."""
+        return self.path("leases", f"{batch_name}.preempt.json")
+
+    @staticmethod
+    def name_priority(batch_name: str) -> int:
+        """The scheduling priority encoded in a batch file name.
+        Priority rides the name as ``p<9-priority>`` so the plain
+        name sort workers claim by IS the priority order; pre-ISSUE-15
+        names (no prefix) read as priority 0."""
+        if (
+            len(batch_name) > 1 and batch_name[0] == "p"
+            and batch_name[1].isdigit()
+        ):
+            return 9 - int(batch_name[1])
+        return 0
 
     def trace_path(self, batch_name: str) -> str:
         """The batch's span-log file (``telemetry.append_trace`` /
@@ -633,7 +660,12 @@ class FleetTicket:
     tenant-labeled), comes back in the result meta and every trace
     span, and drives the coordinator's per-tenant latency/burn
     accounting. ``None`` → the default ``anon`` tenant; explicit ids
-    are validated label-safe here, at the submit boundary."""
+    are validated label-safe here, at the submit boundary.
+
+    ``priority`` (ISSUE 15) picks the scheduling lane explicitly;
+    ``None`` (default) inherits the tenant's ``TenantPolicy.priority``.
+    Higher lanes form and claim first, and may preempt a worker busy
+    on a lower-priority supervised batch."""
 
     size: int
     genome_len: int
@@ -645,6 +677,7 @@ class FleetTicket:
     checkpoint_every: int = 0
     max_retries: int = 1
     tenant: Optional[str] = None
+    priority: Optional[int] = None
 
     def __post_init__(self):
         if self.size < 1 or self.genome_len < 1:
@@ -657,6 +690,10 @@ class FleetTicket:
             raise ValueError("checkpoint_every must be >= 0")
         if self.max_retries < 0:
             raise ValueError("max_retries must be >= 0")
+        if self.priority is not None and not (
+            0 <= int(self.priority) <= 9
+        ):
+            raise ValueError("priority must be in [0, 9] or None")
         object.__setattr__(self, "tenant", validate_tenant(self.tenant))
 
 
@@ -767,14 +804,6 @@ def _now() -> float:
 # ------------------------------------------------------------- coordinator
 
 
-class _Bucket:
-    __slots__ = ("tickets", "oldest")
-
-    def __init__(self):
-        self.tickets: List[Tuple[str, FleetTicket]] = []
-        self.oldest: float = _now()
-
-
 class Fleet:
     """Coordinator of a cross-process serving fleet.
 
@@ -824,7 +853,12 @@ class Fleet:
         self.slo = slo  # fleet-level SLOConfig (check_slo / readback)
         self.registry = registry if registry is not None else _metrics.REGISTRY
         self._lock = threading.RLock()
-        self._buckets: Dict[tuple, _Bucket] = {}
+        # Scheduling layer (ISSUE 15): tickets queue in the weighted-
+        # fair scheduler and are released to the spool as batch files
+        # against a bounded window (sched_lookahead per live worker) —
+        # the spool stays the durable queue of RELEASED work, the
+        # scheduler holds the fair backlog.
+        self.sched = FleetScheduler(self.fleet)
         self._handles: Dict[str, FleetHandle] = {}
         self._meta_cache: Dict[str, dict] = {}
         self._counted: set = set()  # tids folded into self.completed
@@ -842,6 +876,28 @@ class Fleet:
         self._monitor: Optional[threading.Thread] = None
         self._stop_monitor = threading.Event()
         self._cv = threading.Condition()  # completion/backpressure wakeups
+        # Incremental monitor scan (ISSUE 15 satellite): directory
+        # watches gate the spool re-scans, the wake event short-cuts
+        # the adaptive idle backoff on new submissions.
+        self._wake = threading.Event()
+        self._wait_s = self.fleet.poll_s
+        self._results_watch = DirWatch(self.spool.path("results"))
+        self._claimed_watch = DirWatch(
+            self.spool.path("claimed"), self.spool.path("leases")
+        )
+        self._have_claimed = True  # scan once before trusting the watch
+        # Autoscaler (ISSUE 15): policy thread state. _draining pauses
+        # scale decisions across an explicit drain()/start() cycle so
+        # the scaler never fights a deliberate preemption drain.
+        self.autoscaler = (
+            None if self.fleet.autoscale is None
+            else Autoscaler(self.fleet.autoscale)
+        )
+        self._scaler: Optional[threading.Thread] = None
+        self._stop_scaler = threading.Event()
+        self._retiring: set = set()
+        self._draining = False
+        self._preempted_batches: set = set()  # markers outstanding
         self.submitted = 0
         self.completed = 0
         self.requeues = 0
@@ -885,11 +941,25 @@ class Fleet:
         ``PGA_WORKER_CHAOS`` ride here in tests). Returns worker ids."""
         if self._closed:
             raise RuntimeError("fleet is closed")
+        self._draining = False
+        spawned = self._spawn_workers(
+            self.fleet.n_workers, worker_env=worker_env
+        )
+        self._ensure_monitor()
+        self._ensure_scaler()
+        return spawned
+
+    def _spawn_workers(
+        self, n: int, worker_env: Optional[Dict[int, dict]] = None
+    ) -> List[str]:
+        """Spawn ``n`` fresh worker processes (used by :meth:`start`
+        and the autoscaler's scale-up path). ``worker_env`` indexes
+        are relative to this spawn group."""
         spawned = []
         jax_knobs = _jax_env_knobs()
         with self._lock:
             base = len(self._workers)
-            for i in range(self.fleet.n_workers):
+            for i in range(n):
                 wid = f"w{base + i}"
                 out = open(  # worker stdout/stderr, for post-mortems
                     self.spool.path("logs", f"{wid}.out"), "ab"
@@ -919,7 +989,6 @@ class Fleet:
                 self._emit("worker_spawn", worker=wid, pid=proc.pid)
                 self.registry.gauge("fleet.worker.up", worker=wid).set(1)
         self._alive_gauge()
-        self._ensure_monitor()
         return spawned
 
     def session_store(self):
@@ -974,21 +1043,53 @@ class Fleet:
     def submit(
         self, ticket: FleetTicket, tenant: Optional[str] = None
     ) -> FleetHandle:
-        """Admit one ticket; returns its handle. Applies the fleet-wide
-        backpressure policy first, then buckets the ticket; the bucket
-        becomes a claimable batch file at ``max_batch`` tickets or
-        ``max_wait_ms`` after its oldest admission. ``tenant`` (ISSUE
-        14) overrides the ticket's own tenant field — either way the
-        id is validated label-safe and rides the batch file, result
-        meta, spans, and every per-tenant metric series."""
+        """Admit one ticket; returns its handle. Admission order
+        (ISSUE 15): per-tenant quota first (``TenantPolicy.max_pending``
+        — a breach raises :class:`QuotaExceeded` deterministically and
+        emits ``quota_reject``), then the fleet-wide backpressure
+        policy, then the ticket queues in the weighted-fair scheduler
+        under its tenant and priority lane (``ticket.priority``,
+        defaulting to the tenant policy's). Batches release to the
+        spool in deficit-round-robin order against the
+        ``sched_lookahead`` window, at ``max_batch`` same-shape tickets
+        or ``max_wait_ms`` after the oldest admission. ``tenant``
+        (ISSUE 14) overrides the ticket's own tenant field — either
+        way the id is validated label-safe and rides the batch file,
+        result meta, spans, and every per-tenant metric series."""
         if self._closed:
             raise RuntimeError("fleet is closed")
         if tenant is not None:
             ticket = dataclasses.replace(
                 ticket, tenant=validate_tenant(tenant)
             )
+        t_id = ticket.tenant
+        policy = self.sched.policy(t_id)
         self._admit_slot()
+        prio = int(
+            policy.priority if ticket.priority is None else ticket.priority
+        )
         with self._lock:
+            # Quota check-and-admit is ATOMIC under the intake lock:
+            # N concurrent submitters racing a quota of k admit
+            # exactly k, whatever the interleaving.
+            limit = policy.max_pending
+            if limit is not None:
+                outstanding = (
+                    self._tenant_submitted.get(t_id, 0)
+                    - self._tenant_completed.get(t_id, 0)
+                )
+                if outstanding >= limit:
+                    self.registry.counter(
+                        "fleet.sched.quota_rejects", tenant=t_id
+                    ).bump()
+                    self._emit(
+                        "quota_reject", tenant=t_id,
+                        outstanding=outstanding, limit=limit,
+                    )
+                    raise QuotaExceeded(
+                        f"tenant {t_id!r}: {outstanding} outstanding "
+                        f"tickets >= TenantPolicy.max_pending={limit}"
+                    )
             self._tid_seq += 1
             # Token-qualified: a fresh coordinator on a reused spool
             # must never see a previous run's results as its own.
@@ -996,14 +1097,11 @@ class Fleet:
             handle = FleetHandle(self, tid, ticket)
             self._handles[tid] = handle
             key = self._bucket_key(ticket)
-            bucket = self._buckets.get(key)
-            if bucket is None:
-                bucket = self._buckets[key] = _Bucket()
-            if not bucket.tickets:
-                bucket.oldest = _now()
-            bucket.tickets.append((tid, ticket))
+            self.sched.push(SchedEntry(
+                tid=tid, ticket=ticket, bucket=key, tenant=t_id,
+                priority=prio, admitted=_now(),
+            ))
             self.submitted += 1
-            t_id = ticket.tenant
             if t_id not in self._tenants_seen:
                 self._tenants_seen.add(t_id)
                 self._emit("tenant_admit", tenant=t_id, where="fleet")
@@ -1013,19 +1111,35 @@ class Fleet:
             self.registry.counter(
                 "fleet.tenant.submissions", tenant=t_id
             ).bump()
+            self.registry.gauge(
+                "fleet.sched.queued", tenant=t_id
+            ).set(self.sched.tenant_depth().get(t_id, 0))
             self._emit(
                 "batch_admit", bucket=f"{ticket.size}x{ticket.genome_len}",
-                pending=len(bucket.tickets), population_size=ticket.size,
-                genome_len=ticket.genome_len, tenant=t_id,
+                pending=self.sched.bucket_depth(prio, key),
+                population_size=ticket.size,
+                genome_len=ticket.genome_len, tenant=t_id, priority=prio,
             )
-            if len(bucket.tickets) >= self.fleet.max_batch:
-                self._form_batch(key)
+            full = (
+                self.sched.bucket_depth(prio, key) >= self.fleet.max_batch
+            )
+        if full:
+            self._schedule()
         self.registry.gauge("fleet.tickets.outstanding").set(
             self._outstanding()
         )
         self._tenant_outstanding_gauge(ticket.tenant)
+        self._wake.set()
         self._ensure_monitor()
         return handle
+
+    def set_tenant_policy(self, tenant: str, policy: TenantPolicy) -> None:
+        """Install or replace one tenant's scheduling policy on the
+        LIVE fleet (weight, quota, priority lane) — the Python face of
+        the C ABI's ``pga_fleet_tenant_policy``. Takes effect on the
+        next submit/draw; already-queued tickets keep the lane they
+        were admitted into."""
+        self.sched.set_policy(validate_tenant(tenant), policy)
 
     def _tenant_outstanding_gauge(self, tenant: str) -> None:
         """Refresh one tenant's pending-work gauge — the per-tenant
@@ -1041,31 +1155,80 @@ class Fleet:
         ).set(max(n, 0))
 
     def flush(self) -> int:
-        """Write every non-empty bucket out as a pending batch file now
-        (returns batches formed) — the admission-window override."""
+        """Release every queued ticket to the spool as batch files now
+        (returns batches formed) — overrides BOTH the admission window
+        (max_batch / max_wait_ms) and the fair scheduler's
+        ``sched_lookahead`` release window. Single-tenant drains and
+        ``close()`` want this; latency-sensitive awaits use the
+        windowed release so a burst tenant cannot pre-spool past the
+        fairness runway."""
+        return self._schedule(drain=True)
+
+    def _pending_room(self) -> int:
+        """Release-window headroom: how many more unclaimed batch
+        files the coordinator will put on the spool before holding
+        work back in the fair queues."""
+        window = self.fleet.sched_lookahead * max(
+            len(self.workers_alive()), 1
+        )
+        return window - len(self.spool.pending_batches())
+
+    def _schedule(self, urgent: bool = False, drain: bool = False) -> int:
+        """Draw due batches from the weighted-fair scheduler and write
+        them to the spool in deficit order. ``urgent`` overrides the
+        admission window (a lone ticket must not wait out max_wait_ms);
+        ``drain`` additionally overrides the release window. Returns
+        batches formed."""
         formed = 0
         with self._lock:
-            for key in list(self._buckets):
-                if self._buckets[key].tickets:
-                    self._form_batch(key)
-                    formed += 1
+            room = None if drain else self._pending_room()
+            while self.sched.depth() > 0:
+                if room is not None and room <= 0:
+                    break
+                nb = self.sched.next_batch(
+                    _now(), self.fleet.max_batch, self.fleet.max_wait_ms,
+                    urgent=urgent or drain,
+                )
+                if nb is None:
+                    break
+                self._write_batch(*nb)
+                formed += 1
+                if room is not None:
+                    room -= 1
+            queued = self.sched.depth()
+            for tenant, depth in self.sched.tenant_depth().items():
+                self.registry.gauge(
+                    "fleet.sched.queued", tenant=tenant
+                ).set(depth)
+        if formed:
+            self.registry.counter("fleet.sched.rounds").bump()
+            self._emit("sched_round", batches=formed, queued=queued)
+            self.registry.gauge("fleet.batches.pending").set(
+                len(self.spool.pending_batches())
+            )
+            self._wake.set()
         return formed
 
-    def _form_batch(self, key: tuple) -> None:
-        """Turn one bucket's tickets into a claimable batch file
-        (caller holds the lock)."""
-        bucket = self._buckets[key]
-        tickets, bucket.tickets = bucket.tickets, []
+    def _write_batch(
+        self, priority: int, key: tuple, entries: List[SchedEntry]
+    ) -> None:
+        """Turn one drawn batch into a claimable batch file (caller
+        holds the lock). The priority rides the NAME (``p<9-prio>``
+        prefix) so the plain name sort workers claim by serves higher
+        lanes first."""
+        tickets = [(e.tid, e.ticket) for e in entries]
         self._batch_seq += 1
         size, genome_len, supervised = key
         name = (
-            f"b{self._batch_seq:05d}-{self._token}-{size}x{genome_len}"
+            f"p{9 - priority}b{self._batch_seq:05d}-{self._token}"
+            f"-{size}x{genome_len}"
             f"{'-sup' if supervised else ''}.json"
         )
         formed = _tl.anchored_wall()
         batch = {
             "batch": name,
             "formed_at": formed,
+            "priority": priority,
             "trace": bool(self.fleet.trace),
             "spec": {
                 "objective": self.objective,
@@ -1110,9 +1273,7 @@ class Fleet:
         self._emit(
             "batch_launch", bucket=name, batch_size=len(tickets),
             fill_ratio=round(len(tickets) / self.fleet.max_batch, 4),
-        )
-        self.registry.gauge("fleet.batches.pending").set(
-            len(self.spool.pending_batches())
+            priority=priority,
         )
 
     # -------------------------------------------------------------- results
@@ -1128,7 +1289,11 @@ class Fleet:
 
     def _await(self, tid: str, timeout: Optional[float]) -> FleetResult:
         deadline = None if timeout is None else _now() + timeout
-        self.flush()  # a lone ticket must not wait out max_wait_ms
+        # A lone ticket must not wait out max_wait_ms — but release
+        # WINDOWED (not a full drain), so an awaiting burst tenant
+        # cannot pre-spool past the fairness runway; the monitor keeps
+        # releasing as claims free the window.
+        self._schedule(urgent=True)
         while True:
             meta = self._meta(tid)
             if meta is not None:
@@ -1264,7 +1429,14 @@ class Fleet:
             self._monitor.start()
 
     def _monitor_loop(self) -> None:
-        while not self._stop_monitor.wait(self.fleet.poll_s):
+        # Adaptive cadence (ISSUE 15 satellite): an idle fleet's wait
+        # doubles from poll_s up to poll_idle_max_s; a submit (or any
+        # batch release) sets the wake event and snaps it back.
+        while not self._stop_monitor.is_set():
+            if self._wake.wait(timeout=self._wait_s):
+                self._wake.clear()
+            if self._stop_monitor.is_set():
+                return
             try:
                 self._tick()
             except Exception:
@@ -1273,18 +1445,57 @@ class Fleet:
                 pass
 
     def _tick(self) -> None:
+        t0 = time.perf_counter()
         now = _now()
-        # 1. Admission window: flush buckets past max_wait_ms.
-        with self._lock:
-            deadline = now - self.fleet.max_wait_ms / 1000.0
-            for key, b in list(self._buckets.items()):
-                if b.tickets and b.oldest <= deadline:
-                    self._form_batch(key)
-        # 2. Completions: new result metas wake blocked result()/submit().
-        # Counted via a dedicated set, NOT meta-cache presence — a
+        active = False
+        # 1. Admission + release windows: draw due batches from the
+        # fair scheduler into the spool's claimable runway.
+        if self.sched.depth() > 0:
+            active = True
+            self._schedule()
+        # 2. Completions: new result metas wake blocked
+        # result()/submit(). Scanned only when the results directory
+        # actually CHANGED (DirWatch) — the incremental-scan satellite;
+        # counted via a dedicated set, NOT meta-cache presence — a
         # result() call that reads the meta first would otherwise hide
         # the completion from this accounting (undercounting
         # ``completed`` and over-tightening max_pending backpressure).
+        if self._results_watch.poll():
+            active = self._scan_completions() or active
+        # 3+4. Claim/lease scan, gated: skipped entirely while there
+        # are no claimed batches AND the claimed/leases directories
+        # did not change (lease AGING needs periodic rescans, but only
+        # while something is claimed).
+        if self._claimed_watch.poll() or self._have_claimed:
+            lease_owner = self._scan_leases()
+            self._have_claimed = bool(lease_owner) or bool(
+                self.spool.claimed_batches()
+            )
+            active = active or self._have_claimed
+        else:
+            lease_owner = {}
+        self._scan_workers(lease_owner)
+        # 5. Priority preemption (ISSUE 15).
+        self._preempt_scan(lease_owner)
+        # 6. Observability flush (ISSUE 9): at metrics_flush_s cadence,
+        # persist the coordinator's own registry snapshot to the spool
+        # (so post-mortems and fleet_top see the fleet-level series)
+        # and run the straggler scan over the workers' flushes.
+        if now - self._last_flush >= self.fleet.metrics_flush_s:
+            self._last_flush = now
+            self.flush_metrics()
+            self.detect_stragglers()
+        if self._outstanding() > 0:
+            active = True
+        self.registry.histogram("fleet.coordinator.scan_ms").observe(
+            (time.perf_counter() - t0) * 1e3
+        )
+        self._wait_s = (
+            self.fleet.poll_s if active
+            else min(self._wait_s * 2.0, self.fleet.poll_idle_max_s)
+        )
+
+    def _scan_completions(self) -> bool:
         fresh = False
         fresh_tenants: set = set()
         for tid in list(self._handles):
@@ -1313,13 +1524,12 @@ class Fleet:
                 self._tenant_outstanding_gauge(tenant)
             with self._cv:
                 self._cv.notify_all()
-        # 3. Worker liveness: a worker that EXITED while holding a lease
-        # is requeued immediately (no need to wait out the lease).
-        lease_owner: Dict[str, str] = {}
-        for name in self.spool.claimed_batches():
-            lease = self.spool.read_json(self.spool.lease_path(name))
-            if lease is not None:
-                lease_owner[name] = lease.get("worker", "?")
+        return fresh
+
+    def _scan_workers(self, lease_owner: Dict[str, str]) -> None:
+        """Worker liveness (cheap ``Popen.poll`` per worker, every
+        tick): a worker that EXITED while holding a lease is requeued
+        immediately (no need to wait out the lease)."""
         with self._lock:
             workers = dict(self._workers)
         for wid, proc in workers.items():
@@ -1327,6 +1537,7 @@ class Fleet:
             if rc is None or wid in self._worker_gone:
                 continue
             self._worker_gone.add(wid)
+            self._retiring.discard(wid)
             self.registry.gauge("fleet.worker.up", worker=wid).set(0)
             if rc == 0:
                 self._emit("worker_exit", worker=wid, returncode=0)
@@ -1340,13 +1551,22 @@ class Fleet:
                     if owner == wid:
                         self._requeue(name, wid, "worker_died")
             self._alive_gauge()
-        # 4. Lease expiry: stale heartbeats (SIGSTOP, wedged worker,
-        # dead heartbeat thread) requeue the batch onto a survivor.
-        # Lease ages double as per-worker gauges (ISSUE 9): the merged
-        # exposition and fleet_top read how long each worker has gone
-        # without touching its lease.
+
+    def _scan_leases(self) -> Dict[str, str]:
+        """Lease expiry + age gauges over the claimed batches; returns
+        the batch -> owning-worker map. Stale heartbeats (SIGSTOP,
+        wedged worker, dead heartbeat thread) requeue the batch onto a
+        survivor. Lease ages double as per-worker gauges (ISSUE 9):
+        the merged exposition and fleet_top read how long each worker
+        has gone without touching its lease."""
+        lease_owner: Dict[str, str] = {}
+        claimed_names = self.spool.claimed_batches()
+        for name in claimed_names:
+            lease = self.spool.read_json(self.spool.lease_path(name))
+            if lease is not None:
+                lease_owner[name] = lease.get("worker", "?")
         gauged_now: set = set()
-        for name in self.spool.claimed_batches():
+        for name in claimed_names:
             lease_path = self.spool.lease_path(name)
             try:
                 mtime = os.stat(lease_path).st_mtime
@@ -1376,14 +1596,181 @@ class Fleet:
         for owner in self._lease_gauged - gauged_now:
             self.registry.gauge("fleet.lease.age_s", worker=owner).set(0.0)
         self._lease_gauged = gauged_now
-        # 5. Observability flush (ISSUE 9): at metrics_flush_s cadence,
-        # persist the coordinator's own registry snapshot to the spool
-        # (so post-mortems and fleet_top see the fleet-level series)
-        # and run the straggler scan over the workers' flushes.
-        if now - self._last_flush >= self.fleet.metrics_flush_s:
-            self._last_flush = now
-            self.flush_metrics()
-            self.detect_stragglers()
+        # Preempt markers whose batch left the claimed state are
+        # stale — the worker removes its own on finish, this sweeps
+        # markers orphaned by deaths.
+        for name in self._preempted_batches - set(claimed_names):
+            self._preempted_batches.discard(name)
+            try:
+                os.remove(self.spool.preempt_path(name))
+            except OSError:
+                pass
+        return lease_owner
+
+    # ----------------------------------------------- preemption (ISSUE 15)
+
+    def _preempt_scan(self, lease_owner: Dict[str, str]) -> None:
+        """Priority lanes with preemption: when a higher-priority batch
+        is waiting, every worker is busy, and a strictly lower-priority
+        SUPERVISED batch is executing, mark that batch for preemption.
+        The worker's supervised stop hook observes the marker at the
+        next chunk boundary and returns the batch's remainder to the
+        spool — the round-13 SIGTERM-drain discipline without losing
+        the process — then claims the higher-priority batch (the name
+        sort puts it first). Resume is bit-identical: the checkpoint +
+        sidecar machinery is exactly the drain path's."""
+        pending = self.spool.pending_batches()
+        if not pending:
+            return
+        claimed = self.spool.claimed_batches()
+        if not claimed:
+            return
+        if len(self.workers_alive()) > len(claimed):
+            return  # an idle worker will pick the high-prio batch up
+        best_waiting = max(Spool.name_priority(n) for n in pending)
+        victims = [
+            n for n in claimed
+            if n.endswith("-sup.json")
+            and n not in self._preempted_batches
+            and Spool.name_priority(n) < best_waiting
+        ]
+        if not victims:
+            return
+        victim = min(victims, key=Spool.name_priority)
+        high = max(pending, key=Spool.name_priority)
+        owner = lease_owner.get(victim, "?")
+        self.spool.write_json(self.spool.preempt_path(victim), {
+            "batch": victim, "for": high, "worker": owner,
+            "at": _tl.anchored_wall(),
+        })
+        self._preempted_batches.add(victim)
+        self.registry.counter("fleet.sched.preemptions").bump()
+        self._emit("preempt", batch=victim, by=high, worker=owner)
+        if self.fleet.trace:
+            now_w = _tl.anchored_wall()
+            _tl.append_trace(
+                self.spool.trace_path(victim),
+                _tl.trace_span_record(
+                    "preempt", now_w, now_w, batch=victim, by=high,
+                    worker=owner, role="coordinator",
+                ),
+            )
+
+    # ----------------------------------------------- autoscaler (ISSUE 15)
+
+    def _ensure_scaler(self) -> None:
+        if self.autoscaler is None:
+            return
+        with self._lock:
+            if self._scaler is not None and self._scaler.is_alive():
+                return
+            if self._closed:
+                return
+            self._stop_scaler.clear()
+            self._scaler = threading.Thread(
+                target=self._scaler_loop, name="pga-fleet-autoscaler",
+                daemon=True,
+            )
+            self._scaler.start()
+
+    def _scaler_loop(self) -> None:
+        cfg = self.fleet.autoscale
+        while not self._stop_scaler.wait(cfg.check_s):
+            try:
+                self._autoscale_tick()
+            except Exception:
+                pass  # one bad evaluation must not stop the policy
+
+    def _autoscale_tick(self) -> None:
+        """One closed-loop evaluation: feed the pure policy the signals
+        the fleet already exports (claimable backlog, spool-wait p99,
+        per-tenant burn alerts, straggler flags) and apply the delta —
+        spawn on scale-up, SIGTERM-drain (never kill) on scale-down."""
+        if self._draining or self._closed or self.autoscaler is None:
+            return
+        cfg = self.fleet.autoscale
+        # Retiring workers (SIGTERM sent, drain in progress) are no
+        # longer capacity: counting them would let the policy retire a
+        # second worker before the first finishes draining and dip
+        # below the floor.
+        alive = [
+            w for w in self.workers_alive() if w not in self._retiring
+        ]
+        import math as _math
+
+        backlog = len(self.spool.pending_batches()) + _math.ceil(
+            self.sched.depth() / self.fleet.max_batch
+        )
+        claimed = len(self.spool.claimed_batches())
+        p99 = None
+        if cfg.spool_wait_p99_ms is not None:
+            snap = self.registry.histogram(
+                "fleet.ticket.spool_wait_ms"
+            ).snapshot()
+            if snap.count:
+                p99 = snap.percentile(99.0)
+        burn_alerts = sum(
+            1 for t, m in list(self.burn.monitors.items())
+            if m.alerting(t)
+        )
+        delta, reason = self.autoscaler.decide(
+            _now(), len(alive), backlog, claimed, spool_wait_p99=p99,
+            burn_alerts=burn_alerts, stragglers=len(self._stragglers),
+        )
+        self.registry.gauge("fleet.autoscale.workers").set(len(alive))
+        if delta > 0:
+            spawned = self._spawn_workers(delta)
+            self.registry.counter("fleet.autoscale.ups").bump()
+            self._emit(
+                "autoscale_up", workers=delta, reason=reason,
+                alive=len(alive) + delta, backlog=backlog,
+                spawned=",".join(spawned),
+            )
+            self._wake.set()
+        elif delta < 0:
+            self._retire_workers(-delta, reason)
+
+    def _retire_workers(self, n: int, reason: str) -> None:
+        """Scale-down by DRAINING: SIGTERM ``n`` workers (idle ones
+        first) — each checkpoints any in-flight supervised chunk,
+        returns its lease, and exits 0, so results stay bit-identical
+        to a fixed-size fleet. Never SIGKILL from here."""
+        with self._lock:
+            candidates = [
+                wid for wid, p in self._workers.items()
+                if p.poll() is None and wid not in self._retiring
+            ]
+        if not candidates:
+            return
+        owners = set()
+        for name in self.spool.claimed_batches():
+            lease = self.spool.read_json(self.spool.lease_path(name))
+            if lease is not None:
+                owners.add(lease.get("worker"))
+        # Idle workers first; among equals, the newest (highest id) —
+        # the floor keeps the longest-warmed caches.
+        def _rank(wid: str):
+            try:
+                idx = int(wid[1:])
+            except ValueError:
+                idx = 0
+            return (wid in owners, -idx)
+
+        candidates.sort(key=_rank)
+        for wid in candidates[:n]:
+            with self._lock:
+                proc = self._workers.get(wid)
+            if proc is None or proc.poll() is not None:
+                continue
+            try:
+                proc.send_signal(signal.SIGTERM)
+            except OSError:
+                continue
+            self._retiring.add(wid)
+            self.registry.counter("fleet.autoscale.downs").bump()
+            self._emit(
+                "autoscale_down", workers=1, reason=reason, worker=wid
+            )
 
     # -------------------------------------------------- requeue / quarantine
 
@@ -1400,6 +1787,14 @@ class Fleet:
         # batch instead of racing the re-run.
         try:
             os.remove(self.spool.lease_path(name))
+        except OSError:
+            pass
+        # A pending preemption marker dies with the lease: the re-run
+        # starts unpreempted (the scan re-marks it if the high-priority
+        # batch is still waiting).
+        self._preempted_batches.discard(name)
+        try:
+            os.remove(self.spool.preempt_path(name))
         except OSError:
             pass
         self._hb_seen.pop(name, None)
@@ -1648,6 +2043,14 @@ class Fleet:
             "requeues": self.requeues,
             "worker_deaths": self.worker_deaths,
             "quarantined": list(self.quarantined),
+            # Scheduling layer (ISSUE 15): the held-back fair backlog
+            # per tenant, the current monitor cadence (adaptive idle
+            # backoff), and the autoscaler's retire set.
+            "sched_queued": self.sched.depth(),
+            "sched_queued_by_tenant": self.sched.tenant_depth(),
+            "monitor_poll_s": self._wait_s,
+            "retiring": sorted(self._retiring),
+            "preempted_batches": sorted(self._preempted_batches),
         }
         return st
 
@@ -1664,6 +2067,10 @@ class Fleet:
         :meth:`start` afterwards resumes the fleet. Returns the number
         of workers that exited."""
         timeout = self.fleet.drain_timeout_s if timeout is None else timeout
+        # Pause autoscaling across an explicit drain: the policy thread
+        # must not respawn workers the operator just retired (start()
+        # resumes it).
+        self._draining = True
         with self._lock:
             procs = {
                 wid: p for wid, p in self._workers.items()
@@ -1691,11 +2098,15 @@ class Fleet:
         can pick it up."""
         if self._closed:
             return
+        self._stop_scaler.set()
+        if self._scaler is not None:
+            self._scaler.join(timeout=5)
         self.flush()
         self.drain()
         self.flush_metrics()  # final coordinator snapshot for post-mortems
         self._closed = True
         self._stop_monitor.set()
+        self._wake.set()  # snap the monitor out of an idle backoff wait
         if self._monitor is not None:
             self._monitor.join(timeout=5)
         with self._cv:

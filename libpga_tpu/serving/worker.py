@@ -187,6 +187,15 @@ class WorkerHarness:
         lease = self.spool.read_json(self.spool.lease_path(batch_name))
         return lease is not None and lease.get("worker") == self.wid
 
+    def _preempt_requested(self, batch_name: str) -> bool:
+        """Coordinator preemption marker (ISSUE 15): a higher-priority
+        batch wants this slot. Checked by the supervised stop hook at
+        every chunk boundary — exactly the SIGTERM-drain discipline,
+        but the PROCESS survives: the batch's remainder returns to the
+        spool and the claim loop picks the high-priority batch next
+        (the name sort puts it first)."""
+        return os.path.exists(self.spool.preempt_path(batch_name))
+
     # -------------------------------------------------------------- metrics
 
     def _flush_metrics(self) -> None:
@@ -543,6 +552,7 @@ class WorkerHarness:
             stop=lambda: (
                 self.drain_evt.is_set()
                 or self._lease_lost.is_set()
+                or self._preempt_requested(name)
                 or not self._owns_lease(name)
             ),
         )
@@ -591,6 +601,12 @@ class WorkerHarness:
                 pass
         try:
             os.remove(self.spool.lease_path(name))
+        except OSError:
+            pass
+        try:
+            # Consume any preemption marker with the batch: the
+            # returned remainder must re-claim unpreempted later.
+            os.remove(self.spool.preempt_path(name))
         except OSError:
             pass
         if self._trace_on.pop(name, False):

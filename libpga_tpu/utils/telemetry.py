@@ -154,6 +154,20 @@ EVENT_FIELDS: Dict[str, tuple] = {
     "tenant_admit": ("tenant", "where"),
     "slo_burn": ("tenant", "fast_burn", "slow_burn"),
     "session_span": ("session", "span", "t0", "t1"),
+    # Elastic fleet (ISSUE 15): the scheduling layer's verdicts — a
+    # per-tenant quota shed (deterministic QuotaExceeded at submit), a
+    # priority preemption (coordinator marks a lower-priority
+    # supervised batch; the worker drains it at a chunk boundary and
+    # the high-priority batch takes the slot), the autoscaler's
+    # spawn/retire decisions (retire always drains, never kills), and
+    # one record per scheduler pass that released batches to the spool
+    # (deficit-round-robin order; ``queued`` is the fair backlog still
+    # held back by the release window).
+    "quota_reject": ("tenant", "outstanding", "limit"),
+    "preempt": ("batch", "by", "worker"),
+    "autoscale_up": ("workers", "reason"),
+    "autoscale_down": ("workers", "reason"),
+    "sched_round": ("batches", "queued"),
 }
 
 

@@ -1,0 +1,597 @@
+"""Elastic-fleet scheduling layer (ISSUE 15): weighted-fair
+deficit-round-robin, admission control, priority preemption, and the
+load-following autoscaler.
+
+The acceptance invariants pinned here:
+
+- DRR never starves a nonempty tenant queue (property test over random
+  arrival patterns), including tenants whose shapes never co-batch;
+- per-tenant quotas shed DETERMINISTICALLY under concurrent submitters
+  (exactly ``max_pending`` admitted, whatever the interleaving);
+- the autoscaler's hysteresis produces zero decisions under oscillating
+  load and follows sustained load up and back down to the floor;
+- a preempted supervised batch resumes BIT-IDENTICAL (the round-13
+  chunk-boundary drain discipline) while the high-priority arrival
+  takes the slot;
+- an autoscaled fleet's results are bit-identical to a fixed-size
+  fleet's on the same seeds.
+
+Process-spawning tests keep shapes tiny (tier-1 budget); the end-to-end
+burst-vs-steady SLO isolation smoke is ``tools/fairness_smoke.py``
+(CI stage 16).
+"""
+
+import json
+import os
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from libpga_tpu import PGA, PGAConfig
+from libpga_tpu.config import AutoscaleConfig, FleetConfig, TenantPolicy
+from libpga_tpu.robustness.supervisor import supervised_run
+from libpga_tpu.serving.fleet import Fleet, FleetTicket, Spool
+from libpga_tpu.serving.scheduler import (
+    Autoscaler,
+    DirWatch,
+    FleetScheduler,
+    QuotaExceeded,
+    SchedEntry,
+)
+from libpga_tpu.utils import metrics as _metrics
+from libpga_tpu.utils import telemetry
+
+POP, LEN = 128, 16
+CFG = PGAConfig(use_pallas=False)
+
+
+def engine_run(seed, n, pop=POP, length=LEN):
+    pga = PGA(seed=seed, config=CFG)
+    pga.create_population(pop, length)
+    pga.set_objective("onemax")
+    pga.run(n)
+    return np.array(pga._populations[0].genomes, copy=True)
+
+
+def wait_for(cond, timeout=60, interval=0.02, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def mk_entry(i, tenant, bucket, prio=0, t=0.0):
+    return SchedEntry(
+        tid=f"t{i:04d}", ticket=None, bucket=bucket, tenant=tenant,
+        priority=prio, admitted=t,
+    )
+
+
+def drain_all(sched, max_batch=4, urgent=True):
+    """Draw until empty; returns the list of (priority, bucket,
+    entries) draws."""
+    draws = []
+    guard = 0
+    while sched.depth() > 0:
+        nb = sched.next_batch(1e9, max_batch, 0.0, urgent=urgent)
+        assert nb is not None, "due work but no batch drawn"
+        draws.append(nb)
+        guard += 1
+        assert guard < 10_000
+    return draws
+
+
+# ------------------------------------------------------------ validation
+
+
+def test_policy_and_config_validation():
+    with pytest.raises(ValueError):
+        TenantPolicy(weight=0.0)
+    with pytest.raises(ValueError):
+        TenantPolicy(weight=-1.0)
+    with pytest.raises(ValueError):
+        TenantPolicy(max_pending=0)
+    with pytest.raises(ValueError):
+        TenantPolicy(priority=10)
+    with pytest.raises(ValueError):
+        AutoscaleConfig(min_workers=4, max_workers=2)
+    with pytest.raises(ValueError):
+        AutoscaleConfig(target_backlog=0.0)
+    with pytest.raises(ValueError):
+        AutoscaleConfig(step=0)
+    with pytest.raises(ValueError):
+        AutoscaleConfig(check_s=0.0)
+    with pytest.raises(ValueError):
+        FleetConfig(tenants={"a": object()})
+    with pytest.raises(ValueError):
+        FleetConfig(sched_quantum=0.0)
+    with pytest.raises(ValueError):
+        FleetConfig(sched_lookahead=0)
+    with pytest.raises(ValueError):
+        FleetConfig(poll_s=0.5, poll_idle_max_s=0.1)
+    with pytest.raises(ValueError):
+        FleetTicket(size=8, genome_len=8, n=1, seed=0, priority=10)
+    # Valid shapes construct.
+    FleetConfig(
+        tenants={"a": TenantPolicy(weight=2.0, max_pending=4, priority=3)},
+        autoscale=AutoscaleConfig(),
+    )
+    FleetTicket(size=8, genome_len=8, n=1, seed=0, priority=9)
+
+
+# ------------------------------------------------------------------- DRR
+
+
+def test_drr_single_tenant_preserves_fifo():
+    sched = FleetScheduler(FleetConfig())
+    B = (128, 16, False)
+    for i in range(7):
+        sched.push(mk_entry(i, "anon", B))
+    draws = drain_all(sched, max_batch=3)
+    order = [e.tid for _, _, es in draws for e in es]
+    assert order == [f"t{i:04d}" for i in range(7)]
+    # Batches are homogeneous in bucket and bounded by max_batch.
+    assert [len(es) for _, _, es in draws] == [3, 3, 1]
+
+
+def test_drr_burst_cannot_starve_steady():
+    """A burst tenant's 50-deep queue of shape X cannot delay a steady
+    tenant's shape-Y ticket beyond its deficit quantum: the steady
+    ticket rides the very next draw after the burst's current batch."""
+    sched = FleetScheduler(FleetConfig())
+    X, Y = (1024, 64, False), (128, 16, False)
+    for i in range(50):
+        sched.push(mk_entry(i, "burst", X))
+    # Steady arrives AFTER the whole burst is queued.
+    sched.push(mk_entry(99, "steady", Y))
+    first = sched.next_batch(1e9, 8, 0.0, urgent=True)
+    second = sched.next_batch(1e9, 8, 0.0, urgent=True)
+    tenants = [es[0].tenant for _, _, es in (first, second)]
+    assert "steady" in tenants, tenants
+
+
+def test_drr_no_starvation_random_arrivals():
+    """Property test: over random tenants/weights/shapes/interleavings,
+    every queued ticket is eventually drawn, and while every tenant
+    stays backlogged no tenant waits more than one full ring rotation
+    (+1 slack for debt paydown) between its batches."""
+    for seed in range(5):
+        rng = random.Random(seed)
+        n_tenants = rng.randint(2, 5)
+        tenants = [f"ten{j}" for j in range(n_tenants)]
+        policies = {
+            t: TenantPolicy(weight=rng.choice((0.5, 1.0, 2.0)))
+            for t in tenants
+        }
+        # Half the runs give every tenant a PRIVATE shape (never
+        # co-batches), half share one shape pool.
+        disjoint = rng.random() < 0.5
+        shapes = {
+            t: ((64 * (j + 1), 16, False) if disjoint
+                else (64 * rng.randint(1, 2), 16, False))
+            for j, t in enumerate(tenants)
+        }
+        sched = FleetScheduler(
+            FleetConfig(tenants=policies), policies=policies
+        )
+        pushed = 0
+        for i in range(rng.randint(40, 120)):
+            t = rng.choice(tenants)
+            sched.push(mk_entry(i, t, shapes[t]))
+            pushed += 1
+        max_batch = rng.choice((1, 2, 4))
+        backlogged = {
+            t: n for t, n in sched.tenant_depth().items()
+        }
+        last_served = {t: 0 for t in backlogged}
+        draw_i = 0
+        drawn = 0
+        while sched.depth() > 0:
+            nb = sched.next_batch(1e9, max_batch, 0.0, urgent=True)
+            assert nb is not None
+            draw_i += 1
+            _, bucket, entries = nb
+            assert all(e.bucket == bucket for e in entries)
+            drawn += len(entries)
+            served = {e.tenant for e in entries}
+            depth = sched.tenant_depth()
+            for t in served:
+                last_served[t] = draw_i
+            # Starvation bound, checked over tenants still backlogged:
+            # the gap since their last batch is bounded by the ring
+            # size plus debt-paydown slack (max_batch/weight rotations
+            # compressed into draws).
+            for t, n in depth.items():
+                if n > 0:
+                    gap = draw_i - last_served.get(t, 0)
+                    bound = len(depth) * (
+                        1 + max_batch / policies[t].weight
+                    ) + 2
+                    assert gap <= bound, (
+                        f"seed {seed}: tenant {t} gap {gap} > {bound}"
+                    )
+        assert drawn == pushed
+
+
+def test_drr_weighted_share():
+    """Under saturation with a shared shape, drawn tickets split
+    approximately by weight (3:1 here)."""
+    policies = {
+        "heavy": TenantPolicy(weight=3.0), "light": TenantPolicy(),
+    }
+    sched = FleetScheduler(policies=policies)
+    B = (128, 16, False)
+    for i in range(120):
+        sched.push(mk_entry(i, "heavy", B))
+        sched.push(mk_entry(1000 + i, "light", B))
+    counts = {"heavy": 0, "light": 0}
+    for _ in range(24):  # leave both queues nonempty throughout
+        nb = sched.next_batch(1e9, 4, 0.0, urgent=True)
+        for e in nb[2]:
+            counts[e.tenant] += 1
+    ratio = counts["heavy"] / max(counts["light"], 1)
+    assert 2.0 <= ratio <= 4.5, counts
+
+
+def test_drr_priority_lanes_strict():
+    """Higher lanes drain before lower ones, and batch names encode
+    the lane so the workers' name-sorted claim serves it first."""
+    sched = FleetScheduler(FleetConfig())
+    B = (128, 16, False)
+    sched.push(mk_entry(0, "low", B, prio=0))
+    sched.push(mk_entry(1, "high", B, prio=9))
+    sched.push(mk_entry(2, "mid", B, prio=4))
+    prios = [
+        sched.next_batch(1e9, 1, 0.0, urgent=True)[0] for _ in range(3)
+    ]
+    assert prios == [9, 4, 0]
+    assert Spool.name_priority("p0b00001-x-128x16.json") == 9
+    assert Spool.name_priority("p9b00002-x-128x16-sup.json") == 0
+    assert Spool.name_priority("b00003-x-128x16.json") == 0  # legacy
+
+
+def test_admission_window_not_urgent():
+    """Below max_batch and inside max_wait_ms nothing is due; aging
+    past the window makes it due without urgency."""
+    sched = FleetScheduler(FleetConfig())
+    B = (128, 16, False)
+    sched.push(mk_entry(0, "anon", B, t=100.0))
+    assert sched.next_batch(100.01, 8, 1000.0, urgent=False) is None
+    nb = sched.next_batch(101.5, 8, 1000.0, urgent=False)
+    assert nb is not None and len(nb[2]) == 1
+
+
+# ------------------------------------------------------ quota determinism
+
+
+def test_quota_deterministic_under_concurrent_submitters(tmp_path):
+    """N threads race a quota of 3: exactly 3 tickets admit, every
+    other submit raises QuotaExceeded, and each shed emits one
+    schema-valid quota_reject event."""
+    events_path = str(tmp_path / "events.jsonl")
+    log = telemetry.EventLog(events_path)
+    reg = _metrics.MetricsRegistry()
+    fleet = Fleet(
+        str(tmp_path / "spool"), "onemax", config=CFG,
+        fleet=FleetConfig(
+            n_workers=1, max_wait_ms=10_000,
+            tenants={"q": TenantPolicy(max_pending=3)},
+        ),
+        events=log, registry=reg,
+    )
+    admitted, rejected = [], []
+    barrier = threading.Barrier(4)
+
+    def submitter():
+        barrier.wait()
+        for i in range(5):
+            try:
+                admitted.append(fleet.submit(FleetTicket(
+                    size=POP, genome_len=LEN, n=1, seed=i, tenant="q",
+                )))
+            except QuotaExceeded:
+                rejected.append(i)
+
+    threads = [threading.Thread(target=submitter) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(admitted) == 3
+    assert len(rejected) == 17
+    # Unquota'd tenants are untouched by the shed.
+    fleet.submit(FleetTicket(size=POP, genome_len=LEN, n=1, seed=9))
+    fleet.close()
+    log.close()
+    records = telemetry.validate_log(events_path)
+    rejects = [r for r in records if r["event"] == "quota_reject"]
+    assert len(rejects) == 17
+    assert all(r["tenant"] == "q" and r["limit"] == 3 for r in rejects)
+    snap = reg.snapshot()
+    cnt = [
+        c for c in snap["counters"]
+        if c["name"] == "fleet.sched.quota_rejects"
+    ]
+    assert cnt and cnt[0]["value"] == 17
+
+
+# ------------------------------------------------------------- autoscaler
+
+
+def test_autoscaler_hysteresis_no_flap():
+    """Load oscillating between idle and just-under the up threshold
+    produces ZERO decisions; sustained load scales up at cooldown
+    cadence; sustained idleness drains to the floor."""
+    cfg = AutoscaleConfig(
+        min_workers=1, max_workers=4, target_backlog=2.0,
+        up_cooldown_s=1.0, down_cooldown_s=1.0, idle_grace_s=2.0,
+    )
+    sc = Autoscaler(cfg)
+    now = 0.0
+    alive = 2
+    for i in range(200):  # 20 simulated seconds of oscillation
+        now += 0.1
+        backlog = 3 if i % 2 == 0 else 0  # below 2.0 * 2 when busy
+        delta, _ = sc.decide(now, alive, backlog, claimed=0)
+        assert delta == 0, f"flapped at t={now}: {delta}"
+    # Sustained overload: one step up per cooldown, to the max.
+    ups = []
+    for _ in range(60):
+        now += 0.1
+        delta, reason = sc.decide(now, alive, backlog=100, claimed=1)
+        if delta > 0:
+            assert reason == "backlog"
+            alive += delta
+            ups.append(now)
+    assert alive == 4
+    assert all(b - a >= cfg.up_cooldown_s - 1e-9
+               for a, b in zip(ups, ups[1:]))
+    # Sustained idleness: grace first, then one step down per cooldown.
+    downs = []
+    idle_start = now
+    for _ in range(100):
+        now += 0.1
+        delta, reason = sc.decide(now, alive, backlog=0, claimed=0)
+        if delta < 0:
+            assert reason == "idle"
+            alive += delta
+            downs.append(now)
+    assert alive == cfg.min_workers
+    assert downs[0] - idle_start >= cfg.idle_grace_s - 1e-9
+    # A single busy blip re-arms the idle grace clock.
+    delta, _ = sc.decide(now + 0.1, alive + 1, backlog=1, claimed=0)
+    assert delta == 0
+    delta, _ = sc.decide(now + 0.2, alive + 1, backlog=0, claimed=0)
+    assert delta == 0  # grace restarted, no instant retire
+
+
+def test_autoscaler_floor_and_signal_triggers():
+    cfg = AutoscaleConfig(
+        min_workers=2, max_workers=4, target_backlog=10.0,
+        spool_wait_p99_ms=50.0, up_cooldown_s=0.0,
+    )
+    sc = Autoscaler(cfg)
+    # Below the floor: restored regardless of load or cooldown.
+    assert sc.decide(1.0, 0, 0, 0) == (2, "floor")
+    # Latency trigger fires only while busy.
+    assert sc.decide(2.0, 2, 0, 0, spool_wait_p99=500.0)[0] == 0
+    delta, reason = sc.decide(3.0, 2, 1, 0, spool_wait_p99=500.0)
+    assert (delta, reason) == (1, "spool_wait")
+    # Burn-rate trigger.
+    delta, reason = sc.decide(4.0, 2, 1, 0, burn_alerts=1)
+    assert (delta, reason) == (1, "slo_burn")
+    # Straggler supplement needs waiting work.
+    assert sc.decide(5.0, 2, 0, 1, stragglers=1)[0] == 0
+    delta, reason = sc.decide(6.0, 2, 1, 1, stragglers=1)
+    assert (delta, reason) == (1, "straggler")
+
+
+# --------------------------------------------- incremental scan / backoff
+
+
+def test_dirwatch_detects_entry_changes(tmp_path):
+    d = tmp_path / "watched"
+    d.mkdir()
+    w = DirWatch(str(d))
+    assert w.poll() is True  # no baseline yet
+    assert w.poll() is False
+    (d / "a.json").write_text("{}")
+    assert w.poll() is True
+    assert w.poll() is False
+    os.remove(d / "a.json")
+    assert w.poll() is True
+
+
+def test_monitor_idle_backoff_and_scan_metric(tmp_path):
+    reg = _metrics.MetricsRegistry()
+    fleet = Fleet(
+        str(tmp_path), "onemax", config=CFG,
+        fleet=FleetConfig(
+            n_workers=1, poll_s=0.01, poll_idle_max_s=0.32,
+            max_wait_ms=10_000,
+        ),
+        registry=reg,
+    )
+    fleet._ensure_monitor()
+    wait_for(
+        lambda: fleet._wait_s >= 0.16, timeout=30,
+        what="idle poll backoff growth",
+    )
+    assert reg.histogram("fleet.coordinator.scan_ms").snapshot().count > 0
+    # A submission snaps the cadence back to poll_s (outstanding work
+    # keeps the monitor active).
+    fleet.submit(FleetTicket(size=POP, genome_len=LEN, n=1, seed=1))
+    wait_for(
+        lambda: fleet._wait_s == fleet.fleet.poll_s, timeout=30,
+        what="backoff reset on submit",
+    )
+    fleet.close()
+
+
+def test_release_window_holds_backlog(tmp_path):
+    """With no live workers the coordinator spools at most
+    sched_lookahead batches and holds the rest in its fair queues;
+    flush() overrides the window."""
+    fleet = Fleet(
+        str(tmp_path), "onemax", config=CFG,
+        fleet=FleetConfig(
+            n_workers=1, max_batch=1, max_wait_ms=10_000,
+            sched_lookahead=2,
+        ),
+    )
+    for i in range(10):
+        fleet.submit(FleetTicket(size=POP, genome_len=LEN, n=1, seed=i))
+    assert len(fleet.spool.pending_batches()) == 2
+    assert fleet.sched.depth() == 8
+    assert fleet.flush() == 8
+    assert len(fleet.spool.pending_batches()) == 10
+    assert fleet.sched.depth() == 0
+    # Priority rides the names: a high-priority submit sorts first.
+    fleet.submit(FleetTicket(
+        size=POP, genome_len=LEN, n=1, seed=99, priority=9,
+    ))
+    fleet.flush()
+    names = fleet.spool.pending_batches()
+    assert Spool.name_priority(names[0]) == 9
+    batch = Spool.read_json(fleet.spool.path("pending", names[0]))
+    assert batch["priority"] == 9
+    assert batch["tickets"][0]["seed"] == 99
+    fleet.close()
+
+
+# -------------------------------------------------------- with processes
+
+
+def test_preemption_resume_bit_identity(tmp_path):
+    """ACCEPTANCE: a high-priority arrival preempts the single worker's
+    low-priority supervised batch at a chunk boundary (marker, not
+    SIGTERM — the process survives), takes the slot, and the preempted
+    run resumes BIT-IDENTICAL to an uninterrupted same-seed supervised
+    run at the same cadence."""
+    N, K, SUP_POP = 24, 1, 2048
+    events_path = str(tmp_path / "events.jsonl")
+    log = telemetry.EventLog(events_path)
+    reg = _metrics.MetricsRegistry()
+    fleet = Fleet(
+        str(tmp_path / "spool"), "onemax", config=CFG,
+        fleet=FleetConfig(
+            n_workers=1, max_batch=1, max_wait_ms=0,
+            lease_timeout_s=30.0, heartbeat_s=0.5, poll_s=0.02,
+        ),
+        events=log, registry=reg,
+    )
+    try:
+        fleet.start()
+        h_low = fleet.submit(FleetTicket(
+            size=SUP_POP, genome_len=LEN, n=N, seed=9,
+            checkpoint_every=K, priority=0,
+        ))
+        fleet.flush()
+        sidecar = fleet.spool.ckpt_path(h_low.tid) + ".meta.json"
+
+        def mid_run():
+            try:
+                with open(sidecar) as fh:
+                    return 0 < json.load(fh)["generations"] < N
+            except (OSError, json.JSONDecodeError, KeyError):
+                return False
+
+        wait_for(mid_run, timeout=120, interval=0.002,
+                 what="first durable checkpoint")
+        h_high = fleet.submit(FleetTicket(
+            size=POP, genome_len=LEN, n=4, seed=4, priority=9,
+        ))
+        wait_for(
+            lambda: fleet.registry.counter(
+                "fleet.sched.preemptions"
+            ).value > 0,
+            timeout=120, what="preemption marker",
+        )
+        res_high = h_high.result(timeout=240)
+        res_low = h_low.result(timeout=240)
+    finally:
+        fleet.close()
+        log.close()
+    # High-priority plain ticket: bit-identical to a standalone run.
+    assert np.array_equal(res_high.genomes, engine_run(4, 4))
+    # Preempted supervised ticket: bit-identical to an uninterrupted
+    # same-seed supervised run at the same cadence.
+    ref = PGA(seed=9, config=CFG)
+    ref.create_population(SUP_POP, LEN)
+    ref.set_objective("onemax")
+    supervised_run(
+        ref, N, checkpoint_path=str(tmp_path / "ref.npz"),
+        checkpoint_every=K,
+    )
+    assert res_low.generations == N
+    assert np.array_equal(
+        res_low.genomes, np.array(ref._populations[0].genomes)
+    )
+    records = telemetry.validate_log(events_path)
+    kinds = [r["event"] for r in records]
+    assert "preempt" in kinds
+    # The preempted batch's trace shows the preemption record.
+    assert any(r.get("span") == "preempt" for r in res_low.trace or [])
+
+
+def test_autoscaler_follows_load_bit_identical(tmp_path):
+    """ACCEPTANCE: worker count rises under a submission burst and
+    drains back to the floor within the cooldown window, with ZERO
+    result-bit differences versus a fixed-size fleet on the same
+    seeds (here: versus the standalone engine, the fixed fleet's own
+    pinned reference)."""
+    events_path = str(tmp_path / "events.jsonl")
+    log = telemetry.EventLog(events_path)
+    reg = _metrics.MetricsRegistry()
+    fleet = Fleet(
+        str(tmp_path / "spool"), "onemax", config=CFG,
+        fleet=FleetConfig(
+            n_workers=1, max_batch=1, max_wait_ms=5, poll_s=0.02,
+            lease_timeout_s=60.0, heartbeat_s=0.5,
+            autoscale=AutoscaleConfig(
+                min_workers=1, max_workers=2, target_backlog=1.0,
+                up_cooldown_s=0.2, down_cooldown_s=0.3,
+                idle_grace_s=0.4, check_s=0.05,
+            ),
+        ),
+        events=log, registry=reg,
+    )
+    try:
+        fleet.start()
+        seeds = (1, 2, 3, 4, 5, 6)
+        handles = [
+            fleet.submit(FleetTicket(
+                size=POP, genome_len=LEN, n=4, seed=s,
+            ))
+            for s in seeds
+        ]
+        wait_for(
+            lambda: len(fleet.workers_alive()) == 2, timeout=120,
+            what="scale-up under burst",
+        )
+        results = [h.result(timeout=240) for h in handles]
+        for seed, res in zip(seeds, results):
+            assert np.array_equal(res.genomes, engine_run(seed, 4)), (
+                f"seed {seed} diverged under autoscaling"
+            )
+        wait_for(
+            lambda: len(fleet.workers_alive()) == 1, timeout=120,
+            what="scale-down to the floor",
+        )
+        # The retirement was a DRAIN: the retired worker exited 0 (a
+        # non-zero exit would have counted as a death).
+        assert fleet.worker_deaths == 0
+    finally:
+        fleet.close()
+        log.close()
+    records = telemetry.validate_log(events_path)
+    kinds = [r["event"] for r in records]
+    assert "autoscale_up" in kinds
+    assert "autoscale_down" in kinds
+    ups = [r for r in records if r["event"] == "autoscale_up"]
+    assert all(r["reason"] == "backlog" for r in ups)

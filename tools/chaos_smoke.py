@@ -49,6 +49,7 @@ Matrix:
 Exit 0 with a one-line summary per scenario; nonzero on first failure.
 """
 
+import dataclasses
 import os
 import signal
 import sys
@@ -314,15 +315,26 @@ def scenario_fleet(tmp, ref_g, ref_best):
     )
     cfg = PGAConfig(use_pallas=False)
 
-    # (a) SIGKILL mid-batch: worker 0 kills ITSELF (real kill -9) at
-    # the start of its first batch; the survivor re-runs the batch.
+    # (a) SIGKILL mid-batch: the doomed worker is spawned ALONE so it
+    # deterministically claims the batch and kills ITSELF (real
+    # kill -9) at the start of its first execution; the survivor is
+    # spawned only after the death is recorded and re-runs the batch.
+    # (With both workers racing one batch, the healthy one could claim
+    # first and the chaos would silently test nothing.)
+    kcfg = dataclasses.replace(fcfg, n_workers=1)
     f = Fleet(os.path.join(tmp, "fleet-kill"), "onemax", config=cfg,
-              fleet=fcfg, events=log)
+              fleet=kcfg, events=log)
     f.start(worker_env={0: {"PGA_WORKER_CHAOS": "sigkill@execute:1"}})
     handles = [
         f.submit(FleetTicket(size=POP, genome_len=LEN, n=GENS, seed=s))
         for s in (21, 22)
     ]
+    deadline = time.monotonic() + 60
+    while f.worker_deaths < 1:
+        if time.monotonic() > deadline:
+            check("fleet-sigkill", False, "chaos worker never died")
+        time.sleep(0.02)
+    f.start()  # the survivor
     results = [h.result(timeout=300) for h in handles]
     refs = []
     for s in (21, 22):

@@ -484,4 +484,7 @@ JAX_PLATFORMS=cpu python tools/lint_pga.py --all
 echo "== ci: tenant smoke =="
 JAX_PLATFORMS=cpu python tools/tenant_smoke.py
 
+echo "== ci: fairness smoke =="
+JAX_PLATFORMS=cpu python tools/fairness_smoke.py
+
 echo "== ci: all stages passed =="

@@ -1,0 +1,239 @@
+#!/usr/bin/env python
+"""Elastic-fleet fairness + autoscaling smoke (ISSUE 15) — ci.sh
+stage 16.
+
+A burst tenant and a steady tenant through a REAL autoscaled fleet,
+end to end — the chaos-style acceptance of ROADMAP item 1:
+
+1. **Latency isolation**: the burst tenant floods 24 tickets; the
+   steady tenant trickles 8 tickets in while the burst is queued. The
+   steady tenant's ``fleet.tenant.spool_wait_ms`` p99 must stay under
+   its SLO while the burst tenant demonstrably queues (its own
+   spool-wait p99 is worse) — asserted from the EXISTING per-tenant
+   histograms, read back from the spool alone after the coordinator's
+   final flush (the round-19 observability investment doing the
+   acceptance work).
+2. **Load-following autoscaler**: worker count must rise above the
+   floor under the burst and drain back to ``min_workers`` within the
+   cooldown window afterwards — with every result bit-identical to a
+   standalone same-seed engine run (the fixed-fleet reference), since
+   scale-down drains and never kills.
+3. **Admission control**: one submission past the burst tenant's
+   ``TenantPolicy.max_pending`` quota sheds deterministically
+   (``QuotaExceeded`` + one schema-valid ``quota_reject`` event) and
+   leaves the fleet state intact.
+4. Every new event kind this round introduced (``sched_round``,
+   ``autoscale_up``, ``autoscale_down``, ``quota_reject``) appears in
+   the run's event log and the whole log schema-validates.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+#: The steady tenant's spool-wait SLO for this smoke, generous to this
+#: 1-core host's drift but far below what riding behind a 24-ticket
+#: burst in FIFO order would cost (the whole burst takes multiple
+#: seconds of service time here).
+STEADY_SLO_MS = 2000.0
+
+
+def main() -> int:
+    import numpy as np
+
+    from libpga_tpu import PGA, PGAConfig
+    from libpga_tpu.config import (
+        AutoscaleConfig,
+        FleetConfig,
+        TenantPolicy,
+    )
+    from libpga_tpu.serving.fleet import Fleet, FleetTicket, fleet_status
+    from libpga_tpu.serving.scheduler import QuotaExceeded
+    from libpga_tpu.utils import metrics as M
+    from libpga_tpu.utils import telemetry as T
+
+    tmp = tempfile.mkdtemp(prefix="pga-fairness-smoke-")
+    spool = os.path.join(tmp, "spool")
+    events_path = os.path.join(tmp, "events.jsonl")
+    log = T.EventLog(events_path)
+    cfg = PGAConfig(use_pallas=False)
+    registry = M.MetricsRegistry()
+    POP, LEN, GENS = 128, 16, 4
+    BURST_N, STEADY_N = 24, 8
+
+    fleet = Fleet(
+        spool, "onemax", config=cfg,
+        fleet=FleetConfig(
+            n_workers=1, max_batch=2, max_wait_ms=5, poll_s=0.02,
+            lease_timeout_s=60.0, heartbeat_s=0.5, sched_lookahead=1,
+            tenants={
+                "steady": TenantPolicy(weight=2.0),
+                "burst": TenantPolicy(
+                    weight=1.0, max_pending=BURST_N
+                ),
+            },
+            autoscale=AutoscaleConfig(
+                min_workers=1, max_workers=2, target_backlog=1.0,
+                up_cooldown_s=0.3, down_cooldown_s=0.5,
+                idle_grace_s=0.8, check_s=0.1,
+            ),
+        ),
+        events=log, registry=registry,
+    )
+    fleet.start()
+
+    # Warm-up: compile the floor worker's mega-run programs at BOTH
+    # batch widths this smoke produces (a width-1 steady batch and a
+    # width-2 co-batch lower distinct programs), then reset the
+    # registry so the timed histograms read steady-state service, not
+    # worker boot + first AOT build. The autoscaled workers still come
+    # up cold — that cost is execute-side and exactly what a real
+    # scale-up pays.
+    fleet.submit(FleetTicket(
+        size=POP, genome_len=LEN, n=GENS, seed=50_000, tenant="steady",
+    )).result(timeout=600)
+    warm = [
+        fleet.submit(FleetTicket(
+            size=POP, genome_len=LEN, n=GENS, seed=50_001 + i,
+            tenant=t,
+        ))
+        for i, t in enumerate(("steady", "burst"))
+    ]
+    for h in warm:
+        h.result(timeout=600)
+    registry.reset()
+
+    # Phase 1 — the burst floods, then the steady tenant trickles in
+    # WHILE the burst is queued; worker count is sampled throughout.
+    burst_handles = [
+        fleet.submit(FleetTicket(
+            size=POP, genome_len=LEN, n=GENS, seed=60_000 + i,
+            tenant="burst",
+        ))
+        for i in range(BURST_N)
+    ]
+    # Admission control: the burst tenant is now AT its quota — the
+    # next submission sheds deterministically.
+    try:
+        fleet.submit(FleetTicket(
+            size=POP, genome_len=LEN, n=GENS, seed=61_000,
+            tenant="burst",
+        ))
+        sys.exit("quota breach did not shed")
+    except QuotaExceeded:
+        pass
+    peak_workers = len(fleet.workers_alive())
+    steady_results = []
+    steady_seeds = []
+    for i in range(STEADY_N):
+        seed = 70_000 + i
+        steady_seeds.append(seed)
+        h = fleet.submit(FleetTicket(
+            size=POP, genome_len=LEN, n=GENS, seed=seed,
+            tenant="steady",
+        ))
+        time.sleep(0.2)
+        # Await each steady ticket PROMPTLY (a real latency-sensitive
+        # client would): its readback span must measure the fleet, not
+        # this driver's patience.
+        steady_results.append(h.result(timeout=600))
+        peak_workers = max(peak_workers, len(fleet.workers_alive()))
+    pending = list(burst_handles)
+    while pending:
+        pending = [h for h in pending if not h.poll()]
+        peak_workers = max(peak_workers, len(fleet.workers_alive()))
+        time.sleep(0.05)
+    for h in burst_handles:
+        h.result(timeout=600)
+
+    # Bit-identity spot check: the elastic fleet changes WHO runs a
+    # ticket and WHEN, never its bits.
+    ref = PGA(seed=steady_seeds[0], config=cfg)
+    ref.create_population(POP, LEN)
+    ref.set_objective("onemax")
+    ref.run(GENS)
+    if not np.array_equal(
+        steady_results[0].genomes, np.array(ref._populations[0].genomes)
+    ):
+        sys.exit("steady result diverged from the same-seed engine run")
+
+    if peak_workers < 2:
+        sys.exit(
+            f"autoscaler never scaled up under the burst "
+            f"(peak {peak_workers})"
+        )
+    # Scale-down: back to the floor within the cooldown window.
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if len(fleet.workers_alive()) == 1:
+            break
+        time.sleep(0.1)
+    else:
+        sys.exit(
+            f"autoscaler did not drain back to the floor "
+            f"(alive {fleet.workers_alive()})"
+        )
+    if fleet.worker_deaths != 0:
+        sys.exit(
+            f"scale-down killed instead of draining "
+            f"({fleet.worker_deaths} deaths)"
+        )
+
+    # Phase 2 — the isolation verdict, FROM THE SPOOL ALONE: flush the
+    # coordinator's registry, then reconstruct per-tenant spool-wait
+    # percentiles with fleet_status on the directory.
+    fleet.flush_metrics()
+    st = fleet_status(spool)
+    steady = st["tenants"].get("steady", {})
+    burst = st["tenants"].get("burst", {})
+    s_wait = (steady.get("spool_wait") or {}).get("p99_ms")
+    s_e2e = (steady.get("e2e") or {}).get("p50_ms")
+    b_e2e = (burst.get("e2e") or {}).get("p50_ms")
+    if s_wait is None or s_e2e is None or b_e2e is None:
+        sys.exit(f"spool lost the per-tenant histograms: "
+                 f"steady={steady} burst={burst}")
+    if s_wait > STEADY_SLO_MS:
+        sys.exit(
+            f"FAIRNESS VIOLATION: steady spool_wait p99 {s_wait:.0f} ms "
+            f"> SLO {STEADY_SLO_MS:.0f} ms under a concurrent burst"
+        )
+    # The burst tenant demonstrably QUEUED: with the fair scheduler
+    # holding its backlog in the coordinator (the intake span), its
+    # median end-to-end is far above the steady tenant's.
+    if not b_e2e > 2.0 * s_e2e:
+        sys.exit(
+            f"burst tenant did not queue (burst e2e p50 {b_e2e:.0f} ms "
+            f"vs steady {s_e2e:.0f} ms) — the smoke lost its load"
+        )
+    fleet.close()
+    log.close()
+
+    # Phase 3 — event-log schema: the round's new kinds all fired.
+    records = T.validate_log(events_path)
+    kinds = {r["event"] for r in records}
+    for kind in ("sched_round", "autoscale_up", "autoscale_down",
+                 "quota_reject", "tenant_admit"):
+        if kind not in kinds:
+            sys.exit(f"event log missing {kind} (got {sorted(kinds)})")
+    rejects = [r for r in records if r["event"] == "quota_reject"]
+    if len(rejects) != 1 or rejects[0]["tenant"] != "burst":
+        sys.exit(f"unexpected quota_reject records: {rejects}")
+
+    print(
+        f"fairness smoke OK: steady spool_wait p99 {s_wait:.0f} ms "
+        f"(SLO {STEADY_SLO_MS:.0f}), e2e p50 steady {s_e2e:.0f} ms vs "
+        f"burst {b_e2e:.0f} ms under a {BURST_N}-ticket burst; workers "
+        f"1 -> {peak_workers} -> 1; quota shed deterministic; results "
+        f"bit-identical"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
