@@ -93,9 +93,15 @@ V5E_BF16_PEAK = 197e12  # TPU v5e: 197 TFLOP/s bf16 per chip
 V5E_HBM_PEAK = 819e9  # TPU v5e: 819 GB/s HBM bandwidth per chip
 
 # Version of the emitted JSON artifact's schema. Bump when keys are
-# added/renamed; tools/ci.sh gates on the current artifact carrying it.
+# added/renamed; tools/ci.sh gates on the newest artifact speaking a
+# version this code still parses (older artifacts keep their stamp —
+# the perf-history normalizer, libpga_tpu/perf/history.py, reads every
+# generation).
 # 1 = rounds <= 7 implicit schema + the provenance block below.
-SCHEMA_VERSION = 1
+# 2 = + git_rev / monotonic run_id provenance (ISSUE 17 — the identity
+#     fields the perf-history DB orders and dedupes ingested
+#     artifacts by).
+SCHEMA_VERSION = 2
 
 
 def enable_persistent_cache():
@@ -157,6 +163,17 @@ def provenance(cache_dir: str = None) -> dict:
     if cache_dir is not None:
         out["compilation_cache_dir"] = cache_dir
         out["compilation_cache_entries"] = _cache_entries(cache_dir)
+    # Schema-2 identity stamps (ISSUE 17): the git revision the numbers
+    # were measured at and a monotonic run id — what the perf-history
+    # DB (libpga_tpu/perf/history.py) orders and dedupes artifacts by.
+    # Never allowed to break a bench run.
+    try:
+        from libpga_tpu.perf.history import git_rev, new_run_id
+
+        out["git_rev"] = git_rev()
+        out["run_id"] = new_run_id()
+    except Exception:
+        pass
     return out
 
 
@@ -191,6 +208,23 @@ def reference_floor_seconds_per_gen() -> float:
     return launches_per_op * 3 * 3.5e-6
 
 
+def _fire_bench_measure(n: int) -> None:
+    """ISSUE 17 fault site on the bench measurement path: a
+    ``kind="slow"`` plan (``robustness/faults``) stalls ``param``
+    seconds PER GENERATION inside the timed window — a
+    work-proportional synthetic regression. Per-generation matters:
+    the two-length-subtraction estimator cancels any constant per-call
+    overhead by construction, so only a work-scaled slowdown is
+    measurable — exactly like a real kernel regression, which is what
+    lets tools/perf_gate.py prove its trip wire through the REAL
+    measurement path. With no plan installed this is one attribute
+    read (the disabled-path purity stance of every site)."""
+    from libpga_tpu.robustness import faults as _faults
+
+    if _faults.PLAN is not None and _faults.PLAN.fire("bench.measure"):
+        time.sleep(_faults.PLAN.param_of("bench.measure") * n)
+
+
 def _best_gps(run, lo: int = 50, hi: int = 150, tries: int = 3) -> float:
     """Generations/sec via two-length subtraction of per-length minima.
 
@@ -203,9 +237,11 @@ def _best_gps(run, lo: int = 50, hi: int = 150, tries: int = 3) -> float:
     t_lo, t_hi = [], []
     for _ in range(tries):
         t0 = time.perf_counter()
+        _fire_bench_measure(lo)
         run(lo)
         t_lo.append(time.perf_counter() - t0)
         t0 = time.perf_counter()
+        _fire_bench_measure(hi)
         run(hi)
         t_hi.append(time.perf_counter() - t0)
     delta = min(t_hi) - min(t_lo)
@@ -1477,38 +1513,35 @@ def streaming_arm(rounds: int = ROUNDS) -> dict:
 
 
 def single_derived(gene_dtype, gps) -> dict:
-    """Roofline-relative figures for the single-population result."""
-    import jax.numpy as jnp
+    """Roofline-relative figures for the single-population result,
+    derived through the ISSUE 17 cost model (``libpga_tpu/perf/cost``)
+    — the same plan→cost hook ``PGA.program_report`` uses, so this
+    note and a program report for the same shape can never disagree.
+    The flat keys keep their historical names/rounding for cross-round
+    continuity; the ``roofline_*`` keys are the systematic replacement
+    for the ad-hoc ``selection_matmul_mfu`` figure."""
+    from libpga_tpu.perf import achieved as perf_achieved, breed_report
 
-    from libpga_tpu.ops.pallas_step import (
-        _pick_deme_size, auto_deme_size, multigen_default_t,
+    report = breed_report(
+        POP, GENOME_LEN, gene_dtype=gene_dtype, device_kind="TPU v5e",
     )
-
-    Lp = math.ceil(GENOME_LEN / 128) * 128
-    gene_bytes = 2 if gene_dtype == jnp.bfloat16 else 4
-    # Mirror make_pallas_breed's exact K choice (lane- and dtype-aware)
-    # so the FLOPs model can never describe a deme size the kernel
-    # didn't run.
-    K = _pick_deme_size(
-        POP, auto_deme_size(gene_dtype), genome_lanes=Lp,
-        gene_bytes=gene_bytes,
-    )
-    matmuls = 2 if gene_dtype == jnp.bfloat16 else 4
-    flops_per_gen = POP * K * Lp * 2 * matmuls
-    achieved = gps * flops_per_gen
-    T = multigen_default_t(gene_dtype)  # the engine's auto launch depth
-    hbm = gps * hbm_bytes_per_gen(POP, Lp, gene_bytes, T)
-    mfu = round(achieved / V5E_BF16_PEAK, 4)
+    got = perf_achieved(report, gps)
+    # The FLOPs model counts ONLY the one-hot parent-selection matmuls
+    # (perf/cost module docstring). "mfu" repeats selection_matmul_mfu
+    # for cross-round continuity of the flat keys.
+    mfu = round(got["flops_frac_of_peak"], 4)
     return {
         "ms_per_gen": round(1000.0 / gps, 3) if gps else None,
-        "achieved_tflops": round(achieved / 1e12, 2),
-        # selection_matmul_mfu is the honest name: the FLOPs model counts
-        # ONLY the one-hot parent-selection matmuls (module docstring).
-        # "mfu" repeats it for cross-round continuity of the flat keys.
+        "achieved_tflops": round(got["achieved_flops"] / 1e12, 2),
         "mfu": mfu,
         "selection_matmul_mfu": mfu,
-        "achieved_hbm_gbps": round(hbm / 1e9, 1),
-        "hbm_frac_of_peak": round(hbm / V5E_HBM_PEAK, 4),
+        "achieved_hbm_gbps": round(
+            got["achieved_hbm_bytes_per_sec"] / 1e9, 1
+        ),
+        "hbm_frac_of_peak": round(got["hbm_frac_of_peak"], 4),
+        "roofline_gens_per_sec": round(report["roofline_gens_per_sec"], 1),
+        "roofline_bound": report["bound"],
+        "roofline_frac": round(got["roofline_frac"], 4),
     }
 
 

@@ -358,6 +358,14 @@ long pga_metrics_snapshot(char *buf, unsigned long cap) {
     return snapshot_out(call("metrics_snapshot_json", "(k)", cap), buf, cap);
 }
 
+long pga_program_report_snapshot(pga_t *p, population_t *pop, char *buf,
+                                 unsigned long cap) {
+    if (!p || !pop) return -1;
+    return snapshot_out(call("program_report_snapshot_json", "(llk)",
+                             solver_of(p), pop_index_of(pop), cap),
+                        buf, cap);
+}
+
 int pga_fleet_start(const char *spool_dir, const char *objective,
                     unsigned n_workers, unsigned max_batch,
                     float max_wait_ms) {

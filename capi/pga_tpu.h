@@ -633,6 +633,20 @@ pga_session_t *pga_session_resume(const char *path, const char *objective);
 int pga_session_close(pga_session_t *s);
 long pga_session_snapshot(char *buf, unsigned long cap);
 
+/* ---- Performance observatory (ISSUE 17) -------------------------------
+ *
+ * pga_program_report_snapshot writes the roofline-attributed program
+ * report for one population's resolved program — per-generation FLOPs,
+ * HBM bytes, VMEM footprint, the analytic roofline bound and which
+ * roof (compute/bandwidth) binds, keyed like the tuning database
+ * (pop|len|dtype|backend|device|objective|operators) — as a UTF-8
+ * JSON document into buf. Derived from the dry-run kernel plan, so it
+ * works on any backend (a CPU process predicts the chip's roofline).
+ * Same size-query and RETRY-ONCE contract as pga_metrics_snapshot
+ * (see above). */
+long pga_program_report_snapshot(pga_t *p, population_t *pop, char *buf,
+                                 unsigned long cap);
+
 #ifdef __cplusplus
 }
 #endif

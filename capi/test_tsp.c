@@ -4,18 +4,21 @@
  * fused duplicate-genes evaluation) + the named in-kernel operators
  * pga_set_crossover_name("order") / pga_set_mutate_name("swap", ...).
  *
- * Checks: a 300-city tour improves substantially from random and the
+ * Checks: a 160-city tour improves substantially from random and the
  * best tour visits every city exactly once; the non-fused
  * ordered-pairs mode agrees on validity; unknown names and bad coord
- * counts return -1. */
+ * counts return -1. (160 cities keeps the beyond-the-reference claim
+ * while fitting the tier-1 wall-clock budget: the XLA order-crossover
+ * scan is ~quadratic in genome length on the CPU backend, and the
+ * 300-city version of this driver alone ate ~15% of it.) */
 #include <stdio.h>
 #include <stdlib.h>
 
 #include "pga_tpu.h"
 
-#define CITIES 300
+#define CITIES 160
 #define POP 2048
-#define GENS 120
+#define GENS 90
 
 static unsigned unique_cities(const gene *g, unsigned len) {
     unsigned char seen[CITIES] = {0};

@@ -1113,6 +1113,26 @@ def metrics_snapshot_json(cap: int = 0) -> bytes:
     )
 
 
+def program_report_snapshot_json(handle: int, pop: int, cap: int = 0) -> bytes:
+    """``pga_program_report_snapshot``: the roofline-attributed program
+    report for one population's resolved program (ISSUE 17 —
+    ``PGA.program_report`` / ``libpga_tpu/perf/cost``) as UTF-8 JSON.
+    Parked per (solver, population), so concurrent callers reporting on
+    different populations can't swap each other's retry bytes. ``cap``
+    is the caller's buffer capacity (retry-once contract,
+    :func:`_sized_snapshot`)."""
+    import json
+
+    pga, h = _handle_pop(handle, pop)
+
+    def render() -> bytes:
+        with _exec_ctx(handle):
+            report = pga.program_report(h)
+        return json.dumps(report, default=str).encode("utf-8")
+
+    return _sized_snapshot(f"program_report/{handle}/{pop}", render, cap)
+
+
 # ------------------------------------------------------------------ fleet
 
 _fleet = None

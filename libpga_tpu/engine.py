@@ -418,6 +418,98 @@ class PGA:
                 best=float(hist.best[-1]),
             )
 
+    def program_report(
+        self,
+        handle: PopulationHandle,
+        measured_gens_per_sec: Optional[float] = None,
+    ) -> dict:
+        """Roofline-attributed program report for this population's
+        resolved program (ISSUE 17): per-generation FLOPs, HBM bytes,
+        VMEM footprint, and the analytic roofline bound, derived from
+        the dry-run plan resolvers — so it works on ANY backend,
+        predicting the chip. Keyed exactly like the tuning database
+        (``report["key"]``), resolved at the engine's own knob
+        precedence (user > tuning DB > default), and emitted as one
+        ``perf_report`` event. GP objectives (``gp/sr.py``) report
+        their evaluator's cost instead of the breed kernel's.
+
+        ``measured_gens_per_sec`` (e.g. from a bench round) adds the
+        achieved-fraction-of-roofline fields (``perf/cost.achieved``)
+        — the systematic replacement for the ad-hoc
+        ``selection_matmul_mfu`` note in older bench artifacts.
+        """
+        from libpga_tpu import perf as _perf
+        from libpga_tpu.tuning import db as _tdb
+
+        pop = self._populations[handle.index]
+        size, genome_len = int(pop.size), int(pop.genome_len)
+        obj = self._objective
+        key = _tdb.current_key(
+            size, genome_len, self.config.gene_dtype,
+            obj if obj is not None else "<unset>",
+            _kind_key(self._crossover_kind()),
+            _kind_key(self._mutate_kind()),
+        )
+        try:
+            device_kind = getattr(
+                jax.devices()[0], "device_kind", None
+            )
+        except RuntimeError:
+            device_kind = None
+        gpc = getattr(obj, "gp_config", None)
+        if gpc is not None:
+            # The SR objective stamps the evaluator knobs it was built
+            # at (gp/sr.py: user > tuning DB > auto, resolved at build).
+            gp_sd, gp_ob = getattr(obj, "knob_args", (None, None))
+            report = _perf.gp_report(
+                size, gpc,
+                int(getattr(obj, "sr_samples", 0)) or 64,
+                stack_depth=gp_sd, opcode_block=gp_ob,
+                device_kind=device_kind,
+            )
+            report["dispatch_path"] = report["path"]
+        else:
+            deme, layout, subblock, _ = self._resolved_pallas_knobs(
+                size, genome_len
+            )
+            ck = self._crossover_kind()
+            mk = self._mutate_kind()
+            report = _perf.breed_report(
+                size, genome_len,
+                gene_dtype=self.config.gene_dtype,
+                tournament_size=self.config.tournament_size,
+                selection_kind=self.config.selection,
+                selection_param=self.config.selection_param,
+                crossover_kind=ck if ck is not None else "uniform",
+                mutate_kind=mk if mk is not None else "point",
+                deme_size=deme, layout=layout, subblock=subblock,
+                generations_per_launch=(
+                    self.config.pallas_generations_per_launch
+                ),
+                const_carrying=bool(
+                    tuple(getattr(obj, "kernel_rowwise_consts", ()))
+                ),
+                device_kind=device_kind,
+            )
+            # The analytic fields predict the FUSED kernel wherever the
+            # plan resolves; dispatch_path records what THIS backend
+            # would actually run (the XLA step path off-TPU).
+            report["dispatch_path"] = (
+                report["path"] if self._pallas_gate() else "xla"
+            )
+        report["key"] = key.as_string()
+        if measured_gens_per_sec is not None:
+            report.update(_perf.achieved(report, measured_gens_per_sec))
+        self._emit(
+            "perf_report",
+            key=report["key"],
+            path=report["path"],
+            roofline_gens_per_sec=report.get("roofline_gens_per_sec"),
+            bound=report.get("bound"),
+            dispatch_path=report["dispatch_path"],
+        )
+        return report
+
     # ------------------------------------------------------------- callbacks
 
     def set_objective(self, fn) -> None:

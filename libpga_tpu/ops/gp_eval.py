@@ -137,6 +137,43 @@ def gp_eval_plan(
     return plan
 
 
+def gp_plan_cost(plan: dict, pop: int, gp: GPConfig, n_samples: int) -> dict:
+    """Analytic per-evaluation cost of a resolved :func:`gp_eval_plan`
+    (the ISSUE 17 plan→cost hook; ``libpga_tpu/perf/cost.py`` builds the
+    GP roofline report from this).
+
+    The mask-only interpreter executes its FULL lattice regardless of
+    masks — every token step touches the whole ``(S, P, B)`` value stack
+    (top read, second read, result write: 3 passes at compare+select =
+    2 ops each) and computes one ``(P, B)`` candidate plane per
+    registered op family (compute + select = 2 ops) — so the dense
+    elementwise count IS the device work, not an upper bound:
+
+        ``flops_per_eval = max_nodes · P · B · (6·S + 2·n_ops)``
+
+    ``B`` is the padded ``batch_lanes`` on the fused path (the kernel
+    pads samples to the 128 lane); the XLA interpreter runs unpadded,
+    so for ``path="xla"`` the same formula over raw ``n_samples`` is
+    reported. HBM bytes are the evaluation's irreducible traffic: the
+    token stream read (ops i32 + args f32 per padded token), the sample
+    matrix and targets, and the score write. ``vmem_bytes`` is the
+    plan's own admission figure (None on the XLA path).
+    """
+    S = int(plan["stack_depth"])
+    fused = plan["path"] == "fused"
+    B = int(plan["batch_lanes"]) if fused else int(n_samples)
+    Tp = int(plan["token_lanes"]) if fused else int(gp.max_nodes)
+    flops = gp.max_nodes * pop * B * (6 * S + 2 * gp.n_ops)
+    hbm = pop * Tp * (4 + 4) + gp.n_vars * B * 4 + B * 4 + pop * 4
+    return {
+        "flops_per_eval": flops,
+        "hbm_bytes_per_eval": hbm,
+        "vmem_bytes": plan["vmem_bytes"],
+        "batch_lanes": B,
+        "path": plan["path"],
+    }
+
+
 def make_gp_eval(
     gp: GPConfig,
     X,

@@ -2005,6 +2005,51 @@ def kernel_plan(
     }
 
 
+def plan_cost(
+    plan: dict,
+    *,
+    gene_dtype=jnp.float32,
+    generations_per_launch: Optional[int] = None,
+) -> dict:
+    """Analytic per-generation cost of a resolved :func:`kernel_plan`
+    (the ISSUE 17 plan→cost hook — ``libpga_tpu/perf/cost.py`` builds
+    roofline reports from this, ``bench.single_derived`` its MFU note).
+
+    Lives HERE, next to the shape model it describes, for the same
+    reason ``kernel_plan`` does: one copy of the geometry, so the cost
+    model can never describe a kernel the factory wouldn't build.
+
+    FLOPs count ONLY the one-hot parent-selection matmuls — per deme
+    and generation, ``matmuls`` K×K·K×Lp products at 2 FLOPs/MAC (f32
+    genes split into bf16 hi/lo passes, so 4 matmuls; bf16 genes take
+    2) — the kernel's only MXU work. Elementwise crossover/mutate/
+    objective VPU work is excluded, so fraction-of-peak never
+    overstates. HBM bytes are the launch-IO floor (one genome
+    read+write and one score read+write per launch, amortized over the
+    ``T`` generations a multi-generation launch breeds — the
+    ``bench.hbm_bytes_per_gen`` model, on the PADDED shape the kernel
+    actually moves). VMEM is the factory's own admission model
+    (:func:`_scoped_vmem_bytes`) at the resolved geometry.
+    """
+    K = int(plan["deme_size"])
+    D = int(plan["demes_per_step"])
+    Pp = int(plan["Pp"])
+    Lp = int(plan["Lp"])
+    gene_bytes = 2 if gene_dtype == jnp.bfloat16 else 4
+    matmuls = 2 if gene_dtype == jnp.bfloat16 else 4
+    T = int(generations_per_launch or multigen_default_t(gene_dtype))
+    genome = 2 * Pp * Lp * gene_bytes
+    scores = 2 * Pp * 4
+    return {
+        "flops_per_gen": Pp * K * Lp * 2 * matmuls,
+        "hbm_bytes_per_gen": (genome + scores) // T,
+        "vmem_bytes": _scoped_vmem_bytes(K, D, Lp, gene_bytes),
+        "gene_bytes": gene_bytes,
+        "matmuls_per_deme": matmuls,
+        "generations_per_launch": T,
+    }
+
+
 def make_pallas_breed(
     pop_size: int,
     genome_len: int,

@@ -1,0 +1,54 @@
+"""Performance observatory (ISSUE 17): analytic roofline-attributed
+program reports (``cost``), per-stage device-time attribution
+(``attribution``), and the append-only benchmark history with its
+drift-floor-aware regression sentinel (``history`` / ``detect``).
+
+Entry points: ``PGA.program_report`` (engine), ``tools/perf_report.py``
+(history backfill/table), ``tools/perf_gate.py`` (the ci.sh stage-17
+regression gate)."""
+
+from libpga_tpu.perf.attribution import (  # noqa: F401
+    STAGE_BUCKETS,
+    stage_breakdown,
+    stage_shares,
+)
+from libpga_tpu.perf.cost import (  # noqa: F401
+    DEFAULT_DEVICE,
+    DEVICE_PEAKS,
+    achieved,
+    breed_report,
+    device_peaks,
+    gp_report,
+    roofline,
+)
+from libpga_tpu.perf.detect import (  # noqa: F401
+    CROSS_PROCESS_FLOOR,
+    DRIFT_FLOOR,
+    MIN_SAMPLES,
+    Verdict,
+    detect,
+)
+from libpga_tpu.perf.history import (  # noqa: F401
+    MAX_ARTIFACT_SCHEMA,
+    PerfHistory,
+    PerfHistoryError,
+    PerfKey,
+    PerfSample,
+    PerfSchemaError,
+    git_rev,
+    merge_files,
+    new_run_id,
+)
+
+SCHEMA_VERSION = 1  # re-exported history schema (perf/history.py)
+
+__all__ = [
+    "STAGE_BUCKETS", "stage_breakdown", "stage_shares",
+    "DEFAULT_DEVICE", "DEVICE_PEAKS", "achieved", "breed_report",
+    "device_peaks", "gp_report", "roofline",
+    "CROSS_PROCESS_FLOOR", "DRIFT_FLOOR", "MIN_SAMPLES", "Verdict",
+    "detect",
+    "MAX_ARTIFACT_SCHEMA", "PerfHistory", "PerfHistoryError", "PerfKey",
+    "PerfSample", "PerfSchemaError", "git_rev", "merge_files",
+    "new_run_id",
+]

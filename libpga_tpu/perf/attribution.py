@@ -1,0 +1,78 @@
+"""Per-stage device-time attribution (ISSUE 17, leg 2).
+
+The round-7 ``pga/<stage>`` trace spans (``utils/telemetry.span``) gave
+profiles a readable per-stage timeline; as of this round every span
+ALSO feeds its host-side duration into the metrics registry as a
+``perf.stage_ms{stage=}`` histogram (``utils/metrics.observe_stage_ms``)
+— so the BENCH_r13 "evaluator = 94% of a GP generation" number is now a
+standing query over the registry instead of a one-off profile read.
+
+This module is the query side: :func:`stage_breakdown` folds the
+``perf.stage_ms`` series of a registry snapshot into total
+milliseconds and shares per stage, and :func:`stage_shares` maps the
+engine's stage names onto the report buckets (breed/eval/selection/
+collective/host) a generation decomposes into.
+
+Host-level semantics, inherited from ``span``: a stage's time is the
+time its DISPATCH held the host, so under the fused run loop the whole
+generation lands in ``run`` (one dispatch), while the step-by-step API
+(``evaluate``/``select_breed``/``mutate``/``swap``) and the island/
+sharded runners decompose. That is the honest accounting off-device;
+on-chip decomposition of the fused kernel comes from the profiler
+trace the spans annotate.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+#: Engine stage → report bucket. ``select_breed`` covers both the
+#: selection matmuls and the crossover (one fused dispatch);
+#: ``migrate`` is the collective bucket (ring ppermute / shard sync);
+#: ``checkpoint`` is host I/O.
+STAGE_BUCKETS = {
+    "run": "run",
+    "run_islands": "run",
+    "evaluate": "eval",
+    "select_breed": "breed",
+    "mutate": "breed",
+    "swap": "breed",
+    "migrate": "collective",
+    "checkpoint": "host",
+}
+
+
+def stage_breakdown(snapshot: Optional[dict] = None) -> Dict[str, dict]:
+    """Fold a registry snapshot's ``perf.stage_ms`` histograms into
+    ``{stage: {"ms": total, "count": n, "share": fraction}}``. With no
+    snapshot given, reads the live process registry."""
+    if snapshot is None:
+        from libpga_tpu.utils import metrics as _metrics
+
+        snapshot = _metrics.REGISTRY.snapshot()
+    out: Dict[str, dict] = {}
+    for rec in snapshot.get("histograms", ()):
+        if rec.get("name") != "perf.stage_ms":
+            continue
+        stage = dict(rec.get("labels") or {}).get("stage", "?")
+        cur = out.setdefault(stage, {"ms": 0.0, "count": 0})
+        cur["ms"] += float(rec.get("sum", 0.0))
+        cur["count"] += int(rec.get("count", 0))
+    total = sum(v["ms"] for v in out.values())
+    for v in out.values():
+        v["share"] = (v["ms"] / total) if total > 0 else 0.0
+    return out
+
+
+def stage_shares(snapshot: Optional[dict] = None) -> Dict[str, float]:
+    """The generation-decomposition view: per-bucket (breed/eval/
+    collective/host/run) share of attributed stage time. Stages outside
+    :data:`STAGE_BUCKETS` fold into ``host`` (they held the host)."""
+    shares: Dict[str, float] = {}
+    for stage, rec in stage_breakdown(snapshot).items():
+        bucket = STAGE_BUCKETS.get(stage, "host")
+        shares[bucket] = shares.get(bucket, 0.0) + rec["share"]
+    return shares
+
+
+__all__ = ["STAGE_BUCKETS", "stage_breakdown", "stage_shares"]

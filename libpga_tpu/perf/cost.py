@@ -1,0 +1,228 @@
+"""Analytic cost model + roofline program reports (ISSUE 17, leg 1).
+
+A *program report* answers, for one resolved program shape, the
+questions a chip round keeps re-deriving by hand: how many FLOPs and
+HBM bytes does a generation cost, what VMEM does the kernel hold, which
+roof (compute or bandwidth) bounds it, and — paired with a measured
+gens/sec — what fraction of that roof the program achieves. Everything
+derives from the DRY-RUN plan resolvers (``ops/pallas_step.kernel_plan``
+/ ``ops/gp_eval.gp_eval_plan``) through their colocated cost hooks
+(``plan_cost`` / ``gp_plan_cost``), so reports need **no hardware**: a
+CPU session can predict the chip's roofline for any shape, and the
+model can never describe a kernel the factory wouldn't build.
+
+Reports are keyed exactly like the tuning database
+(``tuning/db.TuningKey``: pop, len, dtype, backend, device_kind,
+objective class, operator kinds) — a report and a tuning entry for the
+same signature describe the same program.
+
+The FLOPs model counts only the selection matmuls (the kernel's MXU
+work) and the HBM model is the launch-IO floor — both deliberately
+UNDERCOUNT, so achieved-fraction-of-roofline never flatters (the same
+stance as ``bench.hbm_bytes_per_gen``, which this module now backs).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+#: Per-chip peaks (FLOP/s at the matmul dtype the kernel feeds the MXU
+#: — bf16 on every current path — and HBM bytes/s). Keyed by JAX
+#: ``device_kind`` strings; unknown kinds (and CPU hosts predicting for
+#: the chip) fall back to :data:`DEFAULT_DEVICE` — the repo's measured
+#: chip (BASELINE.md) — with ``peaks_assumed=True`` stamped on the
+#: report so a number computed off-device can't masquerade as
+#: calibrated.
+DEVICE_PEAKS = {
+    "TPU v5e": (197e12, 819e9),
+    "TPU v5 lite": (197e12, 819e9),
+    "TPU v4": (275e12, 1228e9),
+    "TPU v4 lite": (137e12, 614e9),
+}
+DEFAULT_DEVICE = "TPU v5e"
+
+
+def device_peaks(device_kind: Optional[str]) -> tuple:
+    """``(peak_flops, peak_hbm_bytes_per_sec, assumed)`` for a device
+    kind; ``assumed`` is True when the kind missed the table and the
+    default chip's peaks were substituted."""
+    if device_kind in DEVICE_PEAKS:
+        return DEVICE_PEAKS[device_kind] + (False,)
+    return DEVICE_PEAKS[DEFAULT_DEVICE] + (True,)
+
+
+def roofline(
+    flops_per_gen: int,
+    hbm_bytes_per_gen: int,
+    device_kind: Optional[str] = None,
+) -> dict:
+    """Roofline bound for one generation's cost: the attainable
+    gens/sec under each roof, their min, and which roof binds.
+    ``arithmetic_intensity`` (FLOPs/byte) against the chip's ridge
+    point (peak_flops/peak_bw) tells the same story in roofline-plot
+    coordinates."""
+    peak_f, peak_b, assumed = device_peaks(device_kind)
+    compute_gps = peak_f / flops_per_gen if flops_per_gen else float("inf")
+    memory_gps = peak_b / hbm_bytes_per_gen if hbm_bytes_per_gen else float(
+        "inf"
+    )
+    bound_gps = min(compute_gps, memory_gps)
+    return {
+        "roofline_gens_per_sec": bound_gps,
+        "bound": "compute" if compute_gps <= memory_gps else "memory",
+        "compute_bound_gens_per_sec": compute_gps,
+        "memory_bound_gens_per_sec": memory_gps,
+        "arithmetic_intensity": (
+            flops_per_gen / hbm_bytes_per_gen if hbm_bytes_per_gen else None
+        ),
+        "ridge_intensity": peak_f / peak_b,
+        "peak_flops": peak_f,
+        "peak_hbm_bytes_per_sec": peak_b,
+        "peaks_device": device_kind if not assumed else DEFAULT_DEVICE,
+        "peaks_assumed": assumed,
+    }
+
+
+def breed_report(
+    pop: int,
+    genome_len: int,
+    *,
+    gene_dtype=None,
+    tournament_size: int = 2,
+    selection_kind: str = "tournament",
+    selection_param: Optional[float] = None,
+    crossover_kind="uniform",
+    mutate_kind="point",
+    deme_size: Optional[int] = None,
+    demes_per_step: Optional[int] = None,
+    layout: Optional[str] = None,
+    subblock: Optional[int] = None,
+    generations_per_launch: Optional[int] = None,
+    const_carrying: bool = False,
+    device_kind: Optional[str] = None,
+) -> dict:
+    """Program report for one breeding shape.
+
+    Resolves the FUSED plan via ``kernel_plan`` (the factory's own
+    dry-run oracle — works on any backend) and attaches per-generation
+    FLOPs/bytes/VMEM plus the roofline bound. Where the factory would
+    decline the shape (``path="xla"``), the report still renders —
+    with ``plan=None`` and no roofline, because the XLA step path has
+    no closed-form cost model — so callers can always key and log it.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from libpga_tpu.ops.pallas_step import kernel_plan, plan_cost
+
+    gene_dtype = jnp.float32 if gene_dtype is None else gene_dtype
+    try:
+        plan = kernel_plan(
+            pop, genome_len,
+            deme_size=deme_size,
+            tournament_size=tournament_size,
+            selection_kind=selection_kind,
+            selection_param=selection_param,
+            crossover_kind=crossover_kind,
+            mutate_kind=mutate_kind,
+            gene_dtype=gene_dtype,
+            demes_per_step=demes_per_step,
+            layout=layout,
+            subblock=subblock,
+            const_carrying=const_carrying,
+        )
+    except (ValueError, TypeError):
+        # Exotic operator objects / inadmissible explicit knobs: report
+        # the XLA path rather than refusing to report at all.
+        plan = None
+    report = {
+        "report": "breed",
+        "pop": int(pop),
+        "genome_len": int(genome_len),
+        "dtype": np.dtype(gene_dtype).name,
+        "path": "fused" if plan is not None else "xla",
+        "plan": plan,
+    }
+    if plan is not None:
+        cost = plan_cost(
+            plan, gene_dtype=gene_dtype,
+            generations_per_launch=generations_per_launch,
+        )
+        report.update(cost)
+        report.update(roofline(
+            cost["flops_per_gen"], cost["hbm_bytes_per_gen"], device_kind,
+        ))
+    return report
+
+
+def gp_report(
+    pop: int,
+    gp,
+    n_samples: int,
+    *,
+    stack_depth: Optional[int] = None,
+    opcode_block: Optional[int] = None,
+    device_kind: Optional[str] = None,
+) -> dict:
+    """Program report for one GP-evaluation shape (``gp`` is a
+    ``gp/encoding.GPConfig``). One *evaluation* of the whole population
+    is the GP analog of a generation, so the roofline fields read in
+    the same units (evals/sec ≡ gens/sec)."""
+    from libpga_tpu.ops.gp_eval import gp_eval_plan, gp_plan_cost
+
+    plan = gp_eval_plan(
+        pop, gp, n_samples,
+        stack_depth=stack_depth, opcode_block=opcode_block,
+    )
+    report = {
+        "report": "gp_eval",
+        "pop": int(pop),
+        "max_nodes": int(gp.max_nodes),
+        "n_samples": int(n_samples),
+        "path": plan["path"] if plan is not None else "xla",
+        "plan": plan,
+    }
+    if plan is not None:
+        cost = gp_plan_cost(plan, pop, gp, n_samples)
+        report["flops_per_gen"] = cost["flops_per_eval"]
+        report["hbm_bytes_per_gen"] = cost["hbm_bytes_per_eval"]
+        report["vmem_bytes"] = cost["vmem_bytes"]
+        report["batch_lanes"] = cost["batch_lanes"]
+        report.update(roofline(
+            cost["flops_per_eval"], cost["hbm_bytes_per_eval"], device_kind,
+        ))
+    return report
+
+
+def achieved(report: dict, measured_gens_per_sec: float) -> dict:
+    """Pair a report with a measured gens/sec: achieved FLOP/s and HBM
+    bytes/s, their fractions of the chip peaks, and the
+    fraction-of-roofline (the number that replaces the ad-hoc
+    ``selection_matmul_mfu`` note in bench artifacts — against the
+    BINDING roof, so 1.0 means "at the model's limit" whichever roof
+    that is)."""
+    gps = float(measured_gens_per_sec)
+    out = {"measured_gens_per_sec": gps}
+    if report.get("flops_per_gen") is None:
+        return out
+    achieved_flops = gps * report["flops_per_gen"]
+    achieved_hbm = gps * report["hbm_bytes_per_gen"]
+    out.update(
+        achieved_flops=achieved_flops,
+        achieved_hbm_bytes_per_sec=achieved_hbm,
+        flops_frac_of_peak=achieved_flops / report["peak_flops"],
+        hbm_frac_of_peak=achieved_hbm / report["peak_hbm_bytes_per_sec"],
+        roofline_frac=gps / report["roofline_gens_per_sec"],
+    )
+    return out
+
+
+__all__ = [
+    "DEVICE_PEAKS",
+    "DEFAULT_DEVICE",
+    "device_peaks",
+    "roofline",
+    "breed_report",
+    "gp_report",
+    "achieved",
+]

@@ -1,0 +1,134 @@
+"""Drift-floor-aware regression detection (ISSUE 17, leg 3).
+
+The measurement doctrine this encodes is BASELINE.md's, learned the
+hard way over four bench rounds: same-process interleaved medians drift
+~4% on the CPU gate host (and up to ±15% ACROSS processes on the
+tunneled chip), so a "regression" smaller than the relevant floor is
+noise, and the noise of the baseline itself (its IQR) must widen the
+bar further. The math is ``utils/profiling``'s — the same
+``_median``/``_rel_ci`` (half-IQR over median) the interleaved-medians
+verdict protocol uses — so the sentinel and the bench speak one
+statistics dialect.
+
+A verdict only says REGRESSED when the current measurement falls below
+``median(baseline) · (1 − max(drift_floor, 2·rel_ci(baseline)))`` with
+at least ``min_samples`` finite baseline points — otherwise it reports
+the (named) reason it abstained, because a gate that fails on noise
+trains people to ignore it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+#: Same-process interleaved drift floor on the CPU gate host
+#: (BASELINE.md: ~4%). Effects below this are indistinguishable from
+#: run-to-run noise even under the interleaved protocol.
+DRIFT_FLOOR = 0.04
+
+#: Cross-process floor (BASELINE.md: ±15% across processes on the
+#: tunneled chip) — the right bar when the baseline was recorded by a
+#: DIFFERENT process/host than the current measurement, which is
+#: exactly the committed-history case ``tools/perf_gate.py`` gates.
+CROSS_PROCESS_FLOOR = 0.15
+
+#: How many finite baseline samples a verdict needs before it may
+#: accuse: below this, ``_rel_ci`` is infinite/degenerate and the
+#: verdict abstains as "baselining".
+MIN_SAMPLES = 3
+
+
+@dataclasses.dataclass(frozen=True)
+class Verdict:
+    """One regression check's full reasoning — every number a human
+    needs to audit the accusation (or the abstention)."""
+
+    metric: str
+    current: float
+    baseline_median: Optional[float]
+    rel_ci: Optional[float]
+    threshold: Optional[float]
+    ratio: Optional[float]
+    n_baseline: int
+    regressed: bool
+    reason: str
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def detect(
+    baseline: Sequence[float],
+    current: float,
+    *,
+    metric: str = "gens_per_sec",
+    drift_floor: float = DRIFT_FLOOR,
+    min_samples: int = MIN_SAMPLES,
+    higher_is_better: bool = True,
+) -> Verdict:
+    """Judge ``current`` against a baseline trajectory.
+
+    NaN/inf baseline points are dropped (a torn artifact or a failed
+    round must not poison the median — the IQR-window edge case the
+    tests pin). The bar is ``max(drift_floor, 2·rel_ci)``: the floor
+    covers environment drift the baseline can't see, the doubled
+    half-IQR covers the baseline's own spread (±rel_ci is the band one
+    median wanders in; 2× keeps a one-sided excursion from accusing).
+    """
+    from libpga_tpu.utils.profiling import _median, _rel_ci
+
+    kept = sorted(
+        float(x) for x in baseline if not (math.isnan(x) or math.isinf(x))
+    )
+    cur = float(current)
+    if math.isnan(cur) or math.isinf(cur):
+        return Verdict(
+            metric=metric, current=cur, baseline_median=None, rel_ci=None,
+            threshold=None, ratio=None, n_baseline=len(kept),
+            regressed=False, reason="current measurement is not finite",
+        )
+    if len(kept) < max(min_samples, 2):
+        return Verdict(
+            metric=metric, current=cur, baseline_median=None, rel_ci=None,
+            threshold=None, ratio=None, n_baseline=len(kept),
+            regressed=False,
+            reason=f"baselining ({len(kept)} finite samples < "
+                   f"{max(min_samples, 2)})",
+        )
+    med = _median(kept)
+    rci = _rel_ci(kept)
+    if med <= 0 or math.isinf(rci):
+        return Verdict(
+            metric=metric, current=cur, baseline_median=med, rel_ci=None,
+            threshold=None, ratio=None, n_baseline=len(kept),
+            regressed=False, reason="degenerate baseline (median <= 0)",
+        )
+    threshold = max(float(drift_floor), 2.0 * rci)
+    ratio = cur / med
+    if higher_is_better:
+        regressed = ratio < 1.0 - threshold
+    else:
+        regressed = ratio > 1.0 + threshold
+    if regressed:
+        reason = (
+            f"{metric}: {cur:.4g} vs baseline median {med:.4g} "
+            f"(ratio {ratio:.3f}) breaches the "
+            f"{threshold:.1%} bar (floor {drift_floor:.0%}, "
+            f"2x rel_ci {2 * rci:.1%}, n={len(kept)})"
+        )
+    else:
+        reason = (
+            f"within the {threshold:.1%} bar "
+            f"(ratio {ratio:.3f}, n={len(kept)})"
+        )
+    return Verdict(
+        metric=metric, current=cur, baseline_median=med, rel_ci=rci,
+        threshold=threshold, ratio=ratio, n_baseline=len(kept),
+        regressed=regressed, reason=reason,
+    )
+
+
+__all__ = ["DRIFT_FLOOR", "CROSS_PROCESS_FLOOR", "MIN_SAMPLES",
+           "Verdict", "detect"]

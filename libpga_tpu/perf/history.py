@@ -1,0 +1,472 @@
+"""Benchmark history database (ISSUE 17, leg 3).
+
+Fifteen rounds of ``BENCH_r*.json`` artifacts exist as loose files with
+three different shapes; this module gives them (and every future bench
+run) ONE durable home: a schema-versioned, append-only store of
+:class:`PerfSample` records keyed by ``(backend, device_kind, shape,
+arm)``, so the repo's performance trajectory is machine-queryable and
+the regression sentinel (``perf/detect.py``, ``tools/perf_gate.py``)
+has a baseline to compare against.
+
+File conventions match the repo's other durable state (``tuning/db.py``,
+``utils/checkpoint``, the fleet spool):
+
+- schema-versioned, refused LOUDLY on mismatch (:class:`PerfSchemaError`
+  — a future schema is not guessed at);
+- written atomically (temp file + ``os.replace`` — a concurrent reader
+  or SIGKILL mid-write can never observe a torn database);
+- merges are ASSOCIATIVE and COMMUTATIVE: samples carry a full identity
+  (key, metric, round, run id, source) and merge is set-union with
+  per-identity conflicts resolved by a total order — merging per-host
+  histories in any grouping yields the same database;
+- :func:`merge_files` SKIPS torn/partial files and reports (warning +
+  returned ``skipped`` list); :meth:`PerfHistory.load` raises
+  :class:`PerfHistoryError` naming the path.
+
+The artifact normalizer (:meth:`PerfHistory.ingest_artifact`) speaks
+every historical generation: the r01–r06 wrapper shape (``{"cmd", "n",
+"parsed", ...}``), the r07–r08 provenance-stamped nested shape, and the
+r09+ flat-key shape — plus the schema-2 artifacts ``bench.provenance``
+now stamps with ``git_rev``/``run_id``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import threading
+import time
+import warnings
+from typing import Dict, List, Optional, Sequence, Tuple
+
+SCHEMA_VERSION = 1
+
+#: Largest bench schema whose artifacts the normalizer understands.
+#: (0 = the pre-provenance r01–r06 wrapper shape.)
+MAX_ARTIFACT_SCHEMA = 2
+
+
+class PerfHistoryError(RuntimeError):
+    """Torn/partial or otherwise unusable perf-history file."""
+
+
+class PerfSchemaError(PerfHistoryError):
+    """Parseable history whose schema_version this code does not speak
+    — always refused loudly, never skipped."""
+
+
+@dataclasses.dataclass(frozen=True)
+class PerfKey:
+    """The measurement context a sample is only comparable within.
+    ``shape`` is the workload geometry (``"1048576x100"``-style when
+    derivable, else the arm's flagship-shape marker ``"default"``);
+    ``arm`` the bench arm family (``single``/``serving``/``fleet``/
+    ``gp``/...)."""
+
+    backend: str
+    device_kind: str
+    shape: str
+    arm: str
+
+    def as_string(self) -> str:
+        return (
+            f"backend={self.backend}|device={self.device_kind}"
+            f"|shape={self.shape}|arm={self.arm}"
+        )
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d: dict) -> "PerfKey":
+        return PerfKey(
+            backend=str(d["backend"]), device_kind=str(d["device_kind"]),
+            shape=str(d["shape"]), arm=str(d["arm"]),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class PerfSample:
+    """One measured number with enough provenance to audit and order it.
+
+    ``round`` is the BENCH round the sample came from (0 = not a
+    numbered artifact, e.g. a gate measurement), ``run_id`` the
+    monotonic id ``bench.provenance`` stamps from schema 2 on (0 for
+    older artifacts), ``source`` the artifact filename or producing
+    tool. Identity is ``(key, metric, round, run_id, source)`` — the
+    append-only set the merge unions."""
+
+    key: PerfKey
+    metric: str
+    value: float
+    unit: str = ""
+    round: int = 0
+    run_id: int = 0
+    git_rev: str = ""
+    source: str = ""
+    artifact_schema: int = 0
+    note: str = ""
+
+    def ident(self) -> str:
+        return (
+            f"{self.key.as_string()}|metric={self.metric}"
+            f"|round={self.round}|run={self.run_id}|src={self.source}"
+        )
+
+    def _order(self) -> tuple:
+        """Total order for same-identity conflicts (two producers
+        writing the same identity with different payloads): newer run
+        wins, ties break on the value then the serialized payload — so
+        ANY merge grouping picks the same winner."""
+        return (
+            self.run_id, self.round, self.value,
+            json.dumps(self.as_dict(), sort_keys=True, default=str),
+        )
+
+    def as_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["key"] = self.key.as_dict()
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "PerfSample":
+        d = dict(d)
+        d["key"] = PerfKey.from_dict(d["key"])
+        return PerfSample(**d)
+
+
+def new_run_id() -> int:
+    """Monotonic run-id provenance for bench artifacts: wall-clock
+    nanoseconds at stamp time — strictly increasing across a host's
+    bench runs (the ingestion order the history's total order uses),
+    unique enough to identify a run without coordination."""
+    return time.time_ns()
+
+
+def git_rev(cwd: Optional[str] = None) -> str:
+    """Current git revision for artifact provenance, or ``"unknown"``
+    (never raises — provenance must not break a bench run)."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10, cwd=cwd,
+        )
+        rev = out.stdout.strip()
+        return rev if out.returncode == 0 and rev else "unknown"
+    except Exception:
+        return "unknown"
+
+
+# --------------------------------------------------- artifact normalizer
+
+#: Bench-arm families: a flat artifact's top-level ``metric`` name (or a
+#: numeric key's prefix) maps onto the arm that produced it.
+_ARM_PREFIXES = (
+    "serving", "supervised", "fleet", "autotuned", "gp", "streaming",
+    "sharded", "tenant", "fairness", "elastic", "session",
+)
+
+_SHAPE_RE = re.compile(r"(\d+)x(\d+)")
+_ROUND_RE = re.compile(r"r(\d+)")
+
+
+def _arm_of(metric: str) -> str:
+    for p in _ARM_PREFIXES:
+        if metric.startswith(p):
+            return p
+    return "single"
+
+
+def _shape_of(metric: str) -> str:
+    m = _SHAPE_RE.search(metric)
+    if m:
+        return m.group(0)
+    if "1M" in metric:
+        return "1048576x100"  # the flagship single-arm shape (bench.POP)
+    return "default"
+
+
+def _pick_primary(top_metric: str, flat: dict) -> str:
+    """Pick the artifact's headline metric.
+
+    r09+ artifacts stamp ``metric`` with a shape suffix
+    (``sharded_gens_per_sec_65536x64``) while the flat keys omit it, so
+    an exact match is tried first, then the suffix-stripped name. Older
+    artifacts carry no top-level metric at all; prefer a throughput
+    series over the alphabetical accident (``genome_len``).
+    """
+    if top_metric in flat:
+        return top_metric
+    stripped = _SHAPE_RE.sub("", top_metric).rstrip("_")
+    if stripped in flat:
+        return stripped
+    if stripped:
+        pref = sorted((k for k in flat if k.startswith(stripped)),
+                      key=lambda k: ("iqr" in k, k))
+        if pref:
+            return pref[0]
+    for pat in ("generations_per_sec", "gens_per_sec", "runs_per_sec",
+                "per_sec"):
+        hits = sorted(k for k in flat if pat in k and "iqr" not in k)
+        if hits:
+            return hits[0]
+    return sorted(flat)[0] if flat else ""
+
+
+def _flatten(prefix: str, obj, out: dict) -> None:
+    if isinstance(obj, bool):
+        return
+    if isinstance(obj, (int, float)):
+        out[prefix] = float(obj)
+        return
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            _flatten(f"{prefix}.{k}" if prefix else str(k), v, out)
+
+
+class PerfHistory:
+    """In-memory perf history; thread-safe for concurrent ingest vs.
+    series reads (the gate's measurement thread pattern)."""
+
+    def __init__(self, samples: Optional[Dict[str, PerfSample]] = None):
+        self.samples: Dict[str, PerfSample] = dict(samples or {})
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    def add(self, sample: PerfSample) -> None:
+        """Insert, keeping the total-order winner on identity conflict
+        (so add() and merge() agree)."""
+        with self._lock:
+            ident = sample.ident()
+            cur = self.samples.get(ident)
+            if cur is None or sample._order() > cur._order():
+                self.samples[ident] = sample
+
+    def merge(self, other: "PerfHistory") -> "PerfHistory":
+        """Associative, commutative merge: identity-set union with
+        per-identity conflicts resolved by the total order."""
+        out = PerfHistory(dict(self.samples))
+        for s in other.samples.values():
+            out.add(s)
+        return out
+
+    def series(
+        self, key: PerfKey, metric: str
+    ) -> List[PerfSample]:
+        """All samples for one ``(key, metric)``, in trajectory order
+        (round, then run id) — the regression detector's baseline."""
+        ks = key.as_string()
+        with self._lock:
+            got = [
+                s for s in self.samples.values()
+                if s.key.as_string() == ks and s.metric == metric
+            ]
+        return sorted(got, key=lambda s: (s.round, s.run_id, s.source))
+
+    def keys(self) -> List[PerfKey]:
+        seen: Dict[str, PerfKey] = {}
+        with self._lock:
+            for s in self.samples.values():
+                seen.setdefault(s.key.as_string(), s.key)
+        return [seen[k] for k in sorted(seen)]
+
+    # --------------------------------------------------------- ingestion
+
+    def ingest_artifact(
+        self, art: dict, source: str = "<memory>"
+    ) -> List[PerfSample]:
+        """Normalize one bench artifact (any historical generation)
+        into samples and add them. Returns what was added.
+
+        Raises :class:`PerfHistoryError` for an artifact claiming a
+        bench schema NEWER than this code understands (the loud-refusal
+        stance); everything else degrades gracefully — unknown keys are
+        just extra metrics, non-numeric leaves are skipped.
+        """
+        if not isinstance(art, dict):
+            raise PerfHistoryError(f"{source}: artifact is not an object")
+        schema = art.get("schema_version", 0)
+        if not isinstance(schema, int) or schema > MAX_ARTIFACT_SCHEMA:
+            raise PerfHistoryError(
+                f"{source}: bench artifact schema_version {schema!r} is "
+                f"newer than supported {MAX_ARTIFACT_SCHEMA} — update "
+                "libpga_tpu/perf/history.py before ingesting"
+            )
+        m = _ROUND_RE.search(os.path.basename(source))
+        rnd = int(m.group(1)) if m else 0
+        # r01–r06 stamped no provenance: those runs predate the ISSUE 3
+        # stamp, so backend/device are recorded as unstamped rather
+        # than guessed at.
+        backend = str(art.get("backend", "unstamped"))
+        device = str(art.get("device_kind", "unstamped"))
+        run_id = int(art.get("run_id", 0))
+        rev = str(art.get("git_rev", ""))
+        top_metric = str(art.get("metric", ""))
+
+        flat: dict = {}
+        if "parsed" in art and isinstance(art["parsed"], dict):
+            parsed = art["parsed"]
+            if "value" in parsed and isinstance(
+                parsed.get("value"), (int, float)
+            ):
+                # r01–r06: one primary number + derived extras.
+                name = str(parsed.get("metric", "value"))
+                flat[name] = float(parsed["value"])
+                top_metric = top_metric or name
+                for k, v in parsed.items():
+                    if k in ("metric", "value", "unit"):
+                        continue
+                    _flatten(f"{name}.{k}", v, flat)
+            else:
+                # r07–r08: nested per-config sub-dicts.
+                top_metric = top_metric or str(parsed.get("metric", ""))
+                _flatten("", parsed, flat)
+        for k, v in art.items():
+            if k in (
+                "schema_version", "run_id", "rc", "n", "parsed", "cmd",
+                "tail", "compilation_cache_entries",
+            ):
+                continue
+            _flatten(k, v, flat)
+
+        added: List[PerfSample] = []
+        primary = _pick_primary(top_metric, flat)
+        for name, value in sorted(flat.items()):
+            arm = _arm_of(top_metric or name)
+            key = PerfKey(
+                backend=backend, device_kind=device,
+                shape=_shape_of(f"{top_metric} {name}"), arm=arm,
+            )
+            s = PerfSample(
+                key=key, metric=name, value=value, round=rnd,
+                run_id=run_id, git_rev=rev,
+                source=os.path.basename(source), artifact_schema=schema,
+                note="primary" if name == primary else "",
+            )
+            self.add(s)
+            added.append(s)
+        return added
+
+    def ingest_file(self, path: str) -> List[PerfSample]:
+        """Ingest one artifact file. Torn/unparseable →
+        :class:`PerfHistoryError` naming the path (backfill callers
+        skip-and-report, mirroring :func:`merge_files`)."""
+        try:
+            with open(path, encoding="utf-8") as fh:
+                art = json.load(fh)
+        except json.JSONDecodeError as exc:
+            raise PerfHistoryError(
+                f"{path}: torn or partial bench artifact ({exc})"
+            ) from exc
+        return self.ingest_artifact(art, source=path)
+
+    # ----------------------------------------------------------- file IO
+
+    def to_json(self) -> dict:
+        with self._lock:
+            return {
+                "schema_version": SCHEMA_VERSION,
+                "samples": [
+                    self.samples[k].as_dict()
+                    for k in sorted(self.samples)
+                ],
+            }
+
+    @staticmethod
+    def from_json(data: dict, path: str = "<memory>") -> "PerfHistory":
+        if not isinstance(data, dict) or "schema_version" not in data:
+            raise PerfHistoryError(
+                f"{path}: not a perf history (no schema_version)"
+            )
+        if data["schema_version"] != SCHEMA_VERSION:
+            raise PerfSchemaError(
+                f"{path}: perf-history schema_version "
+                f"{data['schema_version']!r} != supported "
+                f"{SCHEMA_VERSION} — refusing to guess at a different "
+                "schema (re-run tools/perf_report.py --backfill)"
+            )
+        out = PerfHistory()
+        for d in data.get("samples", ()):
+            try:
+                out.add(PerfSample.from_dict(d))
+            except (KeyError, TypeError, ValueError) as exc:
+                raise PerfHistoryError(
+                    f"{path}: malformed sample {d!r}: {exc}"
+                ) from exc
+        return out
+
+    def save(self, path: str) -> str:
+        """Atomic write: temp file in the same directory +
+        ``os.replace`` — the checkpoint/spool/tuning-DB durability
+        convention (and the ``spool-atomic-write`` lint rule)."""
+        final = os.path.abspath(path)
+        os.makedirs(os.path.dirname(final) or ".", exist_ok=True)
+        tmp = f"{final}.{os.getpid()}.tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(self.to_json(), fh, indent=1, default=str)
+                fh.write("\n")
+            os.replace(tmp, final)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+        return final
+
+    @staticmethod
+    def load(path: str) -> "PerfHistory":
+        """Load one history file. Torn/unparseable →
+        :class:`PerfHistoryError` naming the path; schema mismatch →
+        :class:`PerfSchemaError` (loud refusal)."""
+        try:
+            with open(path, encoding="utf-8") as fh:
+                data = json.load(fh)
+        except json.JSONDecodeError as exc:
+            raise PerfHistoryError(
+                f"{path}: torn or partial perf history ({exc})"
+            ) from exc
+        return PerfHistory.from_json(data, path=path)
+
+
+def merge_files(paths: Sequence[str]) -> Tuple[PerfHistory, List[str]]:
+    """Merge several history files (associative — any grouping of the
+    same files yields the same database). Torn/partial files are
+    SKIPPED and reported; a parseable file with a mismatched schema
+    refuses loudly; a merely missing file is silently fine."""
+    out = PerfHistory()
+    skipped: List[str] = []
+    for p in paths:
+        try:
+            out = out.merge(PerfHistory.load(p))
+        except PerfSchemaError:
+            raise  # loud refusal: a future schema is not guessed at
+        except FileNotFoundError:
+            continue
+        except PerfHistoryError:
+            skipped.append(p)
+    if skipped:
+        warnings.warn(
+            f"perf-history merge skipped {len(skipped)} torn/partial "
+            f"file(s): {skipped}",
+            stacklevel=2,
+        )
+    return out, skipped
+
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "MAX_ARTIFACT_SCHEMA",
+    "PerfHistoryError",
+    "PerfSchemaError",
+    "PerfKey",
+    "PerfSample",
+    "PerfHistory",
+    "merge_files",
+    "new_run_id",
+    "git_rev",
+]

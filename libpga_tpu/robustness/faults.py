@@ -71,9 +71,18 @@ SITES = (
     # scenario; the coordinator must requeue and the worker must notice
     # the lost lease before publishing)
     "worker.heartbeat",
+    # bench measurement path (bench._best_gps, inside the timed
+    # window, scaled per generation): a kind="slow" plan injects a
+    # per-generation delay of ``param`` seconds — the synthetic
+    # regression tools/perf_gate.py proves its trip wire on (ISSUE
+    # 17). Per-generation, not per-call: the two-length-subtraction
+    # estimator cancels any constant per-call overhead by design, so
+    # only work-proportional slowdowns are measurable — exactly like a
+    # real kernel regression.
+    "bench.measure",
 )
 
-_KINDS = ("raise", "nan")
+_KINDS = ("raise", "nan", "slow")
 
 
 class InjectedFault(RuntimeError):
@@ -94,9 +103,11 @@ class FaultPlan:
     Attributes:
       site: injection-site name (see :data:`SITES`).
       kind: ``"raise"`` (an :class:`InjectedFault` propagates from the
-        site) or ``"nan"`` (the site's caller NaN-poisons the scores it
+        site), ``"nan"`` (the site's caller NaN-poisons the scores it
         produces — the numeric-storm mode; only honored at sites that
-        produce scores).
+        produce scores), or ``"slow"`` (the site's caller stalls by
+        :attr:`param` — the injected-regression mode; only honored at
+        sites that time work, currently ``bench.measure``).
       at_call_n: fire on exactly the Nth call to the site (1-based).
       probability: when ``at_call_n`` is None, fire each call with this
         probability (drawn from the registry's seeded PRNG — the SAME
@@ -104,6 +115,9 @@ class FaultPlan:
       times: maximum number of fires for this plan; None = unlimited.
         The default of 1 models a transient fault (fails once, then the
         retried operation succeeds).
+      param: magnitude for value-transform kinds (``"slow"``: seconds
+        of injected delay per unit of work at the site). Ignored by
+        ``"raise"``/``"nan"``.
     """
 
     site: str
@@ -111,6 +125,7 @@ class FaultPlan:
     at_call_n: Optional[int] = None
     probability: float = 0.0
     times: Optional[int] = 1
+    param: float = 0.0
 
     def __post_init__(self):
         if not self.site:
@@ -197,6 +212,15 @@ class FaultRegistry:
                     raise InjectedFault(site, n)
                 return True
         return False
+
+    def param_of(self, site: str) -> float:
+        """Largest ``param`` among this registry's plans at ``site`` —
+        the magnitude a value-transform site applies after
+        :meth:`fire` returns True (e.g. the ``bench.measure`` injected
+        slowdown)."""
+        return max(
+            (p.param for p in self.plans if p.site == site), default=0.0
+        )
 
 
 #: The active registry, or None (the default, and the production state).

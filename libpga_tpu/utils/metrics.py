@@ -804,6 +804,19 @@ def registry() -> MetricsRegistry:
     return REGISTRY
 
 
+def observe_stage_ms(stage: str, ms: float) -> None:
+    """Feed one engine-stage duration into the process registry's
+    ``perf.stage_ms{stage=}`` histogram — the ISSUE 17 per-stage
+    attribution series (``telemetry.span`` calls this around every
+    ``pga/<stage>`` dispatch; ``perf/attribution.stage_breakdown``
+    reads it back as per-stage shares). Never raises: attribution is
+    observability, not control flow."""
+    try:
+        REGISTRY.histogram("perf.stage_ms", stage=stage).observe(ms)
+    except Exception:
+        pass
+
+
 # ------------------------------------------------- Prometheus exposition
 
 

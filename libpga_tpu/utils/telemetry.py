@@ -168,6 +168,16 @@ EVENT_FIELDS: Dict[str, tuple] = {
     "autoscale_up": ("workers", "reason"),
     "autoscale_down": ("workers", "reason"),
     "sched_round": ("batches", "queued"),
+    # Performance observatory (ISSUE 17): one ``perf_report`` per
+    # roofline-attributed program report (``PGA.program_report`` /
+    # ``perf/cost.py``) — the tuning-DB-style key, the resolved path
+    # (fused/xla), and the analytic roofline bound (None on the XLA
+    # path, which has no closed-form cost model); one
+    # ``perf_regression`` per confirmed regression verdict from the
+    # continuous-bench gate (``tools/perf_gate.py`` — always paired
+    # with a flight dump carrying the full verdict context).
+    "perf_report": ("key", "path", "roofline_gens_per_sec"),
+    "perf_regression": ("metric", "current", "baseline", "threshold"),
 }
 
 
@@ -383,16 +393,32 @@ def span(stage: str):
     only — it wraps the dispatch, never the traced computation, so it
     cannot perturb any jaxpr. No-ops (cheaply) when no profiler is
     attached; degrades to a plain passthrough if the profiler API is
-    unavailable."""
-    try:
-        import jax
+    unavailable.
 
-        ann = jax.profiler.TraceAnnotation(SPAN_PREFIX + stage)
-    except Exception:  # profiler backend unavailable — never block the run
-        yield
-        return
-    with ann:
-        yield
+    Every span additionally feeds its host-side duration into the
+    metrics registry as a ``perf.stage_ms{stage=}`` histogram (ISSUE
+    17 per-stage attribution — ``perf/attribution.stage_breakdown``
+    folds these into per-stage shares), so a generation's breakdown is
+    a standing registry query, not a one-off profile read. The timer is
+    host wall time around the DISPATCH — the same host-level contract
+    as the annotation itself."""
+    t0 = time.perf_counter()
+    try:
+        try:
+            import jax
+
+            ann = jax.profiler.TraceAnnotation(SPAN_PREFIX + stage)
+        except Exception:  # profiler unavailable — never block the run
+            ann = None
+        if ann is not None:
+            with ann:
+                yield
+        else:
+            yield
+    finally:
+        from libpga_tpu.utils.metrics import observe_stage_ms
+
+        observe_stage_ms(stage, (time.perf_counter() - t0) * 1e3)
 
 
 # ------------------------------------------------------------- event log

@@ -165,13 +165,13 @@ def test_capi_expression_objective_stays_on_device(built_shim):
 def test_capi_tsp_coords_and_named_operators(built_shim):
     """pga_set_objective_tsp_coords + pga_set_crossover_name('order') +
     pga_set_mutate_name('swap'): the reference's flagship test3 workload
-    as a first-class C path at device speed, 300 cities (beyond the
+    as a first-class C path at device speed, 160 cities (beyond the
     reference's 110-city cap) — best tour is a full permutation; both
     duplicate modes run; unknown names return -1. Explicit timeout: the
-    XLA order-crossover scan on the CPU backend measured ~66 s solo but
+    XLA order-crossover scan on the CPU backend measured ~31 s solo but
     multiplies under suite-parallel CPU load."""
     out = _run(built_shim, "test_tsp", timeout=900)
-    assert "fused TSP: 300/300 unique cities" in out
+    assert "fused TSP: 160/160 unique cities" in out
     assert "pairs-mode TSP" in out
 
 

@@ -1,0 +1,369 @@
+"""Performance observatory tests (ISSUE 17).
+
+Four legs: the analytic cost model against hand-computed FLOPs/bytes
+(the acceptance check — numbers derived from the kernel structure, not
+from the code under test), the drift-floor-aware regression detector,
+the append-only associatively-mergeable perf history, and the
+program-report / stage-attribution plumbing end to end.
+"""
+
+import glob
+import json
+import math
+import os
+
+import jax.numpy as jnp
+import pytest
+
+from libpga_tpu import PGA, PGAConfig, TelemetryConfig, perf
+from libpga_tpu.perf import history as H
+from libpga_tpu.utils import metrics as M
+from libpga_tpu.utils import telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------ cost model
+
+
+def test_breed_report_hand_computed_f32():
+    """The flagship 1Mx100 f32 shape, FLOPs/bytes derived by hand.
+
+    Plan (pure resolution, no hardware): K=512, D=8 ping-pong, Lp=128
+    (100 genes padded to the lane). Selection is 4 (K,K)x(K,Lp)
+    matmuls per deme step (f32 hi/lo split), P/K deme steps per
+    generation: flops = P*K*Lp*2*4. HBM floor: one read + one write of
+    the (P,Lp) population plus two (P,) f32 score vectors per
+    generation.
+    """
+    r = perf.breed_report(1 << 20, 100, gene_dtype=jnp.float32,
+                          device_kind="TPU v5e")
+    P, K, Lp = 1 << 20, 512, 128
+    assert r["path"] != "xla" and r["plan"]["deme_size"] == K
+    assert r["flops_per_gen"] == P * K * Lp * 2 * 4 == 549755813888
+    assert (r["hbm_bytes_per_gen"]
+            == 2 * P * Lp * 4 + 2 * P * 4 == 1082130432)
+    # v5e roofline: 197 TFLOP/s, 819 GB/s. This shape is compute-bound.
+    t_compute = 549755813888 / 197e12
+    t_memory = 1082130432 / 819e9
+    assert t_compute > t_memory and r["bound"] == "compute"
+    assert r["roofline_gens_per_sec"] == pytest.approx(1.0 / t_compute)
+    assert r["arithmetic_intensity"] == pytest.approx(
+        549755813888 / 1082130432)
+
+
+def test_breed_report_hand_computed_bf16():
+    """bf16 halves both the matmul count (native MXU, no hi/lo split:
+    2 instead of 4) and the gene bytes — so FLOPs halve and the HBM
+    floor drops to 2*P*Lp*2 + scores."""
+    r = perf.breed_report(1 << 20, 100, gene_dtype=jnp.bfloat16,
+                          device_kind="TPU v5e")
+    P, K, Lp = 1 << 20, 512, 128
+    assert r["flops_per_gen"] == P * K * Lp * 2 * 2 == 274877906944
+    assert r["hbm_bytes_per_gen"] == 2 * P * Lp * 2 + 2 * P * 4
+    assert r["roofline_gens_per_sec"] == pytest.approx(
+        197e12 / 274877906944)
+
+
+def test_breed_report_mfu_matches_historical_artifact():
+    """perf.achieved reproduces the r05 BENCH artifact's MFU: 140.0
+    gens/s on the f32 1Mx100 shape was published as mfu 0.3907."""
+    r = perf.breed_report(1 << 20, 100, gene_dtype=jnp.float32,
+                          device_kind="TPU v5e")
+    a = perf.achieved(r, 140.0)
+    assert a["flops_frac_of_peak"] == pytest.approx(0.3907, abs=5e-4)
+    assert a["roofline_frac"] == pytest.approx(140.0 * 549755813888 / 197e12)
+
+
+def test_gp_report_hand_computed():
+    """GP-eval FLOPs from the dense mask-only lattice: per (genome,
+    sample, node) the evaluator does 3 stack passes x 2 ops (6*S) plus
+    2 ops per op-family candidate plane (2*n_ops)."""
+    from libpga_tpu.gp.encoding import GPConfig
+
+    gp = GPConfig(max_nodes=64)
+    P = 512
+    r = perf.gp_report(P, gp, 64)
+    S = r["plan"]["stack_depth"]
+    # The kernel computes PADDED sample lanes, not the raw n_samples —
+    # 64 samples occupy a full 128-lane block — so the FLOPs model
+    # charges batch_lanes. gp_report normalizes to the per-"generation"
+    # (= per full-population eval) keys so roofline/achieved work
+    # identically for both report kinds.
+    B = r["batch_lanes"]
+    assert B == 128
+    assert r["flops_per_gen"] == gp.max_nodes * P * B * (
+        6 * S + 2 * gp.n_ops)
+    assert r["report"] == "gp_eval" and r["roofline_gens_per_sec"] > 0
+
+
+def test_breed_report_xla_fallback_has_no_roofline():
+    """A shape the fused kernel refuses (deme floor) degrades to an
+    xla report without fabricated roofline numbers."""
+    r = perf.breed_report(64, 8, gene_dtype=jnp.float32)
+    assert r["path"] == "xla"
+    assert "roofline_gens_per_sec" not in r
+
+
+def test_device_peaks_unknown_kind_is_flagged():
+    flops, hbm, assumed = perf.device_peaks("TPU v99")
+    assert assumed  # fell back to the default chip, and says so
+    assert flops > 0 and hbm > 0
+    assert not perf.device_peaks("TPU v4")[2]
+
+
+# -------------------------------------------------------------- detector
+
+
+def test_detector_inside_drift_floor_abstains():
+    """A 3.9% dip is indistinguishable from same-process CPU drift
+    (the ~4% floor measured in BENCH_r06) — must not convict."""
+    base = [100.0, 101.0, 99.5, 100.5, 100.2]
+    v = perf.detect(base, 100.2 * (1 - 0.039))
+    assert not v.regressed and v.threshold >= perf.DRIFT_FLOOR
+
+
+def test_detector_outside_drift_floor_convicts():
+    base = [100.0, 101.0, 99.5, 100.5, 100.2]
+    v = perf.detect(base, 100.2 * (1 - 0.10))
+    assert v.regressed and "breaches" in v.reason
+
+
+def test_detector_noisy_baseline_widens_bar():
+    """The bar is max(floor, 2*rel_ci): a baseline whose half-IQR is
+    10% of the median gets a 20% bar, so a 15% dip — a conviction on a
+    tight baseline — is acquitted here."""
+    base = [80.0, 90.0, 100.0, 110.0, 120.0]
+    v = perf.detect(base, 85.0)
+    assert v.rel_ci == pytest.approx(0.10)
+    assert v.threshold == pytest.approx(0.20)
+    assert v.threshold > perf.DRIFT_FLOOR
+    assert not v.regressed
+    assert perf.detect(base, 40.0).regressed
+
+
+def test_detector_abstains_below_min_samples():
+    v = perf.detect([100.0, 101.0], 50.0)
+    assert not v.regressed and "baselining" in v.reason
+
+
+def test_detector_drops_non_finite_baseline_points():
+    base = [100.0, float("nan"), 101.0, float("inf"), 99.0]
+    v = perf.detect(base, 80.0)
+    assert v.n_baseline == 3 and v.regressed
+    v2 = perf.detect([float("nan")] * 5, 80.0)
+    assert not v2.regressed and "baselining" in v2.reason
+
+
+def test_detector_identical_baseline_iqr_zero():
+    """Zero spread -> rel_ci 0 -> the bar is exactly the floor."""
+    v = perf.detect([100.0] * 5, 90.0)
+    assert v.rel_ci == 0.0 and v.threshold == perf.DRIFT_FLOOR
+    assert v.regressed
+
+
+def test_detector_degenerate_baseline_abstains():
+    assert not perf.detect([0.0] * 5, 10.0).regressed
+    assert not perf.detect([-5.0, -5.0, -5.0], 1.0).regressed
+
+
+def test_detector_lower_is_better():
+    base = [10.0, 10.2, 9.9, 10.1]
+    v = perf.detect(base, 12.0, metric="ms_per_gen",
+                    higher_is_better=False)
+    assert v.regressed
+    assert not perf.detect(base, 9.0, higher_is_better=False).regressed
+
+
+# --------------------------------------------------------------- history
+
+
+def _sample(metric="gens", value=1.0, rnd=1, run=1, src="a"):
+    return H.PerfSample(
+        key=H.PerfKey("cpu", "cpu", "64x8", "single"),
+        metric=metric, value=value, round=rnd, run_id=run, source=src,
+    )
+
+
+def test_history_merge_is_associative_and_commutative():
+    def mk(*specs):
+        h = H.PerfHistory()
+        for s in specs:
+            h.add(s)
+        return h
+
+    a = mk(_sample(run=1), _sample(run=2, value=2.0))
+    b = mk(_sample(run=2, value=2.0), _sample(run=3, value=3.0))
+    c = mk(_sample(run=4, value=4.0), _sample(metric="other"))
+
+    left = a.merge(b).merge(c)
+    right = a.merge(b.merge(c))
+    assert left.to_json() == right.to_json()
+    assert a.merge(b).to_json() == b.merge(a).to_json()
+    assert len(left) == 5  # the shared run=2 sample deduped
+    # merge() is non-destructive
+    assert len(a) == 2 and len(b) == 2
+
+
+def test_history_conflicting_duplicate_resolves_by_total_order():
+    """Same identity, different value (a re-written artifact): both
+    merge orders must pick the SAME winner or merging isn't a CRDT."""
+    a = H.PerfHistory(); a.add(_sample(value=1.0))
+    b = H.PerfHistory(); b.add(_sample(value=2.0))
+    ab = a.merge(b).to_json()
+    ba = b.merge(a).to_json()
+    assert ab == ba
+
+
+def test_history_atomic_save_and_load(tmp_path):
+    h = H.PerfHistory()
+    h.add(_sample())
+    path = str(tmp_path / "hist.json")
+    h.save(path)
+    assert not glob.glob(str(tmp_path / "*.tmp"))  # no torn residue
+    h2 = H.PerfHistory.load(path)
+    assert h2.to_json() == h.to_json()
+
+
+def test_history_refuses_newer_schema(tmp_path):
+    h = H.PerfHistory()
+    h.add(_sample())
+    d = h.to_json()
+    d["schema_version"] = H.SCHEMA_VERSION + 1
+    p = tmp_path / "future.json"
+    p.write_text(json.dumps(d))
+    with pytest.raises(H.PerfSchemaError):
+        H.PerfHistory.load(str(p))
+
+
+def test_history_torn_file_skip_and_report(tmp_path):
+    good = tmp_path / "good.json"
+    h = H.PerfHistory()
+    h.add(_sample())
+    h.save(str(good))
+    torn = tmp_path / "torn.json"
+    torn.write_text(good.read_text()[: len(good.read_text()) // 2])
+    merged, skipped = H.merge_files([str(good), str(torn)])
+    assert len(merged) == 1
+    assert len(skipped) == 1 and "torn.json" in skipped[0]
+    with pytest.raises(H.PerfHistoryError):
+        merged.ingest_file(str(torn))
+
+
+def test_ingest_refuses_future_artifact_schema():
+    h = H.PerfHistory()
+    with pytest.raises(H.PerfHistoryError, match="newer than supported"):
+        h.ingest_artifact(
+            {"schema_version": H.MAX_ARTIFACT_SCHEMA + 1, "x": 1.0},
+            source="BENCH_r99.json",
+        )
+
+
+def test_backfill_all_historical_artifacts_ingest():
+    """The acceptance check: every committed BENCH_r*.json (three
+    artifact generations) lands in one schema-valid history DB with
+    exactly one primary sample per artifact."""
+    paths = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+    assert len(paths) >= 15
+    h = H.PerfHistory()
+    per_round_primaries = {}
+    for p in paths:
+        added = h.ingest_file(p)
+        assert added, f"{p} produced no samples"
+        prim = [s for s in added if s.note == "primary"]
+        assert len(prim) == 1, f"{p}: primaries {prim}"
+        per_round_primaries[prim[0].round] = prim[0]
+    assert set(per_round_primaries) == set(range(1, len(paths) + 1))
+    # r01-r06 predate provenance stamping and must say so, not guess.
+    assert per_round_primaries[1].key.backend == "unstamped"
+    assert per_round_primaries[15].key.backend == "cpu"
+    # round-trips through the versioned serialization
+    assert (H.PerfHistory.from_json(h.to_json()).to_json()
+            == h.to_json())
+
+
+def test_series_orders_by_round_then_run():
+    h = H.PerfHistory()
+    h.add(_sample(rnd=2, run=1, value=2.0))
+    h.add(_sample(rnd=1, run=5, value=1.0))
+    h.add(_sample(rnd=2, run=0, value=3.0, src="b"))
+    vals = [s.value for s in h.series(
+        H.PerfKey("cpu", "cpu", "64x8", "single"), "gens")]
+    assert vals == [1.0, 3.0, 2.0]
+
+
+# ----------------------------------------- program report + attribution
+
+
+def _tiny_pga(events_path=None):
+    tel = (TelemetryConfig(history_gens=4, events_path=events_path)
+           if events_path else None)
+    pga = PGA(seed=3, config=PGAConfig(use_pallas=False, telemetry=tel))
+    h = pga.create_population(64, 16)
+    pga.set_objective("onemax")
+    return pga, h
+
+
+def test_program_report_emits_valid_event(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    pga, h = _tiny_pga(path)
+    r = pga.program_report(h)
+    assert r["pop"] == 64 and r["genome_len"] == 16
+    assert r["dispatch_path"] == "xla"  # no TPU in this harness
+    assert r["key"].startswith("pop=64|len=16|dtype=float32|")
+    recs = telemetry.validate_log(path)  # raises on schema break
+    pr = [x for x in recs if x["event"] == "perf_report"]
+    assert pr and pr[0]["key"] == r["key"]
+
+
+def test_program_report_achieved_fraction(tmp_path):
+    pga, h = _tiny_pga()
+    r = pga.program_report(h, measured_gens_per_sec=100.0)
+    assert r["measured_gens_per_sec"] == 100.0
+    if "roofline_gens_per_sec" in r:
+        assert r["roofline_frac"] == pytest.approx(
+            100.0 / r["roofline_gens_per_sec"])
+
+
+def test_span_populates_stage_ms_and_breakdown():
+    M.REGISTRY.reset()
+    pga, _ = _tiny_pga()
+    pga.run(3)
+    shares = perf.stage_shares()
+    assert shares, "pga.run produced no perf.stage_ms series"
+    assert math.isclose(sum(shares.values()), 1.0, rel_tol=1e-9)
+    snap = M.REGISTRY.snapshot()
+    names = {r["name"] for r in snap["histograms"]}
+    assert "perf.stage_ms" in names
+    # ... and the rendering is scrape-able (the stage-17 lint).
+    assert M.lint_prometheus(M.prometheus_text(snap)) == []
+
+
+def test_stage_breakdown_folds_unknown_stage_to_host():
+    snap = {"histograms": [
+        {"name": "perf.stage_ms", "labels": {"stage": "evaluate"},
+         "sum": 30.0, "count": 3},
+        {"name": "perf.stage_ms", "labels": {"stage": "mystery"},
+         "sum": 10.0, "count": 1},
+    ], "counters": [], "gauges": []}
+    shares = perf.stage_shares(snap)
+    assert shares["eval"] == pytest.approx(0.75)
+    assert shares["host"] == pytest.approx(0.25)
+
+
+def test_bench_single_derived_uses_shared_cost_model():
+    import bench
+
+    d = bench.single_derived(jnp.float32, 140.0)
+    assert d["mfu"] == pytest.approx(0.3907, abs=5e-4)
+    assert d["roofline_bound"] == "compute"
+    assert d["selection_matmul_mfu"] == d["mfu"]
+
+
+def test_bench_provenance_stamps_rev_and_run_id():
+    import bench
+
+    prov = bench.provenance()
+    assert prov["schema_version"] == bench.SCHEMA_VERSION == 2
+    assert isinstance(prov["run_id"], int) and prov["run_id"] > 0
+    assert prov["git_rev"]  # short rev or "unknown", never empty

@@ -135,6 +135,25 @@
 #                                 zero RMSE bit-identically across two
 #                                 runs; the gp_run event kind is
 #                                 schema-valid.
+#  17. perf gate                 — tools/perf_gate.py (ISSUE 17): the
+#                                 continuous-bench regression gate.
+#                                 --selftest proves the trip wire
+#                                 through the REAL estimator: a clean
+#                                 baseline is acquitted while an
+#                                 injected work-proportional slowdown
+#                                 (FaultPlan site bench.measure,
+#                                 kind="slow") is convicted, emitting
+#                                 a schema-valid perf_regression event
+#                                 plus a flight dump; then the clean
+#                                 gate measures the fixed workload
+#                                 against the committed
+#                                 PERF_HISTORY.json baseline at the
+#                                 cross-process drift floor and lints
+#                                 the perf.* Prometheus series via
+#                                 tools/metrics_dump.py --check. Also
+#                                 ingests every BENCH_r*.json into a
+#                                 scratch history DB (all artifact
+#                                 generations must keep parsing).
 # Exits nonzero on the first failing stage.
 set -e
 cd "$(dirname "$0")/.."
@@ -222,10 +241,13 @@ if missing:
         f"{latest} missing provenance keys: {sorted(missing)} — every "
         "artifact from schema_version 1 on must be stamped (ISSUE 3)"
     )
-if art["schema_version"] != bench.SCHEMA_VERSION:
+# Range, not equality: the newest committed artifact may predate the
+# current bench schema (ISSUE 17 bumped it to 2 for git_rev/run_id) —
+# old artifacts must keep parsing; only a FUTURE schema is an error.
+if not (1 <= art["schema_version"] <= bench.SCHEMA_VERSION):
     sys.exit(
-        f"{latest} schema_version {art['schema_version']} != "
-        f"bench.SCHEMA_VERSION {bench.SCHEMA_VERSION}"
+        f"{latest} schema_version {art['schema_version']} outside "
+        f"1..bench.SCHEMA_VERSION={bench.SCHEMA_VERSION}"
     )
 print(f"bench provenance OK: {latest} schema_version={art['schema_version']}")
 PY
@@ -486,5 +508,10 @@ JAX_PLATFORMS=cpu python tools/tenant_smoke.py
 
 echo "== ci: fairness smoke =="
 JAX_PLATFORMS=cpu python tools/fairness_smoke.py
+
+echo "== ci: perf gate =="
+JAX_PLATFORMS=cpu python tools/perf_gate.py --selftest
+JAX_PLATFORMS=cpu python tools/perf_gate.py
+JAX_PLATFORMS=cpu python tools/perf_report.py --backfill --db "$(mktemp -d)/scratch_history.json"
 
 echo "== ci: all stages passed =="

@@ -1,0 +1,263 @@
+#!/usr/bin/env python
+"""Continuous-bench regression gate (ISSUE 17, ci.sh stage 17).
+
+    JAX_PLATFORMS=cpu python tools/perf_gate.py             # clean gate
+    JAX_PLATFORMS=cpu python tools/perf_gate.py --selftest  # prove trip
+    JAX_PLATFORMS=cpu python tools/perf_gate.py --record    # add baseline
+
+The gate measures a small fixed workload (OneMax {POP}x{LEN}, XLA
+path) through the REAL bench estimator (``bench._sample_gps``: paired
+two-length subtraction), compares the median of this run's rounds
+against the committed ``PERF_HISTORY.json`` baseline with
+``perf.detect`` at the CROSS-PROCESS drift floor (±15%, BASELINE.md
+doctrine — committed baselines come from other processes), and exits
+nonzero on a confirmed regression after emitting a validated
+``perf_regression`` event and a flight-recorder dump. Fewer than 3
+finite baseline samples → the detector abstains ("baselining") and the
+gate passes. Either way the gate's own run populated ``perf.stage_ms``,
+whose Prometheus rendering is then linted via
+``tools/metrics_dump.py --check`` — the scrape-ability half of the
+observatory contract.
+
+``--selftest`` proves the trip wire end to end in a temp dir: measure a
+clean baseline, re-measure with an injected work-proportional slowdown
+(``FaultPlan(site="bench.measure", kind="slow")`` — per-generation
+stall, the only shape of slowdown the subtraction estimator cannot
+cancel), and require the detector to convict the slowed run and acquit
+the clean one. Exits nonzero if either half fails.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_DB = os.path.join(REPO, "PERF_HISTORY.json")
+GATE_POP, GATE_LEN = 2048, 64
+GATE_METRIC = "gate_gens_per_sec"
+GATE_ROUNDS = 4
+LO, HI = 20, 60  # two-length subtraction lengths (small: this is a gate)
+
+
+def _runner():
+    """The fixed gate workload: OneMax 2048x64 on the XLA path (the
+    path that exists on every backend, so the gate's baseline is
+    comparable wherever ci runs)."""
+    from libpga_tpu import PGA, PGAConfig
+
+    pga = PGA(seed=7, config=PGAConfig(use_pallas=False))
+    h = pga.create_population(GATE_POP, GATE_LEN)
+    pga.set_objective("onemax")
+    pga.run(5)  # compile + warm
+    return pga, h, lambda n: pga.run(n)
+
+
+def _measure(run, rounds: int = GATE_ROUNDS):
+    import bench
+
+    return [bench._sample_gps(run, LO, HI) for _ in range(rounds)]
+
+
+def _gate_key():
+    import jax
+
+    from libpga_tpu.perf import PerfKey
+
+    try:
+        device = getattr(jax.devices()[0], "device_kind", "unknown")
+    except RuntimeError:
+        device = "unknown"
+    return PerfKey(
+        backend=jax.default_backend(), device_kind=str(device),
+        shape=f"{GATE_POP}x{GATE_LEN}", arm="gate",
+    )
+
+
+def _trip(verdict, events_path: str) -> None:
+    """A confirmed regression: emit the validated ``perf_regression``
+    event and dump the flight recorder — the triage artifact."""
+    from libpga_tpu.utils import telemetry as T
+
+    with T.EventLog(events_path) as log:
+        rec = log.emit(
+            "perf_regression",
+            metric=verdict.metric, current=verdict.current,
+            baseline=verdict.baseline_median,
+            threshold=verdict.threshold,
+        )
+    T.validate_event(rec)
+    T.flight_note("perf_regression", {"metric": verdict.metric,
+                                      "ratio": verdict.ratio})
+    dump = T.flight_dump("perf_gate regression")
+    print(f"perf_gate: REGRESSION {verdict.as_dict()}")
+    if dump:
+        print(f"perf_gate: flight dump -> {dump}")
+
+
+def _lint_perf_metrics(tmpdir: str) -> int:
+    """Render the live ``perf.*`` series as Prometheus text and lint it
+    through the real ``tools/metrics_dump.py --check`` subprocess."""
+    from libpga_tpu.utils import metrics as M
+
+    snap = M.REGISTRY.snapshot()
+    for kind in ("counters", "gauges", "histograms"):
+        snap[kind] = [r for r in snap[kind]
+                      if r["name"].startswith("perf.")]
+    if not any(snap[k] for k in ("counters", "gauges", "histograms")):
+        print("perf_gate: no perf.* series after the gate run — the "
+              "span->stage_ms wiring is broken")
+        return 1
+    prom = os.path.join(tmpdir, "perf_metrics.prom")
+    with open(prom, "w", encoding="utf-8") as fh:
+        fh.write(M.prometheus_text(snap))
+    rc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "metrics_dump.py"),
+         "--check", prom],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    ).returncode
+    print(f"perf_gate: prometheus lint of perf.* series "
+          f"{'clean' if rc == 0 else 'FAILED'}")
+    return rc
+
+
+def run_gate(db_path: str, record: bool) -> int:
+    from libpga_tpu.perf import CROSS_PROCESS_FLOOR, PerfHistory, detect
+    from libpga_tpu.perf.history import PerfSample, git_rev, new_run_id
+
+    _, _, run = _runner()
+    samples = _measure(run)
+    current = statistics.median(samples)
+    key = _gate_key()
+    print(f"perf_gate: {key.as_string()} {GATE_METRIC} "
+          f"median={current:.2f} rounds={[round(s, 1) for s in samples]}")
+
+    hist = (PerfHistory.load(db_path) if os.path.exists(db_path)
+            else PerfHistory())
+    baseline = [s.value for s in hist.series(key, GATE_METRIC)]
+    verdict = detect(baseline, current, metric=GATE_METRIC,
+                     drift_floor=CROSS_PROCESS_FLOOR)
+
+    if record:
+        # One run_id per SAMPLE: identity is (key, metric, round,
+        # run_id, source), so same-run samples need distinct ids.
+        rev = git_rev()
+        for s in samples:
+            hist.add(PerfSample(
+                key=key, metric=GATE_METRIC, value=s,
+                run_id=new_run_id(), git_rev=rev, source="perf_gate",
+                note="gate",
+            ))
+        hist.save(db_path)
+        print(f"perf_gate: recorded {len(samples)} samples -> {db_path}")
+
+    rc = 0
+    with tempfile.TemporaryDirectory() as td:
+        if verdict.regressed:
+            _trip(verdict, os.path.join(td, "events.jsonl"))
+            rc = 1
+        else:
+            bar = ("none" if verdict.threshold is None
+                   else f"{verdict.threshold:.3f}")
+            print(f"perf_gate: pass ({verdict.reason}; "
+                  f"baseline n={verdict.n_baseline}, threshold={bar})")
+        lint_rc = _lint_perf_metrics(td)
+    return rc or lint_rc
+
+
+def run_selftest() -> int:
+    from libpga_tpu.perf import (
+        CROSS_PROCESS_FLOOR, PerfHistory, detect,
+    )
+    from libpga_tpu.perf.history import PerfSample
+    from libpga_tpu.robustness import faults
+    from libpga_tpu.utils import telemetry as T
+
+    _, _, run = _runner()
+    key = _gate_key()
+    failures = []
+    with tempfile.TemporaryDirectory() as td:
+        # Clean baseline through the real estimator, persisted through
+        # the real atomic-save/load path.
+        clean = _measure(run)
+        hist = PerfHistory()
+        for i, s in enumerate(clean):
+            hist.add(PerfSample(key=key, metric=GATE_METRIC, value=s,
+                                run_id=i + 1, source="selftest"))
+        db = os.path.join(td, "history.json")
+        hist.save(db)
+        hist = PerfHistory.load(db)
+        baseline = [s.value for s in hist.series(key, GATE_METRIC)]
+        clean_med = statistics.median(baseline)
+
+        # Acquit: a fresh clean re-measure must NOT be convicted. The
+        # floor here is deliberately looser than the gate's (2x the
+        # cross-process floor): this half of the selftest only needs to
+        # separate noise from the ~40% injection below, and a tight bar
+        # would make the selftest itself the flakiest stage in ci.
+        v_clean = detect(baseline, statistics.median(_measure(run, 3)),
+                         metric=GATE_METRIC,
+                         drift_floor=2 * CROSS_PROCESS_FLOOR)
+        print(f"perf_gate selftest: clean verdict {v_clean.as_dict()}")
+        if v_clean.regressed:
+            failures.append("clean run convicted (estimator noise?)")
+
+        # Convict: inject a ~60% work-proportional slowdown into the
+        # timed window and re-measure through the same path.
+        plan = faults.FaultPlan(
+            site="bench.measure", kind="slow", probability=1.0,
+            times=None, param=0.6 / clean_med,
+        )
+        faults.install(plan)
+        try:
+            v_slow = detect(baseline, statistics.median(_measure(run, 2)),
+                            metric=GATE_METRIC,
+                            drift_floor=CROSS_PROCESS_FLOOR)
+        finally:
+            faults.clear()
+        print(f"perf_gate selftest: slowed verdict {v_slow.as_dict()}")
+        if not v_slow.regressed:
+            failures.append("injected slowdown NOT convicted")
+        else:
+            _trip(v_slow, os.path.join(td, "events.jsonl"))
+            try:
+                recs = T.validate_log(os.path.join(td, "events.jsonl"))
+                if not any(r["event"] == "perf_regression" for r in recs):
+                    failures.append("no perf_regression event emitted")
+            except ValueError as exc:
+                failures.append(f"perf_regression event invalid: {exc}")
+
+        lint_rc = _lint_perf_metrics(td)
+        if lint_rc:
+            failures.append("prometheus lint failed")
+
+    if failures:
+        print("perf_gate selftest: FAIL — " + "; ".join(failures))
+        return 1
+    print("perf_gate selftest: ok (clean acquitted, injected slowdown "
+          "convicted, event schema-valid, perf.* scrape-able)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--db", default=DEFAULT_DB)
+    ap.add_argument("--record", action="store_true",
+                    help="append this run's samples to the baseline DB")
+    ap.add_argument("--selftest", action="store_true",
+                    help="prove the trip wire via an injected slowdown")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return run_selftest()
+    return run_gate(args.db, args.record)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Perf-history reporting CLI (ISSUE 17).
+
+    python tools/perf_report.py --backfill            # BENCH_r*.json -> DB
+    python tools/perf_report.py --table               # trajectory table
+    python tools/perf_report.py --ingest ART.json ... # add artifacts
+    python tools/perf_report.py --report 1048576x100 --dtype bfloat16
+
+``--backfill`` ingests every historical bench artifact (all three
+artifact generations — the r01–r06 wrapper shape, the r07–r08
+provenance-stamped nested shape, the r09+ flat shape) into one
+schema-valid history DB; torn files are skipped and reported, matching
+``perf/history.merge_files``. ``--table`` renders the repo's
+performance trajectory as one table (the primary metric of each
+ingested artifact, in round order). ``--report`` prints the analytic
+roofline program report for a shape — the chip-round playbook's
+measurement route (ROADMAP item 5): reports and measurements flow
+through here instead of hand-edited BASELINE.md tables.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_DB = os.path.join(REPO, "PERF_HISTORY.json")
+
+
+def _load(path: str):
+    from libpga_tpu.perf import PerfHistory
+
+    if os.path.exists(path):
+        return PerfHistory.load(path)
+    return PerfHistory()
+
+
+def do_backfill(db_path: str, pattern: str) -> int:
+    hist = _load(db_path)
+    paths = sorted(glob.glob(pattern))
+    if not paths:
+        print(f"perf_report: no artifacts match {pattern!r}")
+        return 1
+    skipped = []
+    n_added = 0
+    for p in paths:
+        try:
+            n_added += len(hist.ingest_file(p))
+        except Exception as exc:  # torn/partial: skip-and-report
+            skipped.append((p, str(exc)))
+    hist.save(db_path)
+    print(
+        f"perf_report: ingested {len(paths) - len(skipped)}/{len(paths)} "
+        f"artifacts ({n_added} samples) into {db_path} "
+        f"({len(hist)} total samples)"
+    )
+    for p, why in skipped:
+        print(f"  skipped {p}: {why}")
+    return 0 if not skipped else 1
+
+
+def do_table(db_path: str, all_metrics: bool) -> int:
+    hist = _load(db_path)
+    rows = sorted(
+        (s for s in hist.samples.values()
+         if all_metrics or s.note == "primary"),
+        key=lambda s: (s.round, s.key.arm, s.metric, s.run_id),
+    )
+    if not rows:
+        print(f"perf_report: {db_path} holds no samples — run --backfill")
+        return 1
+    print(f"{'round':>5}  {'arm':<10} {'backend':<9} "
+          f"{'metric':<44} {'value':>14}  rev")
+    for s in rows:
+        print(
+            f"{s.round:>5}  {s.key.arm:<10} {s.key.backend:<9} "
+            f"{s.metric[:44]:<44} {s.value:>14.4g}  {s.git_rev or '-'}"
+        )
+    print(f"-- {len(rows)} rows ({len(hist)} samples total) from {db_path}")
+    return 0
+
+
+def do_report(shape: str, dtype: str, gp: bool) -> int:
+    from libpga_tpu import perf
+
+    pop, _, length = shape.partition("x")
+    pop, length = int(pop), int(length or 100)
+    if gp:
+        from libpga_tpu.gp.encoding import GPConfig
+
+        report = perf.gp_report(pop, GPConfig(max_nodes=length), 64)
+    else:
+        import jax.numpy as jnp
+
+        report = perf.breed_report(
+            pop, length, gene_dtype=jnp.dtype(dtype).type
+        )
+    print(json.dumps(report, indent=1, default=str))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--db", default=DEFAULT_DB)
+    ap.add_argument("--backfill", nargs="?", const="BENCH_r*.json",
+                    metavar="GLOB")
+    ap.add_argument("--ingest", nargs="+", metavar="FILE")
+    ap.add_argument("--table", action="store_true")
+    ap.add_argument("--all-metrics", action="store_true",
+                    help="--table: every sample, not just primaries")
+    ap.add_argument("--report", metavar="POPxLEN")
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--gp", action="store_true",
+                    help="--report: GP-eval report (LEN = max_nodes)")
+    args = ap.parse_args(argv)
+
+    if args.backfill:
+        pattern = args.backfill
+        if not os.path.isabs(pattern):
+            pattern = os.path.join(REPO, pattern)
+        return do_backfill(args.db, pattern)
+    if args.ingest:
+        hist = _load(args.db)
+        for p in args.ingest:
+            n = len(hist.ingest_file(p))
+            print(f"perf_report: {p}: {n} samples")
+        hist.save(args.db)
+        return 0
+    if args.report:
+        return do_report(args.report, args.dtype, args.gp)
+    return do_table(args.db, args.all_metrics)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
