@@ -423,7 +423,14 @@ long pga_metrics_snapshot(char *buf, unsigned long cap);
  *
  * pga_fleet_start creates (or replaces, closing the old one) the fleet
  * on `spool_dir` serving the named builtin objective, with `max_batch`/
- * `max_wait_ms` as the batch-formation admission window. Returns 0/-1.
+ * `max_wait_ms` as the batch-formation admission window. `ring` != 0
+ * enables the shared-memory ticket ring (ISSUE 18): a coordinator-owned
+ * mmap'd notification ring under the spool that carries claim/
+ * heartbeat/publish wakeups, collapsing the coordination floor from
+ * polling cadence to microseconds. The spool stays the sole source of
+ * truth — a corrupt, stale, or absent ring degrades the fleet back to
+ * pure-spool polling with identical results. 0 = pure-spool (the
+ * pre-ring behavior, bit-for-bit). Returns 0/-1.
  *
  * pga_fleet_submit admits one run (a fresh size x genome_len population
  * from `seed`, `n` generations); `checkpoint_every` > 0 makes the
@@ -484,7 +491,7 @@ long pga_metrics_snapshot(char *buf, unsigned long cap);
 typedef struct pga_fleet_ticket pga_fleet_ticket_t;
 int pga_fleet_start(const char *spool_dir, const char *objective,
                     unsigned n_workers, unsigned max_batch,
-                    float max_wait_ms);
+                    float max_wait_ms, int ring);
 pga_fleet_ticket_t *pga_fleet_submit(unsigned size, unsigned genome_len,
                                      unsigned n, long seed,
                                      unsigned checkpoint_every,

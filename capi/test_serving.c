@@ -183,7 +183,7 @@ int main(void) {
         char spool[] = "/tmp/pga-fleet-capi-XXXXXX";
         if (!mkdtemp(spool))
             return fprintf(stderr, "mkdtemp failed\n"), 1;
-        if (pga_fleet_start(spool, "onemax", 2, 2, 5.0f) != 0)
+        if (pga_fleet_start(spool, "onemax", 2, 2, 5.0f, 1) != 0)
             return fprintf(stderr, "pga_fleet_start failed\n"), 1;
         /* Two tenants through the fleet (ISSUE 14): the ids ride the
          * batch files to the workers and back in the result metas, so

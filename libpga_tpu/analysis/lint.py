@@ -72,6 +72,7 @@ RULES = (
     "event-kind-registered",
     "no-wallclock-in-traced",
     "lock-guarded-registry",
+    "ring-framed-write",
     "unused-suppression",
 )
 
@@ -666,6 +667,76 @@ def rule_lock_guarded_registry(ctx: "FileContext") -> List[Finding]:
     return findings
 
 
+# ------------------------------------------------ rule: ring-framed-write
+
+#: Buffer-expression markers that make a write target "the shared ring
+#: mapping": a direct mmap mention, or the repo's ring-mapping attribute
+#: idiom. Bare names resolve one indirection level through their
+#: visible bindings (``mm = mmap.mmap(...)``) — a plain ``bytearray``
+#: staging image never matches.
+_MMAP_MARKERS = ("mmap", "._mm")
+
+#: Function-name prefix whose bodies are the SANCTIONED writers (the
+#: seqlock/CRC framed-store helpers in ``serving/shm_ring.py``).
+_FRAMED_PREFIX = "_framed"
+
+
+def _mmapish(node: ast.AST, ctx: "FileContext") -> bool:
+    texts = [_unparse(node)]
+    if isinstance(node, ast.Name):
+        scopes = ctx.parents.enclosing_functions(node)
+        texts += _binding_texts(node.id, scopes, ctx.tree)
+    return any(m in t for t in texts for m in _MMAP_MARKERS)
+
+
+def _in_framed_writer(node: ast.AST, ctx: "FileContext") -> bool:
+    return any(
+        getattr(fn, "name", "").startswith(_FRAMED_PREFIX)
+        for fn in ctx.parents.enclosing_functions(node)
+    )
+
+
+def rule_ring_framed_write(ctx: "FileContext") -> List[Finding]:
+    """Every mutation of a shared mmap region must go through the
+    framed seqlock writers (``_framed_*``): a raw slice-assign or
+    ``pack_into`` onto a mapping is exactly the torn-read window the
+    seqlock + CRC framing exists to close. Readers are never flagged
+    (they validate), and building a staging ``bytearray`` image for an
+    atomic file replace is not a shared-mapping write."""
+    findings = []
+    for node in ast.walk(ctx.tree):
+        target = None
+        what = None
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [
+                node.target
+            ]
+            for t in targets:
+                if isinstance(t, ast.Subscript) and _mmapish(
+                    t.value, ctx
+                ):
+                    target = t.value
+                    what = f"{_unparse(t)} = ..."
+        elif isinstance(node, ast.Call) and _call_name(node) in (
+            "pack_into",
+        ):
+            if len(node.args) >= 2 and _mmapish(node.args[1], ctx):
+                target = node.args[1]
+                what = f"pack_into(..., {_unparse(node.args[1])}, ...)"
+        if target is None:
+            continue
+        if _in_framed_writer(node, ctx):
+            continue
+        findings.append(Finding(
+            ctx.path, node.lineno, "ring-framed-write",
+            f"raw mmap mutation {what} outside a {_FRAMED_PREFIX}* "
+            "writer — shared-ring bytes must go through the seqlock/"
+            "CRC framed-store helpers (serving/shm_ring.py) so readers "
+            "can detect torn writes",
+        ))
+    return findings
+
+
 # ----------------------------------------------------------------- driver
 
 
@@ -703,6 +774,7 @@ _FILE_RULES = {
     "event-kind-registered": rule_event_kind_registered,
     "no-wallclock-in-traced": rule_no_wallclock_in_traced,
     "lock-guarded-registry": rule_lock_guarded_registry,
+    "ring-framed-write": rule_ring_framed_write,
 }
 
 

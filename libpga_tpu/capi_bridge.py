@@ -1142,12 +1142,14 @@ _next_fleet_ticket = 1
 
 def fleet_start(
     spool_dir: str, objective: str, n_workers: int, max_batch: int,
-    max_wait_ms: float,
+    max_wait_ms: float, ring: int = 1,
 ) -> int:
     """``pga_fleet_start``: create (or replace) the process-global
     cross-process serving fleet (``serving/fleet.py``) on ``spool_dir``
     and spawn ``n_workers`` worker processes. Replacing an existing
-    fleet closes it first (drain + monitor stop)."""
+    fleet closes it first (drain + monitor stop). ``ring`` != 0 enables
+    the shared-memory ticket ring fast path (ISSUE 18); 0 forces
+    pure-spool polling coordination (identical results either way)."""
     global _fleet
     from libpga_tpu.config import FleetConfig
     from libpga_tpu.serving.fleet import Fleet
@@ -1159,7 +1161,7 @@ def fleet_start(
         spool_dir, objective,
         fleet=FleetConfig(
             n_workers=int(n_workers), max_batch=int(max_batch),
-            max_wait_ms=float(max_wait_ms),
+            max_wait_ms=float(max_wait_ms), ring=bool(ring),
         ),
     )
     _fleet.start()

@@ -505,6 +505,22 @@ class FleetConfig:
         outstanding tickets, and no claimed batches, the monitor's
         poll interval doubles from ``poll_s`` up to this cap, and any
         submission wakes it immediately.
+      ring: same-host shared-memory ticket ring (ISSUE 18,
+        ``serving/shm_ring.py``). On (default), the coordinator creates
+        an mmap'd notification ring under the spool root: workers wake
+        on ring frames instead of polling ``pending/``, lease
+        heartbeats become one framed slot store instead of a file
+        touch, and the monitor wakes on worker notify counters. The
+        spool stays the durable source of truth — any torn, stale, or
+        absent ring record falls back to the pre-ring spool scan
+        bit-for-bit, so the chaos matrix is unchanged. Off disables
+        the ring entirely (pure-spool coordination, the A/B arm of
+        ``bench.py --fleet``).
+      ring_fallback_s: bounded fallback-scan cadence in ring mode:
+        even with a healthy ring, every worker re-lists the pending
+        spool and the coordinator reconciles its advertised depth at
+        least this often, so a wedged or SIGKILL'd peer can never
+        stall the fleet behind a quiet ring.
     """
 
     n_workers: int = 2
@@ -527,6 +543,8 @@ class FleetConfig:
     sched_quantum: float = 1.0
     sched_lookahead: int = 2
     poll_idle_max_s: float = 1.0
+    ring: bool = True
+    ring_fallback_s: float = 1.0
 
     def __post_init__(self):
         if self.n_workers < 1:
@@ -577,6 +595,8 @@ class FleetConfig:
             raise ValueError("sched_lookahead must be >= 1")
         if self.poll_idle_max_s < self.poll_s:
             raise ValueError("poll_idle_max_s must be >= poll_s")
+        if self.ring_fallback_s <= 0:
+            raise ValueError("ring_fallback_s must be > 0")
 
 
 @dataclasses.dataclass(frozen=True)

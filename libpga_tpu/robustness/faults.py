@@ -80,6 +80,17 @@ SITES = (
     # only work-proportional slowdowns are measurable — exactly like a
     # real kernel regression.
     "bench.measure",
+    # shared-memory ticket ring (serving/shm_ring.py, ISSUE 18): fires
+    # on every framed ring WRITE (frame advertise, depth store, worker
+    # slot heartbeat/claim/publish note) — a "raise" plan makes ring
+    # writes fail, forcing the writer onto the pure-spool degradation
+    # path (the chaos proof that the ring is never load-bearing)
+    "ring.publish",
+    # ring wait helpers (worker pending-wait, coordinator
+    # activity-wait): a "raise" plan breaks the event-driven wake so
+    # waiters must fall back to their bounded plain poll; a "slow"
+    # plan delays wakeups without breaking them
+    "ring.wake",
 )
 
 _KINDS = ("raise", "nan", "slow")

@@ -98,7 +98,9 @@ from libpga_tpu.serving.scheduler import (
     FleetScheduler,
     QuotaExceeded,
     SchedEntry,
+    release_room,
 )
+from libpga_tpu.serving.shm_ring import RING_FILENAME, RingError, ShmRing
 from libpga_tpu.utils import metrics as _metrics
 from libpga_tpu.utils import telemetry as _tl
 from libpga_tpu.utils.tenancy import ANON, validate_tenant
@@ -569,9 +571,17 @@ def fleet_status(
                 float(rec["value"])
             )
 
+    # Ring health (ISSUE 18) — read-only peek at the shared-memory
+    # fast path, same spool-alone discipline (works post-mortem).
+    ring_info = ShmRing.peek(spool.path(RING_FILENAME))
+    ring = {"present": False} if ring_info is None else dict(
+        ring_info, present=True
+    )
+
     return {
         "spool": spool.root,
         "ts": now_wall,
+        "ring": ring,
         "queue": {
             "pending_batches": pending,
             "claimed_batches": claimed,
@@ -922,6 +932,103 @@ class Fleet:
         self.burn = TenantBurnTracker(
             self.slo, self.registry, self._emit, "fleet"
         )
+        # Shared-memory ticket ring (ISSUE 18): created before any
+        # worker spawn so every worker attaches a live ring. All ring
+        # writes degrade (never raise) — the spool stays authoritative.
+        self._ring: Optional[ShmRing] = None
+        self._ring_notify = 0  # last observed worker notify sum
+        self._ring_depth = 0  # released-but-unclaimed estimate
+        self._ring_claims_seen = 0
+        self._ring_reconcile_next = 0.0  # monotonic; 0 => reconcile now
+        self._ring_slots: Dict[str, int] = {}  # wid -> bound slot index
+        if self.fleet.ring:
+            self._ring_create()
+
+    # ----------------------------------------------------------------- ring
+
+    def _ring_create(self) -> None:
+        path = self.spool.path(RING_FILENAME)
+        try:
+            self._ring, prior = ShmRing.create(path)
+        except RingError as exc:
+            self._ring_degrade(f"create: {exc}")
+            return
+        stale = bool(prior["existed"] and prior["stale"])
+        if stale:
+            # A SIGKILL'd predecessor's ring: detected (dead pid or
+            # unreadable header) and atomically rebuilt — workers of
+            # the old fleet are gone, so nothing maps the stale inode.
+            self.registry.counter("fleet.ring.stale_rebuilt").bump()
+        self._emit(
+            "ring_attach", role="coordinator", path=path,
+            stale_replaced=stale,
+        )
+
+    def _ring_degrade(self, reason: str) -> None:
+        """Drop this coordinator to pure-spool coordination (one-way):
+        the monitor wait, lease freshness, claim advertisements, and
+        the release-window depth all revert to the pre-ring spool scan
+        paths, bit-for-bit. Workers keep their mapping and simply stop
+        seeing new frames — their bounded fallback scans carry them."""
+        ring, self._ring = self._ring, None
+        if ring is not None:
+            try:
+                ring.close()
+            except Exception:
+                pass
+        self.registry.counter("fleet.ring.degraded").bump()
+        self._emit("ring_degraded", role="coordinator", reason=reason)
+
+    def _ring_advertise(self, name: str) -> None:
+        """Advertise one released batch file as a ``submit`` frame (the
+        ring-advertised claim reservation — workers try this name
+        before falling back to a pending listing) and grow the live
+        depth. The durable release already happened via the atomic
+        spool write; this is only the wake."""
+        ring = self._ring
+        if ring is None:
+            return
+        try:
+            ring.advertise("submit", name)
+        except Exception as exc:
+            self._ring_degrade(f"advertise: {exc}")
+            return
+        self._ring_set_depth(self._ring_depth + 1)
+
+    def _ring_set_depth(self, depth: int) -> None:
+        self._ring_depth = max(int(depth), 0)
+        ring = self._ring
+        if ring is None:
+            return
+        try:
+            ring.set_pending_depth(self._ring_depth)
+        except Exception as exc:
+            self._ring_degrade(f"depth: {exc}")
+
+    def _ring_observe(self) -> None:
+        """Once per monitor tick: fold the workers' claim counters into
+        the live depth estimate (claims consume released batch files).
+        Counter REGRESSIONS (a slot rebound by a respawned worker)
+        just resync the baseline — the periodic reconcile against a
+        real listing bounds any drift either way."""
+        ring = self._ring
+        if ring is None:
+            return
+        counters = ring.counters()
+        delta = counters["claims"] - self._ring_claims_seen
+        self._ring_claims_seen = counters["claims"]
+        if delta > 0:
+            self._ring_set_depth(self._ring_depth - delta)
+
+    def _ring_hb_map(self) -> Dict[str, float]:
+        """wid -> last ring-heartbeat wall time, for lease freshness
+        (ring mode replaces the lease-file touch; the lease scan takes
+        ``max(file mtime, ring heartbeat)`` so a degraded ring can
+        only ever make expiry MORE conservative, never less)."""
+        ring = self._ring
+        if ring is None:
+            return {}
+        return {rec["wid"]: rec["hb"] for rec in ring.slots()}
 
     # --------------------------------------------------------------- events
 
@@ -980,6 +1087,8 @@ class Fleet:
                         "--heartbeat-s", str(self.fleet.heartbeat_s),
                         "--poll-s", str(self.fleet.poll_s),
                         "--metrics-flush-s", str(self.fleet.metrics_flush_s),
+                        "--ring-slot", str(self._ring_slot_for(wid)),
+                        "--ring-fallback-s", str(self.fleet.ring_fallback_s),
                     ],
                     stdout=out, stderr=subprocess.STDOUT, env=env,
                 )
@@ -1000,6 +1109,22 @@ class Fleet:
         from libpga_tpu.streaming.store import SessionStore
 
         return SessionStore(self.spool.path("sessions"))
+
+    def _ring_slot_for(self, wid: str) -> int:
+        """Assign the lowest free ring slot to a spawning worker (the
+        coordinator is the slot allocator — slot assignment at spawn is
+        what keeps every slot single-writer). -1 = no ring / exhausted
+        (the worker then runs pure-spool)."""
+        if self._ring is None:
+            return -1
+        used = set(self._ring_slots.values())
+        from libpga_tpu.serving import shm_ring as _shm
+
+        for idx in range(_shm.HB_SLOTS):
+            if idx not in used:
+                self._ring_slots[wid] = idx
+                return idx
+        return -1
 
     def workers_alive(self) -> List[str]:
         with self._lock:
@@ -1167,11 +1292,27 @@ class Fleet:
     def _pending_room(self) -> int:
         """Release-window headroom: how many more unclaimed batch
         files the coordinator will put on the spool before holding
-        work back in the fair queues."""
-        window = self.fleet.sched_lookahead * max(
-            len(self.workers_alive()), 1
+        work back in the fair queues. Ring mode reads the live depth
+        from the ring's advertised estimate instead of a ``pending/``
+        listing (reconciled against a real listing every
+        ``ring_fallback_s``)."""
+        return release_room(
+            self.fleet.sched_lookahead, len(self.workers_alive()),
+            self._spooled_depth(),
         )
-        return window - len(self.spool.pending_batches())
+
+    def _spooled_depth(self) -> int:
+        """Released-but-unclaimed batch files on the spool."""
+        if self._ring is None:
+            return len(self.spool.pending_batches())
+        now = time.monotonic()
+        if now >= self._ring_reconcile_next:
+            self._ring_reconcile_next = now + self.fleet.ring_fallback_s
+            self.registry.counter("fleet.ring.fallback_scans").bump()
+            depth = len(self.spool.pending_batches())
+            self._ring_set_depth(depth)
+            return depth
+        return self._ring_depth
 
     def _schedule(self, urgent: bool = False, drain: bool = False) -> int:
         """Draw due batches from the weighted-fair scheduler and write
@@ -1204,7 +1345,8 @@ class Fleet:
             self.registry.counter("fleet.sched.rounds").bump()
             self._emit("sched_round", batches=formed, queued=queued)
             self.registry.gauge("fleet.batches.pending").set(
-                len(self.spool.pending_batches())
+                self._spooled_depth() if self._ring is not None
+                else len(self.spool.pending_batches())
             )
             self._wake.set()
         return formed
@@ -1275,6 +1417,9 @@ class Fleet:
             fill_ratio=round(len(tickets) / self.fleet.max_batch, 4),
             priority=priority,
         )
+        # Wake the workers: the durable release above is the truth,
+        # this frame is the reservation they try to claim first.
+        self._ring_advertise(name)
 
     # -------------------------------------------------------------- results
 
@@ -1431,10 +1576,12 @@ class Fleet:
     def _monitor_loop(self) -> None:
         # Adaptive cadence (ISSUE 15 satellite): an idle fleet's wait
         # doubles from poll_s up to poll_idle_max_s; a submit (or any
-        # batch release) sets the wake event and snaps it back.
+        # batch release) sets the wake event and snaps it back. Ring
+        # mode (ISSUE 18) replaces the blind sleep with an event wait
+        # on the workers' notify counters — claims and publishes wake
+        # the monitor within spin_s instead of at the next poll edge.
         while not self._stop_monitor.is_set():
-            if self._wake.wait(timeout=self._wait_s):
-                self._wake.clear()
+            self._monitor_wait()
             if self._stop_monitor.is_set():
                 return
             try:
@@ -1444,10 +1591,43 @@ class Fleet:
                 # scan (e.g. a file racing a rename) must not stop it.
                 pass
 
+    def _monitor_wait(self) -> None:
+        """One monitor sleep: ring event wait when attached, plain
+        wake-event wait otherwise. The adaptive ``_wait_s`` stays the
+        bounded fallback either way."""
+        ring = self._ring
+        if ring is None:
+            if self._wake.wait(timeout=self._wait_s):
+                self._wake.clear()
+            return
+        try:
+            reason, new_sum = ring.wait_activity(
+                self._ring_notify, self._wait_s, stop=self._wake
+            )
+        except Exception as exc:  # ring.wake fault / torn mapping
+            self._ring_degrade(f"wait_activity: {exc}")
+            if self._wake.wait(timeout=self._wait_s):
+                self._wake.clear()
+            return
+        if reason == "stop":
+            self._wake.clear()
+        elif reason == "notify":
+            self._ring_notify = new_sum
+            self.registry.counter("fleet.ring.wakes").bump()
+
     def _tick(self) -> None:
         t0 = time.perf_counter()
         now = _now()
         active = False
+        # Ring bookkeeping first: fold the workers' claim counters into
+        # the advertised pending-depth estimate and refresh the
+        # coordinator-liveness stamp that stale-ring detection reads.
+        self._ring_observe()
+        if self._ring is not None:
+            try:
+                self._ring.touch_coordinator()
+            except Exception as exc:
+                self._ring_degrade(f"touch: {exc}")
         # 1. Admission + release windows: draw due batches from the
         # fair scheduler into the spool's claimable runway.
         if self.sched.depth() > 0:
@@ -1538,6 +1718,7 @@ class Fleet:
                 continue
             self._worker_gone.add(wid)
             self._retiring.discard(wid)
+            self._ring_slots.pop(wid, None)  # slot is reusable now
             self.registry.gauge("fleet.worker.up", worker=wid).set(0)
             if rc == 0:
                 self._emit("worker_exit", worker=wid, returncode=0)
@@ -1565,6 +1746,12 @@ class Fleet:
             lease = self.spool.read_json(self.spool.lease_path(name))
             if lease is not None:
                 lease_owner[name] = lease.get("worker", "?")
+        # Ring-mode workers heartbeat into their slot, not the lease
+        # file — merge the slot stamps so a healthy worker is never
+        # expired off a stale mtime. max() keeps this strictly more
+        # conservative: a degraded/absent ring leaves mtime semantics
+        # exactly as they were pre-ring.
+        ring_hb = self._ring_hb_map()
         gauged_now: set = set()
         for name in claimed_names:
             lease_path = self.spool.lease_path(name)
@@ -1578,6 +1765,9 @@ class Fleet:
                     ).st_ctime
                 except OSError:
                     continue  # finished/requeued under us
+            hb = ring_hb.get(lease_owner.get(name, ""))
+            if hb is not None and hb > mtime:
+                mtime = hb
             last = self._hb_seen.get(name)
             if last is not None and mtime > last:
                 self.registry.counter("fleet.lease.heartbeats").bump()
@@ -1824,6 +2014,7 @@ class Fleet:
             return  # raced a concurrent transition; next tick re-scans
         self.requeues += 1
         self.registry.counter("fleet.lease.requeues").bump()
+        self._ring_advertise(name)  # requeued work is claimable work
         if batch.get("trace", False):
             now_w = _tl.anchored_wall()
             _tl.append_trace(
@@ -2051,6 +2242,11 @@ class Fleet:
             "monitor_poll_s": self._wait_s,
             "retiring": sorted(self._retiring),
             "preempted_batches": sorted(self._preempted_batches),
+            # Ring fast path (ISSUE 18): attached == still on the fast
+            # path; a degraded coordinator runs pure-spool from then on.
+            "ring_enabled": self.fleet.ring,
+            "ring_attached": self._ring is not None,
+            "ring_depth_estimate": self._ring_depth,
         }
         return st
 
@@ -2109,6 +2305,12 @@ class Fleet:
         self._wake.set()  # snap the monitor out of an idle backoff wait
         if self._monitor is not None:
             self._monitor.join(timeout=5)
+        if self._ring is not None:
+            try:
+                self._ring.close(unlink=True)
+            except OSError:
+                pass
+            self._ring = None
         with self._cv:
             self._cv.notify_all()
 

@@ -65,7 +65,20 @@ __all__ = [
     "FleetScheduler",
     "Autoscaler",
     "DirWatch",
+    "release_room",
 ]
+
+
+def release_room(lookahead: int, live_workers: int, spooled: int) -> int:
+    """Release-window headroom: how many more unclaimed batch files the
+    coordinator may put on the spool before holding work back in the
+    fair queues. ``spooled`` is the count of released-but-unclaimed
+    batch files — a ``pending/`` listing in pure-spool mode, the ring's
+    advertised live depth in ring mode (ISSUE 18), which is what lets
+    the windowed release run without a listdir in the submit path. The
+    window floor of one live worker keeps a worker-less fleet able to
+    spool work for workers that arrive later."""
+    return lookahead * max(live_workers, 1) - max(spooled, 0)
 
 
 class QuotaExceeded(QueueFull):

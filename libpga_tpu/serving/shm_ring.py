@@ -1,0 +1,639 @@
+"""Same-host shared-memory ticket ring (ISSUE 18): the fleet's
+coordination fast path.
+
+The spool protocol (``serving/fleet.py``) is the fleet's durable spine:
+every ticket transition is an atomic rename and SIGKILL at any instant
+leaves only recoverable state. It is also the fleet's measured
+coordination floor — BENCH_r15 showed throughput *falling* from 28.8 to
+22.3 runs/sec between 1 and 8 workers, because every transition costs a
+directory scan on the other side of the spool. This module adds the
+same-host accelerator: one mmap'd file under the spool root carrying
+ticket *metadata* — submit / claim / heartbeat / publish / result-ready
+notifications — so workers and the coordinator wake on a shared-memory
+counter instead of polling directories, and a lease heartbeat is one
+framed slot store instead of a file touch.
+
+The ring is NEVER the source of truth. Every reader treats a torn,
+stale, CRC-bad, overflowed, or absent record as "consult the spool":
+the fallback path is exactly the pre-ring behavior, bit-for-bit, and a
+bounded fallback scan cadence is kept even when the ring looks healthy
+so a SIGKILL'd or wedged peer can never stall the fleet.
+
+Layout (all little-endian, one file, default ``ring.shm`` under the
+spool root, created atomically by the coordinator via temp + rename)::
+
+    [fixed header][mutable record][worker slots][event frames]
+
+- **fixed header** (offset 0, written once at create): magic
+  ``PGARING1``, layout version, geometry (slot/frame counts and sizes),
+  the coordinator pid and creation wall time — what :meth:`ShmRing.attach`
+  validates and what stale-ring detection reads on restart.
+- **mutable record** (offset 256, seqlock+CRC framed, coordinator is
+  the single writer): the frame ``head`` sequence, the advertised
+  ``pending_depth`` (released-but-unclaimed batch files), and a
+  ``coord_alive`` wall-clock touch refreshed every monitor tick.
+- **worker slots** (one per worker, seqlock+CRC framed, each slot's
+  spawned worker is its single writer): worker id, pid, last heartbeat
+  wall time, and monotone ``notify``/``claims``/``publishes`` counters.
+  The coordinator's monitor wakes on the sum of ``notify`` counters;
+  lease freshness reads the heartbeat stamp instead of a lease-file
+  mtime.
+- **event frames** (a ring of fixed-size frames, coordinator is the
+  single writer): JSON payloads validated by a per-frame global
+  sequence number + CRC32. Frame ``s`` lives at index ``(s-1) % N``;
+  a reader that has fallen more than ``N`` frames behind sees the
+  overflow and falls back to a spool scan — the ring never blocks and
+  never drops work, it only stops accelerating.
+
+Single-writer-per-region discipline is what makes the seqlock protocol
+sufficient: no CAS, no cross-process locks, no futexes — just framed
+stores (odd sequence while writing, even+CRC when committed) and
+validating readers. All raw mmap mutations in this module live in the
+``_framed_*`` helpers; ``tools/lint_pga.py``'s ``ring-framed-write``
+rule enforces that nothing else in the repo mutates an mmap directly.
+"""
+
+from __future__ import annotations
+
+import json
+import mmap
+import os
+import struct
+import threading
+import time
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+from libpga_tpu.robustness import faults as _faults
+
+__all__ = ["ShmRing", "RingError", "RING_FILENAME"]
+
+RING_FILENAME = "ring.shm"
+
+MAGIC = b"PGARING1"
+LAYOUT_VERSION = 1
+
+#: Geometry defaults. Stored in the fixed header at create time, so
+#: attachers compute offsets from the file, not from these constants.
+HDR_SIZE = 4096
+MUT_OFF = 256
+HB_SLOTS = 64
+SLOT_SIZE = 128
+N_FRAMES = 512
+FRAME_SIZE = 256
+
+_FIXED_FMT = "<8sIIIIIQd"  # magic, version, slots, frames, fsize, ssize, pid, created
+_MUT_FMT = "<QQd"  # head, pending_depth, coord_alive
+_SLOT_FMT = "<16sQdQQQ"  # wid, pid, hb, notify, claims, publishes
+_FRAME_HDR_FMT = "<QII"  # seqno, length, crc32
+
+_MUT_SIZE = struct.calcsize(_MUT_FMT)
+_SLOT_PAYLOAD = struct.calcsize(_SLOT_FMT)
+_FRAME_HDR = struct.calcsize(_FRAME_HDR_FMT)
+
+
+class RingError(RuntimeError):
+    """The ring could not be created, attached, or written. Callers
+    degrade to the pure-spool path — never propagate this into fleet
+    correctness."""
+
+
+# ------------------------------------------------------- framed writers
+#
+# THE sanctioned mmap mutations (lint rule ``ring-framed-write``): a
+# seqlock+CRC framed store for fixed-size records, a sequence-stamped
+# store for ring frames, and the create-time image write. Everything
+# else in the repo goes through ShmRing's public methods.
+
+
+def _framed_store(mm, off: int, payload: bytes) -> None:
+    """Seqlock+CRC framed store: bump the 32-bit sequence to odd (write
+    in progress), lay down the payload and its CRC32, bump to even
+    (committed). A reader that observes an odd or unstable sequence, or
+    a CRC mismatch, discards the read."""
+    (seq,) = struct.unpack_from("<I", mm, off)
+    struct.pack_into("<I", mm, off, (seq + 1) & 0xFFFFFFFF)
+    mm[off + 4:off + 4 + len(payload)] = payload
+    struct.pack_into(
+        "<I", mm, off + 4 + len(payload), zlib.crc32(payload) & 0xFFFFFFFF
+    )
+    struct.pack_into("<I", mm, off, (seq + 2) & 0xFFFFFFFF)
+
+
+def _framed_store_frame(mm, off: int, seqno: int, payload: bytes) -> None:
+    """Ring-frame store: invalidate the frame's sequence stamp, lay
+    down length + CRC + payload, then commit the global sequence
+    number. Readers require the stamp to equal the exact sequence they
+    expect at this index, before AND after reading the payload."""
+    struct.pack_into("<Q", mm, off, 0)
+    struct.pack_into(
+        "<II", mm, off + 8, len(payload), zlib.crc32(payload) & 0xFFFFFFFF
+    )
+    mm[off + _FRAME_HDR:off + _FRAME_HDR + len(payload)] = payload
+    struct.pack_into("<Q", mm, off, seqno)
+
+
+# ------------------------------------------------------ validating reads
+
+
+def _framed_load(mm, off: int, size: int) -> Optional[bytes]:
+    """Validating read of a seqlock+CRC framed record; None on a torn
+    or corrupt frame (caller falls back to the spool)."""
+    for _ in range(4):
+        (s1,) = struct.unpack_from("<I", mm, off)
+        if s1 & 1:
+            continue
+        payload = bytes(mm[off + 4:off + 4 + size])
+        (crc,) = struct.unpack_from("<I", mm, off + 4 + size)
+        (s2,) = struct.unpack_from("<I", mm, off)
+        if s1 == s2 and zlib.crc32(payload) & 0xFFFFFFFF == crc:
+            return payload
+    return None
+
+
+def _load_frame(mm, off: int, expect: int, capacity: int) -> Optional[bytes]:
+    """Validating read of ring frame ``expect``; None when the frame
+    was overwritten, is mid-write, or fails its CRC."""
+    (s1,) = struct.unpack_from("<Q", mm, off)
+    if s1 != expect:
+        return None
+    length, crc = struct.unpack_from("<II", mm, off + 8)
+    if not 0 < length <= capacity:
+        return None
+    payload = bytes(mm[off + _FRAME_HDR:off + _FRAME_HDR + length])
+    (s2,) = struct.unpack_from("<Q", mm, off)
+    if s2 != expect or zlib.crc32(payload) & 0xFFFFFFFF != crc:
+        return None
+    return payload
+
+
+class ShmRing:
+    """One attached (or created) shared-memory ticket ring.
+
+    The coordinator calls :meth:`create` (atomic temp + rename under
+    the spool root, replacing any stale predecessor); workers and
+    observers call :meth:`attach`. Write methods are partitioned by the
+    single-writer discipline: the coordinator owns the mutable record
+    and the event frames, a worker owns exactly the slot it was bound
+    to at spawn. All write methods may raise :class:`RingError` (and
+    fire the ``ring.publish`` fault site) — callers degrade to the
+    spool. All read methods return ``None``/flags instead of raising.
+    """
+
+    def __init__(self, path: str, fd: int, mm, geom: dict,
+                 owner: bool = False):
+        self.path = path
+        self._fd = fd
+        self._mm = mm
+        self._geom = geom
+        self._owner = owner
+        self._wlock = threading.Lock()
+        self._slot_idx: Optional[int] = None
+        self._slot_state: Optional[dict] = None
+        # Coordinator-side cache of the mutable record (it is the
+        # single writer, so its cache is authoritative).
+        self._head = 0
+        self._depth = 0
+
+    # ----------------------------------------------------------- lifecycle
+
+    @classmethod
+    def create(cls, path: str, hb_slots: int = HB_SLOTS,
+               n_frames: int = N_FRAMES) -> Tuple["ShmRing", dict]:
+        """Create (or atomically replace) the ring at ``path``; returns
+        ``(ring, prior)`` where ``prior`` describes any pre-existing
+        ring file — ``{"existed": bool, "stale": bool, "prev_pid": int}``
+        — so the coordinator can report a stale ring left by a
+        SIGKILL'd predecessor being rebuilt."""
+        prior = {"existed": False, "stale": False, "prev_pid": 0}
+        old = cls.peek(path)
+        if old is not None:
+            prior["existed"] = True
+            prior["prev_pid"] = int(old.get("pid", 0))
+            prior["stale"] = not _pid_alive(prior["prev_pid"])
+        elif os.path.exists(path):
+            prior["existed"] = True  # unreadable/corrupt counts as stale
+            prior["stale"] = True
+        size = HDR_SIZE + hb_slots * SLOT_SIZE + n_frames * FRAME_SIZE
+        buf = bytearray(size)
+        struct.pack_into(
+            _FIXED_FMT, buf, 0, MAGIC, LAYOUT_VERSION, hb_slots, n_frames,
+            FRAME_SIZE, SLOT_SIZE, os.getpid(), time.time(),
+        )
+        mut = struct.pack(_MUT_FMT, 0, 0, time.time())
+        # Seqlock-frame the initial mutable record inside the image so
+        # the very first reader sees a committed (even, CRC-valid) one.
+        struct.pack_into("<I", buf, MUT_OFF, 0)
+        buf[MUT_OFF + 4:MUT_OFF + 4 + len(mut)] = mut
+        struct.pack_into(
+            "<I", buf, MUT_OFF + 4 + len(mut), zlib.crc32(mut) & 0xFFFFFFFF
+        )
+        # Frame every (unbound, pid=0) slot the same way — readers must
+        # see "empty", never "torn", for slots no worker has bound yet.
+        empty = bytes(_SLOT_PAYLOAD)
+        empty_crc = struct.pack("<I", zlib.crc32(empty) & 0xFFFFFFFF)
+        for i in range(hb_slots):
+            off = HDR_SIZE + i * SLOT_SIZE
+            buf[off + 4:off + 4 + _SLOT_PAYLOAD] = empty
+            buf[off + 4 + _SLOT_PAYLOAD:off + 8 + _SLOT_PAYLOAD] = empty_crc
+        tmp = f"{path}.{os.getpid()}.tmp"
+        try:
+            with open(tmp, "wb") as fh:
+                fh.write(buf)
+            os.replace(tmp, path)
+        except OSError as exc:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise RingError(f"ring create failed: {exc}") from exc
+        ring = cls._open(path, writable=True, owner=True)
+        return ring, prior
+
+    @classmethod
+    def attach(cls, path: str, slot: Optional[int] = None,
+               worker_id: str = "") -> "ShmRing":
+        """Attach to an existing ring; validates magic/version/geometry
+        and (when ``slot`` is given) binds this process as the single
+        writer of that worker slot."""
+        ring = cls._open(path, writable=True, owner=False)
+        if slot is not None:
+            if not 0 <= slot < ring._geom["hb_slots"]:
+                ring.close()
+                raise RingError(f"slot {slot} out of range")
+            ring._slot_idx = slot
+            ring._slot_state = {
+                "wid": worker_id, "pid": os.getpid(), "hb": time.time(),
+                "notify": 0, "claims": 0, "publishes": 0,
+            }
+            ring._store_slot()
+        return ring
+
+    @classmethod
+    def _open(cls, path: str, writable: bool, owner: bool) -> "ShmRing":
+        try:
+            fd = os.open(path, os.O_RDWR if writable else os.O_RDONLY)
+        except OSError as exc:
+            raise RingError(f"ring open failed: {exc}") from exc
+        try:
+            size = os.fstat(fd).st_size
+            if size < HDR_SIZE:
+                raise RingError(f"ring file truncated ({size} bytes)")
+            mm = mmap.mmap(
+                fd, size,
+                access=mmap.ACCESS_WRITE if writable else mmap.ACCESS_READ,
+            )
+        except (OSError, ValueError) as exc:
+            os.close(fd)
+            raise RingError(f"ring mmap failed: {exc}") from exc
+        try:
+            magic, version, hb_slots, n_frames, fsize, ssize, pid, created = (
+                struct.unpack_from(_FIXED_FMT, mm, 0)
+            )
+        except struct.error as exc:
+            mm.close()
+            os.close(fd)
+            raise RingError(f"ring header unreadable: {exc}") from exc
+        geom = {
+            "hb_slots": hb_slots, "n_frames": n_frames,
+            "frame_size": fsize, "slot_size": ssize,
+            "pid": pid, "created": created,
+        }
+        expect = HDR_SIZE + hb_slots * ssize + n_frames * fsize
+        if (magic != MAGIC or version != LAYOUT_VERSION
+                or n_frames < 1 or hb_slots < 1
+                or fsize < _FRAME_HDR + 1 or ssize < _SLOT_PAYLOAD + 8
+                or size < expect):
+            mm.close()
+            os.close(fd)
+            raise RingError(
+                f"ring header invalid (magic={magic!r} version={version} "
+                f"size={size})"
+            )
+        return cls(path, fd, mm, geom, owner=owner)
+
+    def close(self, unlink: bool = False) -> None:
+        try:
+            self._mm.close()
+        except (BufferError, ValueError):
+            pass
+        try:
+            os.close(self._fd)
+        except OSError:
+            pass
+        if unlink and self._owner:
+            try:
+                os.remove(self.path)
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------- offsets
+
+    def _slot_off(self, idx: int) -> int:
+        return HDR_SIZE + idx * self._geom["slot_size"]
+
+    def _frame_off(self, seqno: int) -> int:
+        n = self._geom["n_frames"]
+        return (HDR_SIZE + self._geom["hb_slots"] * self._geom["slot_size"]
+                + ((seqno - 1) % n) * self._geom["frame_size"])
+
+    def frame_capacity(self) -> int:
+        return self._geom["frame_size"] - _FRAME_HDR
+
+    # ------------------------------------------------- coordinator writers
+
+    def _store_mutable(self) -> None:
+        payload = struct.pack(_MUT_FMT, self._head, self._depth, time.time())
+        try:
+            _framed_store(self._mm, MUT_OFF, payload)
+        except (ValueError, struct.error, IndexError) as exc:
+            raise RingError(f"mutable store failed: {exc}") from exc
+
+    def advertise(self, kind: str, name: str = "", **extra) -> int:
+        """Publish one notification frame (``submit``/``result`` style)
+        and bump the head; workers waiting on the head wake. Returns
+        the frame's global sequence number."""
+        if _faults.PLAN is not None:
+            _faults.PLAN.fire("ring.publish")
+        payload = json.dumps(
+            {"kind": kind, "name": name, **extra},
+            separators=(",", ":"),
+        ).encode("utf-8")
+        if len(payload) > self.frame_capacity():
+            raise RingError(f"frame payload too large ({len(payload)}B)")
+        with self._wlock:
+            seqno = self._head + 1
+            try:
+                _framed_store_frame(
+                    self._mm, self._frame_off(seqno), seqno, payload
+                )
+            except (ValueError, struct.error, IndexError) as exc:
+                raise RingError(f"frame store failed: {exc}") from exc
+            self._head = seqno
+            self._store_mutable()
+        return seqno
+
+    def set_pending_depth(self, depth: int) -> None:
+        """Advertise the live released-but-unclaimed batch depth (the
+        scheduler's release window reads this instead of a listdir)."""
+        if _faults.PLAN is not None:
+            _faults.PLAN.fire("ring.publish")
+        with self._wlock:
+            self._depth = max(int(depth), 0)
+            self._store_mutable()
+
+    def touch_coordinator(self) -> None:
+        """Refresh ``coord_alive`` (called every monitor tick) — the
+        liveness stamp observers use to tell a live ring from the
+        leftovers of a SIGKILL'd coordinator."""
+        with self._wlock:
+            self._store_mutable()
+
+    # ------------------------------------------------------- worker writers
+
+    def _store_slot(self) -> None:
+        st = self._slot_state
+        payload = struct.pack(
+            _SLOT_FMT, st["wid"].encode("utf-8")[:16].ljust(16, b"\0"),
+            st["pid"], st["hb"], st["notify"], st["claims"],
+            st["publishes"],
+        )
+        try:
+            _framed_store(self._mm, self._slot_off(self._slot_idx), payload)
+        except (ValueError, struct.error, IndexError) as exc:
+            raise RingError(f"slot store failed: {exc}") from exc
+
+    def _slot_update(self, **bumps) -> None:
+        if self._slot_idx is None:
+            raise RingError("no slot bound (read-only attach)")
+        if _faults.PLAN is not None:
+            _faults.PLAN.fire("ring.publish")
+        with self._wlock:
+            st = self._slot_state
+            st["hb"] = time.time()
+            for key, delta in bumps.items():
+                st[key] += delta
+            self._store_slot()
+
+    def heartbeat(self) -> None:
+        """One framed slot store — the ring-mode replacement for the
+        lease-file ``os.utime`` touch."""
+        self._slot_update()
+
+    def note_claim(self) -> None:
+        self._slot_update(claims=1, notify=1)
+
+    def note_publish(self) -> None:
+        """Result-ready notification: the coordinator's monitor wakes
+        on the notify sum and scans ``results/``."""
+        self._slot_update(publishes=1, notify=1)
+
+    # --------------------------------------------------------------- reads
+
+    def mutable(self) -> Optional[dict]:
+        payload = _framed_load(self._mm, MUT_OFF, _MUT_SIZE)
+        if payload is None:
+            return None
+        head, depth, alive = struct.unpack(_MUT_FMT, payload)
+        return {"head": head, "pending_depth": depth, "coord_alive": alive}
+
+    def slot(self, idx: int) -> Optional[dict]:
+        payload = _framed_load(self._mm, self._slot_off(idx), _SLOT_PAYLOAD)
+        if payload is None:
+            return None
+        wid, pid, hb, notify, claims, publishes = struct.unpack(
+            _SLOT_FMT, payload
+        )
+        return {
+            "wid": wid.rstrip(b"\0").decode("utf-8", "replace"),
+            "pid": pid, "hb": hb, "notify": notify,
+            "claims": claims, "publishes": publishes,
+        }
+
+    def slots(self) -> List[dict]:
+        """Every bound (pid != 0) worker slot's latest stable record."""
+        out = []
+        for i in range(self._geom["hb_slots"]):
+            rec = self.slot(i)
+            if rec is not None and rec["pid"] != 0:
+                rec["slot"] = i
+                out.append(rec)
+        return out
+
+    def notify_sum(self) -> Optional[Tuple[int, int]]:
+        """``(sum of notify counters, torn slot count)`` across bound
+        slots — the coordinator's wake signal. None when the mutable
+        record itself is unreadable."""
+        torn = 0
+        total = 0
+        for i in range(self._geom["hb_slots"]):
+            payload = _framed_load(
+                self._mm, self._slot_off(i), _SLOT_PAYLOAD
+            )
+            if payload is None:
+                torn += 1
+                continue
+            _, pid, _, notify, _, _ = struct.unpack(_SLOT_FMT, payload)
+            if pid:
+                total += notify
+        return total, torn
+
+    def counters(self) -> dict:
+        """Summed worker-slot counters — the coordinator's per-tick
+        observation: ``{"notify", "claims", "publishes", "torn"}``.
+        Torn slots are skipped (their next stable read is a change the
+        monitor wakes on anyway)."""
+        out = {"notify": 0, "claims": 0, "publishes": 0, "torn": 0}
+        for i in range(self._geom["hb_slots"]):
+            payload = _framed_load(
+                self._mm, self._slot_off(i), _SLOT_PAYLOAD
+            )
+            if payload is None:
+                out["torn"] += 1
+                continue
+            _, pid, _, notify, claims, publishes = struct.unpack(
+                _SLOT_FMT, payload
+            )
+            if pid:
+                out["notify"] += notify
+                out["claims"] += claims
+                out["publishes"] += publishes
+        return out
+
+    def frames_since(self, last_seq: int) -> dict:
+        """Frames published after ``last_seq``: ``{"frames": [payload
+        dicts], "head": int, "overflowed": bool, "torn": bool}``.
+        ``overflowed`` means the reader fell more than a ring's worth
+        behind (missed frames — do a spool scan); ``torn`` means a
+        frame or the head failed validation (same remedy)."""
+        out = {"frames": [], "head": last_seq, "overflowed": False,
+               "torn": False}
+        mut = self.mutable()
+        if mut is None:
+            out["torn"] = True
+            return out
+        head = mut["head"]
+        out["head"] = head
+        if head < last_seq:
+            # The ring was rebuilt under us (coordinator restart).
+            out["overflowed"] = True
+            return out
+        n = self._geom["n_frames"]
+        if head - last_seq > n:
+            out["overflowed"] = True
+            last_seq = head - n
+        for s in range(last_seq + 1, head + 1):
+            payload = _load_frame(
+                self._mm, self._frame_off(s), s, self.frame_capacity()
+            )
+            if payload is None:
+                out["torn"] = True
+                continue
+            try:
+                out["frames"].append(json.loads(payload.decode("utf-8")))
+            except (ValueError, UnicodeDecodeError):
+                out["torn"] = True
+        return out
+
+    # --------------------------------------------------------------- waits
+
+    def wait_pending(self, last_head: int, last_depth: int, timeout: float,
+                     stop: Optional[threading.Event] = None,
+                     spin_s: float = 0.002) -> Tuple[str, int, int]:
+        """Worker-side wait: ``(reason, head, depth)`` with reason
+        ``"head"`` when new frames were published, ``"depth"`` when the
+        advertised released depth GREW past ``last_depth`` (growth
+        only: an unchanged stale depth must not busy-wake a worker
+        that already failed to claim), ``"stop"``/``"timeout"``
+        otherwise, ``"torn"`` when the ring stopped validating. The
+        timeout IS the bounded fallback poll: expiry sends the caller
+        to a spool scan."""
+        if _faults.PLAN is not None:
+            _faults.PLAN.fire("ring.wake")
+        deadline = time.monotonic() + timeout
+        while True:
+            mut = self.mutable()
+            if mut is None:
+                return ("torn", last_head, last_depth)
+            head, depth = mut["head"], mut["pending_depth"]
+            if head != last_head:
+                return ("head", head, depth)
+            if depth > last_depth:
+                return ("depth", head, depth)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return ("timeout", head, depth)
+            if stop is not None:
+                if stop.wait(min(spin_s, remaining)):
+                    return ("stop", head, depth)
+            else:
+                time.sleep(min(spin_s, remaining))
+
+    def wait_activity(self, last_sum: int, timeout: float,
+                      stop: Optional[threading.Event] = None,
+                      spin_s: float = 0.005) -> Tuple[str, int]:
+        """Coordinator-side wait: ``("notify", new_sum)`` when any
+        worker bumped its notify counter (claim or publish happened),
+        ``("stop", ...)`` when the in-process wake event fired,
+        ``("timeout", ...)`` at the bounded fallback expiry."""
+        if _faults.PLAN is not None:
+            _faults.PLAN.fire("ring.wake")
+        deadline = time.monotonic() + timeout
+        while True:
+            res = self.notify_sum()
+            if res is not None and res[0] != last_sum:
+                return ("notify", res[0])
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return ("timeout", last_sum)
+            if stop is not None:
+                if stop.wait(min(spin_s, remaining)):
+                    return ("stop", last_sum)
+            else:
+                time.sleep(min(spin_s, remaining))
+
+    # ------------------------------------------------------------ observers
+
+    @staticmethod
+    def peek(path: str) -> Optional[dict]:
+        """Read-only health snapshot for ``fleet_status``/``fleet_top``:
+        geometry, coordinator pid/liveness, head, advertised depth, and
+        bound worker slots. None when absent or unreadable."""
+        try:
+            ring = ShmRing._open(path, writable=False, owner=False)
+        except RingError:
+            return None
+        try:
+            mut = ring.mutable()
+            slots = ring.slots()
+            geom = ring._geom
+            out = {
+                "pid": geom["pid"],
+                "created": geom["created"],
+                "n_frames": geom["n_frames"],
+                "hb_slots": geom["hb_slots"],
+                "coordinator_alive": _pid_alive(geom["pid"]),
+                "workers_bound": len(slots),
+                "slots": slots,
+            }
+            if mut is not None:
+                out.update(mut)
+            else:
+                out["torn"] = True
+            return out
+        finally:
+            ring.close()
+
+
+def _pid_alive(pid: int) -> bool:
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    except OSError:
+        return False
+    return True

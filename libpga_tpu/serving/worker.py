@@ -46,7 +46,17 @@ wrapping the existing round-9/10 execution engines in a
   writes this process's ``MetricsRegistry`` snapshot to
   ``metrics/<wid>.json`` every ``--metrics-flush-s`` seconds (atomic
   rename), feeding the merged fleet exposition, straggler detection,
-  and ``tools/fleet_top.py``.
+  and ``tools/fleet_top.py``;
+- **ring fast path** (ISSUE 18): when the coordinator spawned this
+  worker with ``--ring-slot``, the worker attaches the spool's
+  shared-memory ticket ring (``serving/shm_ring.py``): claims try the
+  ring-advertised batch names first, idle waits are event-driven off
+  the ring head (with a bounded ``--ring-fallback-s`` pending re-scan
+  so a quiet or wedged ring can never hide work), the lease heartbeat
+  becomes one framed slot store, and each claim/publish bumps the
+  slot's notify counter to wake the coordinator. Any ring failure
+  emits ``ring_degraded`` and drops this worker back to the pure-spool
+  path above — behavior (and result bits) unchanged.
 
 Chaos hooks (environment, set per worker by the coordinator's
 ``start(worker_env=...)`` in tests and ``tools/chaos_smoke.py`` /
@@ -77,6 +87,7 @@ import numpy as np
 
 from libpga_tpu.robustness import faults as _faults
 from libpga_tpu.serving.fleet import Spool, config_from_json
+from libpga_tpu.serving.shm_ring import RING_FILENAME, RingError, ShmRing
 from libpga_tpu.utils import metrics as _metrics
 from libpga_tpu.utils import telemetry as _tl
 
@@ -108,6 +119,8 @@ class WorkerHarness:
         heartbeat_s: float = 0.5,
         poll_s: float = 0.05,
         metrics_flush_s: float = 1.0,
+        ring_slot: int = -1,
+        ring_fallback_s: float = 1.0,
     ):
         self.spool = Spool(spool_dir)
         self.wid = worker_id
@@ -138,6 +151,59 @@ class WorkerHarness:
         self.events = _tl.EventLog(
             self.spool.path("logs", f"{worker_id}.events.jsonl")
         )
+        # Shared-memory ticket ring (ISSUE 18): attach the slot the
+        # coordinator assigned at spawn. An attach failure is a
+        # degradation, not an error — this worker simply runs the
+        # pure-spool path.
+        self.ring_fallback_s = ring_fallback_s
+        self._ring: Optional[ShmRing] = None
+        self._ring_head = 0
+        self._ring_depth = 0
+        self._ring_torn = 0
+        self._ring_fallback_next = 0.0  # monotonic; 0 => scan due now
+        if ring_slot >= 0:
+            ring_path = self.spool.path(RING_FILENAME)
+            try:
+                self._ring = ShmRing.attach(
+                    ring_path, slot=ring_slot, worker_id=worker_id
+                )
+            except RingError as exc:
+                self._ring_degrade(f"attach: {exc}")
+            else:
+                self._emit(
+                    "ring_attach", role="worker", path=ring_path,
+                    stale_replaced=False,
+                )
+
+    # ----------------------------------------------------------------- ring
+
+    def _ring_degrade(self, reason: str) -> None:
+        """Drop to pure-spool coordination (one-way for this process):
+        close the mapping, emit the ``ring_degraded`` event, and let
+        every caller's fallback branch take over. Behavior from here on
+        is the pre-ring worker, bit-for-bit."""
+        ring, self._ring = self._ring, None
+        if ring is not None:
+            try:
+                ring.close()
+            except Exception:
+                pass
+        _metrics.REGISTRY.counter("fleet.ring.degraded").bump()
+        self._emit("ring_degraded", role="worker", reason=reason)
+
+    def _ring_note(self, what: str) -> None:
+        """Best-effort notify-counter bump (claim/publish) — wakes the
+        coordinator's monitor; never worker correctness."""
+        ring = self._ring
+        if ring is None:
+            return
+        try:
+            if what == "claim":
+                ring.note_claim()
+            else:
+                ring.note_publish()
+        except Exception as exc:
+            self._ring_degrade(f"{what} note: {exc}")
 
     # --------------------------------------------------------------- events
 
@@ -163,6 +229,25 @@ class WorkerHarness:
                 # scenario).
                 if _faults.PLAN is not None:
                     _faults.PLAN.fire("worker.heartbeat")
+                ring = self._ring
+                if ring is not None:
+                    # Ring mode (ISSUE 18): the heartbeat is one framed
+                    # slot store instead of a lease-file touch. A
+                    # vanished lease (coordinator requeued us) must
+                    # still be noticed before publishing, so keep the
+                    # existence check — a stat, not a write.
+                    try:
+                        ring.heartbeat()
+                    except Exception as exc:
+                        self._ring_degrade(f"heartbeat: {exc}")
+                    else:
+                        _metrics.REGISTRY.counter(
+                            "worker.heartbeats"
+                        ).bump()
+                        if not os.path.exists(lease):
+                            self._lease_lost.set()
+                            return
+                        continue
                 try:
                     os.utime(lease)
                     _metrics.REGISTRY.counter("worker.heartbeats").bump()
@@ -227,10 +312,44 @@ class WorkerHarness:
 
     # ---------------------------------------------------------------- claim
 
+    def _claim_candidates(self) -> List[str]:
+        """Batch names to attempt, in claim-priority order. Ring mode
+        reads the ring-advertised reservations (new ``submit`` frames
+        since the last look) instead of listing ``pending/``; any
+        overflow, torn frame, or the bounded ``ring_fallback_s``
+        cadence falls back to the full name-sorted spool listing — the
+        pre-ring behavior, so nothing can hide behind a quiet ring."""
+        ring = self._ring
+        if ring is None:
+            return self.spool.pending_batches()
+        now = time.monotonic()
+        try:
+            res = ring.frames_since(self._ring_head)
+        except Exception as exc:
+            self._ring_degrade(f"frames: {exc}")
+            return self.spool.pending_batches()
+        self._ring_head = res["head"]
+        if res["torn"]:
+            _metrics.REGISTRY.counter("fleet.ring.frames_torn").bump()
+        names = [
+            f["name"] for f in res["frames"]
+            if f.get("kind") == "submit" and f.get("name")
+        ]
+        if res["overflowed"] or res["torn"] or now >= self._ring_fallback_next:
+            self._ring_fallback_next = now + self.ring_fallback_s
+            _metrics.REGISTRY.counter("fleet.ring.fallback_scans").bump()
+            listing = self.spool.pending_batches()
+            known = set(listing)
+            # The spool listing is the superset and already
+            # priority-sorted; advertised names not yet visible in the
+            # listing (rename racing the readdir) still get a try.
+            return listing + [n for n in names if n not in known]
+        return names
+
     def claim(self) -> Optional[str]:
         """Claim the oldest pending batch via atomic rename; None when
         nothing is claimable."""
-        for name in self.spool.pending_batches():
+        for name in self._claim_candidates():
             src = self.spool.path("pending", name)
             dst = self.spool.path("claimed", name)
             t0 = _tl.anchored_wall()
@@ -260,6 +379,7 @@ class WorkerHarness:
                     ),
                 )
             self._start_heartbeat(name)
+            self._ring_note("claim")
             self._emit("lease_claim", worker=self.wid, batch=name)
             return name
         return None
@@ -340,6 +460,7 @@ class WorkerHarness:
                   encoding="utf-8") as fh:
             _json.dump(meta, fh)
         self.spool.publish(mtmp, meta_path)
+        self._ring_note("publish")
         _metrics.REGISTRY.counter("worker.tickets.published").bump()
 
     def _trace_base(self, name: str, batch: dict, t: dict,
@@ -397,6 +518,7 @@ class WorkerHarness:
                 fh,
             )
         self.spool.publish(mtmp, meta_path)
+        self._ring_note("publish")
 
     # -------------------------------------------------------------- execute
 
@@ -636,7 +758,7 @@ class WorkerHarness:
             while not self.drain_evt.is_set():
                 name = self.claim()
                 if name is None:
-                    if self.drain_evt.wait(self.poll_s):
+                    if self._idle_wait():
                         break
                     continue
                 self.execute(name)
@@ -649,6 +771,42 @@ class WorkerHarness:
         finally:
             self._shutdown(clean)
         return 0
+
+    def _idle_wait(self) -> bool:
+        """Block until there may be claimable work (or drain). True =
+        drain requested. Ring mode waits event-driven on the ring head
+        / advertised depth for up to ``ring_fallback_s`` (the bounded
+        fallback: a timeout forces the next claim through a full spool
+        listing, so a SIGKILL'd coordinator or wedged ring can never
+        stall this worker); spool mode is the classic ``poll_s`` nap."""
+        ring = self._ring
+        if ring is None:
+            return self.drain_evt.wait(self.poll_s)
+        try:
+            reason, head, depth = ring.wait_pending(
+                self._ring_head, self._ring_depth, self.ring_fallback_s,
+                stop=self.drain_evt,
+            )
+        except Exception as exc:
+            self._ring_degrade(f"wake: {exc}")
+            return self.drain_evt.wait(self.poll_s)
+        self._ring_depth = depth
+        if reason == "stop":
+            return True
+        if reason in ("head", "depth"):
+            self._ring_torn = 0
+            _metrics.REGISTRY.counter("fleet.ring.wakes").bump()
+        elif reason == "torn":
+            _metrics.REGISTRY.counter("fleet.ring.frames_torn").bump()
+            self._ring_torn += 1
+            self._ring_fallback_next = 0.0  # next claim: full listing
+            if self._ring_torn >= 5:
+                self._ring_degrade("mutable record repeatedly torn")
+            elif self.drain_evt.wait(self.poll_s):
+                return True
+        else:  # timeout — bounded fallback scan on the next claim
+            self._ring_fallback_next = 0.0
+        return False
 
     def _shutdown(self, clean: bool = True) -> None:
         self._stop_heartbeat()
@@ -676,6 +834,12 @@ class WorkerHarness:
             pass
         if clean:
             self._emit("worker_exit", worker=self.wid, returncode=0)
+        if self._ring is not None:
+            try:
+                self._ring.close()
+            except Exception:
+                pass
+            self._ring = None
         try:
             self.events.close()
         except Exception:
@@ -689,6 +853,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--heartbeat-s", type=float, default=0.5)
     ap.add_argument("--poll-s", type=float, default=0.05)
     ap.add_argument("--metrics-flush-s", type=float, default=1.0)
+    ap.add_argument("--ring-slot", type=int, default=-1,
+                    help="shared-memory ring slot index assigned by the "
+                         "coordinator; -1 = pure-spool coordination")
+    ap.add_argument("--ring-fallback-s", type=float, default=1.0)
     args = ap.parse_args(argv)
 
     spec = os.environ.get("PGA_FAULT_SPEC", "")
@@ -717,6 +885,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         args.spool, args.worker_id,
         heartbeat_s=args.heartbeat_s, poll_s=args.poll_s,
         metrics_flush_s=args.metrics_flush_s,
+        ring_slot=args.ring_slot, ring_fallback_s=args.ring_fallback_s,
     )
     # SIGTERM = preemption notice: finish/checkpoint the current chunk,
     # return the lease, exit 0. Installed on the main thread before any

@@ -178,6 +178,16 @@ EVENT_FIELDS: Dict[str, tuple] = {
     # with a flight dump carrying the full verdict context).
     "perf_report": ("key", "path", "roofline_gens_per_sec"),
     "perf_regression": ("metric", "current", "baseline", "threshold"),
+    # Shared-memory ticket ring (ISSUE 18, ``serving/shm_ring.py``):
+    # one ``ring_attach`` per participant that mapped the ring (role =
+    # coordinator/worker; the coordinator's also reports whether it
+    # replaced a stale predecessor's ring), one ``ring_degraded`` per
+    # participant that dropped to pure-spool coordination (torn/CRC
+    # failures, attach failure, or an injected ``ring.publish`` fault)
+    # — degradation is an event precisely because behavior stays
+    # bit-identical and would otherwise be invisible.
+    "ring_attach": ("role", "path", "stale_replaced"),
+    "ring_degraded": ("role", "reason"),
 }
 
 

@@ -1,0 +1,29 @@
+"""POSITIVE fixture: raw shared-mapping mutations outside a framed
+writer — every site here must trip ``ring-framed-write``.
+
+Never imported — linted by tests/test_analysis.py only.
+"""
+
+import mmap
+import struct
+
+
+def bump_head(fd, head):
+    # Slice-assign straight onto the mapping: a reader racing this
+    # write sees torn bytes with no seq/CRC to reject them by.
+    mm = mmap.mmap(fd, 4096)
+    mm[256:264] = struct.pack("<Q", head)
+
+
+def stamp_heartbeat(ring, now):
+    # pack_into on the ring's mapping attribute — same torn window.
+    struct.pack_into("<d", ring._mm, 4096, now)
+
+
+class SlotWriter:
+    def __init__(self, mm):
+        self._mm = mm
+
+    def write_slot(self, idx, payload):
+        # method body is not a _framed_* writer: still a violation.
+        self._mm[4096 + idx * 128:4096 + idx * 128 + len(payload)] = payload

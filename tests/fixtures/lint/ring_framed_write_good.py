@@ -1,0 +1,39 @@
+"""NEGATIVE fixture: the sanctioned shared-mapping write shapes.
+
+Never imported — linted by tests/test_analysis.py only.
+"""
+
+import mmap
+import struct
+import zlib
+
+
+def _framed_store(mm, off, payload):
+    # THE sanctioned shape: seqlock framing inside a _framed_* writer.
+    (seq,) = struct.unpack_from("<I", mm, off)
+    struct.pack_into("<I", mm, off, (seq + 1) & 0xFFFFFFFF)
+    mm[off + 4:off + 4 + len(payload)] = payload
+    struct.pack_into(
+        "<I", mm, off + 4 + len(payload), zlib.crc32(payload) & 0xFFFFFFFF
+    )
+    struct.pack_into("<I", mm, off, (seq + 2) & 0xFFFFFFFF)
+
+
+def read_head(fd):
+    # reads are never the rule's business (readers validate seq + CRC).
+    mm = mmap.mmap(fd, 4096, prot=mmap.PROT_READ)
+    return struct.unpack_from("<Q", mm, 256)[0]
+
+
+def build_image(size, pid):
+    # Staging a bytearray image for an atomic file replace is not a
+    # shared-mapping write — nobody can observe it mid-build.
+    buf = bytearray(size)
+    struct.pack_into("<Q", buf, 0, pid)
+    buf[8:16] = b"PGARING1"
+    return bytes(buf)
+
+
+def store_slot(mm, off, payload):
+    # delegating to the framed writer is the non-_framed caller shape.
+    _framed_store(mm, off, payload)

@@ -72,6 +72,8 @@ class TestLintRules:
          "wallclock_good.py"),
         ("lock-guarded-registry", "lock_registry_bad.py",
          "lock_registry_good.py"),
+        ("ring-framed-write", "ring_framed_write_bad.py",
+         "ring_framed_write_good.py"),
     ])
     def test_rule_fires_on_bad_and_is_silent_on_good(
         self, rule, bad, good

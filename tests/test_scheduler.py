@@ -40,6 +40,7 @@ from libpga_tpu.serving.scheduler import (
     FleetScheduler,
     QuotaExceeded,
     SchedEntry,
+    release_room,
 )
 from libpga_tpu.utils import metrics as _metrics
 from libpga_tpu.utils import telemetry
@@ -253,6 +254,20 @@ def test_drr_priority_lanes_strict():
     assert Spool.name_priority("p0b00001-x-128x16.json") == 9
     assert Spool.name_priority("p9b00002-x-128x16-sup.json") == 0
     assert Spool.name_priority("b00003-x-128x16.json") == 0  # legacy
+
+
+def test_release_room_window():
+    """The release-window headroom formula (ISSUE 18): lookahead per
+    live worker minus spooled-but-unclaimed, with a one-worker floor
+    (a worker-less fleet still spools work for late arrivals) and
+    negative spool counts clamped (a torn ring depth must never
+    widen the window)."""
+    assert release_room(2, 3, 0) == 6
+    assert release_room(2, 3, 4) == 2
+    assert release_room(2, 3, 7) == -1  # over-released: hold back
+    assert release_room(2, 0, 0) == 2  # worker-less floor
+    assert release_room(2, 0, 2) == 0
+    assert release_room(2, 3, -5) == 6  # bad depth estimate clamps
 
 
 def test_admission_window_not_urgent():

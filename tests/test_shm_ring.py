@@ -1,0 +1,403 @@
+"""Shared-memory ticket ring (ISSUE 18): framing, degradation, waits.
+
+The ring is an accelerator, never a source of truth — these tests pin
+the framing protocol (seqlock + CRC torn-write detection), every
+degradation edge (torn records, CRC-bad frames, overflow, stale rings
+left by a SIGKILL'd coordinator, injected write faults), and the
+fleet-level contract that a broken ring only ever costs speed, never
+results.
+"""
+
+import os
+import struct
+import subprocess
+import sys
+import threading
+import time
+import zlib
+
+import pytest
+
+from libpga_tpu.robustness import faults
+from libpga_tpu.robustness.faults import FaultPlan
+from libpga_tpu.serving.shm_ring import (
+    HB_SLOTS,
+    MUT_OFF,
+    RING_FILENAME,
+    RingError,
+    ShmRing,
+)
+
+
+def ring_path(tmp_path):
+    return str(tmp_path / RING_FILENAME)
+
+
+def dead_pid():
+    """A real pid guaranteed dead: a child that already exited."""
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait()
+    return proc.pid
+
+
+# ------------------------------------------------------------- lifecycle
+
+
+class TestLifecycle:
+    def test_create_attach_roundtrip(self, tmp_path):
+        path = ring_path(tmp_path)
+        ring, prior = ShmRing.create(path)
+        assert prior == {"existed": False, "stale": False, "prev_pid": 0}
+        ring.advertise("submit", "b0001")
+        ring.advertise("submit", "b0002")
+        ring.set_pending_depth(2)
+
+        att = ShmRing.attach(path)
+        mut = att.mutable()
+        assert mut["head"] == 2 and mut["pending_depth"] == 2
+        res = att.frames_since(0)
+        assert [f["name"] for f in res["frames"]] == ["b0001", "b0002"]
+        assert not res["overflowed"] and not res["torn"]
+        att.close()
+        ring.close(unlink=True)
+        assert not os.path.exists(path)
+
+    def test_attach_missing_and_truncated(self, tmp_path):
+        with pytest.raises(RingError):
+            ShmRing.attach(ring_path(tmp_path))
+        path = ring_path(tmp_path)
+        with open(path, "wb") as fh:
+            fh.write(b"PGARING1 but far too short")
+        with pytest.raises(RingError):
+            ShmRing.attach(path)
+
+    def test_attach_bad_magic_and_bad_slot(self, tmp_path):
+        path = ring_path(tmp_path)
+        ring, _ = ShmRing.create(path)
+        ring.close()
+        with open(path, "r+b") as fh:
+            fh.write(b"NOTARING")
+        with pytest.raises(RingError):
+            ShmRing.attach(path)
+        ring, _ = ShmRing.create(path)  # restores a valid header
+        with pytest.raises(RingError):
+            ShmRing.attach(path, slot=HB_SLOTS, worker_id="w0")
+        ring.close(unlink=True)
+
+    def test_unlink_is_owner_only(self, tmp_path):
+        path = ring_path(tmp_path)
+        ring, _ = ShmRing.create(path)
+        att = ShmRing.attach(path)
+        att.close(unlink=True)  # non-owner: must NOT remove the file
+        assert os.path.exists(path)
+        ring.close(unlink=True)
+        assert not os.path.exists(path)
+
+
+# ------------------------------------------------- stale-ring detection
+
+
+class TestStaleRing:
+    def test_live_predecessor_is_not_stale(self, tmp_path):
+        path = ring_path(tmp_path)
+        first, _ = ShmRing.create(path)  # header pid = us, alive
+        first.close()
+        second, prior = ShmRing.create(path)
+        assert prior["existed"] and not prior["stale"]
+        assert prior["prev_pid"] == os.getpid()
+        second.close(unlink=True)
+
+    def test_dead_coordinator_ring_is_stale_and_rebuilt(self, tmp_path):
+        path = ring_path(tmp_path)
+        first, _ = ShmRing.create(path)
+        first.close()
+        # Rewrite the header pid to a real-but-dead pid — exactly what
+        # a SIGKILL'd coordinator leaves behind.
+        gone = dead_pid()
+        with open(path, "r+b") as fh:
+            fh.seek(28)  # _FIXED_FMT: 8s + 5*I -> pid at offset 28
+            fh.write(struct.pack("<Q", gone))
+        peeked = ShmRing.peek(path)
+        assert peeked["pid"] == gone and not peeked["coordinator_alive"]
+        ring, prior = ShmRing.create(path)
+        assert prior == {"existed": True, "stale": True, "prev_pid": gone}
+        assert ring.mutable()["head"] == 0  # fresh image, old frames gone
+        ring.close(unlink=True)
+
+    def test_corrupt_ring_counts_as_stale(self, tmp_path):
+        path = ring_path(tmp_path)
+        with open(path, "wb") as fh:
+            fh.write(os.urandom(128))
+        ring, prior = ShmRing.create(path)
+        assert prior["existed"] and prior["stale"]
+        ring.close(unlink=True)
+
+
+# --------------------------------------------------- framing/degradation
+
+
+class TestFraming:
+    def test_torn_mutable_record_reads_none(self, tmp_path):
+        path = ring_path(tmp_path)
+        ring, _ = ShmRing.create(path)
+        assert ring.mutable() is not None
+        # Force the seqlock odd = writer died mid-store.
+        with open(path, "r+b") as fh:
+            fh.seek(MUT_OFF)
+            fh.write(struct.pack("<I", 1))
+        att = ShmRing.attach(path)
+        assert att.mutable() is None
+        res = att.frames_since(0)
+        assert res["torn"] and res["frames"] == []
+        reason, _, _ = att.wait_pending(0, 0, timeout=0.05)
+        assert reason == "torn"
+        att.close()
+        ring.close(unlink=True)
+
+    def test_crc_bad_frame_is_skipped_and_flagged(self, tmp_path):
+        path = ring_path(tmp_path)
+        ring, _ = ShmRing.create(path, hb_slots=2, n_frames=8)
+        ring.advertise("submit", "b0001")
+        ring.advertise("submit", "b0002")
+        off = ring._frame_off(1)
+        # Flip a payload byte under frame 1: stamp still matches, CRC
+        # must reject it.
+        with open(path, "r+b") as fh:
+            fh.seek(off + 16 + 4)
+            byte = fh.read(1)
+            fh.seek(off + 16 + 4)
+            fh.write(bytes([byte[0] ^ 0xFF]))
+        att = ShmRing.attach(path)
+        res = att.frames_since(0)
+        assert res["torn"]
+        assert [f["name"] for f in res["frames"]] == ["b0002"]
+        att.close()
+        ring.close(unlink=True)
+
+    def test_overflow_reports_and_clamps(self, tmp_path):
+        path = ring_path(tmp_path)
+        ring, _ = ShmRing.create(path, hb_slots=2, n_frames=4)
+        for i in range(10):
+            ring.advertise("submit", f"b{i:04d}")
+        res = ring.frames_since(0)  # 10 behind a 4-frame ring
+        assert res["overflowed"]
+        assert [f["name"] for f in res["frames"]] == [
+            "b0006", "b0007", "b0008", "b0009"
+        ]
+        fresh = ring.frames_since(res["head"])
+        assert fresh["frames"] == [] and not fresh["overflowed"]
+        ring.close(unlink=True)
+
+    def test_rebuild_under_reader_reports_overflow(self, tmp_path):
+        path = ring_path(tmp_path)
+        ring, _ = ShmRing.create(path)
+        for i in range(5):
+            ring.advertise("submit", f"b{i:04d}")
+        ring.close()
+        rebuilt, _ = ShmRing.create(path)  # head snapped back to 0
+        res = rebuilt.frames_since(5)
+        assert res["overflowed"]  # head < last_seq -> spool scan
+        rebuilt.close(unlink=True)
+
+    def test_oversized_payload_is_rejected(self, tmp_path):
+        ring, _ = ShmRing.create(ring_path(tmp_path))
+        with pytest.raises(RingError):
+            ring.advertise("submit", "x" * (ring.frame_capacity() + 1))
+        ring.close(unlink=True)
+
+
+# ------------------------------------------------------- slots/counters
+
+
+class TestSlots:
+    def test_heartbeat_and_notify_counters(self, tmp_path):
+        path = ring_path(tmp_path)
+        ring, _ = ShmRing.create(path)
+        w0 = ShmRing.attach(path, slot=0, worker_id="w0")
+        w1 = ShmRing.attach(path, slot=1, worker_id="w1")
+        before = w0.slot(0)["hb"]
+        w0.note_claim()
+        w0.note_publish()
+        w1.heartbeat()
+        assert w0.slot(0)["hb"] >= before
+        counters = ring.counters()
+        assert counters["claims"] == 1 and counters["publishes"] == 1
+        assert counters["notify"] == 2 and counters["torn"] == 0
+        total, torn = ring.notify_sum()
+        assert total == 2 and torn == 0
+        recs = {r["wid"]: r for r in ring.slots()}
+        assert set(recs) == {"w0", "w1"}
+        assert recs["w0"]["slot"] == 0 and recs["w0"]["pid"] == os.getpid()
+        w0.close()
+        w1.close()
+        ring.close(unlink=True)
+
+    def test_unbound_attach_cannot_write_slot(self, tmp_path):
+        path = ring_path(tmp_path)
+        ring, _ = ShmRing.create(path)
+        att = ShmRing.attach(path)
+        with pytest.raises(RingError):
+            att.heartbeat()
+        att.close()
+        ring.close(unlink=True)
+
+
+# ---------------------------------------------------------------- waits
+
+
+class TestWaits:
+    def test_wait_pending_wakes_on_head(self, tmp_path):
+        path = ring_path(tmp_path)
+        ring, _ = ShmRing.create(path)
+        att = ShmRing.attach(path)
+        t = threading.Timer(0.05, lambda: ring.advertise("submit", "b1"))
+        t.start()
+        t0 = time.monotonic()
+        reason, head, _ = att.wait_pending(0, 0, timeout=5.0)
+        waited = time.monotonic() - t0
+        assert reason == "head" and head == 1
+        assert waited < 2.0  # event wake, not timeout expiry
+        att.close()
+        ring.close(unlink=True)
+
+    def test_wait_pending_wakes_on_depth_growth_only(self, tmp_path):
+        path = ring_path(tmp_path)
+        ring, _ = ShmRing.create(path)
+        ring.set_pending_depth(3)
+        att = ShmRing.attach(path)
+        # Depth 3 already observed: an unchanged stale depth must NOT
+        # wake (a worker that failed to claim would hot-spin).
+        reason, _, depth = att.wait_pending(0, 3, timeout=0.05)
+        assert reason == "timeout"
+        ring.set_pending_depth(4)
+        reason, _, depth = att.wait_pending(0, 3, timeout=5.0)
+        assert reason == "depth" and depth == 4
+        att.close()
+        ring.close(unlink=True)
+
+    def test_wait_pending_stop_event(self, tmp_path):
+        ring, _ = ShmRing.create(ring_path(tmp_path))
+        stop = threading.Event()
+        threading.Timer(0.05, stop.set).start()
+        reason, _, _ = ring.wait_pending(0, 0, timeout=5.0, stop=stop)
+        assert reason == "stop"
+        ring.close(unlink=True)
+
+    def test_wait_activity_wakes_on_notify(self, tmp_path):
+        path = ring_path(tmp_path)
+        ring, _ = ShmRing.create(path)
+        w0 = ShmRing.attach(path, slot=0, worker_id="w0")
+        threading.Timer(0.05, w0.note_publish).start()
+        reason, new_sum = ring.wait_activity(0, timeout=5.0)
+        assert reason == "notify" and new_sum == 1
+        reason, _ = ring.wait_activity(1, timeout=0.05)
+        assert reason == "timeout"
+        w0.close()
+        ring.close(unlink=True)
+
+
+# ------------------------------------------------------- injected faults
+
+
+class TestInjectedFaults:
+    def test_publish_fault_raises_from_write_sites(self, tmp_path):
+        ring, _ = ShmRing.create(ring_path(tmp_path))
+        with faults.active(FaultPlan("ring.publish", probability=1.0,
+                                     times=None)):
+            with pytest.raises(faults.InjectedFault):
+                ring.advertise("submit", "b1")
+            with pytest.raises(faults.InjectedFault):
+                ring.set_pending_depth(1)
+        ring.close(unlink=True)
+
+    def test_wake_fault_raises_from_waits(self, tmp_path):
+        ring, _ = ShmRing.create(ring_path(tmp_path))
+        with faults.active(FaultPlan("ring.wake", probability=1.0,
+                                     times=None)):
+            with pytest.raises(faults.InjectedFault):
+                ring.wait_activity(0, timeout=0.01)
+            with pytest.raises(faults.InjectedFault):
+                ring.wait_pending(0, 0, timeout=0.01)
+        ring.close(unlink=True)
+
+
+# ------------------------------------------------ fleet-level degradation
+
+
+class TestFleetDegradation:
+    """The contract the whole module exists to honor: any ring failure
+    degrades to the pure-spool path with identical results."""
+
+    def _run_fleet(self, tmp_path, **fleet_kw):
+        from libpga_tpu.config import FleetConfig, PGAConfig
+        from libpga_tpu.serving.fleet import Fleet, FleetTicket
+
+        events = []
+        spool = str(tmp_path / "spool")
+        fcfg = FleetConfig(
+            n_workers=1, max_batch=1, max_wait_ms=5, poll_s=0.05,
+            lease_timeout_s=10.0, heartbeat_s=0.2, **fleet_kw
+        )
+
+        class Cap:
+            def emit(self, kind, **fields):
+                events.append((kind, fields))
+
+            def close(self):
+                pass
+
+        fleet = Fleet(spool, "onemax", PGAConfig(seed=3), fcfg, events=Cap())
+        fleet.start()
+        try:
+            h = fleet.submit(FleetTicket(size=32, genome_len=8, n=2, seed=1))
+            result = h.result(timeout=90)
+        finally:
+            fleet.close()
+        return result, events, fleet
+
+    @pytest.mark.slow
+    def test_coordinator_publish_fault_degrades_not_fails(self, tmp_path):
+        with faults.active(FaultPlan("ring.publish", at_call_n=1)):
+            result, events, fleet = self._run_fleet(tmp_path)
+        kinds = [k for k, _ in events]
+        assert "ring_degraded" in kinds
+        degraded = dict(events[kinds.index("ring_degraded")][1])
+        assert degraded["role"] == "coordinator"
+        assert result.best_score is not None  # work still completed
+
+    @pytest.mark.slow
+    def test_ring_off_config_runs_pure_spool(self, tmp_path):
+        result, events, fleet = self._run_fleet(tmp_path, ring=False)
+        kinds = [k for k, _ in events]
+        assert "ring_attach" not in kinds
+        assert result.best_score is not None
+        assert not os.path.exists(
+            os.path.join(str(tmp_path / "spool"), RING_FILENAME)
+        )
+
+    @pytest.mark.slow
+    def test_stale_ring_rebuilt_on_fleet_start(self, tmp_path):
+        spool = tmp_path / "spool"
+        spool.mkdir()
+        path = str(spool / RING_FILENAME)
+        first, _ = ShmRing.create(path)
+        first.close()
+        gone = dead_pid()
+        with open(path, "r+b") as fh:
+            fh.seek(28)
+            fh.write(struct.pack("<Q", gone))
+        result, events, fleet = self._run_fleet(tmp_path)
+        attach = [f for k, f in events if k == "ring_attach"
+                  and f.get("role") == "coordinator"]
+        assert attach and attach[0]["stale_replaced"] is True
+        assert result.best_score is not None
+
+
+def test_fleet_config_ring_validation():
+    from libpga_tpu.config import FleetConfig
+
+    assert FleetConfig().ring is True
+    with pytest.raises(ValueError):
+        FleetConfig(ring_fallback_s=0.0)
+    with pytest.raises(ValueError):
+        FleetConfig(ring_fallback_s=-1.0)

@@ -296,22 +296,27 @@ def scenario_dead_letter(tmp, ref_g, ref_best):
     )
 
 
-def scenario_fleet(tmp, ref_g, ref_best):
+def scenario_fleet(tmp, ref_g, ref_best, ring=True):
     """ISSUE 8: the single-process fault matrix lifted to a real
     cross-process fleet — SIGKILL mid-batch, SIGSTOP lease expiry, and
     a worker killed mid-checkpoint-write (injected checkpoint.save
     fault, no retries) recovering via resume-from-durable-checkpoint.
     Every recovery must land bit-identical and the coordinator log must
-    carry schema-valid worker_death / lease_requeue events."""
+    carry schema-valid worker_death / lease_requeue events.
+
+    Runs TWICE (ISSUE 18): once on the shared-memory ring fast path and
+    once pure-spool — chaos recovery must be bit-identical either way
+    (the ring is an accelerator, never part of correctness)."""
     from libpga_tpu.config import FleetConfig
     from libpga_tpu.serving.fleet import Fleet, FleetTicket
     from libpga_tpu.utils import telemetry as _tl
 
-    events_path = os.path.join(tmp, "fleet-events.jsonl")
+    mode = "ring" if ring else "spool"
+    events_path = os.path.join(tmp, f"fleet-events-{mode}.jsonl")
     log = _tl.EventLog(events_path)
     fcfg = FleetConfig(
         n_workers=2, max_batch=2, max_wait_ms=5, lease_timeout_s=2.0,
-        heartbeat_s=0.2, poll_s=0.05,
+        heartbeat_s=0.2, poll_s=0.05, ring=ring,
     )
     cfg = PGAConfig(use_pallas=False)
 
@@ -322,7 +327,7 @@ def scenario_fleet(tmp, ref_g, ref_best):
     # (With both workers racing one batch, the healthy one could claim
     # first and the chaos would silently test nothing.)
     kcfg = dataclasses.replace(fcfg, n_workers=1)
-    f = Fleet(os.path.join(tmp, "fleet-kill"), "onemax", config=cfg,
+    f = Fleet(os.path.join(tmp, f"fleet-kill-{mode}"), "onemax", config=cfg,
               fleet=kcfg, events=log)
     f.start(worker_env={0: {"PGA_WORKER_CHAOS": "sigkill@execute:1"}})
     handles = [
@@ -347,16 +352,17 @@ def scenario_fleet(tmp, ref_g, ref_best):
                 for r, g in zip(results, refs))
     )
     f.close()
-    check("fleet-sigkill", kill_ok,
-          f"worker killed -9 mid-batch, requeued, bit-identical")
+    check(f"fleet-sigkill[{mode}]", kill_ok,
+          "worker killed -9 mid-batch, requeued, bit-identical")
 
     # (b) SIGSTOP (simulated preemption pause): the lone worker claims,
     # freezes, its lease expires under a LIVE process; a late-spawned
     # survivor re-runs the batch.
-    f = Fleet(os.path.join(tmp, "fleet-stop"), "onemax", config=cfg,
+    f = Fleet(os.path.join(tmp, f"fleet-stop-{mode}"), "onemax", config=cfg,
               fleet=FleetConfig(
                   n_workers=1, max_batch=1, max_wait_ms=0,
                   lease_timeout_s=1.0, heartbeat_s=0.2, poll_s=0.05,
+                  ring=ring,
               ), events=log)
     f.start(worker_env={0: {"PGA_WORKER_CHAOS": "sigstop@execute:1"}})
     h = f.submit(FleetTicket(size=POP, genome_len=LEN, n=GENS, seed=23))
@@ -364,7 +370,7 @@ def scenario_fleet(tmp, ref_g, ref_best):
     deadline = time.monotonic() + 60
     while not os.listdir(f.spool.path("leases")):
         if time.monotonic() > deadline:
-            check("fleet-sigstop", False, "worker never claimed")
+            check(f"fleet-sigstop[{mode}]", False, "worker never claimed")
         time.sleep(0.02)
     f.start()  # the survivor
     r = h.result(timeout=300)
@@ -377,7 +383,7 @@ def scenario_fleet(tmp, ref_g, ref_best):
         if p.poll() is None:
             os.kill(p.pid, signal.SIGCONT)
     f.close()
-    check("fleet-sigstop", stop_ok,
+    check(f"fleet-sigstop[{mode}]", stop_ok,
           "lease expired under paused worker, requeued, bit-identical")
 
     # (c) worker killed MID-CHECKPOINT-WRITE: the injected
@@ -385,10 +391,11 @@ def scenario_fleet(tmp, ref_g, ref_best):
     # rename of the chunk-2 save, with max_retries=0 — the worker dies,
     # the chunk-1 checkpoint survives the torn save, and a fresh worker
     # RESUMES from it, bit-identical to the fault-free supervised run.
-    f = Fleet(os.path.join(tmp, "fleet-ckpt"), "onemax", config=cfg,
+    f = Fleet(os.path.join(tmp, f"fleet-ckpt-{mode}"), "onemax", config=cfg,
               fleet=FleetConfig(
                   n_workers=1, max_batch=1, max_wait_ms=0,
                   lease_timeout_s=5.0, heartbeat_s=0.2, poll_s=0.05,
+                  ring=ring,
               ), events=log)
     f.start(worker_env={0: {
         "PGA_FAULT_SPEC":
@@ -402,7 +409,8 @@ def scenario_fleet(tmp, ref_g, ref_best):
     deadline = time.monotonic() + 120
     while f.worker_deaths == 0:
         if time.monotonic() > deadline:
-            check("fleet-ckpt-kill", False, "worker never died mid-save")
+            check(f"fleet-ckpt-kill[{mode}]", False,
+                  "worker never died mid-save")
         time.sleep(0.02)
     meta = None
     try:
@@ -420,7 +428,7 @@ def scenario_fleet(tmp, ref_g, ref_best):
         and r.best_score == ref_best
     )
     f.close()
-    check("fleet-ckpt-kill", ckpt_ok,
+    check(f"fleet-ckpt-kill[{mode}]", ckpt_ok,
           "died mid-checkpoint-write, resumed from durable chunk, "
           "bit-identical")
 
@@ -431,7 +439,11 @@ def scenario_fleet(tmp, ref_g, ref_best):
         kinds.count("worker_death") >= 2  # (a) + (c)
         and "lease_requeue" in kinds and "worker_spawn" in kinds
     )
-    check("fleet-events", fleet_ok,
+    if ring:
+        # The fast path was actually ON: every coordinator (and each
+        # surviving worker) must have attached its ring.
+        fleet_ok = fleet_ok and "ring_attach" in kinds
+    check(f"fleet-events[{mode}]", fleet_ok,
           f"{len(records)} schema-valid records, "
           f"{kinds.count('worker_death')} worker_death, "
           f"{kinds.count('lease_requeue')} lease_requeue")
@@ -458,6 +470,9 @@ def main():
             scenario_fleet,
         ):
             scenario(tmp, ref_g, ref_best)
+        # ISSUE 18: the same fleet fault matrix, pure-spool — recovery
+        # must be bit-identical with the ring fast path off.
+        scenario_fleet(tmp, ref_g, ref_best, ring=False)
         # ISSUE 6 acceptance: a chaos run must leave a flight-recorder
         # dump (the dead-letter scenario triggers one) whose every
         # record validates against the versioned event schema, with the

@@ -514,4 +514,7 @@ JAX_PLATFORMS=cpu python tools/perf_gate.py --selftest
 JAX_PLATFORMS=cpu python tools/perf_gate.py
 JAX_PLATFORMS=cpu python tools/perf_report.py --backfill --db "$(mktemp -d)/scratch_history.json"
 
+echo "== ci: ring smoke =="
+JAX_PLATFORMS=cpu python tools/ring_smoke.py
+
 echo "== ci: all stages passed =="

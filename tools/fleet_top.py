@@ -60,6 +60,27 @@ def _worker_state(w: dict, stale_after_s: float) -> str:
     return "up"
 
 
+def _ring_line(ring) -> str:
+    """Ring fast-path health (ISSUE 18), from the spool+ring alone: a
+    dead-coordinator ring reads ``STALE`` (the next coordinator will
+    rebuild it), a torn one ``TORN`` (readers are on spool fallback)."""
+    if not ring or not ring.get("present"):
+        return "ring: absent (pure-spool coordination)"
+    if ring.get("torn"):
+        state = "TORN"
+    elif not ring.get("coordinator_alive"):
+        state = "STALE (coordinator dead)"
+    else:
+        state = "live"
+    head = ring.get("head", "-")
+    depth = ring.get("pending_depth", "-")
+    return (
+        f"ring: {state}  coordinator pid={ring.get('pid', '?')}"
+        f"  head={head}  advertised_depth={depth}"
+        f"  workers_bound={ring.get('workers_bound', 0)}"
+    )
+
+
 def render(status: dict, stale_after_s: float = 10.0) -> str:
     """One screenful of fleet state from a ``fleet_status`` dict —
     pure string building, no I/O (testable against synthetic spools)."""
@@ -81,6 +102,7 @@ def render(status: dict, stale_after_s: float = 10.0) -> str:
             f"  straggler_alerts={c['straggler_alerts']}"
             f"  dead_letters={c['dead_letters']}"
         ),
+        _ring_line(status.get("ring")),
     ]
     lines.append(
         f"{'worker':<8}{'pid':>8}  {'state':<10}{'flush':>7}"

@@ -19,6 +19,15 @@ whose Prometheus rendering is then linted via
 ``tools/metrics_dump.py --check`` — the scrape-ability half of the
 observatory contract.
 
+ISSUE 18 adds a second arm: the FLEET ROUND-TRIP gate — a ring-enabled
+2-worker fleet serving {FLEET_GATE_REQS} small tickets per round, the
+end-to-end coordination rate (submit -> shared-memory wake -> worker
+mega-run -> publish -> readback) in runs/sec. Same detector, same
+cross-process floor, same ``PERF_HISTORY.json`` DB under
+``arm="fleet_gate"`` — a coordination-path regression (e.g. the ring
+silently degrading to polling) now trips ci even when the compute
+kernels are unchanged.
+
 ``--selftest`` proves the trip wire end to end in a temp dir: measure a
 clean baseline, re-measure with an injected work-proportional slowdown
 (``FaultPlan(site="bench.measure", kind="slow")`` — per-generation
@@ -46,6 +55,14 @@ GATE_METRIC = "gate_gens_per_sec"
 GATE_ROUNDS = 4
 LO, HI = 20, 60  # two-length subtraction lengths (small: this is a gate)
 
+# Fleet round-trip arm (ISSUE 18): small tickets — the figure is the
+# COORDINATION rate, so the compute per ticket is kept near-trivial.
+FLEET_GATE_METRIC = "fleet_gate_runs_per_sec"
+FLEET_GATE_POP, FLEET_GATE_LEN, FLEET_GATE_GENS = 256, 32, 5
+FLEET_GATE_WORKERS = 2
+FLEET_GATE_REQS = 4
+FLEET_GATE_ROUNDS = 3
+
 
 def _runner():
     """The fixed gate workload: OneMax 2048x64 on the XLA path (the
@@ -66,7 +83,7 @@ def _measure(run, rounds: int = GATE_ROUNDS):
     return [bench._sample_gps(run, LO, HI) for _ in range(rounds)]
 
 
-def _gate_key():
+def _gate_key(arm: str = "gate", shape: str = None):
     import jax
 
     from libpga_tpu.perf import PerfKey
@@ -77,8 +94,56 @@ def _gate_key():
         device = "unknown"
     return PerfKey(
         backend=jax.default_backend(), device_kind=str(device),
-        shape=f"{GATE_POP}x{GATE_LEN}", arm="gate",
+        shape=shape or f"{GATE_POP}x{GATE_LEN}", arm=arm,
     )
+
+
+def _fleet_measure(rounds: int = FLEET_GATE_ROUNDS):
+    """Runs/sec of whole fleet round trips through a ring-enabled
+    2-worker fleet: one warm pass (worker compiles, excluded), then
+    ``rounds`` timed serves of FLEET_GATE_REQS tickets each."""
+    import shutil
+    import time
+
+    from libpga_tpu import PGAConfig
+    from libpga_tpu.config import FleetConfig
+    from libpga_tpu.serving.fleet import Fleet, FleetTicket
+
+    root = tempfile.mkdtemp(prefix="pga-perf-gate-fleet-")
+    fleet = Fleet(
+        os.path.join(root, "gate"), "onemax",
+        config=PGAConfig(use_pallas=False),
+        fleet=FleetConfig(
+            n_workers=FLEET_GATE_WORKERS, max_batch=2, max_wait_ms=5,
+            lease_timeout_s=30.0, heartbeat_s=0.5, poll_s=0.05,
+            ring=True,
+        ),
+    )
+    fleet.start()
+
+    def serve(base):
+        handles = [
+            fleet.submit(FleetTicket(
+                size=FLEET_GATE_POP, genome_len=FLEET_GATE_LEN,
+                n=FLEET_GATE_GENS, seed=base + i,
+            ))
+            for i in range(FLEET_GATE_REQS)
+        ]
+        fleet.flush()
+        for h in handles:
+            h.result(timeout=600)
+
+    samples = []
+    try:
+        serve(10_000)  # warm: each worker compiles its mega-run once
+        for rnd in range(rounds):
+            t0 = time.perf_counter()
+            serve(20_000 + 1_000 * rnd)
+            samples.append(FLEET_GATE_REQS / (time.perf_counter() - t0))
+    finally:
+        fleet.close()
+        shutil.rmtree(root, ignore_errors=True)
+    return samples
 
 
 def _trip(verdict, events_path: str) -> None:
@@ -133,41 +198,53 @@ def run_gate(db_path: str, record: bool) -> int:
     from libpga_tpu.perf.history import PerfSample, git_rev, new_run_id
 
     _, _, run = _runner()
-    samples = _measure(run)
-    current = statistics.median(samples)
-    key = _gate_key()
-    print(f"perf_gate: {key.as_string()} {GATE_METRIC} "
-          f"median={current:.2f} rounds={[round(s, 1) for s in samples]}")
+    arms = [
+        (_gate_key(), GATE_METRIC, _measure(run), "gate"),
+        (
+            _gate_key("fleet_gate", f"{FLEET_GATE_POP}x{FLEET_GATE_LEN}"),
+            FLEET_GATE_METRIC, _fleet_measure(), "fleet_gate ring=on",
+        ),
+    ]
 
     hist = (PerfHistory.load(db_path) if os.path.exists(db_path)
             else PerfHistory())
-    baseline = [s.value for s in hist.series(key, GATE_METRIC)]
-    verdict = detect(baseline, current, metric=GATE_METRIC,
-                     drift_floor=CROSS_PROCESS_FLOOR)
-
+    rev = git_rev()
+    verdicts = []
+    recorded = 0
+    for key, metric, samples, note in arms:
+        current = statistics.median(samples)
+        print(f"perf_gate: {key.as_string()} {metric} "
+              f"median={current:.2f} "
+              f"rounds={[round(s, 1) for s in samples]}")
+        baseline = [s.value for s in hist.series(key, metric)]
+        verdicts.append(detect(baseline, current, metric=metric,
+                               drift_floor=CROSS_PROCESS_FLOOR))
+        if record:
+            # One run_id per SAMPLE: identity is (key, metric, round,
+            # run_id, source), so same-run samples need distinct ids.
+            for s in samples:
+                hist.add(PerfSample(
+                    key=key, metric=metric, value=s,
+                    run_id=new_run_id(), git_rev=rev,
+                    source="perf_gate", note=note,
+                ))
+            recorded += len(samples)
     if record:
-        # One run_id per SAMPLE: identity is (key, metric, round,
-        # run_id, source), so same-run samples need distinct ids.
-        rev = git_rev()
-        for s in samples:
-            hist.add(PerfSample(
-                key=key, metric=GATE_METRIC, value=s,
-                run_id=new_run_id(), git_rev=rev, source="perf_gate",
-                note="gate",
-            ))
         hist.save(db_path)
-        print(f"perf_gate: recorded {len(samples)} samples -> {db_path}")
+        print(f"perf_gate: recorded {recorded} samples -> {db_path}")
 
     rc = 0
     with tempfile.TemporaryDirectory() as td:
-        if verdict.regressed:
-            _trip(verdict, os.path.join(td, "events.jsonl"))
-            rc = 1
-        else:
-            bar = ("none" if verdict.threshold is None
-                   else f"{verdict.threshold:.3f}")
-            print(f"perf_gate: pass ({verdict.reason}; "
-                  f"baseline n={verdict.n_baseline}, threshold={bar})")
+        for verdict in verdicts:
+            if verdict.regressed:
+                _trip(verdict, os.path.join(td, "events.jsonl"))
+                rc = 1
+            else:
+                bar = ("none" if verdict.threshold is None
+                       else f"{verdict.threshold:.3f}")
+                print(f"perf_gate: pass {verdict.metric} "
+                      f"({verdict.reason}; "
+                      f"baseline n={verdict.n_baseline}, threshold={bar})")
         lint_rc = _lint_perf_metrics(td)
     return rc or lint_rc
 

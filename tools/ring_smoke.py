@@ -1,0 +1,226 @@
+#!/usr/bin/env python
+"""Ring smoke: the ISSUE 18 fast-path acceptance matrix, CI stage 18.
+
+Four gates against a REAL multi-process fleet on the CPU backend:
+
+1. **ring on/off bit-identity** — the same ticket spread served by a
+   4-worker fleet with the shared-memory ticket ring enabled and again
+   with it disabled (pure-spool polling) produces bit-identical
+   genomes; the ring run's spool carries ``ring_attach`` events and
+   live ring wake/heartbeat counters, and the pure-spool run never
+   creates a ring file.
+2. **degradation** — a coordinator whose very first ring write faults
+   (injected ``ring.publish``) emits a schema-valid ``ring_degraded``
+   event and still serves every ticket bit-identically via the spool.
+3. **ring metrics lint** — the ``fleet.ring.*`` counters populated by
+   gate 1 export through ``tools/metrics_dump.py --check`` (Prometheus
+   line-format lint).
+4. **fleet_top ring health** — the console renders the ring line from
+   the spool+ring alone: ``live`` against the running fleet's spool,
+   ``absent`` for the pure-spool one.
+
+Exit 0 with one line per gate; nonzero on the first failure.
+"""
+
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from libpga_tpu import PGA, PGAConfig  # noqa: E402
+from libpga_tpu.config import FleetConfig  # noqa: E402
+from libpga_tpu.robustness import faults  # noqa: E402
+from libpga_tpu.serving.fleet import Fleet, FleetTicket, fleet_status  # noqa: E402
+from libpga_tpu.serving.shm_ring import RING_FILENAME, ShmRing  # noqa: E402
+from libpga_tpu.utils import metrics as _metrics  # noqa: E402
+from libpga_tpu.utils import telemetry as _tl  # noqa: E402
+
+POP, LEN, GENS = 256, 32, 5
+WORKERS = 4
+CFG = PGAConfig(use_pallas=False)
+TOOLS = os.path.dirname(os.path.abspath(__file__))
+SEEDS = list(range(300, 300 + 2 * WORKERS))
+
+
+def check(name, ok, detail=""):
+    status = "ok" if ok else "FAIL"
+    print(f"ring {name}: {status}{' — ' + detail if detail else ''}")
+    if not ok:
+        sys.exit(f"ring smoke failed at {name}")
+
+
+def serve(tmp, sub, ring, events=None, n_workers=WORKERS):
+    """One fleet pass over the standard ticket spread; returns
+    ``(genome arrays by seed, fleet, spool dir)`` with the fleet still
+    open so callers can inspect live state before closing it."""
+    spool = os.path.join(tmp, sub)
+    fleet = Fleet(
+        spool, "onemax", config=CFG,
+        fleet=FleetConfig(
+            n_workers=n_workers, max_batch=2, max_wait_ms=5,
+            lease_timeout_s=6.0, heartbeat_s=0.3, poll_s=0.05, ring=ring,
+        ),
+        events=events,
+    )
+    fleet.start()
+    handles = [
+        fleet.submit(FleetTicket(size=POP, genome_len=LEN, n=GENS, seed=s))
+        for s in SEEDS
+    ]
+    results = {
+        s: np.asarray(h.result(timeout=600).genomes)
+        for s, h in zip(SEEDS, handles)
+    }
+    return results, fleet, spool
+
+
+def stage_bit_identity(tmp):
+    ring_res, ring_fleet, ring_spool = serve(
+        tmp, "ring-on", ring=True,
+        events=_tl.EventLog(os.path.join(tmp, "ring-on-events.jsonl")),
+    )
+    # Inspect the live ring before close() unlinks it.
+    live = fleet_status(ring_spool)
+    ring_live = live["ring"]
+    st = ring_fleet.status()["coordinator"]
+    ring_fleet.close()
+    ring_fleet.events.close()
+
+    spool_res, spool_fleet, spool_spool = serve(tmp, "ring-off", ring=False)
+    spool_fleet.close()
+
+    mismatches = [
+        s for s in SEEDS if not np.array_equal(ring_res[s], spool_res[s])
+    ]
+    records = _tl.validate_log(os.path.join(tmp, "ring-on-events.jsonl"))
+    kinds = [r["event"] for r in records]
+    ok = (
+        not mismatches
+        and st["ring_attached"]
+        and ring_live.get("present") and ring_live.get("coordinator_alive")
+        and ring_live.get("workers_bound", 0) >= 1
+        and "ring_attach" in kinds and "ring_degraded" not in kinds
+        and not os.path.exists(os.path.join(spool_spool, RING_FILENAME))
+    )
+    check(
+        "on-off-bit-identity", ok,
+        f"{len(SEEDS)} tickets x {WORKERS} workers, ring head="
+        f"{ring_live.get('head')}, {ring_live.get('workers_bound')} "
+        "slots bound, results bit-identical to pure-spool",
+    )
+    return ring_spool
+
+
+def stage_degradation(tmp):
+    events = _tl.EventLog(os.path.join(tmp, "degrade-events.jsonl"))
+    # times=None: every coordinator ring write faults, so the very
+    # first advertise (or depth store) forces pure-spool degradation.
+    with faults.active(
+        faults.FaultPlan("ring.publish", probability=1.0, times=None)
+    ):
+        results, fleet, _ = serve(
+            tmp, "degrade", ring=True, events=events, n_workers=2
+        )
+        degraded = not fleet.status()["coordinator"]["ring_attached"]
+        fleet.close()
+    events.close()
+    refs = {}
+    for s in SEEDS:
+        pga = PGA(seed=s, config=CFG)
+        pga.create_population(POP, LEN)
+        pga.set_objective("onemax")
+        pga.run(GENS)
+        refs[s] = np.array(pga._populations[0].genomes, copy=True)
+    mismatches = [
+        s for s in SEEDS if not np.array_equal(results[s], refs[s])
+    ]
+    records = _tl.validate_log(os.path.join(tmp, "degrade-events.jsonl"))
+    degrade_recs = [r for r in records if r["event"] == "ring_degraded"]
+    ok = (
+        degraded and not mismatches and degrade_recs
+        and degrade_recs[0]["role"] == "coordinator"
+    )
+    check(
+        "degradation", ok,
+        "coordinator ring writes faulted, degraded to pure-spool, "
+        f"{len(SEEDS)} tickets bit-identical to single-process refs",
+    )
+
+
+def stage_metrics_lint(tmp):
+    snap = _metrics.REGISTRY.snapshot()
+    names = {c["name"] for c in snap.get("counters", ())}
+    wanted = {"fleet.ring.wakes", "fleet.ring.fallback_scans",
+              "fleet.ring.degraded"}
+    missing = wanted - names
+    if missing:
+        check("metrics-lint", False, f"missing ring series {missing}")
+    prom = os.path.join(tmp, "ring.prom")
+    with open(prom, "w", encoding="utf-8") as fh:
+        fh.write(_metrics.prometheus_text(snap))
+    text = open(prom).read()
+    if "pga_fleet_ring_wakes" not in text:
+        check("metrics-lint", False, "ring counters absent from exposition")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "metrics_dump.py"),
+         "--check", prom],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    if proc.returncode != 0:
+        check("metrics-lint", False,
+              f"{proc.stdout.strip()} {proc.stderr.strip()}")
+    check("metrics-lint", True,
+          "fleet.ring.* counters present, prometheus lint clean")
+
+
+def stage_fleet_top(tmp, ring_spool):
+    from tools.fleet_top import render
+
+    # Post-mortem of the ring-on spool: the coordinator closed cleanly,
+    # unlinking its ring — the console must render "absent" (pure-spool
+    # coordination), never crash.
+    post = render(fleet_status(ring_spool))
+    if "ring: absent" not in post:
+        check("fleet-top", False, f"post-mortem ring line wrong:\n{post}")
+    # Live fleet: the ring line must read from the spool+ring alone.
+    fleet = Fleet(
+        os.path.join(tmp, "top"), "onemax", config=CFG,
+        fleet=FleetConfig(
+            n_workers=1, max_batch=1, max_wait_ms=5,
+            lease_timeout_s=6.0, heartbeat_s=0.3, poll_s=0.05,
+        ),
+    )
+    fleet.start()
+    h = fleet.submit(FleetTicket(size=POP, genome_len=LEN, n=2, seed=1))
+    h.result(timeout=600)
+    live = render(fleet_status(os.path.join(tmp, "top")))
+    fleet.close()
+    ok = "ring: live" in live and "workers_bound=" in live
+    check("fleet-top", ok,
+          "ring health rendered from spool+ring alone (live + absent)")
+
+
+def main():
+    with tempfile.TemporaryDirectory(prefix="pga-ring-smoke-") as tmp:
+        ring_spool = stage_bit_identity(tmp)
+        stage_degradation(tmp)
+        stage_metrics_lint(tmp)
+        stage_fleet_top(tmp, ring_spool)
+    print(
+        f"ring smoke: {WORKERS}-process fleet — ring on/off bit-identical, "
+        "degradation clean, metrics + console gates pass"
+    )
+
+
+if __name__ == "__main__":
+    main()
