@@ -1356,49 +1356,60 @@ def autotuned_arm(rounds: int = ROUNDS) -> dict:
     }
 
 
-# GP arm (ISSUE 11): a symbolic-regression workload over postfix tree
-# genomes — GP_POP programs of up to GP_NODES tokens scored against a
-# GP_SAMPLES-point dataset every generation by the fused stack-machine
-# interpreter (gp/interpreter.py on CPU; the Pallas VMEM-stack kernel
-# on chips). Interleaved against (a) an identical GP engine with a
-# trivial vector objective, isolating the EVALUATOR's share of a
-# generation, and (b) a same-shape vector-genome OneMax engine, the
-# cross-representation baseline.
+# GP arm (ISSUE 11, rebuilt for ISSUE 19): a symbolic-regression
+# workload over postfix tree genomes — GP_POP programs of up to
+# GP_NODES tokens scored against a GP_SAMPLES-point dataset every
+# generation by the fused stack-machine interpreter (gp/interpreter.py
+# on CPU; the Pallas VMEM-stack kernel on chips). Runs through
+# ``interleaved_medians`` in repeat-until-confidence mode
+# (min_rel_ci=GP_MIN_REL_CI) with a permanent optimizer A/B: the
+# optimizer-ON engine (eval-time fold/DCE/compact + live-length trip
+# reduction, the default) against an identical optimizer-OFF twin —
+# plus (a) an identical GP engine with a trivial vector objective,
+# isolating the EVALUATOR's share of a generation, and (b) a
+# same-shape vector-genome OneMax engine, the cross-representation
+# baseline.
 GP_POP = 1024
 GP_NODES = 16
 GP_SAMPLES = 64
+GP_MIN_REL_CI = 0.10
 
 
 def gp_arm(rounds: int = ROUNDS) -> dict:
     """``--gp``: the tree-GP symbolic-regression arm."""
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     from libpga_tpu import PGA, PGAConfig
     from libpga_tpu.gp import encoding as _genc
     from libpga_tpu.gp import operators as _gpo
+    from libpga_tpu.gp.optimize import mean_live_length
     from libpga_tpu.gp.sr import make_dataset, symbolic_regression
+    from libpga_tpu.utils.profiling import interleaved_medians
 
     gp = _genc.GPConfig(max_nodes=GP_NODES, n_vars=2)
+    gp_off = _genc.GPConfig(max_nodes=GP_NODES, n_vars=2, optimize=False)
     X, y = make_dataset(
         lambda a, b: a * b + a, n_samples=GP_SAMPLES, n_vars=2, seed=0
     )
 
-    def gp_engine(objective):
+    def gp_engine(objective, g=gp):
         pga = PGA(seed=0, config=PGAConfig(
             use_pallas=False, selection="truncation", elitism=2,
         ))
         pga.set_objective(objective)
-        pga.set_crossover(_gpo.make_subtree_crossover(gp))
-        pga.set_mutate(_gpo.make_gp_mutate(gp))
-        pga.install_population(
-            _genc.random_population(jax.random.key(0), GP_POP, gp)
+        pga.set_crossover(_gpo.make_subtree_crossover(g))
+        pga.set_mutate(_gpo.make_gp_mutate(g))
+        handle = pga.install_population(
+            _genc.random_population(jax.random.key(0), GP_POP, g)
         )
 
         def run(n):
             pga.run(n)
 
         run.pga = pga
+        run.handle = handle
         return run
 
     def vector_engine():
@@ -1414,51 +1425,67 @@ def gp_arm(rounds: int = ROUNDS) -> dict:
         run.pga = pga
         return run
 
-    runners = [
-        ("gp_sr", gp_engine(symbolic_regression(X, y, gp=gp))),
+    runners = {
+        "gp_sr": gp_engine(symbolic_regression(X, y, gp=gp)),
+        # The permanent optimizer A/B twin: identical seed, breeding,
+        # and dataset — only GPConfig.optimize differs, so the
+        # adjacent-sample ratio IS the fast path's whole-generation win.
+        "gp_sr_noopt": gp_engine(
+            symbolic_regression(X, y, gp=gp_off), gp_off
+        ),
         # Same breeding, trivial objective: the adjacent pair isolates
         # the stack-machine evaluator's share of a generation.
-        ("gp_cheap", gp_engine(lambda g: jnp.sum(g))),
-        ("vector", vector_engine()),
-    ]
-    for _, r in runners:
+        "gp_cheap": gp_engine(lambda g: jnp.sum(g)),
+        "vector": vector_engine(),
+    }
+    for r in runners.values():
         r(3)  # compile + warm outside the timed samples
-    samples = {name: [] for name, _ in runners}
-    ratios, overheads = [], []
-    for _ in range(rounds):
-        for name, r in runners:
-            samples[name].append(_sample_gps(r, 5, 15))
-        ratios.append(samples["gp_sr"][-1] / samples["vector"][-1])
-        overheads.append(
-            (1.0 / samples["gp_sr"][-1] - 1.0 / samples["gp_cheap"][-1])
-            / (1.0 / samples["gp_sr"][-1]) * 100.0
-        )
-    sr_med = _median_iqr(samples["gp_sr"])
-    cheap_med = _median_iqr(samples["gp_cheap"])
-    vec_med = _median_iqr(samples["vector"])
-    ratio_med, ratio_iqr = _median_iqr(ratios)
-    ov_med, ov_iqr = _median_iqr(overheads)
+    med = interleaved_medians(
+        runners, rounds=rounds,
+        sample=lambda r: _sample_gps(r, 5, 15),
+        min_rel_ci=GP_MIN_REL_CI,
+    )
+    sr = runners["gp_sr"]
+    live = float(mean_live_length(
+        np.asarray(sr.pga.population(sr.handle).genomes), gp
+    ))
+    speedup = med["gp_sr"] / med["gp_sr_noopt"]
+    overhead = (
+        (1.0 / med["gp_sr"] - 1.0 / med["gp_cheap"])
+        / (1.0 / med["gp_sr"]) * 100.0
+    )
     return {
-        "gp_gens_per_sec": round(sr_med[0], 2),
-        "gp_gens_per_sec_median": round(sr_med[0], 2),
-        "gp_gens_per_sec_iqr": round(sr_med[1], 2),
-        "gp_cheap_obj_gens_per_sec_median": round(cheap_med[0], 2),
-        "gp_vector_gens_per_sec_median": round(vec_med[0], 2),
-        "gp_vs_vector_ratio_median": round(ratio_med, 4),
-        "gp_vs_vector_ratio_iqr": round(ratio_iqr, 4),
-        "gp_eval_overhead_pct_median": round(ov_med, 2),
-        "gp_eval_overhead_pct_iqr": round(ov_iqr, 2),
+        "gp_gens_per_sec": round(med["gp_sr"], 2),
+        "gp_gens_per_sec_median": round(med["gp_sr"], 2),
+        "gp_noopt_gens_per_sec_median": round(med["gp_sr_noopt"], 2),
+        "gp_opt_speedup_median": round(speedup, 3),
+        "gp_live_length_mean": round(live, 2),
+        "gp_cheap_obj_gens_per_sec_median": round(med["gp_cheap"], 2),
+        "gp_vector_gens_per_sec_median": round(med["vector"], 2),
+        "gp_vs_vector_ratio_median": round(
+            med["gp_sr"] / med["vector"], 4
+        ),
+        "gp_eval_overhead_pct_median": round(overhead, 2),
+        "gp_rel_ci": {k: round(v, 4) for k, v in med.rel_ci.items()},
+        "gp_rounds": med.rounds,
+        "gp_min_rel_ci": GP_MIN_REL_CI,
+        "gp_dropped": dict(med.dropped),
         "gp_shape": f"{GP_POP}x{GP_NODES}nodes",
         "gp_samples": GP_SAMPLES,
         "gp_note": (
             f"symbolic regression over {GP_POP} postfix programs of up "
             f"to {GP_NODES} tokens, {GP_SAMPLES}-sample -RMSE fitness; "
-            "per-round ratios from ADJACENT interleaved samples. "
-            "gp_eval_overhead_pct = the stack-machine evaluator's share "
-            "of a generation (gp_sr vs identical breeding with a "
-            "trivial objective); gp_vs_vector = same-shape OneMax "
-            "vector-genome engine. CPU backend: the XLA interpreter "
-            "path — the fused VMEM-stack kernel's figure needs a chip."
+            "interleaved_medians repeat-until-confidence "
+            "(gp_min_rel_ci). gp_opt_speedup = optimizer-ON "
+            "(fold/DCE/compact + live-length trips, the default) over "
+            "an identical optimizer-OFF twin; gp_live_length_mean = "
+            "mean live tokens after compaction on the evolved ON "
+            "population (of gp_shape's max). gp_eval_overhead_pct = "
+            "the stack-machine evaluator's share of a generation "
+            "(gp_sr vs identical breeding with a trivial objective); "
+            "gp_vs_vector = same-shape OneMax vector-genome engine. "
+            "CPU backend: the XLA interpreter path — the fused "
+            "VMEM-stack kernel's figure needs a chip."
         ),
     }
 

@@ -404,6 +404,8 @@ class PGA:
             max_nodes=gpc.max_nodes,
             n_ops=gpc.n_ops,
             n_vars=gpc.n_vars,
+            optimize=bool(gpc.optimize),
+            dispatch=gpc.dispatch or "dense",
         )
 
     def _check_stall_alert(self, hist: Optional[_tl.History]) -> None:
@@ -460,14 +462,25 @@ class PGA:
         if gpc is not None:
             # The SR objective stamps the evaluator knobs it was built
             # at (gp/sr.py: user > tuning DB > auto, resolved at build).
-            gp_sd, gp_ob = getattr(obj, "knob_args", (None, None))
+            ka = tuple(getattr(obj, "knob_args", ()) or ())
+            gp_sd, gp_ob, gp_disp = (ka + (None, None, None))[:3]
+            live = None
+            if gpc.optimize:
+                # Measured mean post-compaction live length of THIS
+                # population — what the fast path's trips actually are.
+                from libpga_tpu.gp.optimize import mean_live_length
+
+                live = mean_live_length(pop.genomes, gpc)
             report = _perf.gp_report(
                 size, gpc,
                 int(getattr(obj, "sr_samples", 0)) or 64,
                 stack_depth=gp_sd, opcode_block=gp_ob,
+                dispatch=gp_disp, live_length=live,
                 device_kind=device_kind,
             )
             report["dispatch_path"] = report["path"]
+            if live is not None:
+                report["live_length_mean"] = live
         else:
             deme, layout, subblock, _ = self._resolved_pallas_knobs(
                 size, genome_len

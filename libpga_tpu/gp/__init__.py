@@ -9,7 +9,10 @@ fused stack machine — an XLA interpreter everywhere
 subtree/point mutation on the existing operator protocol
 (``gp/operators.py``). The symbolic-regression objective family
 (``gp/sr.py``) closes the loop: dataset-resident ``-RMSE`` fitness
-with tuning-DB-resolved evaluator knobs.
+with tuning-DB-resolved evaluator knobs. ``gp/optimize.py`` is the
+eval-time fast path: fold + DCE + compact genomes into a transient
+:class:`~libpga_tpu.gp.optimize.EvalProgram` so evaluation pays for
+live tokens only — stored genomes are never touched.
 
 Submodules load lazily (PEP 562): importing :mod:`libpga_tpu` must not
 pay for GP, and a vector-genome engine's traced programs are
@@ -23,7 +26,9 @@ from __future__ import annotations
 
 import importlib
 
-_SUBMODULES = ("encoding", "interpreter", "operators", "reference", "sr")
+_SUBMODULES = (
+    "encoding", "interpreter", "operators", "optimize", "reference", "sr",
+)
 
 _LAZY_NAMES = {
     # encoding
@@ -34,6 +39,12 @@ _LAZY_NAMES = {
     "random_population": "encoding",
     "program_structure": "encoding",
     "canonicalize": "encoding",
+    "DISPATCH_KINDS": "encoding",
+    # optimize
+    "EvalProgram": "optimize",
+    "optimize_for_eval": "optimize",
+    "live_lengths": "optimize",
+    "compaction_stats": "optimize",
     # operators
     "make_subtree_crossover": "operators",
     "make_subtree_mutate": "operators",

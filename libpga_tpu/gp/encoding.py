@@ -72,6 +72,10 @@ LOG_EPS = 1e-9
 
 PAD_OP = 0  #: opcode index 0 is always the pad token
 
+#: Token-step dispatch strategies (the ``gp_dispatch`` tuning axis).
+#: ``None`` = auto (dense — the original every-op-every-token lattice).
+DISPATCH_KINDS: Tuple = (None, "dense", "blocked")
+
 
 @dataclasses.dataclass(frozen=True)
 class GPConfig:
@@ -102,6 +106,18 @@ class GPConfig:
       opcode_block: tokens interpreted per fused-loop iteration
         (unroll factor), or None = auto (1). Must divide
         ``max_nodes``; the ``gp_opcode_block`` tuning axis.
+      optimize: run the eval-time program optimizer (``gp/optimize.py``
+        — canonicalize → constant-fold → DCE → compact) before every
+        evaluation. On by default; ``optimize=False`` is the escape
+        hatch that lowers the PRE-OPTIMIZER traced program
+        byte-identically (``tools/gp_smoke.py`` gates it via
+        ``analysis.fingerprint``). Stored genomes are never touched
+        either way — the optimizer rewrites only the transient eval
+        buffer.
+      dispatch: token-step dispatch strategy — ``None`` = auto
+        (``"dense"``, the original every-op-every-token mask lattice)
+        or ``"blocked"`` (arity-class-grouped candidate planes with
+        shared-operand fusions); the ``gp_dispatch`` tuning axis.
 
     The gene dtype for GP populations is float32: bfloat16's ~0.004
     resolution near 1.0 corrupts ``floor(g * n)`` opcode decodes, the
@@ -116,6 +132,8 @@ class GPConfig:
     min_nodes: int = 1
     stack_depth: Optional[int] = None
     opcode_block: Optional[int] = None
+    optimize: bool = True
+    dispatch: Optional[str] = None
 
     def __post_init__(self):
         if self.max_nodes < 2:
@@ -143,6 +161,11 @@ class GPConfig:
         ):
             raise ValueError(
                 f"opcode_block must divide max_nodes ({self.max_nodes})"
+            )
+        if self.dispatch not in DISPATCH_KINDS:
+            raise ValueError(
+                f"dispatch must be one of {DISPATCH_KINDS}; "
+                f"got {self.dispatch!r}"
             )
 
     @property
@@ -186,10 +209,14 @@ class GPConfig:
 
     def cache_key(self) -> tuple:
         """Hashable identity of the encoding (operator/objective cache
-        keys and the serving bucket signature derive from it)."""
+        keys and the serving bucket signature derive from it). The
+        evaluator-shaping fields (``optimize``/``dispatch``) are part of
+        the identity: distinct settings are distinct compiled programs,
+        so tuning entries and serving buckets must not alias them."""
         return (
             "gp", self.max_nodes, self.n_vars, tuple(self.consts),
             tuple(self.unary), tuple(self.binary), self.min_nodes,
+            self.optimize, self.dispatch,
         )
 
 
@@ -504,6 +531,7 @@ __all__ = [
     "UNARY_NAMES",
     "BINARY_NAMES",
     "PAD_OP",
+    "DISPATCH_KINDS",
     "DIV_EPS",
     "LOG_EPS",
     "decode_ops",

@@ -68,7 +68,12 @@ _BINARY_FNS = {
 }
 
 
-def make_token_step(gp: GPConfig) -> Callable:
+def make_token_step(
+    gp: GPConfig,
+    *,
+    dispatch: Optional[str] = None,
+    lit: bool = False,
+) -> Callable:
     """The one token-step implementation both evaluators share.
 
     Returns ``step(stack, sp, op, arg, xt, consts) -> (stack, sp)``
@@ -78,6 +83,22 @@ def make_token_step(gp: GPConfig) -> Callable:
     lookups are masked accumulations over the (small) variable/constant
     tables, stack reads/writes are iota-compare selects — Mosaic-legal
     inside a kernel, ordinary VPU code under XLA.
+
+    ``dispatch`` selects the candidate-plane strategy (the
+    ``gp_dispatch`` tuning axis): ``None``/``"dense"`` is the original
+    every-op-every-token lattice (byte-identical trace to the
+    pre-optimizer step — the ``GPConfig(optimize=False)`` escape hatch
+    depends on it); ``"blocked"`` groups candidates by arity class —
+    one composite plane per class, selected once by arity, with the
+    add/sub pair fused into a single signed add. Every strategy
+    computes the same IEEE operations on the same operands, so all
+    dispatches score bit-identically; which is FASTER is a measured
+    question per backend (``tools/autotune.py``).
+
+    ``lit=True`` additionally understands the optimizer's synthetic
+    ``LIT`` opcode (``gp/optimize.lit_op``: arity 0, value = operand) —
+    only the compacted-program paths enable it, so the legacy trace is
+    untouched.
     """
     names = gp.op_names()
     arity_tab = gp.op_arities()
@@ -87,6 +108,12 @@ def make_token_step(gp: GPConfig) -> Callable:
     binary_ids = [(names.index(n), _BINARY_FNS[n]) for n in gp.binary]
     n_vars = gp.n_vars
     n_consts = len(gp.consts)
+    mode = dispatch or gp.dispatch or "dense"
+    if mode not in ("dense", "blocked"):
+        raise ValueError(
+            f"gp_dispatch must be 'dense' or 'blocked'; got {mode!r}"
+        )
+    lit_id = gp.n_ops if lit else None
 
     def step(stack, sp, op, arg, xt, consts):
         S = stack.shape[0]
@@ -118,12 +145,44 @@ def make_token_step(gp: GPConfig) -> Callable:
             for c in range(n_consts):
                 cval = jnp.where(cidx == c, consts[c], cval)
             leaf = jnp.where(opb == const_op, cval, leaf)
+        if lit_id is not None:
+            # The folded literal: its operand IS the value (broadcast
+            # over the sample axis).
+            leaf = jnp.where(opb == lit_id, argb, leaf)
 
-        res = leaf
-        for k, fn in unary_ids:
-            res = jnp.where(opb == k, fn(top), res)
-        for k, fn in binary_ids:
-            res = jnp.where(opb == k, fn(sec, top), res)
+        if mode == "dense":
+            res = leaf
+            for k, fn in unary_ids:
+                res = jnp.where(opb == k, fn(top), res)
+            for k, fn in binary_ids:
+                res = jnp.where(opb == k, fn(sec, top), res)
+        else:  # blocked: one composite candidate per arity class
+            abm = a_of[:, None]
+            res = leaf
+            if unary_ids:
+                (k0, f0), rest = unary_ids[0], unary_ids[1:]
+                un = f0(top)
+                for k, fn in rest:
+                    un = jnp.where(opb == k, fn(top), un)
+                res = jnp.where(abm == 1, un, res)
+            if binary_ids:
+                fuse = "add" in gp.binary and "sub" in gp.binary
+                if fuse:
+                    sub_id = names.index("sub")
+                    # a - b == a + (-b) bit-exactly in IEEE: one signed
+                    # add serves both ops.
+                    bi = sec + jnp.where(opb == sub_id, -top, top)
+                    rest = [
+                        (names.index(n), _BINARY_FNS[n])
+                        for n in gp.binary
+                        if n not in ("add", "sub")
+                    ]
+                else:
+                    (k0, f0), rest = binary_ids[0], binary_ids[1:]
+                    bi = f0(sec, top)
+                for k, fn in rest:
+                    bi = jnp.where(opb == k, fn(sec, top), bi)
+                res = jnp.where(abm == 2, bi, res)
 
         ex = (op != PAD_OP) & (sp >= a_of) & (sp - a_of < S)
         nsp = jnp.where(ex, sp - a_of + 1, sp)
@@ -141,11 +200,15 @@ def stack_predict(
     *,
     stack_depth: Optional[int] = None,
     opcode_block: Optional[int] = None,
+    dispatch: Optional[str] = None,
 ) -> jax.Array:
     """Run the stack machine over a gene matrix: ``(P, 2T)`` genomes ×
     ``(n_vars, B)`` variable-major samples → ``(P, B)`` predictions.
     Total over arbitrary gene values (skip rule). Traceable — the
-    engine's ``evaluate`` jits straight through it.
+    engine's ``evaluate`` jits straight through it. This is the
+    UNOPTIMIZED path (static ``max_nodes`` trip count); with the
+    default knobs it lowers byte-identically to the pre-optimizer
+    interpreter — the ``GPConfig(optimize=False)`` escape hatch.
     """
     S = int(stack_depth or gp.required_stack())
     block = int(opcode_block or 1)
@@ -162,7 +225,7 @@ def stack_predict(
     ops = decode_ops(genomes, gp)
     args = decode_args(genomes, gp)
     consts = jnp.asarray(gp.consts or (0.0,), jnp.float32)
-    step = make_token_step(gp)
+    step = make_token_step(gp, dispatch=dispatch)
 
     def body(i, carry):
         stack, sp = carry
@@ -183,6 +246,127 @@ def stack_predict(
     return jnp.where(sp[:, None] > 0, top, 0.0)
 
 
+#: Rows per length-sorted population block of the optimized path. Each
+#: block's token loop bounds at ITS OWN max live length, so the total
+#: trip count tracks the length distribution's quantiles instead of the
+#: population max — the multiplicative win of compaction.
+SEG_ROWS = 128
+
+
+def stack_predict_program(
+    prog,
+    xt: jax.Array,
+    gp: GPConfig,
+    *,
+    stack_depth: Optional[int] = None,
+    opcode_block: Optional[int] = None,
+    dispatch: Optional[str] = None,
+    seg_rows: Optional[int] = None,
+) -> jax.Array:
+    """Run the stack machine over a compacted :class:`~libpga_tpu.gp.
+    optimize.EvalProgram` with live-length trip reduction.
+
+    The population is sorted by live length (a transient permutation —
+    predictions scatter back; stored genomes are untouched) and split
+    into ``seg_rows`` blocks; each block's ``fori_loop`` bounds at the
+    block's max live length — a RUNTIME scalar, so the trip count
+    follows each generation's programs with zero recompiles (the bound
+    lowers to a ``while``; the traced program is shape-static).
+    Tokens past an individual's own live length are pads and mask out
+    exactly as in the unoptimized path.
+    """
+    preds, inv = _predict_program_sorted(
+        prog, xt, gp,
+        stack_depth=stack_depth, opcode_block=opcode_block,
+        dispatch=dispatch, seg_rows=seg_rows,
+    )
+    return preds[inv]
+
+
+def _predict_program_sorted(
+    prog,
+    xt: jax.Array,
+    gp: GPConfig,
+    *,
+    stack_depth: Optional[int] = None,
+    opcode_block: Optional[int] = None,
+    dispatch: Optional[str] = None,
+    seg_rows: Optional[int] = None,
+):
+    """:func:`stack_predict_program` minus the final un-permute:
+    returns ``(preds_sorted, inv)`` with predictions in live-length
+    order. Reductions over the sample axis (the RMSE in
+    ``make_eval_rows``) must run on the SORTED array and gather the
+    per-row results through ``inv`` afterwards: fusing the row gather
+    into a sample-axis reduce lets XLA pick a different summation
+    order than the unoptimized path's, and the 1-ulp wobble breaks
+    bit-equality with ``optimize=False`` inside the engine's jit.
+    """
+    S = int(stack_depth or gp.required_stack())
+    block = int(opcode_block or 1)
+    T = gp.max_nodes
+    if S < gp.required_stack():
+        raise ValueError(
+            f"stack_depth {S} < required bound {gp.required_stack()} "
+            f"(a well-formed {T}-token program can hold {T} values)"
+        )
+    if T % block:
+        raise ValueError(f"opcode_block {block} does not divide {T}")
+    P = prog.ops.shape[0]
+    B = xt.shape[1]
+    consts = jnp.asarray(gp.consts or (0.0,), jnp.float32)
+    step = make_token_step(gp, dispatch=dispatch, lit=True)
+    R = int(seg_rows or min(P, SEG_ROWS))
+    G = -(-P // R)
+    pad_n = G * R - P
+
+    order = jnp.argsort(prog.length)
+    inv = jnp.argsort(order)
+    ops_s = prog.ops[order]
+    args_s = prog.args[order]
+    len_s = prog.length[order]
+    if pad_n:
+        ops_s = jnp.pad(
+            ops_s, ((0, pad_n), (0, 0)), constant_values=PAD_OP
+        )
+        args_s = jnp.pad(args_s, ((0, pad_n), (0, 0)), constant_values=0.5)
+        len_s = jnp.pad(len_s, (0, pad_n))
+
+    def seg(_, xs):
+        o, a, ln = xs
+        maxlen = jnp.max(ln)
+        nblk = (maxlen + block - 1) // block
+
+        def body(i, carry):
+            stack, sp = carry
+            for j in range(block):
+                t = i * block + j
+                op = jax.lax.dynamic_index_in_dim(o, t, 1, keepdims=False)
+                arg = jax.lax.dynamic_index_in_dim(a, t, 1, keepdims=False)
+                stack, sp = step(stack, sp, op, arg, xt, consts)
+            return stack, sp
+
+        stack0 = jnp.zeros((S, R, B), jnp.float32)
+        sp0 = jnp.zeros((R,), jnp.int32)
+        stack, sp = jax.lax.fori_loop(0, nblk, body, (stack0, sp0))
+        sidx = jax.lax.broadcasted_iota(jnp.int32, stack.shape, 0)
+        top = jnp.sum(
+            jnp.where(sidx == sp[None, :, None] - 1, stack, 0.0), axis=0
+        )
+        return None, jnp.where(sp[:, None] > 0, top, 0.0)
+
+    _, preds = jax.lax.scan(
+        seg,
+        None,
+        (
+            ops_s.reshape(G, R, T),
+            args_s.reshape(G, R, T),
+            len_s.reshape(G, R),
+        ),
+    )
+    return preds.reshape(G * R, B)[:P], inv
+
+
 def make_eval_rows(
     gp: GPConfig,
     X,
@@ -191,12 +375,28 @@ def make_eval_rows(
     stack_depth: Optional[int] = None,
     opcode_block: Optional[int] = None,
     parsimony: float = 0.0,
+    optimize: Optional[bool] = None,
+    dispatch: Optional[str] = None,
 ) -> Callable:
     """Whole-population symbolic-regression scorer: ``rows(m) -> (P,)``
     float32 ``-RMSE`` scores (higher is better), with non-finite scores
     sanitized to ``-inf`` (one overflowing ``exp``/``mul`` chain must
     not poison the run loop's ``max(scores)`` target check), and an
-    optional parsimony penalty per non-pad token."""
+    optional parsimony penalty per non-pad token.
+
+    ``optimize`` (None = ``gp.optimize``) routes evaluation through the
+    eval-time program optimizer (``gp/optimize.py``): fold + DCE +
+    compact, then the live-length-bounded
+    :func:`stack_predict_program`. Scores are bit-equal either way
+    within a given compile context (the fold uses the evaluator's own
+    jnp table, and the RMSE reduce runs before the row un-permute);
+    across DIFFERENT enclosing programs XLA may re-emit the sample
+    reduce with 1-ulp wobble — exactly as the unoptimized path already
+    wobbles eager-vs-jit. ``rows`` also accepts an
+    already-optimized ``EvalProgram`` directly — how the engine's
+    ``prepare_eval`` hook hands over pre-compacted buffers — except
+    under parsimony, which must count the STORED genome's tokens.
+    """
     import numpy as np
 
     # NUMPY closures deliberately: this factory may run INSIDE an
@@ -218,16 +418,49 @@ def make_eval_rows(
         )
     xt = np.ascontiguousarray(Xa.T)  # (n_vars, B), variable-major
     pfloat = float(parsimony)
+    opt_on = bool(gp.optimize if optimize is None else optimize)
 
     def rows(m):
-        preds = stack_predict(
-            m, xt, gp, stack_depth=stack_depth, opcode_block=opcode_block
-        )
+        from libpga_tpu.gp.optimize import EvalProgram, optimize_for_eval
+
+        live_src = m
+        inv = None
+        if isinstance(m, EvalProgram):
+            if pfloat:
+                raise ValueError(
+                    "parsimony scoring counts the stored genome's "
+                    "tokens; pass the gene matrix, not an EvalProgram"
+                )
+            preds, inv = _predict_program_sorted(
+                m, xt, gp,
+                stack_depth=stack_depth, opcode_block=opcode_block,
+                dispatch=dispatch,
+            )
+        elif opt_on:
+            prog = optimize_for_eval(m, gp)
+            preds, inv = _predict_program_sorted(
+                prog, xt, gp,
+                stack_depth=stack_depth, opcode_block=opcode_block,
+                dispatch=dispatch,
+            )
+        else:
+            preds = stack_predict(
+                m, xt, gp,
+                stack_depth=stack_depth, opcode_block=opcode_block,
+                dispatch=dispatch,
+            )
         err = preds - ya[None, :]
         score = -jnp.sqrt(jnp.mean(err * err, axis=1))
+        if inv is not None:
+            # Un-permute AFTER the sample-axis reduce: gathering rows
+            # first lets the reduce fuse with the gather and pick a
+            # different summation order than the unoptimized path
+            # (1-ulp drift that breaks bit-equality under jit).
+            score = score[inv]
         if pfloat:
             live = jnp.sum(
-                (decode_ops(m, gp) != PAD_OP).astype(jnp.float32), axis=1
+                (decode_ops(live_src, gp) != PAD_OP).astype(jnp.float32),
+                axis=1,
             )
             score = score - jnp.float32(pfloat) * live
         return jnp.where(jnp.isfinite(score), score, -jnp.inf).astype(
@@ -237,4 +470,10 @@ def make_eval_rows(
     return rows
 
 
-__all__ = ["make_token_step", "stack_predict", "make_eval_rows"]
+__all__ = [
+    "make_token_step",
+    "stack_predict",
+    "stack_predict_program",
+    "SEG_ROWS",
+    "make_eval_rows",
+]

@@ -1,0 +1,269 @@
+"""Eval-time program optimizer: canonicalize → fold → DCE → compact.
+
+The host-side pre-eval pass of the GP fast path (ROADMAP item 1;
+"Enabling Population-Level Parallelism in Tree-Based GP", arxiv
+2501.17168, attacks the same cost shape with compact program
+representations). One vectorized forward scan — the same stack walk
+``encoding.program_structure`` runs, carrying folded VALUES alongside
+subtree heads — classifies every token of every genome, and one stable
+argsort compacts the survivors:
+
+- **canonicalize**: dead tokens (the skip rule's no-ops) never reach
+  the eval buffer — live tokens compact to the front, pads stamp the
+  tail (the ``encoding.canonicalize`` normalization, subsumed by the
+  compact step);
+- **constant-fold**: a maximal constant-headed subtree collapses to one
+  synthetic ``LIT`` token whose OPERAND is the folded float32 value
+  itself. Folding runs the evaluator's OWN jnp function table
+  (``interpreter._UNARY_FNS`` / ``_BINARY_FNS``) on-device, so the
+  folded value carries device rounding semantics and optimized
+  evaluation is BIT-EQUAL to unoptimized evaluation — not merely close
+  (property-gated in tests/test_gp_optimize.py);
+- **dead-code-eliminate**: a live subtree whose value is never consumed
+  and is not the final top (possible only in non-strictly-well-formed
+  genomes — buried stack slots) is deleted whole. Removing a complete
+  never-consumed subtree preserves every other token's execution and
+  the final top value: any token that executed without popping into the
+  buried value still finds its operands at the stack top.
+
+Stored genomes are NEVER touched: crossover geometry, checkpoints,
+``pop_shards``, and serving buckets all see the original ``(P, L)``
+gene matrix. The optimizer emits a transient :class:`EvalProgram` —
+decoded int32 opcodes over the EXTENDED table (``lit_op(gp) ==
+gp.n_ops``), float32 operands, and per-individual live lengths — that
+only the evaluators consume (``gp/interpreter.stack_predict_program``,
+``ops/gp_eval.make_gp_eval``), bounding their token loops at the
+population-block max live length.
+
+Everything here is traceable jnp (the engine's jitted run loop calls it
+every generation through the ``prepare_eval`` hook on
+``ops/evaluate.evaluate``); gathers are fine — this pass never runs
+inside a Mosaic kernel.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from libpga_tpu.gp.encoding import (
+    GPConfig,
+    PAD_OP,
+    decode_args,
+    decode_ops,
+)
+
+
+class EvalProgram(NamedTuple):
+    """The transient compacted eval buffer (a pytree — flows through
+    jit/vmap/scan like any array triple).
+
+    Attributes:
+      ops: ``(P, max_nodes)`` int32 opcodes over the EXTENDED table —
+        the config's ``op_names()`` plus the synthetic ``LIT`` opcode
+        at index ``gp.n_ops`` (arity 0; its operand IS the value).
+      args: ``(P, max_nodes)`` float32 operands; for ``LIT`` tokens the
+        folded constant value, for kept tokens the original gene.
+      length: ``(P,)`` int32 live token count after fold + DCE. Tokens
+        at positions >= length are pads.
+    """
+
+    ops: jax.Array
+    args: jax.Array
+    length: jax.Array
+
+
+def lit_op(gp: GPConfig) -> int:
+    """The synthetic literal opcode id — one past the config's table
+    (safe: ``decode_ops`` clips genome decodes to ``n_ops - 1``, so a
+    stored genome can never alias it)."""
+    return gp.n_ops
+
+
+def optimize_for_eval(genomes: jax.Array, gp: GPConfig) -> EvalProgram:
+    """Fold + DCE + compact one gene matrix into an :class:`EvalProgram`.
+
+    Total over arbitrary gene values (the skip rule classifies dead
+    tokens before anything else). Traceable; ~``T``-step scan over
+    ``(P,)``/``(P, T)`` carries — negligible next to one evaluation's
+    ``T·P·B`` lattice.
+    """
+    from libpga_tpu.gp.interpreter import _BINARY_FNS, _UNARY_FNS
+
+    P, L = genomes.shape
+    T = gp.max_nodes
+    if L != 2 * T:
+        raise ValueError(
+            f"genome_len {L} != 2 * max_nodes ({2 * T}) for this GPConfig"
+        )
+    ops = decode_ops(genomes, gp)
+    args = decode_args(genomes, gp)
+    arity = jnp.asarray(gp.op_arities(), jnp.int32)
+    names = gp.op_names()
+    const_op = names.index("const") if gp.consts else -1
+    consts = jnp.asarray(gp.consts or (0.0,), jnp.float32)
+    n_consts = max(len(gp.consts), 1)
+    unary_ids = [(names.index(n), _UNARY_FNS[n]) for n in gp.unary]
+    binary_ids = [(names.index(n), _BINARY_FNS[n]) for n in gp.binary]
+    iota_t = jnp.arange(T, dtype=jnp.int32)
+
+    def body(carry, xs):
+        sp, vstk, cstk, hstk, pconst = carry
+        t, op, arg = xs
+        a = arity[op]
+        ex = (op != PAD_OP) & (sp >= a)
+        i1 = jnp.clip(sp - 1, 0, T - 1)[:, None]
+        i2 = jnp.clip(sp - 2, 0, T - 1)[:, None]
+        topv = jnp.take_along_axis(vstk, i1, axis=1)[:, 0]
+        topc = jnp.take_along_axis(cstk, i1, axis=1)[:, 0] & (sp >= 1)
+        toph = jnp.take_along_axis(hstk, i1, axis=1)[:, 0]
+        secv = jnp.take_along_axis(vstk, i2, axis=1)[:, 0]
+        secc = jnp.take_along_axis(cstk, i2, axis=1)[:, 0] & (sp >= 2)
+        sech = jnp.take_along_axis(hstk, i2, axis=1)[:, 0]
+        # Folded value + const-headed flag. The decode mirrors the
+        # interpreter's exactly; the function applications ARE the
+        # interpreter's (same jnp table, same operand order), evaluated
+        # at (P,) — XLA elementwise lowering is shape-invariant, so the
+        # fold rounds exactly as the unfolded subtree would.
+        val = jnp.zeros_like(arg)
+        if const_op >= 0:
+            cidx = jnp.clip(
+                jnp.floor(arg * n_consts).astype(jnp.int32), 0, n_consts - 1
+            )
+            cval = jnp.zeros_like(arg)
+            for c in range(n_consts):
+                cval = jnp.where(cidx == c, consts[c], cval)
+            val = jnp.where(op == const_op, cval, val)
+            rc = op == const_op
+        else:
+            rc = jnp.zeros_like(ex)
+        for k, fn in unary_ids:
+            val = jnp.where(op == k, fn(topv), val)
+            rc = jnp.where(op == k, topc, rc)
+        for k, fn in binary_ids:
+            val = jnp.where(op == k, fn(secv, topv), val)
+            rc = jnp.where(op == k, secc & topc, rc)
+        # Mark popped operands with the PARENT's const flag: a const
+        # token consumed by a const parent is fold interior (dropped);
+        # a const head with a non-const (or no) parent is a fold ROOT.
+        m1 = ex & (a >= 1)
+        m2 = ex & (a == 2)
+        oh1 = (iota_t[None, :] == toph[:, None]) & m1[:, None]
+        oh2 = (iota_t[None, :] == sech[:, None]) & m2[:, None]
+        pconst = jnp.where(oh1, rc[:, None], pconst)
+        pconst = jnp.where(oh2, rc[:, None], pconst)
+        nsp = jnp.where(ex, sp - a + 1, sp)
+        wid = jnp.clip(nsp - 1, 0, T - 1)
+        ohw = (iota_t[None, :] == wid[:, None]) & ex[:, None]
+        vstk = jnp.where(ohw, val[:, None], vstk)
+        cstk = jnp.where(ohw, (rc & ex)[:, None], cstk)
+        hstk = jnp.where(ohw, t, hstk)
+        out = (
+            ex,
+            rc & ex,
+            val,
+            jnp.where(m1, toph, jnp.int32(-1)),
+            jnp.where(m2, sech, jnp.int32(-1)),
+        )
+        return (nsp, vstk, cstk, hstk, pconst), out
+
+    zeros_i = jnp.zeros((P,), jnp.int32)
+    carry0 = (
+        zeros_i,
+        jnp.zeros((P, T), jnp.float32),
+        jnp.zeros((P, T), bool),
+        jnp.zeros((P, T), jnp.int32),
+        jnp.zeros((P, T), bool),
+    )
+    (sp_f, _, _, hstk, pconst), (live_t, rc_t, val_t, ch1_t, ch2_t) = (
+        jax.lax.scan(
+            body, carry0,
+            (iota_t, ops.T, args.astype(jnp.float32).T),
+        )
+    )
+    live, rcm, val = live_t.T, rc_t.T, val_t.T
+    ch1, ch2 = ch1_t.T, ch2_t.T
+
+    # DCE: need-propagation from the final top, parents to children
+    # (postfix order puts every parent after its children, so one
+    # reverse scan settles the whole forest).
+    i_f = jnp.clip(sp_f - 1, 0, T - 1)[:, None]
+    top_head = jnp.take_along_axis(hstk, i_f, axis=1)[:, 0]
+    needed0 = (iota_t[None, :] == top_head[:, None]) & (sp_f > 0)[:, None]
+
+    def back(needed, xs):
+        t, c1, c2 = xs
+        nt = jnp.any(needed & (iota_t[None, :] == t), axis=1)
+        o1 = (iota_t[None, :] == c1[:, None]) & nt[:, None]
+        o2 = (iota_t[None, :] == c2[:, None]) & nt[:, None]
+        return needed | o1 | o2, None
+
+    needed, _ = jax.lax.scan(
+        back, needed0, (iota_t, ch1.T, ch2.T), reverse=True
+    )
+
+    keep_lit = live & needed & rcm & ~pconst
+    keep = (live & needed & ~rcm) | keep_lit
+    out_ops = jnp.where(keep_lit, jnp.int32(lit_op(gp)), ops)
+    out_args = jnp.where(keep_lit, val, args.astype(jnp.float32))
+    # Stable live-first compaction (jax sorts are stable — the same
+    # move as encoding.canonicalize).
+    order = jnp.argsort((~keep).astype(jnp.int32), axis=1)
+    ops_c = jnp.take_along_axis(out_ops, order, axis=1)
+    args_c = jnp.take_along_axis(out_args, order, axis=1)
+    length = jnp.sum(keep.astype(jnp.int32), axis=1)
+    tail = iota_t[None, :] >= length[:, None]
+    ops_c = jnp.where(tail, jnp.int32(PAD_OP), ops_c)
+    args_c = jnp.where(tail, jnp.float32(0.5), args_c)
+    return EvalProgram(ops=ops_c, args=args_c, length=length)
+
+
+def live_lengths(genomes: jax.Array, gp: GPConfig) -> jax.Array:
+    """``(P,)`` int32 post-optimization live lengths (traceable)."""
+    return optimize_for_eval(genomes, gp).length
+
+
+def mean_live_length(genomes, gp: GPConfig) -> float:
+    """Host-side mean post-optimization live length — the measured
+    token count ``perf/cost.gp_plan_cost`` prices instead of the static
+    ``max_nodes`` cap (``pga.program_report`` passes it through)."""
+    import numpy as np
+
+    return float(np.mean(np.asarray(live_lengths(genomes, gp))))
+
+
+def compaction_stats(genomes, gp: GPConfig) -> dict:
+    """Host-side optimizer effectiveness summary (the gp_smoke /
+    bench compaction-stats line): live token counts before (skip-rule
+    live, ``program_structure``) and after (fold + DCE), and the
+    fraction of live tokens the optimizer removed."""
+    import numpy as np
+
+    from libpga_tpu.gp.encoding import program_structure
+
+    before = np.asarray(program_structure(genomes, gp).length)
+    after = np.asarray(live_lengths(genomes, gp))
+    total_before = float(before.sum())
+    return {
+        "pop": int(before.shape[0]),
+        "max_nodes": int(gp.max_nodes),
+        "mean_live_before": float(before.mean()),
+        "mean_live_after": float(after.mean()),
+        "max_live_after": int(after.max()) if after.size else 0,
+        "removed_frac": (
+            float((before - after).sum() / total_before)
+            if total_before else 0.0
+        ),
+    }
+
+
+__all__ = [
+    "EvalProgram",
+    "lit_op",
+    "optimize_for_eval",
+    "live_lengths",
+    "mean_live_length",
+    "compaction_stats",
+]

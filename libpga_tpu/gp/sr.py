@@ -64,15 +64,25 @@ def symbolic_regression(
     gp: Optional[GPConfig] = None,
     stack_depth: Optional[int] = None,
     opcode_block: Optional[int] = None,
+    dispatch: Optional[str] = None,
     parsimony: float = 0.0,
     fused: Optional[bool] = None,
 ) -> Callable:
     """Build a symbolic-regression objective over an ``(B, n_vars)``
-    dataset. ``stack_depth``/``opcode_block`` pin the evaluator knobs
-    explicitly (user precedence over any installed tuning DB);
-    ``parsimony`` subtracts that many score units per program token;
-    ``fused`` forces the Pallas evaluator on (True), off (False), or
-    auto — TPU backends only (None)."""
+    dataset. ``stack_depth``/``opcode_block``/``dispatch`` pin the
+    evaluator knobs explicitly (user precedence over any installed
+    tuning DB); ``parsimony`` subtracts that many score units per
+    program token; ``fused`` forces the Pallas evaluator on (True),
+    off (False), or auto — TPU backends only (None).
+
+    When ``gp.optimize`` (the default) and ``parsimony == 0``, the
+    objective exposes the ``prepare_eval`` hook (``ops/evaluate.py``):
+    the engine compacts the population once per generation
+    (``gp/optimize.optimize_for_eval``) and ``rows`` consumes the
+    resulting :class:`~libpga_tpu.gp.optimize.EvalProgram` directly —
+    stored genomes are never touched. Parsimony pins the legacy path:
+    its token-count penalty is defined over the ORIGINAL program's
+    live tokens, which compaction erases."""
     gp = gp or GPConfig()
     Xa = np.asarray(X, np.float32)
     if Xa.ndim == 1:
@@ -86,7 +96,11 @@ def symbolic_regression(
         raise ValueError(
             f"X has {Xa.shape[0]} samples but y has {ya.shape[0]}"
         )
-    if stack_depth is not None or opcode_block is not None:
+    if (
+        stack_depth is not None
+        or opcode_block is not None
+        or dispatch is not None
+    ):
         # Validate explicit knobs eagerly (registration-time errors,
         # the expression-objective stance).
         from libpga_tpu.ops.gp_eval import gp_eval_plan
@@ -94,14 +108,17 @@ def symbolic_regression(
         gp_eval_plan(
             8, gp, Xa.shape[0],
             stack_depth=stack_depth, opcode_block=opcode_block,
+            dispatch=dispatch,
         )
 
     name = f"gp_sr/{_digest(Xa, ya, gp, parsimony)}"
-    #: (pop, active-db path) -> (stack_depth, opcode_block, provenance)
+    opt_on = bool(gp.optimize) and float(parsimony) == 0.0
+    #: (pop, active-db path) ->
+    #:     (stack_depth, opcode_block, dispatch, provenance)
     resolved: dict = {}
-    #: (stack_depth, opcode_block) -> rows fn (knob-shaped program)
+    #: (stack_depth, opcode_block, dispatch) -> rows fn
     rows_cache: dict = {}
-    #: (pop, stack_depth, opcode_block) -> fused eval fn or None
+    #: (pop, stack_depth, opcode_block, dispatch) -> fused fn or None
     fused_cache: dict = {}
     degraded: set = set()
 
@@ -113,8 +130,8 @@ def symbolic_regression(
         hit = resolved.get(mark)
         if hit is not None:
             return hit
-        S, B, prov = stack_depth, opcode_block, None
-        if tdb is not None and (S is None or B is None):
+        S, B, D, prov = stack_depth, opcode_block, dispatch, None
+        if tdb is not None and (S is None or B is None or D is None):
             entry = tdb.lookup(_tdb.current_key(
                 pop, gp.genome_len, np.float32, per_genome, "gp", "gp",
             ))
@@ -134,7 +151,14 @@ def symbolic_regression(
                     )
                 else:
                     prov["gp_opcode_block"] = "user"
-        out = (S, B, prov)
+                if D is None:
+                    D = entry.knobs.get("gp_dispatch")
+                    prov["gp_dispatch"] = (
+                        "db" if D is not None else "default"
+                    )
+                else:
+                    prov["gp_dispatch"] = "user"
+        out = (S, B, D, prov)
         resolved[mark] = out
         return out
 
@@ -148,8 +172,8 @@ def symbolic_regression(
         except RuntimeError:
             return False
 
-    def _fused_eval(pop: int, S, B):
-        mark = (pop, S, B)
+    def _fused_eval(pop: int, S, B, D):
+        mark = (pop, S, B, D)
         if mark in fused_cache:
             return fused_cache[mark]
         fn = None
@@ -158,6 +182,7 @@ def symbolic_regression(
 
             fn = make_gp_eval(
                 gp, Xa, ya, pop=pop, stack_depth=S, opcode_block=B,
+                dispatch=D, optimize=opt_on,
             )
         except Exception as exc:  # declines or fails: interpreter serves
             if "fused" not in degraded:
@@ -172,18 +197,22 @@ def symbolic_regression(
         return fn
 
     def rows(m):
-        pop = int(m.shape[0])
-        S, B, prov = _resolve(pop)
+        from libpga_tpu.gp.optimize import EvalProgram
+
+        is_prog = isinstance(m, EvalProgram)
+        pop = int(m.ops.shape[0] if is_prog else m.shape[0])
+        S, B, D, prov = _resolve(pop)
         if _fused_wanted() and parsimony == 0.0:
-            fn = _fused_eval(pop, S, B)
+            fn = _fused_eval(pop, S, B, D)
             if fn is not None:
                 return fn(m)
-        key = (S, B)
+        key = (S, B, D)
         fn = rows_cache.get(key)
         if fn is None:
             fn = make_eval_rows(
                 gp, Xa, ya,
-                stack_depth=S, opcode_block=B, parsimony=parsimony,
+                stack_depth=S, opcode_block=B, dispatch=D,
+                parsimony=parsimony,
             )
             rows_cache[key] = fn
         del prov  # provenance is inspectable via obj.resolved
@@ -195,13 +224,14 @@ def symbolic_regression(
     def with_knobs(
         stack_depth: Optional[int] = None,
         opcode_block: Optional[int] = None,
+        dispatch: Optional[str] = None,
     ):
         """Rebuild at explicit evaluator knobs (the autotuner's
         measurement hook — user-precedence semantics)."""
         return symbolic_regression(
             Xa, ya, gp=gp,
             stack_depth=stack_depth, opcode_block=opcode_block,
-            parsimony=parsimony, fused=fused,
+            dispatch=dispatch, parsimony=parsimony, fused=fused,
         )
 
     per_genome.rows = rows
@@ -210,8 +240,17 @@ def symbolic_regression(
     per_genome.sr_samples = int(Xa.shape[0])
     per_genome.with_knobs = with_knobs
     per_genome.resolved = resolved
-    per_genome.knob_args = (stack_depth, opcode_block)
+    per_genome.knob_args = (stack_depth, opcode_block, dispatch)
     per_genome.parsimony = float(parsimony)
+    if opt_on:
+        def prepare_eval(genomes):
+            """Compact the population for evaluation (``ops/evaluate``
+            hook) — genomes in, transient EvalProgram out."""
+            from libpga_tpu.gp.optimize import optimize_for_eval
+
+            return optimize_for_eval(genomes, gp)
+
+        per_genome.prepare_eval = prepare_eval
     per_genome.__doc__ = (
         f"Symbolic-regression objective ({Xa.shape[0]} samples, "
         f"{gp.n_vars} vars, {gp.max_nodes}-token programs): -RMSE."

@@ -38,7 +38,12 @@ def evaluate(obj: Callable[[jax.Array], jax.Array], genomes: jax.Array) -> jax.A
     # valid outside a kernel).
     rows = getattr(obj, "rows", None) or getattr(obj, "kernel_rowwise", None)
     if rows is not None:
-        scores = rows(genomes)
+        # Eval-prep hook: an objective may transform the population
+        # into a transient eval-only representation first (the GP
+        # optimizer's compacted EvalProgram, ``gp/sr.py``). The stored
+        # genomes the engine breeds/checkpoints are untouched.
+        prep = getattr(obj, "prepare_eval", None)
+        scores = rows(genomes if prep is None else prep(genomes))
     else:
         scores = jax.vmap(obj)(genomes)
     return scores.astype(jnp.float32)

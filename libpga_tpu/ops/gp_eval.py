@@ -32,8 +32,11 @@ autotuner space off-chip.
 
 CHIP-ROUND NOTE: like every Mosaic kernel in the tree this round is
 CPU-validated through interpret mode only; first-hardware items are
-the 3-D ``(S, R, Bp)`` scratch layout and the int32 masked-accumulation
-token reads.
+the 3-D ``(S, R, Bp)`` scratch layout, the int32 masked-accumulation
+token reads, and (optimize path) the runtime ``fori_loop`` trip bound
+read from the per-block max-live-length input — a traced bound lowers
+to ``while`` under Mosaic; if the hardware round finds it hostile the
+fallback is the static ``T // B`` bound with the same masks.
 """
 
 from __future__ import annotations
@@ -44,8 +47,15 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
-from libpga_tpu.gp.encoding import GPConfig, PAD_OP, decode_args, decode_ops
+from libpga_tpu.gp.encoding import (
+    DISPATCH_KINDS,
+    GPConfig,
+    PAD_OP,
+    decode_args,
+    decode_ops,
+)
 from libpga_tpu.gp.interpreter import make_token_step
+from libpga_tpu.gp.optimize import EvalProgram, optimize_for_eval
 
 LANE = 128
 SUBLANE = 8
@@ -76,17 +86,19 @@ def gp_eval_plan(
     *,
     stack_depth: Optional[int] = None,
     opcode_block: Optional[int] = None,
+    dispatch: Optional[str] = None,
 ) -> Optional[dict]:
     """Dry-run shape resolution of the fused GP evaluator.
 
-    Returns the plan dict (resolved ``stack_depth``/``opcode_block``,
-    fused-kernel geometry with ``rows_per_block``/``grid``/
-    ``vmem_bytes`` — or ``path="xla"`` with ``rows_per_block=None``
-    when no block size fits the budget or divides ``pop``), raises
-    ``ValueError`` for an explicitly invalid knob (a stack depth below
-    the provable bound, a block that does not divide ``max_nodes``),
-    and never returns a geometry the factory wouldn't build —
-    :func:`make_gp_eval` resolves through THIS function.
+    Returns the plan dict (resolved ``stack_depth``/``opcode_block``/
+    ``dispatch``, fused-kernel geometry with ``rows_per_block``/
+    ``grid``/``vmem_bytes`` — or ``path="xla"`` with
+    ``rows_per_block=None`` when no block size fits the budget or
+    divides ``pop``), raises ``ValueError`` for an explicitly invalid
+    knob (a stack depth below the provable bound, a block that does not
+    divide ``max_nodes``, an unknown dispatch kind), and never returns
+    a geometry the factory wouldn't build — :func:`make_gp_eval`
+    resolves through THIS function.
     """
     if pop < 1 or n_samples < 1:
         return None
@@ -103,6 +115,11 @@ def gp_eval_plan(
         raise ValueError(
             f"gp_opcode_block {B} does not divide max_nodes "
             f"{gp.max_nodes}"
+        )
+    D = dispatch if dispatch is not None else (gp.dispatch or "dense")
+    if D not in DISPATCH_KINDS:
+        raise ValueError(
+            f"gp_dispatch {D!r} not in {tuple(k for k in DISPATCH_KINDS if k)}"
         )
     Bp = _lanes(n_samples)
     Tp = _lanes(gp.max_nodes)
@@ -127,6 +144,8 @@ def gp_eval_plan(
     plan = {
         "stack_depth": S,
         "opcode_block": B,
+        "dispatch": D,
+        "optimize": bool(gp.optimize),
         "batch_lanes": Bp,
         "token_lanes": Tp,
         "rows_per_block": rows,
@@ -137,33 +156,64 @@ def gp_eval_plan(
     return plan
 
 
-def gp_plan_cost(plan: dict, pop: int, gp: GPConfig, n_samples: int) -> dict:
+def gp_plan_cost(
+    plan: dict,
+    pop: int,
+    gp: GPConfig,
+    n_samples: int,
+    *,
+    live_length: Optional[float] = None,
+) -> dict:
     """Analytic per-evaluation cost of a resolved :func:`gp_eval_plan`
     (the ISSUE 17 plan→cost hook; ``libpga_tpu/perf/cost.py`` builds the
     GP roofline report from this).
 
     The mask-only interpreter executes its FULL lattice regardless of
-    masks — every token step touches the whole ``(S, P, B)`` value stack
-    (top read, second read, result write: 3 passes at compare+select =
-    2 ops each) and computes one ``(P, B)`` candidate plane per
-    registered op family (compute + select = 2 ops) — so the dense
-    elementwise count IS the device work, not an upper bound:
+    masks — every executed token step touches the whole ``(S, P, B)``
+    value stack (top read, second read, result write: 3 passes at
+    compare+select = 2 ops each) and computes candidate ``(P, B)``
+    planes for its dispatch lattice (compute + select = 2 ops per
+    plane) — so the elementwise count IS the device work, not an upper
+    bound:
 
-        ``flops_per_eval = max_nodes · P · B · (6·S + 2·n_ops)``
+        ``flops_per_eval = tokens · P · B · (6·S + 2·n_planes)``
+
+    ``tokens`` is the trip count the evaluator actually runs: the
+    static ``max_nodes`` cap on the legacy path, the MEASURED mean live
+    length (``gp/optimize.mean_live_length``, passed by the caller as
+    ``live_length``) when the plan's config optimizes — that is what
+    keeps ``pga.program_report`` / ``perf.achieved`` roofline fractions
+    honest after compaction + trip reduction. ``n_planes`` is ``n_ops``
+    plus one for the optimizer's synthetic ``LIT`` leaf, minus one when
+    ``dispatch="blocked"`` fuses the add/sub planes into one.
 
     ``B`` is the padded ``batch_lanes`` on the fused path (the kernel
     pads samples to the 128 lane); the XLA interpreter runs unpadded,
     so for ``path="xla"`` the same formula over raw ``n_samples`` is
     reported. HBM bytes are the evaluation's irreducible traffic: the
-    token stream read (ops i32 + args f32 per padded token), the sample
-    matrix and targets, and the score write. ``vmem_bytes`` is the
-    plan's own admission figure (None on the XLA path).
+    token stream read (ops i32 + args f32 per padded token — the
+    compacted buffer keeps the padded extent, only the loop shortens),
+    the sample matrix and targets, and the score write. ``vmem_bytes``
+    is the plan's own admission figure (None on the XLA path).
     """
     S = int(plan["stack_depth"])
     fused = plan["path"] == "fused"
     B = int(plan["batch_lanes"]) if fused else int(n_samples)
     Tp = int(plan["token_lanes"]) if fused else int(gp.max_nodes)
-    flops = gp.max_nodes * pop * B * (6 * S + 2 * gp.n_ops)
+    opt = bool(plan.get("optimize", False))
+    tokens = (
+        float(live_length)
+        if (opt and live_length is not None)
+        else float(gp.max_nodes)
+    )
+    n_planes = gp.n_ops + (1 if opt else 0)
+    if (
+        plan.get("dispatch") == "blocked"
+        and "add" in gp.binary
+        and "sub" in gp.binary
+    ):
+        n_planes -= 1
+    flops = int(round(tokens * pop * B * (6 * S + 2 * n_planes)))
     hbm = pop * Tp * (4 + 4) + gp.n_vars * B * 4 + B * 4 + pop * 4
     return {
         "flops_per_eval": flops,
@@ -171,6 +221,7 @@ def gp_plan_cost(plan: dict, pop: int, gp: GPConfig, n_samples: int) -> dict:
         "vmem_bytes": plan["vmem_bytes"],
         "batch_lanes": B,
         "path": plan["path"],
+        "tokens_per_program": tokens,
     }
 
 
@@ -182,12 +233,21 @@ def make_gp_eval(
     pop: int,
     stack_depth: Optional[int] = None,
     opcode_block: Optional[int] = None,
+    dispatch: Optional[str] = None,
+    optimize: Optional[bool] = None,
 ) -> Callable:
     """Build the fused evaluator for one population size: ``fn(genomes
-    (pop, 2T)) -> (pop,)`` float32 ``-RMSE`` scores, semantics
-    bit-matching the XLA interpreter path (same token step, same
-    sanitization). Raises ``ValueError`` where the plan declines —
-    callers (``gp/sr.py``) apply the ``PGAConfig.fallback`` stance.
+    (pop, 2T) | EvalProgram)`` -> ``(pop,)`` float32 ``-RMSE`` scores,
+    semantics bit-matching the XLA interpreter path (same token step,
+    same sanitization). When the config optimizes (``gp.optimize``, or
+    the explicit ``optimize`` override) the build accepts raw genomes
+    OR a pre-built :class:`~libpga_tpu.gp.optimize.EvalProgram` (the
+    ``prepare_eval`` hook's output), sorts rows by live length so each
+    grid block holds like-sized programs, and bounds each block's token
+    loop at that block's max live length — a runtime scalar, so trips
+    shrink with compaction and nothing recompiles across generations.
+    Raises ``ValueError`` where the plan declines — callers
+    (``gp/sr.py``) apply the ``PGAConfig.fallback`` stance.
     """
     import numpy as np
 
@@ -202,12 +262,14 @@ def make_gp_eval(
     plan = gp_eval_plan(
         pop, gp, n_samples,
         stack_depth=stack_depth, opcode_block=opcode_block,
+        dispatch=dispatch,
     )
     if plan is None or plan["rows_per_block"] is None:
         raise ValueError(
             f"fused GP evaluator declines pop={pop} "
             f"(no admissible rows_per_block in {GP_ROW_POOL})"
         )
+    opt_on = bool(gp.optimize if optimize is None else optimize)
     S, B = plan["stack_depth"], plan["opcode_block"]
     R, Bp, Tp = plan["rows_per_block"], plan["batch_lanes"], plan["token_lanes"]
     T = gp.max_nodes
@@ -230,7 +292,19 @@ def make_gp_eval(
     xt_j = jnp.asarray(xt)
     ym_j = jnp.asarray(ym)
     ctab_j = jnp.asarray(ctab)
-    step = make_token_step(gp)
+    step = make_token_step(gp, dispatch=plan["dispatch"], lit=opt_on)
+
+    def finish(stack, sp, yrow, mask, out_ref):
+        sidx = jax.lax.broadcasted_iota(jnp.int32, (S, R, Bp), 0)
+        top = jnp.sum(
+            jnp.where(sidx == sp[None, :, None] - 1, stack, 0.0), axis=0
+        )
+        top = jnp.where(sp[:, None] > 0, top, 0.0)
+        err = (top - yrow[None, :]) * mask[None, :]
+        mse = jnp.sum(err * err, axis=1) / jnp.sum(mask)
+        score = -jnp.sqrt(mse)
+        score = jnp.where(jnp.isfinite(score), score, -jnp.float32(jnp.inf))
+        out_ref[...] = jnp.broadcast_to(score[:, None], (R, LANE))
 
     def kernel(ops_ref, args_ref, xt_ref, ym_ref, c_ref, out_ref,
                stack_ref):
@@ -238,8 +312,6 @@ def make_gp_eval(
         args_b = args_ref[...]
         xts = xt_ref[...]
         consts = c_ref[0, :]
-        yrow = ym_ref[0, :]
-        mask = ym_ref[1, :]
         stack_ref[...] = jnp.zeros((S, R, Bp), jnp.float32)
         lane_t = jax.lax.broadcasted_iota(jnp.int32, (R, Tp), 1)
 
@@ -257,46 +329,104 @@ def make_gp_eval(
         sp = jax.lax.fori_loop(
             0, T // B, body, jnp.zeros((R,), jnp.int32)
         )
-        stack = stack_ref[...]
-        sidx = jax.lax.broadcasted_iota(jnp.int32, (S, R, Bp), 0)
-        top = jnp.sum(
-            jnp.where(sidx == sp[None, :, None] - 1, stack, 0.0), axis=0
+        finish(stack_ref[...], sp, ym_ref[0, :], ym_ref[1, :], out_ref)
+
+    def kernel_opt(ops_ref, args_ref, xt_ref, ym_ref, c_ref, mx_ref,
+                   out_ref, stack_ref):
+        # Identical walk, but the trip count is the block's max live
+        # length (rows are length-sorted, so blocks are homogeneous):
+        # tokens past a row's own length are PAD_OP inside the bound
+        # and never visited beyond it. Runtime bound -> while loop;
+        # see the module CHIP-ROUND NOTE.
+        ops_b = ops_ref[...]
+        args_b = args_ref[...]
+        xts = xt_ref[...]
+        consts = c_ref[0, :]
+        stack_ref[...] = jnp.zeros((S, R, Bp), jnp.float32)
+        lane_t = jax.lax.broadcasted_iota(jnp.int32, (R, Tp), 1)
+
+        def body(i, sp):
+            stack = stack_ref[...]
+            for j in range(B):
+                t = i * B + j
+                tm = lane_t == t
+                op = jnp.sum(jnp.where(tm, ops_b, 0), axis=1)
+                arg = jnp.sum(jnp.where(tm, args_b, 0.0), axis=1)
+                stack, sp = step(stack, sp, op, arg, xts, consts)
+            stack_ref[...] = stack
+            return sp
+
+        nblk = (mx_ref[0, 0] + (B - 1)) // B
+        sp = jax.lax.fori_loop(
+            0, nblk, body, jnp.zeros((R,), jnp.int32)
         )
-        top = jnp.where(sp[:, None] > 0, top, 0.0)
-        err = (top - yrow[None, :]) * mask[None, :]
-        mse = jnp.sum(err * err, axis=1) / jnp.sum(mask)
-        score = -jnp.sqrt(mse)
-        score = jnp.where(jnp.isfinite(score), score, -jnp.float32(jnp.inf))
-        out_ref[...] = jnp.broadcast_to(score[:, None], (R, LANE))
+        finish(stack_ref[...], sp, ym_ref[0, :], ym_ref[1, :], out_ref)
 
     grid = plan["grid"]
+    tok_specs = [
+        pl.BlockSpec((R, Tp), lambda i: (i, 0)),
+        pl.BlockSpec((R, Tp), lambda i: (i, 0)),
+        pl.BlockSpec((Vp, Bp), lambda i: (0, 0)),
+        pl.BlockSpec((SUBLANE, Bp), lambda i: (0, 0)),
+        pl.BlockSpec((SUBLANE, LANE), lambda i: (0, 0)),
+    ]
 
-    def run(genomes):
-        ops = decode_ops(genomes, gp)
-        args = decode_args(genomes, gp)
+    def _pad_tokens(ops, args):
         if Tp != T:
             ops = jnp.pad(ops, ((0, 0), (0, Tp - T)),
                           constant_values=PAD_OP)
             args = jnp.pad(args, ((0, 0), (0, Tp - T)))
-        out = pl.pallas_call(
-            kernel,
-            grid=(grid,),
-            in_specs=[
-                pl.BlockSpec((R, Tp), lambda i: (i, 0)),
-                pl.BlockSpec((R, Tp), lambda i: (i, 0)),
-                pl.BlockSpec((Vp, Bp), lambda i: (0, 0)),
-                pl.BlockSpec((SUBLANE, Bp), lambda i: (0, 0)),
-                pl.BlockSpec((SUBLANE, LANE), lambda i: (0, 0)),
-            ],
-            out_specs=pl.BlockSpec((R, LANE), lambda i: (i, 0)),
-            out_shape=jax.ShapeDtypeStruct((pop, LANE), jnp.float32),
-            scratch_shapes=[pltpu.VMEM((S, R, Bp), jnp.float32)],
-        )(ops, args, xt_j, ym_j, ctab_j)
-        return out[:, 0]
+        return ops, args
+
+    if opt_on:
+
+        def run(m):
+            prog = m if isinstance(m, EvalProgram) else (
+                optimize_for_eval(m, gp)
+            )
+            order = jnp.argsort(prog.length)
+            inv = jnp.argsort(order)
+            ops, args = _pad_tokens(
+                jnp.take(prog.ops, order, axis=0),
+                jnp.take(prog.args, order, axis=0),
+            )
+            blkmax = jnp.max(
+                jnp.take(prog.length, order).reshape(grid, R), axis=1
+            )
+            mx = jnp.broadcast_to(
+                blkmax[:, None].astype(jnp.int32), (grid, LANE)
+            )
+            out = pl.pallas_call(
+                kernel_opt,
+                grid=(grid,),
+                in_specs=tok_specs + [
+                    pl.BlockSpec((1, LANE), lambda i: (i, 0)),
+                ],
+                out_specs=pl.BlockSpec((R, LANE), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((pop, LANE), jnp.float32),
+                scratch_shapes=[pltpu.VMEM((S, R, Bp), jnp.float32)],
+            )(ops, args, xt_j, ym_j, ctab_j, mx)
+            return jnp.take(out[:, 0], inv)
+
+    else:
+
+        def run(genomes):
+            ops, args = _pad_tokens(
+                decode_ops(genomes, gp), decode_args(genomes, gp)
+            )
+            out = pl.pallas_call(
+                kernel,
+                grid=(grid,),
+                in_specs=tok_specs,
+                out_specs=pl.BlockSpec((R, LANE), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((pop, LANE), jnp.float32),
+                scratch_shapes=[pltpu.VMEM((S, R, Bp), jnp.float32)],
+            )(ops, args, xt_j, ym_j, ctab_j)
+            return out[:, 0]
 
     run.plan = dict(plan)
     return jax.jit(run)
 
 
 __all__ = ["LANE", "GP_ROW_POOL", "GP_VMEM_BUDGET", "gp_eval_plan",
-           "make_gp_eval"]
+           "gp_plan_cost", "make_gp_eval"]

@@ -162,17 +162,25 @@ def gp_report(
     *,
     stack_depth: Optional[int] = None,
     opcode_block: Optional[int] = None,
+    dispatch: Optional[str] = None,
+    live_length: Optional[float] = None,
     device_kind: Optional[str] = None,
 ) -> dict:
     """Program report for one GP-evaluation shape (``gp`` is a
     ``gp/encoding.GPConfig``). One *evaluation* of the whole population
     is the GP analog of a generation, so the roofline fields read in
-    the same units (evals/sec ≡ gens/sec)."""
+    the same units (evals/sec ≡ gens/sec). ``live_length`` is the
+    measured mean post-compaction live length of the population being
+    reported (``gp/optimize.mean_live_length``) — with it, an
+    optimizing config's FLOPs price the trips the evaluator actually
+    runs instead of the ``max_nodes`` cap (ISSUE 19), keeping
+    ``achieved()`` roofline fractions honest on the fast path."""
     from libpga_tpu.ops.gp_eval import gp_eval_plan, gp_plan_cost
 
     plan = gp_eval_plan(
         pop, gp, n_samples,
         stack_depth=stack_depth, opcode_block=opcode_block,
+        dispatch=dispatch,
     )
     report = {
         "report": "gp_eval",
@@ -183,11 +191,14 @@ def gp_report(
         "plan": plan,
     }
     if plan is not None:
-        cost = gp_plan_cost(plan, pop, gp, n_samples)
+        cost = gp_plan_cost(
+            plan, pop, gp, n_samples, live_length=live_length,
+        )
         report["flops_per_gen"] = cost["flops_per_eval"]
         report["hbm_bytes_per_gen"] = cost["hbm_bytes_per_eval"]
         report["vmem_bytes"] = cost["vmem_bytes"]
         report["batch_lanes"] = cost["batch_lanes"]
+        report["tokens_per_program"] = cost["tokens_per_program"]
         report.update(roofline(
             cost["flops_per_eval"], cost["hbm_bytes_per_eval"], device_kind,
         ))

@@ -56,7 +56,7 @@ ENV_VAR = "PGA_TUNING_DB"
 #: so vector-genome resolution is untouched).
 TUNABLE_FIELDS = (
     "pallas_deme_size", "pallas_layout", "pallas_subblock",
-    "gp_stack_depth", "gp_opcode_block",
+    "gp_stack_depth", "gp_opcode_block", "gp_dispatch",
 )
 
 

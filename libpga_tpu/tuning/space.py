@@ -64,6 +64,11 @@ DOMAINS: Dict[str, tuple] = {
     # >1-plan autotuner space off-chip.
     "gp_stack_depth": (None, 8, 16, 32, 64),
     "gp_opcode_block": (None, 1, 2, 4, 8),
+    # Token-step dispatch lattice (ISSUE 19): dense = one candidate
+    # plane per registered op, blocked = arity-class composite planes
+    # (bit-identical results; the plane count is what changes — speed
+    # is the measured axis). AUTO is the dense stock path.
+    "gp_dispatch": (None, "dense", "blocked"),
 }
 
 #: The engine-appliable knobs (PGAConfig fields exist for exactly
@@ -73,7 +78,9 @@ TUNER_KNOBS: Tuple[str, ...] = ("deme_size", "layout", "subblock")
 
 #: The GP evaluator knobs (applied at objective build —
 #: ``gp/sr.symbolic_regression`` — not through PGAConfig).
-GP_KNOBS: Tuple[str, ...] = ("gp_stack_depth", "gp_opcode_block")
+GP_KNOBS: Tuple[str, ...] = (
+    "gp_stack_depth", "gp_opcode_block", "gp_dispatch",
+)
 
 #: The full sweep space (tools/sweep_kernel.py, tools/ablate_floor.py).
 SWEEP_KNOBS: Tuple[str, ...] = TUNER_KNOBS + (
@@ -101,6 +108,7 @@ class KernelConfig:
     dimension_semantics: str = "parallel"
     gp_stack_depth: Optional[int] = None
     gp_opcode_block: Optional[int] = None
+    gp_dispatch: Optional[str] = None
 
     def knobs(self, names: Sequence[str] = TUNER_KNOBS) -> dict:
         return {n: getattr(self, n) for n in names}
@@ -183,6 +191,7 @@ def resolve(ctx: SpaceContext, cfg: KernelConfig) -> Optional[dict]:
             ctx.pop, _gp_config(ctx), ctx.gp_samples,
             stack_depth=cfg.gp_stack_depth,
             opcode_block=cfg.gp_opcode_block,
+            dispatch=cfg.gp_dispatch,
         )
     return kernel_plan(
         ctx.pop, ctx.genome_len,

@@ -113,7 +113,10 @@ def _plan_key(ctx, cfg, pallas_live: bool) -> tuple:
         plan = _space.resolve(ctx, cfg)
         if plan is None:
             return ("xla",)
-        return ("gp", plan["stack_depth"], plan["opcode_block"])
+        return (
+            "gp", plan["stack_depth"], plan["opcode_block"],
+            plan["dispatch"],
+        )
     if not pallas_live:
         return ("xla",)
     plan = _space.resolve(ctx, cfg)
@@ -135,6 +138,7 @@ def _canonical_knobs(plan_key: tuple) -> dict:
     if plan_key[0] == "gp":
         knobs["gp_stack_depth"] = int(plan_key[1])
         knobs["gp_opcode_block"] = int(plan_key[2])
+        knobs["gp_dispatch"] = str(plan_key[3])
         return knobs
     if plan_key[0] != "pallas":
         return knobs
@@ -219,6 +223,7 @@ class MeasurementOracle:
             obj = obj.with_knobs(
                 stack_depth=knobs.get("gp_stack_depth"),
                 opcode_block=knobs.get("gp_opcode_block"),
+                dispatch=knobs.get("gp_dispatch"),
             )
         pga.set_objective(obj)
         if self.crossover_op is not None:
@@ -543,7 +548,9 @@ def autotune(
             subblock=key[4],
         )
     elif key[0] == "gp":
-        plan.update(stack_depth=key[1], opcode_block=key[2])
+        plan.update(
+            stack_depth=key[1], opcode_block=key[2], dispatch=key[3],
+        )
     entry = _db.TuningEntry(
         key=_db.current_key(
             pop, genome_len, gene_dtype, obj, crossover_kind,

@@ -127,7 +127,13 @@ EVENT_FIELDS: Dict[str, tuple] = {
     # Genetic programming (ISSUE 11): one record per run evolving a
     # GP objective (``gp/sr.py``), naming the postfix encoding — the
     # observability anchor for SR-as-a-service traffic.
-    "gp_run": ("population_size", "max_nodes", "n_ops", "n_vars"),
+    # ISSUE 19 adds the eval fast-path provenance: whether the run's
+    # evaluator compacts programs before scoring and which token-step
+    # dispatch lattice it resolved.
+    "gp_run": (
+        "population_size", "max_nodes", "n_ops", "n_vars",
+        "optimize", "dispatch",
+    ),
     # Streaming evolution service (ISSUE 12): session lifecycle —
     # tenant open, external-evaluation folds at generation boundaries
     # (``where`` names the boundary: step / ask / group_step),

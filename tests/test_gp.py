@@ -610,7 +610,10 @@ class TestTuning:
         db = D.TuningDB()
         db.add(D.TuningEntry(
             key=key,
-            knobs={"gp_stack_depth": 32, "gp_opcode_block": 4},
+            knobs={
+                "gp_stack_depth": 32, "gp_opcode_block": 4,
+                "gp_dispatch": "blocked",
+            },
             gens_per_sec=1.0, created=1.0,
         ))
         path = str(tmp_path / "t.json")
@@ -622,16 +625,18 @@ class TestTuning:
             (knobs,) = [
                 v for k, v in obj.resolved.items() if k[0] == 64
             ]
-            assert knobs[:2] == (32, 4)
-            assert knobs[2] == {
+            assert knobs[:3] == (32, 4, "blocked")
+            assert knobs[3] == {
                 "gp_stack_depth": "db", "gp_opcode_block": "db",
+                "gp_dispatch": "db",
             }
             user = symbolic_regression(X, y, gp=gp, stack_depth=64)
             user.rows(pop)
             (uk,) = [
                 v for k, v in user.resolved.items() if k[0] == 64
             ]
-            assert uk[0] == 64 and uk[1] == 4  # user beats db, db fills
+            # user beats db, db fills the rest
+            assert uk[:3] == (64, 4, "blocked")
         finally:
             D.set_tuning_db(None)
 

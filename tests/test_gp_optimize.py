@@ -1,0 +1,477 @@
+"""GP eval-time program optimizer (ISSUE 19): fold + DCE + compact.
+
+Covers the acceptance gates:
+
+- optimized evaluation is BIT-EQUAL to unoptimized evaluation on
+  random well-formed programs AND arbitrary gene noise (the fold uses
+  the evaluator's own jnp table, so device rounding is identical);
+- on IEEE-exact op sets (neg/add/sub/mul/div — correctly rounded on
+  both numpy and XLA CPU) fitness is bit-equal to the numpy oracle
+  piped through the interpreter's own RMSE expression;
+- constant-only programs fold to a single ``LIT`` token; max-depth
+  chains survive; live lengths match ``program_structure`` exactly
+  when nothing folds and never exceed it anywhere;
+- the live-length trip bound is a RUNTIME scalar: populations with
+  different length distributions share one compiled program;
+- the ``gp_dispatch`` tuning knob: domain registration, genome codec
+  round-trip, admissibility (GP-context-only, ValueError on junk),
+  distinct tuner plan keys, tuning-DB entry round-trip;
+- serving buckets split on the new encoding axes (``optimize``,
+  ``dispatch`` ride ``GPConfig.cache_key``).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from libpga_tpu import GPConfig, PGAConfig
+from libpga_tpu.gp import encoding as enc
+from libpga_tpu.gp import operators as gpo
+from libpga_tpu.gp.interpreter import (
+    make_eval_rows,
+    stack_predict,
+    stack_predict_program,
+)
+from libpga_tpu.gp.optimize import (
+    EvalProgram,
+    compaction_stats,
+    lit_op,
+    live_lengths,
+    optimize_for_eval,
+)
+from libpga_tpu.gp.reference import reference_predict
+from libpga_tpu.gp.sr import make_dataset, symbolic_regression
+from libpga_tpu.ops.evaluate import evaluate
+
+#: Op sets where every operation is correctly rounded by BOTH numpy
+#: and XLA CPU (IEEE +,-,*,/ and negation) — the configs where
+#: fitness-vs-oracle equality is exact, not approximate. Transcendental
+#: sets (sin/cos/exp) differ from numpy by ulps (pre-existing, both
+#: evaluator paths equally) and are covered by the opt-vs-unopt
+#: bitwise gates instead.
+ARITH = [
+    GPConfig(max_nodes=10, n_vars=2, unary=("neg",),
+             binary=("add", "sub", "mul", "div")),
+    GPConfig(max_nodes=8, n_vars=1, consts=(0.5, -2.0, 3.0),
+             unary=("neg",), binary=("add", "mul")),
+    GPConfig(max_nodes=16, n_vars=3, consts=(), unary=(),
+             binary=("add", "sub", "mul")),
+]
+FULL = GPConfig()  # default transcendental-bearing table
+
+
+def _pop(gp, n, seed=0):
+    return enc.random_population(jax.random.key(seed), n, gp)
+
+
+def _noise(gp, n, seed=0, lo=-1.5, hi=2.5):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(
+        rng.uniform(lo, hi, size=(n, gp.genome_len)).astype(np.float32)
+    )
+
+
+def _data(gp, n=24, seed=0):
+    return make_dataset(
+        lambda *xs: xs[0] * xs[-1] + xs[0],
+        n_samples=n, n_vars=gp.n_vars, seed=seed,
+    )
+
+
+def _bits(a):
+    return np.asarray(a).view(np.int32)
+
+
+def _oracle_scores(preds, ya):
+    """The numpy oracle's predictions pushed through the SAME jnp RMSE
+    expression the interpreter uses — reduction order and sanitization
+    identical, so score comparison is bit-level."""
+    err = jnp.asarray(preds) - jnp.asarray(ya)[None, :]
+    s = -jnp.sqrt(jnp.mean(err * err, axis=1))
+    return np.asarray(
+        jnp.where(jnp.isfinite(s), s, -jnp.float32(jnp.inf))
+    )
+
+
+# ----------------------------------------------------- oracle equality
+
+
+class TestOracleEquality:
+    @pytest.mark.parametrize("gp", ARITH)
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_fitness_bit_equal_oracle_well_formed(self, gp, seed):
+        X, y = _data(gp)
+        m = _pop(gp, 128, seed)
+        rows = make_eval_rows(gp, X, y, optimize=True)
+        got = np.asarray(rows(m))
+        want = _oracle_scores(
+            reference_predict(np.asarray(m), X, gp), y
+        )
+        assert np.array_equal(_bits(got), _bits(want))
+
+    @pytest.mark.parametrize("gp", ARITH)
+    @pytest.mark.parametrize("seed", [3, 4])
+    def test_fitness_bit_equal_oracle_arbitrary_noise(self, gp, seed):
+        X, y = _data(gp)
+        m = _noise(gp, 128, seed)
+        got = np.asarray(make_eval_rows(gp, X, y, optimize=True)(m))
+        want = _oracle_scores(
+            reference_predict(np.asarray(m), X, gp), y
+        )
+        assert np.array_equal(_bits(got), _bits(want))
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_opt_vs_unopt_bit_equal_full_ops(self, seed):
+        X, y = _data(FULL)
+        m = _pop(FULL, 192, seed)
+        on = np.asarray(make_eval_rows(FULL, X, y, optimize=True)(m))
+        off = np.asarray(make_eval_rows(FULL, X, y, optimize=False)(m))
+        assert np.array_equal(_bits(on), _bits(off))
+
+    @pytest.mark.parametrize("seed", [5, 6])
+    def test_opt_vs_unopt_bit_equal_noise_full_ops(self, seed):
+        X, y = _data(FULL)
+        m = _noise(FULL, 192, seed)
+        on = np.asarray(make_eval_rows(FULL, X, y, optimize=True)(m))
+        off = np.asarray(make_eval_rows(FULL, X, y, optimize=False)(m))
+        assert np.array_equal(_bits(on), _bits(off))
+
+    def test_predictions_close_to_oracle_full_ops(self):
+        X, _ = _data(FULL)
+        m = _pop(FULL, 128, 7)
+        xt = np.ascontiguousarray(np.asarray(X, np.float32).T)
+        got = np.asarray(
+            stack_predict_program(optimize_for_eval(m, FULL), xt, FULL)
+        )
+        want = reference_predict(np.asarray(m), X, FULL)
+        assert np.allclose(got, want, rtol=1e-5, atol=1e-5,
+                           equal_nan=True)
+
+    def test_constant_only_folds_to_single_lit(self):
+        gp = ARITH[1]  # consts (0.5, -2.0, 3.0)
+        g = enc.encode_program(
+            [("const", 0), ("const", 1), "add", ("const", 2), "mul"],
+            gp,
+        )
+        prog = optimize_for_eval(g[None, :], gp)
+        assert int(prog.length[0]) == 1
+        assert int(prog.ops[0, 0]) == lit_op(gp)
+        assert float(prog.args[0, 0]) == np.float32(
+            (np.float32(0.5) + np.float32(-2.0)) * np.float32(3.0)
+        )
+
+    def test_max_depth_chain_survives(self):
+        gp = ARITH[0]
+        toks = [("var", 0)]
+        while len(toks) + 2 <= gp.max_nodes:
+            toks += [("var", 1), "add"]
+        g = enc.encode_program(toks, gp)
+        X, y = _data(gp)
+        on = np.asarray(
+            make_eval_rows(gp, X, y, optimize=True)(g[None, :])
+        )
+        want = _oracle_scores(
+            reference_predict(np.asarray(g)[None, :], X, gp), y
+        )
+        assert np.array_equal(_bits(on), _bits(want))
+        # nothing folds (no consts involved): length is preserved
+        assert int(live_lengths(g[None, :], gp)[0]) == len(toks)
+
+    def test_dce_removes_buried_subtree(self):
+        # A non-strictly-well-formed gene stream that buries a value:
+        # [x0, x1, x0, add] leaves sp=2 — x0's push is never consumed
+        # and is not the final top, so DCE deletes it.
+        gp = ARITH[0]
+        g = enc.encode_program(
+            [("var", 0), ("var", 1), ("var", 0), "add"], gp,
+        )
+        prog = optimize_for_eval(g[None, :], gp)
+        assert int(prog.length[0]) == 3  # x1 x0 add
+        X, y = _data(gp)
+        on = np.asarray(
+            make_eval_rows(gp, X, y, optimize=True)(g[None, :])
+        )
+        off = np.asarray(
+            make_eval_rows(gp, X, y, optimize=False)(g[None, :])
+        )
+        assert np.array_equal(_bits(on), _bits(off))
+
+
+# -------------------------------------------------------- live lengths
+
+
+class TestLiveLengths:
+    def test_matches_structure_when_nothing_folds(self):
+        # No consts -> no fold roots; random well-formed programs are
+        # strictly well-formed (final sp == 1) -> no dead code either:
+        # post-optimization length IS the skip-rule live count.
+        gp = ARITH[2]
+        m = _pop(gp, 256, 1)
+        got = np.asarray(live_lengths(m, gp))
+        want = np.asarray(enc.program_structure(m, gp).length)
+        assert np.array_equal(got, want)
+
+    def test_never_exceeds_structure_anywhere(self):
+        for gp in ARITH + [FULL]:
+            m = _noise(gp, 128, 9)
+            after = np.asarray(live_lengths(m, gp))
+            before = np.asarray(enc.program_structure(m, gp).length)
+            assert np.all(after <= before)
+            assert np.all(after >= 0)
+
+    def test_compaction_stats_schema(self):
+        m = _pop(FULL, 64, 2)
+        st = compaction_stats(m, FULL)
+        assert st["pop"] == 64
+        assert st["max_nodes"] == FULL.max_nodes
+        assert st["mean_live_after"] <= st["mean_live_before"]
+        assert 0.0 <= st["removed_frac"] <= 1.0
+        assert st["max_live_after"] <= FULL.max_nodes
+
+    def test_eval_program_tail_is_padded(self):
+        m = _pop(FULL, 32, 3)
+        prog = optimize_for_eval(m, FULL)
+        ops = np.asarray(prog.ops)
+        ln = np.asarray(prog.length)
+        for i in range(ops.shape[0]):
+            assert np.all(ops[i, ln[i]:] == enc.PAD_OP)
+
+
+# -------------------------------------------- no recompiles across gens
+
+
+class TestNoRecompile:
+    def test_trip_bound_is_runtime_scalar(self):
+        gp = FULL
+        X, _ = _data(gp)
+        xt = np.ascontiguousarray(np.asarray(X, np.float32).T)
+
+        @jax.jit
+        def f(m):
+            return stack_predict_program(
+                optimize_for_eval(m, gp), xt, gp
+            )
+
+        f(_pop(gp, 128, 0)).block_until_ready()
+        # Different generation, different live-length distribution —
+        # short constant-only rows force a different block max.
+        short = _noise(gp, 128, 11, lo=0.0, hi=0.2)
+        f(short).block_until_ready()
+        assert f._cache_size() == 1
+
+    def test_evaluate_hook_shares_one_compile(self):
+        gp = FULL
+        X, y = _data(gp)
+        obj = symbolic_regression(X, y, gp=gp)
+        assert hasattr(obj, "prepare_eval")
+
+        @jax.jit
+        def f(m):
+            return evaluate(obj, m)
+
+        f(_pop(gp, 128, 0)).block_until_ready()
+        f(_noise(gp, 128, 12)).block_until_ready()
+        assert f._cache_size() == 1
+
+    def test_parsimony_or_optimize_off_skip_hook(self):
+        gp = FULL
+        X, y = _data(gp)
+        assert not hasattr(
+            symbolic_regression(X, y, gp=gp, parsimony=0.01),
+            "prepare_eval",
+        )
+        assert not hasattr(
+            symbolic_regression(X, y, gp=GPConfig(optimize=False)),
+            "prepare_eval",
+        )
+
+
+# ------------------------------------------------------ dispatch knob
+
+
+class TestDispatchKnob:
+    def test_domain_and_knob_registration(self):
+        from libpga_tpu.tuning import space as S
+
+        assert S.DOMAINS["gp_dispatch"] == (None, "dense", "blocked")
+        assert "gp_dispatch" in S.GP_KNOBS
+        assert S.DOMAINS["gp_dispatch"][0] is None  # AUTO first
+
+    def test_codec_round_trip(self):
+        from libpga_tpu.tuning import space as S
+
+        for i, val in enumerate(S.DOMAINS["gp_dispatch"]):
+            cfg = S.config_from_indices((0, 0, i), S.GP_KNOBS)
+            assert cfg.gp_dispatch == val
+            back = S.indices_from_config(cfg, S.GP_KNOBS)
+            assert tuple(back)[2] == i
+        # the float-gene decode is total over the new axis too
+        assert S.config_from_genes(
+            (0.0, 0.0, 0.99), S.GP_KNOBS
+        ).gp_dispatch == "blocked"
+
+    def test_admissibility(self):
+        from libpga_tpu.tuning import space as S
+
+        gp_ctx = S.SpaceContext(
+            pop=256, genome_len=32, gp_nodes=16, gp_samples=48,
+            crossover_kind="gp", mutate_kind="gp",
+        )
+        vec_ctx = S.SpaceContext(pop=256, genome_len=32)
+        ok = S.KernelConfig(gp_dispatch="blocked")
+        assert S.admissible(gp_ctx, ok)
+        why = S.why_inadmissible(vec_ctx, ok)
+        assert why is not None and "gp_dispatch" in why
+
+    def test_explicit_junk_dispatch_raises(self):
+        from libpga_tpu.ops.gp_eval import gp_eval_plan
+
+        with pytest.raises(ValueError, match="gp_dispatch"):
+            gp_eval_plan(64, FULL, 24, dispatch="simd")
+        with pytest.raises(ValueError):
+            GPConfig(dispatch="simd")
+
+    def test_plan_keys_distinguish_dispatch(self):
+        from libpga_tpu.tuning import space as S
+        from libpga_tpu.tuning import tuner as T
+
+        ctx = S.SpaceContext(
+            pop=256, genome_len=32, gp_nodes=16, gp_samples=48,
+            crossover_kind="gp", mutate_kind="gp",
+        )
+        dense = T._plan_key(ctx, S.KernelConfig(gp_dispatch="dense"),
+                            False)
+        blocked = T._plan_key(
+            ctx, S.KernelConfig(gp_dispatch="blocked"), False
+        )
+        assert dense != blocked
+        assert T._canonical_knobs(blocked)["gp_dispatch"] == "blocked"
+
+    def test_db_entry_round_trips_dispatch(self, tmp_path):
+        from libpga_tpu.tuning import db as D
+
+        key = D.TuningKey(
+            pop=64, genome_len=32, dtype="float32", backend="cpu",
+            device_kind="cpu", objective="gp_sr/xyz", operators="gp+gp",
+        )
+        db = D.TuningDB()
+        db.add(D.TuningEntry(
+            key=key,
+            knobs={"gp_stack_depth": 16, "gp_opcode_block": 2,
+                   "gp_dispatch": "blocked"},
+            gens_per_sec=5.0, created=1.0,
+        ))
+        path = str(tmp_path / "t.json")
+        db.save(path)
+        got = D.TuningDB.load(path).lookup(key)
+        assert got.knobs["gp_dispatch"] == "blocked"
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_blocked_bit_equal_dense(self, seed):
+        X, _ = _data(FULL)
+        xt = np.ascontiguousarray(np.asarray(X, np.float32).T)
+        m = _pop(FULL, 128, seed)
+        dense = np.asarray(
+            stack_predict(m, xt, FULL, dispatch="dense")
+        )
+        blocked = np.asarray(
+            stack_predict(m, xt, FULL, dispatch="blocked")
+        )
+        assert np.array_equal(_bits(dense), _bits(blocked))
+
+    def test_with_knobs_carries_dispatch(self):
+        gp = GPConfig(max_nodes=16, n_vars=2)
+        X, y = _data(gp)
+        obj = symbolic_regression(X, y, gp=gp)
+        o2 = obj.with_knobs(dispatch="blocked")
+        assert o2.knob_args == (None, None, "blocked")
+        m = _pop(gp, 64, 0)
+        assert np.array_equal(
+            _bits(evaluate(o2, m)), _bits(evaluate(obj, m))
+        )
+
+
+# --------------------------------------------------- serving signatures
+
+
+class TestServingSignatures:
+    def test_buckets_split_on_optimize_and_dispatch(self):
+        from libpga_tpu.serving import BatchedRuns, RunRequest
+
+        X, y = _data(GPConfig(max_nodes=8, n_vars=2))
+        cfg = PGAConfig(use_pallas=False)
+
+        def executor(gp):
+            return BatchedRuns(
+                symbolic_regression(X, y, gp=gp), config=cfg,
+                crossover=gpo.make_subtree_crossover(gp),
+                mutate_kind=gpo.make_gp_mutate(gp),
+            )
+
+        req = RunRequest(size=64, genome_len=16, n=2, seed=0)
+        base = GPConfig(max_nodes=8, n_vars=2)
+        sig = executor(base).signature(req)
+        sig_off = executor(
+            GPConfig(max_nodes=8, n_vars=2, optimize=False)
+        ).signature(req)
+        sig_blk = executor(
+            GPConfig(max_nodes=8, n_vars=2, dispatch="blocked")
+        ).signature(req)
+        assert sig != sig_off
+        assert sig != sig_blk
+        assert sig_off != sig_blk
+
+
+# ------------------------------------------------- fused-kernel parity
+
+
+class TestFusedParity:
+    def test_fused_optimize_paths_bit_equal(self):
+        from libpga_tpu.ops.gp_eval import make_gp_eval
+        from jax.experimental.pallas import tpu as pltpu
+
+        gp = FULL
+        X, y = _data(gp, n=32)
+        m = _pop(gp, 64, 0)
+        with pltpu.force_tpu_interpret_mode():
+            off = make_gp_eval(
+                GPConfig(optimize=False), X, y, pop=64
+            )(m)
+            on = make_gp_eval(gp, X, y, pop=64)(m)
+            prog_in = make_gp_eval(gp, X, y, pop=64)(
+                optimize_for_eval(m, gp)
+            )
+            blk = make_gp_eval(gp, X, y, pop=64, dispatch="blocked")(m)
+        assert np.array_equal(_bits(on), _bits(off))
+        assert np.array_equal(_bits(prog_in), _bits(on))
+        assert np.array_equal(_bits(blk), _bits(on))
+
+    def test_plan_carries_dispatch_and_optimize(self):
+        from libpga_tpu.ops.gp_eval import gp_eval_plan
+
+        plan = gp_eval_plan(256, FULL, 64)
+        assert plan["dispatch"] == "dense"
+        assert plan["optimize"] is True
+        plan2 = gp_eval_plan(
+            256, GPConfig(optimize=False), 64, dispatch="blocked"
+        )
+        assert plan2["dispatch"] == "blocked"
+        assert plan2["optimize"] is False
+
+    def test_plan_cost_prices_live_length(self):
+        from libpga_tpu.ops.gp_eval import gp_eval_plan, gp_plan_cost
+
+        plan = gp_eval_plan(256, FULL, 64)
+        full = gp_plan_cost(plan, 256, FULL, 64)
+        live = gp_plan_cost(plan, 256, FULL, 64, live_length=6.0)
+        assert live["flops_per_eval"] < full["flops_per_eval"]
+        assert live["tokens_per_program"] == 6.0
+        # legacy configs ignore live_length (they run the full cap)
+        plan_off = gp_eval_plan(256, GPConfig(optimize=False), 64)
+        off = gp_plan_cost(
+            plan_off, 256, GPConfig(optimize=False), 64,
+            live_length=6.0,
+        )
+        assert off["tokens_per_program"] == float(FULL.max_nodes)

@@ -78,7 +78,10 @@ def test_breed_report_mfu_matches_historical_artifact():
 def test_gp_report_hand_computed():
     """GP-eval FLOPs from the dense mask-only lattice: per (genome,
     sample, node) the evaluator does 3 stack passes x 2 ops (6*S) plus
-    2 ops per op-family candidate plane (2*n_ops)."""
+    2 ops per op-family candidate plane — n_ops planes, plus the LIT
+    plane when the eval-time optimizer is on (the GPConfig default).
+    Without a measured live length the model charges the full
+    max_nodes trip."""
     from libpga_tpu.gp.encoding import GPConfig
 
     gp = GPConfig(max_nodes=64)
@@ -92,9 +95,24 @@ def test_gp_report_hand_computed():
     # identically for both report kinds.
     B = r["batch_lanes"]
     assert B == 128
+    assert r["tokens_per_program"] == gp.max_nodes
     assert r["flops_per_gen"] == gp.max_nodes * P * B * (
-        6 * S + 2 * gp.n_ops)
+        6 * S + 2 * (gp.n_ops + 1))
     assert r["report"] == "gp_eval" and r["roofline_gens_per_sec"] > 0
+
+    # The optimizer-off twin prices the legacy lattice exactly as
+    # before — no LIT plane, full-cap trip.
+    gp_off = GPConfig(max_nodes=64, optimize=False)
+    r_off = perf.gp_report(P, gp_off, 64)
+    assert r_off["flops_per_gen"] == gp.max_nodes * P * B * (
+        6 * S + 2 * gp.n_ops)
+
+    # A measured mean live length shrinks the charged trip count —
+    # the roofline stays honest for the compacted fast path.
+    r_live = perf.gp_report(P, gp, 64, live_length=16.0)
+    assert r_live["tokens_per_program"] == 16.0
+    assert r_live["flops_per_gen"] == int(round(16.0 * P * B * (
+        6 * S + 2 * (gp.n_ops + 1))))
 
 
 def test_breed_report_xla_fallback_has_no_roofline():

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """CI smoke for the GP subsystem (ISSUE 11) — tools/ci.sh stage 12.
 
-Four gates, all CPU (no chip needed):
+Five gates, all CPU (no chip needed):
 
 1. well-formedness machinery: random-grown programs are strictly
    well-formed and the structural operators preserve that for a
@@ -11,13 +11,26 @@ Four gates, all CPU (no chip needed):
    (interpret mode off-TPU) scores a population within float tolerance
    of the XLA interpreter, at the default AND a non-default
    (gp_stack_depth, gp_opcode_block) plan;
-3. deterministic exact recovery: a seed-pinned symbolic-regression run
-   evolves the known target expression ``x0*x0 + x1`` to EXACT zero
-   RMSE, and a second identical run reproduces the best genome
-   BIT-IDENTICALLY (same generation count, same bytes, same decoded
-   expression);
-4. the ``gp_run`` event kind is emitted once per GP run and validates
-   against the versioned EVENT_FIELDS schema.
+3. the eval-time optimizer (ISSUE 19): ``GPConfig(optimize=False)``
+   lowers StableHLO BYTE-IDENTICAL (``analysis.fingerprint``) to the
+   bare pre-optimizer evaluation pipeline — the escape hatch really is
+   the old program — while optimizer-on scores a random population
+   bit-equal to optimizer-off (fold/DCE/compact change the work, never
+   the answer); prints the compaction-stats line;
+4. deterministic exact recovery: a seed-pinned symbolic-regression run
+   (optimizer ON, the default) evolves the known target expression
+   ``x0*x0 + x1`` to EXACT zero RMSE, a second identical run
+   reproduces the best genome BIT-IDENTICALLY (same generation count,
+   same bytes, same decoded expression), and an optimizer-OFF twin
+   also reaches exact zero. The twin's trajectory is NOT required to
+   be bit-identical: XLA re-emits the sample-axis RMSE reduce
+   per enclosing program (the unoptimized path already differs
+   eager-vs-jit by 1 ulp), so cross-program equality is gate 3's
+   same-context bit-equality, while THIS gate proves the outcome —
+   both evaluators drive evolution to the same exact solution;
+5. the ``gp_run`` event kind is emitted once per GP run and validates
+   against the versioned EVENT_FIELDS schema (now carrying the
+   optimize/dispatch provenance).
 
 Exits nonzero on the first failing gate.
 """
@@ -90,18 +103,66 @@ def main() -> int:
                 )
     print("gp smoke: fused-vs-XLA evaluator agreement OK (2 plans)")
 
-    # -- gates 3+4: deterministic exact recovery + gp_run schema
-    def solve():
+    # -- gate 3: optimizer byte-identity + bit-equality (ISSUE 19)
+    import jax.numpy as jnp
+
+    from libpga_tpu.analysis.ir_audit import fingerprint
+    from libpga_tpu.gp.interpreter import stack_predict
+    from libpga_tpu.gp.optimize import compaction_stats
+
+    gp_off = GPConfig(
+        max_nodes=8, n_vars=2, consts=(1.0, 2.0), unary=("neg",),
+        binary=("add", "sub", "mul"), optimize=False,
+    )
+    xt = np.ascontiguousarray(np.asarray(X, np.float32).T)
+    ya = np.asarray(y, np.float32).reshape(-1)
+
+    def legacy_rows(m):
+        # The pre-optimizer evaluation pipeline, verbatim: dense
+        # stack_predict + RMSE + sanitize. optimize=False must lower
+        # to EXACTLY this program or the escape hatch has drifted.
+        preds = stack_predict(m, xt, gp_off)
+        err = preds - ya[None, :]
+        score = -jnp.sqrt(jnp.mean(err * err, axis=1))
+        return jnp.where(jnp.isfinite(score), score, -jnp.inf).astype(
+            jnp.float32
+        )
+
+    shape = jax.ShapeDtypeStruct((128, gp.genome_len), jnp.float32)
+    fp_off = fingerprint(make_eval_rows(gp_off, X, y), shape)
+    fp_legacy = fingerprint(legacy_rows, shape)
+    if fp_off != fp_legacy:
+        return fail(
+            f"GPConfig(optimize=False) is not byte-identical to the "
+            f"pre-optimizer pipeline ({fp_off[:12]} != {fp_legacy[:12]})"
+        )
+    s_on = np.asarray(make_eval_rows(gp, X, y)(pop))
+    s_off = np.asarray(make_eval_rows(gp_off, X, y)(pop))
+    if not np.array_equal(
+        s_on.view(np.int32), s_off.view(np.int32)
+    ):
+        return fail("optimizer-on scores are not bit-equal to off")
+    st = compaction_stats(pop, gp)
+    print(
+        f"gp smoke: optimizer byte-identity + bit-equality OK "
+        f"(fingerprint {fp_off[:12]}); compaction: mean live "
+        f"{st['mean_live_before']:.2f} -> {st['mean_live_after']:.2f} "
+        f"({st['removed_frac']:.0%} removed, max {st['max_live_after']}"
+        f"/{st['max_nodes']})"
+    )
+
+    # -- gates 4+5: deterministic exact recovery + gp_run schema
+    def solve(gp_cfg=gp):
         path = tempfile.mktemp(suffix=".jsonl", prefix="pga-gp-smoke-")
         pga = PGA(seed=0, config=PGAConfig(
             use_pallas=False, selection="truncation", elitism=2,
             telemetry=TelemetryConfig(history_gens=16, events_path=path),
         ))
-        pga.set_objective(symbolic_regression(X, y, gp=gp))
-        pga.set_crossover(gpo.make_subtree_crossover(gp))
-        pga.set_mutate(gpo.make_gp_mutate(gp, 0.4, 0.6))
+        pga.set_objective(symbolic_regression(X, y, gp=gp_cfg))
+        pga.set_crossover(gpo.make_subtree_crossover(gp_cfg))
+        pga.set_mutate(gpo.make_gp_mutate(gp_cfg, 0.4, 0.6))
         h = pga.install_population(
-            enc.random_population(jax.random.key(0), 64, gp)
+            enc.random_population(jax.random.key(0), 128, gp_cfg)
         )
         gens = pga.run(80, target=0.0)
         best, score = pga.get_best_with_score(h)
@@ -122,9 +183,16 @@ def main() -> int:
         )
     if enc.decode_expression(best2, gp) != expr:
         return fail("decoded expressions diverge across identical runs")
+    gens3, best3, s3, _ = solve(gp_off)
+    if not (gens3 < 80 and s3 == np.float32(0.0)):
+        return fail(
+            f"optimizer-off twin failed to recover the target exactly "
+            f"(gens={gens3}, score={s3})"
+        )
     print(
         f"gp smoke: deterministic exact recovery OK "
-        f"({gens1} generations, best = {expr})"
+        f"({gens1} generations, best = {expr}; optimizer-off twin "
+        f"exact in {gens3})"
     )
 
     records = telemetry.validate_log(path1)  # raises on schema breaks

@@ -28,6 +28,13 @@ cross-process floor, same ``PERF_HISTORY.json`` DB under
 silently degrading to polling) now trips ci even when the compute
 kernels are unchanged.
 
+ISSUE 19 adds a third: the GP EVALUATOR gate — optimizer-ON symbolic
+regression at the BENCH_r13 shape ({GP_GATE_POP}x{GP_GATE_NODES}
+tokens, {GP_GATE_SAMPLES}-sample fitness) in gens/sec under
+``arm="gp_gate"``. The eval-time fold/DCE/compact fast path
+(gp/optimize.py) bought a >1.5x whole-generation win; this arm is the
+trip wire that keeps it bought.
+
 ``--selftest`` proves the trip wire end to end in a temp dir: measure a
 clean baseline, re-measure with an injected work-proportional slowdown
 (``FaultPlan(site="bench.measure", kind="slow")`` — per-generation
@@ -63,6 +70,15 @@ FLEET_GATE_WORKERS = 2
 FLEET_GATE_REQS = 4
 FLEET_GATE_ROUNDS = 3
 
+# GP evaluator arm (ISSUE 19): the optimizer-ON symbolic-regression
+# workload at the BENCH_r13 shape — a regression here means the
+# eval-time fold/DCE/compact fast path (gp/optimize.py) or the
+# live-length-bounded interpreter lost its win.
+GP_GATE_METRIC = "gp_gate_gens_per_sec"
+GP_GATE_POP, GP_GATE_NODES, GP_GATE_SAMPLES = 1024, 16, 64
+GP_GATE_ROUNDS = 3
+GP_LO, GP_HI = 5, 15  # GP generations are ~100x heavier than OneMax's
+
 
 def _runner():
     """The fixed gate workload: OneMax 2048x64 on the XLA path (the
@@ -81,6 +97,42 @@ def _measure(run, rounds: int = GATE_ROUNDS):
     import bench
 
     return [bench._sample_gps(run, LO, HI) for _ in range(rounds)]
+
+
+def _gp_runner():
+    """The GP gate workload: optimizer-ON (the default) symbolic
+    regression at the BENCH_r13 shape, XLA interpreter path — the
+    fast path this gate exists to protect."""
+    import jax
+
+    from libpga_tpu import PGA, PGAConfig
+    from libpga_tpu.gp import encoding as genc
+    from libpga_tpu.gp import operators as gpo
+    from libpga_tpu.gp.sr import make_dataset, symbolic_regression
+
+    gp = genc.GPConfig(max_nodes=GP_GATE_NODES, n_vars=2)
+    X, y = make_dataset(
+        lambda a, b: a * b + a, n_samples=GP_GATE_SAMPLES, n_vars=2,
+        seed=0,
+    )
+    pga = PGA(seed=7, config=PGAConfig(
+        use_pallas=False, selection="truncation", elitism=2,
+    ))
+    pga.set_objective(symbolic_regression(X, y, gp=gp))
+    pga.set_crossover(gpo.make_subtree_crossover(gp))
+    pga.set_mutate(gpo.make_gp_mutate(gp))
+    pga.install_population(
+        genc.random_population(jax.random.key(7), GP_GATE_POP, gp)
+    )
+    pga.run(3)  # compile + warm
+    return lambda n: pga.run(n)
+
+
+def _gp_measure(rounds: int = GP_GATE_ROUNDS):
+    import bench
+
+    run = _gp_runner()
+    return [bench._sample_gps(run, GP_LO, GP_HI) for _ in range(rounds)]
 
 
 def _gate_key(arm: str = "gate", shape: str = None):
@@ -203,6 +255,12 @@ def run_gate(db_path: str, record: bool) -> int:
         (
             _gate_key("fleet_gate", f"{FLEET_GATE_POP}x{FLEET_GATE_LEN}"),
             FLEET_GATE_METRIC, _fleet_measure(), "fleet_gate ring=on",
+        ),
+        (
+            _gate_key(
+                "gp_gate", f"{GP_GATE_POP}x{GP_GATE_NODES}nodes"
+            ),
+            GP_GATE_METRIC, _gp_measure(), "gp_gate optimize=on",
         ),
     ]
 
