@@ -1039,6 +1039,38 @@ def fleet_arm(rounds: int = ROUNDS) -> dict:
         time.sleep(0.05)
     autoscale_settle_s = time.perf_counter() - t0
     az.close()
+
+    # Coordinator-failover settle (ISSUE 20): the submit blackout — two
+    # HA candidates on one spool, the leader's heartbeats stop cold
+    # (the in-process SIGKILL analog), and the clock runs until the
+    # standby holds the lease and schedules. Lease-timeout dominated,
+    # so the figure is stable on a contended host.
+    ha_fc = dict(
+        n_workers=1, max_batch=1, max_wait_ms=2, poll_s=0.05,
+        lease_timeout_s=1.5, heartbeat_s=0.3, ring=False,
+        coordinators=2,
+    )
+    ha_a = Fleet(
+        os.path.join(root, "ha_a"), "onemax", config=cfg,
+        fleet=FleetConfig(**ha_fc), registry=_metrics.MetricsRegistry(),
+    )
+    ha_b = Fleet(
+        os.path.join(root, "ha_a"), "onemax", config=cfg,
+        fleet=FleetConfig(**ha_fc), registry=_metrics.MetricsRegistry(),
+    )
+    ha_a._ensure_monitor()  # heartbeats without a worker pool
+    ha_b.start()            # standby: election watch only
+    time.sleep(2 * ha_fc["heartbeat_s"])
+    t0 = time.perf_counter()
+    ha_a._stop_monitor.set()
+    ha_a._wake.set()
+    if ha_a._monitor is not None:
+        ha_a._monitor.join(timeout=30)
+    while time.perf_counter() - t0 < 120 and not ha_b.is_leader:
+        time.sleep(0.01)
+    failover_settle_s = time.perf_counter() - t0
+    ha_a._closed = True
+    ha_b.close()
     shutil.rmtree(root, ignore_errors=True)
 
     arm_stats = {name: _median_iqr(xs) for name, xs in samples.items()}
@@ -1111,6 +1143,8 @@ def fleet_arm(rounds: int = ROUNDS) -> dict:
         ),
         "fleet_autoscale_settle_s": round(autoscale_settle_s, 3),
         "fleet_autoscale_peak_workers": az_peak,
+        # ISSUE 20: the coordinator-failover submit blackout.
+        "fleet_failover_settle_s": round(failover_settle_s, 3),
         "fleet_note": (
             "runs/sec of whole fleet round trips (submit -> spool "
             "batch -> worker mega-run -> published result) at 1/4/8 "
@@ -1150,7 +1184,12 @@ def fleet_arm(rounds: int = ROUNDS) -> dict:
             "fleet_autoscale_settle_s is the wall seconds an "
             "autoscaled fleet takes to drain from its burst peak "
             "(fleet_autoscale_peak_workers) back to the 1-worker "
-            "floor after the last result"
+            "floor after the last result. fleet_failover_settle_s "
+            "(ISSUE 20) is the coordinator-HA submit blackout: wall "
+            "seconds from the moment a live leader's heartbeats stop "
+            "until a hot standby holds the lease and leads — lease-"
+            "timeout dominated (1.5 s here), so the figure reads the "
+            "election + journal-replay machinery, not host load"
         ),
     }
     for w in FLEET_WIDTHS:

@@ -368,11 +368,16 @@ long pga_program_report_snapshot(pga_t *p, population_t *pop, char *buf,
 
 int pga_fleet_start(const char *spool_dir, const char *objective,
                     unsigned n_workers, unsigned max_batch,
-                    float max_wait_ms, int ring) {
+                    float max_wait_ms, int ring, unsigned coordinators) {
     if (!spool_dir || !objective) return -1;
     return static_cast<int>(call_long(
-        "fleet_start", "(ssIIfi)", spool_dir, objective, n_workers,
-        max_batch, static_cast<double>(max_wait_ms), ring));
+        "fleet_start", "(ssIIfiI)", spool_dir, objective, n_workers,
+        max_batch, static_cast<double>(max_wait_ms), ring, coordinators));
+}
+
+long pga_fleet_leader_snapshot(char *buf, unsigned long cap) {
+    return snapshot_out(call("fleet_leader_snapshot_json", "(k)", cap),
+                        buf, cap);
 }
 
 pga_fleet_ticket_t *pga_fleet_submit(unsigned size, unsigned genome_len,

@@ -430,7 +430,23 @@ long pga_metrics_snapshot(char *buf, unsigned long cap);
  * polling cadence to microseconds. The spool stays the sole source of
  * truth — a corrupt, stale, or absent ring degrades the fleet back to
  * pure-spool polling with identical results. 0 = pure-spool (the
- * pre-ring behavior, bit-for-bit). Returns 0/-1.
+ * pre-ring behavior, bit-for-bit). `coordinators` is the candidate
+ * count sharing the spool (ISSUE 20): 1 (the pre-HA behavior,
+ * byte-for-byte spool compatible) runs this process as the sole
+ * coordinator; > 1 joins the spool's leader election — intake moves
+ * to the durable spool journal, every leader-authored artifact is
+ * tagged with the election epoch (lower-epoch writes from a deposed
+ * leader are fenced), and a standby coordinator process (spawn via
+ * `python -m libpga_tpu.serving.coordinator`) takes over a dead
+ * leader's work losslessly. Returns 0/-1.
+ *
+ * pga_fleet_leader_snapshot writes the spool's leadership block
+ * (leader pid + liveness, election epoch, lease age, standby count,
+ * last-failover timestamp; `enabled` false under coordinators=1) as a
+ * UTF-8 JSON document into buf (NUL-terminated, truncated at cap).
+ * Same size-query + retry-once contract as pga_metrics_snapshot:
+ * returns the full length excluding the NUL, negative on error or
+ * when no fleet is running.
  *
  * pga_fleet_submit admits one run (a fresh size x genome_len population
  * from `seed`, `n` generations); `checkpoint_every` > 0 makes the
@@ -491,7 +507,8 @@ long pga_metrics_snapshot(char *buf, unsigned long cap);
 typedef struct pga_fleet_ticket pga_fleet_ticket_t;
 int pga_fleet_start(const char *spool_dir, const char *objective,
                     unsigned n_workers, unsigned max_batch,
-                    float max_wait_ms, int ring);
+                    float max_wait_ms, int ring, unsigned coordinators);
+long pga_fleet_leader_snapshot(char *buf, unsigned long cap);
 pga_fleet_ticket_t *pga_fleet_submit(unsigned size, unsigned genome_len,
                                      unsigned n, long seed,
                                      unsigned checkpoint_every,

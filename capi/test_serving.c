@@ -183,8 +183,33 @@ int main(void) {
         char spool[] = "/tmp/pga-fleet-capi-XXXXXX";
         if (!mkdtemp(spool))
             return fprintf(stderr, "mkdtemp failed\n"), 1;
-        if (pga_fleet_start(spool, "onemax", 2, 2, 5.0f, 1) != 0)
+        if (pga_fleet_start(spool, "onemax", 2, 2, 5.0f, 1, 1) != 0)
             return fprintf(stderr, "pga_fleet_start failed\n"), 1;
+        /* Leadership snapshot (ISSUE 20), size query then a real
+         * read: under coordinators=1 the HA machinery must stay cold
+         * — the block reports enabled:false and the spool keeps the
+         * pre-HA byte format. */
+        {
+            long lneed = pga_fleet_leader_snapshot(NULL, 0);
+            if (lneed <= 0)
+                return fprintf(stderr, "leader snapshot size %ld\n", lneed),
+                       1;
+            unsigned long lcap = (unsigned long)lneed + 4096;
+            char *ljson = (char *)malloc(lcap);
+            if (!ljson) return fprintf(stderr, "malloc failed\n"), 1;
+            long lgot = pga_fleet_leader_snapshot(ljson, lcap);
+            if (lgot <= 0 || (unsigned long)lgot >= lcap)
+                return fprintf(stderr, "leader snapshot read %ld (cap %lu)\n",
+                               lgot, lcap),
+                       1;
+            if (!strstr(ljson, "\"enabled\"") || !strstr(ljson, "false"))
+                return fprintf(stderr,
+                               "leader snapshot not disabled under a "
+                               "single coordinator: %s\n",
+                               ljson),
+                       1;
+            free(ljson);
+        }
         /* Two tenants through the fleet (ISSUE 14): the ids ride the
          * batch files to the workers and back in the result metas, so
          * the merged snapshot below must carry both tenant slices. */

@@ -1142,14 +1142,18 @@ _next_fleet_ticket = 1
 
 def fleet_start(
     spool_dir: str, objective: str, n_workers: int, max_batch: int,
-    max_wait_ms: float, ring: int = 1,
+    max_wait_ms: float, ring: int = 1, coordinators: int = 1,
 ) -> int:
     """``pga_fleet_start``: create (or replace) the process-global
     cross-process serving fleet (``serving/fleet.py``) on ``spool_dir``
     and spawn ``n_workers`` worker processes. Replacing an existing
     fleet closes it first (drain + monitor stop). ``ring`` != 0 enables
     the shared-memory ticket ring fast path (ISSUE 18); 0 forces
-    pure-spool polling coordination (identical results either way)."""
+    pure-spool polling coordination (identical results either way).
+    ``coordinators`` > 1 joins the spool's leader election (ISSUE 20):
+    this process becomes a candidate — leader or hot standby — with
+    journaled intake and epoch-fenced failover; 1 keeps the pre-HA
+    single-coordinator spool format byte-for-byte."""
     global _fleet
     from libpga_tpu.config import FleetConfig
     from libpga_tpu.serving.fleet import Fleet
@@ -1162,6 +1166,7 @@ def fleet_start(
         fleet=FleetConfig(
             n_workers=int(n_workers), max_batch=int(max_batch),
             max_wait_ms=float(max_wait_ms), ring=bool(ring),
+            coordinators=max(int(coordinators), 1),
         ),
     )
     _fleet.start()
@@ -1266,6 +1271,29 @@ def fleet_metrics_snapshot_json(cap: int = 0) -> bytes:
         ).encode("utf-8"),
         cap,
     )
+
+
+def fleet_leader_snapshot_json(cap: int = 0) -> bytes:
+    """``pga_fleet_leader_snapshot``: the spool's leadership block
+    (``serving.ha.leadership_snapshot`` — leader pid/liveness, election
+    epoch, lease age, standby count, last-failover timestamp;
+    ``{"enabled": false}`` under ``coordinators=1``) as UTF-8 JSON.
+    ``cap`` is the caller's buffer capacity (retry-once contract, see
+    :func:`_sized_snapshot`)."""
+    import json
+
+    from libpga_tpu.serving import ha as _ha
+    from libpga_tpu.serving.fleet import load_spool_metrics
+
+    if _fleet is None:
+        raise ValueError("no fleet: call pga_fleet_start first")
+
+    def render() -> bytes:
+        payloads, _skipped = load_spool_metrics(_fleet.spool)
+        snap = _ha.leadership_snapshot(_fleet.spool, payloads)
+        return json.dumps(snap, default=str).encode("utf-8")
+
+    return _sized_snapshot("fleet_leader", render, cap)
 
 
 def fleet_drain() -> int:

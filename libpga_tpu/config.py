@@ -521,6 +521,15 @@ class FleetConfig:
         spool and the coordinator reconciles its advertised depth at
         least this often, so a wedged or SIGKILL'd peer can never
         stall the fleet behind a quiet ring.
+      coordinators: how many coordinator processes share this spool
+        (ISSUE 20). 1 (default) is the round-23 single-coordinator
+        fleet, byte-for-byte: no leader lease, no epoch stamps, no
+        intake journal on the spool. >1 turns on coordinator HA —
+        candidates elect a leader through a spool-resident lease
+        (same ``lease_timeout_s``/``heartbeat_s`` discipline as worker
+        batch leases), every leader-authored durable artifact carries
+        the election epoch, and standbys journal submissions durably
+        so a takeover rebuilds the fair backlog from the spool alone.
     """
 
     n_workers: int = 2
@@ -545,6 +554,7 @@ class FleetConfig:
     poll_idle_max_s: float = 1.0
     ring: bool = True
     ring_fallback_s: float = 1.0
+    coordinators: int = 1
 
     def __post_init__(self):
         if self.n_workers < 1:
@@ -597,6 +607,8 @@ class FleetConfig:
             raise ValueError("poll_idle_max_s must be >= poll_s")
         if self.ring_fallback_s <= 0:
             raise ValueError("ring_fallback_s must be > 0")
+        if self.coordinators < 1:
+            raise ValueError("coordinators must be >= 1")
 
 
 @dataclasses.dataclass(frozen=True)

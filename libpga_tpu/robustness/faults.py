@@ -91,6 +91,21 @@ SITES = (
     # waiters must fall back to their bounded plain poll; a "slow"
     # plan delays wakeups without breaking them
     "ring.wake",
+    # HA coordinator (serving/ha.py + serving/fleet.py, ISSUE 20):
+    # "coordinator.monitor" fires once per leader monitor tick (before
+    # any scan work) — a "raise" plan kills the monitor thread, the
+    # injected analog of a wedged leader whose lease goes stale;
+    # "coordinator.elect" fires on every leader-lease acquisition
+    # attempt (first-boot election, standby retry, stale-lease
+    # takeover) — a "raise" plan makes this candidate lose the round
+    # and retry, so elections are failure-injectable;
+    # "coordinator.journal" fires on every durable intake-journal
+    # operation (ticket-file write, admission-log append, replay scan)
+    # — a "raise" plan propagates through the submit/replay machinery
+    # exactly like a full disk or torn spool would
+    "coordinator.monitor",
+    "coordinator.elect",
+    "coordinator.journal",
 )
 
 _KINDS = ("raise", "nan", "slow")

@@ -1,0 +1,106 @@
+"""Standalone fleet-coordinator process (ISSUE 20).
+
+Runs ONE coordinator candidate against an existing spool until
+SIGTERM. With ``FleetConfig.coordinators > 1`` the process joins the
+spool's leader election: exactly one candidate holds the leader lease
+and schedules work; the rest stand by, watch the lease, and take over
+(bumping the epoch) when it goes stale. Intake arrives through the
+durable spool journal (``serving/ha.py``; submit from any process via
+``SpoolClient``), so a failover loses nothing — the new leader
+rebuilds scheduler state, tenant quota debts, and in-flight leases
+from the spool alone.
+
+Used by ``tools/ha_smoke.py`` and the failover chaos matrix; the same
+env transports as the worker apply (``PGA_FAULT_SPEC`` fault plans,
+plus the coordinator-side ``PGA_COORD_CHAOS`` kill points).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+from typing import List, Optional
+
+from libpga_tpu.config import FleetConfig, PGAConfig
+from libpga_tpu.robustness import faults as _faults
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--spool", required=True)
+    ap.add_argument("--objective", default="onemax")
+    ap.add_argument("--coordinators", type=int, default=2,
+                    help="candidate count on this spool; > 1 enables "
+                         "the leader election + intake journal")
+    ap.add_argument("--n-workers", type=int, default=2)
+    ap.add_argument("--max-batch", type=int, default=2)
+    ap.add_argument("--max-wait-ms", type=float, default=20.0)
+    ap.add_argument("--lease-timeout-s", type=float, default=3.0)
+    ap.add_argument("--heartbeat-s", type=float, default=0.5)
+    ap.add_argument("--poll-s", type=float, default=0.05)
+    ap.add_argument("--metrics-flush-s", type=float, default=1.0)
+    ap.add_argument("--ring-fallback-s", type=float, default=1.0)
+    ap.add_argument("--no-ring", action="store_true",
+                    help="pure-spool coordination (no shm ticket ring)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="enable a one-worker-headroom autoscaler "
+                         "(the chaos matrix's autoscale kill point "
+                         "needs a live scale loop)")
+    ap.add_argument("--use-pallas", action="store_true",
+                    help="engine Pallas kernels (off by default: this "
+                         "CLI is exercised on CPU CI)")
+    args = ap.parse_args(argv)
+
+    # Same env transport as the worker: install the fault plan before
+    # the Fleet constructor runs its first election attempt.
+    spec = os.environ.get("PGA_FAULT_SPEC", "")
+    if spec:
+        _faults.install_spec(spec)
+
+    from libpga_tpu.config import AutoscaleConfig
+    from libpga_tpu.serving.fleet import Fleet
+
+    autoscale = None
+    if args.autoscale:
+        autoscale = AutoscaleConfig(
+            min_workers=args.n_workers, max_workers=args.n_workers + 1,
+            target_backlog=1.0, up_cooldown_s=0.3, down_cooldown_s=0.5,
+            idle_grace_s=0.8, check_s=0.1,
+        )
+    fleet = Fleet(
+        args.spool, args.objective,
+        config=PGAConfig(use_pallas=args.use_pallas),
+        fleet=FleetConfig(
+            n_workers=args.n_workers, max_batch=args.max_batch,
+            max_wait_ms=args.max_wait_ms,
+            lease_timeout_s=args.lease_timeout_s,
+            heartbeat_s=args.heartbeat_s, poll_s=args.poll_s,
+            metrics_flush_s=args.metrics_flush_s,
+            ring=not args.no_ring, ring_fallback_s=args.ring_fallback_s,
+            coordinators=args.coordinators, autoscale=autoscale,
+        ),
+    )
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    # A standby's start() spawns nothing — the monitor watches the
+    # lease and spawns workers only on takeover.
+    fleet.start()
+    print(
+        f"coordinator pid={os.getpid()} leader={fleet.is_leader} "
+        f"epoch={fleet.epoch}",
+        flush=True,
+    )
+    try:
+        while not stop.wait(0.2):
+            pass
+    finally:
+        fleet.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -73,6 +73,20 @@ cadence; SIGTERM drains them at a chunk boundary via the supervisor's
 ``stop`` hook, and the per-process contract — a resumed run is
 bit-identical to an uninterrupted same-seed run at the same cadence —
 lifts unchanged to the fleet.
+
+**Coordinator HA (ISSUE 20).** With ``FleetConfig.coordinators > 1``
+the coordinator itself stops being a single point of failure: N
+``Fleet`` instances run against ONE spool, elect a leader through the
+spool-resident lease in ``serving/ha.py`` (first-writer-wins link +
+heartbeat + ``lease_timeout_s`` expiry — the worker-lease discipline,
+one level up), and fence every leader-authored artifact with a
+monotonically increasing election epoch. Submissions become durable in
+the intake journal BEFORE they are scheduled, so a new leader rebuilds
+the fair backlog, quota debts, and ticket bookkeeping from the spool
+alone; workers reject batch files below the fence epoch, so a
+SIGSTOP-resumed zombie leader can never make a deposed write execute.
+``coordinators=1`` (the default) takes none of these paths and keeps
+byte-for-byte spool compatibility with round-23 fleets.
 """
 
 from __future__ import annotations
@@ -91,6 +105,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from libpga_tpu.config import FleetConfig, PGAConfig, TenantPolicy
+from libpga_tpu.robustness import faults as _faults
+from libpga_tpu.serving import ha as _ha
 from libpga_tpu.serving.queue import QueueFull, TenantBurnTracker
 from libpga_tpu.serving.scheduler import (
     Autoscaler,
@@ -479,7 +495,9 @@ def fleet_status(
     workers = []
     for p in payloads:
         proc = p["proc"]
-        if proc == "coordinator":
+        # HA fleets flush coordinator snapshots under qualified names
+        # ("coordinator.<token>"), one per candidate — none are workers.
+        if proc.startswith("coordinator"):
             continue
         snap = p["snapshot"]
         exec_rec = None
@@ -565,7 +583,7 @@ def fleet_status(
         labels = rec.get("labels", {})
         if (
             rec["name"] == "fleet.tenant.slo_burn"
-            and labels.get("proc") == "coordinator"
+            and str(labels.get("proc", "")).startswith("coordinator")
         ):
             _trec(labels["tenant"])["burn"][labels.get("window", "?")] = (
                 float(rec["value"])
@@ -582,6 +600,10 @@ def fleet_status(
         "spool": spool.root,
         "ts": now_wall,
         "ring": ring,
+        # Coordinator HA (ISSUE 20): leader pid/liveness, fence epoch,
+        # lease age, standby count, last-failover timestamp — spool
+        # alone, so it works on a post-mortem of a dead fleet too.
+        "leadership": _ha.leadership_snapshot(spool, payloads),
         "queue": {
             "pending_batches": pending,
             "claimed_batches": claimed,
@@ -811,6 +833,34 @@ def _now() -> float:
     return time.monotonic()
 
 
+def _parse_coord_chaos(spec: str) -> List[tuple]:
+    """``PGA_COORD_CHAOS`` — the coordinator twin of the worker's
+    ``PGA_WORKER_CHAOS`` self-signal hook: comma-separated
+    ``<signal>@<site>:<n>`` directives make the coordinator send ITSELF
+    the real signal at its n-th arrival at a named protocol point, so
+    the HA chaos matrix (``tools/ha_smoke.py``) can kill -9 a leader at
+    exact instants. Sites: ``batch_form`` (tickets drawn from the fair
+    scheduler, batch file NOT yet durable — recovery is pure journal
+    replay), ``requeue`` (lease removed, re-release not yet durable),
+    ``ring_write`` (before a ring frame advertise — batch durable but
+    unannounced), ``autoscale`` (top of a scale evaluation). Unknown
+    entries raise — a chaos driver must never silently test nothing."""
+    sites = ("batch_form", "requeue", "ring_write", "autoscale")
+    out = []
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        try:
+            signame, rest = part.split("@", 1)
+            site, n = rest.split(":", 1)
+            if site not in sites:
+                raise ValueError(site)
+            out.append(
+                (getattr(signal, signame.upper()), site, int(n))
+            )
+        except (ValueError, AttributeError):
+            raise ValueError(f"bad PGA_COORD_CHAOS directive {part!r}")
+    return out
+
+
 # ------------------------------------------------------------- coordinator
 
 
@@ -941,15 +991,66 @@ class Fleet:
         self._ring_claims_seen = 0
         self._ring_reconcile_next = 0.0  # monotonic; 0 => reconcile now
         self._ring_slots: Dict[str, int] = {}  # wid -> bound slot index
-        if self.fleet.ring:
+        # Coordinator HA (ISSUE 20): with coordinators > 1 this
+        # instance is a CANDIDATE — it leads only while it holds the
+        # spool's leader lease, every durable artifact it authors
+        # carries its election epoch, and every submission is journaled
+        # before it is scheduled. coordinators=1 (the default) skips
+        # all of it: no coord/ or intake/ directories, no epoch field
+        # in batch files — byte-for-byte the round-23 spool.
+        self._ha_enabled = self.fleet.coordinators > 1
+        self.epoch = 0
+        self.is_leader = not self._ha_enabled
+        self.failovers = 0
+        self._lease: Optional[_ha.LeaderLease] = None
+        self._journal: Optional[_ha.IntakeJournal] = None
+        self._journal_seen: set = set()  # tids admitted to the sched
+        # Journal tids skipped at replay because a pre-failover batch
+        # already carried them. If that batch was a zombie write that
+        # lands fenced (a worker removes it), _reclaim_stranded
+        # re-admits these within half a lease timeout.
+        self._journal_inflight: set = set()
+        self._reclaim_next = 0.0  # monotonic throttle for the rescan
+        self._intake_watch: Optional[DirWatch] = None
+        self._ha_worker_env: Optional[Dict[int, dict]] = None
+        self._proc_name = "coordinator"
+        self._coord_chaos = _parse_coord_chaos(
+            os.environ.get("PGA_COORD_CHAOS", "")
+        )
+        self._coord_chaos_calls: Dict[str, int] = {}
+        if self._ha_enabled:
+            # Qualified identities: N candidates on one spool must not
+            # collide on the metrics flush file or on worker ids.
+            self._proc_name = f"coordinator.{self._token[-6:]}"
+            self._lease = _ha.LeaderLease(
+                self.spool, owner=self._token,
+                timeout_s=self.fleet.lease_timeout_s,
+            )
+            self._journal = _ha.IntakeJournal(self.spool)
+            self._intake_watch = DirWatch(self.spool.path(_ha.INTAKE_DIR))
+            try:
+                won = self._lease.try_acquire()
+            except _faults.InjectedFault:
+                won = None  # injected election loss: boot as standby
+            if won is not None:
+                self._become_leader(won, during_init=True)
+        elif self.fleet.ring:
             self._ring_create()
+        self.registry.gauge("fleet.coordinator.epoch").set(self.epoch)
+        self.registry.gauge("fleet.coordinator.is_leader").set(
+            1 if self.is_leader else 0
+        )
 
     # ----------------------------------------------------------------- ring
 
     def _ring_create(self) -> None:
         path = self.spool.path(RING_FILENAME)
         try:
-            self._ring, prior = ShmRing.create(path)
+            # The ring header carries the author's election epoch
+            # (ISSUE 20): a failover rebuilds the ring atomically under
+            # the new epoch, and status tooling can tell whose ring it
+            # is looking at.
+            self._ring, prior = ShmRing.create(path, epoch=self.epoch)
         except RingError as exc:
             self._ring_degrade(f"create: {exc}")
             return
@@ -988,6 +1089,7 @@ class Fleet:
         ring = self._ring
         if ring is None:
             return
+        self._coord_chaos_check("ring_write")
         try:
             ring.advertise("submit", name)
         except Exception as exc:
@@ -1037,6 +1139,296 @@ class Fleet:
         if self.events is not None:
             self.events.emit(event, **fields)
 
+    # ------------------------------------------------- HA roles (ISSUE 20)
+
+    def _coord_chaos_check(self, site: str) -> None:
+        """Self-signal at the n-th arrival at a protocol point (see
+        :func:`_parse_coord_chaos`) — the chaos matrix's scalpel."""
+        if not self._coord_chaos:
+            return
+        n = self._coord_chaos_calls.get(site, 0) + 1
+        self._coord_chaos_calls[site] = n
+        for sig, s, at in self._coord_chaos:
+            if s == site and at == n:
+                os.kill(os.getpid(), sig)
+
+    def _ha_tick(self) -> bool:
+        """Per-tick role management: heartbeat the lease while leading
+        (a failed heartbeat means we were SEIZED while paused — step
+        down instantly), attempt election while standing by. Returns
+        True when this instance leads after the tick."""
+        if self.is_leader:
+            if self._lease.heartbeat():
+                return True
+            # Zombie path: our lease was seized (we were SIGSTOPped or
+            # wedged past lease_timeout_s). Stop authoring NOW —
+            # anything already written below the new fence is rejected
+            # by workers; in-flight worker results stand (first-writer-
+            # wins, bit-identical to the new leader's re-run).
+            self._step_down("lease_lost")
+            return False
+        try:
+            won = self._lease.try_acquire()
+        except _faults.InjectedFault:
+            won = None  # injected election loss: retry next tick
+        if won is not None:
+            self._become_leader(won)
+            return True
+        return False
+
+    def _step_down(self, reason: str) -> None:
+        """Deposed leader → standby: drop every leader duty (schedule,
+        requeue, autoscale, metrics-of-record) but keep the monitor
+        watching our own handles — their results arrive from the new
+        leader's fleet. Our workers are NOT killed: they hold valid
+        leases and publish first-writer-wins results either way."""
+        with self._lock:
+            self.is_leader = False
+        self.registry.gauge("fleet.coordinator.is_leader").set(0)
+        self.registry.counter("fleet.coordinator.step_downs").bump()
+        self._emit(
+            "leader_fence", what=reason, epoch=self.epoch,
+            fence=self._lease.fence(),
+        )
+
+    def _become_leader(self, won: dict, during_init: bool = False) -> None:
+        """Win the fleet: fence the predecessor (the lease already
+        wrote ``coord/epoch.json`` before returning), rebuild the ring
+        under the new epoch, adopt the spool's pending work, replay the
+        intake journal, and top the worker pool back up."""
+        takeover = bool(won.get("seized")) or not during_init
+        with self._lock:
+            self.epoch = int(won["epoch"])
+            self.is_leader = True
+        self.registry.gauge("fleet.coordinator.epoch").set(self.epoch)
+        self.registry.gauge("fleet.coordinator.is_leader").set(1)
+        self._emit("leader_elect", epoch=self.epoch, takeover=takeover)
+        if self.fleet.ring:
+            # Leader-authored ring: atomic full-image replace stamps
+            # the new epoch in the header; surviving workers reattach
+            # on the inode change within ring_fallback_s.
+            self._ring_slots.clear()
+            self._ring_create()
+        adopted = self._adopt_spool()
+        with self._lock:
+            readmitted, skipped = self._replay_intake()
+        if readmitted or skipped:
+            self._emit(
+                "intake_journal_replay", epoch=self.epoch,
+                admitted=readmitted, skipped=skipped,
+            )
+        if takeover:
+            self.failovers += 1
+            self._emit(
+                "coordinator_failover", epoch=self.epoch,
+                readmitted=readmitted, adopted=adopted,
+            )
+        if self._ring is not None:
+            # Fresh ring, fresh reservations: re-advertise the adopted
+            # runway so surviving workers see it event-driven (their
+            # bounded fallback scan covers the reattach window anyway).
+            self._ring_set_depth(0)
+            for name in self.spool.pending_batches():
+                self._ring_advertise(name)
+        if not during_init and not self._closed:
+            live = self._foreign_live_workers()
+            need = max(self.fleet.n_workers - live, 0)
+            if need and not self._draining:
+                self._spawn_workers(need, worker_env=self._ha_worker_env)
+            self._ensure_scaler()
+            self._schedule(urgent=True)
+            self._wake.set()
+        with self._cv:
+            self._cv.notify_all()
+
+    def _adopt_spool(self) -> int:
+        """Re-stamp lower-epoch pending batch files to this leader's
+        epoch — in place (atomic rewrite, name unchanged, so the
+        priority name sort and worker claims are undisturbed). Claimed
+        batches are left alone: a live worker holds their lease, and
+        its results are never fenced (first-writer-wins publication is
+        epoch-free by design). A batch the zombie releases into the
+        adoption window degrades to a benign duplicate execution."""
+        adopted = 0
+        for name in self.spool.pending_batches():
+            path = self.spool.path("pending", name)
+            batch = self.spool.read_json(path)
+            if batch is None:
+                continue  # claimed under us — the worker owns it now
+            if int(batch.get("epoch", 0)) >= self.epoch:
+                continue
+            batch["epoch"] = self.epoch
+            self.spool.write_json(path, batch)
+            adopted += 1
+        return adopted
+
+    def _spooled_tids(self) -> set:
+        """Tickets already released into a pending/claimed batch file —
+        the journal entries replay must NOT re-admit."""
+        tids: set = set()
+        for dirname, names in (
+            ("pending", self.spool.pending_batches()),
+            ("claimed", self.spool.claimed_batches()),
+        ):
+            for name in names:
+                batch = self.spool.read_json(self.spool.path(dirname, name))
+                for t in () if batch is None else batch.get("tickets", ()):
+                    if t.get("tid"):
+                        tids.add(t["tid"])
+        return tids
+
+    def _replay_intake(self) -> Tuple[int, int]:
+        """Admit every live journal entry not already admitted in this
+        process (takes the reentrant lock itself — callers may already
+        hold it). Idempotent by the
+        ``_journal_seen`` set + the journal's own tid dedupe: replaying
+        twice admits each ticket exactly once. Entries whose result is
+        durable or that already ride a spooled batch are SKIPPED (the
+        readback/lease machinery owns them); foreign entries (another
+        candidate's clients, ``SpoolClient`` submitters) get a handle
+        and count into the tenant quota debts, so fairness and
+        backpressure survive the failover. Returns
+        ``(admitted, skipped)``."""
+        if self._journal is None:
+            return 0, 0
+        entries = self._journal.entries()
+        if not entries:
+            return 0, 0
+        admitted = skipped = 0
+        with self._lock:
+            spooled = self._spooled_tids()
+            for e in entries:
+                tid = e.get("tid")
+                if not tid or tid in self._journal_seen:
+                    continue
+                self._journal_seen.add(tid)
+                if tid not in self._handles:
+                    try:
+                        ticket = FleetTicket(**dict(e.get("ticket") or {}))
+                    except (TypeError, ValueError):
+                        skipped += 1
+                        continue  # unreadable foreign entry: never admit
+                    handle = FleetHandle(self, tid, ticket)
+                    if e.get("trace_id"):
+                        handle.trace_id = e["trace_id"]
+                    self._handles[tid] = handle
+                    t_id = ticket.tenant
+                    self.submitted += 1
+                    self._tenant_submitted[t_id] = (
+                        self._tenant_submitted.get(t_id, 0) + 1
+                    )
+                    self.registry.counter(
+                        "fleet.tenant.submissions", tenant=t_id
+                    ).bump()
+                if self._meta(tid) is not None:
+                    skipped += 1  # result already durable
+                    continue
+                if tid in spooled:
+                    # Riding a pre-failover batch. Track it: if that
+                    # batch turns out to be a fenced zombie write (a
+                    # worker removes it instead of serving it),
+                    # _reclaim_stranded re-admits the ticket within
+                    # half a lease timeout.
+                    self._journal_inflight.add(tid)
+                    skipped += 1
+                    continue
+                ticket = self._handles[tid].ticket
+                prio = e.get("priority")
+                if prio is None:
+                    prio = (
+                        self.sched.policy(ticket.tenant).priority
+                        if ticket.priority is None else ticket.priority
+                    )
+                self.sched.push(SchedEntry(
+                    tid=tid, ticket=ticket,
+                    bucket=self._bucket_key(ticket),
+                    tenant=ticket.tenant, priority=int(prio),
+                    admitted=_now(),
+                ))
+                admitted += 1
+        return admitted, skipped
+
+    def _scan_intake(self) -> bool:
+        """Leader-only, DirWatch-gated: admit journal entries other
+        candidates (or external ``SpoolClient`` s) made durable since
+        the last tick."""
+        if (
+            not self.is_leader or self._intake_watch is None
+            or not self._intake_watch.poll()
+        ):
+            return False
+        with self._lock:
+            admitted, skipped = self._replay_intake()
+        if admitted or skipped:
+            self._emit(
+                "intake_journal_replay", epoch=self.epoch,
+                admitted=admitted, skipped=skipped,
+            )
+        return bool(admitted)
+
+    def _reclaim_stranded(self) -> bool:
+        """Safety net for the adoption race (ISSUE 20): a zombie
+        leader's batch that lands in the window between
+        ``_adopt_spool`` and the journal replay is skipped as
+        in-flight — then a worker fences it (removes the lower-epoch
+        file), leaving its tickets with neither a batch nor a lease.
+        Re-admit every tracked in-flight tid whose batch vanished
+        without a durable result. Cheap: ``_journal_inflight`` is
+        empty except right after a takeover, and the spool rescan is
+        throttled to half the lease timeout."""
+        if not self._journal_inflight:
+            return False
+        now = time.monotonic()
+        if now < self._reclaim_next:
+            return False
+        self._reclaim_next = now + self.fleet.lease_timeout_s / 2.0
+        pushed = 0
+        with self._lock:
+            self._journal_inflight = {
+                tid for tid in self._journal_inflight
+                if tid in self._handles and self._meta(tid) is None
+            }
+            if not self._journal_inflight:
+                return False
+            spooled = self._spooled_tids()
+            for tid in sorted(self._journal_inflight - spooled):
+                ticket = self._handles[tid].ticket
+                prio = (
+                    self.sched.policy(ticket.tenant).priority
+                    if ticket.priority is None else ticket.priority
+                )
+                self.sched.push(SchedEntry(
+                    tid=tid, ticket=ticket, bucket=self._bucket_key(ticket),
+                    tenant=ticket.tenant, priority=int(prio),
+                    admitted=_now(),
+                ))
+                self._journal_inflight.discard(tid)
+                pushed += 1
+        if pushed:
+            self.registry.counter("fleet.coordinator.reclaimed").bump(pushed)
+        return bool(pushed)
+
+    def _foreign_live_workers(self) -> int:
+        """Workers of a previous leader still alive on this spool,
+        counted from their metric flushes (pid + liveness probe) — a
+        takeover tops the pool up to ``n_workers`` instead of doubling
+        it. Workers that never flushed are invisible and may be
+        double-covered: benign (extra capacity, identical bits)."""
+        try:
+            payloads, _ = load_spool_metrics(self.spool)
+        except ValueError:
+            return 0
+        with self._lock:
+            own = set(self._workers)
+        n = 0
+        for p in payloads:
+            proc = str(p.get("proc", ""))
+            if proc.startswith("coordinator") or proc in own:
+                continue
+            if _pid_alive(p.get("pid")):
+                n += 1
+        return n
+
     # -------------------------------------------------------------- workers
 
     def start(self, worker_env: Optional[Dict[int, dict]] = None) -> List[str]:
@@ -1049,6 +1441,13 @@ class Fleet:
         if self._closed:
             raise RuntimeError("fleet is closed")
         self._draining = False
+        self._ha_worker_env = worker_env
+        if self._ha_enabled and not self.is_leader:
+            # Standby (ISSUE 20): no workers, no scaler — just the
+            # monitor (election retry + own-handle completion watch).
+            # Workers spawn on takeover (_become_leader).
+            self._ensure_monitor()
+            return []
         spawned = self._spawn_workers(
             self.fleet.n_workers, worker_env=worker_env
         )
@@ -1068,6 +1467,11 @@ class Fleet:
             base = len(self._workers)
             for i in range(n):
                 wid = f"w{base + i}"
+                if self._ha_enabled:
+                    # Coordinator-qualified: two leaders' spawn groups
+                    # on one spool must never collide on a worker id
+                    # (leases, metric files, and logs all key on it).
+                    wid = f"w{base + i}.{self._token[-6:]}"
                 out = open(  # worker stdout/stderr, for post-mortems
                     self.spool.path("logs", f"{wid}.out"), "ab"
                 )
@@ -1221,11 +1625,31 @@ class Fleet:
             tid = f"t{self._tid_seq:05d}-{self._token}"
             handle = FleetHandle(self, tid, ticket)
             self._handles[tid] = handle
+            if self._ha_enabled:
+                # Durable FIRST (ISSUE 20): the journal is what a new
+                # leader replays, so nothing admitted may exist only in
+                # this process's memory. A journal failure unwinds the
+                # admission — the caller sees the error, nothing half-
+                # submitted remains.
+                try:
+                    self._journal.record(
+                        tid=tid, ticket=dataclasses.asdict(ticket),
+                        tenant=t_id, priority=prio,
+                        trace_id=handle.trace_id, epoch=self.epoch,
+                    )
+                except BaseException:
+                    self._handles.pop(tid, None)
+                    raise
             key = self._bucket_key(ticket)
-            self.sched.push(SchedEntry(
-                tid=tid, ticket=ticket, bucket=key, tenant=t_id,
-                priority=prio, admitted=_now(),
-            ))
+            if self.is_leader:
+                self._journal_seen.add(tid)
+                self.sched.push(SchedEntry(
+                    tid=tid, ticket=ticket, bucket=key, tenant=t_id,
+                    priority=prio, admitted=_now(),
+                ))
+            # else: standby — the live leader admits it from the
+            # journal (its intake watch); our handle resolves from the
+            # shared results directory like any other.
             self.submitted += 1
             if t_id not in self._tenants_seen:
                 self._tenants_seen.add(t_id)
@@ -1320,6 +1744,8 @@ class Fleet:
         admission window (a lone ticket must not wait out max_wait_ms);
         ``drain`` additionally overrides the release window. Returns
         batches formed."""
+        if self._ha_enabled and not self.is_leader:
+            return 0  # only the leader authors batch files
         formed = 0
         with self._lock:
             room = None if drain else self._pending_room()
@@ -1359,6 +1785,9 @@ class Fleet:
         prefix) so the plain name sort workers claim by serves higher
         lanes first."""
         tickets = [(e.tid, e.ticket) for e in entries]
+        # Chaos point "batch_form": tickets drawn, nothing durable yet
+        # — the hardest kill, recovered purely by journal replay.
+        self._coord_chaos_check("batch_form")
         self._batch_seq += 1
         size, genome_len, supervised = key
         name = (
@@ -1389,6 +1818,11 @@ class Fleet:
                 for tid, t in tickets
             ],
         }
+        if self._ha_enabled:
+            # Epoch fence (ISSUE 20): workers reject batches below the
+            # durable fence, so a deposed zombie's writes never
+            # execute. Non-HA batches stay byte-identical to round 23.
+            batch["epoch"] = self.epoch
         self.spool.write_json(self.spool.path("pending", name), batch)
         if self.fleet.trace:
             # The span log opens with one intake span per ticket —
@@ -1584,6 +2018,12 @@ class Fleet:
             self._monitor_wait()
             if self._stop_monitor.is_set():
                 return
+            # Fault site (robustness/faults): fires per LEADER monitor
+            # tick, OUTSIDE the recovery try below — a raise kills this
+            # thread, the injected analog of a wedged leader whose
+            # lease goes stale under it (a standby then takes over).
+            if self.is_leader and _faults.PLAN is not None:
+                _faults.PLAN.fire("coordinator.monitor")
             try:
                 self._tick()
             except Exception:
@@ -1619,6 +2059,33 @@ class Fleet:
         t0 = time.perf_counter()
         now = _now()
         active = False
+        # HA role management first (ISSUE 20): lease heartbeat while
+        # leading, election attempt while standing by. A standby runs
+        # only the half-tick below — no scheduling, no requeues, no
+        # autoscale — but keeps watching results so its own submitted
+        # handles (served by the live leader) still resolve.
+        if self._ha_enabled and not self._ha_tick():
+            if self._results_watch.poll():
+                self._scan_completions()
+            if now - self._last_flush >= self.fleet.metrics_flush_s:
+                self._last_flush = now
+                self.flush_metrics()
+            self.registry.histogram("fleet.coordinator.scan_ms").observe(
+                (time.perf_counter() - t0) * 1e3
+            )
+            # Cadence stays at most one heartbeat: the next election
+            # attempt must come before a stale lease ages further.
+            self._wait_s = (
+                self.fleet.poll_s if self._outstanding() > 0
+                else min(self._wait_s * 2.0, self._wait_cap())
+            )
+            return
+        # HA intake: admit journal entries other candidates or
+        # external SpoolClients made durable since the last tick.
+        if self._ha_enabled and self._scan_intake():
+            active = True
+        if self._ha_enabled and self._reclaim_stranded():
+            active = True
         # Ring bookkeeping first: fold the workers' claim counters into
         # the advertised pending-depth estimate and refresh the
         # coordinator-liveness stamp that stale-ring detection reads.
@@ -1672,8 +2139,16 @@ class Fleet:
         )
         self._wait_s = (
             self.fleet.poll_s if active
-            else min(self._wait_s * 2.0, self.fleet.poll_idle_max_s)
+            else min(self._wait_s * 2.0, self._wait_cap())
         )
+
+    def _wait_cap(self) -> float:
+        """Idle-backoff ceiling. HA candidates cap at the heartbeat
+        cadence: a leader that napped past ``lease_timeout_s`` would be
+        seized, and a standby must keep its election attempts timely."""
+        if self._ha_enabled:
+            return min(self.fleet.poll_idle_max_s, self.fleet.heartbeat_s)
+        return self.fleet.poll_idle_max_s
 
     def _scan_completions(self) -> bool:
         fresh = False
@@ -1686,6 +2161,11 @@ class Fleet:
                 fresh = True
                 self._counted.add(tid)
                 self.completed += 1
+                if self.is_leader and self._journal is not None:
+                    # Retire the intake journal file: the result is
+                    # the durable record now (the admission-log line
+                    # stays — it carries order, not state).
+                    self._journal.retire(tid)
                 self.registry.counter("fleet.tickets.completed").bump()
                 tenant = self._handles[tid].ticket.tenant
                 fresh_tenants.add(tenant)
@@ -1878,6 +2358,9 @@ class Fleet:
         spawn on scale-up, SIGTERM-drain (never kill) on scale-down."""
         if self._draining or self._closed or self.autoscaler is None:
             return
+        if self._ha_enabled and not self.is_leader:
+            return  # deposed mid-cycle: scaling is a leader duty
+        self._coord_chaos_check("autoscale")
         cfg = self.fleet.autoscale
         # Retiring workers (SIGTERM sent, drain in progress) are no
         # longer capacity: counting them would let the policy retire a
@@ -1988,9 +2471,16 @@ class Fleet:
         except OSError:
             pass
         self._hb_seen.pop(name, None)
+        # Chaos point "requeue": lease gone, re-release not yet durable
+        # — the new leader's lease scan ages the claimed file itself.
+        self._coord_chaos_check("requeue")
         attempts = list(batch.get("attempts", []))
         attempts.append(worker)
         batch["attempts"] = attempts
+        if self._ha_enabled:
+            # The requeued file is a fresh leader-authored artifact:
+            # re-stamp it so it clears the current fence.
+            batch["epoch"] = self.epoch
         distinct = len(set(attempts))
         unfinished = [
             t for t in batch["tickets"] if self._meta(t["tid"]) is None
@@ -2093,8 +2583,10 @@ class Fleet:
         fleet-level series survive the coordinator for post-mortems."""
         try:
             write_metrics_file(
-                self.spool, "coordinator", self.registry.snapshot(),
+                self.spool, self._proc_name, self.registry.snapshot(),
                 submitted=self.submitted, completed=self.completed,
+                role="leader" if self.is_leader else "standby",
+                epoch=self.epoch,
             )
         except OSError:
             pass  # a full disk must not take down the monitor
@@ -2105,7 +2597,7 @@ class Fleet:
         associative histogram merge, per-process labels on every
         series (``metrics.merge_snapshots``)."""
         return merge_spool_metrics(
-            self.spool, live={"coordinator": self.registry.snapshot()}
+            self.spool, live={self._proc_name: self.registry.snapshot()}
         )
 
     def merged_prometheus(self) -> str:
@@ -2129,7 +2621,7 @@ class Fleet:
             raise  # mixed-version fleet: fail loudly, not silently
         stats: List[Tuple[str, float]] = []
         for p in payloads:
-            if p["proc"] == "coordinator":
+            if p["proc"].startswith("coordinator"):
                 continue
             for rec in p["snapshot"].get("histograms", ()):
                 if (
@@ -2223,10 +2715,15 @@ class Fleet:
         spools whose coordinator is gone."""
         st = fleet_status(
             self.spool.root,
-            live={"coordinator": self.registry.snapshot()},
+            live={self._proc_name: self.registry.snapshot()},
         )
         st["coordinator"] = {
             "pid": os.getpid(),
+            # Coordinator HA (ISSUE 20): this instance's role + epoch.
+            "coordinators": self.fleet.coordinators,
+            "is_leader": self.is_leader,
+            "epoch": self.epoch,
+            "failovers": self.failovers,
             "workers_alive": self.workers_alive(),
             "submitted": self.submitted,
             "completed": self.completed,
@@ -2305,6 +2802,10 @@ class Fleet:
         self._wake.set()  # snap the monitor out of an idle backoff wait
         if self._monitor is not None:
             self._monitor.join(timeout=5)
+        if self._lease is not None and self.is_leader:
+            # Clean abdication: a standby wins its next election
+            # attempt instead of waiting out lease_timeout_s.
+            self._lease.release()
         if self._ring is not None:
             try:
                 self._ring.close(unlink=True)

@@ -1,0 +1,515 @@
+"""HA coordinator primitives (ISSUE 20): leader election, epoch
+fencing, and the durable intake journal.
+
+The fleet survives any worker dying (round 13) and coordinates through
+a crash-safe shm ring (round 22), but the coordinator itself was a
+single point of failure — ROADMAP item 2(a). This module closes it
+with the same spool discipline everything else uses: every transition
+is one atomic filesystem operation, so a coordinator killed at ANY
+instant (SIGKILL included) leaves only recoverable state.
+
+Three cooperating pieces, all spool-resident:
+
+- :class:`LeaderLease` — the leader election. Candidates race one
+  ``os.link`` onto ``coord/leader.lease.json`` (first-writer-wins, the
+  result-publication discipline); the winner heartbeats the lease file
+  by ``os.utime`` every monitor tick (the round-13 worker-lease
+  discipline, reused verbatim: heartbeat + ``lease_timeout_s`` expiry).
+  A stale lease is SEIZED with one ``os.rename`` onto a tombstone name
+  (exactly one of N racing standbys wins the rename), after which the
+  seizer links its own lease. Every won election carries a
+  monotonically increasing **epoch** — ``max(fence, stale lease
+  epoch) + 1`` — and writes it to the durable fence file
+  ``coord/epoch.json`` BEFORE the new leader authors any artifact.
+- **Epoch fencing** — every leader-authored durable artifact (batch
+  files, requeues, quarantines, the ring header) carries the author's
+  epoch. Workers compare a claimed batch's epoch against the fence
+  file and REJECT lower-epoch writes (``leader_fence`` event): a
+  paused-then-resumed zombie leader (SIGSTOP past lease expiry) can
+  keep writing, but nothing it writes after the takeover is ever
+  executed. The unfenceable window — a zombie artifact adopted
+  between the fence write and the new leader's re-stamp — degrades to
+  a benign duplicate execution under the existing first-writer-wins
+  result links: identical bits, never wrongness.
+- :class:`IntakeJournal` — the durable intake. Pre-HA, the DRR
+  scheduler's fair backlog and the ticket→result bookkeeping lived
+  only in the leader's memory; a leader death lost every unformed
+  ticket. In HA mode every submission is journaled FIRST: one atomic
+  ticket file ``intake/<tid>.json`` (temp + rename) then one
+  whole-line ``O_APPEND`` record in ``intake/admissions.jsonl`` (the
+  admission ORDER — what makes the rebuilt fair queues deterministic).
+  A new leader replays the journal from the spool alone: entries are
+  deduped by ticket id (replaying twice admits each ticket exactly
+  once), already-resulted and already-spooled tickets are skipped, and
+  the leader retires a ticket's journal file when its result lands.
+
+:class:`SpoolClient` is the client half: an external process submits
+by journaling (the journal IS the leader rendezvous — whoever leads
+admits it) and awaits the ticket's first-writer-wins result files, so
+a failover is invisible to clients beyond the settle latency.
+
+Fault sites (``robustness/faults.py``): ``coordinator.elect`` fires on
+every acquisition attempt (a raise makes the candidate lose the round
+and retry), ``coordinator.journal`` on every journal write/replay.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+from libpga_tpu.robustness import faults as _faults
+
+__all__ = [
+    "COORD_DIR",
+    "INTAKE_DIR",
+    "LeaderLease",
+    "IntakeJournal",
+    "SpoolClient",
+    "leadership_snapshot",
+]
+
+#: Spool subdirectories owned by this module. Deliberately NOT in
+#: ``Spool.DIRS``: a single-coordinator fleet (``coordinators=1``, the
+#: default) must keep byte-for-byte spool compatibility with round-23
+#: spools, so these exist only once an HA fleet touches the spool.
+COORD_DIR = "coord"
+INTAKE_DIR = "intake"
+
+LEASE_NAME = "leader.lease.json"
+FENCE_NAME = "epoch.json"
+ADMISSIONS_NAME = "admissions.jsonl"
+
+
+def _fire(site: str) -> None:
+    if _faults.PLAN is not None:
+        _faults.PLAN.fire(site)
+
+
+class LeaderLease:
+    """The spool-resident leader lease + epoch fence for one fleet.
+
+    ``spool`` is duck-typed (``path``/``read_json``/``write_json`` —
+    the ``serving.fleet.Spool`` surface); keeping it duck-typed avoids
+    a circular import and lets tests drive the election with a bare
+    stand-in. One instance per candidate process."""
+
+    def __init__(self, spool, owner: str, timeout_s: float):
+        self.spool = spool
+        self.owner = str(owner)
+        self.timeout_s = float(timeout_s)
+        os.makedirs(spool.path(COORD_DIR), exist_ok=True)
+
+    # ------------------------------------------------------------ paths
+
+    def lease_path(self) -> str:
+        return self.spool.path(COORD_DIR, LEASE_NAME)
+
+    def fence_path(self) -> str:
+        return self.spool.path(COORD_DIR, FENCE_NAME)
+
+    # ------------------------------------------------------------ fence
+
+    def fence(self) -> int:
+        """The durable fence epoch — the generation every worker and
+        standby compares leader-authored artifacts against. 0 = no
+        leader has ever won on this spool."""
+        rec = self.spool.read_json(self.fence_path())
+        if rec is None:
+            return 0
+        try:
+            return int(rec.get("epoch", 0))
+        except (TypeError, ValueError):
+            return 0
+
+    def _write_fence(self, epoch: int) -> None:
+        # Durable BEFORE the winner authors anything: from this instant
+        # every artifact the previous leader writes is below the fence.
+        self.spool.write_json(self.fence_path(), {
+            "epoch": int(epoch),
+            "pid": os.getpid(),
+            "owner": self.owner,
+            "at": time.time(),
+        })
+
+    # --------------------------------------------------------- election
+
+    def _lease_record(self, epoch: int) -> dict:
+        return {
+            "owner": self.owner,
+            "pid": os.getpid(),
+            "epoch": int(epoch),
+            "acquired": time.time(),
+        }
+
+    def _link_lease(self, epoch: int) -> bool:
+        """First-writer-wins lease publication (the ``Spool.publish``
+        discipline): link a private temp record onto the lease name.
+        Exactly one of N racing candidates succeeds."""
+        path = self.lease_path()
+        tmp = f"{path}.{os.getpid()}.{self.owner[-6:]}.tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(self._lease_record(epoch), fh)
+        try:
+            os.link(tmp, path)
+            return True
+        except OSError:
+            return False
+        finally:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+    def _lease_age(self) -> Optional[float]:
+        try:
+            return max(time.time() - os.stat(self.lease_path()).st_mtime,
+                       0.0)
+        except OSError:
+            return None  # no lease (or it just moved)
+
+    def try_acquire(self) -> Optional[dict]:
+        """One election attempt: ``{"epoch": E, "seized": bool}`` when
+        this candidate now leads, None when a live leader holds the
+        lease (or another candidate won the race — retry next tick).
+
+        Fresh acquisition links a new lease at ``fence + 1``. A lease
+        whose heartbeat is older than ``timeout_s`` is seized: ONE
+        ``os.rename`` onto a tombstone name decides which standby may
+        proceed (atomic — the losers' renames fail), the tombstone's
+        epoch joins the max so the new epoch strictly exceeds the
+        zombie's even if the zombie never wrote the fence."""
+        _fire("coordinator.elect")
+        lease = self.spool.read_json(self.lease_path())
+        age = self._lease_age()
+        if lease is None and age is None:
+            if self._link_lease(self.fence() + 1):
+                return self._won(seized=False)
+            return None
+        if age is not None and age <= self.timeout_s:
+            return None  # live leader (possibly us — callers heartbeat)
+        # Stale lease: seize it. The tombstone carries the loser's pid
+        # so a crashed seizer leaves attributable debris, removed after
+        # its epoch is folded in.
+        stale_epoch = 0
+        if lease is not None:
+            try:
+                stale_epoch = int(lease.get("epoch", 0))
+            except (TypeError, ValueError):
+                stale_epoch = 0
+        tomb = (
+            f"{self.lease_path()}.seized.{os.getpid()}"
+            f".{self.owner[-6:]}"
+        )
+        try:
+            os.rename(self.lease_path(), tomb)
+        except OSError:
+            return None  # another standby seized first (or leader woke)
+        try:
+            rec = self.spool.read_json(tomb)
+            if rec is not None:
+                try:
+                    stale_epoch = max(stale_epoch, int(rec.get("epoch", 0)))
+                except (TypeError, ValueError):
+                    pass
+        finally:
+            try:
+                os.remove(tomb)
+            except OSError:
+                pass
+        if self._link_lease(max(self.fence(), stale_epoch) + 1):
+            return self._won(seized=True)
+        return None  # a third candidate linked between our rename+link
+
+    def _won(self, seized: bool) -> dict:
+        rec = self.spool.read_json(self.lease_path())
+        epoch = self.fence() + 1
+        if rec is not None and rec.get("owner") == self.owner:
+            try:
+                epoch = int(rec.get("epoch", epoch))
+            except (TypeError, ValueError):
+                pass
+        self._write_fence(epoch)
+        return {"epoch": epoch, "seized": bool(seized)}
+
+    # -------------------------------------------------------- heartbeat
+
+    def heartbeat(self) -> bool:
+        """Refresh the lease (one ``os.utime`` — the worker-lease touch
+        verbatim) and confirm this process still owns it. False means
+        leadership is LOST (seized while we were paused, or the file is
+        gone): the caller must stop authoring immediately. The
+        ownership re-read makes a zombie's touch harmless — it may
+        refresh the NEW leader's lease once, which only delays the next
+        (unneeded) election."""
+        path = self.lease_path()
+        try:
+            os.utime(path)
+        except OSError:
+            return False
+        rec = self.spool.read_json(path)
+        return rec is not None and rec.get("owner") == self.owner
+
+    def release(self) -> None:
+        """Clean abdication (``Fleet.close``): remove the lease so a
+        standby takes over after one election attempt instead of a
+        full timeout."""
+        rec = self.spool.read_json(self.lease_path())
+        if rec is not None and rec.get("owner") == self.owner:
+            try:
+                os.remove(self.lease_path())
+            except OSError:
+                pass
+
+
+class IntakeJournal:
+    """The durable intake: atomic per-ticket files + an ``O_APPEND``
+    admission log, under ``<spool>/intake/``.
+
+    Write path (``record``): the ticket file lands first (temp +
+    rename — the batch-file discipline), then one whole-line append to
+    the admission log. A crash between the two leaves an unlogged
+    ticket file; replay appends unlogged files after the logged order
+    (name-sorted), so nothing durable is ever lost. Replay
+    (``entries``) is idempotent by construction: entries are deduped by
+    ticket id and ordered by FIRST log occurrence, so replaying the
+    log twice admits each ticket exactly once. A completed ticket's
+    journal file is retired (``retire``) — its log line stays, ordering
+    only."""
+
+    def __init__(self, spool):
+        self.spool = spool
+        os.makedirs(spool.path(INTAKE_DIR), exist_ok=True)
+
+    def entry_path(self, tid: str) -> str:
+        return self.spool.path(INTAKE_DIR, f"{tid}.json")
+
+    def log_path(self) -> str:
+        return self.spool.path(INTAKE_DIR, ADMISSIONS_NAME)
+
+    def record(
+        self, tid: str, ticket: dict, tenant: str, priority: int,
+        trace_id: Optional[str], epoch: int,
+    ) -> None:
+        """Make one submission durable. The ticket file is the payload
+        (everything a new leader needs to re-admit), the log line the
+        order."""
+        _fire("coordinator.journal")
+        self.spool.write_json(self.entry_path(tid), {
+            "tid": tid,
+            "epoch": int(epoch),
+            "submitted_at": time.time(),
+            "trace_id": trace_id,
+            "tenant": tenant,
+            "priority": int(priority),
+            "ticket": dict(ticket),
+        })
+        line = json.dumps(
+            {"tid": tid, "epoch": int(epoch), "ts": time.time()},
+            separators=(",", ":"),
+        ) + "\n"
+        fd = os.open(
+            self.log_path(), os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644
+        )
+        try:
+            os.write(fd, line.encode("utf-8"))
+        finally:
+            os.close(fd)
+
+    def _log_order(self) -> List[str]:
+        """Ticket ids in FIRST-occurrence log order; torn trailing
+        lines (a crash mid-append) are skipped, never fatal."""
+        order: List[str] = []
+        seen: set = set()
+        try:
+            with open(self.log_path(), "r", encoding="utf-8") as fh:
+                for raw in fh:
+                    raw = raw.strip()
+                    if not raw:
+                        continue
+                    try:
+                        tid = json.loads(raw).get("tid")
+                    except (ValueError, AttributeError):
+                        continue
+                    if tid and tid not in seen:
+                        seen.add(tid)
+                        order.append(tid)
+        except OSError:
+            pass
+        return order
+
+    def entries(self) -> List[dict]:
+        """Every LIVE journal entry (retired tickets are gone), deduped
+        by tid, in admission order — logged tickets first (log order),
+        then any unlogged files (crash between file and log line) in
+        name order."""
+        _fire("coordinator.journal")
+        try:
+            names = sorted(
+                n for n in os.listdir(self.spool.path(INTAKE_DIR))
+                if n.endswith(".json")
+            )
+        except OSError:
+            names = []
+        by_tid: Dict[str, dict] = {}
+        for n in names:
+            rec = self.spool.read_json(self.spool.path(INTAKE_DIR, n))
+            if rec is None or not rec.get("tid"):
+                continue
+            by_tid.setdefault(rec["tid"], rec)
+        out: List[dict] = []
+        for tid in self._log_order():
+            rec = by_tid.pop(tid, None)
+            if rec is not None:
+                out.append(rec)
+        out.extend(by_tid[tid] for tid in sorted(by_tid))
+        return out
+
+    def depth(self) -> int:
+        """Live (unretired) journal entries."""
+        try:
+            return sum(
+                1 for n in os.listdir(self.spool.path(INTAKE_DIR))
+                if n.endswith(".json")
+            )
+        except OSError:
+            return 0
+
+    def retire(self, tid: str) -> None:
+        """Drop a completed ticket's journal file (its result is the
+        durable record now)."""
+        try:
+            os.remove(self.entry_path(tid))
+        except OSError:
+            pass
+
+
+class SpoolClient:
+    """Submit-and-await against an HA fleet spool from ANY process.
+
+    No coordinator connection: ``submit`` journals the ticket (the
+    live leader — whoever that is, now or after a failover — admits it
+    from the journal), ``result`` awaits the ticket's first-writer-wins
+    result files. This is how ``Fleet`` client handles "transparently
+    re-resolve the live leader": the spool is the rendezvous, so there
+    is nothing to re-resolve."""
+
+    def __init__(self, spool_dir: str):
+        from libpga_tpu.serving.fleet import Spool
+
+        self.spool = Spool(spool_dir)
+        self.journal = IntakeJournal(self.spool)
+        self._seq = 0
+        self._token = f"{os.getpid():x}-{os.urandom(3).hex()}"
+
+    def submit(self, ticket, tenant: Optional[str] = None,
+               priority: int = 0) -> str:
+        """Journal one ``FleetTicket``; returns its ticket id."""
+        if tenant is not None:
+            ticket = dataclasses.replace(ticket, tenant=tenant)
+        self._seq += 1
+        tid = f"t{self._seq:05d}-{self._token}"
+        self.journal.record(
+            tid=tid, ticket=dataclasses.asdict(ticket),
+            tenant=ticket.tenant or "anon",
+            priority=int(
+                ticket.priority if ticket.priority is not None else priority
+            ),
+            trace_id=None, epoch=0,
+        )
+        return tid
+
+    def poll(self, tid: str) -> bool:
+        return (
+            self.spool.read_json(self.spool.result_paths(tid)[1])
+            is not None
+        )
+
+    def result(self, tid: str, timeout: Optional[float] = None,
+               poll_s: float = 0.05):
+        """Block for one ticket's result (a ``FleetResult``). Raises
+        ``FleetDeadLetter`` on a dead-lettered ticket and
+        ``TimeoutError`` on timeout."""
+        from libpga_tpu.serving.fleet import FleetDeadLetter, FleetResult
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        npz_path, meta_path = self.spool.result_paths(tid)
+        while True:
+            meta = self.spool.read_json(meta_path)
+            if meta is not None:
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"ticket {tid} not completed within {timeout}s"
+                )
+            time.sleep(poll_s)
+        if meta.get("error"):
+            raise FleetDeadLetter(
+                f"ticket {tid} dead-lettered: {meta['error']}"
+            )
+        import numpy as np
+
+        from libpga_tpu.utils.checkpoint import _decode
+
+        with np.load(npz_path) as data:
+            genomes = _decode(
+                data["genomes"], str(data["genomes_dtype"])
+            ).copy()
+            scores = data["scores"].copy()
+            gens = int(data["generations"])
+        return FleetResult(
+            genomes, scores, gens, meta["best_score"], meta.get("worker")
+        )
+
+
+def leadership_snapshot(spool, payloads: List[dict]) -> dict:
+    """The leadership block of ``fleet_status`` — spool alone, live or
+    post-mortem: leader pid/liveness, fence epoch, lease age, standby
+    count (coordinator metric flushes with a live pid that are not the
+    leader), and the last-failover timestamp (the fence write time).
+    ``{"enabled": False}`` on a non-HA spool (no ``coord/``)."""
+    coord = spool.path(COORD_DIR)
+    if not os.path.isdir(coord):
+        return {"enabled": False}
+    lease = spool.read_json(os.path.join(coord, LEASE_NAME))
+    fence = spool.read_json(os.path.join(coord, FENCE_NAME))
+    try:
+        age = max(
+            time.time() - os.stat(os.path.join(coord, LEASE_NAME)).st_mtime,
+            0.0,
+        )
+    except OSError:
+        age = None
+    leader_pid = None if lease is None else lease.get("pid")
+    standbys = 0
+    for p in payloads:
+        if not str(p.get("proc", "")).startswith("coordinator"):
+            continue
+        pid = p.get("pid")
+        if pid == leader_pid:
+            continue
+        alive = _pid_alive(pid)
+        if alive:
+            standbys += 1
+    return {
+        "enabled": True,
+        "leader_pid": leader_pid,
+        "leader_alive": _pid_alive(leader_pid),
+        "epoch": 0 if fence is None else int(fence.get("epoch", 0)),
+        "lease_age_s": age,
+        "standbys": standbys,
+        "last_failover_ts": None if fence is None else fence.get("at"),
+    }
+
+
+def _pid_alive(pid) -> Optional[bool]:
+    try:
+        os.kill(int(pid), 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except (OSError, TypeError, ValueError):
+        return None

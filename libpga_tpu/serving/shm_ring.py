@@ -71,7 +71,11 @@ __all__ = ["ShmRing", "RingError", "RING_FILENAME"]
 RING_FILENAME = "ring.shm"
 
 MAGIC = b"PGARING1"
-LAYOUT_VERSION = 1
+#: v2 (ISSUE 20): the fixed header grew a trailing coordinator-epoch
+#: field — the HA leader-election fence generation stamped at create.
+#: Rings are ephemeral (each coordinator atomically rebuilds its own at
+#: start), so a v1 ring under a v2 reader is simply "stale, rebuild".
+LAYOUT_VERSION = 2
 
 #: Geometry defaults. Stored in the fixed header at create time, so
 #: attachers compute offsets from the file, not from these constants.
@@ -82,7 +86,7 @@ SLOT_SIZE = 128
 N_FRAMES = 512
 FRAME_SIZE = 256
 
-_FIXED_FMT = "<8sIIIIIQd"  # magic, version, slots, frames, fsize, ssize, pid, created
+_FIXED_FMT = "<8sIIIIIQdQ"  # magic, version, slots, frames, fsize, ssize, pid, created, epoch
 _MUT_FMT = "<QQd"  # head, pending_depth, coord_alive
 _SLOT_FMT = "<16sQdQQQ"  # wid, pid, hb, notify, claims, publishes
 _FRAME_HDR_FMT = "<QII"  # seqno, length, crc32
@@ -199,12 +203,17 @@ class ShmRing:
 
     @classmethod
     def create(cls, path: str, hb_slots: int = HB_SLOTS,
-               n_frames: int = N_FRAMES) -> Tuple["ShmRing", dict]:
+               n_frames: int = N_FRAMES,
+               epoch: int = 0) -> Tuple["ShmRing", dict]:
         """Create (or atomically replace) the ring at ``path``; returns
         ``(ring, prior)`` where ``prior`` describes any pre-existing
         ring file — ``{"existed": bool, "stale": bool, "prev_pid": int}``
         — so the coordinator can report a stale ring left by a
-        SIGKILL'd predecessor being rebuilt."""
+        SIGKILL'd predecessor being rebuilt. ``epoch`` (ISSUE 20) is
+        the creating coordinator's leader-election fence generation,
+        stamped into the fixed header: a zombie leader's ring is
+        recognizable by its lower epoch (0 = single-coordinator fleet,
+        no fencing)."""
         prior = {"existed": False, "stale": False, "prev_pid": 0}
         old = cls.peek(path)
         if old is not None:
@@ -218,7 +227,7 @@ class ShmRing:
         buf = bytearray(size)
         struct.pack_into(
             _FIXED_FMT, buf, 0, MAGIC, LAYOUT_VERSION, hb_slots, n_frames,
-            FRAME_SIZE, SLOT_SIZE, os.getpid(), time.time(),
+            FRAME_SIZE, SLOT_SIZE, os.getpid(), time.time(), int(epoch),
         )
         mut = struct.pack(_MUT_FMT, 0, 0, time.time())
         # Seqlock-frame the initial mutable record inside the image so
@@ -287,9 +296,8 @@ class ShmRing:
             os.close(fd)
             raise RingError(f"ring mmap failed: {exc}") from exc
         try:
-            magic, version, hb_slots, n_frames, fsize, ssize, pid, created = (
-                struct.unpack_from(_FIXED_FMT, mm, 0)
-            )
+            (magic, version, hb_slots, n_frames, fsize, ssize, pid,
+             created, epoch) = struct.unpack_from(_FIXED_FMT, mm, 0)
         except struct.error as exc:
             mm.close()
             os.close(fd)
@@ -297,7 +305,7 @@ class ShmRing:
         geom = {
             "hb_slots": hb_slots, "n_frames": n_frames,
             "frame_size": fsize, "slot_size": ssize,
-            "pid": pid, "created": created,
+            "pid": pid, "created": created, "epoch": epoch,
         }
         expect = HDR_SIZE + hb_slots * ssize + n_frames * fsize
         if (magic != MAGIC or version != LAYOUT_VERSION
@@ -610,6 +618,7 @@ class ShmRing:
             out = {
                 "pid": geom["pid"],
                 "created": geom["created"],
+                "epoch": geom["epoch"],
                 "n_frames": geom["n_frames"],
                 "hb_slots": geom["hb_slots"],
                 "coordinator_alive": _pid_alive(geom["pid"]),

@@ -87,7 +87,12 @@ import numpy as np
 
 from libpga_tpu.robustness import faults as _faults
 from libpga_tpu.serving.fleet import Spool, config_from_json
-from libpga_tpu.serving.shm_ring import RING_FILENAME, RingError, ShmRing
+from libpga_tpu.serving.shm_ring import (
+    HB_SLOTS,
+    RING_FILENAME,
+    RingError,
+    ShmRing,
+)
 from libpga_tpu.utils import metrics as _metrics
 from libpga_tpu.utils import telemetry as _tl
 
@@ -161,8 +166,15 @@ class WorkerHarness:
         self._ring_depth = 0
         self._ring_torn = 0
         self._ring_fallback_next = 0.0  # monotonic; 0 => scan due now
+        # Coordinator failover (ISSUE 20): a new leader rebuilds the
+        # ring file in place, which orphans every surviving worker's
+        # mapping. Remember the path + inode so the claim loop and
+        # heartbeat can notice the swap and reattach.
+        self._ring_slot = ring_slot
+        self._ring_path = self.spool.path(RING_FILENAME)
+        self._ring_ino: Optional[int] = None
         if ring_slot >= 0:
-            ring_path = self.spool.path(RING_FILENAME)
+            ring_path = self._ring_path
             try:
                 self._ring = ShmRing.attach(
                     ring_path, slot=ring_slot, worker_id=worker_id
@@ -170,6 +182,10 @@ class WorkerHarness:
             except RingError as exc:
                 self._ring_degrade(f"attach: {exc}")
             else:
+                try:
+                    self._ring_ino = os.stat(ring_path).st_ino
+                except OSError:
+                    self._ring_ino = None
                 self._emit(
                     "ring_attach", role="worker", path=ring_path,
                     stale_replaced=False,
@@ -205,6 +221,62 @@ class WorkerHarness:
         except Exception as exc:
             self._ring_degrade(f"{what} note: {exc}")
 
+    def _ring_check_rebuilt(self) -> None:
+        """Coordinator failover (ISSUE 20): when a new leader won the
+        lease it rebuilt the ring file in place (``create`` is an
+        atomic replace), so this worker's mapping points at a deleted
+        inode — heartbeats and frame reads land in a file nobody
+        reads. Detect the inode swap and reattach to the fresh ring.
+
+        The old mapping is deliberately NOT closed: the heartbeat
+        thread may be mid-call on it, and an unmapped buffer under a
+        live reader is a crash. One leaked (small) mapping per
+        failover is the price of lock-freedom here.
+
+        Slot choice: surviving workers probe for a free slot from the
+        TOP of the slot table while the coordinator assigns spawn
+        slots from the bottom, so the two populations only collide
+        once the table is nearly full — and even then a collision is
+        benign (last-writer-wins attribution; at worst one spurious
+        requeue whose re-execution is bit-identical under
+        first-writer-wins results)."""
+        if self._ring is None:
+            return
+        try:
+            ino = os.stat(self._ring_path).st_ino
+        except OSError:
+            return  # leaderless window: keep the old mapping for now
+        if self._ring_ino is not None and ino == self._ring_ino:
+            return
+        slot = self._ring_slot
+        try:
+            probe = ShmRing.attach(self._ring_path)
+            try:
+                bound = {rec["slot"] for rec in probe.slots()}
+            finally:
+                probe.close()
+            for idx in range(HB_SLOTS - 1, -1, -1):
+                if idx not in bound:
+                    slot = idx
+                    break
+            fresh = ShmRing.attach(
+                self._ring_path, slot=slot, worker_id=self.wid
+            )
+        except RingError as exc:
+            self._ring_degrade(f"reattach: {exc}")
+            return
+        self._ring = fresh
+        self._ring_ino = ino
+        self._ring_slot = slot
+        self._ring_head = 0
+        self._ring_depth = 0
+        self._ring_fallback_next = 0.0  # force a spool scan right away
+        _metrics.REGISTRY.counter("fleet.ring.reattaches").bump()
+        self._emit(
+            "ring_attach", role="worker", path=self._ring_path,
+            stale_replaced=True,
+        )
+
     # --------------------------------------------------------------- events
 
     def _emit(self, event: str, **fields) -> None:
@@ -229,6 +301,11 @@ class WorkerHarness:
                 # scenario).
                 if _faults.PLAN is not None:
                     _faults.PLAN.fire("worker.heartbeat")
+                if self._ring is not None:
+                    # Failover (ISSUE 20): a new leader rebuilt the
+                    # ring — heartbeat into the fresh one, not the
+                    # orphaned inode.
+                    self._ring_check_rebuilt()
                 ring = self._ring
                 if ring is not None:
                     # Ring mode (ISSUE 18): the heartbeat is one framed
@@ -319,6 +396,8 @@ class WorkerHarness:
         overflow, torn frame, or the bounded ``ring_fallback_s``
         cadence falls back to the full name-sorted spool listing — the
         pre-ring behavior, so nothing can hide behind a quiet ring."""
+        if self._ring is not None:
+            self._ring_check_rebuilt()
         ring = self._ring
         if ring is None:
             return self.spool.pending_batches()
@@ -346,6 +425,18 @@ class WorkerHarness:
             return listing + [n for n in names if n not in known]
         return names
 
+    def _fence_epoch(self) -> int:
+        """The spool's leader-epoch fence (ISSUE 20). 0 when the fence
+        file does not exist — i.e. single-coordinator spools, where no
+        batch carries an epoch and nothing is ever fenced."""
+        rec = self.spool.read_json(self.spool.path("coord", "epoch.json"))
+        if rec is None:
+            return 0
+        try:
+            return int(rec.get("epoch", 0))
+        except (TypeError, ValueError):
+            return 0
+
     def claim(self) -> Optional[str]:
         """Claim the oldest pending batch via atomic rename; None when
         nothing is claimable."""
@@ -357,6 +448,29 @@ class WorkerHarness:
                 os.rename(src, dst)
             except OSError:
                 continue  # another worker won this one
+            batch = self.spool.read_json(dst)
+            # Epoch fencing (ISSUE 20): a batch stamped by a deposed
+            # leader (epoch below the spool's fence) is a zombie write
+            # — drop it on the floor BEFORE taking a lease, so the
+            # live leader's re-stamped copy is the only one served.
+            # Non-HA batches carry no "epoch" key and skip this
+            # entirely.
+            bep = None if batch is None else batch.get("epoch")
+            if bep is not None:
+                fence = self._fence_epoch()
+                if int(bep) < fence:
+                    try:
+                        os.remove(dst)
+                    except OSError:
+                        pass
+                    _metrics.REGISTRY.counter(
+                        "fleet.leader.fenced_writes"
+                    ).bump()
+                    self._emit(
+                        "leader_fence", what="batch", epoch=int(bep),
+                        fence=fence, batch=name,
+                    )
+                    continue
             self.spool.write_json(
                 self.spool.lease_path(name),
                 {"worker": self.wid, "pid": os.getpid(),
@@ -364,7 +478,6 @@ class WorkerHarness:
             )
             claimed = _tl.anchored_wall()
             self._claim_wall[name] = claimed
-            batch = self.spool.read_json(dst)
             trace_on = bool(batch.get("trace", False)) if batch else False
             self._trace_on[name] = trace_on
             if trace_on:

@@ -194,6 +194,21 @@ EVENT_FIELDS: Dict[str, tuple] = {
     # bit-identical and would otherwise be invisible.
     "ring_attach": ("role", "path", "stale_replaced"),
     "ring_degraded": ("role", "reason"),
+    # HA coordinator (ISSUE 20, ``serving/ha.py``): one ``leader_elect``
+    # per won election (epoch is the fence generation; ``takeover`` is
+    # True when the win seized a stale predecessor's lease), one
+    # ``leader_fence`` per rejected lower-epoch write (a zombie
+    # leader's late batch/ring artifact — ``what`` names the artifact
+    # kind, ``epoch``/``fence`` the stale and current generations),
+    # one ``coordinator_failover`` per completed takeover rebuild
+    # (journaled tickets re-admitted, in-flight batches adopted), and
+    # one ``intake_journal_replay`` per journal replay scan (idempotent:
+    # ``admitted`` counts first-sightings only, ``skipped`` the
+    # already-seen/already-resulted entries).
+    "leader_elect": ("epoch", "takeover"),
+    "leader_fence": ("what", "epoch", "fence"),
+    "coordinator_failover": ("epoch", "readmitted", "adopted"),
+    "intake_journal_replay": ("epoch", "admitted", "skipped"),
 }
 
 

@@ -1,0 +1,343 @@
+"""Coordinator HA (ISSUE 20): leader election, epoch fencing, and the
+durable intake journal.
+
+The acceptance properties, scaled down to tier-1 budgets:
+
+- exactly one of N racing candidates wins the leader lease, and the
+  epoch only ever goes up — including across a stale-lease seizure;
+- a zombie leader (alive but not heartbeating past the lease timeout)
+  is fenced: its late batch writes carry a stale epoch and workers
+  refuse to serve them;
+- replaying the intake journal is idempotent — every ticket is
+  admitted exactly once no matter how many times a (new) leader
+  replays — and a failover finishes the journaled work bit-identical
+  to an uninterrupted single-process run.
+
+The multi-process murder matrix (kill -9 at the four protocol points)
+lives in ``tools/ha_smoke.py``.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from libpga_tpu import PGA, PGAConfig
+from libpga_tpu.config import FleetConfig
+from libpga_tpu.serving import ha
+from libpga_tpu.serving.fleet import (
+    Fleet,
+    FleetTicket,
+    Spool,
+    _parse_coord_chaos,
+    fleet_status,
+)
+from libpga_tpu.serving.worker import WorkerHarness
+from libpga_tpu.utils import telemetry
+
+POP, LEN = 64, 16
+CFG = PGAConfig(use_pallas=False)
+
+
+def engine_run(seed, n, pop=POP, length=LEN):
+    pga = PGA(seed=seed, config=CFG)
+    pga.create_population(pop, length)
+    pga.set_objective("onemax")
+    pga.run(n)
+    return np.array(pga._populations[0].genomes, copy=True)
+
+
+def wait_for(cond, timeout=60, interval=0.02, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def ha_fc(**kw):
+    base = dict(
+        n_workers=1, max_batch=2, max_wait_ms=5.0, lease_timeout_s=1.2,
+        heartbeat_s=0.2, poll_s=0.05, metrics_flush_s=0.5, ring=False,
+        coordinators=2,
+    )
+    base.update(kw)
+    return FleetConfig(**base)
+
+
+def halt(fleet):
+    """Freeze a coordinator in place — the SIGSTOP/SIGKILL analog for
+    in-process fleets: the monitor (heartbeats, elections, scans)
+    stops, but the object and its spool state stay inspectable."""
+    fleet._stop_monitor.set()
+    fleet._wake.set()
+    if fleet._monitor is not None:
+        fleet._monitor.join(timeout=10)
+    fleet._closed = True
+
+
+def age_lease(spool, by_s):
+    """Backdate the leader lease so the next election attempt sees it
+    stale — the SIGSTOP zombie without the wall-clock wait."""
+    path = spool.path(ha.COORD_DIR, ha.LEASE_NAME)
+    past = time.time() - by_s
+    os.utime(path, (past, past))
+
+
+# ------------------------------------------------------------- election
+
+
+def test_election_single_winner_race(tmp_path):
+    spool = Spool(str(tmp_path / "spool"))
+    wins = []
+    barrier = threading.Barrier(6)
+
+    def race(i):
+        lease = ha.LeaderLease(spool, owner=f"cand-{i:06d}", timeout_s=5.0)
+        barrier.wait()
+        won = lease.try_acquire()
+        if won is not None:
+            wins.append((i, won))
+
+    threads = [threading.Thread(target=race, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(wins) == 1, f"exactly one winner expected, got {wins}"
+    _, won = wins[0]
+    assert won["epoch"] == 1 and not won["seized"]
+    rec = spool.read_json(spool.path(ha.COORD_DIR, ha.FENCE_NAME))
+    assert rec["epoch"] == 1
+
+
+def test_epoch_monotonic_across_seizure(tmp_path):
+    spool = Spool(str(tmp_path / "spool"))
+    a = ha.LeaderLease(spool, owner="aaaaaa", timeout_s=1.0)
+    won = a.try_acquire()
+    assert won == {"epoch": 1, "seized": False}
+    b = ha.LeaderLease(spool, owner="bbbbbb", timeout_s=1.0)
+    assert b.try_acquire() is None, "fresh lease must not be seized"
+    age_lease(spool, by_s=5.0)
+    won_b = b.try_acquire()
+    assert won_b is not None and won_b["seized"]
+    assert won_b["epoch"] == 2, "epoch must go UP across a seizure"
+    assert b.fence() == 2
+    # the deposed owner's heartbeat notices the loss
+    assert a.heartbeat() is False
+    # and a third seizure keeps climbing
+    age_lease(spool, by_s=5.0)
+    c = ha.LeaderLease(spool, owner="cccccc", timeout_s=1.0)
+    assert c.try_acquire()["epoch"] == 3
+
+
+def test_heartbeat_keeps_lease_fresh(tmp_path):
+    spool = Spool(str(tmp_path / "spool"))
+    a = ha.LeaderLease(spool, owner="aaaaaa", timeout_s=1.0)
+    assert a.try_acquire() is not None
+    age_lease(spool, by_s=5.0)
+    assert a.heartbeat() is True  # utime refreshes the mtime
+    b = ha.LeaderLease(spool, owner="bbbbbb", timeout_s=1.0)
+    assert b.try_acquire() is None, "a heartbeated lease is not stale"
+
+
+# -------------------------------------------------------------- journal
+
+
+def test_journal_replay_idempotent(tmp_path):
+    spool = Spool(str(tmp_path / "spool"))
+    j = ha.IntakeJournal(spool)
+    ticket = {"size": POP, "genome_len": LEN, "n": 3, "seed": 1}
+    for i in range(3):
+        j.record(f"t{i:05d}-x", dict(ticket, seed=i), tenant=None,
+                 priority=0, trace_id=None, epoch=1)
+    # duplicate record of an existing tid: entries() still dedupes
+    j.record("t00001-x", dict(ticket, seed=1), tenant=None,
+             priority=0, trace_id=None, epoch=1)
+    first = [e["tid"] for e in j.entries()]
+    second = [e["tid"] for e in j.entries()]
+    assert first == second == ["t00000-x", "t00001-x", "t00002-x"]
+    assert j.depth() == 3
+    j.retire("t00001-x")
+    assert [e["tid"] for e in j.entries()] == ["t00000-x", "t00002-x"]
+    j.retire("t00001-x")  # idempotent
+    assert j.depth() == 2
+
+
+def test_fleet_replay_admits_exactly_once(tmp_path):
+    spool_dir = str(tmp_path / "spool")
+    a = Fleet(spool_dir, "onemax", config=CFG, fleet=ha_fc())
+    assert a.is_leader and a.epoch == 1
+    # durable-before-visible: submitting journals the ticket
+    h = a.submit(FleetTicket(size=POP, genome_len=LEN, n=3, seed=7))
+    assert a._journal.depth() == 1
+    assert a.sched.depth() == 1
+    # replaying over an already-admitted journal is a no-op
+    admitted, skipped = a._replay_intake()
+    assert (admitted, skipped) == (0, 0)
+    assert a.sched.depth() == 1
+    halt(a)  # A dies; its lease goes stale
+    # a second candidate replays the same journal into its OWN sched
+    b = Fleet(spool_dir, "onemax", config=CFG, fleet=ha_fc())
+    assert not b.is_leader
+    age_lease(a.spool, by_s=5.0)
+    won = b._lease.try_acquire()
+    assert won is not None and won["epoch"] == 2
+    b._become_leader(won, during_init=True)  # no worker spawn in-test
+    assert b.sched.depth() == 1, "journaled ticket re-admitted once"
+    assert h.tid in b._handles
+    admitted, skipped = b._replay_intake()
+    assert (admitted, skipped) == (0, 0), "second replay is a no-op"
+    halt(b)
+
+
+# -------------------------------------------------------------- fencing
+
+
+def test_zombie_leader_batch_fenced(tmp_path):
+    spool_dir = str(tmp_path / "spool")
+    a = Fleet(spool_dir, "onemax", config=CFG, fleet=ha_fc())
+    assert a.is_leader
+    a.submit(FleetTicket(size=POP, genome_len=LEN, n=3, seed=7))
+    # SIGSTOP analog: freeze A's monitor so it neither heartbeats nor
+    # notices the coming seizure
+    a._stop_monitor.set()
+    a._wake.set()
+    if a._monitor is not None:
+        a._monitor.join(timeout=10)
+    # the standby seizes the stale lease while A is stopped
+    b = Fleet(spool_dir, "onemax", config=CFG, fleet=ha_fc())
+    age_lease(a.spool, by_s=5.0)
+    won = b._lease.try_acquire()
+    b._become_leader(won, during_init=True)
+    assert b.epoch == 2
+    # A resumes, still believing it leads, and releases its batch with
+    # the stale epoch
+    assert a.is_leader  # the zombie has not noticed yet
+    released = a._schedule(urgent=True)
+    assert released >= 1
+    names = a.spool.pending_batches()
+    assert names
+    batch = a.spool.read_json(a.spool.path("pending", names[0]))
+    assert batch["epoch"] == 1
+    # a worker refuses it: claim removes the file, takes NO lease
+    w = WorkerHarness(spool_dir, "wtest", heartbeat_s=0.2, poll_s=0.05)
+    assert w.claim() is None
+    assert a.spool.pending_batches() == []
+    assert a.spool.claimed_batches() == []
+    assert not os.path.exists(a.spool.lease_path(names[0]))
+    # the zombie's own heartbeat discipline would now demote it
+    assert a._lease.heartbeat() is False
+    a._closed = True
+    halt(b)
+
+
+def test_adopted_batch_is_served_not_fenced(tmp_path):
+    spool_dir = str(tmp_path / "spool")
+    a = Fleet(spool_dir, "onemax", config=CFG, fleet=ha_fc())
+    a.submit(FleetTicket(size=POP, genome_len=LEN, n=3, seed=7))
+    a._schedule(urgent=True)  # batch released BEFORE the failover
+    names = a.spool.pending_batches()
+    assert names and a.spool.read_json(
+        a.spool.path("pending", names[0]))["epoch"] == 1
+    halt(a)  # A dies with its batch still pending
+    b = Fleet(spool_dir, "onemax", config=CFG, fleet=ha_fc())
+    age_lease(a.spool, by_s=5.0)
+    b._become_leader(b._lease.try_acquire(), during_init=True)
+    # adoption re-stamped the pending batch to the new epoch in place
+    batch = b.spool.read_json(b.spool.path("pending", names[0]))
+    assert batch["epoch"] == 2
+    w = WorkerHarness(spool_dir, "wtest", heartbeat_s=0.2, poll_s=0.05)
+    claimed = w.claim()
+    assert claimed == names[0], "adopted batch must stay claimable"
+    w._shutdown(clean=False)
+    halt(b)
+
+
+# ------------------------------------------------------------- failover
+
+
+def test_failover_finishes_journaled_work_bit_identical(tmp_path):
+    spool_dir = str(tmp_path / "spool")
+    a = Fleet(spool_dir, "onemax", config=CFG, fleet=ha_fc())
+    assert a.is_leader
+    client = ha.SpoolClient(spool_dir)
+    tid = client.submit(FleetTicket(size=POP, genome_len=LEN, n=4, seed=11))
+    # A dies before ever admitting the client's ticket (never started)
+    a._closed = True
+    events_path = str(tmp_path / "events.jsonl")
+    log = telemetry.EventLog(events_path)
+    b = Fleet(spool_dir, "onemax", config=CFG, fleet=ha_fc(), events=log)
+    assert not b.is_leader
+    age_lease(b.spool, by_s=5.0)
+    b.start()  # standby start: monitor only; takeover spawns workers
+    try:
+        wait_for(lambda: b.is_leader, timeout=30, what="takeover")
+        assert b.epoch == 2 and b.failovers == 1
+        res = client.result(tid, timeout=120)
+        np.testing.assert_array_equal(res.genomes, engine_run(11, 4))
+        st = fleet_status(spool_dir)
+        ld = st["leadership"]
+        assert ld["enabled"] and ld["epoch"] == 2
+        assert ld["leader_pid"] == os.getpid()
+    finally:
+        b.close()
+        log.close()
+    records = telemetry.validate_log(events_path)
+    kinds = [r["event"] for r in records]
+    assert "leader_elect" in kinds
+    assert "coordinator_failover" in kinds
+    assert "intake_journal_replay" in kinds
+
+
+# ---------------------------------------------------- config + plumbing
+
+
+def test_coordinators_config_validation():
+    with pytest.raises(ValueError):
+        FleetConfig(coordinators=0)
+    assert FleetConfig().coordinators == 1
+
+
+def test_single_coordinator_spool_untouched(tmp_path):
+    """coordinators=1 (the default) must keep the round-23 spool
+    byte-compatible: no coord/ or intake/ directories, no epoch field
+    in batch files, leadership disabled in fleet_status."""
+    spool_dir = str(tmp_path / "spool")
+    f = Fleet(spool_dir, "onemax", config=CFG,
+              fleet=ha_fc(coordinators=1))
+    assert f.is_leader and f.epoch == 0
+    f.submit(FleetTicket(size=POP, genome_len=LEN, n=3, seed=7))
+    f._schedule(urgent=True)
+    assert not os.path.isdir(f.spool.path(ha.COORD_DIR))
+    assert not os.path.isdir(f.spool.path(ha.INTAKE_DIR))
+    names = f.spool.pending_batches()
+    batch = f.spool.read_json(f.spool.path("pending", names[0]))
+    assert "epoch" not in batch
+    assert fleet_status(spool_dir)["leadership"] == {"enabled": False}
+    halt(f)
+
+
+def test_parse_coord_chaos():
+    assert _parse_coord_chaos("") == []
+    plan = _parse_coord_chaos("sigkill@batch_form:2")
+    assert len(plan) == 1
+    with pytest.raises(ValueError):
+        _parse_coord_chaos("sigkill@nonsense:1")
+    with pytest.raises(ValueError):
+        _parse_coord_chaos("gibberish")
+
+
+def test_status_carries_leadership_fields(tmp_path):
+    spool_dir = str(tmp_path / "spool")
+    f = Fleet(spool_dir, "onemax", config=CFG, fleet=ha_fc())
+    st = f.status()
+    coord = st["coordinator"]
+    assert coord["coordinators"] == 2
+    assert coord["is_leader"] is True
+    assert coord["epoch"] == 1
+    assert coord["failovers"] == 0
+    f._closed = True

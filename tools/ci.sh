@@ -517,4 +517,7 @@ JAX_PLATFORMS=cpu python tools/perf_report.py --backfill --db "$(mktemp -d)/scra
 echo "== ci: ring smoke =="
 JAX_PLATFORMS=cpu python tools/ring_smoke.py
 
+echo "== ci: ha smoke =="
+JAX_PLATFORMS=cpu python tools/ha_smoke.py
+
 echo "== ci: all stages passed =="

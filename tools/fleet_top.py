@@ -81,6 +81,31 @@ def _ring_line(ring) -> str:
     )
 
 
+def _leader_line(ld) -> str:
+    """Coordinator-HA leadership (ISSUE 20), from the spool alone:
+    leader pid + liveness, fence epoch, lease age, hot-standby count
+    and the last failover time — the post-mortem of a murdered leader
+    reads ``DEAD`` with the standby count that should have taken
+    over."""
+    if not ld or not ld.get("enabled"):
+        return "leadership: single coordinator (HA off)"
+    pid = ld.get("leader_pid")
+    alive = ld.get("leader_alive")
+    state = "?" if alive is None else ("up" if alive else "DEAD")
+    last = ld.get("last_failover_ts")
+    last_s = (
+        "never" if not last
+        else f"{_fmt_s(max(time.time() - last, 0.0))} ago"
+    )
+    return (
+        f"leadership: leader pid={pid if pid is not None else '?'} ({state})"
+        f"  epoch={ld.get('epoch', 0)}"
+        f"  lease_age={_fmt_s(ld.get('lease_age_s'))}"
+        f"  standbys={ld.get('standbys', 0)}"
+        f"  last_failover={last_s}"
+    )
+
+
 def render(status: dict, stale_after_s: float = 10.0) -> str:
     """One screenful of fleet state from a ``fleet_status`` dict —
     pure string building, no I/O (testable against synthetic spools)."""
@@ -103,6 +128,7 @@ def render(status: dict, stale_after_s: float = 10.0) -> str:
             f"  dead_letters={c['dead_letters']}"
         ),
         _ring_line(status.get("ring")),
+        _leader_line(status.get("leadership")),
     ]
     lines.append(
         f"{'worker':<8}{'pid':>8}  {'state':<10}{'flush':>7}"

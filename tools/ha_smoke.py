@@ -1,0 +1,367 @@
+#!/usr/bin/env python
+"""Coordinator-HA failover smoke (ISSUE 20) — ci.sh stage.
+
+Two REAL coordinator processes (``python -m
+libpga_tpu.serving.coordinator``) against one spool, real workers,
+real ``kill -9`` — the chaos-style acceptance of ROADMAP item 2(a):
+
+1. **Live failover, mid-burst**: clients submit through the durable
+   intake journal (``SpoolClient``); the leader is SIGKILLed while the
+   burst is in flight; the hot standby must seize the lease within the
+   lease-timeout discipline (settle time asserted and reported), adopt
+   the spool, replay the journal, and finish EVERY ticket
+   bit-identical to a same-seed standalone engine run. Nothing is
+   resubmitted.
+2. **Post-failover intake**: fresh submissions (two tenants) after the
+   failover complete bit-identical too — the journal + DRR quota
+   accounting survived the leader change (asserted from the new
+   leader's own metrics flush).
+3. **Kill-point chaos matrix**: four more fleets, each killing the
+   leader at a DIFFERENT protocol point via ``PGA_COORD_CHAOS``
+   (mid-batch-formation, mid-requeue — compounded with a worker death,
+   mid-ring-write, mid-autoscale). Every round must fail over and
+   deliver all results bit-identical.
+4. The merged spool metrics exposition lints clean
+   (``metrics_dump.py --check``) and ``fleet_top.py`` renders the
+   leadership line post-mortem.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+TOOLS = os.path.dirname(os.path.abspath(__file__))
+
+POP, LEN, GENS = 128, 16, 4
+LEASE_S = 2.0
+HEARTBEAT_S = 0.4
+#: Settle-time ceiling: lease timeout + generous CI slack (the lease
+#: must EXPIRE before a standby may seize — sub-lease settles would
+#: mean an unsafe early seizure, so only the upper bound is asserted).
+SETTLE_CEILING_S = LEASE_S + 8.0
+
+
+def _fail(stage: str, msg: str, logs=()):
+    for path in logs:
+        try:
+            with open(path, "r", encoding="utf-8", errors="replace") as fh:
+                tail = fh.readlines()[-25:]
+            print(f"--- {path} ---\n{''.join(tail)}", file=sys.stderr)
+        except OSError:
+            pass
+    print(f"FAIL [{stage}] {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _ok(stage: str, msg: str):
+    print(f"ok   [{stage}] {msg}")
+
+
+def main() -> int:
+    import numpy as np
+
+    from libpga_tpu import PGA, PGAConfig
+    from libpga_tpu.serving import ha as H
+    from libpga_tpu.serving.fleet import (
+        FleetTicket, Spool, load_spool_metrics, merge_spool_metrics,
+    )
+    from libpga_tpu.utils import metrics as M
+
+    cfg = PGAConfig(use_pallas=False)
+    _refs: dict = {}
+
+    def ref_genomes(seed: int):
+        if seed not in _refs:
+            ref = PGA(seed=seed, config=cfg)
+            ref.create_population(POP, LEN)
+            ref.set_objective("onemax")
+            ref.run(GENS)
+            _refs[seed] = np.array(ref._populations[0].genomes)
+        return _refs[seed]
+
+    def lease_pid(spool_dir):
+        rec = Spool.read_json(
+            os.path.join(spool_dir, H.COORD_DIR, H.LEASE_NAME)
+        )
+        return None if rec is None else rec.get("pid")
+
+    def fence_epoch(spool_dir) -> int:
+        rec = Spool.read_json(
+            os.path.join(spool_dir, H.COORD_DIR, H.FENCE_NAME)
+        )
+        try:
+            return 0 if rec is None else int(rec.get("epoch", 0))
+        except (TypeError, ValueError):
+            return 0
+
+    def wait_for(pred, timeout, what, logs=()):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return
+            time.sleep(0.05)
+        _fail("wait", f"timed out after {timeout}s waiting for {what}",
+              logs)
+
+    def spawn_coord(spool, name, tmp, *, n_workers, extra=(),
+                    env_extra=None):
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        env.update(env_extra or {})
+        log = open(os.path.join(tmp, f"coord_{name}.log"), "wb")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "libpga_tpu.serving.coordinator",
+             "--spool", spool, "--coordinators", "2",
+             "--n-workers", str(n_workers), "--max-batch", "2",
+             "--max-wait-ms", "5", "--lease-timeout-s", str(LEASE_S),
+             "--heartbeat-s", str(HEARTBEAT_S), "--poll-s", "0.05",
+             "--metrics-flush-s", "0.4", *extra],
+            env=env, stdout=log, stderr=log,
+        )
+        proc._log_path = log.name  # type: ignore[attr-defined]
+        log.close()
+        return proc
+
+    def spool_pids(spool):
+        """Every pid that ever flushed metrics into this spool."""
+        pids = set()
+        try:
+            payloads, _ = load_spool_metrics(Spool(spool))
+        except (ValueError, OSError):
+            payloads = []
+        for p in payloads:
+            pid = p.get("pid")
+            if isinstance(pid, int) and pid > 0 and pid != os.getpid():
+                pids.add(pid)
+        return pids
+
+    def sweep(spool, coords):
+        """Graceful coordinator shutdown, then SIGKILL any stragglers
+        (orphaned workers of a murdered leader included)."""
+        for c in coords:
+            if c.poll() is None:
+                c.send_signal(signal.SIGTERM)
+        for c in coords:
+            try:
+                c.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                c.kill()
+                c.wait(timeout=10)
+        for pid in spool_pids(spool):
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    top = tempfile.mkdtemp(prefix="pga-ha-smoke-")
+
+    # ---- Stage 1+2: live failover mid-burst + post-failover intake --
+    spool = os.path.join(top, "main")
+    a = spawn_coord(spool, "a", top, n_workers=4)
+    logs = [a._log_path]
+    coords = [a]
+    try:
+        wait_for(lambda: lease_pid(spool) == a.pid, 60,
+                 "coordinator A to win the initial election", logs)
+        b = spawn_coord(spool, "b", top, n_workers=4)
+        coords.append(b)
+        logs.append(b._log_path)
+
+        sc = H.SpoolClient(spool)
+        tids = [
+            sc.submit(
+                FleetTicket(size=POP, genome_len=LEN, n=GENS,
+                            seed=60_000 + i),
+                tenant=f"t{i % 2}",
+            )
+            for i in range(12)
+        ]
+        # Mid-burst: wait until the fleet is demonstrably serving
+        # (some results durable, workers warm) but the burst is NOT
+        # done, then murder the leader.
+        wait_for(
+            lambda: sum(sc.poll(t) for t in tids) >= 2, 300,
+            "first results before the kill", logs,
+        )
+        if lease_pid(spool) != a.pid:
+            _fail("failover", "leadership moved before the kill", logs)
+        epoch_before = fence_epoch(spool)
+        t0 = time.monotonic()
+        os.kill(a.pid, signal.SIGKILL)
+        a.wait(timeout=30)
+        wait_for(lambda: fence_epoch(spool) > epoch_before,
+                 SETTLE_CEILING_S + 5,
+                 "the standby to seize the lease", logs)
+        settle = time.monotonic() - t0
+        if settle > SETTLE_CEILING_S:
+            _fail("failover",
+                  f"settle {settle:.2f}s exceeds ceiling "
+                  f"{SETTLE_CEILING_S}s", logs)
+        if lease_pid(spool) != b.pid:
+            _fail("failover", "lease holder is not coordinator B", logs)
+        _ok("failover",
+            f"leader SIGKILLed mid-burst; standby seized epoch "
+            f"{fence_epoch(spool)} in {settle:.2f}s "
+            f"(lease timeout {LEASE_S}s)")
+
+        for i, tid in enumerate(tids):
+            res = sc.result(tid, timeout=600)
+            if not np.array_equal(res.genomes, ref_genomes(60_000 + i)):
+                _fail("bits", f"ticket {tid} diverged from the "
+                      "same-seed engine run", logs)
+        _ok("bits", f"all {len(tids)} pre-kill tickets completed "
+            "bit-identical across the failover (zero resubmits)")
+
+        # Post-failover intake: the journal + tenant accounting are
+        # live under the new leader.
+        post = [
+            sc.submit(
+                FleetTicket(size=POP, genome_len=LEN, n=GENS,
+                            seed=61_000 + i),
+                tenant=f"t{i % 2}",
+            )
+            for i in range(4)
+        ]
+        for i, tid in enumerate(post):
+            res = sc.result(tid, timeout=600)
+            if not np.array_equal(res.genomes, ref_genomes(61_000 + i)):
+                _fail("bits", f"post-failover ticket {tid} diverged",
+                      logs)
+
+        def leader_tenants():
+            try:
+                payloads, _ = load_spool_metrics(Spool(spool))
+            except (ValueError, OSError):
+                return set()
+            for p in payloads:
+                if (p.get("pid") == b.pid
+                        and str(p.get("proc", "")).startswith(
+                            "coordinator")):
+                    return {
+                        rec.get("labels", {}).get("tenant")
+                        for rec in p.get("snapshot", {}).get(
+                            "counters", [])
+                        if rec.get("name") == "fleet.tenant.submissions"
+                    }
+            return set()
+
+        wait_for(lambda: {"t0", "t1"} <= leader_tenants(), 30,
+                 "the new leader's per-tenant DRR accounting flush",
+                 logs)
+        _ok("intake", "4 post-failover submissions bit-identical; new "
+            "leader's flush carries both tenants' quota accounting "
+            "(rebuilt from the journal)")
+
+        # Merged exposition lints clean with every proc labeled.
+        merged = merge_spool_metrics(Spool(spool))
+        prom = os.path.join(top, "merged.prom")
+        with open(prom, "w", encoding="utf-8") as fh:
+            fh.write(M.prometheus_text(merged))
+        n_coord = sum(
+            1 for p in merged["merged_from"]
+            if p.startswith("coordinator")
+        )
+        if n_coord < 2:
+            _fail("lint", f"merged exposition covers "
+                  f"{sorted(merged['merged_from'])}, expected both "
+                  "coordinators", logs)
+        lint = subprocess.run(
+            [sys.executable, os.path.join(TOOLS, "metrics_dump.py"),
+             "--check", prom],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        if lint.returncode != 0:
+            _fail("lint", f"{lint.stdout.strip()} {lint.stderr.strip()}",
+                  logs)
+        _ok("lint", f"merged exposition "
+            f"({len(merged['merged_from'])} procs, both coordinators) "
+            "prometheus-lint clean")
+
+        topout = subprocess.run(
+            [sys.executable, os.path.join(TOOLS, "fleet_top.py"),
+             "--spool", spool],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        if topout.returncode != 0 or "leadership:" not in topout.stdout:
+            _fail("top", "fleet_top did not render the leadership "
+                  f"line: {topout.stdout!r} {topout.stderr!r}", logs)
+        _ok("top", "fleet_top renders leadership "
+            + next(l for l in topout.stdout.splitlines()
+                   if l.startswith("leadership:")).removeprefix(
+                       "leadership:").strip())
+    finally:
+        sweep(spool, coords)
+
+    # ---- Stage 3: kill-point chaos matrix ---------------------------
+    # Each round: fresh spool, leader A armed with a PGA_COORD_CHAOS
+    # kill point, standby B clean. A must die BY THE CHAOS (asserted),
+    # B must take over, and every ticket must come back bit-identical.
+    rounds = [
+        ("batch_form", {"PGA_COORD_CHAOS": "sigkill@batch_form:2"}, ()),
+        # Compound: every one of A's workers dies on its first execute
+        # (inherited env), so the leader is requeueing a dead worker's
+        # batch when the requeue kill point fires.
+        ("requeue", {"PGA_COORD_CHAOS": "sigkill@requeue:1",
+                     "PGA_WORKER_CHAOS": "sigkill@execute:1"}, ()),
+        ("ring_write", {"PGA_COORD_CHAOS": "sigkill@ring_write:2"}, ()),
+        ("autoscale", {"PGA_COORD_CHAOS": "sigkill@autoscale:20"},
+         ("--autoscale",)),
+    ]
+    for rnd, (site, chaos_env, extra) in enumerate(rounds):
+        spool = os.path.join(top, f"chaos_{site}")
+        a = spawn_coord(spool, f"{site}_a", top, n_workers=2,
+                        extra=extra, env_extra=chaos_env)
+        coords = [a]
+        logs = [a._log_path]
+        try:
+            wait_for(lambda: lease_pid(spool) == a.pid, 60,
+                     f"[{site}] A to lead", logs)
+            b = spawn_coord(spool, f"{site}_b", top, n_workers=2,
+                            extra=extra)
+            coords.append(b)
+            logs.append(b._log_path)
+            epoch_before = fence_epoch(spool)
+            sc = H.SpoolClient(spool)
+            seeds = [70_000 + 100 * rnd + i for i in range(4)]
+            tids = [
+                sc.submit(FleetTicket(size=POP, genome_len=LEN,
+                                      n=GENS, seed=s))
+                for s in seeds
+            ]
+            try:
+                a.wait(timeout=240)
+            except subprocess.TimeoutExpired:
+                _fail(site, "chaos kill point never fired (leader "
+                      "still alive)", logs)
+            if a.returncode != -signal.SIGKILL:
+                _fail(site, f"leader exited {a.returncode}, expected "
+                      "SIGKILL from the chaos plan", logs)
+            wait_for(lambda: fence_epoch(spool) > epoch_before,
+                     SETTLE_CEILING_S + 5,
+                     f"[{site}] failover after the chaos kill", logs)
+            for s, tid in zip(seeds, tids):
+                res = sc.result(tid, timeout=600)
+                if not np.array_equal(res.genomes, ref_genomes(s)):
+                    _fail(site, f"ticket {tid} diverged after the "
+                          f"{site} kill", logs)
+            _ok(site, f"leader SIGKILLed mid-{site}; epoch "
+                f"{fence_epoch(spool)} took over, all "
+                f"{len(tids)} tickets bit-identical")
+        finally:
+            sweep(spool, coords)
+
+    print("ha smoke: all stages passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

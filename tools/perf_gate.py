@@ -79,6 +79,15 @@ GP_GATE_POP, GP_GATE_NODES, GP_GATE_SAMPLES = 1024, 16, 64
 GP_GATE_ROUNDS = 3
 GP_LO, GP_HI = 5, 15  # GP generations are ~100x heavier than OneMax's
 
+# Coordinator-failover arm (ISSUE 20): the submit blackout — wall
+# seconds from a live leader's last heartbeat to a hot standby holding
+# the lease and leading. Lease-timeout dominated (so the figure is
+# stable on a contended host), and LOWER IS BETTER — the one arm in
+# this gate where a rising number is the regression.
+HA_GATE_METRIC = "ha_gate_failover_settle_s"
+HA_GATE_ROUNDS = 3
+HA_GATE_LEASE_S = 1.5
+
 
 def _runner():
     """The fixed gate workload: OneMax 2048x64 on the XLA path (the
@@ -198,6 +207,56 @@ def _fleet_measure(rounds: int = FLEET_GATE_ROUNDS):
     return samples
 
 
+def _ha_measure(rounds: int = HA_GATE_ROUNDS):
+    """Seconds of coordinator-failover settle per round: two HA
+    candidates on one spool, the leader's monitor (heartbeats) stops
+    cold — the in-process SIGKILL analog — and the clock runs until
+    the standby seizes the stale lease and leads."""
+    import shutil
+    import time
+
+    from libpga_tpu import PGAConfig
+    from libpga_tpu.config import FleetConfig
+    from libpga_tpu.serving.fleet import Fleet
+    from libpga_tpu.utils import metrics as M
+
+    cfg = PGAConfig(use_pallas=False)
+    samples = []
+    for _ in range(rounds):
+        root = tempfile.mkdtemp(prefix="pga-perf-gate-ha-")
+        fc = dict(
+            n_workers=1, max_batch=1, max_wait_ms=2, poll_s=0.05,
+            lease_timeout_s=HA_GATE_LEASE_S, heartbeat_s=0.3,
+            ring=False, coordinators=2,
+        )
+        a = Fleet(os.path.join(root, "spool"), "onemax", config=cfg,
+                  fleet=FleetConfig(**fc),
+                  registry=M.MetricsRegistry())
+        b = Fleet(os.path.join(root, "spool"), "onemax", config=cfg,
+                  fleet=FleetConfig(**fc),
+                  registry=M.MetricsRegistry())
+        try:
+            a._ensure_monitor()  # leader heartbeats, no worker pool
+            b.start()            # standby: election watch only
+            time.sleep(2 * fc["heartbeat_s"])
+            t0 = time.perf_counter()
+            a._stop_monitor.set()
+            a._wake.set()
+            if a._monitor is not None:
+                a._monitor.join(timeout=30)
+            while time.perf_counter() - t0 < 60 and not b.is_leader:
+                time.sleep(0.01)
+            if not b.is_leader:
+                samples.append(float("nan"))  # detect() drops it loudly
+            else:
+                samples.append(time.perf_counter() - t0)
+        finally:
+            a._closed = True
+            b.close()
+            shutil.rmtree(root, ignore_errors=True)
+    return samples
+
+
 def _trip(verdict, events_path: str) -> None:
     """A confirmed regression: emit the validated ``perf_regression``
     event and dump the flight recorder — the triage artifact."""
@@ -251,16 +310,23 @@ def run_gate(db_path: str, record: bool) -> int:
 
     _, _, run = _runner()
     arms = [
-        (_gate_key(), GATE_METRIC, _measure(run), "gate"),
+        (_gate_key(), GATE_METRIC, _measure(run), "gate", True),
         (
             _gate_key("fleet_gate", f"{FLEET_GATE_POP}x{FLEET_GATE_LEN}"),
             FLEET_GATE_METRIC, _fleet_measure(), "fleet_gate ring=on",
+            True,
         ),
         (
             _gate_key(
                 "gp_gate", f"{GP_GATE_POP}x{GP_GATE_NODES}nodes"
             ),
-            GP_GATE_METRIC, _gp_measure(), "gp_gate optimize=on",
+            GP_GATE_METRIC, _gp_measure(), "gp_gate optimize=on", True,
+        ),
+        # ISSUE 20: seconds, not a rate — lower is better here.
+        (
+            _gate_key("ha_gate", "2coordx1worker"),
+            HA_GATE_METRIC, _ha_measure(), "ha_gate coordinators=2",
+            False,
         ),
     ]
 
@@ -269,14 +335,15 @@ def run_gate(db_path: str, record: bool) -> int:
     rev = git_rev()
     verdicts = []
     recorded = 0
-    for key, metric, samples, note in arms:
+    for key, metric, samples, note, higher in arms:
         current = statistics.median(samples)
         print(f"perf_gate: {key.as_string()} {metric} "
               f"median={current:.2f} "
-              f"rounds={[round(s, 1) for s in samples]}")
+              f"rounds={[round(s, 2) for s in samples]}")
         baseline = [s.value for s in hist.series(key, metric)]
         verdicts.append(detect(baseline, current, metric=metric,
-                               drift_floor=CROSS_PROCESS_FLOOR))
+                               drift_floor=CROSS_PROCESS_FLOOR,
+                               higher_is_better=higher))
         if record:
             # One run_id per SAMPLE: identity is (key, metric, round,
             # run_id, source), so same-run samples need distinct ids.
